@@ -1,0 +1,105 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on JAX/XLA/Pallas/pjit.
+
+Architecture notes live in SURVEY.md §7 of the repo root; each module
+docstring cites the reference component (file:line) it re-implements.
+"""
+
+from . import dtypes, errors, flags
+from .dtypes import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    get_default_dtype, int8, int16, int32, int64, set_default_dtype, uint8,
+)
+from .flags import get_flags, set_flags  # noqa: F401
+from .core import (  # noqa: F401
+    Parameter, Tensor, enable_grad, grad, is_grad_enabled, is_tensor, no_grad,
+    set_grad_enabled, to_tensor,
+)
+from .core.random import get_rng_state, seed, set_rng_state  # noqa: F401
+from .ops import *  # noqa: F401,F403
+from .ops import creation as _creation  # noqa: F401
+from . import ops  # noqa: F401
+
+version = "0.1.0"
+__version__ = version
+
+
+def disable_static(place=None):
+    """Eager (dygraph) mode is the only mode; kept for API parity."""
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static "
+        "(XLA compilation) instead.")
+
+
+def in_dynamic_mode():
+    return True
+
+
+_device = [None]
+
+
+def set_device(device: str):
+    _device[0] = device
+    return device
+
+
+def get_device() -> str:
+    if _device[0] is not None:
+        return _device[0]
+    import jax
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def device_count() -> int:
+    import jax
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    import builtins
+    import jax
+    # note: bare any/all/sum/... here are paddle ops after the star-import above
+    return builtins.any(d.platform == "tpu" for d in jax.devices())
+
+
+# Subsystem imports (each mirrors a reference python/paddle/* package).
+_SUBMODULES = [
+    "nn", "optimizer", "amp", "io", "jit", "autograd", "framework", "vision",
+    "linalg", "fft", "incubate", "metric", "sparse", "profiler", "hapi",
+    "device", "distributed", "distribution", "static", "audio", "text",
+    "quantization", "utils",
+]
+
+
+def __getattr__(name):
+    """Lazy submodule import (keeps `import paddle_tpu` cheap and cycle-free)."""
+    if name in _SUBMODULES:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name in ("save", "load"):
+        from .framework import io as _fio
+        globals()["save"], globals()["load"] = _fio.save, _fio.load
+        return globals()[name]
+    if name in ("Model", "summary"):
+        from . import hapi as _hapi
+        globals()["Model"], globals()["summary"] = _hapi.Model, _hapi.summary
+        return globals()[name]
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel as _DP
+        globals()["DataParallel"] = _DP
+        return _DP
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

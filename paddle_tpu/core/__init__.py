@@ -1,0 +1,3 @@
+from . import autograd, random  # noqa: F401
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad, run_backward, set_grad_enabled  # noqa: F401
+from .tensor import Parameter, Tensor, is_tensor, to_tensor  # noqa: F401

@@ -1,0 +1,354 @@
+"""Define-by-run autograd engine.
+
+TPU-native redesign of the reference's eager autograd
+(paddle/fluid/eager/grad_node_info.h ``GradNodeBase``,
+paddle/fluid/eager/backward.cc ``RunBackward``): every differentiable op call
+runs ``jax.vjp`` on its pure-JAX primitive, producing the op output *and* a
+pullback whose residuals live on device — the pullback plays the role the
+reference's generated ``GradNode`` + ``TensorWrapper`` pair plays.  ``backward``
+is the same reverse-topological walk with cotangent accumulation, hooks and
+leaf ``.grad`` writing; there is no codegen because JAX derives every VJP.
+
+Under ``jit``/``to_static`` tracing the tape is bypassed entirely — whole
+programs differentiate through ``jax.vjp`` at the program level (see jit/api.py),
+which is the XLA-idiomatic replacement for appending a backward graph.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes, flags
+
+_tls = threading.local()
+
+
+def _grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled()
+
+
+def set_grad_enabled(mode: bool):
+    _tls.grad_enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _grad_enabled()
+        _tls.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        self._prev = _grad_enabled()
+        _tls.grad_enabled = True
+        return self
+
+    def __call__(self, fn):
+        def wrapper(*a, **k):
+            with enable_grad():
+                return fn(*a, **k)
+        return wrapper
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    ``vjp_fn`` maps output cotangents -> input cotangents (jax pullback holding
+    on-device residuals). ``inputs`` are the differentiable input Tensors in
+    pullback order; ``out_avals`` describe output slots so missing cotangents
+    can be zero-filled.
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "cotangents", "single_output")
+
+    def __init__(self, name, vjp_fn, inputs, out_avals, single_output):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs
+        self.out_avals = out_avals            # list of (shape, dtype)
+        self.cotangents: List[Optional[Any]] = [None] * len(out_avals)
+        self.single_output = single_output
+
+    def accumulate(self, slot: int, value) -> None:
+        cur = self.cotangents[slot]
+        self.cotangents[slot] = value if cur is None else cur + value
+
+    def ready_cotangents(self):
+        cots = []
+        for aval, c in zip(self.out_avals, self.cotangents):
+            if c is None:
+                c = jnp.zeros(aval[0], aval[1])
+            cots.append(c)
+        return cots[0] if self.single_output else tuple(cots)
+
+    def release(self):
+        self.vjp_fn = None
+        self.cotangents = [None] * len(self.out_avals)
+
+
+def _check_nan_inf(name, arrays):
+    for a in arrays:
+        if hasattr(a, "dtype") and dtypes.is_floating_point(np.dtype(a.dtype)):
+            if not bool(jnp.isfinite(a).all()):
+                raise FloatingPointError(f"NaN/Inf detected in output of op '{name}'")
+
+
+_jit_cache: dict = {}
+
+
+def _hashable(kw: dict):
+    try:
+        items = []
+        for k, v in sorted(kw.items()):
+            if isinstance(v, list):
+                v = tuple(v)
+            hash(v)
+            items.append((k, v))
+        return tuple(items)
+    except TypeError:
+        return None
+
+
+def apply(name: str, prim: Callable, tensor_args: Sequence, kwargs: dict | None = None):
+    """Execute primitive ``prim`` over Tensor/array args, recording the tape.
+
+    ``prim`` must be a pure function of jax arrays (plus static kwargs)
+    returning an array or tuple of arrays.  This is the single dispatch seam —
+    the analog of the reference's generated ``*_ad_func`` + KernelFactory
+    selection (SURVEY §3.1), collapsed to one function because XLA owns kernel
+    choice.
+    """
+    from .tensor import Tensor  # circular-safe
+
+    kwargs = kwargs or {}
+    arrays = [a._data if isinstance(a, Tensor) else a for a in tensor_args]
+
+    tracing = any(isinstance(a, jax.core.Tracer) for a in arrays)
+    diff_idx = []
+    if _grad_enabled() and not tracing:
+        for i, a in enumerate(tensor_args):
+            if isinstance(a, Tensor) and not a.stop_gradient and dtypes.is_floating_point(a.dtype):
+                diff_idx.append(i)
+
+    if not diff_idx:
+        if tracing or not flags.flag("eager_op_jit"):
+            out = prim(*arrays, **kwargs)
+        else:
+            hkw = _hashable(kwargs)
+            if hkw is None:
+                out = prim(*arrays, **kwargs)
+            else:
+                key = (prim, hkw)
+                fn = _jit_cache.get(key)
+                if fn is None:
+                    fn = _jit_cache[key] = jax.jit(partial(prim, **kwargs))
+                try:
+                    out = fn(*arrays)
+                except TypeError:
+                    out = prim(*arrays, **kwargs)
+        if flags.flag("check_nan_inf") and not tracing:
+            _check_nan_inf(name, out if isinstance(out, (tuple, list)) else (out,))
+        return _wrap_outputs(out, None)
+
+    def f(*diff_arrays):
+        full = list(arrays)
+        for i, d in zip(diff_idx, diff_arrays):
+            full[i] = d
+        return prim(*full, **kwargs)
+
+    out, vjp_fn = jax.vjp(f, *[arrays[i] for i in diff_idx])
+    single = not isinstance(out, (tuple, list))
+    flat = (out,) if single else tuple(out)
+    node = GradNode(
+        name, vjp_fn,
+        [tensor_args[i] for i in diff_idx],
+        [(o.shape, o.dtype) for o in flat],
+        single,
+    )
+    if flags.flag("check_nan_inf"):
+        _check_nan_inf(name, flat)
+    return _wrap_outputs(out, node)
+
+
+def _wrap_outputs(out, node):
+    from .tensor import Tensor
+
+    if isinstance(out, (tuple, list)):
+        res = []
+        for i, o in enumerate(out):
+            t = Tensor(o, stop_gradient=node is None or not dtypes.is_floating_point(np.dtype(o.dtype)))
+            if node is not None and not t.stop_gradient:
+                t._node, t._slot = node, i
+            res.append(t)
+        return tuple(res)
+    t = Tensor(out, stop_gradient=node is None)
+    if node is not None:
+        t._node, t._slot = node, 0
+    return t
+
+
+def _topo_order(seed_nodes):
+    order, visited = [], set()
+    for root in seed_nodes:
+        if root in visited:
+            continue
+        stack = [(root, False)]
+        while stack:
+            n, processed = stack.pop()
+            if processed:
+                order.append(n)
+                continue
+            if n in visited:
+                continue
+            visited.add(n)
+            stack.append((n, True))
+            for t in n.inputs:
+                child = t._node
+                if child is not None and child not in visited and child.vjp_fn is not None:
+                    stack.append((child, False))
+    order.reverse()  # consumers before producers
+    return order
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward analog (reference: eager/backward.cc:105)."""
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    seeds = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            garr = jnp.ones(t._data.shape, t._data.dtype)
+        else:
+            garr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._node is not None:
+            t._node.accumulate(t._slot, garr)
+            seeds.append(t._node)
+        elif not t.stop_gradient:
+            _accumulate_leaf(t, garr)
+
+    for node in _topo_order(seeds):
+        if node.vjp_fn is None:
+            continue
+        grads_in = node.vjp_fn(node.ready_cotangents())
+        for t, g in zip(node.inputs, grads_in):
+            if g is None:
+                continue
+            for hook in t._hooks:
+                res = hook(_as_tensor(g))
+                if res is not None:
+                    g = res._data if isinstance(res, Tensor) else res
+            if t._node is not None and t._node.vjp_fn is not None:
+                t._node.accumulate(t._slot, g)
+                if t._retain_grad:
+                    _accumulate_leaf(t, g)
+            else:
+                _accumulate_leaf(t, g)
+        if not retain_graph:
+            node.release()
+
+
+def _as_tensor(arr):
+    from .tensor import Tensor
+    return Tensor(arr, stop_gradient=True)
+
+
+def _accumulate_leaf(t, g):
+    from .tensor import Tensor
+    if t.stop_gradient and not t._retain_grad:
+        return
+    if t.grad is None:
+        t.grad = Tensor(g, stop_gradient=True)
+    else:
+        t.grad = Tensor(t.grad._data + g, stop_gradient=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """paddle.grad: gradients of outputs wrt inputs without touching .grad.
+
+    Implemented by running the tape walk with a private accumulation map.
+    ``create_graph`` (double grad) is supported through jax by replaying: the
+    pullbacks are themselves jax functions, so higher-order grads work when the
+    graph is retained.
+    """
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    acc: dict = {}
+    seeds = []
+    for t, g in zip(outputs, grad_outputs):
+        garr = jnp.ones(t._data.shape, t._data.dtype) if g is None else (
+            g._data if isinstance(g, Tensor) else jnp.asarray(g))
+        if t._node is not None:
+            t._node.accumulate(t._slot, garr)
+            seeds.append(t._node)
+        else:
+            acc[id(t)] = garr
+
+    targets = {id(t) for t in inputs}
+    for node in _topo_order(seeds):
+        if node.vjp_fn is None:
+            continue
+        grads_in = node.vjp_fn(node.ready_cotangents())
+        for t, g in zip(node.inputs, grads_in):
+            if g is None:
+                continue
+            if id(t) in targets or t._node is None:
+                acc[id(t)] = acc[id(t)] + g if id(t) in acc else g
+            if t._node is not None and t._node.vjp_fn is not None:
+                t._node.accumulate(t._slot, g)
+        if not retain_graph:
+            node.release()
+
+    result = []
+    for t in inputs:
+        if id(t) in acc:
+            result.append(Tensor(acc[id(t)], stop_gradient=not create_graph))
+        elif allow_unused:
+            result.append(None)
+        else:
+            raise ValueError(
+                "One of the differentiated tensors appears unused in the graph; "
+                "pass allow_unused=True to return None for it.")
+    return result

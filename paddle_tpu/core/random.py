@@ -1,0 +1,69 @@
+"""Global RNG state.
+
+The reference keeps per-device cuRAND generators plus a cross-rank
+``RNGStatesTracker`` for tensor parallel dropout (fleet/layers/mpu/random.py).
+On TPU randomness is functional: a global root key advanced by splitting in
+eager mode, and a *traced* key slot during jit tracing so compiled programs get
+a fresh key argument per call instead of a baked-in constant.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _glob():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.key(0)
+        _state.trace_stack = []
+    return _state
+
+
+def seed(value: int) -> None:
+    """Set the global random seed (paddle.seed analog)."""
+    g = _glob()
+    g.key = jax.random.key(int(value))
+
+
+def next_key():
+    """Return a fresh PRNG key.
+
+    Eager: split the global root key. Tracing (inside to_static / jit): fold a
+    trace-local counter into the key slot pushed by the tracer so every traced
+    random op gets a distinct, *argument-derived* key.
+    """
+    g = _glob()
+    if g.trace_stack:
+        slot = g.trace_stack[-1]
+        key = jax.random.fold_in(slot["key"], slot["counter"])
+        slot["counter"] += 1
+        return key
+    g.key, sub = jax.random.split(g.key)
+    return sub
+
+
+class trace_key_scope:
+    """Context manager installing a traced key as the RNG source."""
+
+    def __init__(self, key):
+        self.slot = {"key": key, "counter": 0}
+
+    def __enter__(self):
+        _glob().trace_stack.append(self.slot)
+        return self
+
+    def __exit__(self, *exc):
+        _glob().trace_stack.pop()
+        return False
+
+
+def get_rng_state():
+    return _glob().key
+
+
+def set_rng_state(key) -> None:
+    _glob().key = key

@@ -1,0 +1,249 @@
+"""Eager Tensor.
+
+Replaces the reference's ``phi::DenseTensor`` + ``AutogradMeta`` +
+``paddle::Tensor`` stack (paddle/phi/core/dense_tensor.h:37,
+paddle/fluid/eager/autograd_meta.h) with a thin wrapper over ``jax.Array``:
+storage/layout/placement belong to XLA+PJRT, autograd metadata
+(``stop_gradient``, ``grad``, producer GradNode) lives on the wrapper, and
+distributed metadata (process_mesh/placements, the DistTensor role —
+dist_tensor.h:39) is carried by the underlying global ``jax.Array`` sharding
+plus optional annotations set by paddle_tpu.distributed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes
+from . import autograd
+
+_tensor_count = [0]
+
+
+class Tensor:
+    __slots__ = (
+        "_data", "stop_gradient", "grad", "_node", "_slot", "_retain_grad",
+        "_hooks", "name", "persistable", "trainable", "_dist_meta", "__weakref__",
+    )
+
+    def __init__(self, data, dtype=None, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array) and not isinstance(data, jax.core.Tracer):
+            data = jnp.asarray(data, dtype=dtypes.convert_dtype(dtype) if dtype is not None else None)
+        elif dtype is not None and np.dtype(data.dtype) != dtypes.convert_dtype(dtype):
+            data = data.astype(dtypes.convert_dtype(dtype))
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._node = None
+        self._slot = 0
+        self._retain_grad = False
+        self._hooks: List = []
+        if name is None:
+            _tensor_count[0] += 1
+            name = f"generated_tensor_{_tensor_count[0]}"
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._dist_meta = None
+
+    # ---- metadata ----
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    rank = ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    def numel(self) -> int:
+        return self.size
+
+    @property
+    def place(self) -> str:
+        try:
+            dev = list(self._data.devices())[0]
+            return f"{dev.platform}:{dev.id}"
+        except Exception:
+            return "traced"
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def T(self) -> "Tensor":
+        from ..ops import manipulation
+        perm = list(range(self.ndim))[::-1]
+        return manipulation.transpose(self, perm)
+
+    # ---- conversion ----
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return self._data.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def astype(self, dtype) -> "Tensor":
+        from ..ops import manipulation
+        return manipulation.cast(self, dtype)
+
+    cast = astype
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def clone(self) -> "Tensor":
+        from ..ops._prim import apply_op
+        return apply_op("clone", lambda x: x + jnp.zeros((), x.dtype), (self,))
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, (str, np.dtype)) and str(a) in dtypes._ALIASES or isinstance(a, np.dtype):
+                dtype = a
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    # ---- autograd ----
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.run_backward([self], None if grad_tensor is None else [grad_tensor], retain_graph)
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+        return hook
+
+    def retain_grads(self):
+        self._retain_grad = True
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    @property
+    def is_dist(self) -> bool:
+        return self._dist_meta is not None
+
+    # ---- mutation (wrapper-level; arrays are immutable) ----
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = jnp.asarray(value, dtype=self._data.dtype).reshape(self._data.shape)
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._data = jnp.full(self._data.shape, value, self._data.dtype)
+        return self
+
+    def zero_(self):
+        return self.fill_(0)
+
+    def scale_(self, scale):
+        self._data = self._data * scale
+        return self
+
+    # ---- python protocol ----
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            data = np.asarray(self._data)
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_info},\n"
+                    f"       {data})")
+        except Exception:
+            return f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_info}, traced)"
+
+    def __getitem__(self, idx):
+        from ..ops import indexing
+        return indexing.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from ..ops import indexing
+        self._data = indexing.setitem_array(self, idx, value)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: paddle.base.framework.Parameter)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip", "is_distributed")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True
+        self.is_distributed = False
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor analog."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    if isinstance(data, (list, tuple)) and any(isinstance(x, Tensor) for x in data):
+        data = [x.numpy() if isinstance(x, Tensor) else x for x in data]
+    arr = np.asarray(data)
+    if dtype is None and arr.dtype == np.float64:
+        arr = arr.astype(dtypes.default_dtype())
+    return Tensor(arr, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)

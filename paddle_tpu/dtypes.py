@@ -1,0 +1,95 @@
+"""Dtype registry.
+
+The reference packs dtype into a 32-bit KernelKey (paddle/phi/core/kernel_factory.h)
+and exposes ``paddle.float32``-style handles.  On TPU dispatch happens at trace
+time, so dtypes are plain numpy/jax dtypes with paddle-style aliases plus
+helpers for promotion and default-dtype state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import flags
+
+# Canonical dtype handles (numpy dtype objects; jax accepts them everywhere).
+bool_ = np.dtype("bool")
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = jnp.bfloat16.dtype if hasattr(jnp.bfloat16, "dtype") else np.dtype(jnp.bfloat16)
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_ALIASES = {
+    "bool": bool_, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16, "fp16": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16, "float32": float32, "fp32": float32,
+    "float64": float64, "fp64": float64, "complex64": complex64,
+    "complex128": complex128,
+}
+
+FLOATING = {float16, bfloat16, float32, float64}
+INTEGRAL = {uint8, int8, int16, int32, int64}
+
+
+def _canonicalize(d: np.dtype) -> np.dtype:
+    """Map 64-bit types to 32-bit when jax x64 is off (TPU-native widths).
+
+    The reference defaults indices to int64; on TPU the canonical integer is
+    int32 (XLA S32) and float64 is unsupported on the MXU, so unless the user
+    enables jax_enable_x64 we store the 32-bit type directly instead of letting
+    jax truncate with a warning.
+    """
+    import jax
+    if jax.config.jax_enable_x64:
+        return d
+    return {np.dtype("int64"): int32, np.dtype("uint64"): np.dtype("uint32"),
+            np.dtype("float64"): float32, np.dtype("complex128"): complex64}.get(d, d)
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize str/np/jnp dtype-ish values to a numpy dtype object."""
+    if dtype is None:
+        return default_dtype()
+    if isinstance(dtype, str):
+        d = _ALIASES.get(dtype) or np.dtype(dtype)
+    elif isinstance(dtype, np.dtype):
+        d = dtype
+    else:
+        d = np.dtype(dtype)
+    return _canonicalize(d)
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in INTEGRAL
+
+
+_default_dtype: list = []
+
+
+def set_default_dtype(dtype) -> None:
+    d = convert_dtype(dtype)
+    if d not in FLOATING:
+        raise ValueError(f"default dtype must be floating, got {d}")
+    _default_dtype[:] = [d]
+
+
+def get_default_dtype() -> np.dtype:
+    return default_dtype()
+
+
+def default_dtype() -> np.dtype:
+    if _default_dtype:
+        return _default_dtype[0]
+    return convert_dtype(flags.flag("default_dtype"))

@@ -1,0 +1,70 @@
+"""Global flag registry.
+
+TPU-native analog of the reference's exported-flag system
+(paddle/common/flags.h + flags.cc: ``PHI_DEFINE_EXPORTED_*`` registry, settable
+from env ``FLAGS_x=...`` or ``paddle.set_flags``).  Here the registry is a plain
+Python dict seeded from the environment at import time; C++ components read the
+same values through ``paddle_tpu.native`` when loaded.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_DEFS: Dict[str, dict] = {}
+_VALUES: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default: Any, help_str: str = "", flag_type: type | None = None) -> None:
+    """Register a flag. Env var ``FLAGS_<name>`` overrides the default."""
+    if flag_type is None:
+        flag_type = type(default)
+    _DEFS[name] = {"default": default, "help": help_str, "type": flag_type}
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        _VALUES[name] = _parse(env, flag_type)
+    else:
+        _VALUES[name] = default
+
+
+def _parse(text: str, flag_type: type) -> Any:
+    if flag_type is bool:
+        return text.lower() in ("1", "true", "yes", "on")
+    return flag_type(text)
+
+
+def get_flags(flags=None) -> Dict[str, Any]:
+    if flags is None:
+        return dict(_VALUES)
+    if isinstance(flags, str):
+        flags = [flags]
+    return {f: _VALUES[f] for f in flags}
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    for k, v in flags.items():
+        if k.startswith("FLAGS_"):
+            k = k[len("FLAGS_"):]
+        if k not in _DEFS:
+            raise ValueError(f"Unknown flag {k!r}; known flags: {sorted(_DEFS)}")
+        _VALUES[k] = _parse(v, _DEFS[k]["type"]) if isinstance(v, str) else _DEFS[k]["type"](v)
+
+
+def flag(name: str) -> Any:
+    return _VALUES[name]
+
+
+# ---- core flag set (subset of the reference's 183; grows as subsystems land) ----
+define_flag("check_nan_inf", False, "Check every op output for NaN/Inf (debug).")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >=1: report statistics only.")
+define_flag("use_deterministic_ops", False, "Prefer deterministic XLA lowering.")
+define_flag("default_dtype", "float32", "Default floating point dtype.")
+define_flag("eager_op_jit", True, "Cache per-op jitted executables in eager mode.")
+define_flag("log_memory_stats", False, "Log live buffer stats after each op.")
+define_flag("enable_async_trace", False, "Collective watchdog tracing.")
+define_flag("comm_timeout_s", 600, "Collective/barrier watchdog timeout in seconds.")
+define_flag("allocator_strategy", "auto_growth", "Kept for API parity; XLA/PJRT owns device memory.")
+define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest.")
+define_flag("flash_attention_block_q", 512, "Pallas flash attention query block.")
+define_flag("flash_attention_block_kv", 512, "Pallas flash attention kv block.")

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the fused-op set.
+
+Fills the slot of the reference's fused CUDA kernels
+(paddle/phi/kernels/fusion/gpu: fused_attention, fused_rms_norm, fused_rope,
+block attention...) with Pallas implementations that fall back to XLA-fused
+jax reference code on non-TPU backends (tests run the fallback via interpret
+mode or directly).
+"""
+
+from . import flash_attention  # noqa: F401

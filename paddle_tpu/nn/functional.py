@@ -1,0 +1,954 @@
+"""nn functional ops (reference: python/paddle/nn/functional/).
+
+Convs/matmuls lower straight to lax conv/dot (MXU); norms and activations are
+left to XLA fusion in jit mode.  Fused Pallas versions of the hot ops
+(flash attention, rms_norm, rope, swiglu) live in paddle_tpu.incubate.nn.functional
+and are used by the model zoo; these are the reference semantics.
+"""
+
+from __future__ import annotations
+
+import math as _math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes
+from ..core import random as rnd
+from ..core.tensor import Tensor
+from ..ops._prim import apply_op
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+# ================= activations =================
+
+def relu(x, name=None):
+    return apply_op("relu", jax.nn.relu, (_t(x),))
+
+
+def relu6(x, name=None):
+    return apply_op("relu6", jax.nn.relu6, (_t(x),))
+
+
+def relu_(x):
+    out = relu(x)
+    x._data = out._data
+    return x
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), (_t(x),))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def prim(a, w):
+        if w.size > 1:
+            ch_dim = 1 if data_format == "NCHW" else a.ndim - 1
+            shape = [1] * a.ndim
+            shape[ch_dim] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a >= 0, a, w * a)
+    return apply_op("prelu", prim, (_t(x), _t(weight)))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), (_t(x),))
+
+
+def silu(x, name=None):
+    return apply_op("silu", jax.nn.silu, (_t(x),))
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def hardswish(x, name=None):
+    return apply_op("hardswish", jax.nn.hard_swish, (_t(x),))
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return apply_op("hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), (_t(x),))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return apply_op("hardtanh", lambda a: jnp.clip(a, min, max), (_t(x),))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op("hardshrink",
+                    lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), (_t(x),))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op("softshrink",
+                    lambda a: jnp.where(a > threshold, a - threshold,
+                                        jnp.where(a < -threshold, a + threshold, 0.0)), (_t(x),))
+
+
+def tanhshrink(x, name=None):
+    return apply_op("tanhshrink", lambda a: a - jnp.tanh(a), (_t(x),))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda a: jax.nn.elu(a, alpha), (_t(x),))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda a: jax.nn.celu(a, alpha), (_t(x),))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), (_t(x),))
+
+
+def mish(x, name=None):
+    return apply_op("mish", jax.nn.mish, (_t(x),))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op("softplus",
+                    lambda a: jnp.where(beta * a > threshold, a,
+                                        jnp.log1p(jnp.exp(beta * a)) / beta), (_t(x),))
+
+
+def softsign(x, name=None):
+    return apply_op("softsign", jax.nn.soft_sign, (_t(x),))
+
+
+def sigmoid(x, name=None):
+    return apply_op("sigmoid", jax.nn.sigmoid, (_t(x),))
+
+
+def log_sigmoid(x, name=None):
+    return apply_op("log_sigmoid", jax.nn.log_sigmoid, (_t(x),))
+
+
+def tanh(x, name=None):
+    return apply_op("tanh", jnp.tanh, (_t(x),))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply_op("softmax", lambda a: jax.nn.softmax(a, axis=int(axis)), (x,))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply_op("log_softmax", lambda a: jax.nn.log_softmax(a, axis=int(axis)), (x,))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = _t(x)
+    g = jax.random.gumbel(rnd.next_key(), tuple(x._data.shape), x._data.dtype)
+
+    def prim(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard + jax.lax.stop_gradient(-y) + y  # straight-through
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+    return apply_op("gumbel_softmax", prim, (x,))
+
+
+def glu(x, axis=-1, name=None):
+    def prim(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return apply_op("glu", prim, (_t(x),))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def prim(a):
+        shape = list(a.shape)
+        c = shape[axis]
+        shape[axis:axis + 1] = [c // groups, groups]
+        return jnp.max(a.reshape(shape), axis=axis + 1)
+    return apply_op("maxout", prim, (_t(x),))
+
+
+# ================= linear / embedding =================
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b; weight layout [in, out] (reference: nn/functional/common.py)."""
+    if bias is None:
+        return apply_op("linear", lambda a, w: jnp.matmul(a, w), (_t(x), _t(weight)))
+    return apply_op("linear", lambda a, w, b: jnp.matmul(a, w) + b, (_t(x), _t(weight), _t(bias)))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def prim(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply_op("embedding", prim, (_t(x), _t(weight)))
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op("one_hot", lambda i: jax.nn.one_hot(i, int(num_classes), dtype=jnp.float32), (_t(x),))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def prim(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (_t(x1), _t(x2), _t(weight)) + ((_t(bias),) if bias is not None else ())
+    return apply_op("bilinear", prim, args)
+
+
+# ================= dropout =================
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op("dropout_scale", lambda a: a * (1.0 - p), (x,))
+        return x
+    shape = tuple(x._data.shape)
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rnd.next_key(), keep, shape)
+
+    def prim(a):
+        if mode == "upscale_in_train":
+            return jnp.where(mask, a / keep, 0.0)
+        return jnp.where(mask, a, 0.0)
+    return apply_op("dropout", prim, (x,))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - p
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    mask = jax.random.bernoulli(rnd.next_key(), keep, tuple(x._data.shape))
+    return apply_op("alpha_dropout", lambda v: a * jnp.where(mask, v, alpha_p) + b, (x,))
+
+
+# ================= normalization =================
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+
+    def prim(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("layer_norm", prim, tuple(args))
+
+
+def rms_norm(x, weight, epsilon=1e-6, name=None):
+    def prim(a, w):
+        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (a * jax.lax.rsqrt(var + epsilon).astype(a.dtype)) * w
+    return apply_op("rms_norm", prim, (_t(x), _t(weight)))
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None, name=None):
+    x = _t(x)
+    ch_dim = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_dim)
+    shape = [1] * x.ndim
+    shape[ch_dim] = x.shape[ch_dim]
+
+    use_batch_stats = training and not use_global_stats
+    if use_batch_stats:
+        batch_mean = jnp.mean(x._data, axis=axes)
+        batch_var = jnp.var(x._data, axis=axes)
+        # update running stats in-place on the wrapper (reference semantics)
+        if running_mean is not None:
+            running_mean._data = momentum * running_mean._data + (1 - momentum) * batch_mean
+            running_var._data = momentum * running_var._data + (1 - momentum) * batch_var
+        mean_used, var_used = batch_mean, batch_var
+    else:
+        mean_used, var_used = running_mean._data, running_var._data
+
+    def prim(a, *wb):
+        out = (a - mean_used.reshape(shape)) * jax.lax.rsqrt(var_used.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("batch_norm", prim, tuple(args))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    x = _t(x)
+    axes = tuple(range(2, x.ndim))
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+
+    def prim(a, *wb):
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("instance_norm", prim, tuple(args))
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    x = _t(x)
+
+    def prim(a, *wb):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        n, c = a.shape[0], a.shape[1]
+        g = a.reshape((n, num_groups, c // num_groups) + a.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+        shape = [1, c] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("group_norm", prim, tuple(args))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def prim(a):
+        sq = jnp.square(a)
+        ch = 1 if data_format.startswith("NC") else a.ndim - 1
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[ch] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        idx = [slice(None)] * a.ndim
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            idx[ch] = slice(i, i + a.shape[ch])
+            acc = acc + padded[tuple(idx)]
+        return a / jnp.power(k + alpha * acc, beta)
+    return apply_op("lrn", prim, (_t(x),))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply_op("normalize",
+                    lambda a: a / jnp.maximum(jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True),
+                                              epsilon), (_t(x),))
+
+
+# ================= convolution =================
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial = "DHW"[-nd:] if nd == 3 else ("HW" if nd == 2 else "W")
+    if channels_last:
+        lhs_spec = "N" + spatial + "C"
+        out_spec = lhs_spec
+    else:
+        lhs_spec = "NC" + spatial
+        out_spec = lhs_spec
+    rhs_spec = "OI" + spatial
+    dn = jax.lax.conv_dimension_numbers((1,) * (nd + 2), (1,) * (nd + 2),
+                                        (lhs_spec, rhs_spec, out_spec))
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+
+    if isinstance(padding, str):
+        pad = padding.upper()
+        if pad == "SAME":
+            padding_cfg = "SAME"
+        elif pad == "VALID":
+            padding_cfg = "VALID"
+        else:
+            raise ValueError(f"bad padding {padding}")
+    elif isinstance(padding, (list, tuple)) and len(padding) == 2 * nd:
+        padding_cfg = [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(nd)]
+    elif isinstance(padding, (list, tuple)) and padding and isinstance(padding[0], (list, tuple)):
+        # paddle full-form [[0,0],[0,0],[h0,h1],[w0,w1]]
+        sp = padding[2:] if not channels_last else padding[1:-1]
+        padding_cfg = [tuple(int(v) for v in p) for p in sp]
+    else:
+        p = _pair(padding, nd)
+        padding_cfg = [(pi, pi) for pi in p]
+
+    def prim(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=padding_cfg,
+            rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None)
+        if b:
+            ch_shape = [1] * out.ndim
+            ch_shape[1 if not channels_last else out.ndim - 1] = b[0].shape[0]
+            out = out + b[0].reshape(ch_shape)
+        return out
+    args = (_t(x), _t(weight)) + ((_t(bias),) if bias is not None else ())
+    return apply_op(f"conv{nd}d", prim, args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NLC" if data_format == "NLC" else "NCL"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    nd = 2
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    p = _pair(padding, nd)
+
+    def prim(a, w, *b):
+        # weight layout [in, out//groups, kH, kW] (paddle transpose-conv convention)
+        w_t = jnp.swapaxes(w, 0, 1)
+        w_t = jnp.flip(w_t, axis=(-2, -1))
+        kh = (w.shape[2] - 1) * dilation[0] + 1
+        kw = (w.shape[3] - 1) * dilation[1] + 1
+        pad_cfg = [(kh - 1 - p[0], kh - 1 - p[0] + _pair(output_padding, nd)[0]),
+                   (kw - 1 - p[1], kw - 1 - p[1] + _pair(output_padding, nd)[1])]
+        dn = jax.lax.conv_dimension_numbers(a.shape, w_t.shape, ("NCHW", "OIHW", "NCHW"))
+        out = jax.lax.conv_general_dilated(
+            a, w_t, window_strides=(1, 1), padding=pad_cfg,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1)
+        return out
+    args = (_t(x), _t(weight)) + ((_t(bias),) if bias is not None else ())
+    return apply_op("conv2d_transpose", prim, args)
+
+
+# ================= pooling =================
+
+def _pool(x, kernel, stride, padding, nd, reducer, init, data_format, count_include_pad=True,
+          ceil_mode=False):
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride if stride is not None else kernel, nd)
+    p = _pair(padding, nd)
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if channels_last:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0),) + tuple((pi, pi) for pi in p) + ((0, 0),)
+    else:
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+
+    def prim(a):
+        out = jax.lax.reduce_window(a, init, reducer, dims, strides, pads)
+        return out
+    return prim, dims, strides, pads
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False,
+               data_format="NCHW", name=None):
+    prim, *_ = _pool(x, kernel_size, stride, padding, 2, jax.lax.max, -jnp.inf, data_format)
+    return apply_op("max_pool2d", prim, (_t(x),))
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    sum_prim, dims, strides, pads = _pool(x, kernel_size, stride, padding, 2, jax.lax.add, 0.0,
+                                          data_format)
+
+    def prim(a):
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides, pads)
+        if divisor_override:
+            return s / divisor_override
+        if exclusive and any(p != (0, 0) for p in pads):
+            ones = jnp.ones_like(a)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pads)
+            return s / cnt
+        return s / float(np.prod(dims))
+    return apply_op("avg_pool2d", prim, (_t(x),))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    prim, *_ = _pool(x, kernel_size, stride, padding, 1, jax.lax.max, -jnp.inf, "NCL")
+    return apply_op("max_pool1d", prim, (_t(x),))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    _, dims, strides, pads = _pool(x, kernel_size, stride, padding, 1, jax.lax.add, 0.0, "NCL")
+
+    def prim(a):
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides, pads)
+        return s / float(np.prod(dims))
+    return apply_op("avg_pool1d", prim, (_t(x),))
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _pair(output_size, 2)
+
+    def prim(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            oh, ow = out_hw
+            a_ = a.reshape(n, c, oh, h // oh, ow, w // ow)
+            return jnp.mean(a_, axis=(3, 5))
+        n, h, w, c = a.shape
+        oh, ow = out_hw
+        a_ = a.reshape(n, oh, h // oh, ow, w // ow, c)
+        return jnp.mean(a_, axis=(2, 4))
+    return apply_op("adaptive_avg_pool2d", prim, (_t(x),))
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out_hw = _pair(output_size, 2)
+
+    def prim(a):
+        n, c, h, w = a.shape
+        oh, ow = out_hw
+        a_ = a.reshape(n, c, oh, h // oh, ow, w // ow)
+        return jnp.max(a_, axis=(3, 5))
+    return apply_op("adaptive_max_pool2d", prim, (_t(x),))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    def prim(a):
+        n, c, l = a.shape
+        o = int(output_size)
+        return jnp.mean(a.reshape(n, c, o, l // o), axis=3)
+    return apply_op("adaptive_avg_pool1d", prim, (_t(x),))
+
+
+# ================= padding / resize =================
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    from ..ops.manipulation import pad as _pad
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    x = _t(x)
+    nd = x.ndim - 2
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
+        spatial = x.shape[2:] if data_format.startswith("NC") else x.shape[1:-1]
+        size = [int(s * f) for s, f in zip(spatial, sf)]
+    size = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in
+            (size if isinstance(size, (list, tuple)) else [size] * nd)]
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def prim(a):
+        if data_format.startswith("NC"):
+            out_shape = a.shape[:2] + tuple(size)
+        else:
+            out_shape = (a.shape[0],) + tuple(size) + (a.shape[-1],)
+        return jax.image.resize(a, out_shape, method=jmode)
+    return apply_op("interpolate", prim, (x,))
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def prim(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+        return a.reshape(n, c // (r * r), h * r, w * r)
+    return apply_op("pixel_shuffle", prim, (_t(x),))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    k = _pair(kernel_sizes, 2)
+    s = _pair(strides, 2)
+    p = _pair(paddings, 2)
+    d = _pair(dilations, 2)
+
+    def prim(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+        kh = (k[0] - 1) * d[0] + 1
+        kw = (k[1] - 1) * d[1] + 1
+        oh = (a.shape[2] - kh) // s[0] + 1
+        ow = (a.shape[3] - kw) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patches.append(a[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                                 j * d[1]: j * d[1] + ow * s[1]: s[1]])
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * k[0] * k[1], oh * ow)
+    return apply_op("unfold", prim, (_t(x),))
+
+
+# ================= attention =================
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Reference: paddle.nn.functional.scaled_dot_product_attention
+    (flash_attn_kernel.cu:587 on GPU).  Layout [batch, seq, heads, head_dim].
+    The Pallas flash-attention kernel (paddle_tpu/kernels/flash_attention.py)
+    is used automatically on TPU for long sequences; this is the XLA-fused path.
+    """
+    args = [_t(query), _t(key), _t(value)]
+    if attn_mask is not None:
+        args.append(_t(attn_mask))
+
+    def prim(q, k, v, *mask):
+        qh = jnp.swapaxes(q, 1, 2)  # b h s d
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        scale = 1.0 / _math.sqrt(q.shape[-1])
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        if mask:
+            m = mask[0]
+            if np.dtype(m.dtype) == np.bool_:
+                scores = jnp.where(m, scores, -1e9)
+            else:
+                scores = scores + m
+        if is_causal:
+            sq, sk = scores.shape[-2], scores.shape[-1]
+            causal = jnp.tril(jnp.ones((sq, sk), bool))
+            scores = jnp.where(causal, scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+        return jnp.swapaxes(out, 1, 2)
+    out = apply_op("sdpa", prim, tuple(args))
+    if dropout_p > 0.0 and training:
+        out = dropout(out, p=dropout_p, training=training)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    from ..kernels.flash_attention import flash_attention as _fa
+    out = _fa(query, key, value, causal=causal)
+    return (out, None) if return_softmax is not None else out
+
+
+# ================= losses =================
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+
+    def prim(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label:
+            tgt = lab
+            if label_smoothing > 0:
+                n = logits.shape[axis]
+                tgt = tgt * (1 - label_smoothing) + label_smoothing / n
+            loss = -jnp.sum(tgt * logp, axis=axis)
+        else:
+            lab_i = lab
+            if lab_i.ndim == logits.ndim:
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            lab_i = lab_i.astype(jnp.int32)
+            valid = lab_i != ignore_index
+            safe = jnp.where(valid, lab_i, 0)
+            picked = jnp.take_along_axis(logp, safe[..., None], axis=axis)[..., 0]
+            if label_smoothing > 0:
+                n = logits.shape[axis]
+                smooth = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+            loss = jnp.where(valid, -picked, 0.0)
+            if w:
+                loss = loss * jnp.take(w[0], safe)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0) if w == () else \
+                    jnp.maximum(jnp.sum(jnp.where(valid, jnp.take(w[0], safe), 0.0)), 1e-10)
+                return jnp.sum(loss) / denom
+        return _reduce_loss(loss, reduction)
+    return apply_op("cross_entropy", prim, tuple(args))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from ..ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+
+    def prim(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=1)[..., 0] if logp.ndim == 2 else \
+            jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+        loss = jnp.where(valid, -picked, 0.0)
+        if w:
+            wt = jnp.take(w[0], safe)
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, wt, 0.0)), 1e-10)
+        return _reduce_loss(loss, reduction)
+    return apply_op("nll_loss", prim, tuple(args))
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply_op("mse_loss",
+                    lambda a, b: _reduce_loss(jnp.square(a - b), reduction), (_t(input), _t(label)))
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply_op("l1_loss",
+                    lambda a, b: _reduce_loss(jnp.abs(a - b), reduction), (_t(input), _t(label)))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def prim(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce_loss(loss, reduction)
+    return apply_op("smooth_l1", prim, (_t(input), _t(label)))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+
+    def prim(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce_loss(loss, reduction)
+    return apply_op("bce", prim, tuple(args))
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+
+    def prim(z, y, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        max_val = jnp.maximum(-z, 0.0)
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.log1p(jnp.exp(-jnp.abs(z))) + max_val)
+        else:
+            loss = (1 - y) * z + jnp.log1p(jnp.exp(-jnp.abs(z))) + max_val
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+    return apply_op("bce_logits", prim, tuple(args))
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    def prim(logp, tgt):
+        if log_target:
+            loss = jnp.exp(tgt) * (tgt - logp)
+        else:
+            loss = tgt * (jnp.log(jnp.maximum(tgt, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce_loss(loss, reduction)
+    return apply_op("kl_div", prim, (_t(input), _t(label)))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    def prim(a, b, y):
+        return _reduce_loss(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+    return apply_op("margin_ranking", prim, (_t(input), _t(other), _t(label)))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def prim(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.maximum(jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis), eps)
+        return num / den
+    return apply_op("cosine_similarity", prim, (_t(x1), _t(x2)))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def prim(a, b, y):
+        cos = jnp.sum(a * b, axis=1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=1) * jnp.linalg.norm(b, axis=1), 1e-8)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce_loss(loss, reduction)
+    return apply_op("cosine_embedding", prim, (_t(input1), _t(input2), _t(label)))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,  # noqa: A002
+                        swap=False, reduction="mean", name=None):
+    def prim(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce_loss(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply_op("triplet_margin", prim, (_t(input), _t(positive), _t(negative)))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    def prim(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce_loss(loss, reduction)
+    return apply_op("hinge_embedding", prim, (_t(input), _t(label)))
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return apply_op("square_error_cost", lambda a, b: jnp.square(a - b), (_t(input), _t(label)))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    args = [_t(logit), _t(label)] + ([_t(normalizer)] if normalizer is not None else [])
+
+    def prim(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce_loss(loss, reduction)
+    return apply_op("sigmoid_focal", prim, tuple(args))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def prim(y):
+        n = y.shape[-1]
+        return (1 - epsilon) * y + epsilon / n
+    return apply_op("label_smooth", prim, (_t(label),))
+
+
+# ================= sequence =================
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    x = _t(x)
+    m = maxlen if maxlen is not None else int(np.asarray(x._data).max())
+    out = jnp.arange(m)[None, :] < x._data[..., None]
+    return Tensor(out.astype(dtypes.convert_dtype(dtype)))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def prim(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        out = jnp.zeros_like(a)
+        out = out.at[:, 1:, :fold].set(a[:, :-1, :fold])
+        out = out.at[:, :-1, fold:2 * fold].set(a[:, 1:, fold:2 * fold])
+        out = out.at[:, :, 2 * fold:].set(a[:, :, 2 * fold:])
+        return out.reshape(nt, c, h, w)
+    return apply_op("temporal_shift", prim, (_t(x),))

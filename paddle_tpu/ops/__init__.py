@@ -1,0 +1,128 @@
+"""Functional op namespace + Tensor method attachment.
+
+The reference wires ~2000 tensor methods onto paddle.Tensor from
+python/paddle/tensor/__init__.py (a giant method table); we do the same here by
+attaching the functional ops as methods and operator dunders.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import creation, indexing, linalg, manipulation, math, random_ops, search
+from ._prim import OP_REGISTRY, apply_op  # noqa: F401
+
+# ---- re-export everything public ----
+_MODULES = (creation, math, manipulation, linalg, search, random_ops)
+__all__ = []
+for _m in _MODULES:
+    for _name in dir(_m):
+        if _name.startswith("_"):
+            continue
+        _obj = getattr(_m, _name)
+        if callable(_obj) and getattr(_obj, "__module__", "").startswith("paddle_tpu"):
+            globals()[_name] = _obj
+            __all__.append(_name)
+
+
+# ---- operator dunders ----
+def _binop(fn, reverse=False):
+    def op(self, other):
+        if reverse:
+            return fn(other if isinstance(other, Tensor) else Tensor(np.asarray(other)), self)
+        return fn(self, other)
+    return op
+
+
+Tensor.__add__ = _binop(math.add)
+Tensor.__radd__ = _binop(math.add, True)
+Tensor.__sub__ = _binop(math.subtract)
+Tensor.__rsub__ = _binop(math.subtract, True)
+Tensor.__mul__ = _binop(math.multiply)
+Tensor.__rmul__ = _binop(math.multiply, True)
+Tensor.__truediv__ = _binop(math.divide)
+Tensor.__rtruediv__ = _binop(math.divide, True)
+Tensor.__floordiv__ = _binop(math.floor_divide)
+Tensor.__rfloordiv__ = _binop(math.floor_divide, True)
+Tensor.__mod__ = _binop(math.mod)
+Tensor.__pow__ = _binop(math.pow)
+Tensor.__rpow__ = _binop(math.pow, True)
+Tensor.__matmul__ = _binop(linalg.matmul)
+Tensor.__rmatmul__ = _binop(linalg.matmul, True)
+Tensor.__neg__ = lambda self: math.neg(self)
+Tensor.__abs__ = lambda self: math.abs(self)
+Tensor.__invert__ = lambda self: math.logical_not(self)
+Tensor.__eq__ = _binop(math.equal)
+Tensor.__ne__ = _binop(math.not_equal)
+Tensor.__lt__ = _binop(math.less_than)
+Tensor.__le__ = _binop(math.less_equal)
+Tensor.__gt__ = _binop(math.greater_than)
+Tensor.__ge__ = _binop(math.greater_equal)
+Tensor.__and__ = _binop(math.logical_and)
+Tensor.__or__ = _binop(math.logical_or)
+Tensor.__xor__ = _binop(math.logical_xor)
+
+_METHOD_SOURCES = {
+    "exp": math.exp, "log": math.log, "sqrt": math.sqrt, "rsqrt": math.rsqrt,
+    "square": math.square, "abs": math.abs, "sign": math.sign, "sin": math.sin,
+    "cos": math.cos, "tan": math.tan, "tanh": math.tanh, "sigmoid": math.sigmoid,
+    "ceil": math.ceil, "floor": math.floor, "round": math.round, "reciprocal": math.reciprocal,
+    "add": math.add, "subtract": math.subtract, "multiply": math.multiply,
+    "divide": math.divide, "pow": math.pow, "mod": math.mod, "remainder": math.mod,
+    "maximum": math.maximum, "minimum": math.minimum, "clip": math.clip,
+    "scale": math.scale, "isnan": math.isnan, "isinf": math.isinf, "isfinite": math.isfinite,
+    "equal": math.equal, "not_equal": math.not_equal, "less_than": math.less_than,
+    "less_equal": math.less_equal, "greater_than": math.greater_than,
+    "greater_equal": math.greater_equal, "equal_all": math.equal_all,
+    "allclose": math.allclose, "isclose": math.isclose,
+    "logical_and": math.logical_and, "logical_or": math.logical_or,
+    "logical_not": math.logical_not, "logical_xor": math.logical_xor,
+    "sum": math.sum, "mean": math.mean, "prod": math.prod, "max": math.max,
+    "min": math.min, "amax": math.amax, "amin": math.amin, "std": math.std,
+    "var": math.var, "argmax": math.argmax, "argmin": math.argmin,
+    "cumsum": math.cumsum, "cumprod": math.cumprod, "logsumexp": math.logsumexp,
+    "all": math.all, "any": math.any, "lerp": math.lerp, "kron": math.kron,
+    "trunc": math.trunc, "frac": math.frac, "diff": math.diff, "erf": math.erf,
+    "lgamma": math.lgamma, "digamma": math.digamma, "nan_to_num": math.nan_to_num,
+    # manipulation
+    "reshape": manipulation.reshape, "reshape_": manipulation.reshape_,
+    "flatten": manipulation.flatten, "transpose": manipulation.transpose,
+    "squeeze": manipulation.squeeze, "unsqueeze": manipulation.unsqueeze,
+    "split": manipulation.split, "chunk": manipulation.chunk, "tile": manipulation.tile,
+    "expand": manipulation.expand, "expand_as": manipulation.expand_as,
+    "broadcast_to": manipulation.broadcast_to, "flip": manipulation.flip,
+    "roll": manipulation.roll, "gather": manipulation.gather,
+    "gather_nd": manipulation.gather_nd, "scatter": manipulation.scatter,
+    "scatter_nd_add": manipulation.scatter_nd_add, "unbind": manipulation.unbind,
+    "unstack": manipulation.unstack, "unique": manipulation.unique,
+    "masked_fill": manipulation.masked_fill, "masked_select": manipulation.masked_select,
+    "index_select": manipulation.index_select, "take_along_axis": manipulation.take_along_axis,
+    "put_along_axis": manipulation.put_along_axis, "where": manipulation.where,
+    "nonzero": manipulation.nonzero, "diagonal": manipulation.diagonal,
+    "tensordot": manipulation.tensordot, "repeat_interleave": manipulation.repeat_interleave,
+    "index_add": manipulation.index_add, "index_put": manipulation.index_put,
+    "bincount": manipulation.bincount, "pad": manipulation.pad,
+    "moveaxis": manipulation.moveaxis, "swapaxes": manipulation.swapaxes,
+    "index_sample": manipulation.index_sample,
+    "one_hot": manipulation.one_hot,
+    # linalg
+    "matmul": linalg.matmul, "mm": linalg.mm, "dot": linalg.dot, "bmm": linalg.bmm,
+    "t": linalg.t, "norm": linalg.norm, "dist": linalg.dist, "trace": linalg.trace,
+    "cross": linalg.cross, "cholesky": linalg.cholesky, "inverse": linalg.inv,
+    "outer": linalg.outer, "inner": linalg.inner, "mv": linalg.mv,
+    # search
+    "sort": search.sort, "argsort": search.argsort, "topk": search.topk,
+    "kthvalue": search.kthvalue, "mode": search.mode,
+    # creation
+    "tril": creation.tril, "triu": creation.triu, "diag": creation.diag,
+    # random
+    "normal_": random_ops.normal_, "uniform_": random_ops.uniform_,
+    "exponential_": random_ops.exponential_, "multinomial": random_ops.multinomial,
+    "bernoulli": random_ops.bernoulli,
+}
+
+for _name, _fn in _METHOD_SOURCES.items():
+    setattr(Tensor, _name, _fn)
+
+inverse = linalg.inv

@@ -1,0 +1,31 @@
+"""Op definition helpers.
+
+The reference generates its functional API from ops.yaml through 11 codegens
+(paddle/phi/api/generator/).  Here an op is a pure jax function registered with
+``apply_op`` — trace-time dispatch removes the KernelFactory/KernelKey layer
+entirely, and VJPs come from jax instead of backward.yaml.
+"""
+
+from __future__ import annotations
+
+from ..core import autograd
+from ..core.tensor import Tensor
+
+OP_REGISTRY: dict = {}
+
+
+def apply_op(name, prim, tensors, kwargs=None):
+    return autograd.apply(name, prim, tensors, kwargs)
+
+
+def register_op(name, prim, spmd_rule=None):
+    """Record an op in the registry (schema single-source-of-truth analog)."""
+    OP_REGISTRY[name] = {"prim": prim, "spmd_rule": spmd_rule}
+    return prim
+
+
+def as_tensors(*vals):
+    out = []
+    for v in vals:
+        out.append(v if isinstance(v, Tensor) else Tensor(v))
+    return out

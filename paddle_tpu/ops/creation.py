@@ -1,0 +1,140 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes
+from ..core.tensor import Tensor, to_tensor  # noqa: F401 (re-export)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), dtypes.convert_dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), dtypes.convert_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = dtypes.default_dtype() if isinstance(fill_value, float) else None
+    return Tensor(jnp.full(_shape(shape), fill_value, dtypes.convert_dtype(dtype) if dtype else None))
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    return Tensor(jnp.zeros_like(x._data, dtype=dtypes.convert_dtype(dtype) if dtype else None))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    return Tensor(jnp.ones_like(x._data, dtype=dtypes.convert_dtype(dtype) if dtype else None))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=dtypes.convert_dtype(dtype) if dtype else None))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange bounds must be python numbers on TPU (static shapes)")
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if all(isinstance(v, (int, np.integer)) for v in (start, end, step)) \
+            else dtypes.default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(stop, Tensor):
+        stop = stop.item()
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=dtypes.convert_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=dtypes.convert_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns),
+                          dtype=dtypes.convert_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    from ._prim import apply_op
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    if x.ndim == 1 and padding_value != 0:
+        def prim(a):
+            d = jnp.diag(a, k=offset)
+            mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+            return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+        return apply_op("diag", prim, (x,))
+    return apply_op("diag", lambda a: jnp.diag(a, k=offset), (x,))
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    from ._prim import apply_op
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    return apply_op("diagflat", lambda a: jnp.diagflat(a, k=offset), (x,))
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    from ._prim import apply_op
+    return apply_op("tril", lambda a: jnp.tril(a, k=diagonal), (x,))
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    from ._prim import apply_op
+    return apply_op("triu", lambda a: jnp.triu(a, k=diagonal), (x,))
+
+
+def meshgrid(*args, **kwargs) -> list:
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(g) for g in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+def assign(x, output=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    if output is not None:
+        output.set_value(x)
+        return output
+    return Tensor(x._data, stop_gradient=x.stop_gradient)
+
+
+def clone(x, name=None) -> Tensor:
+    return x.clone()
+
+
+def complex(real, imag, name=None) -> Tensor:
+    from ._prim import apply_op
+    return apply_op("complex", lambda r, i: jax.lax.complex(r, i), (real, imag))
+
+
+import jax  # noqa: E402  (used by complex)

@@ -1,0 +1,71 @@
+"""__getitem__/__setitem__ support (reference: paddle/fluid/pybind/slice_utils.h)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._prim import apply_op
+
+
+def _norm_index(idx):
+    """Convert Tensors inside an index expression to arrays / python ints."""
+    if isinstance(idx, Tensor):
+        if idx.ndim == 0 and np.issubdtype(idx.dtype, np.integer):
+            return idx._data
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, list):
+        if any(isinstance(i, (slice, type(None), type(Ellipsis))) for i in idx):
+            return tuple(_norm_index(i) for i in idx)
+        return jnp.asarray([i._data if isinstance(i, Tensor) else i for i in idx])
+    if isinstance(idx, slice):
+        return slice(
+            int(idx.start.item()) if isinstance(idx.start, Tensor) else idx.start,
+            int(idx.stop.item()) if isinstance(idx.stop, Tensor) else idx.stop,
+            int(idx.step.item()) if isinstance(idx.step, Tensor) else idx.step,
+        )
+    return idx
+
+
+def getitem(x, idx):
+    nidx = _norm_index(idx)
+    # boolean-mask indexing produces data-dependent shapes: resolve on host
+    if _has_bool_mask(nidx):
+        arr = np.asarray(x._data)
+        return Tensor(arr[_to_numpy_index(nidx)])
+    return apply_op("getitem", lambda a: a[nidx], (x,))
+
+
+def _has_bool_mask(idx):
+    items = idx if isinstance(idx, tuple) else (idx,)
+    for i in items:
+        if hasattr(i, "dtype") and np.dtype(i.dtype) == np.bool_ and getattr(i, "ndim", 0) > 0:
+            return True
+    return False
+
+
+def _to_numpy_index(idx):
+    if isinstance(idx, tuple):
+        return tuple(_to_numpy_index(i) for i in idx)
+    if hasattr(idx, "dtype"):
+        return np.asarray(idx)
+    return idx
+
+
+def setitem_array(x, idx, value):
+    """Functional __setitem__: returns the new underlying array."""
+    nidx = _norm_index(idx)
+    v = value._data if isinstance(value, Tensor) else jnp.asarray(value, x._data.dtype)
+    if _has_bool_mask(nidx):
+        items = nidx if isinstance(nidx, tuple) else (nidx,)
+        if len(items) == 1 and hasattr(items[0], "dtype"):
+            mask = items[0]
+            return jnp.where(jnp.broadcast_to(jnp.asarray(mask), x._data.shape),
+                             jnp.asarray(v, x._data.dtype), x._data)
+        arr = np.asarray(x._data)
+        arr[_to_numpy_index(nidx)] = np.asarray(v)
+        return jnp.asarray(arr)
+    return x._data.at[nidx].set(jnp.asarray(v, x._data.dtype))

@@ -1,0 +1,278 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py).
+
+matmul (linalg.py:191 in the reference) is the MXU hot path: computed via
+jnp.matmul with bf16-friendly precision from FLAGS_tpu_matmul_precision.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes, flags
+from ..core.tensor import Tensor
+from ._prim import apply_op, register_op
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _precision():
+    p = flags.flag("tpu_matmul_precision")
+    return None if p == "default" else p
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def prim(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b, precision=_precision())
+    return apply_op("matmul", prim, (_t(x), _t(y)))
+
+
+register_op("matmul", jnp.matmul, spmd_rule="MatmulInferSpmd")
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return apply_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), (_t(x), _t(y)))
+
+
+def inner(x, y, name=None):
+    return apply_op("inner", jnp.inner, (_t(x), _t(y)))
+
+
+def outer(x, y, name=None):
+    return apply_op("outer", lambda a, b: jnp.outer(a, b), (_t(x), _t(y)))
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def t(x, name=None):
+    from .manipulation import transpose
+    x = _t(x)
+    if x.ndim < 2:
+        return x
+    return transpose(x, [1, 0])
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else None
+    x, y = _t(x), _t(y)
+    if ax is None:
+        ax = next((i for i, s in enumerate(x.shape) if s == 3), 0)
+    return apply_op("cross", lambda a, b: jnp.cross(a, b, axis=ax), (x, y))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = _t(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, tuple) else 2
+    def prim(a):
+        if axis is None:
+            flat = a.reshape(-1)
+            if p in ("fro", 2):
+                return jnp.sqrt(jnp.sum(flat * flat)) if not keepdim else \
+                    jnp.sqrt(jnp.sum(flat * flat)).reshape([1] * a.ndim)
+            if p == np.inf:
+                return jnp.max(jnp.abs(flat))
+            if p == -np.inf:
+                return jnp.min(jnp.abs(flat))
+            if p == 1:
+                return jnp.sum(jnp.abs(flat))
+            if p == 0:
+                return jnp.sum((flat != 0).astype(a.dtype))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p)), 1.0 / p)
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=keepdim))
+        if p == "nuc":
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return jnp.sum(s, axis=-1, keepdims=keepdim)
+        return jnp.linalg.norm(a, ord=p, axis=axis, keepdims=keepdim)
+    return apply_op("norm", prim, (x,))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_op("vector_norm", lambda a: jnp.linalg.vector_norm(a, ord=p, axis=ax, keepdims=keepdim), (_t(x),))
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply_op("matrix_norm",
+                    lambda a: jnp.linalg.matrix_norm(a, ord=p, keepdims=keepdim), (_t(x),))
+
+
+def dist(x, y, p=2, name=None):
+    return norm(apply_op("sub", jnp.subtract, (_t(x), _t(y))), p=p)
+
+
+def transpose(x, perm, name=None):
+    from .manipulation import transpose as _transpose
+    return _transpose(x, perm)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    arr = _t(input)._data
+    lo, hi = (float(jnp.min(arr)), float(jnp.max(arr))) if min == 0 and max == 0 else (min, max)
+    hist, _ = jnp.histogram(arr, bins=bins, range=(lo, hi))
+    return Tensor(hist.astype(dtypes.convert_dtype("int64")))
+
+
+def histogramdd(sample, bins=10, ranges=None, density=False, weights=None, name=None):
+    h, edges = jnp.histogramdd(_t(sample)._data, bins=bins, range=ranges, density=density,
+                               weights=None if weights is None else _t(weights)._data)
+    return Tensor(h), [Tensor(e) for e in edges]
+
+
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power", lambda a: jnp.linalg.matrix_power(a, int(n)), (_t(x),))
+
+
+def qr(x, mode="reduced", name=None):
+    res = apply_op("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), (_t(x),))
+    return res
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op("svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), (_t(x),))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = _t(x)
+    a = x._data
+    if center:
+        a = a - jnp.mean(a, axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+    k = q or min(a.shape[-2:])
+    return Tensor(u[..., :k]), Tensor(s[..., :k]), Tensor(jnp.swapaxes(vh, -1, -2)[..., :k])
+
+
+def eig(x, name=None):
+    vals, vecs = np.linalg.eig(np.asarray(_t(x)._data))
+    return Tensor(vals), Tensor(vecs)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), (_t(x),))
+
+
+def eigvals(x, name=None):
+    return Tensor(np.linalg.eigvals(np.asarray(_t(x)._data)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), (_t(x),))
+
+
+def det(x, name=None):
+    return apply_op("det", jnp.linalg.det, (_t(x),))
+
+
+def slogdet(x, name=None):
+    def prim(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return apply_op("slogdet", prim, (_t(x),))
+
+
+def inv(x, name=None):
+    return apply_op("inv", jnp.linalg.inv, (_t(x),))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), (_t(x),))
+
+
+def solve(x, y, name=None):
+    return apply_op("solve", jnp.linalg.solve, (_t(x), _t(y)))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def prim(a, b):
+        return jax.scipy.linalg.solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0,
+                                                 unit_diagonal=unitriangular)
+    return apply_op("triangular_solve", prim, (_t(x), _t(y)))
+
+
+def cholesky(x, upper=False, name=None):
+    def prim(a):
+        c = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(c, -1, -2) if upper else c
+    return apply_op("cholesky", prim, (_t(x),))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def prim(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return apply_op("cholesky_solve", prim, (_t(x), _t(y)))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(_t(x)._data)
+    piv = piv + 1  # paddle returns 1-based pivots (LAPACK convention)
+    if get_infos:
+        return Tensor(lu_), Tensor(piv.astype(np.int32)), Tensor(np.zeros((), np.int32))
+    return Tensor(lu_), Tensor(piv.astype(np.int32))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(_t(x)._data, rtol=tol))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(_t(x)._data, _t(y)._data, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return Tensor(jnp.cov(_t(x)._data, rowvar=rowvar, ddof=1 if ddof else 0,
+                          fweights=None if fweights is None else _t(fweights)._data,
+                          aweights=None if aweights is None else _t(aweights)._data))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor(jnp.corrcoef(_t(x)._data, rowvar=rowvar))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), (_t(x),))
+
+
+def einsum(equation, *operands):
+    ops = tuple(_t(o) for o in operands)
+    return apply_op("einsum", lambda *arrs: jnp.einsum(equation, *arrs, precision=_precision()), ops)
+
+
+def multi_dot(x, name=None):
+    return apply_op("multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), tuple(_t(i) for i in x))
+
+
+def householder_product(x, tau, name=None):
+    def prim(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+        for k in range(n):
+            v = jnp.concatenate(
+                [jnp.zeros(a.shape[:-2] + (k,), a.dtype),
+                 jnp.ones(a.shape[:-2] + (1,), a.dtype),
+                 a[..., k + 1:, k]], axis=-1)
+            h = jnp.eye(m, dtype=a.dtype) - t_[..., k:k + 1, None] * v[..., :, None] * v[..., None, :]
+            q = jnp.matmul(q, h)
+        return q[..., :, :n]
+    return apply_op("householder_product", prim, (_t(x), _t(tau)))

@@ -1,0 +1,510 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes
+from ..core.tensor import Tensor
+from ._prim import apply_op
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def cast(x, dtype):
+    x = _t(x)
+    d = dtypes.convert_dtype(dtype)
+    if np.dtype(x._data.dtype) == d:
+        return x
+    return apply_op("cast", lambda a: a.astype(d), (x,))
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+    return apply_op("reshape", lambda a: jnp.reshape(a, shape), (_t(x),))
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data = out._data
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _t(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    new_shape = x.shape[:s] + [-1] + x.shape[e + 1:]
+    return reshape(x, new_shape)
+
+
+def transpose(x, perm=None, name=None):
+    x = _t(x)
+    if perm is None:
+        perm = list(range(x.ndim))[::-1]
+    perm = tuple(int(p) for p in perm)
+    return apply_op("transpose", lambda a: jnp.transpose(a, perm), (x,))
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), (_t(x),))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op("swapaxes", lambda a: jnp.swapaxes(a, int(axis0), int(axis1)), (_t(x),))
+
+
+def squeeze(x, axis=None, name=None):
+    x = _t(x)
+    if axis is None:
+        return apply_op("squeeze", lambda a: jnp.squeeze(a), (x,))
+    if isinstance(axis, (int, np.integer)):
+        axis = [axis]
+    axis = tuple(int(a) % max(x.ndim, 1) for a in axis)
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    return apply_op("squeeze", lambda a: jnp.squeeze(a, axis=axis), (x,))
+
+
+def unsqueeze(x, axis, name=None):
+    x = _t(x)
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (int, np.integer)):
+        axis = [axis]
+    axis = tuple(int(a) for a in axis)
+    return apply_op("unsqueeze", lambda a: jnp.expand_dims(a, axis), (x,))
+
+
+def concat(x, axis=0, name=None):
+    tensors = [_t(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op("concat", lambda *arrs: jnp.concatenate(arrs, axis=int(axis)), tuple(tensors))
+
+
+def stack(x, axis=0, name=None):
+    tensors = [_t(t) for t in x]
+    return apply_op("stack", lambda *arrs: jnp.stack(arrs, axis=int(axis)), tuple(tensors))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = _t(x)
+    n = x.shape[axis] if num is None else num
+    outs = apply_op("unstack",
+                    lambda a: tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis)),
+                    (x,))
+    return list(outs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis) % x.ndim
+    if isinstance(num_or_sections, (int, np.integer)):
+        indices = int(num_or_sections)
+        outs = apply_op("split", lambda a: tuple(jnp.split(a, indices, axis=axis)), (x,))
+        return list(outs)
+    sections = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+    total = x.shape[axis]
+    if any(s == -1 for s in sections):
+        known = sum(s for s in sections if s != -1)
+        sections = [total - known if s == -1 else s for s in sections]
+    points = np.cumsum(sections)[:-1].tolist()
+    outs = apply_op("split", lambda a: tuple(jnp.split(a, points, axis=axis)), (x,))
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    reps = tuple(int(r.item()) if isinstance(r, Tensor) else int(r) for r in repeat_times)
+    return apply_op("tile", lambda a: jnp.tile(a, reps), (_t(x),))
+
+
+def expand(x, shape, name=None):
+    x = _t(x)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    cur = [1] * (len(shape) - x.ndim) + x.shape
+    target = tuple(c if s == -1 else s for s, c in zip(shape, cur))
+    return apply_op("expand", lambda a: jnp.broadcast_to(a.reshape(cur), target), (x,))
+
+
+def expand_as(x, y, name=None):
+    return expand(x, _t(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = jnp.broadcast_arrays(*[_t(i)._data for i in inputs])
+    return [Tensor(a) for a in arrs]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, (int, np.integer)):
+        axis = [axis]
+    ax = tuple(int(a) for a in axis)
+    return apply_op("flip", lambda a: jnp.flip(a, axis=ax), (_t(x),))
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, Tensor):
+        shifts = shifts.tolist()
+    return apply_op("roll", lambda a: jnp.roll(a, shifts, axis=axis), (_t(x),))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), (_t(x),))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = repeats._data
+    return apply_op("repeat_interleave", lambda a: jnp.repeat(a, repeats, axis=axis), (_t(x),))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    x = _t(x)
+    flat = x._data.reshape(-1)
+    idx = np.zeros(tuple(shape), dtype=dtypes.convert_dtype("int64")) + offset
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        ix = np.arange(s) * st
+        idx += ix.reshape([-1 if i == d else 1 for i in range(len(shape))])
+    return apply_op("as_strided", lambda a: a.reshape(-1)[jnp.asarray(idx)], (x,))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply_op("view_dtype", lambda a: a.view(dtypes.convert_dtype(shape_or_dtype)), (_t(x),))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    x = _t(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle semantics: pad applies to last len(pad)//2 spatial dims,
+        # ordered from the last dim backwards in (before, after) pairs
+        k = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format.upper() in ("NCHW", "NCL", "NCDHW"):
+            dims = list(range(nd - k, nd))
+        else:
+            dims = list(range(1, 1 + k))
+        for i, d in enumerate(dims):
+            width[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    kw = {"constant_values": value} if jmode == "constant" else {}
+    return apply_op("pad", lambda a: jnp.pad(a, width, mode=jmode, **kw), (x,))
+
+
+def unbind(x, axis=0, name=None):
+    return unstack(x, axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    arr = np.asarray(_t(x)._data)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r if i == 0 else r.astype(dtypes.convert_dtype("int64"))) for i, r in enumerate(res))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(_t(x)._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        change = np.concatenate([[True], arr[1:] != arr[:-1]])
+        out = arr[change]
+        results = [Tensor(out)]
+        if return_inverse:
+            results.append(Tensor(np.cumsum(change) - 1))
+        if return_counts:
+            idx = np.flatnonzero(change)
+            counts = np.diff(np.concatenate([idx, [arr.size]]))
+            results.append(Tensor(counts))
+        return results[0] if len(results) == 1 else tuple(results)
+    raise NotImplementedError("unique_consecutive with axis is not supported yet")
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._data if isinstance(value, Tensor) else value
+    return apply_op("masked_fill", lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), (_t(x), _t(mask)))
+
+
+def masked_select(x, mask, name=None):
+    arr = np.asarray(_t(x)._data)
+    m = np.asarray(_t(mask)._data)
+    return Tensor(arr[np.broadcast_to(m, arr.shape)])
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op("index_select", lambda a, i: jnp.take(a, i, axis=int(axis)), (_t(x), _t(index)))
+
+
+def index_sample(x, index):
+    return apply_op("index_sample",
+                    lambda a, i: jnp.take_along_axis(a, i, axis=1), (_t(x), _t(index)))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return apply_op("take_along_axis",
+                    lambda a, i: jnp.take_along_axis(a, i, axis=int(axis)), (_t(arr), _t(indices)))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True):  # noqa: A002
+    def prim(a, i, v):
+        v = jnp.broadcast_to(jnp.asarray(v, a.dtype), i.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=int(axis), inplace=False)
+        dims = [jnp.arange(s).reshape([-1 if k == d else 1 for k in range(i.ndim)])
+                for d, s in enumerate(i.shape)]
+        idx = tuple(i if d == (int(axis) % a.ndim) else jnp.broadcast_to(dims[d], i.shape)
+                    for d in range(a.ndim))
+        upd = a.at[idx]
+        return {"add": upd.add, "multiply": upd.multiply, "mul": upd.multiply,
+                "amin": upd.min, "amax": upd.max}[reduce](v)
+    vals = values if isinstance(values, Tensor) else Tensor(jnp.asarray(values))
+    return apply_op("put_along_axis", prim, (_t(arr), _t(indices), vals))
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = _t(x), _t(index)
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = Tensor(index._data.reshape(-1))
+    return apply_op("gather", lambda a, i: jnp.take(a, i, axis=int(axis) if not isinstance(axis, Tensor) else int(axis.item())), (x, index))
+
+
+def gather_nd(x, index, name=None):
+    def prim(a, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+    return apply_op("gather_nd", prim, (_t(x), _t(index)))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def prim(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        return a.at[i].set(jnp.zeros_like(u)).at[i].add(u)
+    return apply_op("scatter", prim, (_t(x), _t(index), _t(updates)))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def prim(a, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+    return apply_op("scatter_nd_add", prim, (_t(x), _t(index), _t(updates)))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zeros = Tensor(jnp.zeros(tuple(shape), _t(updates)._data.dtype))
+    return scatter_nd_add(zeros, index, updates)
+
+
+def index_add(x, index, axis, value, name=None):
+    def prim(a, i, v):
+        a_m = jnp.moveaxis(a, int(axis), 0)
+        out = a_m.at[i].add(jnp.moveaxis(v, int(axis), 0))
+        return jnp.moveaxis(out, 0, int(axis))
+    return apply_op("index_add", prim, (_t(x), _t(index), _t(value)))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def prim(a, v, *idx):
+        ref = a.at[tuple(idx)]
+        return ref.add(v) if accumulate else ref.set(v)
+    return apply_op("index_put", prim, (_t(x), _t(value)) + tuple(_t(i) for i in indices))
+
+
+def index_fill(x, index, axis, value, name=None):
+    v = value._data if isinstance(value, Tensor) else value
+
+    def prim(a, i):
+        a_m = jnp.moveaxis(a, int(axis), 0)
+        out = a_m.at[i].set(jnp.asarray(v, a.dtype))
+        return jnp.moveaxis(out, 0, int(axis))
+    return apply_op("index_fill", prim, (_t(x), _t(index)))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op("where", lambda c, a, b: jnp.where(c, a, b), (_t(condition), _t(x), _t(y)))
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(_t(x)._data)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(i.astype(dtypes.convert_dtype("int64"))) for i in nz)
+    return Tensor(np.stack(nz, axis=1).astype(dtypes.convert_dtype("int64")))
+
+
+def numel(x, name=None):
+    return Tensor(np.dtype("int64").type(_t(x).size))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    def prim(i):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+        ok = (i >= lo) & (i < hi)
+        return jnp.where(ok, i - lo, ignore_value)
+    return apply_op("shard_index", prim, (_t(input),))
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None):
+    raise NotImplementedError
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op("one_hot", lambda i: jax.nn.one_hot(i, int(num_classes), dtype=jnp.float32), (_t(x),))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = _t(x)
+    if weights is not None:
+        return Tensor(jnp.bincount(x._data, weights=_t(weights)._data, minlength=minlength))
+    return Tensor(jnp.bincount(x._data, minlength=minlength))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(_t(sorted_sequence)._data, _t(values)._data, side=side)
+    return Tensor(out.astype(np.int32 if out_int32 else dtypes.convert_dtype("int64")))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), (_t(x),))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def prim(a):
+        n = a.shape[-1] + builtins_abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        ii = jnp.arange(a.shape[-1])
+        rows = ii + (-offset if offset < 0 else 0)
+        cols = ii + (offset if offset > 0 else 0)
+        out = out.at[..., rows, cols].set(a)
+        d1, d2 = dim1 % out.ndim, dim2 % out.ndim
+        return jnp.moveaxis(out, (-2, -1), (d1, d2))
+    return apply_op("diag_embed", prim, (_t(x),))
+
+
+from builtins import abs as builtins_abs  # noqa: E402
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _t(x)
+    shape = [int(s) for s in (shape or x.shape)]
+    offsets = [int(o) for o in (offsets or [0] * x.ndim)]
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return apply_op("crop", lambda a: a[slices], (x,))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = _t(x)
+    sl = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        sl[int(ax)] = slice(int(s), int(e), int(st))
+    sl = tuple(sl)
+    return apply_op("strided_slice", lambda a: a[sl], (x,))
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    return strided_slice(x, axes, starts, ends, [1] * len(axes))
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return apply_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), (_t(x), _t(y)))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op("atleast_1d", jnp.atleast_1d, (_t(i),)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op("atleast_2d", jnp.atleast_2d, (_t(i),)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op("atleast_3d", jnp.atleast_3d, (_t(i),)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def hsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=1 if _t(x).ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=2)
+
+
+def hstack(x, name=None):
+    return apply_op("hstack", lambda *a: jnp.hstack(a), tuple(_t(t) for t in x))
+
+
+def vstack(x, name=None):
+    return apply_op("vstack", lambda *a: jnp.vstack(a), tuple(_t(t) for t in x))
+
+
+def dstack(x, name=None):
+    return apply_op("dstack", lambda *a: jnp.dstack(a), tuple(_t(t) for t in x))
+
+
+def column_stack(x, name=None):
+    return apply_op("column_stack", lambda *a: jnp.column_stack(a), tuple(_t(t) for t in x))
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = _t(x)
+    outs = jnp.array_split(x._data, num_or_indices if isinstance(num_or_indices, int)
+                           else [int(i) for i in num_or_indices], axis=axis)
+    return [Tensor(o) for o in outs]

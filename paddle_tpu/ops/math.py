@@ -1,0 +1,311 @@
+"""Elementwise math + reductions (reference: python/paddle/tensor/math.py, ops.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes
+from ..core.tensor import Tensor
+from ._prim import apply_op, register_op
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---------------- unary ----------------
+
+def _unary(name, fn):
+    def op(x, name=None):
+        return apply_op(name_, fn, (_t(x),))
+    name_ = name
+    op.__name__ = name
+    register_op(name, fn)
+    return op
+
+
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)  # noqa: A001
+sign = _unary("sign", jnp.sign)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+ceil = _unary("ceil", jnp.ceil)
+floor = _unary("floor", jnp.floor)
+round = _unary("round", jnp.round)  # noqa: A001
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
+neg = _unary("neg", jnp.negative)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+logsigmoid = _unary("logsigmoid", jax.nn.log_sigmoid)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+isnan = _unary("isnan", jnp.isnan)
+isinf = _unary("isinf", jnp.isinf)
+isfinite = _unary("isfinite", jnp.isfinite)
+logical_not = _unary("logical_not", jnp.logical_not)
+bitwise_not = _unary("bitwise_not", jnp.bitwise_not)
+
+
+# ---------------- binary ----------------
+
+def _binary(name, fn):
+    def op(x, y, name=None):
+        xt = isinstance(x, Tensor)
+        yt = isinstance(y, Tensor)
+        if not xt and not yt:
+            x = Tensor(x)
+        return apply_op(name_, fn, (x if xt or not yt else x, y))
+    name_ = name
+    op.__name__ = name
+    register_op(name, fn)
+    return op
+
+
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = _binary("pow", jnp.power)  # noqa: A001
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+hypot = _binary("hypot", jnp.hypot)
+logical_and = _binary("logical_and", jnp.logical_and)
+logical_or = _binary("logical_or", jnp.logical_or)
+logical_xor = _binary("logical_xor", jnp.logical_xor)
+bitwise_and = _binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binary("bitwise_xor", jnp.bitwise_xor)
+equal = _binary("equal", lambda a, b: jnp.equal(a, b))
+not_equal = _binary("not_equal", jnp.not_equal)
+less_than = _binary("less_than", jnp.less)
+less_equal = _binary("less_equal", jnp.less_equal)
+greater_than = _binary("greater_than", jnp.greater)
+greater_equal = _binary("greater_equal", jnp.greater_equal)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+heaviside = _binary("heaviside", jnp.heaviside)
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+ldexp = _binary("ldexp", jnp.ldexp)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def prim(a, s, b):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out
+    s = scale._data if isinstance(scale, Tensor) else scale
+    return apply_op("scale", lambda a: (a * s + bias) if bias_after_scale else ((a + bias) * s), (_t(x),))
+
+
+def multiplex(inputs, index, name=None):
+    arrs = jnp.stack([_t(i)._data for i in inputs])
+    idx = _t(index)._data.reshape(-1)
+    return Tensor(arrs[idx, jnp.arange(idx.shape[0])])
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply_op("clip", lambda a: jnp.clip(a, lo, hi), (_t(x),))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply_op("lerp", lambda a, b, w: a + w * (b - a), (_t(x), _t(y), weight))
+    return apply_op("lerp", lambda a, b: a + weight * (b - a), (_t(x), _t(y)))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), (_t(x),))
+
+
+# ---------------- reductions ----------------
+
+def _reduce(name, fn, dtype_arg=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _axis(axis)
+        kw = {"axis": ax, "keepdims": keepdim}
+        if dtype_arg and dtype is not None:
+            kw["dtype"] = dtypes.convert_dtype(dtype)
+        return apply_op(name_, lambda a: fn(a, **kw), (_t(x),))
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+sum = _reduce("sum", jnp.sum, dtype_arg=True)  # noqa: A001
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod, dtype_arg=True)
+max = _reduce("max", jnp.max)  # noqa: A001
+min = _reduce("min", jnp.min)  # noqa: A001
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+nanmean = _reduce("nanmean", jnp.nanmean)
+nansum = _reduce("nansum", jnp.nansum)
+logsumexp = _reduce("logsumexp", jax.scipy.special.logsumexp)
+all = _reduce("all", jnp.all)  # noqa: A001
+any = _reduce("any", jnp.any)  # noqa: A001
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op("std", lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), (_t(x),))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op("var", lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), (_t(x),))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+    return apply_op("median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim), (_t(x),))
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return apply_op("quantile", lambda a: jnp.quantile(a, jnp.asarray(q), axis=ax, keepdims=keepdim), (_t(x),))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return Tensor(jnp.count_nonzero(_t(x)._data, axis=ax, keepdims=keepdim))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = _t(x)
+    if axis is None:
+        return apply_op("cumsum", lambda a: jnp.cumsum(a.reshape(-1)), (x,))
+    return apply_op("cumsum", lambda a: jnp.cumsum(a, axis=int(axis)), (x,))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply_op("cumprod", lambda a: jnp.cumprod(a, axis=int(dim)), (_t(x),))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = _t(x)
+    ax = 0 if axis is None else int(axis)
+    a = x._data if axis is not None else x._data.reshape(-1)
+    # cummax over (value, index) pairs in one associative scan
+    n = a.shape[ax]
+    ind = jnp.broadcast_to(
+        jnp.arange(n).reshape([n if i == ax else 1 for i in range(a.ndim)]), a.shape)
+
+    def combine(c1, c2):
+        v1, i1 = c1
+        v2, i2 = c2
+        take2 = v2 >= v1
+        return jnp.where(take2, v2, v1), jnp.where(take2, i2, i1)
+    vals, inds = jax.lax.associative_scan(combine, (a, ind), axis=ax)
+    return Tensor(vals), Tensor(inds.astype(dtypes.convert_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = _t(x)
+    neg_vals, inds = cummax(Tensor(-x._data), axis=axis, dtype=dtype)
+    return Tensor(-neg_vals._data), inds
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = _t(x)
+    ax = _axis(axis)
+    if ax is None:
+        return apply_op("logcumsumexp", lambda a: jax.lax.cumlogsumexp(a.reshape(-1), axis=0), (x,))
+    return apply_op("logcumsumexp", lambda a: jax.lax.cumlogsumexp(a, axis=ax), (x,))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = _t(x)
+    ax = _axis(axis)
+    out = jnp.argmax(x._data if ax is not None else x._data.reshape(-1), axis=ax if ax is not None else 0)
+    if keepdim and ax is not None:
+        out = jnp.expand_dims(out, ax)
+    return Tensor(out.astype(dtypes.convert_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = _t(x)
+    ax = _axis(axis)
+    out = jnp.argmin(x._data if ax is not None else x._data.reshape(-1), axis=ax if ax is not None else 0)
+    if keepdim and ax is not None:
+        out = jnp.expand_dims(out, ax)
+    return Tensor(out.astype(dtypes.convert_dtype(dtype)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(_t(x)._data, _t(y)._data, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(_t(x)._data, _t(y)._data, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(_t(x)._data, _t(y)._data))
+
+
+def kron(x, y, name=None):
+    return apply_op("kron", jnp.kron, (_t(x), _t(y)))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = _t(prepend)._data if prepend is not None else None
+    app = _t(append)._data if append is not None else None
+    return apply_op("diff", lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app), (_t(x),))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    yd = _t(y)._data
+    if x is not None:
+        return Tensor(jax.scipy.integrate.trapezoid(yd, x=_t(x)._data, axis=axis))
+    return Tensor(jax.scipy.integrate.trapezoid(yd, dx=1.0 if dx is None else dx, axis=axis))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op("nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), (_t(x),))

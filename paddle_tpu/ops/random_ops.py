@@ -1,0 +1,131 @@
+"""Random ops (reference: python/paddle/tensor/random.py).
+
+Functional TPU randomness: every op draws a key from the global/traced RNG
+state (core/random.py) — the analog of the per-device generator the reference
+keeps, but trace-safe so to_static programs get per-call fresh keys.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes
+from ..core import random as rnd
+from ..core.tensor import Tensor
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.normal(rnd.next_key(), _shape(shape), dtypes.convert_dtype(dtype)))
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.uniform(rnd.next_key(), _shape(shape), dtypes.convert_dtype(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:  # noqa: A002
+    key = jax.random.key(seed) if seed else rnd.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtypes.convert_dtype(dtype),
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, dtype=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(rnd.next_key(), out_shape))
+    return Tensor(mean + std * jax.random.normal(rnd.next_key(), _shape(shape or [1]),
+                                                 dtypes.convert_dtype(dtype)))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None) -> Tensor:
+    key = jax.random.key(seed) if seed else rnd.next_key()
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape), dtypes.convert_dtype(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(rnd.next_key(), _shape(shape), int(low), int(high),
+                                     dtype=dtypes.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return Tensor(jax.random.permutation(rnd.next_key(), int(n)).astype(dtypes.convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    if replacement:
+        out = jax.random.categorical(rnd.next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + x._data.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        g = jax.random.gumbel(rnd.next_key(), x._data.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(dtypes.convert_dtype("int64")))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    return Tensor(jax.random.bernoulli(rnd.next_key(), x._data).astype(x._data.dtype))
+
+
+def poisson(x, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    return Tensor(jax.random.poisson(rnd.next_key(), x._data).astype(x._data.dtype))
+
+
+def binomial(count, prob, name=None) -> Tensor:
+    count = count if isinstance(count, Tensor) else Tensor(count)
+    prob = prob if isinstance(prob, Tensor) else Tensor(prob)
+    return Tensor(jax.random.binomial(rnd.next_key(), count._data.astype(np.float32),
+                                      prob._data).astype(dtypes.convert_dtype("int64")))
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    u = jax.random.uniform(rnd.next_key(), tuple(x._data.shape), x._data.dtype,
+                           minval=1e-20, maxval=1.0)
+    x._data = -jnp.log(u) / lam
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
+    x._data = mean + std * jax.random.normal(rnd.next_key(), tuple(x._data.shape), x._data.dtype)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:  # noqa: A002
+    key = jax.random.key(seed) if seed else rnd.next_key()
+    x._data = jax.random.uniform(key, tuple(x._data.shape), x._data.dtype, minval=min, maxval=max)
+    return x
+
+
+def rand_like(x, dtype=None, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    return rand(x.shape, dtype or x.dtype)
+
+
+def randn_like(x, dtype=None, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    return randn(x.shape, dtype or x.dtype)

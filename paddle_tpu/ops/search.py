@@ -1,0 +1,106 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes
+from ..core.tensor import Tensor
+from ._prim import apply_op
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def prim(a):
+        out = jnp.sort(a, axis=axis, stable=stable or True)
+        return jnp.flip(out, axis=axis) if descending else out
+    return apply_op("sort", prim, (_t(x),))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    a = _t(x)._data
+    out = jnp.argsort(a, axis=axis, stable=True, descending=descending)
+    return Tensor(out.astype(dtypes.convert_dtype("int64")))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    x = _t(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = -1 if axis is None else int(axis)
+
+    def prim(a):
+        a_m = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(a_m, k)
+        else:
+            vals, idx = jax.lax.top_k(-a_m, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+    vals, idx = apply_op("topk", prim, (x,))
+    return vals, Tensor(idx._data.astype(dtypes.convert_dtype("int64")))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = _t(x)
+
+    def prim(a):
+        s = jnp.sort(a, axis=axis)
+        si = jnp.argsort(a, axis=axis)
+        v = jnp.take(s, k - 1, axis=axis)
+        i = jnp.take(si, k - 1, axis=axis)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            i = jnp.expand_dims(i, axis)
+        return v, i
+    v, i = apply_op("kthvalue", prim, (x,))
+    return v, Tensor(i._data.astype(dtypes.convert_dtype("int64")))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(_t(x)._data)
+    mv = np.apply_along_axis(lambda v: np.bincount(np.searchsorted(np.unique(v), v)).argmax(), axis, arr)
+    uniq = np.apply_along_axis(lambda v: np.sort(np.unique(v))[
+        np.bincount(np.searchsorted(np.unique(v), v)).argmax()], axis, arr)
+    idx = np.apply_along_axis(lambda v: np.max(np.flatnonzero(v == np.sort(np.unique(v))[
+        np.bincount(np.searchsorted(np.unique(v), v)).argmax()])), axis, arr)
+    del mv
+    if keepdim:
+        uniq = np.expand_dims(uniq, axis)
+        idx = np.expand_dims(idx, axis)
+    return Tensor(uniq), Tensor(idx.astype(dtypes.convert_dtype("int64")))
+
+
+def index_select(x, index, axis=0, name=None):
+    from .manipulation import index_select as _is
+    return _is(x, index, axis)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)
+
+
+def where(condition, x=None, y=None, name=None):
+    from .manipulation import where as _w
+    return _w(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    from .manipulation import nonzero as _nz
+    return _nz(x, as_tuple)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    from .manipulation import bucketize as _b
+    return _b(x, sorted_sequence, out_int32, right)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    from .manipulation import searchsorted as _s
+    return _s(sorted_sequence, values, out_int32, right)

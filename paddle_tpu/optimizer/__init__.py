@@ -1,0 +1,409 @@
+"""Optimizers (reference: python/paddle/optimizer/).
+
+Updates are pure jitted functions over (param, grad, state) — the multi-tensor
+fused-update analog: in eager every parameter's update is one cached XLA
+executable; under to_static training the whole step (fwd+bwd+update) fuses
+into a single program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from ..nn.clip import ClipGradBase
+from . import lr  # noqa: F401
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = self._flatten_param_groups(parameters)
+        self._param_groups = parameters if self._has_param_groups(parameters) else None
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
+        self._step_count = 0
+        self._aux = {}
+
+    @staticmethod
+    def _has_param_groups(parameters):
+        return bool(parameters) and isinstance(parameters[0], dict)
+
+    @staticmethod
+    def _flatten_param_groups(parameters):
+        if parameters is None:
+            return None
+        if parameters and isinstance(parameters[0], dict):
+            flat = []
+            for group in parameters:
+                flat.extend(group["params"])
+            return flat
+        return list(parameters)
+
+    # ---- lr ----
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("optimizer's learning rate is an LRScheduler; "
+                               "call scheduler.step() instead")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ---- accumulators ----
+    def _acc(self, name: str, p: Parameter, init=None):
+        store = self._accumulators.setdefault(name, {})
+        key = id(p)
+        if key not in store:
+            store[key] = jnp.zeros(p._data.shape, p._data.dtype) if init is None else init
+        return store[key]
+
+    def _set_acc(self, name: str, p: Parameter, value):
+        self._accumulators[name][id(p)] = value
+
+    # ---- main API ----
+    @property
+    def _params(self) -> List[Parameter]:
+        if self._parameter_list is None:
+            raise ValueError("Optimizer created without parameters")
+        return self._parameter_list
+
+    def _collect_params_grads(self):
+        pg = []
+        for p in self._params:
+            if not p.trainable:
+                continue
+            g = p.grad
+            if g is None:
+                continue
+            pg.append((p, g))
+        return pg
+
+    def step(self):
+        params_grads = self._collect_params_grads()
+        self._apply_optimize(params_grads)
+
+    def _apply_optimize(self, params_grads):
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr_val = self.get_lr()
+        self._step_count += 1
+        for p, g in params_grads:
+            garr = g._data if isinstance(g, Tensor) else g
+            if garr.dtype != p._data.dtype:
+                garr = garr.astype(p._data.dtype)
+            wd = self._decay_for(p)
+            self._update_param(p, garr, lr_val, wd)
+
+    def _decay_for(self, p: Parameter) -> float:
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if not getattr(p, "need_clip", True) and wd:  # bias exempt conventions handled by caller
+            pass
+        if callable(getattr(self, "_apply_decay_param_fun", None)) and \
+                not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return float(wd) if not isinstance(wd, (list, tuple)) else float(wd[0])
+
+    def _update_param(self, p: Parameter, g, lr_val: float, wd: float):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._params:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # ---- state dict ----
+    def state_dict(self):
+        state = {}
+        for name, store in self._accumulators.items():
+            for i, p in enumerate(self._params):
+                if id(p) in store:
+                    state[f"{name}_{i}"] = Tensor(store[id(p)])
+        state["@step"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            state["LR_Scheduler"] = self._learning_rate.state_dict()
+        return state
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("@step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for name in list(self._accumulators) or self._acc_names():
+            store = self._accumulators.setdefault(name, {})
+            for i, p in enumerate(self._params):
+                key = f"{name}_{i}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    store[id(p)] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+    def _acc_names(self):
+        return []
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(3,))
+def _sgd_update(param, grad, lr, wd):
+    if wd:
+        grad = grad + wd * param
+    return param - lr * grad
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update_param(self, p, g, lr_val, wd):
+        p._data = _sgd_update(p._data, g, lr_val, wd)
+
+
+@partial(jax.jit, donate_argnums=(0, 2), static_argnums=(5, 6))
+def _momentum_update(param, grad, velocity, lr, mu, use_nesterov, wd):
+    if wd:
+        grad = grad + wd * param
+    v_new = mu * velocity + grad
+    if use_nesterov:
+        update = grad + mu * v_new
+    else:
+        update = v_new
+    return param - lr * update, v_new
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _acc_names(self):
+        return ["velocity"]
+
+    def _update_param(self, p, g, lr_val, wd):
+        v = self._acc("velocity", p)
+        p._data, v_new = _momentum_update(p._data, g, v, lr_val, self._momentum,
+                                          self._use_nesterov, wd)
+        self._set_acc("velocity", p, v_new)
+
+
+@partial(jax.jit, donate_argnums=(0, 2, 3), static_argnums=())
+def _adam_update(param, grad, m, v, lr, beta1, beta2, eps, t, wd, lazy=None):
+    m_new = beta1 * m + (1 - beta1) * grad
+    v_new = beta2 * v + (1 - beta2) * jnp.square(grad)
+    mhat = m_new / (1 - beta1 ** t)
+    vhat = v_new / (1 - beta2 ** t)
+    update = mhat / (jnp.sqrt(vhat) + eps)
+    if wd is not None:
+        update = update + wd * param  # decoupled (AdamW); plain Adam passes wd=None
+    return param - lr * update, m_new, v_new
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._multi_precision = multi_precision
+
+    def _acc_names(self):
+        return ["moment1", "moment2"]
+
+    def _update_param(self, p, g, lr_val, wd):
+        # plain Adam applies weight decay as L2 into the gradient
+        if wd:
+            g = g + wd * p._data
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        p._data, m_new, v_new = _adam_update(p._data, g, m, v, lr_val, self._beta1,
+                                             self._beta2, self._epsilon,
+                                             float(self._step_count), None)
+        self._set_acc("moment1", p, m_new)
+        self._set_acc("moment2", p, v_new)
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update_param(self, p, g, lr_val, wd):
+        if self._lr_ratio is not None:
+            lr_val = lr_val * self._lr_ratio(p)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        p._data, m_new, v_new = _adam_update(p._data, g, m, v, lr_val, self._beta1,
+                                             self._beta2, self._epsilon,
+                                             float(self._step_count), wd or 0.0)
+        self._set_acc("moment1", p, m_new)
+        self._set_acc("moment2", p, v_new)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _acc_names(self):
+        return ["moment", "inf_norm"]
+
+    def _update_param(self, p, g, lr_val, wd):
+        if wd:
+            g = g + wd * p._data
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        m_new = self._beta1 * m + (1 - self._beta1) * g
+        u_new = jnp.maximum(self._beta2 * u, jnp.abs(g))
+        p._data = p._data - (lr_val / (1 - self._beta1 ** self._step_count)) * \
+            m_new / (u_new + self._epsilon)
+        self._set_acc("moment", p, m_new)
+        self._set_acc("inf_norm", p, u_new)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _acc_names(self):
+        return ["mean_square", "mean_grad", "velocity"]
+
+    def _update_param(self, p, g, lr_val, wd):
+        if wd:
+            g = g + wd * p._data
+        ms = self._acc("mean_square", p)
+        ms_new = self._rho * ms + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._acc("mean_grad", p)
+            mg_new = self._rho * mg + (1 - self._rho) * g
+            denom = jnp.sqrt(ms_new - jnp.square(mg_new) + self._epsilon)
+            self._set_acc("mean_grad", p, mg_new)
+        else:
+            denom = jnp.sqrt(ms_new + self._epsilon)
+        vel = self._acc("velocity", p)
+        vel_new = self._momentum * vel + lr_val * g / denom
+        p._data = p._data - vel_new
+        self._set_acc("mean_square", p, ms_new)
+        self._set_acc("velocity", p, vel_new)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _acc_names(self):
+        return ["moment"]
+
+    def _update_param(self, p, g, lr_val, wd):
+        if wd:
+            g = g + wd * p._data
+        acc = self._acc("moment", p, jnp.full(p._data.shape, self._init_acc, p._data.dtype))
+        acc_new = acc + jnp.square(g)
+        p._data = p._data - lr_val * g / (jnp.sqrt(acc_new) + self._epsilon)
+        self._set_acc("moment", p, acc_new)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _acc_names(self):
+        return ["avg_squared_grad", "avg_squared_update"]
+
+    def _update_param(self, p, g, lr_val, wd):
+        if wd:
+            g = g + wd * p._data
+        sg = self._acc("avg_squared_grad", p)
+        su = self._acc("avg_squared_update", p)
+        sg_new = self._rho * sg + (1 - self._rho) * jnp.square(g)
+        update = jnp.sqrt(su + self._epsilon) / jnp.sqrt(sg_new + self._epsilon) * g
+        su_new = self._rho * su + (1 - self._rho) * jnp.square(update)
+        p._data = p._data - lr_val * update
+        self._set_acc("avg_squared_grad", p, sg_new)
+        self._set_acc("avg_squared_update", p, su_new)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _acc_names(self):
+        return ["moment1", "moment2"]
+
+    def _update_param(self, p, g, lr_val, wd):
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        t = float(self._step_count)
+        m_new = self._beta1 * m + (1 - self._beta1) * g
+        v_new = self._beta2 * v + (1 - self._beta2) * jnp.square(g)
+        mhat = m_new / (1 - self._beta1 ** t)
+        vhat = v_new / (1 - self._beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        if wd and (self._exclude_fn is None or not self._exclude_fn(p)):
+            r = r + wd * p._data
+        w_norm = jnp.linalg.norm(p._data)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        p._data = p._data - lr_val * trust * r
+        self._set_acc("moment1", p, m_new)
+        self._set_acc("moment2", p, v_new)
+
+
+class Lars(Momentum):
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0, name=None):
+        super().__init__(learning_rate, momentum, parameters, False,
+                         lars_weight_decay, grad_clip, name)
+        self._lars_coeff = lars_coeff
+        self._lars_eps = epsilon
+
+    def _update_param(self, p, g, lr_val, wd):
+        w_norm = jnp.linalg.norm(p._data)
+        g_norm = jnp.linalg.norm(g)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm / (g_norm + wd * w_norm + self._lars_eps), 1.0)
+        v = self._acc("velocity", p)
+        v_new = self._momentum * v + lr_val * local_lr * (g + wd * p._data)
+        p._data = p._data - v_new
+        self._set_acc("velocity", p, v_new)
+
+
+LarsMomentumOptimizer = Lars
