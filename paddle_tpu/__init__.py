@@ -5,13 +5,17 @@ Architecture notes live in SURVEY.md §7 of the repo root; each module
 docstring cites the reference component (file:line) it re-implements.
 """
 
+import os as _os
+
 import jax as _jax
 
 # Paddle's dtype surface includes real int64/float64 tensors
 # (phi DataType::INT64/FLOAT64); without x64 JAX silently narrows to 32-bit.
 # Weak-typed Python scalars still combine at the other operand's dtype, and
 # all defaults here remain float32, so TPU compute paths are unaffected.
-_jax.config.update("jax_enable_x64", True)
+# An explicit JAX_ENABLE_X64 in the environment wins over this default.
+if "JAX_ENABLE_X64" not in _os.environ:
+    _jax.config.update("jax_enable_x64", True)
 
 from . import dtypes, errors, flags
 from .dtypes import (  # noqa: F401

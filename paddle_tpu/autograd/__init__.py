@@ -1,0 +1,203 @@
+"""paddle.autograd analog.
+
+Reference: python/paddle/autograd/ — backward (backward_mode.py:33), PyLayer
+(py_layer.py), functional transforms (functional.py: jacobian/hessian/jvp/vjp).
+PyLayer maps onto our tape as a hand-written GradNode; the functional
+transforms delegate to jax's jacfwd/jacrev/jvp/vjp on the unwrapped arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd as _engine
+from ..core.autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from ..core.tensor import Tensor
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward (reference backward_mode.py:33)."""
+    _engine.run_backward(tensors, grad_tensors, retain_graph)
+
+
+from ..core.autograd import grad  # noqa: F401,E402
+
+
+class PyLayerContext:
+    """ctx object handed to PyLayer.forward/backward (reference py_layer.py)."""
+
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError(
+            "PyLayer is not instantiated directly; call MyLayer.apply(...)")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined differentiable function (reference: paddle.autograd.PyLayer).
+
+    class Tanh(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle.tanh(x)
+            ctx.save_for_backward(y)
+            return y
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor()
+            return dy * (1 - y * y)
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        need_grad = _engine.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        if not need_grad:
+            return out
+
+        single = not isinstance(out, (tuple, list))
+        flat_out = (out,) if single else tuple(out)
+        diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+
+        def vjp_fn(cotangents):
+            cots = (cotangents,) if single else tuple(cotangents)
+            with no_grad():
+                grads = cls.backward(ctx, *[Tensor(c) for c in cots])
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            grad_arrays = []
+            gi = iter(grads)
+            for t in tensor_inputs:
+                if t.stop_gradient:
+                    # PyLayer.backward returns one grad per forward tensor input
+                    g = next(gi, None)
+                    continue
+                g = next(gi, None)
+                grad_arrays.append(None if g is None else
+                                   (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
+            return grad_arrays
+
+        node = _engine.GradNode(
+            cls.__name__, vjp_fn, diff_inputs,
+            [(tuple(o.shape), o._data.dtype) for o in flat_out], single)
+        for i, o in enumerate(flat_out):
+            o.stop_gradient = False
+            o._node, o._slot = node, i
+        return out
+
+
+class LegacyPyLayer(PyLayer):
+    pass
+
+
+def _fn_over_arrays(func, example_inputs):
+    """Lift a Tensor->Tensor function to a pure array function."""
+    def array_fn(*arrays):
+        tensors = [Tensor(a, stop_gradient=False) for a in arrays]
+        out = func(*tensors)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+    return array_fn
+
+
+def _unwrap(xs):
+    if isinstance(xs, Tensor):
+        return xs._data
+    if isinstance(xs, (tuple, list)):
+        return tuple(_unwrap(x) for x in xs)
+    return jnp.asarray(xs)
+
+
+def _wrap(o):
+    if isinstance(o, (tuple, list)):
+        return tuple(_wrap(x) for x in o)
+    return Tensor(o)
+
+
+def jacobian(func, xs, is_batched=False):
+    """paddle.autograd.jacobian — reverse-mode jacobian (functional.py)."""
+    single = isinstance(xs, Tensor)
+    xs_t = (xs,) if single else tuple(xs)
+    array_fn = _fn_over_arrays(func, xs_t)
+    jac = jax.jacrev(array_fn, argnums=tuple(range(len(xs_t))))(
+        *[t._data for t in xs_t])
+    if single:
+        jac = jac[0] if isinstance(jac, tuple) else jac
+    return _wrap(jac)
+
+
+def hessian(func, xs):
+    single = isinstance(xs, Tensor)
+    xs_t = (xs,) if single else tuple(xs)
+    array_fn = _fn_over_arrays(func, xs_t)
+    hes = jax.hessian(array_fn, argnums=tuple(range(len(xs_t))))(
+        *[t._data for t in xs_t])
+    if single:
+        hes = hes[0][0] if isinstance(hes, tuple) else hes
+    return _wrap(hes)
+
+
+def jvp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    xs_t = (xs,) if single else tuple(xs)
+    array_fn = _fn_over_arrays(func, xs_t)
+    primals = tuple(t._data for t in xs_t)
+    if v is None:
+        tangents = tuple(jnp.ones_like(p) for p in primals)
+    else:
+        v_t = (v,) if isinstance(v, Tensor) else tuple(v)
+        tangents = tuple(t._data for t in v_t)
+    out, tang_out = jax.jvp(array_fn, primals, tangents)
+    return _wrap(out), _wrap(tang_out)
+
+
+def vjp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    xs_t = (xs,) if single else tuple(xs)
+    array_fn = _fn_over_arrays(func, xs_t)
+    out, pullback = jax.vjp(array_fn, *[t._data for t in xs_t])
+    if v is None:
+        cot = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(
+            jnp.ones_like(o) for o in out)
+    else:
+        cot = _unwrap(v)
+    grads = pullback(cot)
+    grads = grads[0] if single else grads
+    return _wrap(out), _wrap(grads)
+
+
+__all__ = [
+    "backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+    "set_grad_enabled", "PyLayer", "PyLayerContext", "LegacyPyLayer",
+    "jacobian", "hessian", "jvp", "vjp",
+]

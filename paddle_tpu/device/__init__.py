@@ -1,0 +1,191 @@
+"""paddle.device analog over PJRT devices.
+
+Reference: python/paddle/device/ (set_device/get_device, cuda submodule with
+memory stats backed by paddle/phi/core/memory/stats.h).  Here devices are
+jax/PJRT devices; memory stats come from PJRT's per-device memory_stats().
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+
+__all__ = [
+    "set_device", "get_device", "get_all_custom_device_type",
+    "get_available_device", "get_available_custom_device", "device_count",
+    "synchronize", "Place", "CPUPlace", "TPUPlace", "CustomPlace", "Event",
+    "Stream", "current_stream",
+]
+
+
+class Place:
+    """Device identity (reference phi::Place)."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place) and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if d.platform == self.device_type]
+        if not devs:
+            devs = jax.devices()
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("tpu", device_id)
+
+
+class CustomPlace(Place):
+    pass
+
+
+_current = [None]
+
+
+def set_device(device: str) -> Place:
+    if ":" in device:
+        kind, idx = device.split(":")
+        place = Place(kind, int(idx))
+    elif device in ("cpu",):
+        place = CPUPlace()
+    else:
+        place = Place(device, 0)
+    _current[0] = place
+    return place
+
+
+def get_device() -> str:
+    if _current[0] is not None:
+        return f"{_current[0].device_type}:{_current[0].device_id}"
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def get_all_custom_device_type() -> List[str]:
+    return sorted({d.platform for d in jax.devices() if d.platform not in ("cpu",)})
+
+
+def get_available_device() -> List[str]:
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+get_available_custom_device = get_available_device
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def synchronize(device=None):
+    """Block until all launched work on the device is done
+    (reference paddle.device.synchronize -> stream sync)."""
+    for d in jax.live_arrays():
+        d.block_until_ready()
+
+
+class Stream:
+    """XLA orders work per-device; streams exist only as API parity objects."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None) -> Stream:
+    return Stream(device)
+
+
+class _MemNamespace:
+    """paddle.device.cuda-style memory stats over PJRT."""
+
+    @staticmethod
+    def _stats(device_id=0):
+        try:
+            d = jax.devices()[device_id]
+            return d.memory_stats() or {}
+        except Exception:
+            return {}
+
+    @classmethod
+    def max_memory_allocated(cls, device=None):
+        return cls._stats(_dev_id(device)).get("peak_bytes_in_use", 0)
+
+    @classmethod
+    def memory_allocated(cls, device=None):
+        return cls._stats(_dev_id(device)).get("bytes_in_use", 0)
+
+    @classmethod
+    def max_memory_reserved(cls, device=None):
+        return cls._stats(_dev_id(device)).get("peak_bytes_in_use", 0)
+
+    @classmethod
+    def memory_reserved(cls, device=None):
+        return cls._stats(_dev_id(device)).get("bytes_reserved", 0)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def device_count():
+        return jax.device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize(device)
+
+
+def _dev_id(device) -> int:
+    if device is None:
+        return 0
+    if isinstance(device, int):
+        return device
+    if isinstance(device, Place):
+        return device.device_id
+    if isinstance(device, str) and ":" in device:
+        return int(device.split(":")[1])
+    return 0
+
+
+cuda = _MemNamespace()
+tpu = _MemNamespace()

@@ -1,0 +1,11 @@
+"""paddle.framework analog: save/load, dtype helpers, seed plumbing.
+
+Reference: python/paddle/framework/__init__.py + io.py (paddle.save at
+io.py:773, paddle.load at io.py:1020).
+"""
+
+from . import io  # noqa: F401
+from .io import load, save  # noqa: F401
+
+from ..core.random import seed  # noqa: F401
+from ..dtypes import get_default_dtype, set_default_dtype  # noqa: F401

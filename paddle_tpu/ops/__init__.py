@@ -7,6 +7,7 @@ attaching the functional ops as methods and operator dunders.
 
 from __future__ import annotations
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -30,7 +31,9 @@ for _m in _MODULES:
 def _binop(fn, reverse=False):
     def op(self, other):
         if reverse:
-            return fn(other if isinstance(other, Tensor) else Tensor(np.asarray(other)), self)
+            # jnp.asarray keeps Python scalars weak-typed, so 3.0 * f32_tensor
+            # stays float32 under x64 (np.asarray would make a strong float64).
+            return fn(other if isinstance(other, Tensor) else Tensor(jnp.asarray(other)), self)
         return fn(self, other)
     return op
 

@@ -1,0 +1,209 @@
+"""Tests for paddle.save/load, DataLoader, autograd module (PyLayer etc.)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu.io import (
+    BatchSampler, DataLoader, Dataset, DistributedBatchSampler, IterableDataset,
+    TensorDataset, random_split,
+)
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.full((3,), i, dtype="float32"), np.int64(i % 2)
+
+    def __len__(self):
+        return self.n
+
+
+class TestSaveLoad:
+    def test_state_dict_roundtrip(self, tmp_path):
+        net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        path = str(tmp_path / "model.pdparams")
+        P.save(net.state_dict(), path)
+        loaded = P.load(path)
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        net2.set_state_dict(loaded)
+        for (k, v), (k2, v2) in zip(sorted(net.state_dict().items()),
+                                    sorted(net2.state_dict().items())):
+            assert k == k2
+            np.testing.assert_allclose(v.numpy(), v2.numpy())
+
+    def test_nested_objects(self, tmp_path):
+        obj = {"step": 7, "tensors": [P.to_tensor(np.arange(5, dtype="int64"))],
+               "nested": {"lr": 0.1}}
+        path = str(tmp_path / "ckpt.pdopt")
+        P.save(obj, path)
+        back = P.load(path)
+        assert back["step"] == 7
+        assert back["nested"]["lr"] == 0.1
+        np.testing.assert_array_equal(back["tensors"][0].numpy(), np.arange(5))
+
+    def test_return_numpy(self, tmp_path):
+        path = str(tmp_path / "t.pd")
+        P.save({"w": P.to_tensor(np.ones(3, "float32"))}, path)
+        back = P.load(path, return_numpy=True)
+        assert isinstance(back["w"], np.ndarray)
+
+    def test_bfloat16_roundtrip(self, tmp_path):
+        t = P.to_tensor(np.ones((2, 2), "float32")).astype("bfloat16")
+        path = str(tmp_path / "bf16.pd")
+        P.save({"t": t}, path)
+        back = P.load(path)
+        assert back["t"].dtype == t.dtype
+
+
+class TestDataLoader:
+    def test_basic_iteration(self):
+        loader = DataLoader(RangeDataset(10), batch_size=4, drop_last=False)
+        batches = list(loader)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 3]
+        assert y.shape == [4]
+        assert len(batches[-1][0]) == 2
+
+    def test_shuffle_epoch(self):
+        loader = DataLoader(RangeDataset(16), batch_size=16, shuffle=True)
+        (x1, _), = list(loader)
+        order1 = x1.numpy()[:, 0]
+        assert set(order1.tolist()) == set(range(16))
+
+    def test_drop_last(self):
+        loader = DataLoader(RangeDataset(10), batch_size=4, drop_last=True)
+        assert len(loader) == 2
+        assert len(list(loader)) == 2
+
+    def test_num_workers(self):
+        loader = DataLoader(RangeDataset(23), batch_size=4, num_workers=3)
+        batches = list(loader)
+        assert len(batches) == 6
+        # order must be preserved
+        firsts = [b[0].numpy()[0, 0] for b in batches]
+        assert firsts == [0.0, 4.0, 8.0, 12.0, 16.0, 20.0]
+
+    def test_iterable_dataset(self):
+        class Stream(IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.full((2,), i, "float32")
+
+        loader = DataLoader(Stream(), batch_size=3)
+        batches = list(loader)
+        assert len(batches) == 3
+        assert batches[0].shape == [3, 2]
+
+    def test_tensor_dataset_and_split(self):
+        xs = P.to_tensor(np.arange(20, dtype="float32").reshape(10, 2))
+        ys = P.to_tensor(np.arange(10, dtype="int64"))
+        ds = TensorDataset([xs, ys])
+        a, b = random_split(ds, [7, 3])
+        assert len(a) == 7 and len(b) == 3
+
+    def test_batch_sampler_custom(self):
+        bs = BatchSampler(RangeDataset(10), batch_size=5)
+        loader = DataLoader(RangeDataset(10), batch_sampler=bs)
+        assert len(list(loader)) == 2
+
+    def test_distributed_batch_sampler(self):
+        ds = RangeDataset(20)
+        seen = []
+        for rank in range(4):
+            s = DistributedBatchSampler(ds, batch_size=5, num_replicas=4, rank=rank)
+            for batch in s:
+                seen.extend(batch)
+        assert sorted(seen) == list(range(20))
+
+    def test_collate_dict(self):
+        class DictDS(Dataset):
+            def __getitem__(self, i):
+                return {"a": np.float32(i), "b": np.full((2,), i, "int64")}
+
+            def __len__(self):
+                return 4
+
+        loader = DataLoader(DictDS(), batch_size=2)
+        batch = next(iter(loader))
+        assert batch["a"].shape == [2]
+        assert batch["b"].shape == [2, 2]
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 2
+
+        x = P.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0, 2.0])
+
+    def test_custom_nonlinear(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Tanh(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = P.tanh(x)
+                ctx.save_for_backward(y)
+                return y
+
+            @staticmethod
+            def backward(ctx, dy):
+                (y,) = ctx.saved_tensor()
+                return dy * (1 - y * y)
+
+        xv = np.random.default_rng(0).standard_normal(5).astype("float32")
+        x = P.to_tensor(xv, stop_gradient=False)
+        Tanh.apply(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 1 - np.tanh(xv) ** 2, rtol=1e-5)
+
+
+class TestFunctionalAutograd:
+    def test_jacobian(self):
+        from paddle_tpu.autograd import jacobian
+        x = P.to_tensor(np.array([1.0, 2.0], "float32"))
+        jac = jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0]))
+
+    def test_hessian(self):
+        from paddle_tpu.autograd import hessian
+        x = P.to_tensor(np.array([1.0, 2.0], "float32"))
+        h = hessian(lambda t: (t * t).sum(), x)
+        np.testing.assert_allclose(h.numpy(), 2 * np.eye(2))
+
+    def test_jvp_vjp(self):
+        from paddle_tpu.autograd import jvp, vjp
+        x = P.to_tensor(np.array([1.0, 2.0], "float32"))
+        out, tangent = jvp(lambda t: t * t, x)
+        np.testing.assert_allclose(tangent.numpy(), [2.0, 4.0])
+        out, grads = vjp(lambda t: (t * t).sum(), x)
+        np.testing.assert_allclose(grads.numpy(), [2.0, 4.0])
+
+
+class TestDevice:
+    def test_device_api(self):
+        import paddle_tpu.device as device
+        assert device.device_count() >= 1
+        s = device.get_device()
+        assert ":" in s
+        place = device.set_device("cpu")
+        assert device.get_device() == "cpu:0"
+        assert device.cuda.memory_allocated() >= 0
