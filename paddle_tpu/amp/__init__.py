@@ -1,0 +1,208 @@
+"""paddle.amp analog.
+
+Reference: python/paddle/amp/ — auto_cast (auto_cast.py), decorate,
+GradScaler (grad_scaler.py:62) with dynamic loss scaling.  On TPU the
+default amp dtype is bfloat16 (same exponent range as fp32, so loss scaling
+is usually a no-op), but the fp16 path and the full scaler state machine are
+kept for parity and for fp16 inference.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import amp_state
+from ..core.amp_state import AmpAttrs, BLACK_LIST, WHITE_LIST
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "white_list",
+           "black_list", "is_auto_cast_enabled", "get_amp_dtype"]
+
+
+def white_list():
+    return {"float16": set(WHITE_LIST), "bfloat16": set(WHITE_LIST)}
+
+
+def black_list():
+    return {"float16": set(BLACK_LIST), "bfloat16": set(BLACK_LIST)}
+
+
+def is_auto_cast_enabled() -> bool:
+    return amp_state.current().enabled
+
+
+def get_amp_dtype() -> str:
+    cur = amp_state.current()
+    return cur.dtype if cur.enabled else "float32"
+
+
+class auto_cast:
+    """Context manager enabling per-op autocast (reference auto_cast.py:Pure
+    fp16/bf16 training O1/O2 levels)."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        if level not in ("O0", "O1", "O2"):
+            raise ValueError(f"level must be O0/O1/O2, got {level}")
+        if dtype not in ("float16", "bfloat16"):
+            raise ValueError(f"amp dtype must be float16/bfloat16, got {dtype}")
+        self.attrs = AmpAttrs(
+            enabled=bool(enable) and level != "O0", level=level, dtype=dtype,
+            white=set(custom_white_list or ()), black=set(custom_black_list or ()))
+
+    def __enter__(self):
+        amp_state.push(self.attrs)
+        return self
+
+    def __exit__(self, *exc):
+        amp_state.pop()
+        return False
+
+    def __call__(self, fn):
+        attrs = self.attrs
+
+        def wrapper(*a, **k):
+            amp_state.push(attrs)
+            try:
+                return fn(*a, **k)
+            finally:
+                amp_state.pop()
+        return wrapper
+
+
+autocast = auto_cast
+
+
+_KEEP_FP32_LAYERS = ("BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+                     "SyncBatchNorm", "RMSNorm")
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False):
+    """O2 decoration: cast model params to the amp dtype (norm layers stay
+    fp32), enable fp32 master weights in the optimizer
+    (reference amp/auto_cast.py decorate + multi_precision optimizer path)."""
+    single_model = not isinstance(models, (list, tuple))
+    single_opt = optimizers is not None and not isinstance(optimizers, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    opt_list = ([optimizers] if single_opt else list(optimizers or []))
+
+    if level == "O2":
+        target = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
+        for model in model_list:
+            for layer in model.sublayers(include_self=True):
+                if any(k in type(layer).__name__ for k in _KEEP_FP32_LAYERS):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and p._data.dtype == jnp.float32:
+                        p._data = p._data.astype(target)
+        for o in opt_list:
+            if master_weight is None or master_weight:
+                o._use_master_weights = True
+
+    if optimizers is None:
+        return model_list[0] if single_model else model_list
+    return ((model_list[0] if single_model else model_list),
+            (opt_list[0] if single_opt else opt_list))
+
+
+class GradScaler:
+    """Dynamic loss scaler (reference grad_scaler.py:62 state machine:
+    scale up after ``incr_every_n_steps`` good steps, scale down and skip the
+    step when non-finite grads appear)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = bool(enable)
+        self._scale = float(init_loss_scaling) if self._enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._dynamic
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def scale(self, loss: Tensor) -> Tensor:
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._params:
+            if p.grad is None:
+                continue
+            g = p.grad._data.astype(jnp.float32) * inv
+            if not bool(jnp.isfinite(g).all()):
+                found = True
+            p.grad = Tensor(g)
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update_scale()
+        self._unscaled = False
+
+    def update(self):
+        """No-op retained for API parity; scale bookkeeping happens in step."""
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def _update_scale(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    # -- state dict (checkpointable scaler, reference grad_scaler state) --
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+from . import debugging  # noqa: E402,F401

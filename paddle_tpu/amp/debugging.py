@@ -1,0 +1,96 @@
+"""Numeric debugging utilities.
+
+Reference: python/paddle/amp/debugging.py — TensorCheckerConfig (:173),
+enable_operator_stats_collection, check_numerics; backed there by
+FLAGS_check_nan_inf + nan_inf_utils.cc.  Here the kernel-output NaN check is
+the ``check_nan_inf`` flag consulted in core.autograd.apply.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from enum import Enum
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import flags
+from ..core.tensor import Tensor
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics", "collect_operator_stats",
+           "compare_accuracy"]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    """reference debugging.py:173."""
+
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+        self.debug_step = debug_step
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    if config.enable:
+        flags.set_flags({"check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    flags.set_flags({"check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Raise on NaN/Inf; return (num_nan, num_inf) tensors otherwise."""
+    arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    num_nan = int(jnp.isnan(arr).sum())
+    num_inf = int(jnp.isinf(arr).sum())
+    if num_nan or num_inf:
+        raise FloatingPointError(
+            f"[check_numerics] op={op_type} var={var_name}: "
+            f"{num_nan} NaN, {num_inf} Inf values detected")
+    return Tensor(jnp.asarray(num_nan)), Tensor(jnp.asarray(num_inf))
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """Collect per-dtype op counts during the block (reference
+    enable_operator_stats_collection)."""
+    from ..core import autograd as _engine
+    stats = {"float16": 0, "bfloat16": 0, "float32": 0, "other": 0}
+    orig_apply = _engine.apply
+
+    def counting_apply(name, prim, tensor_args, kwargs=None):
+        out = orig_apply(name, prim, tensor_args, kwargs)
+        first = out[0] if isinstance(out, tuple) else out
+        dt = str(first.dtype) if hasattr(first, "dtype") else "other"
+        stats[dt if dt in stats else "other"] += 1
+        return out
+
+    _engine.apply = counting_apply
+    try:
+        yield stats
+    finally:
+        _engine.apply = orig_apply
+        print("<------------------------------ op list ------------------------------->")
+        for k, v in stats.items():
+            print(f"  {k:<10} calls: {v}")
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename=None,
+                     loss_scale=1, dump_all_tensors=False):
+    raise NotImplementedError(
+        "compare_accuracy requires tensor dump files; use "
+        "paddle_tpu.amp.debugging.check_numerics for live checking")

@@ -1,0 +1,62 @@
+"""Autocast state consulted by the op-dispatch seam (core.autograd.apply).
+
+Reference: the AMP insertion point in generated ad_funcs
+(paddle/fluid/eager/amp_auto_cast.h) driven by per-op allow/block lists
+(python/paddle/amp/amp_lists.py).  Kept in core/ so autograd can import it
+without a cycle; the user API lives in paddle_tpu.amp.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# O1 allow list: ops that are fast and numerically safe in half precision
+# (reference WHITE_LIST amp_lists.py: conv/matmul/gemm family).
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "conv3d_transpose", "einsum", "addmm",
+    "flash_attention", "fused_linear",
+}
+
+# O1/O2 block list: numerically sensitive reductions stay float32
+# (reference BLACK_LIST: exp/log/softmax/norm/loss ops).
+BLACK_LIST = {
+    "exp", "expm1", "log", "log2", "log10", "log1p", "pow", "square", "sqrt",
+    "rsqrt", "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "layer_norm", "rms_norm", "group_norm", "instance_norm", "batch_norm",
+    "mean", "sum", "prod", "cumsum", "logsumexp", "sigmoid_cross_entropy_with_logits",
+    "binary_cross_entropy", "nll_loss", "kl_div", "erf", "erfinv", "norm",
+    "cos_sim", "dist", "renorm", "reduce_sum", "softplus", "linspace",
+}
+
+_tls = threading.local()
+
+
+class AmpAttrs:
+    __slots__ = ("enabled", "level", "dtype", "white", "black")
+
+    def __init__(self, enabled=False, level="O0", dtype="bfloat16",
+                 white=(), black=()):
+        self.enabled = enabled
+        self.level = level
+        self.dtype = dtype
+        self.white = set(white)
+        self.black = set(black)
+
+
+_DISABLED = AmpAttrs()
+
+
+def current() -> AmpAttrs:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else _DISABLED
+
+
+def push(attrs: AmpAttrs):
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    _tls.stack.append(attrs)
+
+
+def pop():
+    _tls.stack.pop()

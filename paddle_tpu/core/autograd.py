@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import dtypes, flags
+from . import amp_state
 
 _tls = threading.local()
 
@@ -102,12 +103,39 @@ class GradNode:
         for aval, c in zip(self.out_avals, self.cotangents):
             if c is None:
                 c = jnp.zeros(aval[0], aval[1])
+            elif c.dtype != aval[1]:
+                # mixed-precision boundaries (amp O1): an fp32 consumer may
+                # hand back an fp32 cotangent for a bf16 output
+                c = c.astype(aval[1])
             cots.append(c)
         return cots[0] if self.single_output else tuple(cots)
 
     def release(self):
         self.vjp_fn = None
         self.cotangents = [None] * len(self.out_avals)
+
+
+def _amp_cast(name, arrays, amp):
+    """Autocast inputs per allow/block lists (the amp_auto_cast.h insertion
+    point of the reference's generated ad_funcs)."""
+    amp_dtype = jnp.bfloat16 if amp.dtype == "bfloat16" else jnp.float16
+    in_white = name in amp.white or (name in amp_state.WHITE_LIST and name not in amp.black)
+    in_black = name in amp.black or (name in amp_state.BLACK_LIST and name not in amp.white)
+    if in_black:
+        target = jnp.float32
+        src = (amp_dtype,)
+    elif in_white or amp.level == "O2":
+        target = amp_dtype
+        src = (jnp.float32,)
+    else:
+        return arrays
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and a.dtype in src:
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
 
 
 def _check_nan_inf(name, arrays):
@@ -146,6 +174,10 @@ def apply(name: str, prim: Callable, tensor_args: Sequence, kwargs: dict | None 
 
     kwargs = kwargs or {}
     arrays = [a._data if isinstance(a, Tensor) else a for a in tensor_args]
+
+    amp = amp_state.current()
+    if amp.enabled:
+        arrays = _amp_cast(name, arrays, amp)
 
     tracing = any(isinstance(a, jax.core.Tracer) for a in arrays)
     diff_idx = []

@@ -244,9 +244,13 @@ class DistributedBatchSampler(BatchSampler):
         self.shuffle = shuffle
         self.drop_last = drop_last
         if num_replicas is None or rank is None:
-            from .. import distributed as dist
-            num_replicas = num_replicas if num_replicas is not None else dist.get_world_size()
-            rank = rank if rank is not None else dist.get_rank()
+            try:
+                from .. import distributed as dist
+                num_replicas = num_replicas if num_replicas is not None else dist.get_world_size()
+                rank = rank if rank is not None else dist.get_rank()
+            except ImportError:
+                num_replicas = num_replicas if num_replicas is not None else 1
+                rank = rank if rank is not None else 0
         self.nranks = num_replicas
         self.local_rank = rank
         self.epoch = 0
@@ -263,8 +267,10 @@ class DistributedBatchSampler(BatchSampler):
             indices = rng.permutation(n).tolist()
         else:
             indices = list(range(n))
-        # pad to be evenly divisible
-        indices += indices[: self.total_size - n]
+        # repeat-pad to be evenly divisible (dataset may be smaller than
+        # nranks, so a single slice-extend is not enough)
+        while len(indices) < self.total_size:
+            indices += indices[: self.total_size - len(indices)]
         indices = indices[self.local_rank::self.nranks]
         batch = []
         for idx in indices:
@@ -289,11 +295,11 @@ class WorkerInfo:
         self.seed = seed
 
 
-_worker_info: List[Optional[WorkerInfo]] = [None]
+_worker_tls = threading.local()
 
 
 def get_worker_info():
-    return _worker_info[0]
+    return getattr(_worker_tls, "info", None)
 
 
 def default_collate_fn(batch: List[Any]):
@@ -350,7 +356,7 @@ class _IterableIterator:
         if not samples:
             raise StopIteration
         if self.loader.batch_size is None:
-            return self.loader.collate_fn(samples)[0] if False else samples[0]
+            return samples[0]
         if len(samples) < (self.loader.batch_size or 1) and self.loader.drop_last:
             raise StopIteration
         return self.loader.collate_fn(samples)
@@ -371,6 +377,7 @@ class _PrefetchIterator:
         self.batches = list(iter(loader.batch_sampler))
         self.out: dict = {}
         self.next_idx = 0
+        self.shutdown = False
         self.cv = threading.Condition()
         self.task_iter = iter(enumerate(self.batches))
         self.task_lock = threading.Lock()
@@ -383,10 +390,10 @@ class _PrefetchIterator:
             w.start()
 
     def _work(self, wid, num_workers):
-        _worker_info[0] = WorkerInfo(wid, num_workers, self.loader.dataset, wid)
+        _worker_tls.info = WorkerInfo(wid, num_workers, self.loader.dataset, wid)
         if self.loader.worker_init_fn is not None:
             self.loader.worker_init_fn(wid)
-        while True:
+        while not self.shutdown:
             with self.task_lock:
                 task = next(self.task_iter, None)
             if task is None:
@@ -401,8 +408,10 @@ class _PrefetchIterator:
                     self.cv.notify_all()
                 return
             with self.cv:
-                while i > self.next_idx + self.max_ready:
+                while i > self.next_idx + self.max_ready and not self.shutdown:
                     self.cv.wait(timeout=1.0)
+                if self.shutdown:
+                    return
                 self.out[i] = batch
                 self.cv.notify_all()
 
@@ -421,6 +430,14 @@ class _PrefetchIterator:
             self.next_idx += 1
             self.cv.notify_all()
         return batch
+
+    def close(self):
+        with self.cv:
+            self.shutdown = True
+            self.cv.notify_all()
+
+    def __del__(self):
+        self.close()
 
 
 class DataLoader:

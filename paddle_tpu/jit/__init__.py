@@ -1,0 +1,363 @@
+"""paddle.jit analog: dynamic-to-static compilation via XLA.
+
+Reference: python/paddle/jit/api.py:196 ``to_static`` + SOT bytecode tracer
+(sot/translate.py:31) + PartialProgramLayer.  TPU-native redesign: tracing IS
+jax.jit — the "symbolic translation + PIR program + CINN" pipeline collapses
+to one jaxpr trace compiled by XLA.  The SOT guard cache becomes a shape/
+dtype/static-arg cache key; training works by treating the whole compiled
+program as ONE tape node (``jax.vjp`` of the jitted function gives a compiled
+forward and a compiled backward — the PartialProgramLayer fwd/bwd pair).
+
+jit.save/load use jax.export (StableHLO serialization) — the deployment
+artifact the reference produces as an inference ProgramDesc.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd as _engine
+from ..core.random import next_key, trace_key_scope
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["to_static", "not_to_static", "enable_to_static", "InputSpec",
+           "StaticFunction", "TranslatedLayer", "save", "load"]
+
+_enabled = [True]
+
+
+def enable_to_static(flag: bool):
+    """ProgramTranslator.enable analog."""
+    _enabled[0] = bool(flag)
+
+
+class InputSpec:
+    """Shape/dtype declaration (reference paddle.static.InputSpec).
+
+    ``None`` dims mark dynamic axes; XLA needs static shapes, so dynamic dims
+    participate in the guard key and each observed size compiles one variant
+    (the bucketing policy of SURVEY §7.4.3).
+    """
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _flatten(obj, out: List):
+    """Flatten nested containers, returning a spec tree with slot markers."""
+    if isinstance(obj, Tensor):
+        out.append(obj)
+        return ("T", len(out) - 1)
+    if isinstance(obj, (list, tuple)):
+        return ("L" if isinstance(obj, list) else "U",
+                [_flatten(v, out) for v in obj])
+    if isinstance(obj, dict):
+        return ("D", {k: _flatten(v, out) for k, v in sorted(obj.items())})
+    return ("S", obj)
+
+
+def _unflatten(spec, arrays):
+    kind, payload = spec
+    if kind == "T":
+        return Tensor(arrays[payload])
+    if kind in ("L", "U"):
+        vals = [_unflatten(s, arrays) for s in payload]
+        return vals if kind == "L" else tuple(vals)
+    if kind == "D":
+        return {k: _unflatten(s, arrays) for k, s in payload.items()}
+    return payload
+
+
+def _static_repr(spec):
+    """Hashable guard component for the non-tensor part of the args."""
+    kind, payload = spec
+    if kind == "T":
+        return ("T",)
+    if kind in ("L", "U"):
+        return (kind,) + tuple(_static_repr(s) for s in payload)
+    if kind == "D":
+        return ("D",) + tuple((k, _static_repr(s)) for k, s in payload.items())
+    try:
+        hash(payload)
+        return ("S", payload)
+    except TypeError:
+        return ("S", repr(payload))
+
+
+class StaticFunction:
+    """Guard-cached compiled callable (reference program_translator.py:377)."""
+
+    def __init__(self, function, input_spec=None, build_strategy=None,
+                 full_graph=True):
+        from ..nn.layer import Layer
+
+        self._layer: Optional[Layer] = None
+        if isinstance(function, Layer):
+            self._layer = function
+            self._fn = function.forward
+        else:
+            self._fn = function
+            self._layer = getattr(function, "__self__", None)
+            if self._layer is not None and not isinstance(self._layer, Layer):
+                self._layer = None
+        self._input_spec = input_spec
+        self.build_strategy = build_strategy
+        self._cache: dict = {}
+        self.__name__ = getattr(self._fn, "__name__", "static_fn")
+
+    # -- state collection ------------------------------------------------
+    def _state(self):
+        if self._layer is None:
+            return [], []
+        params, buffers = [], []
+        for _, p in self._layer.named_parameters():
+            params.append(p)
+        for _, b in self._layer.named_buffers():
+            buffers.append(b)
+        return params, buffers
+
+    def _make_pure(self, spec, n_params, n_buffers, n_inputs, param_objs,
+                   buffer_objs):
+        """Build prim(*arrays) running the python fn over tracer-backed state.
+
+        Array order: params, buffers, key, inputs.  Returns
+        (outputs..., new_buffer_values...); buffer mutation during the trace is
+        captured functionally (the BN running-stats problem of SURVEY §7.4.1).
+        """
+        fn = self._fn
+
+        def prim(*arrays):
+            p_arr = arrays[:n_params]
+            b_arr = arrays[n_params:n_params + n_buffers]
+            key = jax.random.wrap_key_data(arrays[n_params + n_buffers])
+            in_arr = arrays[n_params + n_buffers + 1:]
+            saved_p = [t._data for t in param_objs]
+            saved_b = [t._data for t in buffer_objs]
+            try:
+                for t, a in zip(param_objs, p_arr):
+                    t._data = a
+                for t, a in zip(buffer_objs, b_arr):
+                    t._data = a
+                with trace_key_scope(key):
+                    with _engine.no_grad():
+                        call_args, call_kwargs = _unflatten(spec, list(in_arr))
+                        out = fn(*call_args, **call_kwargs)
+                out_arrays: List = []
+                self._out_spec = _flatten_out(out, out_arrays)
+                new_b = [t._data for t in buffer_objs]
+            finally:
+                for t, a in zip(param_objs, saved_p):
+                    t._data = a
+                for t, a in zip(buffer_objs, saved_b):
+                    t._data = a
+            return tuple(out_arrays) + tuple(new_b)
+
+        return prim
+
+    # -- call ------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if not _enabled[0]:
+            return self._fn(*args, **kwargs)
+        tensors: List[Tensor] = []
+        spec = _flatten((tuple(args), dict(kwargs)), tensors)
+        params, buffers = self._state()
+        training = self._layer.training if self._layer is not None else False
+
+        guard = (
+            _static_repr(spec), training,
+            tuple((tuple(t.shape), str(t.dtype)) for t in tensors),
+            tuple((tuple(p.shape), str(p.dtype)) for p in params),
+            len(buffers),
+        )
+        entry = self._cache.get(guard)
+        if entry is None:
+            prim = self._make_pure(spec, len(params), len(buffers), len(tensors),
+                                   params, buffers)
+            entry = {"prim": prim, "jit": jax.jit(prim), "out_spec": None}
+            self._cache[guard] = entry
+
+        key = jax.random.key_data(next_key())
+        all_inputs = list(params) + list(buffers) + [Tensor(key)] + tensors
+        flat = _engine.apply(self.__name__, entry["jit"], all_inputs)
+        if not isinstance(flat, tuple):
+            flat = (flat,)
+        if entry["out_spec"] is None:
+            entry["out_spec"] = self._out_spec
+        out_spec = entry["out_spec"]
+        n_out = _count_slots(out_spec)
+        out_tensors = flat[:n_out]
+        new_buffers = flat[n_out:]
+        for b, nb in zip(buffers, new_buffers):
+            b._data = nb._data
+        return _unflatten_out(out_spec, list(out_tensors))
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def concrete_programs(self):
+        return list(self._cache)
+
+    def rollback(self):
+        return self._fn
+
+
+def _flatten_out(obj, out: List):
+    if isinstance(obj, Tensor):
+        out.append(obj._data)
+        return ("T", len(out) - 1)
+    if isinstance(obj, (list, tuple)):
+        return ("L" if isinstance(obj, list) else "U",
+                [_flatten_out(v, out) for v in obj])
+    if isinstance(obj, dict):
+        return ("D", {k: _flatten_out(v, out) for k, v in obj.items()})
+    return ("S", obj)
+
+
+def _count_slots(spec):
+    kind, payload = spec
+    if kind == "T":
+        return 1
+    if kind in ("L", "U"):
+        return sum(_count_slots(s) for s in payload)
+    if kind == "D":
+        return sum(_count_slots(s) for s in payload.values())
+    return 0
+
+
+def _unflatten_out(spec, tensors):
+    kind, payload = spec
+    if kind == "T":
+        return tensors[payload]
+    if kind in ("L", "U"):
+        vals = [_unflatten_out(s, tensors) for s in payload]
+        return vals if kind == "L" else tuple(vals)
+    if kind == "D":
+        return {k: _unflatten_out(s, tensors) for k, s in payload.items()}
+    return payload
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Compile a function/Layer for whole-program XLA execution
+    (reference jit/api.py:196)."""
+    def decorate(fn):
+        from ..nn.layer import Layer
+        static = StaticFunction(fn, input_spec=input_spec,
+                                build_strategy=build_strategy)
+        if isinstance(fn, Layer):
+            fn.forward = static
+            return fn
+        return static
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+# ---- save / load (deployment path) -------------------------------------
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save analog: StableHLO export + weights.
+
+    Produces ``path + '.stablehlo'`` (serialized jax.export artifact of the
+    inference forward) and ``path + '.pdiparams'`` (weights via paddle.save).
+    """
+    from .. import framework
+    from ..nn.layer import Layer
+
+    was_training = False
+    if isinstance(layer, Layer):
+        fn = layer.forward
+        was_training = layer.training
+        layer.eval()
+        params, buffers = [], []
+        for _, p in layer.named_parameters():
+            params.append(p)
+        for _, b in layer.named_buffers():
+            buffers.append(b)
+    else:
+        fn = layer
+        params, buffers = [], []
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec to trace the program")
+    example = [jnp.zeros([1 if d is None else d for d in s.shape],
+                         np.dtype(s.dtype)) for s in input_spec]
+
+    def pure(p_arr, b_arr, *inputs):
+        saved_p = [t._data for t in params]
+        saved_b = [t._data for t in buffers]
+        try:
+            for t, a in zip(params, p_arr):
+                t._data = a
+            for t, a in zip(buffers, b_arr):
+                t._data = a
+            with _engine.no_grad():
+                with trace_key_scope(jax.random.key(0)):
+                    out = fn(*[Tensor(i) for i in inputs])
+        finally:
+            for t, a in zip(params, saved_p):
+                t._data = a
+            for t, a in zip(buffers, saved_b):
+                t._data = a
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    p_arrays = [p._data for p in params]
+    b_arrays = [b._data for b in buffers]
+    try:
+        exported = jax.export.export(jax.jit(pure))(p_arrays, b_arrays, *example)
+    finally:
+        if was_training:
+            layer.train()
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(exported.serialize())
+    framework.io.save(
+        {"params": list(params), "buffers": list(buffers)}, path + ".pdiparams")
+
+
+class TranslatedLayer:
+    """Loaded deployment program (reference paddle.jit.TranslatedLayer)."""
+
+    def __init__(self, exported, params, buffers):
+        self._exported = exported
+        self._params = [p._data for p in params]
+        self._buffers = [b._data for b in buffers]
+
+    def __call__(self, *inputs):
+        arrays = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                  for i in inputs]
+        out = self._exported.call(self._params, self._buffers, *arrays)
+        if isinstance(out, (tuple, list)):
+            outs = tuple(Tensor(o) for o in out)
+            return outs[0] if len(outs) == 1 else outs
+        return Tensor(out)
+
+    def eval(self):
+        return self
+
+    forward = __call__
+
+
+def load(path):
+    from .. import framework
+
+    with open(path + ".stablehlo", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    state = framework.io.load(path + ".pdiparams")
+    return TranslatedLayer(exported, state["params"], state["buffers"])

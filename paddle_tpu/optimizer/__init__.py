@@ -32,6 +32,10 @@ class Optimizer:
         self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
         self._step_count = 0
         self._aux = {}
+        # amp O2: fp32 master copies keyed by id(param); enabled by
+        # paddle_tpu.amp.decorate (reference: multi_precision kernels)
+        self._use_master_weights = False
+        self._master_weights: Dict[int, jax.Array] = {}
 
     @staticmethod
     def _has_param_groups(parameters):
@@ -103,10 +107,21 @@ class Optimizer:
         self._step_count += 1
         for p, g in params_grads:
             garr = g._data if isinstance(g, Tensor) else g
-            if garr.dtype != p._data.dtype:
-                garr = garr.astype(p._data.dtype)
             wd = self._decay_for(p)
-            self._update_param(p, garr, lr_val, wd)
+            if self._use_master_weights and p._data.dtype in (
+                    jnp.float16, jnp.bfloat16):
+                orig_dtype = p._data.dtype
+                master = self._master_weights.get(id(p))
+                if master is None:
+                    master = p._data.astype(jnp.float32)
+                p._data = master
+                self._update_param(p, garr.astype(jnp.float32), lr_val, wd)
+                self._master_weights[id(p)] = p._data
+                p._data = p._data.astype(orig_dtype)
+            else:
+                if garr.dtype != p._data.dtype:
+                    garr = garr.astype(p._data.dtype)
+                self._update_param(p, garr, lr_val, wd)
 
     def _decay_for(self, p: Parameter) -> float:
         wd = self._weight_decay

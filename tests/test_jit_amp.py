@@ -1,0 +1,180 @@
+"""to_static + amp tests (reference: test/dygraph_to_static/ parity idiom —
+compiled output must match eager output; amp list behavior)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.amp as amp
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.jit import InputSpec, to_static
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(P.tanh(self.fc1(x)))
+
+
+class TestToStatic:
+    def test_function_parity(self):
+        @to_static
+        def f(x, y):
+            return P.matmul(x, y) + 1.0
+
+        a = P.to_tensor(np.random.default_rng(0).standard_normal((3, 4)).astype("float32"))
+        b = P.to_tensor(np.random.default_rng(1).standard_normal((4, 5)).astype("float32"))
+        out = f(a, b)
+        ref = P.matmul(a, b) + 1.0
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_layer_parity_and_cache(self):
+        net = SmallNet()
+        x = P.to_tensor(np.random.default_rng(2).standard_normal((5, 8)).astype("float32"))
+        eager = net(x).numpy()
+        snet = to_static(net)
+        static_out = snet(x).numpy()
+        np.testing.assert_allclose(static_out, eager, rtol=1e-5, atol=1e-6)
+        # second call hits cache (same guard)
+        assert len(snet.forward._cache) == 1
+        snet(x)
+        assert len(snet.forward._cache) == 1
+        # different shape -> new program
+        x2 = P.to_tensor(np.ones((7, 8), "float32"))
+        snet(x2)
+        assert len(snet.forward._cache) == 2
+
+    def test_training_through_static(self):
+        net = SmallNet()
+        net2 = SmallNet()
+        net2.set_state_dict(net.state_dict())
+        snet = to_static(net2)
+
+        x = P.to_tensor(np.random.default_rng(3).standard_normal((4, 8)).astype("float32"))
+        y = P.to_tensor(np.random.default_rng(4).standard_normal((4, 4)).astype("float32"))
+
+        loss_e = ((net(x) - y) ** 2).mean()
+        loss_e.backward()
+        loss_s = ((snet(x) - y) ** 2).mean()
+        loss_s.backward()
+        np.testing.assert_allclose(loss_s.numpy(), loss_e.numpy(), rtol=1e-5)
+        for (n1, p1), (n2, p2) in zip(net.named_parameters(), net2.named_parameters()):
+            assert p2.grad is not None, f"no grad for {n2} through to_static"
+            np.testing.assert_allclose(p1.grad.numpy(), p2.grad.numpy(),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_static_train_loop_converges(self):
+        net = to_static(SmallNet())
+        o = opt.Adam(parameters=net.parameters(), learning_rate=0.01)
+        x = P.to_tensor(np.random.default_rng(5).standard_normal((16, 8)).astype("float32"))
+        y = P.to_tensor(np.random.default_rng(6).standard_normal((16, 4)).astype("float32"))
+        losses = []
+        for _ in range(30):
+            loss = ((net(x) - y) ** 2).mean()
+            losses.append(float(loss.numpy()))
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_buffer_update_through_static(self):
+        bn = nn.BatchNorm1D(4)
+        sbn = to_static(bn)
+        x = P.to_tensor(np.random.default_rng(7).standard_normal((16, 4)).astype("float32") + 5.0)
+        sbn(x)
+        # running mean must move toward 5 through the traced program
+        assert float(np.abs(bn._mean.numpy()).mean()) > 0.1
+
+    def test_dropout_varies_under_static(self):
+        drop = to_static(nn.Dropout(0.5))
+        drop.train()
+        x = P.to_tensor(np.ones((64, 64), "float32"))
+        a = drop(x).numpy()
+        b = drop(x).numpy()
+        assert (a != b).any(), "dropout mask must differ between compiled calls"
+
+    def test_kwargs_and_static_args(self):
+        @to_static
+        def f(x, scale=1.0):
+            return x * scale
+
+        x = P.to_tensor(np.ones(3, "float32"))
+        np.testing.assert_allclose(f(x, scale=2.0).numpy(), [2, 2, 2])
+        np.testing.assert_allclose(f(x, scale=3.0).numpy(), [3, 3, 3])
+
+
+class TestJitSaveLoad:
+    def test_save_load_inference(self, tmp_path):
+        net = SmallNet()
+        net.eval()
+        path = str(tmp_path / "inference")
+        import paddle_tpu.jit as jit
+        jit.save(net, path, input_spec=[InputSpec([None, 8], "float32")])
+        loaded = jit.load(path)
+        x = P.to_tensor(np.random.default_rng(8).standard_normal((1, 8)).astype("float32"))
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestAmp:
+    def test_auto_cast_o1_matmul_bf16(self):
+        import ml_dtypes
+        a = P.to_tensor(np.ones((4, 4), "float32"))
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = P.matmul(a, a)
+            assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+            # black-list op stays fp32
+            s = P.nn.functional.softmax(a)
+            assert s.dtype == np.dtype("float32")
+        out2 = P.matmul(a, a)
+        assert out2.dtype == np.dtype("float32")
+
+    def test_auto_cast_disabled(self):
+        a = P.to_tensor(np.ones((4, 4), "float32"))
+        with amp.auto_cast(enable=False):
+            assert P.matmul(a, a).dtype == np.dtype("float32")
+
+    def test_grad_scaler_normal_step(self):
+        net = nn.Linear(4, 4)
+        o = opt.SGD(parameters=net.parameters(), learning_rate=0.1)
+        scaler = amp.GradScaler(init_loss_scaling=1024.0)
+        x = P.to_tensor(np.ones((2, 4), "float32"))
+        loss = (net(x) ** 2).mean()
+        before = net.weight.numpy().copy()
+        scaler.scale(loss).backward()
+        scaler.step(o)
+        o.clear_grad()
+        assert (net.weight.numpy() != before).any()
+        # grads were unscaled before the update: magnitude sane
+        assert np.abs(net.weight.numpy() - before).max() < 10.0
+
+    def test_grad_scaler_skips_inf(self):
+        net = nn.Linear(2, 2)
+        o = opt.SGD(parameters=net.parameters(), learning_rate=0.1)
+        scaler = amp.GradScaler(init_loss_scaling=4.0)
+        before = net.weight.numpy().copy()
+        net.weight.grad = P.to_tensor(np.array([[np.inf, 0], [0, 0]], "float32") * 4.0)
+        net.bias.grad = P.zeros_like(net.bias)
+        scaler.step(o)
+        np.testing.assert_array_equal(net.weight.numpy(), before)  # step skipped
+        assert scaler.get_loss_scaling() == 2.0  # scale halved
+
+    def test_decorate_o2(self):
+        import ml_dtypes
+        net = SmallNet()
+        o = opt.AdamW(parameters=net.parameters(), learning_rate=0.01)
+        net, o = amp.decorate(net, o, level="O2", dtype="bfloat16")
+        assert net.fc1.weight.dtype == np.dtype(ml_dtypes.bfloat16)
+        assert o._use_master_weights
+        x = P.to_tensor(np.ones((2, 8), "float32"))
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            loss = net(x).astype("float32").mean()
+        loss.backward()
+        o.step()
+        # master weights stay fp32
+        assert any(a.dtype == np.dtype("float32") for a in o._master_weights.values())
