@@ -1,0 +1,55 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/ — 148.7k LoC;
+SURVEY.md §2.6-§2.7).
+
+Execution model — single-controller SPMD over a `jax.sharding.Mesh`:
+"ranks" are devices, process groups are mesh axes, collectives are XLA
+ICI/DCN ops.  Multi-host scaling uses jax.distributed (each host runs this
+controller for its local devices; arrays remain global).
+"""
+
+from .env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
+)
+from .group import (  # noqa: F401
+    Group, ReduceOp, destroy_process_group, get_group, new_group,
+)
+from .communication import (  # noqa: F401
+    all_gather, all_gather_object, all_reduce, all_to_all, alltoall, barrier,
+    broadcast, broadcast_object_list, irecv, isend, ppermute, recv, reduce,
+    reduce_scatter, scatter, send,
+)
+from .parallel import DataParallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
+    reshard, shard_dataloader, shard_layer, shard_optimizer, shard_tensor,
+    unshard_dtensor,
+)
+from .auto_parallel.api import (  # noqa: F401
+    ShardingStage1, ShardingStage2, ShardingStage3,
+)
+from .auto_parallel.process_mesh import get_mesh, set_mesh  # noqa: F401
+from . import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401
+
+# reference parity: paddle.distributed.fleet.meta_parallel classes
+from .meta_parallel import (  # noqa: F401
+    LayerDesc, PipelineLayer, PipelineParallel, SharedLayerDesc,
+)
+
+
+def get_backend() -> str:
+    return "xla"
+
+
+def parallel_device_count() -> int:
+    return get_world_size()
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """reference: python/paddle/distributed/spawn.py.
+
+    Single-controller SPMD drives all local devices from one process, so
+    spawn degenerates to a direct call; multi-host launch is handled by
+    `paddle_tpu.distributed.launch` + jax.distributed.
+    """
+    return func(*args)

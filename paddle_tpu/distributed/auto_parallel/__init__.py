@@ -1,0 +1,6 @@
+from .placements import Partial, Placement, Replicate, Shard  # noqa: F401
+from .process_mesh import ProcessMesh  # noqa: F401
+from .api import (  # noqa: F401
+    dtensor_from_fn, reshard, shard_dataloader, shard_layer, shard_optimizer,
+    shard_tensor, unshard_dtensor,
+)

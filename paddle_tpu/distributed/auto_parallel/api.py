@@ -1,0 +1,299 @@
+"""Semi-auto parallel (DistTensor) API (reference:
+python/paddle/distributed/auto_parallel/api.py — shard_tensor :212,
+reshard :710, shard_layer :821, shard_optimizer :1612, shard_dataloader :3229;
+C++ DistTensor paddle/phi/core/distributed/auto_parallel/dist_tensor.h:39).
+
+TPU-native mechanism: placements compile to a ``jax.sharding.NamedSharding``
+and GSPMD does what the reference's InferSpmd→reshard→local-kernel pipeline
+does by hand — each op's sharding is propagated by XLA and the collectives
+(the reference's reshard function library: s_to_r = all_gather, p_to_r =
+all_reduce, s_to_s = all_to_all...) are emitted by the partitioner.  Explicit
+``reshard`` lowers to a sharding constraint (traced) or ``jax.device_put``
+(eager), which performs the same collective data movement.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Parameter, Tensor
+from .placements import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh
+
+
+class DistMeta:
+    __slots__ = ("process_mesh", "placements")
+
+    def __init__(self, process_mesh: ProcessMesh, placements: Sequence[Placement]):
+        self.process_mesh = process_mesh
+        self.placements = list(placements)
+
+
+def placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
+                       ndim: int) -> P:
+    """placements (one per mesh dim) → PartitionSpec (one entry per tensor dim).
+
+    Partial placements occupy no tensor dim (XLA partial tiling is internal);
+    they are tracked in DistMeta and discharged on reshard.
+    """
+    per_dim: List[List[str]] = [[] for _ in range(ndim)]
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim % ndim if ndim else 0
+            per_dim[d].append(mesh.dim_names[mesh_dim])
+        elif not isinstance(pl, (Replicate, Partial)):
+            raise TypeError(f"unknown placement {pl!r}")
+    entries = []
+    for names in per_dim:
+        if not names:
+            entries.append(None)
+        elif len(names) == 1:
+            entries.append(names[0])
+        else:
+            entries.append(tuple(names))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def _normalize_placements(placements, mesh: ProcessMesh):
+    if placements is None:
+        return [Replicate() for _ in range(mesh.ndim)]
+    pls = list(placements)
+    while len(pls) < mesh.ndim:
+        pls.append(Replicate())
+    return pls
+
+
+def sharding_of(tensor, mesh: ProcessMesh, placements) -> NamedSharding:
+    ndim = tensor.ndim if hasattr(tensor, "ndim") else np.ndim(tensor)
+    spec = placements_to_spec(placements, mesh, ndim)
+    return NamedSharding(mesh.to_jax(), spec)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 stop_gradient=None) -> Tensor:
+    """reference: auto_parallel/api.py:212.
+
+    Takes the *global* tensor and lays it out over the mesh.  Under
+    single-controller SPMD the global value is the source of truth (matching
+    the reference's DistTensor global semantics); ``Partial`` keeps the global
+    (already-reduced) value and is recorded as metadata.
+    """
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    pls = _normalize_placements(placements, mesh)
+    arr = t._data
+    if isinstance(arr, jax.core.Tracer):
+        arr = jax.lax.with_sharding_constraint(arr, sharding_of(t, mesh, pls))
+    else:
+        arr = jax.device_put(arr, sharding_of(t, mesh, pls))
+    cls = Parameter if isinstance(t, Parameter) else Tensor
+    out = cls(arr, name=t.name)
+    out.stop_gradient = t.stop_gradient if stop_gradient is None else stop_gradient
+    out.trainable = t.trainable
+    out._dist_meta = DistMeta(mesh, pls)
+    return out
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh, placements, *args, **kwargs) -> Tensor:
+    """reference: auto_parallel/api.py dtensor_from_fn."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements) -> Tensor:
+    """reference: auto_parallel/api.py:710 + the reshard function library
+    (paddle/phi/core/distributed/auto_parallel/reshard/): the data movement
+    (all_gather/all_to_all/slice/all_reduce) is emitted by XLA from the
+    sharding change; cross-mesh reshard = device_put to the new device set."""
+    pls = _normalize_placements(placements, mesh)
+    src_meta = getattr(dist_tensor, "_dist_meta", None)
+    arr = dist_tensor._data
+    # Discharge Partial→Replicate/Shard: the global value is already the
+    # reduced one under single-controller semantics (see shard_tensor); for a
+    # `max`-partial nothing changes either (metadata-only transition).
+    if isinstance(arr, jax.core.Tracer):
+        arr = jax.lax.with_sharding_constraint(
+            arr, sharding_of(dist_tensor, mesh, pls))
+    else:
+        arr = jax.device_put(arr, sharding_of(dist_tensor, mesh, pls))
+    out = Tensor(arr, name=dist_tensor.name)
+    out.stop_gradient = dist_tensor.stop_gradient
+    out._dist_meta = DistMeta(mesh, pls)
+    return out
+
+
+def unshard_dtensor(dist_tensor: Tensor) -> Tensor:
+    """reference: auto_parallel/api.py unshard_dtensor — back to replicated."""
+    arr = dist_tensor._data
+    if not isinstance(arr, jax.core.Tracer):
+        arr = jax.device_put(arr, jax.devices()[0])
+    out = Tensor(arr, name=dist_tensor.name)
+    out.stop_gradient = dist_tensor.stop_gradient
+    return out
+
+
+# ---- Tensor integration ----
+def _placements(self):
+    return self._dist_meta.placements if self._dist_meta is not None else None
+
+
+def _process_mesh(self):
+    return self._dist_meta.process_mesh if self._dist_meta is not None else None
+
+
+Tensor.placements = property(_placements)
+Tensor.process_mesh = property(_process_mesh)
+
+
+def shard_layer(layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None):
+    """reference: auto_parallel/api.py:821 — walk sublayers, let shard_fn
+    re-place each parameter; default replicates everything on the mesh."""
+
+    def _replicate_fn(name, sublayer, mesh):
+        for pname, p in list(sublayer._parameters.items()):
+            if p is not None and p._dist_meta is None:
+                sublayer.add_parameter(pname, shard_tensor(p, mesh, None))
+
+    fn = shard_fn or _replicate_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+# ---- sharded optimizer (ZeRO via placements, reference api.py:1322-1520) ----
+class _ShardingStage:
+    def __init__(self, sharding_mesh_dim, mesh=None):
+        self.sharding_mesh_dim = sharding_mesh_dim
+        self.mesh = mesh
+
+
+class ShardingStage1(_ShardingStage):
+    """Shard optimizer states over the sharding axis."""
+
+
+class ShardingStage2(_ShardingStage):
+    """+ gradients (same placement effect under single-controller: grads of
+    sharded states are sharded by propagation)."""
+
+
+class ShardingStage3(_ShardingStage):
+    """+ parameters."""
+
+
+def shard_optimizer(optimizer, shard_fn: Optional[Callable] = None):
+    """reference: auto_parallel/api.py:1612.
+
+    ZeRO on TPU is a *placement policy*, not a wrapper runtime (SURVEY.md
+    §7.1): stage 1/2 shard each optimizer-state tensor over the sharding mesh
+    axis; stage 3 additionally shards the parameters.  States are created
+    lazily, so we wrap the accumulator factory and re-place on first use.
+    """
+    if shard_fn is None:
+        return optimizer
+
+    def _pick_dim(p) -> int:
+        # shard along the largest dim divisible by the axis size
+        if isinstance(shard_fn, _ShardingStage) and shard_fn.mesh is not None:
+            axis = shard_fn.sharding_mesh_dim
+            mesh = shard_fn.mesh
+            size = mesh.get_dim_size(axis) if isinstance(axis, str) else mesh.shape[axis]
+            for d in np.argsort(p.shape)[::-1]:
+                if p.shape[int(d)] % size == 0:
+                    return int(d)
+        return -1
+
+    if isinstance(shard_fn, _ShardingStage):
+        stage = shard_fn
+        mesh = stage.mesh
+        if mesh is None:
+            from .process_mesh import get_mesh
+            mesh = get_mesh()
+            stage.mesh = mesh
+        axis = stage.sharding_mesh_dim
+        axis_idx = mesh.dim_names.index(axis) if isinstance(axis, str) else axis
+
+        def _state_placements(p):
+            d = _pick_dim(p)
+            pls = [Replicate()] * mesh.ndim
+            if d >= 0:
+                pls[axis_idx] = Shard(d)
+            return pls
+
+        orig_acc = optimizer._acc
+
+        def _sharded_acc(name, p, init=None):
+            store = optimizer._accumulators.setdefault(name, {})
+            fresh = id(p) not in store
+            arr = orig_acc(name, p, init)
+            if fresh and np.ndim(arr) > 0:
+                sh = NamedSharding(mesh.to_jax(),
+                                   placements_to_spec(_state_placements(p), mesh,
+                                                      np.ndim(arr)))
+                arr = jax.device_put(arr, sh)
+                store[id(p)] = arr
+            return arr
+
+        optimizer._acc = _sharded_acc
+
+        if isinstance(stage, ShardingStage3):
+            for p in optimizer._params:
+                if p._dist_meta is None:
+                    sharded = shard_tensor(p, mesh, _state_placements(p))
+                    p._data = sharded._data
+                    p._dist_meta = sharded._dist_meta
+        return optimizer
+
+    # custom shard_fn(key, param, accumulator) -> placed accumulator
+    orig_set = optimizer._set_acc
+
+    def _set(name, p, value):
+        value = shard_fn(name, p, Tensor(value))
+        orig_set(name, p, value._data if isinstance(value, Tensor) else value)
+
+    optimizer._set_acc = _set
+    return optimizer
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """reference: auto_parallel/api.py:3229 — wrap a DataLoader so each batch
+    is laid out over the mesh (batch dim sharded on `shard_dims`)."""
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+
+    class _ShardedLoader:
+        def __init__(self, loader):
+            self._loader = loader
+
+        def __len__(self):
+            return len(self._loader)
+
+        def __iter__(self):
+            for batch in self._loader:
+                yield jax.tree_util.tree_map(self._place, batch,
+                                             is_leaf=lambda x: isinstance(x, Tensor))
+
+        def _place(self, item):
+            if not isinstance(item, Tensor):
+                return item
+            if shard_dims is None:
+                return shard_tensor(item, mesh, None)
+            dims = shard_dims if isinstance(shard_dims, (list, tuple)) else [shard_dims]
+            pls = []
+            for name in mesh.dim_names:
+                pls.append(Shard(0) if name in dims else Replicate())
+            return shard_tensor(item, mesh, pls)
+
+    return _ShardedLoader(dataloader)

@@ -1,0 +1,75 @@
+"""Placement types (reference: python/paddle/distributed/auto_parallel/placement_type.py;
+C++ ``TensorDistAttr`` dims_mapping/partial — paddle/phi/core/distributed/
+auto_parallel/dist_attr.h).
+
+``Shard(d)``/``Replicate``/``Partial`` map 1:1 onto GSPMD:
+Shard(d) on mesh dim k ⇒ tensor dim d named with mesh axis k in a
+``PartitionSpec``; Replicate ⇒ axis unused; Partial ⇒ pending-reduction
+annotation (XLA's partial tiling) tracked as metadata and discharged by
+``reshard`` with a ``psum``.
+"""
+
+from __future__ import annotations
+
+
+class Placement:
+    def is_shard(self, dim=None) -> bool:
+        return False
+
+    def is_replicated(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None) -> bool:
+        return dim is None or dim == self.dim
+
+    def get_dim(self) -> int:
+        return self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicated(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type: str = "sum"):
+        # accept paddle's ReduceType enum-ish or a plain string
+        self.reduce_type = getattr(reduce_type, "name", str(reduce_type)).lower()
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"

@@ -1,0 +1,116 @@
+"""ProcessMesh (reference: python/paddle/distributed/auto_parallel/process_mesh.py
+class ProcessMesh; C++ paddle/phi/core/distributed/auto_parallel/process_mesh.h).
+
+Wraps a ``jax.sharding.Mesh``: the reference's process ids become device ids,
+dim_names become mesh axis names.  Sub-meshes (``mesh[i]``, used for MoE
+expert placement and pipeline stages) slice the device array.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .. import env
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None, process_ids=None):
+        if mesh is None and shape is not None:
+            mesh = np.array(process_ids if process_ids is not None
+                            else range(int(np.prod(shape)))).reshape(shape)
+        self._mesh = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(self._mesh.ndim)]
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(i) for i in self._mesh.flatten()]
+
+    @property
+    def mesh(self) -> np.ndarray:
+        return self._mesh
+
+    @property
+    def size(self) -> int:
+        return int(self._mesh.size)
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index=None):
+        """Move ``dim_name`` to the front (reference process_mesh.py same name);
+        with ``index``, take that slice (a sub-mesh without the axis)."""
+        axis = self._dim_names.index(dim_name)
+        order = [axis] + [i for i in range(self._mesh.ndim) if i != axis]
+        names = [self._dim_names[i] for i in order]
+        moved = self._mesh.transpose(order)
+        if index is not None:
+            return ProcessMesh(moved[index], names[1:])
+        return ProcessMesh(moved, names)
+
+    def to_jax(self) -> jax.sharding.Mesh:
+        """The backing jax Mesh (device order = process_ids)."""
+        if self._jax_mesh is None:
+            devs = env._devices()
+            dev_arr = np.empty(self._mesh.shape, dtype=object)
+            for idx in np.ndindex(self._mesh.shape):
+                dev_arr[idx] = devs[int(self._mesh[idx]) % len(devs)]
+            self._jax_mesh = jax.sharding.Mesh(dev_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __getitem__(self, index):
+        sub = self._mesh[index]
+        if np.isscalar(sub) or sub.ndim == 0:
+            return int(sub)
+        # track which dims the index dropped (int) vs kept (slice/array)
+        idx = index if isinstance(index, tuple) else (index,)
+        kept = []
+        for i, it in enumerate(idx):
+            if it is Ellipsis or it is None:
+                raise NotImplementedError("Ellipsis/None mesh indexing")
+            if not isinstance(it, (int, np.integer)):
+                kept.append(self._dim_names[i])
+        kept.extend(self._dim_names[len(idx):])
+        return ProcessMesh(sub, kept)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh, other._mesh)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+
+_GLOBAL_MESH = [None]
+
+
+def set_mesh(mesh: ProcessMesh):
+    """reference: python/paddle/distributed/auto_parallel/api.py set_mesh."""
+    _GLOBAL_MESH[0] = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _GLOBAL_MESH[0]

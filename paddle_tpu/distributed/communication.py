@@ -1,0 +1,324 @@
+"""Collective communication API (reference:
+python/paddle/distributed/communication/ — all_reduce/all_gather/alltoall/
+broadcast/reduce/scatter/reduce_scatter/send/recv; C++ side ProcessGroup
+paddle/phi/core/distributed/collective/process_group.h:48 and ProcessGroupNCCL
+paddle/fluid/distributed/collective/process_group_nccl.cc).
+
+Two execution paths, both XLA-native (no NCCL analog needed):
+
+1. **Traced (per-rank) path** — inside ``shard_map``/``pjit`` where the
+   group's mesh axis is bound, each call lowers to the matching
+   ``jax.lax`` collective (``psum``/``all_gather``/``all_to_all``/
+   ``ppermute``) and XLA schedules it on ICI/DCN.  This is the path the
+   parallel layers (TP/PP/MoE) use — the analog of the reference's
+   dedicated comm stream with event sync (process_group_nccl.cc:902):
+   XLA's latency-hiding scheduler overlaps these automatically.
+
+2. **Eager (single-controller) path** — the per-rank tensors of the
+   reference's SPMD processes are represented *stacked*: a tensor of
+   per-rank shape ``S`` for a group of N ranks is a global array
+   ``[N, *S]`` sharded over the group axis.  Each collective runs a
+   ``shard_map`` over the group's 1-D mesh so the real collective
+   executes on devices.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .group import Group, ReduceOp, _resolve_group
+
+
+class _Task:
+    """Completed-task handle (ProcessGroup tasks are futures; XLA dispatch is
+    already async, so wait() only blocks on the result buffer)."""
+
+    def __init__(self, data=None):
+        self._data = data
+
+    def wait(self):
+        if self._data is not None:
+            jax.block_until_ready(self._data)
+
+    def is_completed(self):
+        return True
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _as_array(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _stacked(f, g: Group, *arrays, out_specs=None):
+    """Run per-rank function f over the group's mesh; arrays are [N, ...]."""
+    ax = g.axis_name
+    spec = P(ax)
+    return jax.shard_map(f, mesh=g.mesh, in_specs=tuple(spec for _ in arrays),
+                         out_specs=spec if out_specs is None else out_specs,
+                         check_vma=False)(*arrays)
+
+
+def _check_stack(arr, g: Group, name: str):
+    if arr.ndim == 0 or arr.shape[0] != g.nranks:
+        raise ValueError(
+            f"{name}: eager collectives use stacked per-rank semantics — "
+            f"expected leading dim {g.nranks} (group size), got shape {list(arr.shape)}. "
+            f"Inside shard_map, pass traced per-rank tensors instead.")
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lax.psum,
+    ReduceOp.AVG: lambda x, ax: lax.pmean(x, ax),
+    ReduceOp.MAX: lax.pmax,
+    ReduceOp.MIN: lax.pmin,
+    ReduceOp.PROD: lambda x, ax: jnp.exp(lax.psum(jnp.log(x), ax)),
+}
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _resolve_group(group)
+    x = _as_array(tensor)
+    if g.nranks == 1:
+        return _Task(x)
+    red = _REDUCERS[op]
+    if _is_traced(x):
+        out = red(x, g.axis_name)
+    else:
+        _check_stack(x, g, "all_reduce")
+        out = _stacked(lambda v: red(v, g.axis_name), g, x)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return _Task(out)
+    return out
+
+
+def all_gather(tensor_list: Optional[List] = None, tensor=None, group=None, sync_op=True):
+    g = _resolve_group(group)
+    x = _as_array(tensor)
+    if _is_traced(x):
+        out = lax.all_gather(x, g.axis_name)  # [N, *S]
+    else:
+        if g.nranks == 1:
+            out = jnp.expand_dims(x, 0)
+        else:
+            _check_stack(x, g, "all_gather")
+            # each rank gathers every rank's slice: result identical per rank
+            out = _stacked(lambda v: lax.all_gather(v[0], g.axis_name), g, x,
+                           out_specs=P())
+    if tensor_list is not None:
+        for i in range(out.shape[0]):
+            tensor_list.append(Tensor(out[i]))
+        return _Task(out)
+    return out
+
+
+
+def _group_index(g: Group, rank: int, what: str) -> int:
+    """Map a global rank to its index in the group (paddle semantics: src/dst
+    are global ranks and must be members)."""
+    if rank in g.ranks:
+        return g.get_group_rank(rank)
+    raise ValueError(f"{what} rank {rank} is not a member of group {g.ranks}")
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    x = _as_array(tensor)
+    if g.nranks == 1:
+        return _Task(x)
+    si = _group_index(g, src, 'src')
+    if _is_traced(x):
+        out = lax.all_gather(x, g.axis_name)[si]
+    else:
+        _check_stack(x, g, "broadcast")
+        out = _stacked(lambda v: lax.all_gather(v[0], g.axis_name)[si][None], g, x)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return _Task(out)
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Only rank ``dst``'s slice receives the reduction (others keep input)."""
+    g = _resolve_group(group)
+    x = _as_array(tensor)
+    if g.nranks == 1:
+        return _Task(x)
+    di = _group_index(g, dst, 'dst')
+    red = _REDUCERS[op]
+    if _is_traced(x):
+        full = red(x, g.axis_name)
+        idx = lax.axis_index(g.axis_name)
+        out = jnp.where(idx == di, full, x)
+    else:
+        _check_stack(x, g, "reduce")
+
+        def f(v):
+            full = red(v, g.axis_name)
+            idx = lax.axis_index(g.axis_name)
+            return jnp.where(idx == di, full, v)
+
+        out = _stacked(f, g, x)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return _Task(out)
+    return out
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    if g.nranks > 1:
+        _group_index(g, src, 'src')
+    if tensor_list is not None:
+        stacked = jnp.stack([_as_array(t) for t in tensor_list])
+    else:
+        stacked = _as_array(tensor)
+    if g.nranks == 1:
+        out = stacked[0] if tensor_list is not None else stacked
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+        return _Task(out)
+    # rank i receives chunk i from src: pure slice in stacked form
+    out = stacked
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return _Task(out)
+    return out
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Per-rank input: list of N chunks (or [N*chunk] tensor); output: the
+    rank's chunk reduced over ranks.  Stacked eager input: [N_ranks, N_chunks, *S]."""
+    g = _resolve_group(group)
+    if tensor_list is not None:
+        x = jnp.stack([_as_array(t) for t in tensor_list])
+    else:
+        x = _as_array(tensor)
+    if g.nranks == 1:
+        out = x[0] if tensor_list is not None else x
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+        return _Task(out)
+    if _is_traced(x):
+        out = lax.psum_scatter(x, g.axis_name, scatter_dimension=0, tiled=False)
+    else:
+        _check_stack(x, g, "reduce_scatter")
+
+        def f(v):  # v: [1, N_chunks, *S]
+            return lax.psum_scatter(v[0], g.axis_name, scatter_dimension=0,
+                                    tiled=False)[None]
+
+        out = _stacked(f, g, x)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return _Task(out)
+    return out
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """reference: python/paddle/distributed/communication/all_to_all.py.
+
+    Per-rank semantics: rank i sends chunk j to rank j.  Stacked eager input:
+    ``[N_ranks, N_chunks, *S]`` → output ``out[i, j] = in[j, i]``.
+    """
+    g = _resolve_group(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        x = jnp.stack([_as_array(t) for t in in_tensor_list])
+    else:
+        x = _as_array(in_tensor_list)
+    if _is_traced(x):
+        out = lax.all_to_all(x, g.axis_name, split_axis=0, concat_axis=0, tiled=False)
+    elif g.nranks == 1:
+        out = x
+    else:
+        _check_stack(x, g, "alltoall")
+
+        def f(v):  # v: [1, N, *S]
+            return lax.all_to_all(v[0], g.axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False)[None]
+
+        out = _stacked(f, g, x)
+    if out_tensor_list is not None:
+        for i in range(out.shape[0]):
+            out_tensor_list.append(Tensor(out[i]))
+        return _Task(out)
+    return out
+
+
+all_to_all = alltoall
+
+
+# ---- p2p ----
+# Single-controller p2p: the controller plays both endpoints, so messages
+# queue FIFO per (group, dst); recv pops the oldest message for any dst the
+# caller could be (the reference's src/dst pairing is per-process state we
+# don't have — ordering is the contract here, as with MPI same-peer traffic).
+from collections import deque as _deque
+
+_MAILBOX: dict = {}
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    g = _resolve_group(group)
+    x = _as_array(tensor)
+    if _is_traced(x):
+        raise RuntimeError("Inside shard_map use paddle_tpu.distributed.ppermute "
+                           "(collective_permute) for p2p.")
+    _MAILBOX.setdefault(g.id, _deque()).append((dst, x))
+    return _Task(x)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    q = _MAILBOX.get(_resolve_group(group).id)
+    if not q:
+        raise RuntimeError("recv without matching send (single-controller p2p)")
+    _dst, out = q.popleft()
+    if isinstance(tensor, Tensor):
+        tensor._data = out.reshape(tensor._data.shape).astype(tensor._data.dtype)
+    return _Task(out)
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+def ppermute(x, perm: Sequence, group=None):
+    """collective_permute over the group axis (traced path only) — the p2p
+    building block for pipeline parallelism (reference p2p_communication.py:573
+    batch_isend_irecv maps to one lax.ppermute)."""
+    g = _resolve_group(group)
+    arr = _as_array(x)
+    out = lax.ppermute(arr, g.axis_name, list(perm))
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def barrier(group=None):
+    g = _resolve_group(group)
+    if g.nranks == 1:
+        return _Task()
+    x = jnp.zeros((g.nranks, 1))
+    out = _stacked(lambda v: lax.psum(v, g.axis_name), g, x)
+    jax.block_until_ready(out)
+    return _Task()
+
+
+# ---- object collectives (reference communication/all_gather.py all_gather_object) ----
+def all_gather_object(object_list: List, obj, group=None):
+    g = _resolve_group(group)
+    object_list.extend([obj] * g.nranks)
+
+
+def broadcast_object_list(object_list: List, src=0, group=None):
+    return object_list

@@ -1,0 +1,126 @@
+"""Distributed environment (reference: python/paddle/distributed/parallel.py:978
+``init_parallel_env``, ``ParallelEnv``; rendezvous via TCPStore
+paddle/phi/core/distributed/store/tcp_store.h:121).
+
+TPU-native model — **single-controller SPMD**: one Python process drives every
+local device through ``jax``; multi-host processes are coordinated by
+``jax.distributed`` (the TCPStore analog).  A "rank" in the reference's
+process-per-GPU world maps to a *device* here; process groups map to
+`jax.sharding.Mesh` axes/sub-meshes.  Collectives ride ICI/DCN via XLA
+(SURVEY.md §5.8 translation table).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+_STATE = {
+    "initialized": False,
+    "devices": None,       # list[jax.Device], rank order
+    "default_group": None,  # Group over all devices
+}
+
+
+def _devices() -> List:
+    if _STATE["devices"] is None:
+        _STATE["devices"] = list(jax.devices())
+    return _STATE["devices"]
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return len(_devices())
+
+
+def get_rank(group=None) -> int:
+    """Rank of this controller.
+
+    Under single-controller SPMD every device is driven by this process; the
+    reference's per-process rank (PADDLE_TRAINER_ID) maps to the process index
+    in a multi-host setup and to 0 on a single host.
+    """
+    if group is not None and group.nranks > 0:
+        return group.rank
+    return jax.process_index()
+
+
+def is_initialized() -> bool:
+    return _STATE["initialized"]
+
+
+class ParallelEnv:
+    """reference: python/paddle/distributed/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def device_id(self) -> int:
+        return 0
+
+    @property
+    def current_endpoint(self) -> str:
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self) -> List[str]:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    local_rank = rank
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None):
+    """Initialise the distributed env (reference parallel.py:978).
+
+    Single host: records the device list and builds the default (global)
+    group.  Multi-host: also brings up the jax.distributed coordination
+    service (TCPStore analog) using either explicit args or the standard
+    PADDLE_* / coordination env vars.
+    """
+    if _STATE["initialized"]:
+        return _STATE["default_group"]
+
+    addr = coordinator_address or os.environ.get("PADDLE_MASTER") or \
+        os.environ.get("MASTER_ADDR")
+    nproc = num_processes or int(os.environ.get("PADDLE_TRAINERS_NUM", "0") or 0)
+    pid = process_id if process_id is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    if addr and nproc > 1:
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nproc, process_id=pid)
+
+    _STATE["devices"] = list(jax.devices())
+    from .group import Group
+    world = list(range(len(_STATE["devices"])))
+    _STATE["default_group"] = Group(world, gid=0)
+    _STATE["initialized"] = True
+    return _STATE["default_group"]
+
+
+def _default_group():
+    if not _STATE["initialized"]:
+        init_parallel_env()
+    return _STATE["default_group"]
+
+
+def device_mesh_1d(ranks: List[int], axis_name: str = "g"):
+    """A 1-D Mesh over the given device ranks."""
+    devs = _devices()
+    return jax.sharding.Mesh(np.array([devs[r] for r in ranks]), (axis_name,))

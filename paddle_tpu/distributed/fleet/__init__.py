@@ -1,0 +1,125 @@
+"""Fleet: hybrid-parallel training facade (reference:
+python/paddle/distributed/fleet/fleet.py — fleet.init :218,
+distributed_model python/paddle/distributed/fleet/model.py:32,
+DistributedStrategy python/paddle/distributed/fleet/base/distributed_strategy.py:284).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import env
+from . import topology as _topology
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, build_hybrid_mesh, get_hcg,
+    set_hcg,
+)
+from .mpu import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding, get_rng_state_tracker,
+)
+
+
+class DistributedStrategy:
+    """reference distributed_strategy.py:284 — the single knob surface.
+
+    The protobuf schema becomes plain attributes; only the knobs that alter
+    behavior on TPU are consumed (hybrid_configs, amp, recompute); the rest
+    are accepted for API parity.
+    """
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+            "order": ["dp", "pp", "sharding", "sep", "mp"],
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.find_unused_parameters = False
+        self.tensor_parallel_configs = {}
+        self.sync_param = True
+
+    def __setattr__(self, k, v):
+        if k == "hybrid_configs" and hasattr(self, "hybrid_configs"):
+            merged = dict(self.__dict__["hybrid_configs"])
+            merged.update(v)
+            self.__dict__[k] = merged
+        else:
+            self.__dict__[k] = v
+
+
+class _Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+        """reference fleet.py:218 — builds the hybrid topology/mesh."""
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        env.init_parallel_env()
+        _topology.build_hybrid_mesh(
+            dp=hc.get("dp_degree", 1), mp=hc.get("mp_degree", 1),
+            pp=hc.get("pp_degree", 1), sharding=hc.get("sharding_degree", 1),
+            sep=hc.get("sep_degree", 1))
+        self._is_initialized = True
+        return self
+
+    def is_first_worker(self) -> bool:
+        return env.get_rank() == 0
+
+    def worker_index(self) -> int:
+        return env.get_rank()
+
+    def worker_num(self) -> int:
+        return env.get_world_size()
+
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        return get_hcg()
+
+    def distributed_model(self, model):
+        """reference model.py:32,:142-176 — wrap by parallel mode."""
+        hcg = get_hcg()
+        if hcg is None:
+            self.init()
+            hcg = get_hcg()
+        from ..meta_parallel import (PipelineParallel, ShardingParallel,
+                                     TensorParallel)
+        from ..parallel import DataParallel
+        if hcg.get_pipe_parallel_world_size() > 1:
+            return PipelineParallel(model, hcg, strategy=self._strategy)
+        if hcg.get_sharding_parallel_world_size() > 1:
+            model = ShardingParallel(model, hcg, strategy=self._strategy)
+        if hcg.get_model_parallel_world_size() > 1:
+            model = TensorParallel(model, hcg, strategy=self._strategy)
+        elif hcg.get_data_parallel_world_size() > 1:
+            model = DataParallel(model, strategy=self._strategy)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from ..meta_parallel import HybridParallelOptimizer
+        hcg = get_hcg()
+        if hcg is None:
+            return optimizer
+        return HybridParallelOptimizer(optimizer, hcg, self._strategy)
+
+    @property
+    def util(self):
+        return None
+
+
+fleet = _Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num

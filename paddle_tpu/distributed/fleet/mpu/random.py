@@ -1,0 +1,84 @@
+"""Cross-rank RNG state tracker (reference:
+python/paddle/distributed/fleet/layers/mpu/random.py RNGStatesTracker).
+
+Tensor-parallel dropout needs two RNG regimes: *same* across the mp group for
+replicated activations, *different* per rank for partitioned activations
+("local_seed").  On TPU keys are functional, so each tracked state is just a
+named root key; entering ``rng_state(name)`` swaps it in as the global key and
+writes the advanced key back on exit — identical semantics to the reference's
+cuRAND state swap, with no device state.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ....core import random as _random
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.key(int(seed))
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig = _random.get_rng_state()
+        _random.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = _random.get_rng_state()
+            _random.set_rng_state(orig)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed: int = None):
+    """reference mpu/random.py model_parallel_random_seed: derive a global
+    seed shared across mp ranks and a local seed unique per rank."""
+    import paddle_tpu as paddle
+    from ..topology import get_hcg
+
+    hcg = get_hcg()
+    rank = hcg.get_model_parallel_rank() if hcg else 0
+    if seed is None:
+        seed = 0
+    global_seed = seed
+    local_seed = seed + 1024 + rank
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    paddle.seed(global_seed)
+
+
+def determinate_seed(name: str) -> int:
+    return 0

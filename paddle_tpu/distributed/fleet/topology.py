@@ -1,0 +1,228 @@
+"""Hybrid-parallel process topology (reference:
+python/paddle/distributed/fleet/base/topology.py — CommunicateTopology /
+HybridCommunicateGroup :189, per-axis group creation :212-260).
+
+The reference builds a 5-D cartesian process topology
+[data, pipe, sharding, sep, model] and one NCCL ring per axis subset.  Here
+the whole topology IS one ``jax.sharding.Mesh`` with those named axes; each
+"communication group" is a mesh axis (XLA emits per-axis collectives over
+ICI), exposed through `Group` objects whose axis_name matches the mesh axis.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .. import env
+from ..group import Group
+
+_HCG = [None]
+
+_ORDER_DEFAULT = ["data", "pipe", "sharding", "sep", "model"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names: Sequence[str] = _ORDER_DEFAULT,
+                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(*(range(d) for d in dims)))
+        self._rank2coord = {self.coord_to_rank(c): c for c in self.coordinate}
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+    def coord_to_rank(self, coord) -> int:
+        rank = 0
+        for i, c in enumerate(coord):
+            rank = rank * self._dims[i] + c
+        return rank
+
+    def rank_to_coord(self, rank: int):
+        return self._rank2coord[rank]
+
+    def get_coord(self, rank: int):
+        return self.rank_to_coord(rank)
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return sorted(self.coord_to_rank(c) for c in self.coordinate
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All rank-groups along `axis_name` (one per combination of the
+        other axes) — reference topology.py get_comm_list."""
+        axis = self._parallel_names.index(axis_name)
+        other = [i for i in range(len(self._dims)) if i != axis]
+        groups = []
+        for combo in itertools.product(*(range(self._dims[i]) for i in other)):
+            ranks = []
+            for v in range(self._dims[axis]):
+                coord = [0] * len(self._dims)
+                for i, o in enumerate(other):
+                    coord[o] = combo[i]
+                coord[axis] = v
+                ranks.append(self.coord_to_rank(coord))
+            groups.append(ranks)
+        return groups
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = list(self.rank_to_coord(global_rank))
+        for k, v in kwargs.items():
+            coord[self._parallel_names.index(k)] = v
+        return self.coord_to_rank(coord)
+
+
+class HybridCommunicateGroup:
+    """reference topology.py:189 — built by fleet.init; owns per-axis groups
+    and the global hybrid mesh."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.global_rank = env.get_rank()
+        self.nranks = topology.world_size()
+
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in topology.get_hybrid_group_names() else 1
+        self._mp_degree = topology.get_dim("model")
+
+        # one global mesh with the topology's named axes (jax axis names can't
+        # collide with user axes; use canonical short names)
+        self._axis_map = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+                          "sep": "sep", "model": "mp"}
+        names = [self._axis_map[n] for n in topology.get_hybrid_group_names()]
+        dims = [topology.get_dim(n) for n in topology.get_hybrid_group_names()]
+        devs = env._devices()
+        n = int(np.prod(dims))
+        if len(devs) % n != 0 and n > len(devs):
+            raise ValueError(f"topology needs {n} devices, have {len(devs)}")
+        dev_arr = np.array(devs[:n]).reshape(dims)
+        self.global_mesh = jax.sharding.Mesh(dev_arr, tuple(names))
+
+        self._groups: Dict[str, Group] = {}
+        for logical, short in self._axis_map.items():
+            if logical in topology.get_hybrid_group_names():
+                ranks = topology.get_comm_list(logical)[0]
+                g = Group(ranks, name=f"{short}_group")
+                g.axis_name = short     # collectives inside shard_map bind this
+                g._mesh = None          # lazily built over these devices
+                self._groups[short] = g
+
+        # fused groups (reference topology.py:255-260): the dp×sep cartesian
+        # sub-grid (all ranks whose coords differ only in data/sep) for grad sync
+        self._dp_sep_group = None
+        if "sep" in self._groups and self._sep_degree * self._dp_degree > 1:
+            names = topology.get_hybrid_group_names()
+            d_ax, s_ax = names.index("data"), names.index("sep")
+            ranks = sorted(
+                topology.coord_to_rank(c) for c in topology.coordinate
+                if all(c[i] == 0 for i in range(len(names)) if i not in (d_ax, s_ax)))
+            self._dp_sep_group = Group(ranks, name="dp_sep_group")
+
+    # ---- degrees (reference :195-199) ----
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._sep_degree
+
+    # ---- ranks (single-controller: coordinate of rank 0's perspective) ----
+    def get_data_parallel_rank(self) -> int:
+        return self._coord("data")
+
+    def get_model_parallel_rank(self) -> int:
+        return self._coord("model")
+
+    def get_stage_id(self) -> int:
+        return self._coord("pipe")
+
+    def get_sharding_parallel_rank(self) -> int:
+        return self._coord("sharding")
+
+    def get_sep_parallel_rank(self) -> int:
+        return self._coord("sep")
+
+    def _coord(self, name: str) -> int:
+        coord = self._topo.rank_to_coord(self.global_rank % self.nranks)
+        return coord[self._topo.get_hybrid_group_names().index(name)]
+
+    # ---- groups ----
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    def get_dp_sep_parallel_group(self) -> Group:
+        return self._dp_sep_group or self._groups["dp"]
+
+    def get_check_parallel_group(self, *a, **k) -> Group:
+        return Group(list(range(self.nranks)), name="check_group")
+
+    def get_data_parallel_group_src_rank(self) -> int:
+        return self._groups["dp"].ranks[0]
+
+    def get_model_parallel_group_src_rank(self) -> int:
+        return self._groups["mp"].ranks[0]
+
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    # ---- p2p neighbours for PP (reference topology.py get_p2p_groups) ----
+    def get_p2p_groups(self):
+        return None
+
+    def get_pipe_parallel_prev_next(self):
+        stage = self.get_stage_id()
+        pp = self._pp_degree
+        return (stage - 1) % pp, (stage + 1) % pp
+
+
+def set_hcg(hcg: HybridCommunicateGroup):
+    _HCG[0] = hcg
+
+
+def get_hcg() -> Optional[HybridCommunicateGroup]:
+    return _HCG[0]
+
+
+def build_hybrid_mesh(dp: int = 1, mp: int = 1, pp: int = 1, sharding: int = 1,
+                      sep: int = 1) -> HybridCommunicateGroup:
+    """Convenience used by fleet.init and tests."""
+    env.init_parallel_env()
+    topo = CommunicateTopology(_ORDER_DEFAULT, [dp, pp, sharding, sep, mp])
+    hcg = HybridCommunicateGroup(topo)
+    set_hcg(hcg)
+    return hcg

@@ -1,0 +1,102 @@
+"""Process groups (reference: python/paddle/distributed/collective.py ``Group``,
+``new_group`` :194; NCCL ring creation ``CommContextManager`` :360).
+
+A Group is a subset of ranks (= devices under single-controller SPMD) with a
+1-D ``jax.sharding.Mesh`` over them.  Where the reference creates one NCCL
+communicator per group, we create one mesh axis per group — XLA emits the
+matching ICI/DCN collective when `shard_map`/`psum` names that axis.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from . import env
+
+_GROUP_COUNT = [0]
+_GROUP_MAP = {}
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    def __init__(self, ranks: List[int], gid: Optional[int] = None, name: Optional[str] = None):
+        if gid is None:
+            _GROUP_COUNT[0] += 1
+            gid = _GROUP_COUNT[0]
+        self.id = gid
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.name = name or f"_default_pg{gid}"
+        self.axis_name = f"pg{gid}"
+        self._mesh = None
+        _GROUP_MAP[gid] = self
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def rank(self) -> int:
+        """Controller's rank inside the group (0 when it drives the group)."""
+        r = env.get_rank()
+        return self.ranks.index(r) if r in self.ranks else 0
+
+    @property
+    def process_group(self):
+        return self
+
+    @property
+    def mesh(self) -> jax.sharding.Mesh:
+        if self._mesh is None:
+            devs = env._devices()
+            self._mesh = jax.sharding.Mesh(
+                np.array([devs[r] for r in self.ranks]), (self.axis_name,))
+        return self._mesh
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+def new_group(ranks: Optional[List[int]] = None, backend: Optional[str] = None,
+              timeout=None) -> Group:
+    """reference: python/paddle/distributed/collective.py:194."""
+    if ranks is None:
+        ranks = list(range(env.get_world_size()))
+    return Group(sorted(ranks))
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    if gid == 0:
+        return env._default_group()
+    return _GROUP_MAP.get(gid)
+
+
+def _resolve_group(group) -> Group:
+    if group is None:
+        return env._default_group()
+    return group
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _GROUP_MAP.clear()
+        env._STATE["initialized"] = False
+        env._STATE["default_group"] = None
+    else:
+        _GROUP_MAP.pop(group.id, None)

@@ -1,0 +1,285 @@
+"""Meta-parallel wrappers (reference:
+python/paddle/distributed/fleet/meta_parallel/ — tensor_parallel.py:28,
+segment_parallel.py:26, pp_layers.py:257, pipeline_parallel.py:820,
+hybrid_parallel_optimizer.py:266).
+
+Single-controller SPMD changes what these wrappers must *do*: parameter
+broadcast at init is unnecessary (one copy of truth), gradient sync happens
+inside XLA (psum from batch sharding), so the wrappers mainly (1) lay tensors
+out on the hybrid mesh and (2) implement the microbatch schedules.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer, LayerList
+
+
+class _WrapperBase(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+class ShardingParallel(_WrapperBase):
+    """reference meta_parallel/sharding_parallel.py — group-sharded params;
+    actual state sharding is applied by the sharded optimizers (ZeRO =
+    placements, SURVEY.md §7.1)."""
+
+
+class SegmentParallel(_WrapperBase):
+    """reference segment_parallel.py:26 — sequence split over the sep axis;
+    activations are sharded on the sequence dim by the model's sharding
+    constraints (see models.llama sequence sharding)."""
+
+
+class TensorParallel(_WrapperBase):
+    """reference tensor_parallel.py:28 — with GSPMD-sharded mpu layers the
+    wrapper only needs to exist for API parity; weights are already laid out
+    over the mp axis by the layers themselves."""
+
+
+class LayerDesc:
+    """reference pp_layers.py:56 — lazy layer constructor for stage building."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """reference pp_layers.py:76 — tied layers (e.g. embedding/lm-head).
+    Under one controller the same built Layer object is shared directly, which
+    makes weight tying exact (no broadcast/allreduce of tied grads needed)."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """reference pp_layers.py:257 — describes the model as a flat list of
+    LayerDescs segmented into pp stages.
+
+    TPU-native placement: each stage's parameters are placed on the matching
+    slice of the 'pp' mesh axis, so inter-stage tensors move over ICI when the
+    forward crosses a stage boundary.  The microbatch *schedule* lives in
+    PipelineParallel.
+    """
+
+    def __init__(self, layers: List, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, **kwargs):
+        super().__init__()
+        from .fleet.topology import get_hcg
+        self._hcg = get_hcg()
+        self._num_stages = num_stages or (
+            self._hcg.get_pipe_parallel_world_size() if self._hcg else 1)
+        self._loss_fn = loss_fn
+        self.descs = list(layers)
+        self._shared = {}
+        built = []
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = d.build_layer()
+                built.append((self._shared[d.layer_name], d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer):
+                built.append((d, None))
+            else:  # plain callable (e.g. lambda reshape)
+                built.append((d, None))
+        self.run_function = LayerList([l for l, _ in built if isinstance(l, Layer)])
+        self._pipeline = built
+        self._segment()
+        self._place_stages()
+
+    def _segment(self):
+        n = len(self._pipeline)
+        stages = self._num_stages
+        bounds = [int(round(i * n / stages)) for i in range(stages + 1)]
+        self._stage_of = np.zeros(n, dtype=int)
+        for s in range(stages):
+            self._stage_of[bounds[s]:bounds[s + 1]] = s
+        self.segment_parts = bounds
+
+    def _place_stages(self):
+        """Put each stage's params on its pp mesh slice (keeping other-axis
+        shardings such as mp intact is future work: stage placement currently
+        resets to replicated-within-stage)."""
+        if self._hcg is None or self._num_stages <= 1:
+            return
+        mesh = self._hcg.global_mesh
+        if "pp" not in mesh.axis_names:
+            return
+        dev_grid = mesh.devices
+        pp_axis = mesh.axis_names.index("pp")
+        for i, (layer, _) in enumerate(self._pipeline):
+            if not isinstance(layer, Layer):
+                continue
+            s = int(self._stage_of[i])
+            stage_devs = np.take(dev_grid, s, axis=pp_axis).ravel()
+            for p in layer.parameters():
+                arr = p._data
+                if not isinstance(arr, jax.core.Tracer):
+                    sharding = arr.sharding
+                    if isinstance(sharding, NamedSharding) and any(
+                            sharding.spec):
+                        continue  # keep mp/other sharding
+                    p._data = jax.device_put(arr, stage_devs[0])
+
+    def get_stage_from_index(self, index: int) -> int:
+        return int(self._stage_of[index])
+
+    def forward(self, x, **kwargs):
+        for layer, fwd in self._pipeline:
+            if fwd is not None:
+                x = fwd(layer, x)
+            elif isinstance(layer, Layer) or callable(layer):
+                x = layer(x)
+        return x
+
+
+class PipelineParallel(_WrapperBase):
+    """reference pipeline_parallel.py:820 train_batch / :575
+    forward_backward_pipeline.
+
+    Schedule: microbatched gradient accumulation.  Stage overlap (true 1F1B
+    wavefront) on TPU comes from running this under jit where XLA's
+    latency-hiding scheduler overlaps stage s of microbatch i with stage s+1
+    of microbatch i-1; the host loop only defines the dataflow.
+    """
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        cfg = (strategy.pipeline_configs if strategy is not None else {}) or {}
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self.micro_batch_size = cfg.get("micro_batch_size", None)
+        self.total_loss = None
+
+    def _split_micro(self, data):
+        acc = self.accumulate_steps
+        if isinstance(data, (tuple, list)):
+            xs, ys = data
+        else:
+            xs, ys = data, None
+        n = xs.shape[0]
+        if acc < 1:
+            raise ValueError(f"accumulate_steps must be >= 1, got {acc}")
+        if n % acc != 0:
+            raise ValueError(
+                f"batch size {n} must be divisible by accumulate_steps {acc}")
+        mb = n // acc
+        micros = []
+        for i in range(acc):
+            sl = slice(i * mb, (i + 1) * mb)
+            micros.append((xs[sl], ys[sl] if ys is not None else None))
+        return micros
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        from .. import amp as _amp  # noqa: F401
+        losses = []
+        for x, y in self._split_micro(data):
+            out = self._layers(x)
+            loss = self._layers._loss_fn(out, y) if getattr(
+                self._layers, "_loss_fn", None) is not None else out
+            if scaler is not None:
+                scaled = scaler.scale(loss * (1.0 / self.accumulate_steps))
+                scaled.backward()
+            else:
+                (loss * (1.0 / self.accumulate_steps)).backward()
+            losses.append(loss)
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        self.total_loss = total * (1.0 / self.accumulate_steps)
+        return self.total_loss
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        losses = []
+        for x, y in self._split_micro(data):
+            out = self._layers(x)
+            if compute_loss and getattr(self._layers, "_loss_fn", None) is not None:
+                out = self._layers._loss_fn(out, y)
+            losses.append(out)
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        return total * (1.0 / len(losses))
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """reference pipeline_parallel.py:1174 (VPP) — same dataflow under one
+    controller; virtual stages only change parameter placement granularity."""
+
+
+class HybridParallelOptimizer:
+    """reference hybrid_parallel_optimizer.py:266 — wraps the user optimizer.
+
+    Under single-controller SPMD, grad allreduce across dp/sharding groups is
+    performed by XLA (grads of replicated params are psummed automatically),
+    so the wrapper's remaining jobs are grad clipping across the hybrid groups
+    (global norm is already global here) and API parity.
+    """
+
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero=set_to_zero)
+
+    def minimize(self, loss, *args, **kwargs):
+        return self._inner_opt.minimize(loss, *args, **kwargs)
+
+
+class HybridParallelGradScaler:
+    """reference hybrid_parallel_gradscaler.py — delegate to amp.GradScaler
+    (found-inf allreduce is global by construction)."""
+
+    def __new__(cls, scaler, hcg=None):
+        return scaler

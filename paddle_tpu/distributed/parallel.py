@@ -1,0 +1,91 @@
+"""Data parallelism (reference: python/paddle/distributed/parallel.py:219
+``DataParallel``; gradient bucketing EagerReducer
+paddle/fluid/distributed/collective/reducer.h:88).
+
+TPU-native: the batch is ONE global array sharded over the 'dp' mesh axis;
+parameters are replicated.  The backward of (sharded batch) × (replicated
+params) makes XLA emit the gradient all-reduce — fused and overlapped by the
+latency-hiding scheduler, which is exactly what EagerReducer's bucketed
+allreduce-on-ready achieves by hand.  ``no_sync`` therefore has nothing to
+skip; it is kept for API parity (gradient accumulation is already local until
+params are updated).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..ops._prim import apply_op
+from . import env
+from .fleet.topology import get_hcg
+
+
+def _dp_sharding(ndim: int):
+    hcg = get_hcg()
+    if hcg is not None and hcg.get_data_parallel_world_size() > 1:
+        mesh = hcg.global_mesh
+        return NamedSharding(mesh, P(*(["dp"] + [None] * (ndim - 1))))
+    devs = env._devices()
+    if len(devs) > 1:
+        mesh = jax.sharding.Mesh(np.array(devs), ("dp",))
+        return NamedSharding(mesh, P(*(["dp"] + [None] * (ndim - 1))))
+    return None
+
+
+def _shard_batch(x):
+    if not isinstance(x, Tensor):
+        return x
+    sh = _dp_sharding(x.ndim)
+    if sh is None:
+        return x
+    if isinstance(x._data, jax.core.Tracer):
+        return apply_op("dp_shard",
+                        lambda v: jax.lax.with_sharding_constraint(v, sh), (x,))
+    out = Tensor(jax.device_put(x._data, sh), name=x.name)
+    out.stop_gradient = x.stop_gradient
+    return out
+
+
+class DataParallel(Layer):
+    """reference parallel.py:219."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+        # init-time param broadcast (reference: broadcast from rank 0) is a
+        # no-op: there is one copy of every param under single-controller SPMD.
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(_shard_batch(x) for x in inputs)
+        kwargs = {k: _shard_batch(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Gradient-sync-free context (API parity; see module docstring)."""
+        yield
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
