@@ -1,0 +1,90 @@
+"""Driver benchmark: flagship Llama train step, single chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": ...}
+
+vs_baseline = measured MFU / 0.45 (the BASELINE.json north-star MFU target;
+the reference repo publishes no numbers of its own — see BASELINE.md).
+MFU accounting per BASELINE.md: 6*N*T flops/token (remat flops reported
+separately, not credited).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+# peak bf16 FLOP/s by TPU generation (public spec sheets)
+_PEAK_BF16 = {
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v4": 275e12, "v6e": 918e12, "v6": 918e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_BF16.items():
+        if key in kind:
+            return val
+    return 197e12  # assume v5e-class if unknown
+
+
+def main():
+    import jax
+
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models.pretrain import ParallelConfig, PretrainStep
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16")
+        batch, seq, steps = 4, 2048, 10
+    else:  # CPU smoke mode
+        cfg = LlamaConfig.tiny(num_hidden_layers=2)
+        batch, seq, steps = 4, 64, 2
+
+    pc = ParallelConfig(remat=True)
+    ps = PretrainStep(cfg, pc)
+    state = ps.init_state(seed=0)
+
+    rng = np.random.default_rng(0)
+    ids, labels = ps.shard_batch(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    # warmup (compile)
+    state, loss = ps.train_step(state, ids, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = ps.train_step(state, ids, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tok_per_sec = tokens / dt
+    flops_per_token = 6.0 * cfg.num_params()  # remat flops not credited
+    mfu = tok_per_sec * flops_per_token / _peak_flops(jax.devices()[0])
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "mfu": round(mfu, 4),
+        "model_params": cfg.num_params(),
+        "loss": round(float(loss), 4),
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from . import api  # noqa: F401
+from .api import load_state_dict, save_state_dict, wait_async_save  # noqa: F401
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401

@@ -1,0 +1,44 @@
+"""Checkpoint metadata (reference:
+python/paddle/distributed/checkpoint/metadata.py — LocalTensorMetadata
+{global_offset, local_shape} + Metadata{state_dict_metadata, storage_metadata}).
+
+Kept for API parity and for tools that inspect layouts; the actual storage
+engine is orbax/tensorstore (see api.py), which records equivalent
+chunk-offset metadata inside the OCDBT store.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass
+class LocalTensorMetadata:
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str = "float32"
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclass
+class Metadata:
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(default_factory=dict)
+    storage_metadata: Dict[LocalTensorIndex, str] = field(default_factory=dict)
+    flat_mapping: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+
+
+def metadata_from_sharded(tensor_name: str, arr) -> List[LocalTensorMetadata]:
+    """Describe a (possibly sharded) jax array the way the reference's
+    save_state_dict metadata file does: one entry per device shard."""
+    out = []
+    for s in arr.addressable_shards:
+        offset = tuple(idx.start or 0 for idx in s.index)
+        out.append(LocalTensorMetadata(offset, tuple(s.data.shape),
+                                       str(arr.dtype)))
+    return out

@@ -84,21 +84,27 @@ _REDUCERS = {
 }
 
 
+
+def _finish(tensor, out):
+    """Uniform result contract: Tensor input -> in-place update + _Task;
+    raw-array input -> the result array (same type at any world size)."""
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return _Task(out)
+    return out
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     g = _resolve_group(group)
     x = _as_array(tensor)
     if g.nranks == 1:
-        return _Task(x)
+        return _finish(tensor, x)
     red = _REDUCERS[op]
     if _is_traced(x):
         out = red(x, g.axis_name)
     else:
         _check_stack(x, g, "all_reduce")
         out = _stacked(lambda v: red(v, g.axis_name), g, x)
-    if isinstance(tensor, Tensor):
-        tensor._data = out
-        return _Task(out)
-    return out
+    return _finish(tensor, out)
 
 
 def all_gather(tensor_list: Optional[List] = None, tensor=None, group=None, sync_op=True):
@@ -133,17 +139,14 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     g = _resolve_group(group)
     x = _as_array(tensor)
     if g.nranks == 1:
-        return _Task(x)
+        return _finish(tensor, x)
     si = _group_index(g, src, 'src')
     if _is_traced(x):
         out = lax.all_gather(x, g.axis_name)[si]
     else:
         _check_stack(x, g, "broadcast")
         out = _stacked(lambda v: lax.all_gather(v[0], g.axis_name)[si][None], g, x)
-    if isinstance(tensor, Tensor):
-        tensor._data = out
-        return _Task(out)
-    return out
+    return _finish(tensor, out)
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -151,7 +154,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     g = _resolve_group(group)
     x = _as_array(tensor)
     if g.nranks == 1:
-        return _Task(x)
+        return _finish(tensor, x)
     di = _group_index(g, dst, 'dst')
     red = _REDUCERS[op]
     if _is_traced(x):
@@ -167,10 +170,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
             return jnp.where(idx == di, full, v)
 
         out = _stacked(f, g, x)
-    if isinstance(tensor, Tensor):
-        tensor._data = out
-        return _Task(out)
-    return out
+    return _finish(tensor, out)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
@@ -182,16 +182,9 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     else:
         stacked = _as_array(tensor)
     if g.nranks == 1:
-        out = stacked[0] if tensor_list is not None else stacked
-        if isinstance(tensor, Tensor):
-            tensor._data = out
-        return _Task(out)
+        return _finish(tensor, stacked[0] if tensor_list is not None else stacked)
     # rank i receives chunk i from src: pure slice in stacked form
-    out = stacked
-    if isinstance(tensor, Tensor):
-        tensor._data = out
-        return _Task(out)
-    return out
+    return _finish(tensor, stacked)
 
 
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -203,10 +196,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_o
     else:
         x = _as_array(tensor)
     if g.nranks == 1:
-        out = x[0] if tensor_list is not None else x
-        if isinstance(tensor, Tensor):
-            tensor._data = out
-        return _Task(out)
+        return _finish(tensor, x[0] if tensor_list is not None else x)
     if _is_traced(x):
         out = lax.psum_scatter(x, g.axis_name, scatter_dimension=0, tiled=False)
     else:
@@ -217,10 +207,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_o
                                     tiled=False)[None]
 
         out = _stacked(f, g, x)
-    if isinstance(tensor, Tensor):
-        tensor._data = out
-        return _Task(out)
-    return out
+    return _finish(tensor, out)
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):

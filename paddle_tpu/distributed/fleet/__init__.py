@@ -100,7 +100,10 @@ class _Fleet:
             model = ShardingParallel(model, hcg, strategy=self._strategy)
         if hcg.get_model_parallel_world_size() > 1:
             model = TensorParallel(model, hcg, strategy=self._strategy)
-        elif hcg.get_data_parallel_world_size() > 1:
+        if hcg.get_data_parallel_world_size() > 1:
+            # unlike the reference (dp implicit in per-process feeding), batch
+            # sharding over the 'dp' mesh axis happens in DataParallel.forward,
+            # so it must wrap even in hybrid dp×mp/dp×sharding configs
             model = DataParallel(model, strategy=self._strategy)
         return model
 

@@ -183,11 +183,16 @@ class PipelineParallel(_WrapperBase):
         self.total_loss = None
 
     def _split_micro(self, data):
+        from .parallel import _shard_batch
         acc = self.accumulate_steps
         if isinstance(data, (tuple, list)):
             xs, ys = data
         else:
             xs, ys = data, None
+        # hybrid pp×dp: batch sharding over the 'dp' mesh axis happens here
+        # (DataParallel never wraps a PipelineParallel model)
+        xs = _shard_batch(xs)
+        ys = _shard_batch(ys) if ys is not None else None
         n = xs.shape[0]
         if acc < 1:
             raise ValueError(f"accumulate_steps must be >= 1, got {acc}")
@@ -233,16 +238,21 @@ class PipelineParallel(_WrapperBase):
         return loss
 
     def eval_batch(self, data, compute_loss=True):
-        losses = []
+        outs = []
+        loss_applied = compute_loss and \
+            getattr(self._layers, "_loss_fn", None) is not None
         for x, y in self._split_micro(data):
             out = self._layers(x)
-            if compute_loss and getattr(self._layers, "_loss_fn", None) is not None:
+            if loss_applied:
                 out = self._layers._loss_fn(out, y)
-            losses.append(out)
-        total = losses[0]
-        for l in losses[1:]:
-            total = total + l
-        return total * (1.0 / len(losses))
+            outs.append(out)
+        if loss_applied:
+            total = outs[0]
+            for l in outs[1:]:
+                total = total + l
+            return total * (1.0 / len(outs))
+        from ..ops.manipulation import concat
+        return concat(outs, axis=0)
 
 
 class PipelineParallelWithInterleave(PipelineParallel):

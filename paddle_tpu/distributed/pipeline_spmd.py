@@ -1,0 +1,96 @@
+"""SPMD pipeline parallelism — GPipe schedule over a mesh axis.
+
+Reference mechanism: FleetExecutor interceptors / PipelineParallel 1F1B with
+NCCL p2p (pipeline_parallel.py:575, p2p_communication.py:573).  TPU-native
+redesign: the pipeline IS a collective program — stage parameters are stacked
+on a leading dim sharded over the 'pp' mesh axis, and one `shard_map`ped
+`lax.scan` advances the wavefront with `lax.ppermute` stage-to-stage
+transfers over ICI.  Every stage computes every tick (SPMD), so fill/drain
+bubbles are idle-compute, exactly as in GPipe; reverse-mode AD through
+scan+ppermute yields the backward pipeline automatically (the B/W phases the
+reference schedules by hand).
+
+Other mesh axes (dp/mp/...) stay *auto*: GSPMD keeps partitioning each
+stage's internals (Megatron TP etc.) inside the manual pp axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply(mesh, axis: str, stage_fn: Callable, stage_params: Any,
+                   microbatches, *consts):
+    """Run a GPipe pipeline over `axis`.
+
+    Args:
+      mesh: the hybrid `jax.sharding.Mesh` (must contain `axis`).
+      axis: pipeline mesh-axis name (e.g. 'pp'), size S.
+      stage_fn: `(params_slice, x, *consts) -> y` — one stage's compute;
+        `params_slice` leaves have the stacked leading dims removed; y must
+        have x's shape/dtype.
+      stage_params: pytree with leaves stacked `[S, ...]` (sharded P(axis)).
+      microbatches: `[M, mb, ...]` activations fed to stage 0.
+      consts: broadcast arrays (e.g. rope tables) replicated to every stage.
+
+    Returns `[M, mb, ...]` outputs of the final stage (replicated over pp).
+    """
+    S = mesh.shape[axis]
+    if S == 1:
+        params = jax.tree_util.tree_map(lambda l: l[0], stage_params)
+
+        def body(carry, mb):
+            return carry, stage_fn(params, mb, *consts)
+
+        _, out = lax.scan(body, 0, microbatches)
+        return out
+
+    M = microbatches.shape[0]
+    auto = frozenset(n for n in mesh.axis_names if n != axis)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def per_stage(params_local, micro, *cs):
+        # params_local leaves: [1, ...] — this stage's block stack
+        params = jax.tree_util.tree_map(lambda l: l[0], params_local)
+        s = lax.axis_index(axis)
+        # carries become device-varying after the first ppermute; mark them so
+        state = lax.pcast(jnp.zeros_like(micro[0]), (axis,), to="varying")
+        out_buf = lax.pcast(jnp.zeros_like(micro), (axis,), to="varying")
+
+        def tick(carry, t):
+            state, out_buf = carry
+            x0 = lax.dynamic_index_in_dim(micro, jnp.clip(t, 0, M - 1), 0,
+                                          keepdims=False)
+            x = jnp.where(s == 0, x0, state)
+            y = stage_fn(params, x, *cs)
+            out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+            valid = jnp.logical_and(t - (S - 1) >= 0, s == S - 1)
+            out_buf = jnp.where(
+                valid,
+                lax.dynamic_update_index_in_dim(out_buf, y, out_idx, 0),
+                out_buf)
+            state = lax.ppermute(y, axis, perm)
+            return (state, out_buf), None
+
+        (state, out_buf), _ = lax.scan(tick, (state, out_buf),
+                                       jnp.arange(M + S - 1))
+        # replicate the last stage's buffer so downstream (loss) code sees a
+        # full array on every pp rank (an S-hop broadcast over ICI)
+        mask = (s == S - 1).astype(out_buf.dtype)
+        return lax.psum(out_buf * mask, axis)
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+                P()) + tuple(P() for _ in consts)
+    return jax.shard_map(per_stage, mesh=mesh, in_specs=in_specs,
+                         out_specs=P(), axis_names={axis},
+                         )(stage_params, microbatches, *consts)
+
+
+def num_pipeline_ticks(num_micro: int, num_stages: int) -> int:
+    return num_micro + num_stages - 1

@@ -1,0 +1,8 @@
+"""paddle.incubate surface (reference: python/paddle/incubate/)."""
+
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
+
+
+def autograd_enabled():
+    return True

@@ -1,0 +1,108 @@
+"""MoE gates (reference: python/paddle/incubate/distributed/models/moe/gate/
+— naive_gate.py, gshard_gate.py, switch_gate.py).
+
+Each gate maps tokens [N, d] to (dispatch weights, expert assignment).  All
+shapes are static (capacity-based) so the whole MoE block compiles to one
+XLA program — the TPU replacement for the reference's dynamic
+number_count/prune_gate_by_capacity CUDA ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....nn.layer import Layer
+from .....ops._prim import apply_op
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 top_k: int = 2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert            # experts per rank (reference)
+        self.world_size = world_size
+        self.tot_expert = num_expert * world_size
+        self.top_k = top_k
+        self.weight = self.create_parameter([d_model, self.tot_expert])
+        self.loss = None
+
+    def scores(self, x):
+        from .....nn import functional as F
+        return F.linear(x, self.weight, None)
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax gate, no auxiliary loss (naive_gate.py)."""
+
+    def forward(self, x):
+        logits = self.scores(x)
+
+        def prim(l):
+            probs = jax.nn.softmax(l.astype(jnp.float32), axis=-1)
+            val, idx = jax.lax.top_k(probs, self.top_k)
+            return val / jnp.sum(val, -1, keepdims=True), idx
+
+        val, idx = apply_op("naive_gate_topk", prim, (logits,))
+        self.loss = None
+        return val, idx
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with GShard load-balancing aux loss (gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        super().__init__(d_model, num_expert, world_size, top_k)
+        self.capacity = capacity
+
+    def forward(self, x):
+        logits = self.scores(x)
+        E = self.tot_expert
+
+        def prim(l):
+            probs = jax.nn.softmax(l.astype(jnp.float32), axis=-1)
+            val, idx = jax.lax.top_k(probs, self.top_k)
+            # GShard aux loss: E * mean(fraction) . mean(prob)
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(jax.nn.one_hot(idx[:, 0], E, dtype=jnp.float32), axis=0)
+            aux = jnp.sum(me * ce) * E
+            return val / jnp.sum(val, -1, keepdims=True), idx, aux
+
+        val, idx, aux = apply_op("gshard_gate", prim, (logits,))
+        self.loss = aux
+        return val, idx
+
+
+class SwitchGate(BaseGate):
+    """Top-1 Switch-Transformer gate with load-balance loss (switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, top_k=1)
+        self.switch_eps = switch_eps
+
+    def forward(self, x):
+        logits = self.scores(x)
+        E = self.tot_expert
+
+        def prim(l, key):
+            l = l.astype(jnp.float32)
+            if self.training:
+                noise = jax.random.uniform(key, l.shape, jnp.float32,
+                                           1.0 - self.switch_eps,
+                                           1.0 + self.switch_eps)
+                l = l * noise
+            probs = jax.nn.softmax(l, axis=-1)
+            val, idx = jax.lax.top_k(probs, 1)
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(jax.nn.one_hot(idx[:, 0], E, dtype=jnp.float32), axis=0)
+            aux = jnp.sum(me * ce) * E
+            return val, idx, aux
+
+        from .....core.random import next_key
+        key = next_key()
+        val, idx, aux = apply_op("switch_gate", lambda l: prim(l, key), (logits,))
+        self.loss = aux
+        return val, idx

@@ -1,0 +1,145 @@
+"""Fused-op functional API (reference: python/paddle/incubate/nn/functional/ —
+fused_rms_norm, fused_rotary_position_embedding, swiglu, fused_moe,
+masked_multihead_attention...).
+
+On TPU "fused" means: written so XLA/Pallas fuses it (SURVEY.md §7.1 —
+the CINN slot).  These wrappers share math with models.llama and
+kernels.flash_attention so every entry point hits the same kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...kernels.flash_attention import flash_attention  # noqa: F401
+from ...ops._prim import apply_op
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    """reference: fused_rms_norm.py (kernel fused_rms_norm GPU)."""
+    from ...kernels.rms_norm import rms_norm_fp32
+
+    ndim = x.ndim
+    axes = tuple(range(begin_norm_axis % ndim, ndim)) \
+        if begin_norm_axis != -1 else (-1,)
+
+    def prim(v, w, *rest):
+        return rms_norm_fp32(v, w, epsilon, bias=rest[0] if rest else None,
+                             axes=axes)
+
+    args = (x, norm_weight) + ((norm_bias,) if norm_bias is not None else ())
+    return apply_op("fused_rms_norm", prim, args)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, **kw):
+    from ...nn import functional as F
+    return F.layer_norm(x, x.shape[-1:], weight=norm_weight, bias=norm_bias,
+                        epsilon=epsilon)
+
+
+def swiglu(x, y=None):
+    """reference: python/paddle/incubate/nn/functional/swiglu.py."""
+    if y is None:
+        def prim(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a) * b
+        return apply_op("swiglu", prim, (x,))
+    return apply_op("swiglu", lambda a, b: jax.nn.silu(a) * b, (x, y))
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """reference: fused_rotary_position_embedding.py.
+
+    q/k/v: [b, s, h, d]; sin/cos: [1, s, 1, d], [s, d] or [s, d/2] tables.
+    ``use_neox_rotary_style=True`` (reference default) rotates half-split
+    (rotate-half); False rotates interleaved pairs (GPT-J style).
+    ``position_ids`` [b, s] indexes the tables per batch row.
+    """
+    def table(t, d, half_slice):
+        arr = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+        if arr.ndim == 4:
+            arr = arr[0, :, 0, :]
+        if arr.shape[-1] == d:          # full-dim table -> per-frequency half
+            arr = arr[..., :d // 2] if half_slice == "front" else arr[..., ::2]
+        return arr
+
+    d = q.shape[-1]
+    seq = q.shape[1]
+    if sin is None or cos is None:
+        from ...models.llama import _rope_cos_sin
+        c_t, s_t = _rope_cos_sin(seq, d, 10000.0, jnp.float32)
+    else:
+        style = "front" if use_neox_rotary_style else "interleaved"
+        c_t = table(cos, d, style)
+        s_t = table(sin, d, style)
+
+    pos = None
+    if position_ids is not None:
+        pos = position_ids._data if isinstance(position_ids, Tensor) \
+            else jnp.asarray(position_ids)
+
+    def rotate(a):
+        c, s = c_t, s_t
+        if pos is not None:
+            c, s = c[pos], s[pos]       # [b, s, d/2]
+            c, s = c[:, :, None, :], s[:, :, None, :]
+        else:
+            c, s = c[None, :, None, :], s[None, :, None, :]
+        if use_neox_rotary_style:
+            x1, x2 = jnp.split(a, 2, axis=-1)
+            out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+            return out.astype(a.dtype)
+        x1 = a[..., 0::2]
+        x2 = a[..., 1::2]
+        o1 = x1 * c - x2 * s
+        o2 = x2 * c + x1 * s
+        return jnp.stack([o1, o2], axis=-1).reshape(a.shape).astype(a.dtype)
+
+    outs = []
+    for t in (q, k, v):
+        outs.append(None if t is None else apply_op("fused_rope", rotate, (t,)))
+    return tuple(outs)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    def prim(v, *rest):
+        if rest:
+            v = v + rest[0]
+        if act_method == "gelu":
+            return jax.nn.gelu(v)
+        if act_method in ("geglu", "swiglu"):
+            a, b = jnp.split(v, 2, axis=-1)
+            gate = jax.nn.gelu(a) if act_method == "geglu" else jax.nn.silu(a)
+            return gate * b
+        return jax.nn.relu(v)
+
+    args = (x,) + ((bias,) if bias is not None else ())
+    return apply_op("fused_bias_act", prim, args)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    from ...nn import functional as F
+    w = weight.T if transpose_weight else weight
+    return F.linear(x, w, bias)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    from ...nn import functional as F
+    out = F.linear(x, y.T if trans_y else y, bias)
+    return fused_bias_act(out, act_method=activation)
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train"):
+    from ...nn import functional as F
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def masked_multihead_attention(x, cache_kv=None, **kw):
+    raise NotImplementedError(
+        "decode-time fused attention lands with the inference stack; "
+        "use kernels.flash_attention for training")

@@ -25,6 +25,7 @@ from ..core.tensor import Tensor
 from ..ops._prim import apply_op
 
 NEG_INF = -1e30
+_I0 = np.int32(0)
 
 
 def _reference_attention(q, k, v, causal):
@@ -43,11 +44,16 @@ def _reference_attention(q, k, v, causal):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
-def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_kv, kv_len, causal, scale, block_q):
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_kv, kv_len, causal,
+                   scale, block_q, q_len):
     """One (batch*head, q_block) program: stream KV blocks with online softmax."""
     from jax.experimental import pallas as pl
 
-    q = q_ref[:].astype(jnp.float32) * scale  # [block_q, d]
+    # NOTE: scalar literals inside the kernel must be wrapped to f32:
+    # in the mosaic lowering (unlike plain jax weak typing) they
+    # materialise as f64 under x64 mode and tpu.truncf f64->f32 has
+    # no legalization
+    q = q_ref[:].astype(jnp.float32) * jnp.float32(scale)  # [block_q, d]
     q_idx = pl.program_id(1)
 
     m = jnp.full((q.shape[0], 1), NEG_INF, jnp.float32)
@@ -55,22 +61,20 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_kv, kv_len, causal, scal
     acc = jnp.zeros((q.shape[0], v_ref.shape[-1]), jnp.float32)
 
     num_kv = kv_len // block_kv
-    if causal:
-        # only blocks at or before the diagonal contribute
-        num_kv_needed = (q_idx * block_q + block_q + block_kv - 1) // block_kv
-    else:
-        num_kv_needed = num_kv
+    # query i attends keys j <= i + (kv_len - q_len), matching the reference
+    # tril(k=sk-sq) semantics (decode: sq < sk attends the whole prefix)
+    diag_off = kv_len - q_len
 
-    def body(i, carry):
+    def compute(i, carry):
         m, l, acc = carry
-        k = pl.load(k_ref, (pl.dslice(i * block_kv, block_kv), slice(None))).astype(jnp.float32)
-        v = pl.load(v_ref, (pl.dslice(i * block_kv, block_kv), slice(None))).astype(jnp.float32)
+        k = k_ref[pl.ds(i * block_kv, block_kv), :].astype(jnp.float32)
+        v = v_ref[pl.ds(i * block_kv, block_kv), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [bq, bkv]
         if causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = i * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = jnp.where(q_pos + diag_off >= k_pos, s, jnp.float32(NEG_INF))
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -79,8 +83,21 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_kv, kv_len, causal, scal
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, num_kv_needed, body, (m, l, acc))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if causal:
+        # static trip count (mosaic cannot lower a dynamic-bound loop), but
+        # skip fully-above-diagonal KV blocks via cond so causal costs ~half
+        def body(i, carry):
+            needed = i * block_kv <= q_idx * block_q + block_q - 1 + diag_off
+            return jax.lax.cond(needed, lambda c: compute(i, c),
+                                lambda c: c, carry)
+    else:
+        body = compute
+
+    # int32 bounds: x64 mode would promote bare ints to int64, which the
+    # mosaic lowering cannot convert
+    m, l, acc = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_kv), body,
+                                  (m, l, acc))
+    o_ref[:] = (acc / jnp.maximum(l, jnp.float32(1e-30))).astype(o_ref.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -111,16 +128,19 @@ def _fa_pallas_forward(q, k, v, causal):
     vf = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
 
     kernel = functools.partial(_fa_fwd_kernel, block_kv=block_kv, kv_len=sk,
-                               causal=causal, scale=scale, block_q=block_q)
+                               causal=causal, scale=scale, block_q=block_q,
+                               q_len=sq)
     out = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q),
+        # index maps use int32 literals: x64 mode would make bare `0` an
+        # int64, which mosaic refuses to return from the index-map func
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, _I0)),
+            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, _I0, _I0)),
+            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, _I0, _I0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, _I0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
     )(qf, kf, vf)
     return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)

@@ -1,0 +1,13 @@
+"""Model zoo (reference: the PaddleNLP/vision model families built on the
+framework; in-tree analogs python/paddle/vision/models).
+
+Flagship: Llama-2 decoder family (the BASELINE.md north-star workload),
+built TPU-first — bf16 compute, flash-attention Pallas kernel, GSPMD
+sharding plan over the hybrid mesh (dp/mp/pp/sep axes).
+"""
+
+from . import gpt, llama  # noqa: F401
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, llama_shard_plan,
+)
+from .gpt import GPTConfig, GPTForCausalLM  # noqa: F401

@@ -1,0 +1,135 @@
+"""GPT-2 decoder family (BASELINE.md config 2 workload).
+
+Reference surface: PaddleNLP GPT built on the framework (fleet mpu layers for
+TP; fused attention kernels).  Same TPU-first structure as models.llama:
+plain jax math + flash attention; sharding applied as a plan.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..kernels.flash_attention import flash_attention
+from ..nn import functional as F
+from ..nn.layer import Layer, LayerList
+from ..ops._prim import apply_op
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=128, hidden_size=48, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=96,
+                    max_position_embeddings=64, dtype="float32")
+        base.update(kw)
+        return GPTConfig(**base)
+
+    @staticmethod
+    def gpt2_base(**kw):
+        return GPTConfig(**kw)
+
+    @staticmethod
+    def gpt2_medium(**kw):
+        return GPTConfig(**{**dict(hidden_size=1024, num_hidden_layers=24,
+                                   num_attention_heads=16, intermediate_size=4096), **kw})
+
+
+def _normal_init(std):
+    def init(shape, dtype):
+        from ..core.random import next_key
+        return (jax.random.normal(next_key(), shape, jnp.float32) * std).astype(dtype)
+    return init
+
+
+class _Linear(Layer):
+    def __init__(self, in_f, out_f, dtype, std=0.02):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter([in_f, out_f],
+                                            default_initializer=_normal_init(std))
+        self.bias = self.create_parameter([out_f], is_bias=True)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class GPTBlock(Layer):
+    def __init__(self, c: GPTConfig):
+        super().__init__(dtype=c.dtype)
+        from ..nn import LayerNorm
+        self.ln_1 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
+        self.ln_2 = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
+        self.qkv = _Linear(c.hidden_size, 3 * c.hidden_size, c.dtype)
+        self.proj = _Linear(c.hidden_size, c.hidden_size, c.dtype,
+                            std=0.02 / math.sqrt(2 * c.num_hidden_layers))
+        self.fc_in = _Linear(c.hidden_size, c.intermediate_size, c.dtype)
+        self.fc_out = _Linear(c.intermediate_size, c.hidden_size, c.dtype,
+                              std=0.02 / math.sqrt(2 * c.num_hidden_layers))
+        self._c = c
+
+    def forward(self, x):
+        c = self._c
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv(self.ln_1(x)).reshape([b, s, 3, c.num_attention_heads, c.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        att = flash_attention(q, k, v, causal=True).reshape([b, s, c.hidden_size])
+        x = x + self.proj(att)
+        h = self.fc_in(self.ln_2(x))
+        h = apply_op("gelu_tanh", lambda a: jax.nn.gelu(a, approximate=True), (h,))
+        return x + self.fc_out(h)
+
+
+class GPTModel(Layer):
+    def __init__(self, c: GPTConfig):
+        super().__init__(dtype=c.dtype)
+        self.config = c
+        self.wte = self.create_parameter([c.vocab_size, c.hidden_size],
+                                         default_initializer=_normal_init(0.02))
+        self.wpe = self.create_parameter([c.max_position_embeddings, c.hidden_size],
+                                         default_initializer=_normal_init(0.01))
+        self.h = LayerList([GPTBlock(c) for _ in range(c.num_hidden_layers)])
+        from ..nn import LayerNorm
+        self.ln_f = LayerNorm(c.hidden_size, epsilon=c.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        x = F.embedding(input_ids, self.wte) + self.wpe[:s]
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    """Weight-tied LM head (GPT-2 convention)."""
+
+    def __init__(self, c: GPTConfig):
+        super().__init__(dtype=c.dtype)
+        self.config = c
+        self.gpt = GPTModel(c)
+
+    def forward(self, input_ids, labels=None):
+        h = self.gpt(input_ids)
+        logits = F.linear(h, self.gpt.wte.T, None)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.astype("float32").reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]))
+            return logits, loss
+        return logits

@@ -1,0 +1,368 @@
+"""Llama-2/3 decoder family — the flagship model (BASELINE.md configs 2-3).
+
+Reference behavior surface: PaddleNLP's LlamaForCausalLM built on the
+framework's TP layers (python/paddle/distributed/fleet/layers/mpu/
+mp_layers.py) and fused ops (python/paddle/incubate/nn/functional:
+fused_rotary_position_embedding, swiglu, fused_rms_norm; flash attention
+paddle/phi/kernels/gpu/flash_attn_kernel.cu:587).
+
+TPU-first design decisions:
+- bf16 params/compute by default (MXU native), fp32 RMSNorm accumulation;
+- attention via the Pallas flash-attention kernel ([b, s, h, d] layout);
+- GQA by grouped KV heads (repeated at attention time, XLA keeps it fused);
+- sharding is a *plan*, not wired into layers: `llama_shard_plan` lays
+  weights/activations over a hybrid mesh (mp = Megatron TP, dp = batch,
+  sep = sequence) and GSPMD emits the Megatron collective schedule —
+  the model code itself stays single-device jax.
+- `jax.checkpoint` rematerialisation per decoder layer (the reference's
+  recompute pass) is applied by the trainer via `recompute=True` configs.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..kernels.flash_attention import flash_attention
+from ..nn import functional as F
+from ..nn.layer import Layer, LayerList
+from ..ops._prim import apply_op
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    # parallel knobs (consumed by llama_shard_plan / trainer)
+    tensor_parallel: bool = False
+    sequence_parallel: bool = False
+    recompute: bool = False
+
+    def __post_init__(self):
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=128,
+                    dtype="float32")
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**{**dict(hidden_size=4096, intermediate_size=11008,
+                                     num_hidden_layers=32, num_attention_heads=32), **kw})
+
+    @staticmethod
+    def llama2_13b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**{**dict(hidden_size=5120, intermediate_size=13824,
+                                     num_hidden_layers=40, num_attention_heads=40), **kw})
+
+    def num_params(self) -> int:
+        h, i, v, L = (self.hidden_size, self.intermediate_size,
+                      self.vocab_size, self.num_hidden_layers)
+        kvh = self.num_key_value_heads * self.head_dim
+        per_layer = h * h + 2 * h * kvh + h * h + 3 * h * i + 2 * h
+        emb = v * h * (1 if self.tie_word_embeddings else 2)
+        return L * per_layer + emb + h
+
+
+def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype):
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    t = np.arange(seq_len, dtype=np.float32)
+    freqs = np.outer(t, inv_freq)                      # [s, d/2]
+    return (jnp.asarray(np.cos(freqs), dtype=dtype),
+            jnp.asarray(np.sin(freqs), dtype=dtype))
+
+
+def apply_rotary_pos_emb(x, cos, sin):
+    """Rotate pairs (x[..., ::2], x[..., 1::2]) — fused by XLA; the slot of
+    the reference's fused_rotary_position_embedding.  x: [b, s, h, d]."""
+    # cos/sin: [s, d/2] -> broadcast over batch and heads
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    o1 = x1 * c - x2 * s
+    o2 = x2 * c + x1 * s
+    # interleave back; rotate in fp32 (cos/sin tables), return input dtype
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def swiglu(gate, up):
+    """reference: python/paddle/incubate/nn/functional/swiglu.py."""
+    return jax.nn.silu(gate) * up
+
+
+class LlamaRMSNorm(Layer):
+    """fp32-accumulating RMSNorm (fused_rms_norm slot)."""
+
+    def __init__(self, hidden_size: int, eps: float, dtype):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [hidden_size], default_initializer=lambda shape, dt: jnp.ones(shape, dt))
+        self.eps = eps
+
+    def forward(self, x):
+        from ..kernels.rms_norm import rms_norm_fp32
+        return apply_op("llama_rms_norm",
+                        lambda v, w: rms_norm_fp32(v, w, self.eps),
+                        (x, self.weight))
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        c = config
+        self.config = c
+        hd = c.head_dim
+        init = _scaled_init(c.hidden_size)
+        self.q_proj = _ParamLinear(c.hidden_size, c.num_attention_heads * hd, c.dtype, init)
+        self.k_proj = _ParamLinear(c.hidden_size, c.num_key_value_heads * hd, c.dtype, init)
+        self.v_proj = _ParamLinear(c.hidden_size, c.num_key_value_heads * hd, c.dtype, init)
+        self.o_proj = _ParamLinear(c.num_attention_heads * hd, c.hidden_size, c.dtype, init)
+
+    def forward(self, hidden, cos, sin):
+        c = self.config
+        # cos/sin are rope tables consumed inside raw-array prims
+        cos = cos._data if isinstance(cos, Tensor) else cos
+        sin = sin._data if isinstance(sin, Tensor) else sin
+        b, s = hidden.shape[0], hidden.shape[1]
+        q = self.q_proj(hidden).reshape([b, s, c.num_attention_heads, c.head_dim])
+        k = self.k_proj(hidden).reshape([b, s, c.num_key_value_heads, c.head_dim])
+        v = self.v_proj(hidden).reshape([b, s, c.num_key_value_heads, c.head_dim])
+
+        def rope_prim(qa, ka):
+            return (apply_rotary_pos_emb(qa, cos, sin),
+                    apply_rotary_pos_emb(ka, cos, sin))
+
+        q, k = apply_op("fused_rope", rope_prim, (q, k))
+        if c.num_key_value_heads != c.num_attention_heads:
+            rep = c.num_attention_heads // c.num_key_value_heads
+
+            def repeat_prim(ka, va):
+                return (jnp.repeat(ka, rep, axis=2), jnp.repeat(va, rep, axis=2))
+
+            k, v = apply_op("repeat_kv", repeat_prim, (k, v))
+        out = flash_attention(q, k, v, causal=True)
+        out = out.reshape([b, s, c.num_attention_heads * c.head_dim])
+        return self.o_proj(out)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        c = config
+        init = _scaled_init(c.hidden_size)
+        self.gate_proj = _ParamLinear(c.hidden_size, c.intermediate_size, c.dtype, init)
+        self.up_proj = _ParamLinear(c.hidden_size, c.intermediate_size, c.dtype, init)
+        self.down_proj = _ParamLinear(c.intermediate_size, c.hidden_size, c.dtype,
+                                      _scaled_init(c.intermediate_size))
+
+    def forward(self, x):
+        gate = self.gate_proj(x)
+        up = self.up_proj(x)
+        act = apply_op("swiglu", lambda g, u: swiglu(g, u), (gate, up))
+        return self.down_proj(act)
+
+
+class _ParamLinear(Layer):
+    """Bias-free linear with explicit init (Llama uses no biases)."""
+
+    def __init__(self, in_f, out_f, dtype, init):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter([in_f, out_f], default_initializer=init)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, None)
+
+
+def _scaled_init(fan_in):
+    std = 1.0 / math.sqrt(fan_in)
+
+    def init(shape, dtype):
+        from ..core.random import next_key
+        return (jax.random.normal(next_key(), shape, jnp.float32) * std).astype(dtype)
+
+    return init
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps,
+                                            config.dtype)
+        self.post_attention_layernorm = LlamaRMSNorm(config.hidden_size,
+                                                     config.rms_norm_eps, config.dtype)
+        self._config = config
+
+    def forward(self, hidden, cos, sin):
+        h = hidden + self.self_attn(self.input_layernorm(hidden), cos, sin)
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.embed_tokens = _Embedding(config.vocab_size, config.hidden_size,
+                                       config.dtype)
+        self.layers = LayerList([LlamaDecoderLayer(config)
+                                 for _ in range(config.num_hidden_layers)])
+        self.norm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps, config.dtype)
+
+    def forward(self, input_ids):
+        c = self.config
+        seq = input_ids.shape[1]
+        cos, sin = _rope_cos_sin(seq, c.head_dim, c.rope_theta,
+                                 jnp.float32)
+        h = self.embed_tokens(input_ids)
+        h = _seq_constrain(h, c)
+        for layer in self.layers:
+            if c.recompute:
+                h = _remat_layer(layer, h, cos, sin)
+            else:
+                h = layer(h, cos, sin)
+        return self.norm(h)
+
+
+class _Embedding(Layer):
+    def __init__(self, vocab, hidden, dtype):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [vocab, hidden], default_initializer=_scaled_init(hidden))
+
+    def forward(self, ids):
+        return F.embedding(ids, self.weight)
+
+
+def _remat_layer(layer, h, cos, sin):
+    """jax.checkpoint over one decoder layer (reference: recompute pass —
+    python/paddle/distributed/passes/auto_parallel_recompute.py)."""
+    params = [p for p in layer.parameters()]
+
+    def pure(h_arr, *p_arrs):
+        saved = [p._data for p in params]
+        try:
+            for p, a in zip(params, p_arrs):
+                p._data = a
+            out = layer(Tensor(h_arr), cos, sin)
+            return out._data if isinstance(out, Tensor) else out
+        finally:
+            for p, a in zip(params, saved):
+                p._data = a
+
+    return apply_op("recompute_layer",
+                    jax.checkpoint(pure),
+                    tuple([h] + params))
+
+
+def _seq_constrain(h, config: LlamaConfig):
+    """Sequence-parallel activation layout: shard [b, s, h] as (dp, sep)
+    when a hybrid mesh is active (reference: sequence_parallel_utils.py and
+    the sep axis — SURVEY.md §5.7; on TPU one sharding constraint replaces
+    both scatter/gather mechanisms)."""
+    if not config.sequence_parallel:
+        return h
+    from ..distributed.fleet.topology import get_hcg
+    hcg = get_hcg()
+    if hcg is None:
+        return h
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(hcg.global_mesh, P("dp", "sep", None))
+    return apply_op("sp_constrain",
+                    lambda v: jax.lax.with_sharding_constraint(v, sh), (h,))
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = _ParamLinear(config.hidden_size, config.vocab_size,
+                                        config.dtype, _scaled_init(config.hidden_size))
+
+    def forward(self, input_ids, labels=None):
+        h = self.llama(input_ids)
+        if self.lm_head is None:
+            logits = F.linear(h, self.llama.embed_tokens.weight.T, None)
+        else:
+            logits = self.lm_head(h)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.astype("float32").reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]))
+            return logits, loss
+        return logits
+
+
+# ---- sharding plan ----
+def llama_shard_plan(model: LlamaForCausalLM, mesh=None):
+    """Lay the model's weights over the hybrid mesh (Megatron TP schedule,
+    reference mp_layers.py; SURVEY.md §7.1 'TP mpu layers' row):
+
+      q/k/v_proj, gate/up_proj : Shard(out_dim)  over 'mp'  (column-parallel)
+      o_proj, down_proj        : Shard(in_dim)   over 'mp'  (row-parallel)
+      embed_tokens, lm_head    : Shard(vocab dim) over 'mp' (vocab-parallel)
+      norms                    : replicated
+
+    GSPMD then emits the canonical TP collectives.  Pipeline/dp placement
+    comes from batch sharding + (optionally) PipelineLayer staging.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        from ..distributed.fleet.topology import get_hcg
+        hcg = get_hcg()
+        if hcg is None:
+            return model
+        mesh = hcg.global_mesh
+    if "mp" not in mesh.axis_names or mesh.shape["mp"] == 1:
+        return model
+
+    def put(p, spec):
+        if not isinstance(p._data, jax.core.Tracer):
+            p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+
+    put(model.llama.embed_tokens.weight, P("mp", None))
+    if model.lm_head is not None:
+        put(model.lm_head.weight, P(None, "mp"))
+    for layer in model.llama.layers:
+        put(layer.self_attn.q_proj.weight, P(None, "mp"))
+        put(layer.self_attn.k_proj.weight, P(None, "mp"))
+        put(layer.self_attn.v_proj.weight, P(None, "mp"))
+        put(layer.self_attn.o_proj.weight, P("mp", None))
+        put(layer.mlp.gate_proj.weight, P(None, "mp"))
+        put(layer.mlp.up_proj.weight, P(None, "mp"))
+        put(layer.mlp.down_proj.weight, P("mp", None))
+        put(layer.input_layernorm.weight, P(None))
+        put(layer.post_attention_layernorm.weight, P(None))
+    put(model.llama.norm.weight, P(None))
+    return model

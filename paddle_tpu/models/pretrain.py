@@ -1,0 +1,260 @@
+"""Flagship pretraining engine: one jitted SPMD train step over the hybrid
+mesh (the BASELINE.md north-star workload).
+
+This is the TPU-native counterpart of the reference's Fleet hybrid-parallel
+train loop (SURVEY.md §3.4): where the reference composes
+DataParallel→TensorParallel→PipelineParallel wrappers + HybridParallelOptimizer
+around an eager model, here the whole train step — microbatched pipeline,
+Megatron TP shardings, loss, backward, AdamW update — is ONE compiled XLA
+program over a Mesh with axes ('dp', 'pp', 'mp'):
+
+  * dp  : batch sharding (grad allreduce emitted by XLA)
+  * pp  : GPipe pipeline via shard_map+ppermute (pipeline_spmd.py)
+  * mp  : Megatron TP via weight PartitionSpecs (GSPMD collectives)
+  * sequence parallelism: activations between blocks are sharded over 'mp'
+    on the seq dim (Megatron-SP; supersedes the reference's scatter/gather
+    utils — SURVEY.md §5.7)
+
+The model *math* comes from models.llama's layers via the functional bridge
+(utils.functional_call), so eager and compiled paths share one definition.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..distributed.pipeline_spmd import pipeline_apply
+from ..utils import extract_params, functional_call, stack_params
+from .llama import LlamaConfig, LlamaDecoderLayer, _rope_cos_sin, _scaled_init
+
+
+@dataclass
+class ParallelConfig:
+    dp: int = 1
+    pp: int = 1
+    mp: int = 1
+    micro_batches: int = 1
+    sequence_parallel: bool = False
+    zero1: bool = False          # shard optimizer moments over dp
+    remat: bool = False          # jax.checkpoint each decoder layer
+
+    @property
+    def n_devices(self):
+        return self.dp * self.pp * self.mp
+
+
+def build_mesh(pc: ParallelConfig, devices=None) -> Mesh:
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = pc.n_devices
+    if devices.size < n:
+        raise ValueError(f"need {n} devices, have {devices.size}")
+    return Mesh(devices.ravel()[:n].reshape(pc.dp, pc.pp, pc.mp),
+                ("dp", "pp", "mp"))
+
+
+def _block_spec(name: str) -> Tuple[Optional[str], ...]:
+    """Megatron TP PartitionSpec entries for one decoder-layer param (without
+    the stacking dims) — mirrors llama_shard_plan."""
+    if name.endswith(("q_proj.weight", "k_proj.weight", "v_proj.weight",
+                      "gate_proj.weight", "up_proj.weight")):
+        return (None, "mp")      # column parallel
+    if name.endswith(("o_proj.weight", "down_proj.weight")):
+        return ("mp", None)      # row parallel
+    return (None,)               # norms
+
+
+class PretrainStep:
+    """Builds init_state() and a jitted train_step(state, ids, labels)."""
+
+    def __init__(self, config: LlamaConfig, parallel: Optional[ParallelConfig] = None,
+                 learning_rate: float = 3e-4, weight_decay: float = 0.1,
+                 beta1: float = 0.9, beta2: float = 0.95, eps: float = 1e-8,
+                 mesh: Optional[Mesh] = None):
+        self.config = config
+        self.pc = parallel or ParallelConfig()
+        self.mesh = mesh if mesh is not None else build_mesh(self.pc)
+        self.lr, self.wd = learning_rate, weight_decay
+        self.b1, self.b2, self.eps = beta1, beta2, eps
+        if config.num_hidden_layers % self.pc.pp:
+            raise ValueError(
+                f"pp degree ({self.pc.pp}) must divide num_hidden_layers "
+                f"({config.num_hidden_layers})")
+        # one template layer provides the block math for every (stage, layer)
+        self._template = LlamaDecoderLayer(config)
+        self._jit_step = None
+
+    # ---- parameter init & sharding ----
+    def _shardings(self, sample_params) -> Dict[str, Any]:
+        mesh = self.mesh
+        out = {}
+        for k, v in sample_params["blocks"].items():
+            out_k = ("pp", None) + _block_spec(k)[:np.ndim(v) - 2]
+            out[k] = NamedSharding(mesh, P(*out_k))
+        return {
+            "embed": NamedSharding(mesh, P("mp", None)),
+            "head": NamedSharding(mesh, P(None, "mp")),
+            "norm": NamedSharding(mesh, P(None)),
+            "blocks": out,
+        }
+
+    def init_state(self, seed: int = 0) -> Dict[str, Any]:
+        c = self.config
+        from ..core import random as prandom
+        prandom.seed(seed)
+        dt = jnp.dtype(c.dtype) if isinstance(c.dtype, str) else c.dtype
+
+        layer_params = []
+        for _ in range(c.num_hidden_layers):
+            layer = LlamaDecoderLayer(c)
+            layer_params.append(extract_params(layer))
+        stacked = stack_params(layer_params)          # [L, ...]
+        S = self.pc.pp
+        stacked = {k: v.reshape((S, c.num_hidden_layers // S) + v.shape[1:])
+                   for k, v in stacked.items()}       # [S, L/S, ...]
+
+        params = {
+            "embed": _scaled_init(c.hidden_size)([c.vocab_size, c.hidden_size], dt),
+            "head": _scaled_init(c.hidden_size)([c.hidden_size, c.vocab_size], dt),
+            "norm": jnp.ones([c.hidden_size], dt),
+            "blocks": stacked,
+        }
+        sh = self._shardings(params)
+        params = {
+            "embed": jax.device_put(params["embed"], sh["embed"]),
+            "head": jax.device_put(params["head"], sh["head"]),
+            "norm": jax.device_put(params["norm"], sh["norm"]),
+            "blocks": {k: jax.device_put(v, sh["blocks"][k])
+                       for k, v in params["blocks"].items()},
+        }
+
+        def moment_like(p):
+            m = jnp.zeros(p.shape, jnp.float32)
+            return jax.device_put(m, p.sharding)
+
+        state = {
+            "params": params,
+            "m": jax.tree_util.tree_map(moment_like, params),
+            "v": jax.tree_util.tree_map(moment_like, params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+        return state
+
+    # ---- forward/loss as a pure function ----
+    def forward_logits(self, params, ids):
+        """Pure forward to fp32 logits (used by entry()/eval)."""
+        return self._logits(params, ids)
+
+    def _forward_loss(self, params, ids, labels):
+        logits = self._logits(params, ids)
+        logits = jax.lax.with_sharding_constraint(
+            logits, NamedSharding(self.mesh, P("dp", None, "mp")))
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        return (lse - gold).mean()
+
+    def _logits(self, params, ids):
+        c, pc = self.config, self.pc
+        mesh = self.mesh
+        B, T = ids.shape
+        cos, sin = _rope_cos_sin(T, c.head_dim, c.rope_theta, jnp.float32)
+
+        h = jnp.take(params["embed"], ids, axis=0)     # [B, T, H] (vocab-gather)
+        h = jax.lax.with_sharding_constraint(
+            h, NamedSharding(mesh, P("dp", "mp" if pc.sequence_parallel else None, None)))
+
+        template = self._template
+
+        def block(lp, x):
+            y = functional_call(template, lp, Tensor(x), cos, sin)
+            # Megatron-SP between blocks: only expressible outside the manual
+            # pp region (inside it GSPMD still shards over the auto axes by
+            # propagation from the mp-sharded weights)
+            if pc.sequence_parallel and pc.pp == 1:
+                y = jax.lax.with_sharding_constraint(
+                    y, NamedSharding(mesh, P("dp", "mp", None)))
+            return y
+
+        if pc.remat:
+            block = jax.checkpoint(block)
+
+        def stage_fn(stage_params, x, *consts):
+            def body(carry, lp):
+                return block(lp, carry), None
+            out, _ = jax.lax.scan(body, x, stage_params)
+            return out
+
+        M = pc.micro_batches
+        if B % M:
+            raise ValueError(
+                f"micro_batches ({M}) must divide the batch size ({B})")
+        micro = h.reshape((M, B // M) + h.shape[1:])
+        out = pipeline_apply(mesh, "pp", stage_fn, params["blocks"], micro)
+        h = out.reshape(B, T, c.hidden_size)
+
+        # final rms norm (fp32 accumulation) + head
+        from ..kernels.rms_norm import rms_norm_fp32
+        h = rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
+        return (h @ params["head"]).astype(jnp.float32)   # [B, T, V]
+
+    # ---- adamw ----
+    def _update(self, state, grads):
+        b1, b2, eps, lr, wd = self.b1, self.b2, self.eps, self.lr, self.wd
+        step = state["step"] + 1
+        t = step.astype(jnp.float32)
+        c1 = 1.0 - b1 ** t
+        c2 = 1.0 - b2 ** t
+
+        def upd(p, g, m, v):
+            g = g.astype(jnp.float32)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * (g * g)
+            u = (m / c1) / (jnp.sqrt(v / c2) + eps)
+            pf = p.astype(jnp.float32)
+            pf = pf - lr * (u + wd * pf)
+            return pf.astype(p.dtype), m, v
+
+        flat_p, treedef = jax.tree_util.tree_flatten(state["params"])
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_m = jax.tree_util.tree_leaves(state["m"])
+        flat_v = jax.tree_util.tree_leaves(state["v"])
+        new = [upd(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+        params = jax.tree_util.tree_unflatten(treedef, [n[0] for n in new])
+        m = jax.tree_util.tree_unflatten(treedef, [n[1] for n in new])
+        v = jax.tree_util.tree_unflatten(treedef, [n[2] for n in new])
+        return {"params": params, "m": m, "v": v, "step": step}
+
+    # ---- the jitted step ----
+    def train_step(self, state, ids, labels):
+        if self._jit_step is None:
+            def step(state, ids, labels):
+                loss, grads = jax.value_and_grad(
+                    lambda p: self._forward_loss(p, ids, labels))(state["params"])
+                return self._update(state, grads), loss
+
+            self._jit_step = jax.jit(step, donate_argnums=(0,))
+        return self._jit_step(state, ids, labels)
+
+    def eval_loss(self, state, ids, labels):
+        return self._forward_loss(state["params"], ids, labels)
+
+    # ---- accounting (BASELINE.md MFU formula) ----
+    def flops_per_token(self) -> float:
+        n = self.config.num_params()
+        f = 6.0 * n
+        if self.pc.remat:
+            f += 2.0 * n  # recompute forward counted separately per BASELINE.md
+        return f
+
+    def shard_batch(self, ids: np.ndarray, labels: np.ndarray):
+        sh = NamedSharding(self.mesh, P("dp", None))
+        return (jax.device_put(jnp.asarray(ids), sh),
+                jax.device_put(jnp.asarray(labels), sh))

@@ -1,0 +1,91 @@
+"""Utility surface (reference: python/paddle/utils/) + functional bridges.
+
+The functional bridge (extract_params/functional_call) is the TPU-native
+replacement for the reference's program-capture machinery: any ``nn.Layer``
+becomes a pure function over a params pytree, which is what jit/scan/
+shard_map/pipeline transforms consume.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+def extract_params(layer) -> Dict[str, Any]:
+    """Layer → {qualified_name: jax.Array} pytree (insertion-ordered)."""
+    return {name: p._data for name, p in layer.named_parameters()}
+
+
+def extract_buffers(layer) -> Dict[str, Any]:
+    return {name: b._data for name, b in layer.named_buffers()}
+
+
+def functional_call(layer, params: Dict[str, Any], *args, **kwargs):
+    """Run ``layer(*args)`` with ``params`` swapped in (pure w.r.t. params).
+
+    Tensor args pass through as-is; jax arrays are wrapped.  Returns raw jax
+    arrays (pytree) so the result composes with jax transforms.
+    """
+    named = dict(layer.named_parameters())
+    saved = {k: p._data for k, p in named.items()}
+
+    def wrap(a):
+        return Tensor(a) if isinstance(a, (jax.Array, jax.core.Tracer)) else a
+
+    try:
+        for k, arr in params.items():
+            named[k]._data = arr
+        out = layer(*[wrap(a) for a in args],
+                    **{k: wrap(v) for k, v in kwargs.items()})
+        return jax.tree_util.tree_map(
+            lambda o: o._data if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda o: isinstance(o, Tensor))
+    finally:
+        for k, arr in saved.items():
+            named[k]._data = arr
+
+
+def load_params(layer, params: Dict[str, Any]) -> None:
+    """Write a params pytree back into the layer's Parameters."""
+    named = dict(layer.named_parameters())
+    for k, arr in params.items():
+        named[k]._data = arr
+
+
+def stack_params(param_dicts) -> Dict[str, Any]:
+    """[{name: arr}, ...] → {name: stacked arr} (leading stacking dim).
+
+    Used to turn N structurally-identical blocks into one scan/pipeline-able
+    pytree (the scan-over-layers / stacked-stage-params idiom)."""
+    import jax.numpy as jnp
+    keys = list(param_dicts[0])
+    return {k: jnp.stack([d[k] for d in param_dicts]) for k in keys}
+
+
+def try_import(name: str):
+    try:
+        import importlib
+        return importlib.import_module(name)
+    except ImportError:
+        return None
+
+
+# reference paddle.utils surface stubs
+def run_check():
+    """paddle.utils.run_check analog: verify an op runs on the backend."""
+    import jax.numpy as jnp
+    x = jnp.ones((2, 2))
+    assert float((x @ x).sum()) == 8.0
+    print("paddle_tpu is installed successfully!")
+
+
+class deprecated:
+    def __init__(self, *a, **k):
+        pass
+
+    def __call__(self, fn):
+        return fn

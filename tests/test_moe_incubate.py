@@ -1,0 +1,123 @@
+"""MoE (expert parallel) + incubate fused-op tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _experts(d, E):
+    return [nn.Sequential(nn.Linear(d, 32), nn.GELU(), nn.Linear(32, d))
+            for _ in range(E)]
+
+
+def test_moe_forward_backward(rng):
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    paddle.seed(0)
+    d, E = 16, 4
+    moe = MoELayer(d, _experts(d, E), gate={"type": "gshard", "top_k": 2})
+    x = paddle.to_tensor(rng.standard_normal((2, 8, d)).astype(np.float32),
+                         stop_gradient=False)
+    y = moe(x)
+    assert y.shape == [2, 8, d]
+    assert moe.loss is not None
+    loss = (y * y).mean() + 0.01 * moe.loss
+    loss.backward()
+    assert all(p.grad is not None for p in moe.experts.parameters())
+    assert moe.gate.weight.grad is not None
+
+
+def test_moe_vmap_vs_python_parity(rng):
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    paddle.seed(1)
+    d = 16
+    moe = MoELayer(d, _experts(d, 4), gate={"type": "naive", "top_k": 2})
+    x = paddle.to_tensor(rng.standard_normal((3, 5, d)).astype(np.float32))
+    y_fast = moe(x).numpy()
+    moe._template = None
+    y_py = moe(x).numpy()
+    np.testing.assert_allclose(y_fast, y_py, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_capacity_drops(rng):
+    """All tokens to one expert with tiny capacity: over-capacity output = 0."""
+    from paddle_tpu.incubate.distributed.models.moe.moe_layer import _dispatch_combine
+    import jax.numpy as jnp
+
+    N, E, C = 8, 2, 4
+    idx = jnp.zeros((N, 1), jnp.int32)
+    val = jnp.ones((N, 1), jnp.float32)
+    dispatch, combine = _dispatch_combine(val, idx, E, C)
+    assert float(dispatch.sum()) == C        # only capacity tokens kept
+    assert float(combine[C:].sum()) == 0.0   # dropped tokens combine to zero
+
+
+def test_moe_expert_parallel_mesh(rng):
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    import paddle_tpu.distributed.fleet as fleet
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "sharding_degree": 4, "mp_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+    paddle.seed(2)
+    d = 16
+    moe = MoELayer(d, _experts(d, 4), gate={"type": "switch"})
+    assert moe._ep_axis() is not None
+    x = paddle.to_tensor(rng.standard_normal((2, 8, d)).astype(np.float32))
+    y = moe(x)
+    (y * y).mean().backward()
+    assert all(p.grad is not None for p in moe.experts.parameters())
+
+
+def test_incubate_fused_ops(rng):
+    import paddle_tpu.incubate.nn.functional as IF
+
+    x = paddle.to_tensor(rng.standard_normal((2, 6, 16)).astype(np.float32),
+                         stop_gradient=False)
+    w = paddle.ones([16])
+    out = IF.fused_rms_norm(x, w, epsilon=1e-6)
+    ref = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    g = paddle.to_tensor(rng.standard_normal((2, 6, 16)).astype(np.float32))
+    u = paddle.to_tensor(rng.standard_normal((2, 6, 16)).astype(np.float32))
+    sw = IF.swiglu(g, u)
+    def silu(a):
+        return a / (1 + np.exp(-a))
+    np.testing.assert_allclose(sw.numpy(), silu(g.numpy()) * u.numpy(), rtol=1e-5)
+    sw2 = IF.swiglu(paddle.concat([g, u], axis=-1))
+    np.testing.assert_allclose(sw2.numpy(), sw.numpy(), rtol=1e-6)
+
+    q = paddle.to_tensor(rng.standard_normal((2, 8, 4, 16)).astype(np.float32))
+    k = paddle.to_tensor(rng.standard_normal((2, 8, 4, 16)).astype(np.float32))
+    d = 16
+    from paddle_tpu.models.llama import _rope_cos_sin
+    cos_t, sin_t = _rope_cos_sin(8, d, 10000.0, np.float32)
+    cos_t, sin_t = np.asarray(cos_t), np.asarray(sin_t)
+
+    # neox (rotate-half) numerics vs handwritten reference
+    qr, kr, _ = IF.fused_rotary_position_embedding(q, k, use_neox_rotary_style=True)
+    qn = q.numpy()
+    x1, x2 = qn[..., :d // 2], qn[..., d // 2:]
+    c = cos_t[None, :, None, :]
+    s = sin_t[None, :, None, :]
+    expect = np.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    np.testing.assert_allclose(qr.numpy(), expect, rtol=1e-5, atol=1e-6)
+
+    # interleaved (GPT-J) numerics
+    qr2, _, _ = IF.fused_rotary_position_embedding(q, use_neox_rotary_style=False)
+    y1, y2 = qn[..., 0::2], qn[..., 1::2]
+    o = np.stack([y1 * c - y2 * s, y2 * c + y1 * s], axis=-1).reshape(qn.shape)
+    np.testing.assert_allclose(qr2.numpy(), o, rtol=1e-5, atol=1e-6)
+
+    # position_ids indexing
+    pos = paddle.to_tensor(np.tile(np.arange(8)[::-1], (2, 1)).copy())
+    qr3, _, _ = IF.fused_rotary_position_embedding(q, position_ids=pos,
+                                                   use_neox_rotary_style=True)
+    c3 = cos_t[::-1][None, :, None, :]
+    s3 = sin_t[::-1][None, :, None, :]
+    expect3 = np.concatenate([x1 * c3 - x2 * s3, x2 * c3 + x1 * s3], axis=-1)
+    np.testing.assert_allclose(qr3.numpy(), expect3, rtol=1e-5, atol=1e-6)
