@@ -1,0 +1,76 @@
+"""paddle.audio (reference: python/paddle/audio/ — features/functional).
+
+Minimal functional surface: spectrogram/mel utilities over paddle_tpu.fft.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops._prim import apply_op
+
+
+class functional:
+    @staticmethod
+    def hz_to_mel(freq, htk=False):
+        if htk:
+            return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+        f = np.asarray(freq, dtype="float64")
+        mel = 3.0 * f / 200.0
+        min_log_hz, min_log_mel = 1000.0, 15.0
+        logstep = math.log(6.4) / 27.0
+        return np.where(f >= min_log_hz,
+                        min_log_mel + np.log(f / min_log_hz) / logstep, mel)
+
+    @staticmethod
+    def mel_to_hz(mel, htk=False):
+        if htk:
+            return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+        m = np.asarray(mel, dtype="float64")
+        freq = 200.0 * m / 3.0
+        min_log_hz, min_log_mel = 1000.0, 15.0
+        logstep = math.log(6.4) / 27.0
+        return np.where(m >= min_log_mel,
+                        min_log_hz * np.exp(logstep * (m - min_log_mel)), freq)
+
+    @staticmethod
+    def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                             htk=False, norm="slaney", dtype="float32"):
+        f_max = f_max or sr / 2
+        mels = np.linspace(functional.hz_to_mel(f_min, htk),
+                           functional.hz_to_mel(f_max, htk), n_mels + 2)
+        hz = functional.mel_to_hz(mels, htk)
+        bins = np.floor((n_fft + 1) * hz / sr).astype(int)
+        fb = np.zeros((n_mels, n_fft // 2 + 1))
+        for m in range(1, n_mels + 1):
+            l, c, r = bins[m - 1], bins[m], bins[m + 1]
+            for k in range(l, c):
+                if c > l:
+                    fb[m - 1, k] = (k - l) / (c - l)
+            for k in range(c, r):
+                if r > c:
+                    fb[m - 1, k] = (r - k) / (r - c)
+        return Tensor(fb.astype(dtype))
+
+
+class features:
+    class Spectrogram:
+        def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                     power=2.0, **kw):
+            self.n_fft = n_fft
+            self.hop = hop_length or n_fft // 4
+            self.win = win_length or n_fft
+            self.power = power
+
+        def __call__(self, x):
+            arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            window = jnp.hanning(self.win)
+            n_frames = 1 + (arr.shape[-1] - self.win) // self.hop
+            frames = jnp.stack([arr[..., i * self.hop:i * self.hop + self.win]
+                                for i in range(n_frames)], axis=-2)
+            spec = jnp.abs(jnp.fft.rfft(frames * window, n=self.n_fft)) ** self.power
+            return Tensor(jnp.swapaxes(spec, -1, -2))

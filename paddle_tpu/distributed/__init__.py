@@ -30,6 +30,14 @@ from .auto_parallel.api import (  # noqa: F401
 from .auto_parallel.process_mesh import get_mesh, set_mesh  # noqa: F401
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import sharding  # noqa: F401
+from . import launch  # noqa: F401
+from . import rpc  # noqa: F401
+from . import auto_tuner  # noqa: F401
+from . import watchdog  # noqa: F401
+from .pipeline_spmd import pipeline_apply  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 
 # reference parity: paddle.distributed.fleet.meta_parallel classes
 from .meta_parallel import (  # noqa: F401

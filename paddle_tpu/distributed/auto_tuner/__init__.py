@@ -1,0 +1,119 @@
+"""Hybrid-parallel auto-tuner (reference: python/paddle/distributed/auto_tuner/
+— search.py candidate enumeration, prune.py rule-based pruning,
+cost_model.py, recorder.py).
+
+Searches (dp, mp, pp, micro_batches, recompute) over a device count with an
+analytic cost model (compute + collective volumes over ICI), prunes invalid
+points, and can measure the survivors by running a user-provided trial
+function (the reference launches real jobs; here a trial = one jitted step).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class TuningRecord:
+    config: Dict
+    cost: float
+    measured: Optional[float] = None
+
+
+class Recorder:
+    def __init__(self):
+        self.records: List[TuningRecord] = []
+
+    def add(self, rec: TuningRecord):
+        self.records.append(rec)
+
+    def best(self) -> Optional[TuningRecord]:
+        done = [r for r in self.records if r.measured is not None]
+        pool = done or self.records
+        return min(pool, key=lambda r: r.measured if r.measured is not None
+                   else r.cost) if pool else None
+
+    def sorted(self):
+        return sorted(self.records, key=lambda r: r.cost)
+
+
+def _candidates(n_devices: int, num_layers: int, global_batch: int,
+                heads: int):
+    """Enumerate (dp, mp, pp) factorizations + microbatching (search.py)."""
+    for dp in _divisors(n_devices):
+        for mp in _divisors(n_devices // dp):
+            pp = n_devices // dp // mp
+            if pp < 1:
+                continue
+            # prune rules (prune.py): layers divisible by pp, heads by mp,
+            # batch divisible by dp
+            if num_layers % pp or heads % mp or global_batch % dp:
+                continue
+            local_batch = global_batch // dp
+            for micro in _divisors(local_batch):
+                if pp > 1 and micro < 2 * pp:
+                    continue  # too few microbatches: bubble dominates
+                for remat in (False, True):
+                    yield {"dp": dp, "mp": mp, "pp": pp,
+                           "micro_batches": micro, "recompute": remat}
+
+
+def _divisors(n: int):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def analytic_cost(cfg: Dict, *, hidden: int, num_layers: int, seq: int,
+                  global_batch: int, flops_per_chip: float = 197e12,
+                  ici_bw: float = 4.5e10) -> float:
+    """Seconds per step ≈ compute/chip + TP collectives + pp bubble + remat.
+
+    Rough model (cost_model.py slot): enough to rank configurations.
+    """
+    dp, mp, pp = cfg["dp"], cfg["mp"], cfg["pp"]
+    M = cfg["micro_batches"]
+    params = 12 * hidden * hidden * num_layers
+    tokens = global_batch * seq
+    flops = 6.0 * params * tokens * (4.0 / 3.0 if cfg["recompute"] else 1.0)
+    compute = flops / (dp * mp * pp) / (flops_per_chip * 0.5)
+    # Megatron TP: 4 allgather/reducescatter of activations per layer
+    act_bytes = 2.0 * tokens / dp * hidden
+    tp_comm = 0.0 if mp == 1 else \
+        4 * num_layers * act_bytes * (mp - 1) / mp / ici_bw
+    bubble = (pp - 1) / max(M, 1)
+    mem_penalty = 0.0 if cfg["recompute"] else \
+        1e-3 * (tokens / dp / M) * hidden * num_layers / 8e9
+    return compute * (1 + bubble) + tp_comm + mem_penalty
+
+
+class AutoTuner:
+    """reference auto_tuner Search+Recorder driver."""
+
+    def __init__(self, n_devices: int, *, hidden: int, num_layers: int,
+                 heads: int, seq: int, global_batch: int):
+        self.n_devices = n_devices
+        self.model_kw = dict(hidden=hidden, num_layers=num_layers, seq=seq,
+                             global_batch=global_batch)
+        self.heads = heads
+        self.recorder = Recorder()
+
+    def search_all(self) -> List[TuningRecord]:
+        for cfg in _candidates(self.n_devices, self.model_kw["num_layers"],
+                               self.model_kw["global_batch"], self.heads):
+            self.recorder.add(TuningRecord(cfg, analytic_cost(cfg, **self.model_kw)))
+        return self.recorder.sorted()
+
+    def tune(self, trial_fn: Optional[Callable[[Dict], float]] = None,
+             max_trials: int = 4) -> TuningRecord:
+        """Rank by cost model; optionally measure the top candidates with
+        trial_fn(config) -> seconds/step."""
+        ranked = self.search_all()
+        if trial_fn is not None:
+            for rec in ranked[:max_trials]:
+                try:
+                    rec.measured = trial_fn(rec.config)
+                except Exception:
+                    rec.measured = float("inf")
+        return self.recorder.best()

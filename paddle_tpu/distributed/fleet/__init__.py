@@ -18,6 +18,8 @@ from .mpu import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
     VocabParallelEmbedding, get_rng_state_tracker,
 )
+from . import utils  # noqa: F401
+from .utils import recompute  # noqa: F401
 
 
 class DistributedStrategy:

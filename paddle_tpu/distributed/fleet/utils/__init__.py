@@ -1,0 +1,8 @@
+"""Fleet utils (reference: python/paddle/distributed/fleet/utils/ —
+recompute, hybrid_parallel_util, sequence_parallel_utils,
+tensor_fusion_helper)."""
+
+from .recompute import recompute  # noqa: F401
+from . import hybrid_parallel_util  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401
+from .hybrid_parallel_util import fused_allreduce_gradients  # noqa: F401

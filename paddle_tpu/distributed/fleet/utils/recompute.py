@@ -1,0 +1,80 @@
+"""Activation recomputation (reference:
+python/paddle/distributed/fleet/utils/recompute.py ``recompute`` /
+``recompute_sequential``).
+
+TPU-native mechanism: ``jax.checkpoint`` (remat) over the function's pure
+form — XLA rematerializes the forward inside the backward, the same
+FLOPs-for-memory trade the reference implements by replaying the block under
+a stashed RNG state.  RNG consistency is inherent here: the traced key is an
+argument, so replay uses identical randomness (the RNGStatesTracker stash
+dance is unnecessary).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ....core.tensor import Tensor
+from ....nn.layer import Layer
+from ....ops._prim import apply_op
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function`` under rematerialization (reference recompute.py).
+
+    ``function``: a Layer or callable over Tensors; positional Tensor args
+    are differentiable.
+    """
+    kwargs.pop("use_reentrant", None)
+    preserve = kwargs.pop("preserve_rng_state", True)  # inherent on TPU
+
+    params = []
+    if isinstance(function, Layer):
+        params = [p for p in function.parameters() if p.trainable]
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    tensors = [args[i] for i in tensor_idx] + params
+    n_inputs = len(tensor_idx)
+
+    def pure(*arrays):
+        in_arrays = arrays[:n_inputs]
+        p_arrays = arrays[n_inputs:]
+        saved = [p._data for p in params]
+        call_args = list(args)
+        for j, i in enumerate(tensor_idx):
+            call_args[i] = Tensor(in_arrays[j])
+        try:
+            for p, a in zip(params, p_arrays):
+                p._data = a
+            out = function(*call_args, **kwargs)
+            return jax.tree_util.tree_map(
+                lambda o: o._data if isinstance(o, Tensor) else o, out,
+                is_leaf=lambda o: isinstance(o, Tensor))
+        finally:
+            for p, a in zip(params, saved):
+                p._data = a
+
+    return apply_op("recompute", jax.checkpoint(pure), tuple(tensors))
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference recompute.py recompute_sequential over nn.Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    seg_size = max(len(funcs) // max(segments, 1), 1)
+    out = args
+    for s in range(0, len(funcs), seg_size):
+        seg = funcs[s:s + seg_size]
+
+        def run_seg(*xs, _seg=seg):
+            y = xs
+            for f in _seg:
+                y = f(*y) if isinstance(y, tuple) else f(y)
+                y = y if isinstance(y, tuple) else (y,)
+            return y[0] if len(y) == 1 else y
+
+        out = recompute(run_seg, *(out if isinstance(out, tuple) else (out,)))
+        out = out if isinstance(out, tuple) else (out,)
+    return out[0] if len(out) == 1 else out

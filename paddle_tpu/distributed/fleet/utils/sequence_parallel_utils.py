@@ -1,0 +1,115 @@
+"""Megatron sequence-parallel utilities (reference:
+python/paddle/distributed/fleet/utils/sequence_parallel_utils.py —
+ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers :85-127,
+ColumnSequenceParallelLinear :429, RowSequenceParallelLinear :564).
+
+TPU-native: the scatter/gather PyLayers around TP linears are *layout
+changes* — one `with_sharding_constraint` each, with GSPMD emitting the
+all_gather/reduce_scatter pair (and overlapping it, the job of the
+reference's SPInnerOverlapLinear).  The classes keep the reference API;
+sharding happens over the 'mp' axis on the sequence dim (dim 0 in the
+reference's [s, b, h] convention; dim-configurable here).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ....nn import functional as F
+from ....nn.layer import Layer
+from ....ops._prim import apply_op
+from ..mpu.mp_layers import ColumnParallelLinear, RowParallelLinear, _mp_info
+
+
+def _constrain_dim(x: Tensor, dim: int, axis_name, mesh) -> Tensor:
+    if mesh is None:
+        return x
+    spec = [None] * x.ndim
+    spec[dim] = axis_name
+    sh = NamedSharding(mesh, P(*spec))
+    return apply_op("sp_layout",
+                    lambda v: jax.lax.with_sharding_constraint(v, sh), (x,))
+
+
+def _replicate(x: Tensor, mesh) -> Tensor:
+    if mesh is None:
+        return x
+    sh = NamedSharding(mesh, P(*([None] * x.ndim)))
+    return apply_op("sp_layout",
+                    lambda v: jax.lax.with_sharding_constraint(v, sh), (x,))
+
+
+def scatter(x, axis=0):
+    """ScatterOp: full -> seq-sharded (reference :85)."""
+    world, ax, mesh = _mp_info(None)
+    return _constrain_dim(x, axis, ax, mesh) if world > 1 else x
+
+
+def all_gather(x, axis=0):
+    """AllGatherOp/GatherOp: seq-sharded -> full (reference :101)."""
+    world, ax, mesh = _mp_info(None)
+    return _replicate(x, mesh) if world > 1 else x
+
+
+def reduce_scatter(x, axis=0):
+    """ReduceScatterOp: partial-full -> reduced seq shard (reference :114).
+    GSPMD discharges the partial sum when re-laying out the value."""
+    world, ax, mesh = _mp_info(None)
+    return _constrain_dim(x, axis, ax, mesh) if world > 1 else x
+
+
+class ScatterOp:
+    @staticmethod
+    def apply(x, axis=0):
+        return scatter(x, axis)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x, axis=0):
+        return all_gather(x, axis)
+
+
+class AllGatherOp:
+    @staticmethod
+    def apply(x):
+        return all_gather(x, 0)
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x):
+        return reduce_scatter(x, 0)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """reference :429 — all-gather the seq-sharded input before the
+    column-parallel GEMM (one layout change; XLA overlaps it)."""
+
+    def forward(self, x):
+        if self.is_mp:
+            x = all_gather(x, 0)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """reference :564 — row-parallel GEMM then reduce-scatter onto the seq
+    dim."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self.is_mp:
+            out = reduce_scatter(out, 0)
+        return out
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Grad allreduce for SP params happens inside XLA; parity no-op."""
+    return None

@@ -1,0 +1,79 @@
+"""paddle.distributed.launch analog (reference:
+python/paddle/distributed/launch/main.py:23; CollectiveController builds a
+pod of per-GPU processes with PADDLE_TRAINER_ID env — SURVEY.md §3.4 step 1).
+
+TPU-native process model: ONE controller process per *host* drives all local
+chips (jax SPMD), so on a single host the launcher simply runs the script.
+Multi-host: one process per node, rendezvous via jax.distributed
+(coordinator = --master).  ``--nproc_per_node`` still spawns N processes for
+multi-process simulation/testing (each pinned to the CPU platform with
+virtual devices).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import subprocess
+import sys
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=str, default="1")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator address host:port")
+    p.add_argument("--rank", type=int, default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+    p.add_argument("--devices", "--gpus", type=str, default=None,
+                   help="accepted for reference parity; device visibility is "
+                        "managed by the TPU runtime")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    nnodes = int(str(args.nnodes).split(":")[0])
+
+    if args.nproc_per_node <= 1:
+        # controller-per-host: configure rendezvous env and run in-process
+        if args.master and nnodes > 1:
+            os.environ["PADDLE_MASTER"] = args.master
+            os.environ["PADDLE_TRAINERS_NUM"] = str(nnodes)
+            os.environ["PADDLE_TRAINER_ID"] = str(args.rank)
+        sys.argv = [args.script] + list(args.script_args)
+        runpy.run_path(args.script, run_name="__main__")
+        return 0
+
+    # multi-process simulation (the reference's process-per-device pod),
+    # used by collective tests without real multi-host
+    os.makedirs(args.log_dir, exist_ok=True)
+    master = args.master or "127.0.0.1:36718"
+    procs = []
+    for rank in range(args.nproc_per_node):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_MASTER": master,
+            "PADDLE_TRAINERS_NUM": str(args.nproc_per_node),
+            "PADDLE_TRAINER_ID": str(rank),
+            "JAX_PLATFORMS": "cpu",
+        })
+        log = open(os.path.join(args.log_dir,
+                                f"workerlog.{rank}"), "w")
+        procs.append((subprocess.Popen(
+            [sys.executable, args.script] + list(args.script_args),
+            env=env, stdout=log, stderr=subprocess.STDOUT), log))
+    code = 0
+    for p, log in procs:
+        code |= p.wait()
+        log.close()
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -1,0 +1,69 @@
+"""paddle.distributed.rpc (reference: python/paddle/distributed/rpc/ over
+brpc).
+
+Single-controller SPMD has one process per host; in-process "rpc" is a
+direct call.  Cross-host rpc requires a transport this round does not ship;
+the API raises with guidance rather than silently faking multi-host.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str = "127.0.0.1"
+    port: int = 0
+
+
+_STATE = {"name": None, "inited": False}
+
+
+def init_rpc(name: str, rank: int = 0, world_size: int = 1,
+             master_endpoint: str = None):
+    if world_size > 1:
+        raise NotImplementedError(
+            "multi-host rpc transport is not shipped; use "
+            "paddle_tpu.distributed collectives / jax.distributed")
+    _STATE.update(name=name, inited=True)
+
+
+def rpc_sync(to: str, fn: Callable, args=None, kwargs=None, timeout=None):
+    _require()
+    return fn(*(args or ()), **(kwargs or {}))
+
+
+class _Future:
+    def __init__(self, value):
+        self._v = value
+
+    def wait(self):
+        return self._v
+
+
+def rpc_async(to: str, fn: Callable, args=None, kwargs=None, timeout=None):
+    _require()
+    return _Future(fn(*(args or ()), **(kwargs or {})))
+
+
+def get_worker_info(name: str = None) -> WorkerInfo:
+    _require()
+    return WorkerInfo(name or _STATE["name"], 0)
+
+
+def get_all_worker_infos():
+    _require()
+    return [get_worker_info()]
+
+
+def shutdown():
+    _STATE["inited"] = False
+
+
+def _require():
+    if not _STATE["inited"]:
+        raise RuntimeError("call paddle_tpu.distributed.rpc.init_rpc first")

@@ -1,0 +1,76 @@
+"""group_sharded (ZeRO-2/3) API (reference:
+python/paddle/distributed/sharding/group_sharded.py:50
+``group_sharded_parallel`` + save_group_sharded_model; engines
+GroupShardedOptimizerStage2/GroupShardedStage2/GroupShardedStage3 under
+fleet/meta_parallel/sharding/).
+
+TPU-native: ZeRO stages are placement policies over the sharding mesh axis
+(SURVEY.md §7.1): os = optimizer states sharded; os_g adds gradients (under
+jit, grads of sharded states are sharded by propagation); p_g_os additionally
+shards the parameters.  The wrapper delegates to
+auto_parallel.shard_optimizer/shard_tensor so eager and semi-auto share one
+mechanism.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..auto_parallel.api import (ShardingStage1, ShardingStage2,
+                                 ShardingStage3, shard_optimizer)
+from ..auto_parallel.process_mesh import ProcessMesh, get_mesh, set_mesh
+
+
+def _sharding_mesh(group):
+    import numpy as np
+
+    from ..fleet.topology import get_hcg
+    hcg = get_hcg()
+    if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+        return None, "sharding"   # hybrid mesh: use its sharding axis
+    mesh = get_mesh()
+    if mesh is None:
+        from .. import env
+        n = group.nranks if group is not None else env.get_world_size()
+        mesh = ProcessMesh(np.arange(n), dim_names=["sharding"])
+        set_mesh(mesh)
+    ax = mesh.dim_names[0]
+    return mesh, ax
+
+
+def group_sharded_parallel(model, optimizer, level: str, scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """reference group_sharded.py:50 — level in {'os', 'os_g', 'p_g_os'}."""
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError("level must be one of 'os', 'os_g', 'p_g_os'")
+    mesh, ax = _sharding_mesh(group)
+    stage_cls = {"os": ShardingStage1, "os_g": ShardingStage2,
+                 "p_g_os": ShardingStage3}[level]
+    stage = stage_cls(ax, mesh=mesh)
+    if mesh is None:
+        from ..fleet.topology import get_hcg
+        # hybrid: shard over the hcg mesh's sharding axis
+        import numpy as np
+        hcg = get_hcg()
+        jmesh = hcg.global_mesh
+        pm = ProcessMesh(np.arange(jmesh.devices.size).reshape(jmesh.devices.shape),
+                         dim_names=list(jmesh.axis_names))
+        stage.mesh = pm
+    optimizer = shard_optimizer(optimizer, stage)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """reference group_sharded.py save_group_sharded_model."""
+    import os
+
+    from ...framework import io as fio
+    os.makedirs(output, exist_ok=True) if not output.endswith(".pdmodel") else None
+    fio.save(model.state_dict(), os.path.join(output, "model.pdmodel")
+             if os.path.isdir(output) else output)
+    if optimizer is not None:
+        fio.save(optimizer.state_dict(), os.path.join(output, "model.pdopt")
+                 if os.path.isdir(output) else output + ".pdopt")

@@ -1,0 +1,146 @@
+"""Collective hang/failure watchdog (reference: CommTaskManager —
+paddle/phi/core/distributed/comm_task_manager.h:37, background threads
+polling outstanding NCCL tasks for timeout/async error, dumping
+store-coordinated debug traces; SURVEY.md §5.3).
+
+TPU-native redesign: there is no NCCL async-error channel — hangs show up as
+a device computation that never completes.  The watchdog is a host-side
+monitor: work registers a heartbeat before blocking on device results; a
+background thread flags work that exceeds ``FLAGS_comm_timeout_s`` and dumps
+the live task table (the CommTask dump).  `barrier_timeout` wraps a
+collective barrier with a deadline, the multi-host failure-detection
+primitive used by elastic logic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .. import flags
+
+
+@dataclass
+class _Task:
+    name: str
+    started: float
+    stack: str = ""
+    done: bool = False
+
+
+class CommTaskManager:
+    """Singleton watchdog thread over outstanding device/collective work."""
+
+    def __init__(self):
+        self._tasks: Dict[int, _Task] = {}
+        self._lock = threading.Lock()
+        self._next = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.timed_out: list = []
+
+    def start(self):
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def begin(self, name: str) -> int:
+        with self._lock:
+            tid = self._next
+            self._next += 1
+            stack = "".join(traceback.format_stack(limit=8)) \
+                if flags.flag("enable_async_trace") else ""
+            self._tasks[tid] = _Task(name, time.time(), stack)
+            return tid
+
+    def end(self, tid: int):
+        with self._lock:
+            self._tasks.pop(tid, None)
+
+    def outstanding(self):
+        with self._lock:
+            return list(self._tasks.values())
+
+    def _loop(self):
+        while not self._stop.wait(1.0):
+            timeout = flags.flag("comm_timeout_s")
+            now = time.time()
+            with self._lock:
+                hung = [t for t in self._tasks.values()
+                        if now - t.started > timeout]
+            for t in hung:
+                self.timed_out.append(t)
+                self._dump(t, now)
+                with self._lock:
+                    self._tasks = {k: v for k, v in self._tasks.items()
+                                   if v is not t}
+
+    def _dump(self, task: _Task, now: float):
+        import sys
+        print(f"[paddle_tpu watchdog] task '{task.name}' exceeded "
+              f"{flags.flag('comm_timeout_s')}s (running {now - task.started:.1f}s)."
+              f" Outstanding tasks: {[t.name for t in self.outstanding()]}",
+              file=sys.stderr)
+        if task.stack:
+            print(task.stack, file=sys.stderr)
+
+
+_MANAGER: Optional[CommTaskManager] = None
+
+
+def get_comm_task_manager() -> CommTaskManager:
+    global _MANAGER
+    if _MANAGER is None:
+        _MANAGER = CommTaskManager().start()
+    return _MANAGER
+
+
+class watch:
+    """Context manager registering a named task with the watchdog."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self._tid = get_comm_task_manager().begin(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        get_comm_task_manager().end(self._tid)
+        return False
+
+
+def barrier_timeout(group=None, timeout_s: Optional[float] = None) -> bool:
+    """Barrier with deadline: True on success, False on timeout (the
+    peer-failure detection primitive; reference: store barrier + watchdog)."""
+    from .communication import barrier
+
+    timeout_s = timeout_s or flags.flag("comm_timeout_s")
+    result = {}
+
+    def run():
+        try:
+            barrier(group)
+            result["ok"] = True
+        except Exception as e:
+            result["err"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return False
+    if "err" in result:
+        raise result["err"]
+    return True

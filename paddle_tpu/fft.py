@@ -1,0 +1,60 @@
+"""paddle.fft (reference: python/paddle/fft.py) over jnp.fft."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops._prim import apply_op
+
+
+def _mk(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name_=None):
+        return apply_op(f"fft_{name}",
+                        lambda a: fn(a, n=n, axis=axis, norm=norm),
+                        (x if isinstance(x, Tensor) else Tensor(x),))
+    op.__name__ = name
+    return op
+
+
+def _mk_nd(name, fn):
+    def op(x, s=None, axes=None, norm="backward", name_=None):
+        return apply_op(f"fft_{name}",
+                        lambda a: fn(a, s=s, axes=axes, norm=norm),
+                        (x if isinstance(x, Tensor) else Tensor(x),))
+    op.__name__ = name
+    return op
+
+
+fft = _mk("fft", jnp.fft.fft)
+ifft = _mk("ifft", jnp.fft.ifft)
+rfft = _mk("rfft", jnp.fft.rfft)
+irfft = _mk("irfft", jnp.fft.irfft)
+hfft = _mk("hfft", jnp.fft.hfft)
+ihfft = _mk("ihfft", jnp.fft.ihfft)
+fft2 = _mk_nd("fft2", lambda a, s, axes, norm: jnp.fft.fft2(a, s=s, axes=axes or (-2, -1), norm=norm))
+ifft2 = _mk_nd("ifft2", lambda a, s, axes, norm: jnp.fft.ifft2(a, s=s, axes=axes or (-2, -1), norm=norm))
+rfft2 = _mk_nd("rfft2", lambda a, s, axes, norm: jnp.fft.rfft2(a, s=s, axes=axes or (-2, -1), norm=norm))
+irfft2 = _mk_nd("irfft2", lambda a, s, axes, norm: jnp.fft.irfft2(a, s=s, axes=axes or (-2, -1), norm=norm))
+fftn = _mk_nd("fftn", lambda a, s, axes, norm: jnp.fft.fftn(a, s=s, axes=axes, norm=norm))
+ifftn = _mk_nd("ifftn", lambda a, s, axes, norm: jnp.fft.ifftn(a, s=s, axes=axes, norm=norm))
+rfftn = _mk_nd("rfftn", lambda a, s, axes, norm: jnp.fft.rfftn(a, s=s, axes=axes, norm=norm))
+irfftn = _mk_nd("irfftn", lambda a, s, axes, norm: jnp.fft.irfftn(a, s=s, axes=axes, norm=norm))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fftshift", lambda a: jnp.fft.fftshift(a, axes),
+                    (x if isinstance(x, Tensor) else Tensor(x),))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes),
+                    (x if isinstance(x, Tensor) else Tensor(x),))

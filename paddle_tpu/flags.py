@@ -68,3 +68,9 @@ define_flag("allocator_strategy", "auto_growth", "Kept for API parity; XLA/PJRT 
 define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest.")
 define_flag("flash_attention_block_q", 512, "Pallas flash attention query block.")
 define_flag("flash_attention_block_kv", 512, "Pallas flash attention kv block.")
+define_flag("use_native_dataloader", False,
+            "Route DataLoader prefetch through the C++ ring-buffer engine "
+            "(native/ringbuf.cc). Off by default: with in-process thread "
+            "workers, reference passing beats slot serialization (measured "
+            "3.5x on 224x224 batches); the native engine is for feeder "
+            "processes / multi-host input pipelines.")

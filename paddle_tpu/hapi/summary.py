@@ -1,0 +1,62 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer summary; returns {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def register(layer, name):
+        def hook(l, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else "?"
+            n_params = sum(p.size for p in l._parameters.values() if p is not None)
+            rows.append((name or l.__class__.__name__, shape, n_params))
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, sub in net.named_sublayers():
+        register(sub, f"{sub.__class__.__name__}-{name}")
+
+    if input is not None:
+        x = input
+    else:
+        if input_size is None:
+            raise ValueError("either input or input_size is required")
+        sizes = input_size if isinstance(input_size, list) and \
+            isinstance(input_size[0], (list, tuple)) else [input_size]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else [dtypes] * len(sizes)
+        xs = []
+        for sz, dt in zip(sizes, dts):
+            sz = [1 if d is None or d == -1 else d for d in sz]
+            xs.append(Tensor(np.zeros(sz, dtype=np.dtype(dt or "float32"))))
+        x = xs if len(xs) > 1 else xs[0]
+
+    was_training = net.training
+    net.eval()
+    try:
+        net(*x) if isinstance(x, list) else net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if p.trainable)
+
+    w = max([len(r[0]) for r in rows] + [20])
+    print(f"{'Layer (type)':<{w}} {'Output Shape':<24} {'Param #':>12}")
+    print("=" * (w + 38))
+    for name, shape, n in rows:
+        print(f"{name:<{w}} {str(shape):<24} {n:>12,}")
+    print("=" * (w + 38))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

@@ -452,6 +452,7 @@ class DataLoader:
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = max(0, int(num_workers))
+        self.use_shared_memory = use_shared_memory
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
@@ -480,6 +481,12 @@ class DataLoader:
         if self._iterable:
             return _IterableIterator(self)
         if self.num_workers > 0:
+            from .. import flags
+            if flags.flag("use_native_dataloader"):
+                from .native_loader import (_NativePrefetchIterator,
+                                            native_available)
+                if native_available():
+                    return _NativePrefetchIterator(self, self.num_workers)
             return _PrefetchIterator(self, self.num_workers)
         return _MapIterator(self)
 

@@ -1,0 +1,294 @@
+"""Native-backed DataLoader prefetch path (reference: the C++ data-loader
+side of paddle/fluid/imperative + shared-memory queue of
+python/paddle/io/dataloader/worker.py when use_shared_memory=True).
+
+Worker threads collate batches and serialize them into fixed-size slots of a
+C++ ring buffer (native/ringbuf.cc); the consumer deserializes zero-copy
+views and re-orders by batch index.  ctypes calls release the GIL, so slot
+waits and memcpy overlap Python decode and JAX dispatch — the same overlap
+the reference gets from its C++ worker pool.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import List
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..native import RingBuffer, load_library
+
+_DTYPES = [np.dtype(x) for x in
+           ("float32", "float64", "float16", "bfloat16", "int8", "int16",
+            "int32", "int64", "uint8", "bool")]
+_DTYPE_CODE = {dt: i for i, dt in enumerate(_DTYPES)}
+_OVERFLOW = 0xFFFFFFFF
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def _flatten_batch(batch) -> List[np.ndarray]:
+    if isinstance(batch, (list, tuple)):
+        out = []
+        for b in batch:
+            out.extend(_flatten_batch(b))
+        return out
+    if isinstance(batch, Tensor):
+        return [np.asarray(batch._data)]
+    return [np.asarray(batch)]
+
+
+def _batch_spec(batch):
+    """Container skeleton used to rebuild the batch from flat arrays."""
+    if isinstance(batch, (list, tuple)):
+        return ("L" if isinstance(batch, list) else "U",
+                [_batch_spec(b) for b in batch])
+    return ("T", None)
+
+
+def _rebuild(spec, arrays, pos=[0]):
+    kind, payload = spec
+    if kind == "T":
+        arr = arrays[pos[0]]
+        pos[0] += 1
+        return Tensor(arr)
+    vals = [_rebuild(s, arrays, pos) for s in payload]
+    return vals if kind == "L" else tuple(vals)
+
+
+def _serialized_size(arrays: List[np.ndarray]) -> int:
+    n = 12  # batch idx + n_fields
+    for a in arrays:
+        n += 2 + 8 * a.ndim + 8 + a.nbytes
+    return n
+
+
+def _write_slot(view: np.ndarray, batch_idx: int, arrays: List[np.ndarray]):
+    off = 0
+
+    def put(fmt, *vals):
+        nonlocal off
+        b = struct.pack(fmt, *vals)
+        view[off:off + len(b)] = np.frombuffer(b, np.uint8)
+        off += len(b)
+
+    put("<qI", batch_idx, len(arrays))
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        code = _DTYPE_CODE.get(a.dtype)
+        if code is None:
+            raise TypeError(f"unsupported dtype {a.dtype} for native loader")
+        put("<BB", code, a.ndim)
+        for d in a.shape:
+            put("<q", d)
+        put("<q", a.nbytes)
+        raw = a.view(np.uint8).reshape(-1)
+        view[off:off + a.nbytes] = raw
+        off += a.nbytes
+    return off
+
+
+def _read_slot(view: np.ndarray):
+    off = 0
+
+    def take(fmt):
+        nonlocal off
+        n = struct.calcsize(fmt)
+        vals = struct.unpack(fmt, view[off:off + n].tobytes())
+        off += n
+        return vals
+
+    batch_idx, n_fields = take("<qI")
+    arrays = []
+    for _ in range(n_fields):
+        code, ndim = take("<BB")
+        shape = tuple(take("<q")[0] for _ in range(ndim))
+        (nbytes,) = take("<q")
+        dt = _DTYPES[code]
+        arr = np.frombuffer(view[off:off + nbytes].tobytes(), dtype=dt)
+        arrays.append(arr.reshape(shape))
+        off += nbytes
+    return batch_idx, arrays
+
+
+class _NativePrefetchIterator:
+    """User-facing iterator handle.
+
+    Worker threads strongly reference the separate ``_NativeImpl``;
+    ``weakref.finalize`` on this front object closes the impl when the user
+    abandons the iterator mid-epoch, so threads and the ring buffer are
+    reclaimed deterministically.
+    """
+
+    def __init__(self, loader, num_workers):
+        import weakref
+        self._impl = _NativeImpl(loader, num_workers)
+        self._fin = weakref.finalize(self, _NativeImpl.close, self._impl)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._impl)
+
+    def close(self):
+        self._fin()
+
+
+def _work_entry(impl, wid, num_workers):
+    while impl._work_quantum(wid, num_workers):
+        pass
+
+
+class _NativeImpl:
+    """Order-preserving MPMC prefetch over the native ring buffer.
+
+    Backpressure: workers do not *start* batch i until
+    ``i < next_idx + inflight_window``, so even with one slow straggler the
+    re-order buffer (`pending`) holds at most `inflight_window` batches.
+    """
+
+    def __init__(self, loader, num_workers):
+        from . import WorkerInfo, _worker_tls
+
+        self.loader = loader
+        self.batches = list(iter(loader.batch_sampler))
+        self.next_idx = 0
+        self.pending = {}        # out-of-order batches awaiting their turn
+        self.overflow = {}       # batches too big for a slot (python path)
+        self.spec = None
+        self.errors: List[BaseException] = []
+        self.shutdown = False
+        self.rb = None
+        self._rb_lock = threading.Lock()
+        self.n_slots = max(2 * num_workers, 4)
+        self.inflight_window = max(4 * num_workers, 2 * self.n_slots)
+        self.task_iter = iter(enumerate(self.batches))
+        self.task_lock = threading.Lock()
+        self._worker_tls = _worker_tls
+        self._WorkerInfo = WorkerInfo
+        self._inited = [False] * num_workers
+        self._cur = [None] * num_workers
+        self.workers = [
+            threading.Thread(target=_work_entry, args=(self, w, num_workers),
+                             daemon=True)
+            for w in range(num_workers)]
+        for w in self.workers:
+            w.start()
+
+    def _ensure_rb(self, nbytes: int):
+        with self._rb_lock:
+            if self.rb is None:
+                slot = max(2 * nbytes + 4096, 1 << 16)
+                self.rb = RingBuffer(slot, self.n_slots)
+            return self.rb
+
+    def _work_quantum(self, wid, num_workers) -> bool:
+        """Advance this worker by one bounded step (<= ~200ms).
+
+        Returns False when the worker should exit.  State that must survive
+        between quanta (the current task / its serialized payload) lives in
+        ``self._cur[wid]`` so the caller holds no strong reference while
+        waiting on backpressure or a free slot.
+        """
+        import time
+
+        if not self._inited[wid]:
+            self._inited[wid] = True
+            self._worker_tls.info = self._WorkerInfo(
+                wid, num_workers, self.loader.dataset, wid)
+            if self.loader.worker_init_fn is not None:
+                self.loader.worker_init_fn(wid)
+        if self.shutdown:
+            return False
+        state = self._cur[wid]
+        try:
+            if state is None:
+                with self.task_lock:
+                    task = next(self.task_iter, None)
+                if task is None:
+                    return False
+                self._cur[wid] = state = {"task": task, "arrays": None}
+            i, indices = state["task"]
+            if state["arrays"] is None:
+                # backpressure: don't start far-ahead batches (bounded wait)
+                deadline = time.time() + 0.2
+                while i >= self.next_idx + self.inflight_window:
+                    if self.shutdown:
+                        return False
+                    if time.time() > deadline:
+                        return True  # retry next quantum
+                    time.sleep(0.002)
+                samples = [self.loader.dataset[j] for j in indices]
+                batch = self.loader.collate_fn(samples)
+                state["arrays"] = _flatten_batch(batch)
+                if self.spec is None:
+                    self.spec = _batch_spec(batch)
+            arrays = state["arrays"]
+            size = _serialized_size(arrays)
+            rb = self._ensure_rb(size)
+            slot = rb.acquire_write(timeout_ms=200)
+            if slot < 0:
+                return not self.shutdown  # retry next quantum
+            if size > rb.slot_bytes:
+                self.overflow[i] = arrays
+                view = rb.slot_view(slot)
+                view[0:12] = np.frombuffer(
+                    struct.pack("<qI", i, _OVERFLOW), np.uint8)
+                rb.commit_write(slot, 12)
+            else:
+                used = _write_slot(rb.slot_view(slot), i, arrays)
+                rb.commit_write(slot, used)
+            self._cur[wid] = None
+            return True
+        except BaseException as e:
+            self.errors.append(e)
+            if self.rb is not None:
+                self.rb.close()
+            return False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.next_idx >= len(self.batches):
+            self.close()
+            raise StopIteration
+        while self.next_idx not in self.pending:
+            if self.errors:
+                raise self.errors[0]
+            rb = self.rb
+            if rb is None:
+                import time
+                time.sleep(0.001)
+                continue
+            slot = rb.acquire_read(timeout_ms=200)
+            if slot < 0:
+                continue
+            used = rb.slot_bytes_used(slot)
+            view = rb.slot_view(slot, used)
+            bidx, nf = struct.unpack("<qI", view[0:12].tobytes())
+            if nf == _OVERFLOW:
+                arrays = self.overflow.pop(bidx)
+            else:
+                bidx, arrays = _read_slot(view)
+            rb.release_read(slot)
+            self.pending[bidx] = arrays
+        arrays = self.pending.pop(self.next_idx)
+        self.next_idx += 1
+        return _rebuild(self.spec, arrays, pos=[0])
+
+    def close(self):
+        self.shutdown = True
+        if self.rb is not None:
+            self.rb.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

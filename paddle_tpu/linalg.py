@@ -1,0 +1,14 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py re-exports
+from tensor/linalg.py)."""
+
+import inspect as _inspect
+
+from .ops import linalg as _l
+
+__all__ = [n for n, obj in vars(_l).items()
+           if not n.startswith("_") and _inspect.isfunction(obj)
+           and obj.__module__ == _l.__name__]
+
+for _n in __all__:
+    globals()[_n] = getattr(_l, _n)
+del _inspect, _l, _n

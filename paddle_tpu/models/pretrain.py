@@ -138,7 +138,18 @@ class PretrainStep:
 
         def moment_like(p):
             m = jnp.zeros(p.shape, jnp.float32)
-            return jax.device_put(m, p.sharding)
+            sh_ = p.sharding
+            if self.pc.zero1 and self.pc.dp > 1 and isinstance(sh_, NamedSharding):
+                # ZeRO-1: shard fp32 moments over the (otherwise replicated)
+                # dp axis along the first divisible unsharded dim
+                spec = list(sh_.spec) + [None] * (len(p.shape) - len(sh_.spec))
+                for d, entry in enumerate(spec):
+                    if entry is None and p.shape[d] % self.pc.dp == 0 and \
+                            p.shape[d] > 0:
+                        spec[d] = "dp"
+                        sh_ = NamedSharding(self.mesh, P(*spec))
+                        break
+            return jax.device_put(m, sh_)
 
         state = {
             "params": params,

@@ -1,0 +1,156 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference implements its data-loader core, executors and allocators in
+C++ (SURVEY.md §2.1/§2.10); on TPU the compute/runtime side belongs to
+XLA/PJRT, so the native layer here covers what actually remains host-side:
+the data-pipeline hot path (ring-buffer batch handoff + row gather).
+
+Build model: compiled on demand with g++ into ``paddle_tpu/native/build/``
+(no pybind11 — plain C ABI + ctypes), cached by source mtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "build")
+_LOCK = threading.Lock()
+_LIB = [None, False]  # lib handle, attempted
+
+
+def _compile(src: str, out: str) -> bool:
+    os.makedirs(_BUILD, exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           src, "-o", out]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def load_library():
+    """The native library, or None when no toolchain is available (every
+    consumer must keep a pure-python fallback)."""
+    with _LOCK:
+        if _LIB[1]:
+            return _LIB[0]
+        _LIB[1] = True
+        src = os.path.join(_DIR, "ringbuf.cc")
+        out = os.path.join(_BUILD, "libpaddle_tpu_native.so")
+        # staleness by source hash (mtimes are unreliable after checkout)
+        import hashlib
+        with open(src, "rb") as f:
+            src_hash = hashlib.sha256(f.read()).hexdigest()
+        stamp = out + ".srchash"
+        stale = True
+        if os.path.exists(out) and os.path.exists(stamp):
+            with open(stamp) as f:
+                stale = f.read().strip() != src_hash
+        if stale:
+            if not _compile(src, out):
+                return None
+            with open(stamp, "w") as f:
+                f.write(src_hash)
+        try:
+            lib = ctypes.CDLL(out)
+        except OSError:
+            return None
+        lib.rb_create.restype = ctypes.c_void_p
+        lib.rb_create.argtypes = [ctypes.c_size_t, ctypes.c_int]
+        lib.rb_acquire_write.restype = ctypes.c_int
+        lib.rb_acquire_write.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rb_commit_write.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.c_size_t]
+        lib.rb_acquire_read.restype = ctypes.c_int
+        lib.rb_acquire_read.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rb_release_read.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rb_slot_ptr.restype = ctypes.c_void_p
+        lib.rb_slot_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rb_slot_bytes.restype = ctypes.c_size_t
+        lib.rb_slot_bytes.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rb_slot_capacity.restype = ctypes.c_size_t
+        lib.rb_slot_capacity.argtypes = [ctypes.c_void_p]
+        lib.rb_ready_count.restype = ctypes.c_int
+        lib.rb_ready_count.argtypes = [ctypes.c_void_p]
+        lib.rb_close.argtypes = [ctypes.c_void_p]
+        lib.rb_destroy.argtypes = [ctypes.c_void_p]
+        lib.rb_gather_rows.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_size_t]
+        _LIB[0] = lib
+        return lib
+
+
+class RingBuffer:
+    """MPMC slot ring over the native lib (see ringbuf.cc)."""
+
+    def __init__(self, slot_bytes: int, n_slots: int):
+        self._lib = load_library()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.rb_create(slot_bytes, n_slots)
+        if not self._h:
+            raise MemoryError("ring buffer allocation failed")
+        self.slot_bytes = slot_bytes
+        self.n_slots = n_slots
+
+    def acquire_write(self, timeout_ms: int = -1) -> int:
+        return self._lib.rb_acquire_write(self._h, timeout_ms)
+
+    def commit_write(self, slot: int, nbytes: int):
+        self._lib.rb_commit_write(self._h, slot, nbytes)
+
+    def acquire_read(self, timeout_ms: int = -1) -> int:
+        return self._lib.rb_acquire_read(self._h, timeout_ms)
+
+    def release_read(self, slot: int):
+        self._lib.rb_release_read(self._h, slot)
+
+    def slot_view(self, slot: int, nbytes: int = None):
+        import numpy as np
+        ptr = self._lib.rb_slot_ptr(self._h, slot)
+        n = self.slot_bytes if nbytes is None else nbytes
+        return np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)), (n,))
+
+    def slot_bytes_used(self, slot: int) -> int:
+        return self._lib.rb_slot_bytes(self._h, slot)
+
+    def ready_count(self) -> int:
+        return self._lib.rb_ready_count(self._h)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.rb_close(self._h)
+
+    def destroy(self):
+        if getattr(self, "_h", None):
+            self._lib.rb_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
+def gather_rows(dst, src, idx):
+    """C++ row gather: dst[i] = src[idx[i]] (2-D contiguous arrays)."""
+    import numpy as np
+    lib = load_library()
+    assert lib is not None
+    assert dst.flags["C_CONTIGUOUS"] and src.flags["C_CONTIGUOUS"]
+    idx64 = np.ascontiguousarray(idx, dtype=np.int64)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:]))
+    lib.rb_gather_rows(
+        dst.ctypes.data_as(ctypes.c_char_p),
+        src.ctypes.data_as(ctypes.c_char_p),
+        idx64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(idx64), row_bytes)
+    return dst

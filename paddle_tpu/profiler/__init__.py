@@ -1,0 +1,239 @@
+"""paddle.profiler (reference: python/paddle/profiler/profiler.py —
+Profiler with states/targets/scheduler windows, RecordEvent spans,
+profiler_statistic summary tables, timer.py throughput benchmark).
+
+TPU-native engine: jax.profiler (XPlane/perfetto traces, the CUPTI+chrome
+slot — SURVEY.md §5.1) for device timelines, plus a host-side RecordEvent
+aggregator that powers ``summary()`` without any device hooks.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class TracerEventType(Enum):
+    Operator = 0
+    Dataloader = 1
+    ProfileStep = 2
+    Forward = 3
+    Backward = 4
+    Optimization = 5
+    Communication = 6
+    PythonOp = 7
+    UserDefined = 8
+
+
+_HOST_EVENTS = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+_ACTIVE = []
+
+
+class RecordEvent:
+    """Host span recorder (reference: paddle.profiler.RecordEvent; C++
+    platform/profiler RecordEvent)."""
+
+    def __init__(self, name: str, event_type=TracerEventType.UserDefined):
+        self.name = name
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+
+    def end(self):
+        if self._t0 is not None:
+            ev = _HOST_EVENTS[self.name]
+            ev[0] += 1
+            ev[1] += time.perf_counter() - self._t0
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """reference profiler.py make_scheduler — step-windowed states."""
+    period = closed + ready + record
+
+    def fn(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return fn
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof):
+        prof._trace_dir = dir_name
+    return handler
+
+
+class Profiler:
+    """reference profiler.py Profiler."""
+
+    def __init__(self, targets: Optional[Iterable] = None, scheduler=None,
+                 on_trace_ready=None, timer_only: bool = False, record_shapes=False,
+                 profile_memory=False, with_flops=False):
+        self.timer_only = timer_only
+        self._scheduler = scheduler if callable(scheduler) else (
+            # (start, end) tuple = ONE capture window (reference semantics)
+            make_scheduler(closed=0, ready=0, record=scheduler[1] - scheduler[0],
+                           repeat=1, skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else None)
+        self._on_trace_ready = on_trace_ready
+        self._trace_dir = None
+        self._step = 0
+        self._jax_active = False
+        self._step_times = []
+        self._last_step_t = None
+
+    # -- lifecycle --
+    def _start_trace(self):
+        if self._jax_active or self.timer_only:
+            return
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+        if self._trace_dir is None:
+            import tempfile
+            self._trace_dir = tempfile.mkdtemp(prefix="paddle_tpu_prof_")
+        try:
+            import jax
+            jax.profiler.start_trace(self._trace_dir)
+            self._jax_active = True
+        except Exception:
+            self._jax_active = False
+
+    def _stop_trace(self):
+        if self._jax_active:
+            import jax
+            jax.profiler.stop_trace()
+            self._jax_active = False
+
+    def start(self):
+        _HOST_EVENTS.clear()
+        self._last_step_t = time.perf_counter()
+        # with a scheduler, tracing starts/stops around RECORD windows in
+        # step(); without one the whole start()-stop() span is traced
+        if self._scheduler is None:
+            self._start_trace()
+        elif self._scheduler(0) in (ProfilerState.RECORD,
+                                    ProfilerState.RECORD_AND_RETURN):
+            self._start_trace()
+        _ACTIVE.append(self)
+        return self
+
+    def stop(self):
+        self._stop_trace()
+        if self in _ACTIVE:
+            _ACTIVE.remove(self)
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append((now - self._last_step_t, num_samples))
+        self._last_step_t = now
+        self._step += 1
+        if self._scheduler is not None:
+            recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+            prev = self._scheduler(self._step - 1)
+            cur = self._scheduler(self._step)
+            if cur in recording and not self._jax_active:
+                self._start_trace()
+            elif cur not in recording and self._jax_active:
+                self._stop_trace()
+            elif prev == ProfilerState.RECORD_AND_RETURN and \
+                    cur in recording and self._jax_active:
+                pass  # contiguous windows keep one trace
+
+    def step_info(self, unit=None) -> str:
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+        ts = np.array([t for t, _ in self._step_times[-100:]])
+        ips = ""
+        samples = [n for _, n in self._step_times[-100:] if n]
+        if samples:
+            ips = f" ips: {np.sum(samples) / ts.sum():.2f} samples/s"
+        return (f"step latency avg {ts.mean() * 1000:.2f} ms, "
+                f"min {ts.min() * 1000:.2f} ms, max {ts.max() * 1000:.2f} ms"
+                + ips)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Host-span summary table (the profiler_statistic.py slot)."""
+        rows = sorted(_HOST_EVENTS.items(), key=lambda kv: -kv[1][1])
+        width = max([len(k) for k, _ in rows] + [16])
+        print(f"{'Name':<{width}} {'Calls':>8} {'Total(ms)':>12} {'Avg(ms)':>12}")
+        print("-" * (width + 36))
+        for name, (count, total) in rows:
+            print(f"{name:<{width}} {count:>8} {total * 1000:>12.3f} "
+                  f"{total * 1000 / max(count, 1):>12.3f}")
+        if self._trace_dir:
+            print(f"\nDevice trace (XPlane/perfetto): {self._trace_dir}")
+        return rows
+
+    def export(self, path: str, format: str = "json"):
+        """Copy the captured trace to ``path`` (call after stop())."""
+        if self._jax_active:
+            raise RuntimeError("export() must be called after stop()")
+        if self._trace_dir and self._trace_dir != path:
+            import shutil
+            shutil.copytree(self._trace_dir, path, dirs_exist_ok=True)
+        else:
+            self._trace_dir = path
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+@contextlib.contextmanager
+def profile(**kwargs):
+    p = Profiler(**kwargs)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()
+
+
+def load_profiler_result(path: str):
+    raise NotImplementedError("load the XPlane trace with tensorboard/xprof")

@@ -1,0 +1,78 @@
+"""paddle.quantization (reference: python/paddle/quantization/ — QAT/PTQ
+config + quanters).
+
+Round-1 surface: fake-quant simulation ops (per-tensor/per-channel abs-max)
+usable for QAT experiments; the full pass-driven PTQ pipeline is deferred.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..ops._prim import apply_op
+
+
+def fake_quantize_abs_max(x, bits: int = 8):
+    """Simulated quantization with straight-through estimator."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def prim(v):
+        import jax
+        scale = jnp.maximum(jnp.max(jnp.abs(v)) / qmax, 1e-8)
+        q = jnp.round(v / scale) * scale
+        # straight-through estimator: identity gradient
+        return v + jax.lax.stop_gradient(q - v)
+
+    return apply_op("fake_quantize_abs_max", prim,
+                    (x if isinstance(x, Tensor) else Tensor(x),))
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_configs = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        self._layer_configs[id(layer)] = (activation, weight)
+
+
+class FakeQuanterWithAbsMax(Layer):
+    def __init__(self, bits=8, **kw):
+        super().__init__()
+        self.bits = bits
+
+    def forward(self, x):
+        return fake_quantize_abs_max(x, self.bits)
+
+
+class QAT:
+    """reference quantization/qat.py — wrap a model's linear/conv layers
+    with fake quanters."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        from .. import nn
+
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, (nn.Linear,)):
+                quanter = FakeQuanterWithAbsMax()
+                orig_forward = sub.forward
+
+                def wrapped(x, _f=orig_forward, _q=quanter):
+                    return _f(_q(x))
+
+                sub.forward = wrapped
+        return model
+
+
+class PTQ:
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        raise NotImplementedError("PTQ calibration pipeline: future round")

@@ -1,0 +1,103 @@
+"""paddle.sparse (reference: python/paddle/sparse/ — SparseCooTensor/
+SparseCsrTensor creation + ops; C++ paddle/phi/core/sparse_coo_tensor.h).
+
+TPU-native engine: jax.experimental.sparse BCOO (XLA-compiled sparse ops).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..ops._prim import apply_op
+
+
+class SparseCooTensor:
+    """Sparse COO tensor over a BCOO payload (dense mirror only materialized
+    by to_dense)."""
+
+    def __init__(self, bcoo, name=None):
+        self._bcoo = bcoo
+        self.name = name or "sparse_coo"
+        self.stop_gradient = True
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._bcoo.dtype)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T)
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def is_sparse_coo(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype.name})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCooTensor:
+    """reference: python/paddle/sparse/creation.py sparse_coo_tensor.
+
+    indices: [ndim, nnz]; values: [nnz, ...].
+    """
+    idx = np.asarray(indices.numpy() if isinstance(indices, Tensor) else indices)
+    val = jnp.asarray(values.numpy() if isinstance(values, Tensor) else values,
+                      dtype=dtype)
+    if shape is None:
+        shape = tuple(int(i.max()) + 1 for i in idx)
+    bcoo = jsparse.BCOO((val, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def to_dense(x):
+    return x.to_dense() if isinstance(x, SparseCooTensor) else x
+
+
+def _dense_to_coo(x: Tensor, n_batch=0) -> SparseCooTensor:
+    return SparseCooTensor(jsparse.BCOO.fromdense(x._data, n_batch=n_batch))
+
+
+def matmul(x, y):
+    """sparse @ dense (reference sparse/binary.py matmul)."""
+    if isinstance(x, SparseCooTensor):
+        yb = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        return Tensor(x._bcoo @ yb)
+    raise TypeError("sparse.matmul expects a SparseCooTensor lhs")
+
+
+def add(x, y):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return SparseCooTensor(jsparse.bcoo_add_(x._bcoo, y._bcoo)
+                               if hasattr(jsparse, "bcoo_add_")
+                               else jsparse.BCOO.fromdense(
+                                   x._bcoo.todense() + y._bcoo.todense()))
+    raise TypeError("sparse.add expects SparseCooTensors")
+
+
+def relu(x: SparseCooTensor) -> SparseCooTensor:
+    import jax
+    b = x._bcoo
+    return SparseCooTensor(jsparse.BCOO((jax.nn.relu(b.data), b.indices),
+                                        shape=b.shape))
+
+
+# API-parity namespaces
+class nn:
+    pass

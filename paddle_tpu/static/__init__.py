@@ -1,0 +1,42 @@
+"""paddle.static compatibility surface (reference: python/paddle/static/).
+
+The legacy ProgramDesc static-graph mode is not ported (SURVEY.md §7.5);
+this module keeps the names that remain meaningful under the XLA
+compilation model: InputSpec, save/load_inference_model (jit.save/load),
+and informative errors for the rest.
+"""
+
+from __future__ import annotations
+
+from ..jit import InputSpec  # noqa: F401
+
+
+def _no_static(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"paddle_tpu has no legacy static-graph {name}; use "
+            "paddle_tpu.jit.to_static (XLA whole-program compilation) instead")
+    fn.__name__ = name
+    return fn
+
+
+Program = _no_static("Program")
+program_guard = _no_static("program_guard")
+Executor = _no_static("Executor")
+default_main_program = _no_static("default_main_program")
+default_startup_program = _no_static("default_startup_program")
+data = _no_static("data")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.save(to_static(fn), path) — StableHLO export")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError("use paddle_tpu.jit.load(path)")
+
+
+class amp:
+    """paddle.static.amp parity shim."""

@@ -1,0 +1,76 @@
+"""paddle.text (reference: python/paddle/text/ — dataset loaders).
+
+Zero-egress environment: dataset classes require local files; `viterbi_decode`
+(the one algorithmic API) is implemented.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._prim import apply_op
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """reference: python/paddle/text/viterbi_decode.py (CRF decoding).
+
+    ``lengths`` masks padded timesteps: past a sequence's length the score is
+    frozen and backpointers are identity, so the returned path repeats the
+    last valid tag over the padding.
+    """
+    import jax
+
+    t = potentials if isinstance(potentials, Tensor) else Tensor(potentials)
+    tr = transition_params if isinstance(transition_params, Tensor) \
+        else Tensor(transition_params)
+    T = t.shape[1]
+    if lengths is None:
+        lens_arr = None
+    else:
+        lens_arr = (lengths._data if isinstance(lengths, Tensor)
+                    else jnp.asarray(lengths)).astype(jnp.int32)
+
+    def prim(pot, trans):
+        # pot: [B, T, N]; trans: [N, N]
+        N = pot.shape[-1]
+        identity = jnp.arange(N, dtype=jnp.int32)[None, :]
+
+        def step(carry, inp):
+            score = carry
+            emit, tstep = inp                              # emission at time t
+            cand = score[:, :, None] + trans[None]         # [B, prev, cur]
+            best = cand.max(axis=1) + emit
+            idx = cand.argmax(axis=1).astype(jnp.int32)
+            if lens_arr is not None:
+                active = (tstep < lens_arr)[:, None]
+                best = jnp.where(active, best, score)
+                idx = jnp.where(active, idx, identity)
+            return best, idx
+
+        init = pot[:, 0]
+        ts = jnp.arange(1, T, dtype=jnp.int32)
+        ts_b = jnp.broadcast_to(ts[:, None], (T - 1, pot.shape[0]))
+        final, backs = jax.lax.scan(step, init,
+                                    (jnp.swapaxes(pot, 0, 1)[1:], ts_b))
+        best_last = final.argmax(-1).astype(jnp.int32)
+
+        def backtrack(carry, bp):
+            prev = jnp.take_along_axis(bp, carry[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        _, path = jax.lax.scan(backtrack, best_last, backs, reverse=True)
+        path = jnp.concatenate([jnp.swapaxes(path, 0, 1),
+                                best_last[:, None]], axis=1)
+        return final.max(-1), path.astype(jnp.int64)
+
+    return apply_op("viterbi_decode", prim, (t, tr))
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths)

@@ -1,0 +1,13 @@
+"""paddle.vision (reference: python/paddle/vision/)."""
+
+from . import datasets, models, transforms  # noqa: F401
+from .models import *  # noqa: F401,F403
+
+
+def set_image_backend(backend):
+    if backend not in ("pil", "cv2", "tensor", "numpy"):
+        raise ValueError(f"unknown backend {backend}")
+
+
+def get_image_backend():
+    return "numpy"

@@ -1,0 +1,107 @@
+"""paddle.vision.datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: dataset classes load from local files
+(`data_file=`); `FakeData` provides synthetic samples for pipelines/tests.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic image dataset (torchvision-style; for tests/benchmarks)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.default_rng(seed)
+        self._seed = seed
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self._seed + idx)
+        img = rng.standard_normal(self.image_shape).astype("float32")
+        label = np.int64(rng.integers(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(Dataset):
+    """reference datasets/mnist.py — requires local idx/gz files
+    (no download in this environment)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download and (image_path is None or label_path is None):
+            raise RuntimeError("zero-egress environment: pass local "
+                               "image_path/label_path (idx[.gz] files)")
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            return np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), dtype=np.uint8)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+
+class Cifar10(Dataset):
+    """reference datasets/cifar.py — requires the local python-version tarball
+    extracted; pass ``data_path`` to the directory of data_batch_* files."""
+
+    def __init__(self, data_path=None, mode="train", transform=None,
+                 download=False, backend=None):
+        import pickle
+        if data_path is None:
+            raise RuntimeError("zero-egress environment: pass data_path")
+        files = ([f"data_batch_{i}" for i in range(1, 6)]
+                 if mode == "train" else ["test_batch"])
+        xs, ys = [], []
+        for fn in files:
+            with open(os.path.join(data_path, fn), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.extend(d[b"labels"])
+        self.data = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, dtype=np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, self.labels[idx]

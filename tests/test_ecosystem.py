@@ -1,0 +1,194 @@
+"""Ecosystem-layer tests: hapi Model, metric, vision, fft, distribution,
+sparse, profiler, text, quantization (SURVEY.md §2.8/§2.11 surfaces)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_hapi_fit_eval_predict(rng, tmp_path):
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.io import Dataset
+
+    W = rng.standard_normal((8, 3)).astype(np.float32)
+
+    class DS(Dataset):
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            r = np.random.default_rng(i)
+            x = r.standard_normal(8).astype(np.float32)
+            return x, np.int64((x @ W).argmax())
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 3))
+    model = paddle.Model(net)
+    model.prepare(optimizer=opt.Adam(5e-3, parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss(),
+                  metrics=paddle.metric.Accuracy())
+    hist = model.fit(DS(), epochs=4, batch_size=16, verbose=0)
+    assert len(hist) == 4
+    ev = model.evaluate(DS(), batch_size=16, verbose=0)
+    assert ev["eval_acc"] > 0.6
+    preds = model.predict(DS(), batch_size=16, stack_outputs=True)
+    assert preds[0].shape == [64, 3]
+    model.save(str(tmp_path / "ck"))
+    model.load(str(tmp_path / "ck"))
+
+
+def test_hapi_early_stopping(rng):
+    from paddle_tpu.hapi.callbacks import EarlyStopping
+
+    es = EarlyStopping(monitor="eval_loss", patience=1, mode="min")
+
+    class M:
+        stop_training = False
+
+    es.set_model(M())
+    es.on_eval_end({"eval_loss": 1.0})
+    es.on_eval_end({"eval_loss": 1.5})
+    es.on_eval_end({"eval_loss": 1.6})
+    assert es.model.stop_training
+
+
+def test_metric_accuracy():
+    m = paddle.metric.Accuracy(topk=(1, 2))
+    pred = paddle.to_tensor([[0.1, 0.7, 0.2], [0.8, 0.1, 0.1]])
+    label = paddle.to_tensor([[1], [2]])
+    m.update(m.compute(pred, label))
+    top1, top2 = m.accumulate()
+    assert top1 == 0.5 and top2 == 0.5
+
+
+def test_metric_auc():
+    auc = paddle.metric.Auc()
+    preds = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4], [0.3, 0.7]])
+    labels = np.array([0, 1, 0, 1])
+    auc.update(preds, labels)
+    assert auc.accumulate() == 1.0
+
+
+def test_resnet_train_step(rng):
+    import paddle_tpu.optimizer as opt
+
+    paddle.seed(0)
+    net = paddle.vision.models.resnet18(num_classes=4)
+    o = opt.Momentum(0.01, parameters=net.parameters())
+    x = paddle.to_tensor(rng.standard_normal((2, 3, 32, 32)).astype(np.float32))
+    y = paddle.to_tensor(np.array([0, 3]))
+    logits = net(x)
+    assert logits.shape == [2, 4]
+    loss = nn.CrossEntropyLoss()(logits, y)
+    loss.backward()
+    o.step()
+    assert all(p.grad is not None for p in net.parameters() if p.trainable)
+
+
+def test_vision_transforms(rng):
+    from paddle_tpu.vision import transforms as T
+
+    img = (rng.random((40, 48, 3)) * 255).astype("uint8")
+    out = T.Compose([T.Resize(32), T.CenterCrop(28), T.ToTensor(),
+                     T.Normalize([0.5] * 3, [0.5] * 3)])(img)
+    assert out.shape == [3, 28, 28]
+    assert float(out.numpy().max()) <= 1.0
+
+
+def test_fake_data_loader():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.vision.datasets import FakeData
+
+    ds = FakeData(size=10, image_shape=(3, 8, 8), num_classes=5)
+    batches = list(DataLoader(ds, batch_size=4))
+    assert batches[0][0].shape == [4, 3, 8, 8]
+    # deterministic per index
+    np.testing.assert_array_equal(ds[3][0], ds[3][0])
+
+
+def test_fft_grad(rng):
+    x = paddle.to_tensor(rng.standard_normal(16).astype(np.float32),
+                         stop_gradient=False)
+    y = paddle.fft.fft(x)
+    np.testing.assert_allclose(np.asarray(y.numpy()), np.fft.fft(x.numpy()),
+                               rtol=1e-4)
+    mag = (y * y.conj()).real() if hasattr(y, "conj") else None
+    z = paddle.fft.ifft(y)
+    np.testing.assert_allclose(np.asarray(z.numpy()).real, x.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_distributions(rng):
+    from paddle_tpu.distribution import (Bernoulli, Categorical, Normal,
+                                         Uniform, kl_divergence)
+
+    paddle.seed(0)
+    n = Normal(0.0, 1.0)
+    s = n.sample([2000])
+    assert abs(float(np.mean(s.numpy()))) < 0.1
+    np.testing.assert_allclose(float(n.entropy().item()),
+                               0.5 * np.log(2 * np.pi) + 0.5, rtol=1e-5)
+    assert float(kl_divergence(Normal(0., 1.), Normal(0., 1.)).item()) == 0.0
+
+    u = Uniform(0.0, 2.0)
+    assert abs(float(u.log_prob(paddle.to_tensor(1.0)).item()) + np.log(2)) < 1e-5
+
+    c = Categorical(paddle.to_tensor([[1.0, 2.0, 3.0]]))
+    lp = c.log_prob(paddle.to_tensor([2]))
+    probs = np.exp([1, 2, 3]) / np.exp([1, 2, 3]).sum()
+    np.testing.assert_allclose(float(lp.item()), np.log(probs[2]), rtol=1e-5)
+
+    b = Bernoulli(paddle.to_tensor([0.3]))
+    np.testing.assert_allclose(float(b.variance.item()), 0.21, rtol=1e-5)
+
+
+def test_sparse(rng):
+    sp = paddle.sparse.sparse_coo_tensor([[0, 1, 2], [1, 2, 0]],
+                                         [1.0, 2.0, 3.0], shape=[3, 3])
+    dense = sp.to_dense().numpy()
+    expect = np.zeros((3, 3), np.float32)
+    expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+    np.testing.assert_array_equal(dense, expect)
+    rhs = rng.standard_normal((3, 2)).astype(np.float32)
+    out = paddle.sparse.matmul(sp, paddle.to_tensor(rhs))
+    np.testing.assert_allclose(out.numpy(), expect @ rhs, rtol=1e-5)
+
+
+def test_profiler_and_scheduler():
+    import paddle_tpu.profiler as prof
+
+    sched = prof.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sched(i) for i in range(4)]
+    assert states[0] == prof.ProfilerState.CLOSED
+    assert states[1] == prof.ProfilerState.READY
+    assert states[2] == prof.ProfilerState.RECORD
+    assert states[3] == prof.ProfilerState.RECORD_AND_RETURN
+
+    p = prof.Profiler(timer_only=True)
+    p.start()
+    with prof.RecordEvent("work"):
+        pass
+    p.step(num_samples=2)
+    p.stop()
+    assert "step latency" in p.step_info()
+
+
+def test_viterbi_decode():
+    # deterministic chain: transition forces path 0->1
+    pots = paddle.to_tensor(np.array([[[5.0, 0.0], [0.0, 5.0]]], "float32"))
+    trans = paddle.to_tensor(np.array([[0.0, 1.0], [1.0, 0.0]], "float32"))
+    score, path = paddle.text.viterbi_decode(pots, trans)
+    assert path.numpy().tolist() == [[0, 1]]
+    np.testing.assert_allclose(float(score.item()), 11.0)
+
+
+def test_fake_quantize(rng):
+    x = paddle.to_tensor(rng.standard_normal(64).astype(np.float32),
+                         stop_gradient=False)
+    q = paddle.quantization.fake_quantize_abs_max(x, bits=8)
+    err = np.abs(q.numpy() - x.numpy()).max()
+    assert err < np.abs(x.numpy()).max() / 100  # 8-bit quantization error
+    q.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(64), rtol=1e-6)  # STE
