@@ -44,12 +44,12 @@ def main():
             num_hidden_layers=16, num_attention_heads=16,
             num_key_value_heads=16, max_position_embeddings=2048,
             dtype="bfloat16")
-        batch, seq, steps = 4, 2048, 10
+        batch, seq, steps = 8, 2048, 10
     else:  # CPU smoke mode
         cfg = LlamaConfig.tiny(num_hidden_layers=2)
         batch, seq, steps = 4, 64, 2
 
-    pc = ParallelConfig(remat=True)
+    pc = ParallelConfig(remat=True, loss_chunks=16 if on_tpu else 1)
     ps = PretrainStep(cfg, pc)
     state = ps.init_state(seed=0)
 

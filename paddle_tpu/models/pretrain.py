@@ -46,6 +46,7 @@ class ParallelConfig:
     sequence_parallel: bool = False
     zero1: bool = False          # shard optimizer moments over dp
     remat: bool = False          # jax.checkpoint each decoder layer
+    loss_chunks: int = 1         # chunked CE: never materialize [B,T,V] fp32
 
     @property
     def n_devices(self):
@@ -165,14 +166,45 @@ class PretrainStep:
         return self._logits(params, ids)
 
     def _forward_loss(self, params, ids, labels):
-        logits = self._logits(params, ids)
-        logits = jax.lax.with_sharding_constraint(
-            logits, NamedSharding(self.mesh, P("dp", None, "mp")))
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-        return (lse - gold).mean()
+        C = self.pc.loss_chunks
+        if C <= 1:
+            logits = self._logits(params, ids)
+            logits = jax.lax.with_sharding_constraint(
+                logits, NamedSharding(self.mesh, P("dp", None, "mp")))
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, labels[..., None],
+                                       axis=-1)[..., 0]
+            return (lse - gold).mean()
+        # chunked CE: head matmul + logsumexp per token chunk under remat, so
+        # peak memory holds one [N/C, V] fp32 block instead of [B, T, V]
+        h = self._hidden(params, ids)
+        H = h.shape[-1]
+        hf = h.reshape(-1, H)
+        lf = labels.reshape(-1)
+        N = hf.shape[0]
+        if N % C:
+            raise ValueError(f"loss_chunks ({C}) must divide B*T ({N})")
+        hc = hf.reshape(C, N // C, H)
+        lc = lf.reshape(C, N // C)
+
+        @jax.checkpoint
+        def chunk_loss(args):
+            hunk, gold_ids = args
+            logits = (hunk @ params["head"]).astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, gold_ids[..., None],
+                                       axis=-1)[..., 0]
+            return (lse - gold).sum()
+
+        total = jax.lax.map(chunk_loss, (hc, lc)).sum()
+        return total / N
 
     def _logits(self, params, ids):
+        c = self.config
+        h = self._hidden(params, ids)
+        return (h @ params["head"]).astype(jnp.float32)   # [B, T, V]
+
+    def _hidden(self, params, ids):
         c, pc = self.config, self.pc
         mesh = self.mesh
         B, T = ids.shape
@@ -211,10 +243,9 @@ class PretrainStep:
         out = pipeline_apply(mesh, "pp", stage_fn, params["blocks"], micro)
         h = out.reshape(B, T, c.hidden_size)
 
-        # final rms norm (fp32 accumulation) + head
+        # final rms norm (fp32 accumulation); head applied by caller
         from ..kernels.rms_norm import rms_norm_fp32
-        h = rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
-        return (h @ params["head"]).astype(jnp.float32)   # [B, T, V]
+        return rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
 
     # ---- adamw ----
     def _update(self, state, grads):
