@@ -47,6 +47,14 @@ class ParallelConfig:
     zero1: bool = False          # shard optimizer moments over dp
     remat: bool = False          # jax.checkpoint each decoder layer
     loss_chunks: int = 1         # chunked CE: never materialize [B,T,V] fp32
+    m_dtype: str = "float32"     # AdamW first-moment storage dtype. bf16 is
+    #                              safe here: with beta1=0.9 the per-step
+    #                              relative update (~10%) is far above bf16's
+    #                              half-ULP (~0.2%), and update math is fp32.
+    v_dtype: str = "float32"     # Second moment: keep fp32. With large beta2
+    #                              the per-step relative increment can round
+    #                              away in bf16 and v silently stops tracking
+    #                              gradient variance.
 
     @property
     def n_devices(self):
@@ -137,8 +145,8 @@ class PretrainStep:
                        for k, v in params["blocks"].items()},
         }
 
-        def moment_like(p):
-            m = jnp.zeros(p.shape, jnp.float32)
+        def moment_like(p, dtype):
+            m = jnp.zeros(p.shape, jnp.dtype(dtype))
             sh_ = p.sharding
             if self.pc.zero1 and self.pc.dp > 1 and isinstance(sh_, NamedSharding):
                 # ZeRO-1: shard fp32 moments over the (otherwise replicated)
@@ -154,8 +162,10 @@ class PretrainStep:
 
         state = {
             "params": params,
-            "m": jax.tree_util.tree_map(moment_like, params),
-            "v": jax.tree_util.tree_map(moment_like, params),
+            "m": jax.tree_util.tree_map(
+                lambda p: moment_like(p, self.pc.m_dtype), params),
+            "v": jax.tree_util.tree_map(
+                lambda p: moment_like(p, self.pc.v_dtype), params),
             "step": jnp.zeros((), jnp.int32),
         }
         return state
@@ -257,12 +267,13 @@ class PretrainStep:
 
         def upd(p, g, m, v):
             g = g.astype(jnp.float32)
-            m = b1 * m + (1 - b1) * g
-            v = b2 * v + (1 - b2) * (g * g)
+            mdt, vdt = m.dtype, v.dtype
+            m = b1 * m.astype(jnp.float32) + (1 - b1) * g
+            v = b2 * v.astype(jnp.float32) + (1 - b2) * (g * g)
             u = (m / c1) / (jnp.sqrt(v / c2) + eps)
             pf = p.astype(jnp.float32)
             pf = pf - lr * (u + wd * pf)
-            return pf.astype(p.dtype), m, v
+            return pf.astype(p.dtype), m.astype(mdt), v.astype(vdt)
 
         flat_p, treedef = jax.tree_util.tree_flatten(state["params"])
         flat_g = jax.tree_util.tree_leaves(grads)
@@ -289,11 +300,13 @@ class PretrainStep:
         return self._forward_loss(state["params"], ids, labels)
 
     # ---- accounting (BASELINE.md MFU formula) ----
-    def flops_per_token(self) -> float:
+    def flops_per_token(self, include_remat: bool = False) -> float:
+        """6*N per token; with include_remat, adds the 2*N recompute forward.
+        BASELINE.md requires MFU reported both ways — callers pick."""
         n = self.config.num_params()
         f = 6.0 * n
-        if self.pc.remat:
-            f += 2.0 * n  # recompute forward counted separately per BASELINE.md
+        if include_remat and self.pc.remat:
+            f += 2.0 * n
         return f
 
     def shard_batch(self, ids: np.ndarray, labels: np.ndarray):
