@@ -1,0 +1,79 @@
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import lax
+
+S, M, mb, D = 2, 3, 1, 4
+rng = np.random.default_rng(0)
+Ws = jnp.asarray(rng.standard_normal((S, D, D)) * 0.3, jnp.float32)
+micro = jnp.asarray(rng.standard_normal((M, mb, D)), jnp.float32)
+labels = jnp.asarray(rng.standard_normal((M, mb, D)), jnp.float32)
+lp = jnp.asarray(rng.standard_normal((D,)), jnp.float32)
+mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+
+def stage_fn(w, x):
+    return jnp.tanh(x @ w)
+def loss_fn(y, lbl, p):
+    return jnp.sum((y * p - lbl) ** 2)
+
+W = 2*S - 1
+T = 2*S + M - 1
+fwd_perm = [(i, (i+1) % S) for i in range(S)]
+bwd_perm = [(i, (i-1) % S) for i in range(S)]
+
+def per_stage(wl, micro_, lbls, lp_):
+    w = wl[0]
+    s = lax.axis_index("pp")
+    vary = lambda x: lax.pcast(x, ("pp",), to="varying")
+    fwd_carry = vary(jnp.zeros_like(micro_[0]))
+    bwd_carry = vary(jnp.zeros_like(micro_[0]))
+    inbuf = vary(jnp.zeros((W,) + micro_[0].shape, micro_.dtype))
+    glp_acc = vary(jnp.zeros_like(lp_))
+    glp_trace = vary(jnp.zeros((T,) + lp_.shape))
+
+    def tick(carry, t):
+        fwd_carry, bwd_carry, inbuf, glp_acc, glp_trace = carry
+        b = t - (2*S - 1 - s)
+        b_valid = jnp.logical_and(b >= 0, b < M)
+        bc = jnp.clip(b, 0, M-1)
+        xb = lax.dynamic_index_in_dim(inbuf, bc % W, 0, keepdims=False)
+        f = t - s
+        f_valid = jnp.logical_and(f >= 0, f < M)
+        fc = jnp.clip(f, 0, M-1)
+        x0 = lax.dynamic_index_in_dim(micro_, fc, 0, keepdims=False)
+        x = jnp.where(s == 0, x0, fwd_carry)
+        y = stage_fn(w, x)
+        inbuf = jnp.where(f_valid, lax.dynamic_update_index_in_dim(inbuf, x, fc % W, 0), inbuf)
+        lbl_b = lax.dynamic_index_in_dim(lbls, bc, 0, keepdims=False)
+        def fal(w_, x_, p_):
+            y_ = stage_fn(w_, x_)
+            return y_, loss_fn(y_, lbl_b, p_)
+        (_, loss_b), vjp = jax.vjp(fal, w, xb, lp_)
+        is_last = (s == S-1)
+        gy_seed = jnp.where(jnp.logical_or(is_last, jnp.logical_not(b_valid)),
+                            jnp.zeros_like(y), bwd_carry).astype(y.dtype)
+        gl_seed = jnp.where(jnp.logical_and(is_last, b_valid), jnp.float32(1.0), jnp.float32(0.0))
+        gw, dx, glp = vjp((gy_seed, gl_seed))
+        glp_acc = glp_acc + glp
+        glp_trace = glp_trace.at[t].set(glp)
+        fwd_carry = lax.ppermute(y, "pp", fwd_perm)
+        bwd_carry = lax.ppermute(dx.astype(y.dtype), "pp", bwd_perm)
+        return (fwd_carry, bwd_carry, inbuf, glp_acc, glp_trace), None
+
+    carry = (fwd_carry, bwd_carry, inbuf, glp_acc, glp_trace)
+    carry, _ = lax.scan(tick, carry, jnp.arange(T))
+    return carry[3][None], carry[4][None]
+
+out, trace = jax.shard_map(per_stage, mesh=mesh,
+    in_specs=(P("pp"), P(), P(), P()), out_specs=(P("pp"), P("pp")),
+    axis_names={"pp"})(Ws, micro, labels, lp)
+print("per-stage glp:", out)
+for s in range(S):
+    for t in range(T):
+        v = trace[s, t]
+        if float(jnp.abs(v).max()) > 1e-6:
+            print("stage", s, "tick", t, v)

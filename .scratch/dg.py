@@ -1,0 +1,27 @@
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+
+# d2/dx2 of x^3 = 6x
+x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+x.stop_gradient = False
+y = x * x * x
+(g,) = paddle.grad(y, x, create_graph=True)
+print("g (3x^2):", g.numpy())
+(g2,) = paddle.grad(g, x, grad_outputs=paddle.to_tensor(np.ones(3, np.float32)), retain_graph=True)
+print("g2 (6x):", g2.numpy())
+np.testing.assert_allclose(g2.numpy(), 6 * x.numpy(), rtol=1e-6)
+
+# triple: d3/dx3 = 6
+(gg,) = paddle.grad(g, x, grad_outputs=paddle.to_tensor(np.ones(3, np.float32)), create_graph=True)
+(g3,) = paddle.grad(gg, x, grad_outputs=paddle.to_tensor(np.ones(3, np.float32)))
+print("g3 (6):", g3.numpy())
+np.testing.assert_allclose(g3.numpy(), np.full(3, 6.0), rtol=1e-6)
+
+# grad-penalty style: L = sum(g^2), dL/dx = 2*g*6x... for y=x^3: g=3x^2, L=sum(9x^4), dL/dx=36x^3
+L = (g * g).sum()
+(gp,) = paddle.grad(L, x)
+print("gp (36x^3):", gp.numpy())
+np.testing.assert_allclose(gp.numpy(), 36 * x.numpy() ** 3, rtol=1e-5)
+print("PASS")

@@ -1,0 +1,17 @@
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+
+x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)); x.stop_gradient = False
+y = x * x * x
+(g,) = paddle.grad(y, x, create_graph=True)
+L = (g * g).sum()                       # grad penalty: dL/dx = 2g * 6x = 36x^3
+(gp,) = paddle.grad(L, x, retain_graph=True)
+np.testing.assert_allclose(gp.numpy(), 36 * x.numpy() ** 3, rtol=1e-5)
+(g2,) = paddle.grad(g, x, grad_outputs=paddle.to_tensor(np.ones(3, np.float32)),
+                    create_graph=True)
+np.testing.assert_allclose(g2.numpy(), 6 * x.numpy(), rtol=1e-6)
+(g3,) = paddle.grad(g2, x, grad_outputs=paddle.to_tensor(np.ones(3, np.float32)))
+np.testing.assert_allclose(g3.numpy(), np.full(3, 6.0), rtol=1e-6)
+print("PASS: double, triple, grad-penalty")

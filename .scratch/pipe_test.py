@@ -1,0 +1,63 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from paddle_tpu.distributed.pipeline_spmd import (
+    pipeline_apply, pipeline_1f1b_grads, interleave_chunk_order)
+
+S, v, M, mb, D = 4, 2, 8, 2, 16
+L = S * v  # one "layer" per chunk
+rng = np.random.default_rng(0)
+Ws = jnp.asarray(rng.standard_normal((L, D, D)) * 0.3, jnp.float32)
+micro = jnp.asarray(rng.standard_normal((M, mb, D)), jnp.float32)
+mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+
+def chunk_fn(w, x):
+    return jnp.tanh(x @ w)
+
+# sequential reference
+def seq(ws, x):
+    for i in range(L):
+        x = chunk_fn(ws[i], x)
+    return x
+ref = jnp.stack([seq(Ws, micro[m]) for m in range(M)])
+
+# gpipe with v layers per stage as stage stack [S, v, D, D]
+Wg = Ws.reshape(S, v, D, D)
+def stage_fn(wstack, x):
+    def body(c, w):
+        return chunk_fn(w, c), None
+    out, _ = jax.lax.scan(body, x, wstack)
+    return out
+out_g = jax.jit(lambda w, m: pipeline_apply(mesh, "pp", stage_fn, w, m))(Wg, micro)
+print("gpipe err", float(jnp.abs(out_g - ref).max()))
+
+# interleave: rows s*v + r = chunk r*S + s
+order = interleave_chunk_order(S, v)
+Wi = Ws[jnp.asarray(order)]
+out_i = jax.jit(lambda w, m: pipeline_apply(mesh, "pp", chunk_fn, w, m, virtual=v))(Wi, micro)
+print("interleave err", float(jnp.abs(out_i - ref).max()))
+
+# 1f1b: stage stack [S, v, D, D] like gpipe; loss = sum(y * t)
+lp = jnp.asarray(rng.standard_normal((D,)), jnp.float32)
+labels = jnp.asarray(rng.standard_normal((M, mb, D)), jnp.float32)
+def loss_fn(y, lbl, lp_):
+    return jnp.sum((y * lp_ - lbl) ** 2)
+
+loss, gp, glp, dmicro = jax.jit(
+    lambda w, m, l, p: pipeline_1f1b_grads(mesh, "pp", stage_fn, loss_fn, w, p, m, l)
+)(Wg, micro, labels, lp)
+
+# reference grads
+def total_loss(w, p, m):
+    out = jnp.stack([seq(w.reshape(L, D, D), m[i]) for i in range(M)])
+    return sum(loss_fn(out[i], labels[i], p) for i in range(M))
+rl, (rgw, rglp, rgm) = jax.value_and_grad(total_loss, argnums=(0, 1, 2))(Wg, lp, micro)
+print("1f1b loss err", float(jnp.abs(loss - rl)))
+print("1f1b gw err", float(jnp.abs(gp - rgw).max()))
+print("1f1b glp err", float(jnp.abs(glp - rglp).max()))
+print("1f1b dmicro err", float(jnp.abs(dmicro - rgm).max()))

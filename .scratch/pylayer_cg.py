@@ -1,0 +1,26 @@
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+class Cube(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        ctx.save_for_backward(x)
+        return x * x * x
+    @staticmethod
+    def backward(ctx, g):
+        (x,) = ctx.saved_tensor()
+        return g * 3 * x * x
+
+x = paddle.to_tensor(np.array([1.0, 2.0], np.float32)); x.stop_gradient = False
+z = paddle.to_tensor(np.array([3.0, 4.0], np.float32)); z.stop_gradient = False
+y = (x * x).sum() + Cube.apply(z).sum()
+# path to x avoids the PyLayer entirely: must work
+(gx,) = paddle.grad(y, x, create_graph=True, retain_graph=True)
+np.testing.assert_allclose(gx.numpy(), 2 * x.numpy(), rtol=1e-6)
+# first-order through the PyLayer also works
+(gz,) = paddle.grad(y, z, create_graph=True)
+np.testing.assert_allclose(gz.numpy(), 3 * z.numpy() ** 2, rtol=1e-6)
+print("PASS pylayer-create-graph")

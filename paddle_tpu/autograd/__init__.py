@@ -106,8 +106,11 @@ class PyLayer(metaclass=PyLayerMeta):
                                    (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
             return grad_arrays
 
+        # f=None: a user-defined PyLayer backward is opaque to the tape, so
+        # it cannot be re-differentiated (grad(create_graph=True) through a
+        # PyLayer raises in the engine)
         node = _engine.GradNode(
-            cls.__name__, vjp_fn, diff_inputs,
+            cls.__name__, vjp_fn, None, diff_inputs,
             [(tuple(o.shape), o._data.dtype) for o in flat_out], single)
         for i, o in enumerate(flat_out):
             o.stop_gradient = False

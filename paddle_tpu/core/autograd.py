@@ -84,11 +84,14 @@ class GradNode:
     can be zero-filled.
     """
 
-    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "cotangents", "single_output")
+    __slots__ = ("name", "vjp_fn", "f", "inputs", "out_avals", "cotangents",
+                 "single_output")
 
-    def __init__(self, name, vjp_fn, inputs, out_avals, single_output):
+    def __init__(self, name, vjp_fn, f, inputs, out_avals, single_output):
         self.name = name
         self.vjp_fn = vjp_fn
+        self.f = f                            # diff-args-only primal (for
+        #                                       re-derivation in double grad)
         self.inputs = inputs
         self.out_avals = out_avals            # list of (shape, dtype)
         self.cotangents: List[Optional[Any]] = [None] * len(out_avals)
@@ -110,8 +113,14 @@ class GradNode:
             cots.append(c)
         return cots[0] if self.single_output else tuple(cots)
 
+    def clear_cotangents(self):
+        """Reset accumulation between walks; a retained graph keeps vjp_fn/f
+        but must not leak one backward's cotangents into the next."""
+        self.cotangents = [None] * len(self.out_avals)
+
     def release(self):
         self.vjp_fn = None
+        self.f = None
         self.cotangents = [None] * len(self.out_avals)
 
 
@@ -216,7 +225,7 @@ def apply(name: str, prim: Callable, tensor_args: Sequence, kwargs: dict | None 
     single = not isinstance(out, (tuple, list))
     flat = (out,) if single else tuple(out)
     node = GradNode(
-        name, vjp_fn,
+        name, vjp_fn, f,
         [tensor_args[i] for i in diff_idx],
         [(o.shape, o.dtype) for o in flat],
         single,
@@ -306,7 +315,9 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
                     _accumulate_leaf(t, g)
             else:
                 _accumulate_leaf(t, g)
-        if not retain_graph:
+        if retain_graph:
+            node.clear_cotangents()
+        else:
             node.release()
 
 
@@ -330,9 +341,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     """paddle.grad: gradients of outputs wrt inputs without touching .grad.
 
     Implemented by running the tape walk with a private accumulation map.
-    ``create_graph`` (double grad) is supported through jax by replaying: the
-    pullbacks are themselves jax functions, so higher-order grads work when the
-    graph is retained.
+    With ``create_graph=True`` (reference: GeneralGrad, eager/backward.cc:105)
+    every pullback execution is itself RECORDED on the tape as an op whose
+    inputs are the node's primal inputs plus the cotangents — grads w.r.t. x
+    flow through the pullback residuals (e.g. d(2x*g)/dx), so grad-of-grad
+    and higher orders chain naturally.
     """
     from .tensor import Tensor
 
@@ -346,6 +359,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
         grad_outputs = [grad_outputs]
     if retain_graph is None:
         retain_graph = create_graph
+
+    if create_graph:
+        return _grad_taped(outputs, inputs, grad_outputs, allow_unused)
 
     acc: dict = {}
     seeds = []
@@ -370,13 +386,99 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
                 acc[id(t)] = acc[id(t)] + g if id(t) in acc else g
             if t._node is not None and t._node.vjp_fn is not None:
                 t._node.accumulate(t._slot, g)
-        if not retain_graph:
+        if retain_graph:
+            node.clear_cotangents()
+        else:
             node.release()
 
     result = []
     for t in inputs:
         if id(t) in acc:
-            result.append(Tensor(acc[id(t)], stop_gradient=not create_graph))
+            result.append(Tensor(acc[id(t)], stop_gradient=True))
+        elif allow_unused:
+            result.append(None)
+        else:
+            raise ValueError(
+                "One of the differentiated tensors appears unused in the graph; "
+                "pass allow_unused=True to return None for it.")
+    return result
+
+
+def _grad_taped(outputs, inputs, grad_outputs, allow_unused):
+    """create_graph=True tape walk: cotangents are Tensors and every pullback
+    runs through apply() as ``(xs, cots) -> vjp(f, xs)(cots)``, so the result
+    is tape-connected through both the cotangents AND the primal inputs."""
+    from .tensor import Tensor
+
+    cot_map: dict = {}            # (id(node), slot) -> Tensor
+    leaf_acc: dict = {}           # id(tensor) -> Tensor
+    keep = []                     # keep nodes alive while ids are dict keys
+
+    def add_cot(key, gt):
+        cot_map[key] = cot_map[key] + gt if key in cot_map else gt
+
+    seeds = []
+    for t, g in zip(outputs, grad_outputs):
+        gt = (Tensor(jnp.ones(t._data.shape, t._data.dtype), stop_gradient=True)
+              if g is None else (g if isinstance(g, Tensor)
+                                 else Tensor(jnp.asarray(g), stop_gradient=True)))
+        if t._node is not None:
+            add_cot((id(t._node), t._slot), gt)
+            seeds.append(t._node)
+        else:
+            leaf_acc[id(t)] = gt
+
+    targets = {id(t) for t in inputs}
+    for node in _topo_order(seeds):
+        touched = any(cot_map.get((id(node), slot)) is not None
+                      for slot in range(len(node.out_avals)))
+        if node.f is None and not touched:
+            continue  # opaque node off the requested cotangent paths
+        keep.append(node)
+        cots = []
+        for slot, aval in enumerate(node.out_avals):
+            c = cot_map.get((id(node), slot))
+            if c is None:
+                c = Tensor(jnp.zeros(aval[0], aval[1]), stop_gradient=True)
+            cots.append(c)
+        k = len(node.inputs)
+
+        if node.f is None:
+            # user-defined PyLayer backward: opaque to the tape.  Its pullback
+            # still contributes FIRST-order cotangents (as constants); like
+            # torch's once_differentiable, a further grad through this path
+            # reports the tensor as unused rather than returning wrong values.
+            raw = node.vjp_fn(cots[0]._data if node.single_output
+                              else tuple(c._data for c in cots))
+            outs = tuple(None if g is None else Tensor(g, stop_gradient=True)
+                         for g in raw)
+        else:
+            def pullback_prim(*arrs, _f=node.f, _k=k,
+                              _single=node.single_output):
+                xs, cs = arrs[:_k], arrs[_k:]
+                _, vjp = jax.vjp(_f, *xs)
+                return vjp(cs[0] if _single else tuple(cs))
+
+            outs = apply("grad_" + node.name, pullback_prim,
+                         list(node.inputs) + cots)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+        for t, gt in zip(node.inputs, outs):
+            if gt is None:
+                continue
+            for hook in t._hooks:
+                res = hook(gt)
+                if res is not None:
+                    gt = res if isinstance(res, Tensor) else Tensor(res)
+            if t._node is not None:
+                add_cot((id(t._node), t._slot), gt)
+            if id(t) in targets or t._node is None:
+                leaf_acc[id(t)] = leaf_acc[id(t)] + gt \
+                    if id(t) in leaf_acc else gt
+
+    result = []
+    for t in inputs:
+        if id(t) in leaf_acc:
+            result.append(leaf_acc[id(t)])
         elif allow_unused:
             result.append(None)
         else:

@@ -80,7 +80,10 @@ _REDUCERS = {
     ReduceOp.AVG: lambda x, ax: lax.pmean(x, ax),
     ReduceOp.MAX: lax.pmax,
     ReduceOp.MIN: lax.pmin,
-    ReduceOp.PROD: lambda x, ax: jnp.exp(lax.psum(jnp.log(x), ax)),
+    # PROD must handle negatives and zeros exactly (exp∘psum∘log would NaN on
+    # negatives): gather the factors and multiply. PROD is rare enough that
+    # the gather cost is irrelevant.
+    ReduceOp.PROD: lambda x, ax: jnp.prod(lax.all_gather(x, ax), axis=0),
 }
 
 
@@ -245,9 +248,12 @@ all_to_all = alltoall
 
 # ---- p2p ----
 # Single-controller p2p: the controller plays both endpoints, so messages
-# queue FIFO per (group, dst); recv pops the oldest message for any dst the
-# caller could be (the reference's src/dst pairing is per-process state we
-# don't have — ordering is the contract here, as with MPI same-peer traffic).
+# queue FIFO per (group, dst) channel.  recv with a single live channel pops
+# it (the common sequential send/recv emulation).  With messages queued for
+# SEVERAL destinations the pairing is genuinely ambiguous under one
+# controller (the caller's process rank cannot stand in for the logical
+# receiving rank), so recv requires an explicit ``dst=`` then — interleaved
+# sends to different destinations are never silently cross-delivered.
 from collections import deque as _deque
 
 _MAILBOX: dict = {}
@@ -259,17 +265,37 @@ def send(tensor, dst=0, group=None, sync_op=True):
     if _is_traced(x):
         raise RuntimeError("Inside shard_map use paddle_tpu.distributed.ppermute "
                            "(collective_permute) for p2p.")
-    _MAILBOX.setdefault(g.id, _deque()).append((dst, x))
+    _MAILBOX.setdefault((g.id, dst), _deque()).append(x)
     return _Task(x)
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
-    q = _MAILBOX.get(_resolve_group(group).id)
-    if not q:
+def recv(tensor, src=0, group=None, sync_op=True, dst=None):
+    """``dst`` (extension): the logical receiving rank, required only when
+    messages for several destinations are queued at once."""
+    g = _resolve_group(group)
+    live = {d: q for (gid, d), q in _MAILBOX.items() if gid == g.id and q}
+    if not live:
         raise RuntimeError("recv without matching send (single-controller p2p)")
-    _dst, out = q.popleft()
+    if dst is not None:
+        if dst not in live:
+            raise RuntimeError(
+                f"recv(dst={dst}): no message queued for that rank "
+                f"(queued dsts: {sorted(live)})")
+        q = live[dst]
+    elif len(live) == 1:
+        (q,) = live.values()
+    else:
+        raise RuntimeError(
+            f"ambiguous recv: messages queued for dsts {sorted(live)}; under "
+            f"a single controller the receiving rank cannot be inferred — "
+            f"pass recv(..., dst=<receiving rank>)")
+    out = q.popleft()
     if isinstance(tensor, Tensor):
-        tensor._data = out.reshape(tensor._data.shape).astype(tensor._data.dtype)
+        if tuple(out.shape) != tuple(tensor._data.shape):
+            raise ValueError(
+                f"recv buffer shape {list(tensor._data.shape)} does not match "
+                f"sent message shape {list(out.shape)}")
+        tensor._data = out.astype(tensor._data.dtype)
     return _Task(out)
 
 
@@ -303,6 +329,10 @@ def barrier(group=None):
 
 # ---- object collectives (reference communication/all_gather.py all_gather_object) ----
 def all_gather_object(object_list: List, obj, group=None):
+    """Single-controller parity semantics: every logical rank IS this
+    process, so the gathered list is nranks copies of the caller's object
+    (matching the reference's contract, where each rank contributes its own
+    object — here there is exactly one rank's worth of state)."""
     g = _resolve_group(group)
     object_list.extend([obj] * g.nranks)
 

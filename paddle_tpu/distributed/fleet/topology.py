@@ -108,7 +108,7 @@ class HybridCommunicateGroup:
         dims = [topology.get_dim(n) for n in topology.get_hybrid_group_names()]
         devs = env._devices()
         n = int(np.prod(dims))
-        if len(devs) % n != 0 and n > len(devs):
+        if n > len(devs):
             raise ValueError(f"topology needs {n} devices, have {len(devs)}")
         dev_arr = np.array(devs[:n]).reshape(dims)
         self.global_mesh = jax.sharding.Mesh(dev_arr, tuple(names))

@@ -129,9 +129,12 @@ class PipelineLayer(Layer):
         self.segment_parts = bounds
 
     def _place_stages(self):
-        """Put each stage's params on its pp mesh slice (keeping other-axis
-        shardings such as mp intact is future work: stage placement currently
-        resets to replicated-within-stage)."""
+        """Put each stage's params on its pp mesh slice, replicated over the
+        stage's remaining axes (dp/mp/...): a stage sub-Mesh is carved from
+        the global mesh at pp index s, and unsharded params are device_put
+        with a replicated NamedSharding over that sub-mesh.  Params that
+        already carry a non-trivial sharding (e.g. mp from the mpu layers)
+        are left alone — GSPMD keeps them partitioned inside the slice."""
         if self._hcg is None or self._num_stages <= 1:
             return
         mesh = self._hcg.global_mesh
@@ -139,11 +142,15 @@ class PipelineLayer(Layer):
             return
         dev_grid = mesh.devices
         pp_axis = mesh.axis_names.index("pp")
+        other_axes = tuple(n for n in mesh.axis_names if n != "pp")
+        stage_meshes = [
+            jax.sharding.Mesh(np.take(dev_grid, s, axis=pp_axis), other_axes)
+            if other_axes else None
+            for s in range(self._num_stages)]
         for i, (layer, _) in enumerate(self._pipeline):
             if not isinstance(layer, Layer):
                 continue
             s = int(self._stage_of[i])
-            stage_devs = np.take(dev_grid, s, axis=pp_axis).ravel()
             for p in layer.parameters():
                 arr = p._data
                 if not isinstance(arr, jax.core.Tracer):
@@ -151,7 +158,12 @@ class PipelineLayer(Layer):
                     if isinstance(sharding, NamedSharding) and any(
                             sharding.spec):
                         continue  # keep mp/other sharding
-                    p._data = jax.device_put(arr, stage_devs[0])
+                    if stage_meshes[s] is not None:
+                        p._data = jax.device_put(
+                            arr, NamedSharding(stage_meshes[s], P()))
+                    else:
+                        stage_devs = np.take(dev_grid, s, axis=pp_axis).ravel()
+                        p._data = jax.device_put(arr, stage_devs[0])
 
     def get_stage_from_index(self, index: int) -> int:
         return int(self._stage_of[index])
@@ -169,10 +181,13 @@ class PipelineParallel(_WrapperBase):
     """reference pipeline_parallel.py:820 train_batch / :575
     forward_backward_pipeline.
 
-    Schedule: microbatched gradient accumulation.  Stage overlap (true 1F1B
-    wavefront) on TPU comes from running this under jit where XLA's
-    latency-hiding scheduler overlaps stage s of microbatch i with stage s+1
-    of microbatch i-1; the host loop only defines the dataflow.
+    Schedule: microbatched gradient ACCUMULATION, executed eagerly — the
+    micro-batches run strictly sequentially with no stage overlap.  True
+    pipeline schedules (GPipe / interleaved-VPP / 1F1B wavefronts over the
+    'pp' mesh axis) are the SPMD path: `distributed.pipeline_spmd` +
+    `models.pretrain.PretrainStep(schedule=...)`, which compile the whole
+    schedule into one XLA program.  This wrapper exists for API parity with
+    eager fleet code and for correctness at small scale.
     """
 
     def __init__(self, layers, hcg, strategy=None):
@@ -256,8 +271,10 @@ class PipelineParallel(_WrapperBase):
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """reference pipeline_parallel.py:1174 (VPP) — same dataflow under one
-    controller; virtual stages only change parameter placement granularity."""
+    """reference pipeline_parallel.py:1174 (VPP) — eager wrapper: same
+    accumulation dataflow under one controller; virtual stages only change
+    parameter placement granularity.  The compiled interleaved schedule is
+    `pipeline_spmd.pipeline_apply(..., virtual=v)`."""
 
 
 class HybridParallelOptimizer:

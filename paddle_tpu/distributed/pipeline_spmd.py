@@ -1,14 +1,30 @@
-"""SPMD pipeline parallelism — GPipe schedule over a mesh axis.
+"""SPMD pipeline parallelism — GPipe / interleaved (VPP) / 1F1B schedules
+over a mesh axis.
 
-Reference mechanism: FleetExecutor interceptors / PipelineParallel 1F1B with
-NCCL p2p (pipeline_parallel.py:575, p2p_communication.py:573).  TPU-native
-redesign: the pipeline IS a collective program — stage parameters are stacked
-on a leading dim sharded over the 'pp' mesh axis, and one `shard_map`ped
-`lax.scan` advances the wavefront with `lax.ppermute` stage-to-stage
-transfers over ICI.  Every stage computes every tick (SPMD), so fill/drain
-bubbles are idle-compute, exactly as in GPipe; reverse-mode AD through
-scan+ppermute yields the backward pipeline automatically (the B/W phases the
-reference schedules by hand).
+Reference mechanism: FleetExecutor interceptors / PipelineParallel schedules
+(pipeline_parallel.py:575 forward_backward_pipeline, :1174 interleave/VPP) with
+NCCL p2p (p2p_communication.py:573).  TPU-native redesign: the pipeline IS a
+collective program — stage parameters are stacked on a leading dim sharded
+over the 'pp' mesh axis, and one `shard_map`ped `lax.scan` advances the
+wavefront with `lax.ppermute` stage-to-stage transfers over ICI.  Every stage
+computes every tick (SPMD), so bubbles are idle-compute, and the schedules
+trade off differently than their MPMD ancestors:
+
+* ``gpipe``      — forward scan, XLA AD produces the reversed backward
+                   wavefront.  Fewest lockstep ticks (M+S-1 fwd / M+S-1 bwd)
+                   but activation residuals grow with M.
+* ``interleave`` — circular schedule, the VPP analog: each device holds
+                   ``v`` layer chunks (device s owns chunks {r*S+s}), and
+                   microbatches circulate v rounds.  Fill/drain shrinks from
+                   (S-1) full-stage ticks to (S-1) chunk ticks — a v× smaller
+                   bubble, exactly Megatron-VPP's ratio.
+* ``1f1b``       — manual one-forward-one-backward schedule with
+                   recompute-from-checkpoint (pipeline_1f1b_grads): live
+                   activation checkpoints are capped at 2S-1 microbatches per
+                   device, independent of M (GPipe stores M+S-1).  The
+                   schedule of choice when M >> S; costs loss-fn compute on
+                   every stage's backward tick (SPMD lockstep has no
+                   last-stage-only work).
 
 Other mesh axes (dp/mp/...) stay *auto*: GSPMD keeps partitioning each
 stage's internals (Megatron TP etc.) inside the manual pp axis.
@@ -26,33 +42,48 @@ from jax.sharding import PartitionSpec as P
 
 
 def pipeline_apply(mesh, axis: str, stage_fn: Callable, stage_params: Any,
-                   microbatches, *consts):
-    """Run a GPipe pipeline over `axis`.
+                   microbatches, *consts, virtual: int = 1):
+    """Run a forward pipeline over `axis` (differentiable; XLA AD gives the
+    reversed backward wavefront — the GPipe schedule, or circular/VPP when
+    ``virtual > 1``).
 
     Args:
       mesh: the hybrid `jax.sharding.Mesh` (must contain `axis`).
       axis: pipeline mesh-axis name (e.g. 'pp'), size S.
-      stage_fn: `(params_slice, x, *consts) -> y` — one stage's compute;
-        `params_slice` leaves have the stacked leading dims removed; y must
-        have x's shape/dtype.
-      stage_params: pytree with leaves stacked `[S, ...]` (sharded P(axis)).
+      stage_fn: `(params_slice, x, *consts) -> y` — one stage's (or, with
+        virtual>1, one chunk's) compute; `params_slice` leaves have the
+        stacked leading dims removed; y must have x's shape/dtype.
+      stage_params: pytree with leaves stacked `[S, ...]` (sharded P(axis));
+        with virtual=v, `[S*v, ...]` where row `s*v + r` holds the chunk that
+        stage s runs in round r (i.e. layer group `r*S + s` — see
+        `interleave_chunk_order`).
       microbatches: `[M, mb, ...]` activations fed to stage 0.
       consts: broadcast arrays (e.g. rope tables) replicated to every stage.
+      virtual: chunks per device (VPP degree v).  1 = plain GPipe.
 
-    Returns `[M, mb, ...]` outputs of the final stage (replicated over pp).
+    Returns `[M, mb, ...]` outputs of the final chunk (replicated over pp).
     """
     S = mesh.shape[axis]
     if S == 1:
-        params = jax.tree_util.tree_map(lambda l: l[0], stage_params)
-
         def body(carry, mb):
-            return carry, stage_fn(params, mb, *consts)
+            x = mb
+            for r in range(virtual):
+                p_r = jax.tree_util.tree_map(lambda l: l[r], stage_params)
+                x = stage_fn(p_r, x, *consts)
+            return carry, x
 
         _, out = lax.scan(body, 0, microbatches)
         return out
 
+    if virtual == 1:
+        return _gpipe(mesh, axis, S, stage_fn, stage_params, microbatches,
+                      *consts)
+    return _circular(mesh, axis, S, virtual, stage_fn, stage_params,
+                     microbatches, *consts)
+
+
+def _gpipe(mesh, axis, S, stage_fn, stage_params, microbatches, *consts):
     M = microbatches.shape[0]
-    auto = frozenset(n for n in mesh.axis_names if n != axis)
     perm = [(i, (i + 1) % S) for i in range(S)]
 
     def per_stage(params_local, micro, *cs):
@@ -92,5 +123,261 @@ def pipeline_apply(mesh, axis: str, stage_fn: Callable, stage_params: Any,
                          )(stage_params, microbatches, *consts)
 
 
-def num_pipeline_ticks(num_micro: int, num_stages: int) -> int:
+def interleave_chunk_order(S: int, v: int):
+    """Row order for stacking chunk params: row s*v + r must hold layer group
+    g = r*S + s, so a [S*v] leading dim sharded over the S-way axis gives
+    device s exactly its v round-chunks in round order."""
+    return [r * S + s for s in range(S) for r in range(v)]
+
+
+def _circular(mesh, axis, S, v, stage_fn, stage_params, microbatches, *consts):
+    """Circular (interleaved/VPP) schedule: microbatch m, round r is processed
+    by stage (g mod S) with chunk params row r, at tick i = r*M + m + s.
+    Requires M >= S so a round-(r) activation has always arrived at stage 0
+    before tick r*M + m (produced at (r-1)*M + m + S - 1)."""
+    M = microbatches.shape[0]
+    if M < S:
+        raise ValueError(
+            f"interleaved pipeline needs microbatches ({M}) >= stages ({S})")
+    T = v * M + S - 1
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def per_stage(params_local, micro, *cs):
+        # params_local leaves: [v, ...] — this stage's chunks in round order
+        s = lax.axis_index(axis)
+        state = lax.pcast(jnp.zeros_like(micro[0]), (axis,), to="varying")
+        out_buf = lax.pcast(jnp.zeros_like(micro), (axis,), to="varying")
+        circ = lax.pcast(jnp.zeros_like(micro), (axis,), to="varying")
+
+        def tick(carry, i):
+            state, out_buf, circ = carry
+            f = i - s                          # global work index
+            m = jnp.clip(f, 0, v * M - 1) % M  # microbatch
+            r = jnp.clip(f, 0, v * M - 1) // M  # round
+            valid = jnp.logical_and(f >= 0, f < v * M)
+
+            # stage 0 consumed a circulating activation that arrived from
+            # stage S-1 via ppermute LAST tick and was parked in circ
+            x0_new = lax.dynamic_index_in_dim(micro, m, 0, keepdims=False)
+            x0_circ = lax.dynamic_index_in_dim(circ, m, 0, keepdims=False)
+            x0 = jnp.where(r == 0, x0_new, x0_circ)
+            x = jnp.where(s == 0, x0, state)
+
+            p_r = jax.tree_util.tree_map(
+                lambda l: lax.dynamic_index_in_dim(l, r, 0, keepdims=False),
+                params_local)
+            y = stage_fn(p_r, x, *cs)
+
+            # last stage, final round: emit; otherwise circulate
+            emit = jnp.logical_and(valid,
+                                   jnp.logical_and(s == S - 1, r == v - 1))
+            out_buf = jnp.where(
+                emit, lax.dynamic_update_index_in_dim(out_buf, y, m, 0),
+                out_buf)
+            state = lax.ppermute(y, axis, perm)
+
+            # park the activation that just arrived at stage 0 (sent by stage
+            # S-1, which at tick i worked on f' = i - (S-1)) for its next round
+            mp = jnp.clip(i - (S - 1), 0, v * M - 1) % M
+            park = jnp.logical_and(s == 0,
+                                   jnp.logical_and(i - (S - 1) >= 0,
+                                                   i - (S - 1) < v * M - M))
+            circ = jnp.where(
+                park, lax.dynamic_update_index_in_dim(circ, state, mp, 0),
+                circ)
+            return (state, out_buf, circ), None
+
+        (state, out_buf, circ), _ = lax.scan(tick, (state, out_buf, circ),
+                                             jnp.arange(T))
+        mask = (s == S - 1).astype(out_buf.dtype)
+        return lax.psum(out_buf * mask, axis)
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+                P()) + tuple(P() for _ in consts)
+    return jax.shard_map(per_stage, mesh=mesh, in_specs=in_specs,
+                         out_specs=P(), axis_names={axis},
+                         )(stage_params, microbatches, *consts)
+
+
+def pipeline_1f1b_grads(mesh, axis: str, stage_fn: Callable,
+                        loss_fn: Callable, stage_params: Any, loss_params: Any,
+                        microbatches, labels, *consts):
+    """One-forward-one-backward schedule with manual gradient plumbing.
+
+    Per-device live activation checkpoints are capped at W = 2S-1
+    microbatches (GPipe-by-AD stores M+S-1 scan residuals), at the cost of
+    running `loss_fn` on every stage during backward ticks (SPMD lockstep).
+    The backward recomputes each stage's forward from its checkpointed input
+    (Megatron-style recompute), so `stage_fn` need not be remat'd by the
+    caller.
+
+    Timing (tick t): stage s forwards microbatch f = t - s and backwards
+    microbatch b = t - (2S - 1 - s); cotangents hop s+1 -> s via reverse
+    ppermute.  Total ticks 2S + M - 1.
+
+    Args:
+      stage_fn: `(stage_params_slice, x, *consts) -> y`.
+      loss_fn: `(y, labels_mb, loss_params) -> scalar` — per-microbatch loss
+        applied after the LAST stage (e.g. final norm + lm head + CE).  Must
+        return the SUM-convention loss for correct accumulation; the caller
+        divides by M.
+      stage_params: leaves `[S, ...]` sharded P(axis).
+      loss_params: pytree, replicated.
+      microbatches: `[M, mb...]`; labels: `[M, ...]` per-microbatch labels.
+
+    Returns `(total_loss, d_stage_params, d_loss_params, d_microbatches)`
+    where total_loss is the sum over microbatches (divide by M for the mean).
+    """
+    S = mesh.shape[axis]
+    M = microbatches.shape[0]
+
+    if S == 1:
+        params = jax.tree_util.tree_map(lambda l: l[0], stage_params)
+
+        def body(carry, xs):
+            loss_acc, gp_acc, glp_acc = carry
+            mb, lbl = xs
+
+            def f(p, lp, mb_):
+                return loss_fn(stage_fn(p, mb_, *consts), lbl, lp)
+
+            l, (gp, glp, dmb) = jax.value_and_grad(f, argnums=(0, 1, 2))(
+                params, loss_params, mb)
+            return (loss_acc + l,
+                    jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(jnp.float32), gp_acc, gp),
+                    jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(jnp.float32), glp_acc, glp),
+                    ), dmb.astype(microbatches.dtype)
+
+        zero_p = jax.tree_util.tree_map(
+            lambda l: jnp.zeros(l.shape[1:], jnp.float32), stage_params)
+        zero_lp = jax.tree_util.tree_map(
+            lambda l: jnp.zeros(l.shape, jnp.float32), loss_params)
+        (loss, gp, glp), dmicro = lax.scan(
+            body, (jnp.float32(0.0), zero_p, zero_lp), (microbatches, labels))
+        gp = jax.tree_util.tree_map(lambda l: l[None], gp)
+        return loss, gp, glp, dmicro
+
+    W = 2 * S - 1                       # ring slots for in-flight checkpoints
+    T = 2 * S + M - 1
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def per_stage(params_local, micro, lbls, lparams, *cs):
+        params = jax.tree_util.tree_map(lambda l: l[0], params_local)
+        s = lax.axis_index(axis)
+        mb_shape = micro[0]
+
+        def vary(x):
+            return lax.pcast(x, (axis,), to="varying")
+
+        # mark loss params device-varying BEFORE the per-tick vjp: the
+        # cotangent of an invariant input inside a manual region is auto-
+        # psummed across the axis — correct, but that is a hidden per-tick
+        # allreduce of head-sized grads.  Varying-typed inputs keep local
+        # cotangents; we reduce once after the scan.
+        lparams = jax.tree_util.tree_map(vary, lparams)
+
+        fwd_carry = vary(jnp.zeros_like(mb_shape))
+        bwd_carry = vary(jnp.zeros_like(mb_shape))
+        inbuf = vary(jnp.zeros((W,) + mb_shape.shape, mb_shape.dtype))
+        dmicro = vary(jnp.zeros_like(micro))
+        gacc = jax.tree_util.tree_map(
+            lambda l: vary(jnp.zeros(l.shape, jnp.float32)), params)
+        glp_acc = jax.tree_util.tree_map(
+            lambda l: vary(jnp.zeros(l.shape, jnp.float32)), lparams)
+        loss_acc = vary(jnp.float32(0.0))
+
+        def tick(carry, t):
+            (fwd_carry, bwd_carry, inbuf, dmicro, gacc, glp_acc,
+             loss_acc) = carry
+
+            # backward checkpoint must be read BEFORE the forward stores:
+            # at stage 0, mb f's slot is reused by mb f + (2S-1) in the same
+            # tick that consumes it
+            b = t - (2 * S - 1 - s)
+            b_valid = jnp.logical_and(b >= 0, b < M)
+            bc = jnp.clip(b, 0, M - 1)
+            xb = lax.dynamic_index_in_dim(inbuf, bc % W, 0, keepdims=False)
+
+            # ---- forward half: microbatch f = t - s ----
+            f = t - s
+            f_valid = jnp.logical_and(f >= 0, f < M)
+            fc = jnp.clip(f, 0, M - 1)
+            x0 = lax.dynamic_index_in_dim(micro, fc, 0, keepdims=False)
+            x = jnp.where(s == 0, x0, fwd_carry)
+            y = stage_fn(params, x, *cs)
+            inbuf = jnp.where(
+                f_valid,
+                lax.dynamic_update_index_in_dim(inbuf, x, fc % W, 0), inbuf)
+
+            # ---- backward half ----
+            lbl_b = lax.dynamic_index_in_dim(lbls, bc, 0, keepdims=False)
+
+            def fwd_and_loss(p, x_, lp):
+                y_ = stage_fn(p, x_, *cs)
+                return y_, loss_fn(y_, lbl_b, lp)
+
+            (_, loss_b), vjp = jax.vjp(fwd_and_loss, params, xb, lparams)
+            is_last = (s == S - 1)
+            # seed: last stage pulls back d(loss)=1; others pull back the
+            # cotangent from the next stage.  Linearity of vjp zeroes the
+            # loss-path (resp. y-path) contributions automatically.
+            gy_seed = jnp.where(jnp.logical_or(is_last,
+                                               jnp.logical_not(b_valid)),
+                                jnp.zeros_like(y), bwd_carry).astype(y.dtype)
+            gl_seed = jnp.where(jnp.logical_and(is_last, b_valid),
+                                jnp.float32(1.0), jnp.float32(0.0))
+            gp, dx, glp = vjp((gy_seed, gl_seed))
+
+            gacc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), gacc, gp)
+            glp_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), glp_acc, glp)
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(is_last, b_valid), loss_b, 0.0)
+
+            # stage 0's dx is the cotangent of the embedded microbatch
+            dmicro = jnp.where(
+                jnp.logical_and(s == 0, b_valid),
+                lax.dynamic_update_index_in_dim(
+                    dmicro, dx.astype(dmicro.dtype), bc, 0),
+                dmicro)
+
+            fwd_carry = lax.ppermute(y, axis, fwd_perm)
+            bwd_carry = lax.ppermute(dx.astype(mb_shape.dtype), axis,
+                                     bwd_perm)
+            return (fwd_carry, bwd_carry, inbuf, dmicro, gacc, glp_acc,
+                    loss_acc), None
+
+        carry = (fwd_carry, bwd_carry, inbuf, dmicro, gacc, glp_acc, loss_acc)
+        carry, _ = lax.scan(tick, carry, jnp.arange(T))
+        _, _, _, dmicro, gacc, glp_acc, loss_acc = carry
+
+        # stage grads stay sharded [1, ...] over pp; everything else reduces
+        gacc = jax.tree_util.tree_map(lambda l: l[None], gacc)
+        loss = lax.psum(loss_acc, axis)
+        glp = jax.tree_util.tree_map(lambda l: lax.psum(l, axis), glp_acc)
+        dmicro = lax.psum(
+            dmicro * (s == 0).astype(dmicro.dtype), axis)
+        return loss, gacc, glp, dmicro
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+                P(), P(), jax.tree_util.tree_map(lambda _: P(), loss_params),
+                ) + tuple(P() for _ in consts)
+    out_specs = (P(), jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+                 jax.tree_util.tree_map(lambda _: P(), loss_params), P())
+    return jax.shard_map(per_stage, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, axis_names={axis},
+                         )(stage_params, microbatches, labels, loss_params,
+                           *consts)
+
+
+def num_pipeline_ticks(num_micro: int, num_stages: int, virtual: int = 1,
+                       schedule: str = "gpipe") -> int:
+    if schedule == "1f1b":
+        return 2 * num_stages + num_micro - 1
+    if virtual > 1:
+        return virtual * num_micro + num_stages - 1
     return num_micro + num_stages - 1

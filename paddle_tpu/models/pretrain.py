@@ -32,7 +32,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
-from ..distributed.pipeline_spmd import pipeline_apply
+from ..distributed.pipeline_spmd import (interleave_chunk_order,
+                                         pipeline_1f1b_grads, pipeline_apply)
 from ..utils import extract_params, functional_call, stack_params
 from .llama import LlamaConfig, LlamaDecoderLayer, _rope_cos_sin, _scaled_init
 
@@ -43,6 +44,8 @@ class ParallelConfig:
     pp: int = 1
     mp: int = 1
     micro_batches: int = 1
+    schedule: str = "gpipe"      # pipeline schedule: gpipe | interleave | 1f1b
+    virtual_pp: int = 1          # VPP chunks per stage (schedule="interleave")
     sequence_parallel: bool = False
     zero1: bool = False          # shard optimizer moments over dp
     remat: bool = False          # jax.checkpoint each decoder layer
@@ -93,9 +96,17 @@ class PretrainStep:
         self.mesh = mesh if mesh is not None else build_mesh(self.pc)
         self.lr, self.wd = learning_rate, weight_decay
         self.b1, self.b2, self.eps = beta1, beta2, eps
-        if config.num_hidden_layers % self.pc.pp:
+        if self.pc.schedule not in ("gpipe", "interleave", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule {self.pc.schedule!r}")
+        if self.pc.schedule == "1f1b" and self.pc.virtual_pp > 1:
+            raise ValueError("interleaved 1F1B is not implemented; use "
+                             "schedule='interleave' or virtual_pp=1")
+        self._virtual = self.pc.virtual_pp if self.pc.schedule == "interleave" \
+            else 1
+        groups = self.pc.pp * self._virtual
+        if config.num_hidden_layers % groups:
             raise ValueError(
-                f"pp degree ({self.pc.pp}) must divide num_hidden_layers "
+                f"pp*virtual ({groups}) must divide num_hidden_layers "
                 f"({config.num_hidden_layers})")
         # one template layer provides the block math for every (stage, layer)
         self._template = LlamaDecoderLayer(config)
@@ -126,9 +137,15 @@ class PretrainStep:
             layer = LlamaDecoderLayer(c)
             layer_params.append(extract_params(layer))
         stacked = stack_params(layer_params)          # [L, ...]
-        S = self.pc.pp
-        stacked = {k: v.reshape((S, c.num_hidden_layers // S) + v.shape[1:])
-                   for k, v in stacked.items()}       # [S, L/S, ...]
+        G = self.pc.pp * self._virtual
+        stacked = {k: v.reshape((G, c.num_hidden_layers // G) + v.shape[1:])
+                   for k, v in stacked.items()}       # [G, L/G, ...]
+        if self._virtual > 1:
+            # row s*v + r must hold layer group r*S + s (device s's chunks in
+            # round order) so the pp-sharded leading dim lands correctly
+            order = np.asarray(
+                interleave_chunk_order(self.pc.pp, self._virtual))
+            stacked = {k: v[order] for k, v in stacked.items()}
 
         params = {
             "embed": _scaled_init(c.hidden_size)([c.vocab_size, c.hidden_size], dt),
@@ -250,12 +267,85 @@ class PretrainStep:
             raise ValueError(
                 f"micro_batches ({M}) must divide the batch size ({B})")
         micro = h.reshape((M, B // M) + h.shape[1:])
-        out = pipeline_apply(mesh, "pp", stage_fn, params["blocks"], micro)
+        out = pipeline_apply(mesh, "pp", stage_fn, params["blocks"], micro,
+                             virtual=self._virtual)
         h = out.reshape(B, T, c.hidden_size)
 
         # final rms norm (fp32 accumulation); head applied by caller
         from ..kernels.rms_norm import rms_norm_fp32
         return rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
+
+    # ---- 1F1B: manual grad plumbing (loss computed per-microbatch at the
+    # last stage; embed grads recovered from the pipeline's input cotangent) --
+    def _loss_and_grads_1f1b(self, params, ids, labels):
+        c, pc = self.config, self.pc
+        mesh = self.mesh
+        B, T = ids.shape
+        M = pc.micro_batches
+        if B % M:
+            raise ValueError(
+                f"micro_batches ({M}) must divide the batch size ({B})")
+        cos, sin = _rope_cos_sin(T, c.head_dim, c.rope_theta, jnp.float32)
+        template = self._template
+
+        def block(lp, x):
+            return functional_call(template, lp, Tensor(x), cos, sin)
+
+        if pc.remat:
+            block = jax.checkpoint(block)
+
+        def stage_fn(stage_params, x):
+            def body(carry, lp):
+                return block(lp, carry), None
+            out, _ = jax.lax.scan(body, x, stage_params)
+            return out
+
+        def embed_fn(emb):
+            h = jnp.take(emb, ids, axis=0)
+            h = jax.lax.with_sharding_constraint(
+                h, NamedSharding(mesh, P("dp", None, None)))
+            return h.reshape((M, B // M, T, c.hidden_size))
+
+        micro, embed_vjp = jax.vjp(embed_fn, params["embed"])
+        lbl_micro = labels.reshape(M, B // M, T)
+        loss_params = {"norm": params["norm"], "head": params["head"]}
+
+        from ..kernels.rms_norm import rms_norm_fp32
+
+        def loss_fn(y, lbl, lp):
+            """SUM-convention CE over one microbatch (final norm + head)."""
+            h = rms_norm_fp32(y, lp["norm"], c.rms_norm_eps)
+            H = h.shape[-1]
+            hf = h.reshape(-1, H)
+            lf = lbl.reshape(-1)
+            C = pc.loss_chunks if hf.shape[0] % pc.loss_chunks == 0 else 1
+            hc = hf.reshape(C, -1, H)
+            lc = lf.reshape(C, -1)
+
+            @jax.checkpoint
+            def chunk_loss(args):
+                hunk, gold_ids = args
+                logits = (hunk @ lp["head"]).astype(jnp.float32)
+                lse = jax.scipy.special.logsumexp(logits, axis=-1)
+                gold = jnp.take_along_axis(logits, gold_ids[..., None],
+                                           axis=-1)[..., 0]
+                return (lse - gold).sum()
+
+            return jax.lax.map(chunk_loss, (hc, lc)).sum()
+
+        loss_sum, d_blocks, d_lp, d_micro = pipeline_1f1b_grads(
+            mesh, "pp", stage_fn, loss_fn, params["blocks"], loss_params,
+            micro, lbl_micro)
+
+        n_tok = jnp.float32(B * T)
+        scale = lambda g: g / n_tok  # noqa: E731  (sum -> mean convention)
+        grads = {
+            "embed": scale(embed_vjp(d_micro)[0]),
+            "head": scale(d_lp["head"]),
+            "norm": scale(d_lp["norm"]),
+            "blocks": jax.tree_util.tree_map(scale, d_blocks),
+        }
+        return loss_sum / n_tok, grads
 
     # ---- adamw ----
     def _update(self, state, grads):
@@ -288,10 +378,16 @@ class PretrainStep:
     # ---- the jitted step ----
     def train_step(self, state, ids, labels):
         if self._jit_step is None:
-            def step(state, ids, labels):
-                loss, grads = jax.value_and_grad(
-                    lambda p: self._forward_loss(p, ids, labels))(state["params"])
-                return self._update(state, grads), loss
+            if self.pc.schedule == "1f1b":
+                def step(state, ids, labels):
+                    loss, grads = self._loss_and_grads_1f1b(
+                        state["params"], ids, labels)
+                    return self._update(state, grads), loss
+            else:
+                def step(state, ids, labels):
+                    loss, grads = jax.value_and_grad(
+                        lambda p: self._forward_loss(p, ids, labels))(state["params"])
+                    return self._update(state, grads), loss
 
             self._jit_step = jax.jit(step, donate_argnums=(0,))
         return self._jit_step(state, ids, labels)

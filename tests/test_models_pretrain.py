@@ -114,6 +114,10 @@ def test_pipeline_single_stage_scan(rng):
           remat=True), "dp2pp2mp2_sp_remat"),
     (dict(dp=8), "dp8"),
     (dict(mp=8, sequence_parallel=True), "mp8_sp"),
+    (dict(pp=2, mp=2, micro_batches=4, schedule="interleave",
+          virtual_pp=2), "pp2v2_interleave"),
+    (dict(dp=2, pp=2, micro_batches=4, schedule="1f1b", remat=True),
+     "pp2_1f1b"),
 ])
 def test_pretrain_hybrid_parity(rng, pcfg_kw, name):
     from paddle_tpu.models.llama import LlamaConfig
