@@ -89,9 +89,9 @@ def is_compiled_with_tpu() -> bool:
 # Subsystem imports (each mirrors a reference python/paddle/* package).
 _SUBMODULES = [
     "nn", "optimizer", "amp", "io", "jit", "autograd", "framework", "vision",
-    "linalg", "fft", "incubate", "metric", "sparse", "profiler", "hapi",
-    "device", "distributed", "distribution", "static", "audio", "text",
-    "quantization", "utils",
+    "linalg", "fft", "signal", "incubate", "metric", "sparse", "profiler",
+    "hapi", "device", "distributed", "distribution", "static", "audio",
+    "text", "quantization", "utils",
 ]
 
 

@@ -215,8 +215,17 @@ def apply(name: str, prim: Callable, tensor_args: Sequence, kwargs: dict | None 
             _check_nan_inf(name, out if isinstance(out, (tuple, list)) else (out,))
         return _wrap_outputs(out, None)
 
+    # close over only the NON-diff inputs: diff arrays arrive as arguments,
+    # and keeping a second reference to them (or their amp-cast copies) here
+    # would pin memory beyond what node.inputs already holds
+    n_args = len(arrays)
+    nondiff = tuple((i, a) for i, a in enumerate(arrays)
+                    if i not in set(diff_idx))
+
     def f(*diff_arrays):
-        full = list(arrays)
+        full = [None] * n_args
+        for i, a in nondiff:
+            full[i] = a
         for i, d in zip(diff_idx, diff_arrays):
             full[i] = d
         return prim(*full, **kwargs)

@@ -378,3 +378,52 @@ def flash_attention(query, key, value, causal=False):
     args = tuple(a if isinstance(a, Tensor) else Tensor(a) for a in (query, key, value))
     return apply_op("flash_attention",
                     lambda q, k, v: _flash_attention_arrays(q, k, v, causal), args)
+
+
+# --------------------------------------------------------------------------
+# varlen (unpadded) attention
+# --------------------------------------------------------------------------
+
+def _segments_from_cu(cu, total):
+    """cu_seqlens [B+1] -> (segment id, position-in-segment) per token."""
+    tok = jnp.arange(total)
+    seg = jnp.searchsorted(cu[1:], tok, side="right")
+    pos = tok - cu[seg]
+    return seg, pos
+
+
+def flash_attn_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k, causal=False):
+    """Unpadded variable-length attention (reference ops.yaml:
+    flash_attn_unpadded / flash_attn_varlen_qkvpacked).
+
+    q/k/v: [total_tokens, heads, dim] — sequences packed back-to-back;
+    cu_seqlens: [batch+1] cumulative lengths.  Tokens only attend within
+    their own segment (block-diagonal mask), causally if requested.
+
+    XLA-fused segment-mask formulation: on TPU the perf path for training is
+    the padded-batch Pallas kernel (flash_attention); this op exists for the
+    packed-sequence API and inference prefill over ragged batches.
+    """
+    def prim(q_, k_, v_, cq, ck):
+        tq, h, d = q_.shape
+        tk = k_.shape[0]
+        seg_q, pos_q = _segments_from_cu(cq, tq)
+        seg_k, pos_k = _segments_from_cu(ck, tk)
+        scale = 1.0 / math.sqrt(d)
+        s = jnp.einsum("qhd,khd->hqk", q_.astype(jnp.float32),
+                       k_.astype(jnp.float32)) * scale
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            mask = jnp.logical_and(mask, pos_q[:, None] >= pos_k[None, :])
+        s = jnp.where(mask[None], s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("hqk,khd->qhd", p, v_.astype(jnp.float32))
+        return out.astype(q_.dtype)
+
+    return apply_op("flash_attn_varlen",
+                    prim,
+                    tuple(a if isinstance(a, Tensor) else Tensor(a)
+                          for a in (q, k, v, cu_seqlens_q, cu_seqlens_k)))
+
+
+flash_attn_unpadded = flash_attn_varlen

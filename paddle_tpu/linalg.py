@@ -12,3 +12,7 @@ __all__ = [n for n, obj in vars(_l).items()
 for _n in __all__:
     globals()[_n] = getattr(_l, _n)
 del _inspect, _l, _n
+
+from .ops.linalg import lu_unpack  # noqa: E402,F401
+
+__all__.append("lu_unpack")

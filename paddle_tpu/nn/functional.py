@@ -530,6 +530,8 @@ def _pool(x, kernel, stride, padding, nd, reducer, init, data_format, count_incl
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False,
                data_format="NCHW", name=None):
+    if return_mask:
+        return max_pool2d_with_index(x, kernel_size, stride, padding)
     prim, *_ = _pool(x, kernel_size, stride, padding, 2, jax.lax.max, -jnp.inf, data_format)
     return apply_op("max_pool2d", prim, (_t(x),))
 
@@ -952,3 +954,422 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
         out = out.at[:, :, 2 * fold:].set(a[:, :, 2 * fold:])
         return out.reshape(nt, c, h, w)
     return apply_op("temporal_shift", prim, (_t(x),))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    """Inverse of pixel_shuffle (reference ops.yaml: pixel_unshuffle)."""
+    r = int(downscale_factor)
+
+    def prim(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+        return a.reshape(n, c * r * r, h // r, w // r)
+    return apply_op("pixel_unshuffle", prim, (_t(x),))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """reference ops.yaml: channel_shuffle (ShuffleNet block)."""
+    g = int(groups)
+
+    def prim(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(a.shape)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, g, c // g).swapaxes(3, 4).reshape(a.shape)
+    return apply_op("channel_shuffle", prim, (_t(x),))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """col2im — exact adjoint of unfold (reference ops.yaml: fold).
+
+    x: [N, C*kh*kw, L] -> [N, C, H, W], overlapping patches summed.
+    """
+    out_hw = _pair(output_sizes, 2)
+    k = _pair(kernel_sizes, 2)
+    s = _pair(strides, 2)
+    p = _pair(paddings, 2)
+    d = _pair(dilations, 2)
+
+    def prim(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        ph, pw = out_hw[0] + 2 * p[0], out_hw[1] + 2 * p[1]
+        kh = (k[0] - 1) * d[0] + 1
+        kw = (k[1] - 1) * d[1] + 1
+        oh = (ph - kh) // s[0] + 1
+        ow = (pw - kw) // s[1] + 1
+        a = a.reshape(n, c, k[0], k[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                             j * d[1]: j * d[1] + ow * s[1]: s[1]].add(
+                    a[:, :, i, j])
+        return out[:, :, p[0]: p[0] + out_hw[0], p[1]: p[1] + out_hw[1]]
+    return apply_op("fold", prim, (_t(x),))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """reference ops.yaml: grid_sample (STN / deformable heads / diffusion
+    warping).  x: [N, C, H, W]; grid: [N, Hg, Wg, 2] in [-1, 1] (x then y).
+    Gather-based bilinear with border/zeros/reflection handling — all
+    vectorized jnp, so XLA fuses the 4 corner gathers.
+    """
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode {mode!r} not supported")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"grid_sample padding_mode {padding_mode!r}")
+
+    def prim(a, g):
+        n, c, h, w = a.shape
+
+        def unnormalize(coord, size):
+            if align_corners:
+                return (coord + 1) * 0.5 * (size - 1)
+            return ((coord + 1) * size - 1) * 0.5
+
+        def reflect(coord, size):
+            if align_corners:
+                span = 2 * (size - 1)
+                if size == 1:
+                    return jnp.zeros_like(coord)
+                m = jnp.mod(coord, span)
+                return jnp.where(m > size - 1, span - m, m)
+            span = 2 * size
+            m = jnp.mod(coord + 0.5, span)
+            return jnp.clip(jnp.where(m > size - 0.5, span - m, m) - 0.5,
+                            0, size - 1)
+
+        gx = unnormalize(g[..., 0].astype(jnp.float32), w)   # [N, Hg, Wg]
+        gy = unnormalize(g[..., 1].astype(jnp.float32), h)
+        if padding_mode == "reflection":
+            gx, gy = reflect(gx, w), reflect(gy, h)
+
+        # vectorized corner gather via take-along flattened spatial dim
+        flat = a.reshape(n, c, h * w)
+
+        def sample(iy, ix, in_bounds):
+            iyc = jnp.clip(iy, 0, h - 1)
+            ixc = jnp.clip(ix, 0, w - 1)
+            idx = (iyc * w + ixc).reshape(n, 1, -1)            # [N,1,Hg*Wg]
+            vals = jnp.take_along_axis(flat, idx.astype(jnp.int32), axis=2)
+            vals = vals.reshape(n, c, *g.shape[1:3])
+            if padding_mode == "zeros":
+                vals = vals * in_bounds.reshape(n, 1, *g.shape[1:3])
+            return vals
+
+        if mode == "nearest":
+            ix = jnp.round(gx).astype(jnp.int32)
+            iy = jnp.round(gy).astype(jnp.int32)
+            inb = ((ix >= 0) & (ix <= w - 1) & (iy >= 0)
+                   & (iy <= h - 1)).astype(a.dtype)
+            return sample(iy, ix, inb)
+
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (gx - x0).astype(a.dtype)
+        wy = (gy - y0).astype(a.dtype)
+
+        def inb(iy, ix):
+            return ((ix >= 0) & (ix <= w - 1) & (iy >= 0)
+                    & (iy <= h - 1)).astype(a.dtype)
+
+        v00 = sample(y0, x0, inb(y0, x0))
+        v01 = sample(y0, x1, inb(y0, x1))
+        v10 = sample(y1, x0, inb(y1, x0))
+        v11 = sample(y1, x1, inb(y1, x1))
+        wx = wx.reshape(n, 1, *g.shape[1:3])
+        wy = wy.reshape(n, 1, *g.shape[1:3])
+        top = v00 * (1 - wx) + v01 * wx
+        bot = v10 * (1 - wx) + v11 * wx
+        return top * (1 - wy) + bot * wy
+
+    return apply_op("grid_sample", prim, (_t(x), _t(grid)))
+
+
+def swiglu(x, y=None, name=None):
+    """reference ops.yaml: swiglu (fused SwiGLU MLP gate) — silu(x) * y;
+    with y=None, x is split in half on the last dim."""
+    if y is None:
+        def prim(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+        return apply_op("swiglu", prim, (_t(x),))
+    return apply_op("swiglu", lambda a, b: jax.nn.silu(a) * b, (_t(x), _t(y)))
+
+
+def fused_softmax_mask(x, mask, scale=1.0, name=None):
+    """reference ops.yaml: fused_softmax_mask — softmax(x*scale + mask) on
+    [N, H, Tq, Tk] attention scores; one XLA fusion on TPU."""
+    return apply_op("fused_softmax_mask",
+                    lambda a, m: jax.nn.softmax(
+                        a.astype(jnp.float32) * scale + m.astype(jnp.float32),
+                        axis=-1).astype(a.dtype),
+                    (_t(x), _t(mask)))
+
+
+def fused_softmax_mask_upper_triangle(x, name=None):
+    """reference ops.yaml: fused_softmax_mask_upper_triangle — causal-masked
+    softmax (upper triangle excluded), fp32 accumulation."""
+    def prim(a):
+        t_q, t_k = a.shape[-2], a.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        s = jnp.where(mask, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(s, axis=-1).astype(a.dtype)
+    return apply_op("fused_softmax_mask_upper_triangle", prim, (_t(x),))
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):  # noqa: A002
+    """reference ops.yaml: huber_loss (quadratic within delta, linear out)."""
+    def prim(a, b):
+        diff = a - b
+        ad = jnp.abs(diff)
+        out = jnp.where(ad <= delta, 0.5 * diff * diff,
+                        delta * (ad - 0.5 * delta))
+        if reduction == "mean":
+            return out.mean()
+        if reduction == "sum":
+            return out.sum()
+        return out
+    return apply_op("huber_loss", prim, (_t(input), _t(label)))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    """reference ops.yaml: log_loss — negative log-likelihood of a Bernoulli
+    probability prediction."""
+    def prim(p_, y):
+        return (-y * jnp.log(p_ + epsilon)
+                - (1.0 - y) * jnp.log(1.0 - p_ + epsilon))
+    return apply_op("log_loss", prim, (_t(input), _t(label)))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return max_pool3d_with_index(x, kernel_size, stride, padding)
+    prim, *_ = _pool(x, kernel_size, stride, padding, 3, jax.lax.max,
+                     -jnp.inf, data_format)
+    return apply_op("max_pool3d", prim, (_t(x),))
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    _, dims, strides, pads = _pool(x, kernel_size, stride, padding, 3,
+                                   jax.lax.add, 0.0, data_format)
+
+    def prim(a):
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides, pads)
+        if divisor_override:
+            return s / divisor_override
+        if exclusive and any(p != (0, 0) for p in pads):
+            # exclusive mean: divide border windows by the in-bounds count
+            cnt = jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add,
+                                        dims, strides, pads)
+            return s / cnt
+        return s / float(np.prod(dims))
+    return apply_op("avg_pool3d", prim, (_t(x),))
+
+
+def _pool_with_index(x, kernel_size, stride, padding, nd, data_format):
+    """Max pooling that also returns flat spatial argmax indices (reference
+    max_pool2d_with_index / max_pool3d_with_index)."""
+    kernel = _pair(kernel_size, nd)
+    stride_ = _pair(stride if stride is not None else kernel_size, nd)
+    p = _pair(padding, nd)
+    dims = (1, 1) + kernel
+    strides = (1, 1) + stride_
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+
+    def prim(a):
+        spatial = a.shape[2:]
+        flat_idx = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+        idx = jnp.broadcast_to(flat_idx, a.shape).astype(jnp.int32)
+
+        def reducer(c1, c2):
+            v1, i1 = c1
+            v2, i2 = c2
+            take2 = v2 > v1
+            return jnp.where(take2, v2, v1), jnp.where(take2, i2, i1)
+
+        out, ind = jax.lax.reduce_window(
+            (a, idx), (jnp.asarray(-jnp.inf, a.dtype), jnp.int32(-1)),
+            reducer, dims, strides, pads, (1,) * a.ndim, (1,) * a.ndim)
+        return out, ind
+    return prim
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0, name=None):
+    prim = _pool_with_index(x, kernel_size, stride, padding, 2, "NCHW")
+    return apply_op("max_pool2d_with_index", prim, (_t(x),))
+
+
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0, name=None):
+    prim = _pool_with_index(x, kernel_size, stride, padding, 3, "NCDHW")
+    return apply_op("max_pool3d_with_index", prim, (_t(x),))
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """reference ops.yaml: unpool — scatter pooled values back to the argmax
+    positions (zeros elsewhere)."""
+    k = _pair(kernel_size, 2)
+    s = _pair(stride if stride is not None else kernel_size, 2)
+    p = _pair(padding, 2)
+
+    def prim(a, ind):
+        n, c, h, w = a.shape
+        if output_size is not None:
+            oh, ow = [int(v) for v in output_size[-2:]]
+        else:
+            oh = (h - 1) * s[0] - 2 * p[0] + k[0]
+            ow = (w - 1) * s[1] - 2 * p[1] + k[1]
+        flat = jnp.zeros((n, c, oh * ow), a.dtype)
+        out = flat.at[
+            jnp.arange(n)[:, None, None],
+            jnp.arange(c)[None, :, None],
+            ind.reshape(n, c, -1)].add(a.reshape(n, c, -1))
+        return out.reshape(n, c, oh, ow)
+    return apply_op("max_unpool2d", prim, (_t(x), _t(indices)))
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    k = _pair(kernel_size, 3)
+    s = _pair(stride if stride is not None else kernel_size, 3)
+    p = _pair(padding, 3)
+
+    def prim(a, ind):
+        n, c, d, h, w = a.shape
+        if output_size is not None:
+            od, oh, ow = [int(v) for v in output_size[-3:]]
+        else:
+            od = (d - 1) * s[0] - 2 * p[0] + k[0]
+            oh = (h - 1) * s[1] - 2 * p[1] + k[1]
+            ow = (w - 1) * s[2] - 2 * p[2] + k[2]
+        flat = jnp.zeros((n, c, od * oh * ow), a.dtype)
+        out = flat.at[
+            jnp.arange(n)[:, None, None],
+            jnp.arange(c)[None, :, None],
+            ind.reshape(n, c, -1)].add(a.reshape(n, c, -1))
+        return out.reshape(n, c, od, oh, ow)
+    return apply_op("max_unpool3d", prim, (_t(x), _t(indices)))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op("thresholded_relu",
+                    lambda a: jnp.where(a > threshold, a, value), (_t(x),))
+
+
+tanh_shrink = tanhshrink  # reference ops.yaml name
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True, name=None):
+    """reference ops.yaml: rrelu — randomized leaky ReLU (train) / fixed
+    mean slope (eval)."""
+    x = _t(x)
+    if not training:
+        slope = (lower + upper) / 2.0
+        return apply_op("rrelu", lambda a: jnp.where(a >= 0, a, slope * a),
+                        (x,))
+    alpha = jax.random.uniform(rnd.next_key(), tuple(x._data.shape),
+                               jnp.float32, lower, upper)
+
+    def prim(a):
+        return jnp.where(a >= 0, a, alpha.astype(a.dtype) * a)
+    return apply_op("rrelu", prim, (x,))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """reference ops.yaml: affine_grid — sampling grid for grid_sample from
+    a batch of 2x3 affine matrices.  out_shape: [N, C, H, W]."""
+    if isinstance(out_shape, Tensor):
+        out_shape = out_shape.tolist()
+    n, _, h, w = [int(v) for v in out_shape]
+
+    def prim(th):
+        def line(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size)
+            return (jnp.arange(size) * 2 + 1) / size - 1.0
+        ys = line(h)
+        xs = line(w)
+        base = jnp.stack(
+            [jnp.tile(xs[None, :], (h, 1)),
+             jnp.tile(ys[:, None], (1, w)),
+             jnp.ones((h, w))], axis=-1)            # [H, W, 3]
+        grid = jnp.einsum("hwk,nik->nhwi", base, th.astype(jnp.float32))
+        return grid                                  # [N, H, W, 2]
+    return apply_op("affine_grid", prim, (_t(theta),))
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    """reference ops.yaml: fractional_max_pool2d — pseudo-random bin edges
+    (Graham 2014).  Uses the deterministic `random_u` when given (paddle
+    semantics), else draws one."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    u = float(random_u) if random_u is not None else float(
+        jax.random.uniform(rnd.next_key(), ()))
+
+    def edges(in_size, out_size):
+        # alpha-spaced pseudo-fractional bins: ceil(alpha*(i+u)) - ceil(alpha*u)
+        alpha = in_size / out_size
+        i = np.arange(out_size + 1)
+        e = np.ceil(alpha * (i + u)).astype(int) - int(np.ceil(alpha * u))
+        e[-1] = in_size
+        return np.clip(e, 0, in_size)
+
+    def prim(a):
+        n, c, h, w = a.shape
+        eh = edges(h, oh)
+        ew = edges(w, ow)
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                block = a[:, :, eh[i]:max(eh[i + 1], eh[i] + 1),
+                          ew[j]:max(ew[j + 1], ew[j] + 1)]
+                cols.append(block.max(axis=(2, 3)))
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+    return apply_op("fractional_max_pool2d", prim, (_t(x),))
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Composes the 2d fractional pool: (h, w) first with depth folded into
+    channels, then the depth axis with unit bins on the folded (oh*ow)."""
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    od, oh, ow = output_size
+    x = _t(x)
+    n, c, d, h, w = x.shape
+    hw = fractional_max_pool2d(x.reshape([n, c * d, h, w]), (oh, ow),
+                               random_u=random_u)
+    x2 = hw.reshape([n, c, d, oh * ow])            # [N, C, H=d, W=oh*ow]
+    out = fractional_max_pool2d(x2, (od, oh * ow), random_u=random_u)
+    return out.reshape([n, c, od, oh, ow])
+
+
+def spectral_norm(weight, n_power_iterations=1, eps=1e-12, dim=0, name=None):
+    """reference ops.yaml: spectral_norm — W / sigma_max(W) via power
+    iteration (GAN regularization)."""
+    def prim(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((wm.shape[0],), jnp.float32)
+        v = jnp.ones((wm.shape[1],), jnp.float32)
+        for _ in range(max(1, n_power_iterations)):
+            v = wm.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = wm @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ wm @ v
+        return w / jnp.maximum(sigma, eps)
+    return apply_op("spectral_norm", prim, (_t(weight),))

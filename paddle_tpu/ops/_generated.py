@@ -1,0 +1,239 @@
+"""AUTO-GENERATED — DO NOT EDIT.
+
+Generated from ops/schema.yaml by `python -m paddle_tpu.ops.gen`.
+Edit the schema and regenerate; tests/test_ops_schema.py enforces sync.
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ._prim import binary_op, reduce_op, unary_op
+
+__all__ = [
+    'abs',
+    'acos',
+    'acosh',
+    'add',
+    'all',
+    'amax',
+    'amin',
+    'angle',
+    'any',
+    'asin',
+    'asinh',
+    'atan',
+    'atan2',
+    'atanh',
+    'bitwise_and',
+    'bitwise_left_shift',
+    'bitwise_not',
+    'bitwise_or',
+    'bitwise_right_shift',
+    'bitwise_xor',
+    'cbrt',
+    'ceil',
+    'conj',
+    'copysign',
+    'cos',
+    'cosh',
+    'count_nonzero',
+    'deg2rad',
+    'digamma',
+    'divide',
+    'equal',
+    'erf',
+    'erfc',
+    'erfinv',
+    'exp',
+    'exp2',
+    'expm1',
+    'fix',
+    'floor',
+    'floor_divide',
+    'floor_mod',
+    'fmax',
+    'fmin',
+    'frac',
+    'gammainc',
+    'gammaincc',
+    'gammaln',
+    'gcd',
+    'greater_equal',
+    'greater_than',
+    'heaviside',
+    'hypot',
+    'i0',
+    'i0e',
+    'i1',
+    'i1e',
+    'imag',
+    'isfinite',
+    'isinf',
+    'isnan',
+    'isreal',
+    'lcm',
+    'ldexp',
+    'less_equal',
+    'less_than',
+    'lgamma',
+    'log',
+    'log10',
+    'log1p',
+    'log2',
+    'logaddexp',
+    'logical_and',
+    'logical_not',
+    'logical_or',
+    'logical_xor',
+    'logit',
+    'logsigmoid',
+    'logsumexp',
+    'max',
+    'maximum',
+    'mean',
+    'min',
+    'minimum',
+    'mod',
+    'multiply',
+    'nanmean',
+    'nansum',
+    'neg',
+    'nextafter',
+    'not_equal',
+    'pow',
+    'prod',
+    'rad2deg',
+    'real',
+    'reciprocal',
+    'remainder',
+    'round',
+    'rsqrt',
+    'sigmoid',
+    'sign',
+    'signbit',
+    'sin',
+    'sinc',
+    'sinh',
+    'sqrt',
+    'square',
+    'subtract',
+    'sum',
+    'tan',
+    'tanh',
+    'true_divide',
+    'trunc',
+]
+
+exp = unary_op("exp", jnp.exp)
+expm1 = unary_op("expm1", jnp.expm1)
+exp2 = unary_op("exp2", jnp.exp2)
+log = unary_op("log", jnp.log)
+log2 = unary_op("log2", jnp.log2)
+log10 = unary_op("log10", jnp.log10)
+log1p = unary_op("log1p", jnp.log1p)
+sqrt = unary_op("sqrt", jnp.sqrt)
+rsqrt = unary_op("rsqrt", jax.lax.rsqrt)
+cbrt = unary_op("cbrt", jnp.cbrt)
+square = unary_op("square", jnp.square)
+abs = unary_op("abs", jnp.abs)  # noqa: A001
+sign = unary_op("sign", jnp.sign)
+signbit = unary_op("signbit", jnp.signbit)
+ceil = unary_op("ceil", jnp.ceil)
+floor = unary_op("floor", jnp.floor)
+round = unary_op("round", jnp.round)  # noqa: A001
+trunc = unary_op("trunc", jnp.trunc)
+fix = trunc
+frac = unary_op("frac", lambda x: x - jnp.trunc(x))
+reciprocal = unary_op("reciprocal", lambda x: 1.0 / x)
+neg = unary_op("neg", jnp.negative)
+sin = unary_op("sin", jnp.sin)
+cos = unary_op("cos", jnp.cos)
+tan = unary_op("tan", jnp.tan)
+asin = unary_op("asin", jnp.arcsin)
+acos = unary_op("acos", jnp.arccos)
+atan = unary_op("atan", jnp.arctan)
+sinh = unary_op("sinh", jnp.sinh)
+cosh = unary_op("cosh", jnp.cosh)
+tanh = unary_op("tanh", jnp.tanh)
+asinh = unary_op("asinh", jnp.arcsinh)
+acosh = unary_op("acosh", jnp.arccosh)
+atanh = unary_op("atanh", jnp.arctanh)
+sinc = unary_op("sinc", jnp.sinc)
+deg2rad = unary_op("deg2rad", jnp.deg2rad)
+rad2deg = unary_op("rad2deg", jnp.rad2deg)
+erf = unary_op("erf", jsp.erf)
+erfc = unary_op("erfc", jsp.erfc)
+erfinv = unary_op("erfinv", jsp.erfinv)
+lgamma = unary_op("lgamma", jsp.gammaln)
+gammaln = lgamma
+digamma = unary_op("digamma", jsp.digamma)
+i0 = unary_op("i0", jsp.i0)
+i0e = unary_op("i0e", jsp.i0e)
+i1 = unary_op("i1", jsp.i1)
+i1e = unary_op("i1e", jsp.i1e)
+logit = unary_op("logit", jsp.logit)
+sigmoid = unary_op("sigmoid", jax.nn.sigmoid)
+logsigmoid = unary_op("logsigmoid", jax.nn.log_sigmoid)
+angle = unary_op("angle", jnp.angle)
+conj = unary_op("conj", jnp.conj)
+real = unary_op("real", jnp.real)
+imag = unary_op("imag", jnp.imag)
+isnan = unary_op("isnan", jnp.isnan)
+isinf = unary_op("isinf", jnp.isinf)
+isfinite = unary_op("isfinite", jnp.isfinite)
+isreal = unary_op("isreal", jnp.isreal)
+logical_not = unary_op("logical_not", jnp.logical_not)
+bitwise_not = unary_op("bitwise_not", jnp.bitwise_not)
+add = binary_op("add", jnp.add)
+subtract = binary_op("subtract", jnp.subtract)
+multiply = binary_op("multiply", jnp.multiply)
+divide = binary_op("divide", jnp.divide)
+true_divide = divide
+floor_divide = binary_op("floor_divide", jnp.floor_divide)
+mod = binary_op("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = binary_op("pow", jnp.power)  # noqa: A001
+maximum = binary_op("maximum", jnp.maximum)
+minimum = binary_op("minimum", jnp.minimum)
+fmax = binary_op("fmax", jnp.fmax)
+fmin = binary_op("fmin", jnp.fmin)
+atan2 = binary_op("atan2", jnp.arctan2)
+hypot = binary_op("hypot", jnp.hypot)
+logaddexp = binary_op("logaddexp", jnp.logaddexp)
+heaviside = binary_op("heaviside", jnp.heaviside)
+copysign = binary_op("copysign", jnp.copysign)
+nextafter = binary_op("nextafter", jnp.nextafter)
+ldexp = binary_op("ldexp", jnp.ldexp)
+gcd = binary_op("gcd", jnp.gcd)
+lcm = binary_op("lcm", jnp.lcm)
+gammainc = binary_op("gammainc", jsp.gammainc)
+gammaincc = binary_op("gammaincc", jsp.gammaincc)
+equal = binary_op("equal", jnp.equal)
+not_equal = binary_op("not_equal", jnp.not_equal)
+less_than = binary_op("less_than", jnp.less)
+less_equal = binary_op("less_equal", jnp.less_equal)
+greater_than = binary_op("greater_than", jnp.greater)
+greater_equal = binary_op("greater_equal", jnp.greater_equal)
+logical_and = binary_op("logical_and", jnp.logical_and)
+logical_or = binary_op("logical_or", jnp.logical_or)
+logical_xor = binary_op("logical_xor", jnp.logical_xor)
+bitwise_and = binary_op("bitwise_and", jnp.bitwise_and)
+bitwise_or = binary_op("bitwise_or", jnp.bitwise_or)
+bitwise_xor = binary_op("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = binary_op("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = binary_op("bitwise_right_shift", jnp.right_shift)
+sum = reduce_op("sum", jnp.sum, dtype_arg=True)  # noqa: A001
+mean = reduce_op("mean", jnp.mean)
+prod = reduce_op("prod", jnp.prod, dtype_arg=True)
+max = reduce_op("max", jnp.max)  # noqa: A001
+min = reduce_op("min", jnp.min)  # noqa: A001
+amax = reduce_op("amax", jnp.max)
+amin = reduce_op("amin", jnp.min)
+nanmean = reduce_op("nanmean", jnp.nanmean)
+nansum = reduce_op("nansum", jnp.nansum)
+logsumexp = reduce_op("logsumexp", jsp.logsumexp)
+all = reduce_op("all", jnp.all)  # noqa: A001
+any = reduce_op("any", jnp.any)  # noqa: A001
+count_nonzero = reduce_op("count_nonzero", jnp.count_nonzero)

@@ -29,3 +29,56 @@ def as_tensors(*vals):
     for v in vals:
         out.append(v if isinstance(v, Tensor) else Tensor(v))
     return out
+
+
+# ---- table-op factories (consumed by the schema codegen, ops/gen.py) ----
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def unary_op(name, fn):
+    def op(x, name=None):
+        return apply_op(name_, fn, (_t(x),))
+    name_ = name
+    op.__name__ = name
+    register_op(name, fn)
+    return op
+
+
+def binary_op(name, fn):
+    def op(x, y, name=None):
+        xt = isinstance(x, Tensor)
+        yt = isinstance(y, Tensor)
+        if not xt and not yt:
+            x = Tensor(x)
+        return apply_op(name_, fn, (x if xt or not yt else x, y))
+    name_ = name
+    op.__name__ = name
+    register_op(name, fn)
+    return op
+
+
+def reduce_op(name, fn, dtype_arg=False):
+    from .. import dtypes
+
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _axis(axis)
+        kw = {"axis": ax, "keepdims": keepdim}
+        if dtype_arg and dtype is not None:
+            kw["dtype"] = dtypes.convert_dtype(dtype)
+        return apply_op(name_, lambda a: fn(a, **kw), (_t(x),))
+    name_ = name
+    op.__name__ = name
+    register_op(name, fn)
+    return op

@@ -138,3 +138,19 @@ def complex(real, imag, name=None) -> Tensor:
 
 
 import jax  # noqa: E402  (used by complex)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
+    """reference ops.yaml: tril_indices -> [2, n] indices."""
+    from .. import dtypes as _dt
+    col = row if col is None else col
+    r, c = np.tril_indices(int(row), int(offset), int(col))
+    return Tensor(jnp.asarray(np.stack([r, c]), _dt.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    """reference ops.yaml: triu_indices -> [2, n] indices."""
+    from .. import dtypes as _dt
+    col = row if col is None else col
+    r, c = np.triu_indices(int(row), int(offset), int(col))
+    return Tensor(jnp.asarray(np.stack([r, c]), _dt.convert_dtype(dtype)))

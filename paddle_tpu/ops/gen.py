@@ -1,0 +1,95 @@
+"""Op codegen: schema.yaml -> _generated.py.
+
+TPU-native analog of the reference's generator stack
+(paddle/phi/api/generator/api_gen.py and friends, driven by
+paddle/phi/ops/yaml/ops.yaml).  One generator suffices because the runtime
+collapsed: the emitted code is plain python binding a jax impl through the
+table-op factories in ops/_prim.py, which handle tape recording, amp casting
+and registry entry.  The generated file is CHECKED IN and a test
+(tests/test_ops_schema.py) regenerates it and asserts sync, so the schema can
+never drift from the shipped API.
+
+Usage:
+  python -m paddle_tpu.ops.gen            # (re)write _generated.py
+  python -m paddle_tpu.ops.gen --check    # exit 1 if out of sync
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import yaml
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SCHEMA = os.path.join(_HERE, "schema.yaml")
+TARGET = os.path.join(_HERE, "_generated.py")
+
+_FACTORY = {"unary": "unary_op", "binary": "binary_op", "reduce": "reduce_op"}
+
+_HEADER = '''\
+"""AUTO-GENERATED — DO NOT EDIT.
+
+Generated from ops/schema.yaml by `python -m paddle_tpu.ops.gen`.
+Edit the schema and regenerate; tests/test_ops_schema.py enforces sync.
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ._prim import binary_op, reduce_op, unary_op
+
+__all__ = {all_list}
+
+'''
+
+
+def render(schema_path: str = SCHEMA) -> str:
+    with open(schema_path) as f:
+        schema = yaml.safe_load(f)
+    lines = []
+    names = []
+    seen = set()
+    for entry in schema["ops"]:
+        op, kind, impl = entry["op"], entry["kind"], entry["impl"]
+        if op in seen:
+            raise ValueError(f"duplicate op in schema: {op}")
+        seen.add(op)
+        if kind not in _FACTORY:
+            raise ValueError(f"unknown kind {kind!r} for op {op}")
+        extra = ", dtype_arg=True" if entry.get("dtype_arg") else ""
+        noqa = "  # noqa: A001" if op in (
+            "abs", "round", "pow", "sum", "max", "min", "all", "any") else ""
+        lines.append(f'{op} = {_FACTORY[kind]}("{op}", {impl}{extra}){noqa}')
+        names.append(op)
+        for alias in entry.get("aliases", ()) or ():
+            if alias in seen:
+                raise ValueError(f"duplicate alias in schema: {alias}")
+            seen.add(alias)
+            lines.append(f"{alias} = {op}")
+            names.append(alias)
+    body = "\n".join(lines) + "\n"
+    all_list = "[\n    " + ",\n    ".join(
+        repr(n) for n in sorted(names)) + ",\n]"
+    return _HEADER.format(all_list=all_list) + body
+
+
+def main(argv) -> int:
+    text = render()
+    if "--check" in argv:
+        on_disk = open(TARGET).read() if os.path.exists(TARGET) else ""
+        if on_disk != text:
+            sys.stderr.write(
+                "_generated.py is out of sync with schema.yaml — run "
+                "`python -m paddle_tpu.ops.gen`\n")
+            return 1
+        return 0
+    with open(TARGET, "w") as f:
+        f.write(text)
+    print(f"wrote {TARGET} ({len(text.splitlines())} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

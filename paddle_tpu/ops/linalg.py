@@ -276,3 +276,30 @@ def householder_product(x, tau, name=None):
             q = jnp.matmul(q, h)
         return q[..., :, :n]
     return apply_op("householder_product", prim, (_t(x), _t(tau)))
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """reference ops.yaml: lu_unpack — split packed LU (from linalg.lu) into
+    P, L, U.  x: [.., M, N] packed factors; y: [.., min(M,N)] 1-based pivots."""
+    def prim(lu, piv):
+        m, n = lu.shape[-2], lu.shape[-1]
+        k = min(m, n)
+        l = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
+        u = jnp.triu(lu[..., :k, :])
+        # pivots (1-based sequential row swaps) -> permutation matrix
+        pv = piv.astype(jnp.int32) - 1
+        pm = jnp.broadcast_to(jnp.arange(m), piv.shape[:-1] + (m,))
+
+        def swap(i, pm):
+            j = pv[..., i]
+            a = pm[..., i]
+            b = jnp.take_along_axis(pm, j[..., None], -1)[..., 0]
+            pm = pm.at[..., i].set(b)
+            return jnp.put_along_axis(pm, j[..., None], a[..., None], -1,
+                                      inplace=False)
+        for i in range(pv.shape[-1]):
+            pm = swap(i, pm)
+        p_mat = jnp.swapaxes(jax.nn.one_hot(pm, m, dtype=lu.dtype), -1, -2)
+        return p_mat, l, u
+
+    return apply_op("lu_unpack", prim, (_t(x), _t(y)))

@@ -508,3 +508,46 @@ def tensor_split(x, num_or_indices, axis=0, name=None):
     outs = jnp.array_split(x._data, num_or_indices if isinstance(num_or_indices, int)
                            else [int(i) for i in num_or_indices], axis=axis)
     return [Tensor(o) for o in outs]
+
+
+def as_complex(x, name=None):
+    """[..., 2] float -> complex (reference ops.yaml: as_complex)."""
+    return apply_op("as_complex",
+                    lambda a: jax.lax.complex(a[..., 0], a[..., 1]), (_t(x),))
+
+
+def as_real(x, name=None):
+    """complex -> [..., 2] float (reference ops.yaml: as_real)."""
+    return apply_op("as_real",
+                    lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                    (_t(x),))
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """reference ops.yaml: fill_diagonal (last-two-dims diagonal)."""
+    def prim(a):
+        n, m = a.shape[-2], a.shape[-1]
+        i = jnp.arange(n)[:, None]
+        j = jnp.arange(m)[None, :]
+        mask = (j - i) == offset
+        if wrap and a.ndim == 2 and n > m:
+            mask = (j - (i % (m + 1))) == offset
+        return jnp.where(mask, jnp.asarray(value, a.dtype), a)
+    return apply_op("fill_diagonal", prim, (_t(x),))
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """reference ops.yaml: fill_diagonal_tensor — write tensor y onto the
+    (dim1, dim2) diagonal of x."""
+    def prim(a, b):
+        am = jnp.moveaxis(a, (dim1, dim2), (-2, -1))
+        n, m = am.shape[-2], am.shape[-1]
+        diag_len = max(min(n, m - offset) if offset >= 0
+                       else min(n + offset, m), 0)
+        bb = jnp.broadcast_to(b, am.shape[:-2] + (diag_len,))
+        di = jnp.arange(diag_len)
+        rows = di if offset >= 0 else di - offset
+        cols = di + offset if offset >= 0 else di
+        out = am.at[..., rows, cols].set(bb)
+        return jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return apply_op("fill_diagonal_tensor", prim, (_t(x), _t(y)))

@@ -25,113 +25,8 @@ def _axis(axis):
     return int(axis)
 
 
-# ---------------- unary ----------------
-
-def _unary(name, fn):
-    def op(x, name=None):
-        return apply_op(name_, fn, (_t(x),))
-    name_ = name
-    op.__name__ = name
-    register_op(name, fn)
-    return op
-
-
-exp = _unary("exp", jnp.exp)
-expm1 = _unary("expm1", jnp.expm1)
-log = _unary("log", jnp.log)
-log2 = _unary("log2", jnp.log2)
-log10 = _unary("log10", jnp.log10)
-log1p = _unary("log1p", jnp.log1p)
-sqrt = _unary("sqrt", jnp.sqrt)
-rsqrt = _unary("rsqrt", jax.lax.rsqrt)
-square = _unary("square", jnp.square)
-abs = _unary("abs", jnp.abs)  # noqa: A001
-sign = _unary("sign", jnp.sign)
-sin = _unary("sin", jnp.sin)
-cos = _unary("cos", jnp.cos)
-tan = _unary("tan", jnp.tan)
-asin = _unary("asin", jnp.arcsin)
-acos = _unary("acos", jnp.arccos)
-atan = _unary("atan", jnp.arctan)
-sinh = _unary("sinh", jnp.sinh)
-cosh = _unary("cosh", jnp.cosh)
-tanh = _unary("tanh", jnp.tanh)
-asinh = _unary("asinh", jnp.arcsinh)
-acosh = _unary("acosh", jnp.arccosh)
-atanh = _unary("atanh", jnp.arctanh)
-ceil = _unary("ceil", jnp.ceil)
-floor = _unary("floor", jnp.floor)
-round = _unary("round", jnp.round)  # noqa: A001
-trunc = _unary("trunc", jnp.trunc)
-frac = _unary("frac", lambda x: x - jnp.trunc(x))
-reciprocal = _unary("reciprocal", lambda x: 1.0 / x)
-neg = _unary("neg", jnp.negative)
-erf = _unary("erf", jax.scipy.special.erf)
-erfinv = _unary("erfinv", jax.scipy.special.erfinv)
-lgamma = _unary("lgamma", jax.scipy.special.gammaln)
-digamma = _unary("digamma", jax.scipy.special.digamma)
-sigmoid = _unary("sigmoid", jax.nn.sigmoid)
-logsigmoid = _unary("logsigmoid", jax.nn.log_sigmoid)
-angle = _unary("angle", jnp.angle)
-conj = _unary("conj", jnp.conj)
-real = _unary("real", jnp.real)
-imag = _unary("imag", jnp.imag)
-isnan = _unary("isnan", jnp.isnan)
-isinf = _unary("isinf", jnp.isinf)
-isfinite = _unary("isfinite", jnp.isfinite)
-logical_not = _unary("logical_not", jnp.logical_not)
-bitwise_not = _unary("bitwise_not", jnp.bitwise_not)
-
-
-# ---------------- binary ----------------
-
-def _binary(name, fn):
-    def op(x, y, name=None):
-        xt = isinstance(x, Tensor)
-        yt = isinstance(y, Tensor)
-        if not xt and not yt:
-            x = Tensor(x)
-        return apply_op(name_, fn, (x if xt or not yt else x, y))
-    name_ = name
-    op.__name__ = name
-    register_op(name, fn)
-    return op
-
-
-add = _binary("add", jnp.add)
-subtract = _binary("subtract", jnp.subtract)
-multiply = _binary("multiply", jnp.multiply)
-divide = _binary("divide", jnp.divide)
-floor_divide = _binary("floor_divide", jnp.floor_divide)
-mod = _binary("mod", jnp.mod)
-remainder = mod
-floor_mod = mod
-pow = _binary("pow", jnp.power)  # noqa: A001
-maximum = _binary("maximum", jnp.maximum)
-minimum = _binary("minimum", jnp.minimum)
-fmax = _binary("fmax", jnp.fmax)
-fmin = _binary("fmin", jnp.fmin)
-atan2 = _binary("atan2", jnp.arctan2)
-hypot = _binary("hypot", jnp.hypot)
-logical_and = _binary("logical_and", jnp.logical_and)
-logical_or = _binary("logical_or", jnp.logical_or)
-logical_xor = _binary("logical_xor", jnp.logical_xor)
-bitwise_and = _binary("bitwise_and", jnp.bitwise_and)
-bitwise_or = _binary("bitwise_or", jnp.bitwise_or)
-bitwise_xor = _binary("bitwise_xor", jnp.bitwise_xor)
-equal = _binary("equal", lambda a, b: jnp.equal(a, b))
-not_equal = _binary("not_equal", jnp.not_equal)
-less_than = _binary("less_than", jnp.less)
-less_equal = _binary("less_equal", jnp.less_equal)
-greater_than = _binary("greater_than", jnp.greater)
-greater_equal = _binary("greater_equal", jnp.greater_equal)
-logaddexp = _binary("logaddexp", jnp.logaddexp)
-heaviside = _binary("heaviside", jnp.heaviside)
-copysign = _binary("copysign", jnp.copysign)
-nextafter = _binary("nextafter", jnp.nextafter)
-ldexp = _binary("ldexp", jnp.ldexp)
-gcd = _binary("gcd", jnp.gcd)
-lcm = _binary("lcm", jnp.lcm)
+# ---- table ops (unary/binary/reduce): generated from schema.yaml ----
+from ._generated import *  # noqa: F401,F403,E402
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
@@ -164,32 +59,7 @@ def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
     return apply_op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), (_t(x),))
 
 
-# ---------------- reductions ----------------
-
-def _reduce(name, fn, dtype_arg=False):
-    def op(x, axis=None, keepdim=False, name=None, dtype=None):
-        ax = _axis(axis)
-        kw = {"axis": ax, "keepdims": keepdim}
-        if dtype_arg and dtype is not None:
-            kw["dtype"] = dtypes.convert_dtype(dtype)
-        return apply_op(name_, lambda a: fn(a, **kw), (_t(x),))
-    name_ = name
-    op.__name__ = name
-    return op
-
-
-sum = _reduce("sum", jnp.sum, dtype_arg=True)  # noqa: A001
-mean = _reduce("mean", jnp.mean)
-prod = _reduce("prod", jnp.prod, dtype_arg=True)
-max = _reduce("max", jnp.max)  # noqa: A001
-min = _reduce("min", jnp.min)  # noqa: A001
-amax = _reduce("amax", jnp.max)
-amin = _reduce("amin", jnp.min)
-nanmean = _reduce("nanmean", jnp.nanmean)
-nansum = _reduce("nansum", jnp.nansum)
-logsumexp = _reduce("logsumexp", jax.scipy.special.logsumexp)
-all = _reduce("all", jnp.all)  # noqa: A001
-any = _reduce("any", jnp.any)  # noqa: A001
+# ---------------- reductions: generated from schema.yaml (see _generated) ----------------
 
 
 def std(x, axis=None, unbiased=True, keepdim=False, name=None):
@@ -210,11 +80,6 @@ def median(x, axis=None, keepdim=False, mode="avg", name=None):
 def quantile(x, q, axis=None, keepdim=False, name=None):
     ax = _axis(axis)
     return apply_op("quantile", lambda a: jnp.quantile(a, jnp.asarray(q), axis=ax, keepdims=keepdim), (_t(x),))
-
-
-def count_nonzero(x, axis=None, keepdim=False, name=None):
-    ax = _axis(axis)
-    return Tensor(jnp.count_nonzero(_t(x)._data, axis=ax, keepdims=keepdim))
 
 
 def cumsum(x, axis=None, dtype=None, name=None):
@@ -309,3 +174,35 @@ def trapezoid(y, x=None, dx=None, axis=-1, name=None):
 
 def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
     return apply_op("nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), (_t(x),))
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+    return apply_op("nanmedian",
+                    lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim),
+                    (_t(x),))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """reference ops.yaml: renorm — scale slices along `axis` whose p-norm
+    exceeds max_norm down to exactly max_norm."""
+    def prim(a):
+        dims = tuple(i for i in range(a.ndim) if i != axis)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return a * factor
+    return apply_op("renorm", prim, (_t(x),))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """reference ops.yaml: addmm — beta*input + alpha*(x @ y)."""
+    return apply_op("addmm",
+                    lambda i, a, b: beta * i + alpha * (a @ b),
+                    (_t(input), _t(x), _t(y)))
+
+
+def polygamma(x, n, name=None):
+    """reference ops.yaml: polygamma — n-th derivative of digamma."""
+    n_ = int(n)
+    return apply_op("polygamma",
+                    lambda a: jax.scipy.special.polygamma(n_, a), (_t(x),))

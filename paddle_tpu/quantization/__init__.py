@@ -1,12 +1,20 @@
 """paddle.quantization (reference: python/paddle/quantization/ — QAT/PTQ
-config + quanters).
+config + quanters; weight-only ops paddle/phi/kernels/fusion/gpu
+weight_only_linear / ops.yaml weight_quantize, weight_dequantize,
+llm_int8_linear).
 
-Round-1 surface: fake-quant simulation ops (per-tensor/per-channel abs-max)
-usable for QAT experiments; the full pass-driven PTQ pipeline is deferred.
+TPU-native design: quantized weights are plain int8 jnp arrays with
+per-channel fp scales.  `weight_only_linear` dequantizes into the matmul's
+bf16 operand — on TPU the win is HBM footprint/bandwidth (weights stream at
+1/2 or 1/4 the bytes), while the MXU still runs bf16; XLA fuses the
+dequant-multiply into the matmul epilogue.  Fake-quant ops carry
+straight-through gradients for QAT, and PTQ is an observer-driven
+calibration pass over real batches.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -14,20 +22,134 @@ from ..nn.layer import Layer
 from ..ops._prim import apply_op
 
 
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+# ---- weight-only quantization (inference) ----
+
+def weight_quantize(x, algo="weight_only_int8", name=None):
+    """reference ops.yaml: weight_quantize.  x: [in, out] fp weight ->
+    (quantized int8 weight, per-out-channel fp32 scale).
+
+    int4 uses the int8 container clipped to [-7, 7] (TPU has no int4
+    storage; the bandwidth win of true 4-bit packing needs a Pallas unpack
+    kernel — tracked as a kernels/ follow-up)."""
+    if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
+        raise ValueError(f"unknown weight_quantize algo {algo!r}")
+    qmax = 7.0 if algo == "weight_only_int4" else 127.0
+    w = _t(x)._data
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / qmax
+    scale = jnp.maximum(scale, 1e-10)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -qmax, qmax)
+    return Tensor(q.astype(jnp.int8)), Tensor(scale)
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float32",
+                      name=None):
+    """reference ops.yaml: weight_dequantize."""
+    q = _t(x)._data
+    s = _t(scale)._data
+    return Tensor((q.astype(jnp.float32) * s).astype(jnp.dtype(out_dtype)))
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1,
+                       name=None):
+    """reference ops.yaml: weight_only_linear — y = x @ dequant(qw) + b.
+
+    The dequant happens in the matmul's input precision; XLA fuses the scale
+    multiplication, so the int8 weight is the only HBM-resident copy."""
+    if weight_scale is None:
+        raise ValueError(
+            "weight_only_linear requires weight_scale (from weight_quantize)")
+
+    def prim(a, qw, *rest):
+        s = rest[0]
+        w = qw.astype(a.dtype) * s.astype(a.dtype)
+        y = a @ w
+        if len(rest) > 1:
+            y = y + rest[1]
+        return y
+
+    args = [_t(x), _t(weight), _t(weight_scale)]
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("weight_only_linear", prim, tuple(args))
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0,
+                    name=None):
+    """reference ops.yaml: llm_int8_linear — outlier-aware int8 matmul:
+    feature columns whose magnitude exceeds `threshold` run in fp, the rest
+    through per-row int8 activation quantization."""
+    if weight_scale is None:
+        raise ValueError(
+            "llm_int8_linear requires weight_scale (from weight_quantize)")
+
+    def prim(a, qw, s, *maybe_bias):
+        af = a.astype(jnp.float32)
+        outlier = jnp.max(jnp.abs(af),
+                          axis=tuple(range(af.ndim - 1))) > threshold
+        w = qw.astype(jnp.float32) * s
+        a_out = jnp.where(outlier, af, 0.0)
+        a_in = jnp.where(outlier, 0.0, af)
+        a_scale = jnp.maximum(
+            jnp.max(jnp.abs(a_in), axis=-1, keepdims=True) / 127.0, 1e-10)
+        a_q = jnp.round(a_in / a_scale)
+        y = (a_q @ qw.astype(jnp.float32)) * a_scale * s + a_out @ w
+        if maybe_bias:
+            y = y + maybe_bias[0]
+        return y.astype(a.dtype)
+
+    args = [_t(x), _t(weight), _t(weight_scale)]
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("llm_int8_linear", prim, tuple(args))
+
+
+# ---- fake quantization (QAT / PTQ simulation) ----
+
 def fake_quantize_abs_max(x, bits: int = 8):
-    """Simulated quantization with straight-through estimator."""
+    """Simulated per-tensor quantization with straight-through estimator."""
     qmax = float(2 ** (bits - 1) - 1)
 
     def prim(v):
-        import jax
         scale = jnp.maximum(jnp.max(jnp.abs(v)) / qmax, 1e-8)
         q = jnp.round(v / scale) * scale
         # straight-through estimator: identity gradient
         return v + jax.lax.stop_gradient(q - v)
 
-    return apply_op("fake_quantize_abs_max", prim,
-                    (x if isinstance(x, Tensor) else Tensor(x),))
+    return apply_op("fake_quantize_abs_max", prim, (_t(x),))
 
+
+def fake_channel_wise_quantize_abs_max(x, bits: int = 8, quant_axis: int = 0):
+    """Per-channel fake quant (reference ops.yaml:
+    fake_channel_wise_quantize_abs_max)."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def prim(v):
+        axes = tuple(i for i in range(v.ndim) if i != quant_axis)
+        scale = jnp.maximum(jnp.max(jnp.abs(v), axis=axes, keepdims=True)
+                            / qmax, 1e-8)
+        q = jnp.round(v / scale) * scale
+        return v + jax.lax.stop_gradient(q - v)
+
+    return apply_op("fake_channel_wise_quantize_abs_max", prim, (_t(x),))
+
+
+def quant_with_scale(x, scale, bits: int = 8):
+    """Fake-quantize with a FIXED scale (PTQ inference simulation)."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def prim(v):
+        q = jnp.clip(jnp.round(v / scale), -qmax, qmax) * scale
+        return v + jax.lax.stop_gradient(q - v)
+
+    return apply_op("quant_with_scale", prim, (_t(x),))
+
+
+# ---- configuration ----
 
 class QuantConfig:
     def __init__(self, activation=None, weight=None):
@@ -48,9 +170,28 @@ class FakeQuanterWithAbsMax(Layer):
         return fake_quantize_abs_max(x, self.bits)
 
 
+class AbsmaxObserver(Layer):
+    """PTQ observer: tracks the running abs-max of activations."""
+
+    def __init__(self, bits=8, **kw):
+        super().__init__()
+        self.bits = bits
+        self.absmax = 0.0
+
+    def forward(self, x):
+        self.absmax = max(self.absmax,
+                          float(jnp.max(jnp.abs(_t(x)._data))))
+        return x
+
+    @property
+    def scale(self):
+        qmax = float(2 ** (self.bits - 1) - 1)
+        return max(self.absmax / qmax, 1e-8)
+
+
 class QAT:
-    """reference quantization/qat.py — wrap a model's linear/conv layers
-    with fake quanters."""
+    """reference quantization/qat.py — wrap a model's linear layers with
+    fake quanters."""
 
     def __init__(self, config: QuantConfig):
         self.config = config
@@ -71,8 +212,62 @@ class QAT:
 
 
 class PTQ:
-    def __init__(self, config: QuantConfig):
-        self.config = config
+    """reference quantization/ptq.py — post-training quantization:
+
+      m = PTQ(QuantConfig()).quantize(model)      # insert observers
+      for batch in calibration_data: m(batch)     # calibrate
+      q = PTQ.convert(m)                          # freeze scales
+
+    After convert, each Linear's weight is round-tripped through int8
+    per-channel quantization and its input is fake-quantized with the frozen
+    calibration scale — the numerics a TPU int8 serving path would see."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.config = config or QuantConfig()
 
     def quantize(self, model, inplace=False):
-        raise NotImplementedError("PTQ calibration pipeline: future round")
+        from .. import nn
+
+        observed = []
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, (nn.Linear,)):
+                obs = AbsmaxObserver()
+                orig_forward = sub.forward
+
+                def wrapped(x, _f=orig_forward, _o=obs):
+                    return _f(_o(x))
+
+                sub.forward = wrapped
+                sub._ptq_observer = obs
+                sub._ptq_forward = orig_forward
+                observed.append(sub)
+        model._ptq_observed = observed
+        return model
+
+    @staticmethod
+    def convert(model, inplace=True):
+        for sub in getattr(model, "_ptq_observed", []):
+            obs = sub._ptq_observer
+            qw = fake_channel_wise_quantize_abs_max(sub.weight, bits=8,
+                                                    quant_axis=1)
+            sub.weight.set_value(qw)
+
+            def converted(x, _f=sub._ptq_forward, _s=obs.scale):
+                return _f(quant_with_scale(x, _s))
+
+            sub.forward = converted
+        return model
+
+
+def fake_channel_wise_dequantize_max_abs(x, scales, quant_bits=(8,),
+                                         quant_axis=0, name=None):
+    """reference ops.yaml: fake_channel_wise_dequantize_max_abs."""
+    bits = quant_bits[0] if isinstance(quant_bits, (list, tuple)) else quant_bits
+    qmax = float(2 ** (int(bits) - 1) - 1)
+
+    def prim(v, s):
+        shape = [1] * v.ndim
+        shape[quant_axis] = -1
+        return v.astype(jnp.float32) * (s.reshape(shape) / qmax)
+    return apply_op("fake_channel_wise_dequantize_max_abs", prim,
+                    (_t(x), _t(scales)))

@@ -1,0 +1,140 @@
+"""paddle.signal (reference: python/paddle/signal.py — frame, overlap_add,
+stft, istft; kernels frame_kernel/overlap_add via ops.yaml).
+
+TPU-native: framing is one static gather ([n_frames, frame_length] index
+matrix), overlap-add is its scatter-add adjoint, stft/istft compose them
+with jnp.fft — everything static-shaped and jit/vmap-friendly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops._prim import apply_op
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice x into overlapping frames: [..., N] -> [..., frame_length,
+    n_frames] (axis=-1) or [N, ...] -> [n_frames, frame_length, ...]."""
+    fl, hop = int(frame_length), int(hop_length)
+
+    def prim(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        n = a.shape[ax]
+        nf = 1 + (n - fl) // hop
+        idx = (jnp.arange(nf)[:, None] * hop +
+               jnp.arange(fl)[None, :])            # [nf, fl]
+        out = jnp.take(a, idx.reshape(-1), axis=ax)
+        shape = a.shape[:ax] + (nf, fl) + a.shape[ax + 1:]
+        out = out.reshape(shape)
+        # paddle layout: frame dim OUTSIDE for axis=0, frame dim LAST else
+        if ax == a.ndim - 1:
+            return jnp.swapaxes(out, -1, -2)       # [..., fl, nf]
+        return out                                 # [nf, fl, ...]
+    return apply_op("frame", prim, (_t(x),))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Adjoint of frame: [..., frame_length, n_frames] -> [..., N]."""
+    hop = int(hop_length)
+
+    def prim(a):
+        if axis in (-1, a.ndim - 1):
+            fr = jnp.swapaxes(a, -1, -2)           # [..., nf, fl]
+            lead = fr.shape[:-2]
+            nf, fl = fr.shape[-2], fr.shape[-1]
+            n = (nf - 1) * hop + fl
+            out = jnp.zeros(lead + (n,), a.dtype)
+            idx = (jnp.arange(nf)[:, None] * hop + jnp.arange(fl)[None, :])
+            flat = fr.reshape(lead + (nf * fl,))
+            return out.at[..., idx.reshape(-1)].add(flat)
+        # axis == 0: [nf, fl, ...]
+        nf, fl = a.shape[0], a.shape[1]
+        n = (nf - 1) * hop + fl
+        out = jnp.zeros((n,) + a.shape[2:], a.dtype)
+        idx = (jnp.arange(nf)[:, None] * hop + jnp.arange(fl)[None, :])
+        flat = a.reshape((nf * fl,) + a.shape[2:])
+        return out.at[idx.reshape(-1)].add(flat)
+    return apply_op("overlap_add", prim, (_t(x),))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform: [B, N] (or [N]) -> [B, F, n_frames]
+    complex (reference signal.py stft semantics)."""
+    hop = int(hop_length) if hop_length is not None else n_fft // 4
+    wl = int(win_length) if win_length is not None else n_fft
+
+    def prim(a, *maybe_win):
+        sig = a if a.ndim > 1 else a[None]
+        if center:
+            pad = n_fft // 2
+            sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1) + [(pad, pad)],
+                          mode=pad_mode)
+        nf = 1 + (sig.shape[-1] - n_fft) // hop
+        idx = jnp.arange(nf)[:, None] * hop + jnp.arange(n_fft)[None, :]
+        frames = sig[..., idx]                     # [B, nf, n_fft]
+        if maybe_win:
+            w = maybe_win[0]
+            if wl < n_fft:                         # center-pad the window
+                lp = (n_fft - wl) // 2
+                w = jnp.pad(w, (lp, n_fft - wl - lp))
+            frames = frames * w
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.swapaxes(spec, -1, -2)           # [B, F, nf]
+        return out if a.ndim > 1 else out[0]
+
+    args = (_t(x),) + ((_t(window),) if window is not None else ())
+    return apply_op("stft", prim, args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (COLA division)."""
+    hop = int(hop_length) if hop_length is not None else n_fft // 4
+    wl = int(win_length) if win_length is not None else n_fft
+
+    def prim(a, *maybe_win):
+        spec = a if a.ndim > 2 else a[None]
+        spec = jnp.swapaxes(spec, -1, -2)          # [B, nf, F]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)
+        if maybe_win:
+            w = maybe_win[0]
+            if wl < n_fft:
+                lp = (n_fft - wl) // 2
+                w = jnp.pad(w, (lp, n_fft - wl - lp))
+        else:
+            w = jnp.ones((n_fft,), frames.dtype)
+        frames = frames * w
+        nf = frames.shape[-2]
+        n = (nf - 1) * hop + n_fft
+        idx = (jnp.arange(nf)[:, None] * hop + jnp.arange(n_fft)[None, :])
+        sig = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        sig = sig.at[..., idx.reshape(-1)].add(
+            frames.reshape(frames.shape[:-2] + (-1,)))
+        env = jnp.zeros((n,), frames.dtype)
+        env = env.at[idx.reshape(-1)].add(
+            jnp.broadcast_to((w * w)[None], (nf, n_fft)).reshape(-1))
+        sig = sig / jnp.maximum(env, 1e-11)
+        if center:
+            pad = n_fft // 2
+            sig = sig[..., pad:n - pad]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig if a.ndim > 2 else sig[0]
+
+    args = (_t(x),) + ((_t(window),) if window is not None else ())
+    return apply_op("istft", prim, args)

@@ -1,0 +1,187 @@
+"""Detection ops (reference: python/paddle/vision/ops.py; kernels
+paddle/phi/kernels/roi_align_kernel.*, nms ops.yaml entries).
+
+TPU-native notes: everything is expressed as dense vectorized gathers and
+masked reductions — no dynamic shapes, no host loops — so XLA can fuse and
+the ops compose under jit/vmap.  NMS uses the O(N^2) masked suppression
+matrix with a lax.while fixpoint, the standard accelerator formulation
+(dynamic-shape greedy NMS does not map to XLA).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._prim import apply_op
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def box_iou(boxes1, boxes2, name=None):
+    """Pairwise IoU, boxes [N,4]/[M,4] as (x1, y1, x2, y2) -> [N, M]."""
+    def prim(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area1[:, None] + area2[None, :] - inter,
+                                   1e-10)
+    return apply_op("box_iou", prim, (_t(boxes1), _t(boxes2)))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """reference ops.yaml: nms / multiclass_nms3.
+
+    Returns indices of kept boxes, ordered by descending score.  With
+    category_idxs given, suppression is per-category (boxes of different
+    categories never suppress each other).
+    """
+    b = _t(boxes)._data
+    n = b.shape[0]
+    s = (_t(scores)._data if scores is not None
+         else jnp.arange(n, 0, -1, dtype=jnp.float32))
+    iou = box_iou(Tensor(b), Tensor(b))._data
+    if category_idxs is not None:
+        c = _t(category_idxs)._data
+        same = c[:, None] == c[None, :]
+        iou = jnp.where(same, iou, 0.0)
+
+    order = jnp.argsort(-s)
+    iou_sorted = iou[order][:, order]
+    above = iou_sorted > iou_threshold
+    # keep[i] = no higher-scored KEPT box suppresses i; fixpoint over the
+    # lower-triangular suppression relation (at most n iterations, usually
+    # converges in a handful — lax.while with a change detector)
+    tri = jnp.tril(above, k=-1)            # j < i (higher score) suppresses i
+
+    def body(state):
+        keep, _ = state
+        new_keep = ~jnp.any(tri & keep[None, :], axis=1)
+        return new_keep, jnp.any(new_keep != keep)
+
+    def cond(state):
+        return state[1]
+
+    keep0 = jnp.ones(n, bool)
+    keep, _ = jax.lax.while_loop(cond, body, (keep0, jnp.bool_(True)))
+    kept_sorted = jnp.sort(jnp.where(keep, jnp.arange(n), n))
+    idx = jnp.where(kept_sorted < n, order[jnp.clip(kept_sorted, 0, n - 1)],
+                    -1)
+    count = jnp.sum(keep)
+    # eager: true variable-length result; traced: fixed shape, -1 padded
+    idx = idx[:int(count)] if not isinstance(count, jax.core.Tracer) else idx
+    out = Tensor(idx)
+    if top_k is not None:
+        out = Tensor(out._data[:top_k])
+    return out
+
+
+def _roi_align_one(feat, box, resolution, sampling_ratio, spatial_scale,
+                   aligned):
+    """One ROI on one [C, H, W] feature map -> [C, ph, pw]."""
+    c, h, w = feat.shape
+    ph, pw = resolution
+    offset = 0.5 if aligned else 0.0
+    x1 = box[0] * spatial_scale - offset
+    y1 = box[1] * spatial_scale - offset
+    x2 = box[2] * spatial_scale - offset
+    y2 = box[3] * spatial_scale - offset
+    if aligned:
+        rw, rh = x2 - x1, y2 - y1
+    else:  # legacy semantics: rois are at least 1px
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+    ns = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: [ph*ns, pw*ns] bilinear points, then average-pool ns x ns
+    ys = y1 + (jnp.arange(ph * ns) + 0.5) * (bin_h / ns).reshape(())
+    xs = x1 + (jnp.arange(pw * ns) + 0.5) * (bin_w / ns).reshape(())
+
+    y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+    y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+    x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+    wy = jnp.clip(ys - y0, 0, 1)
+    wx = jnp.clip(xs - x0, 0, 1)
+    y0 = y0.astype(jnp.int32)
+    x0 = x0.astype(jnp.int32)
+
+    f00 = feat[:, y0][:, :, x0]
+    f01 = feat[:, y0][:, :, x1i]
+    f10 = feat[:, y1i][:, :, x0]
+    f11 = feat[:, y1i][:, :, x1i]
+    top = f00 * (1 - wx)[None, None, :] + f01 * wx[None, None, :]
+    bot = f10 * (1 - wx)[None, None, :] + f11 * wx[None, None, :]
+    vals = top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+    # average the ns x ns samples per bin
+    vals = vals.reshape(c, ph, ns, pw, ns)
+    return vals.mean(axis=(2, 4))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference ops.yaml: roi_align (kernels/roi_align_kernel).
+
+    x: [N, C, H, W]; boxes: [R, 4]; boxes_num: [N] rois per image.
+    Returns [R, C, ph, pw].  vmapped bilinear sampling per ROI.
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+
+    def prim(feat, bx, bn):
+        # map each roi to its batch image
+        img_of = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                            total_repeat_length=bx.shape[0])
+        roi_feats = feat[img_of]            # [R, C, H, W]
+        fn = lambda f, b: _roi_align_one(  # noqa: E731
+            f, b, output_size, sampling_ratio, spatial_scale, aligned)
+        return jax.vmap(fn)(roi_feats, bx)
+
+    return apply_op("roi_align", prim,
+                    (_t(x), _t(boxes), _t(boxes_num)))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """reference ops.yaml: roi_pool — max-pooled ROI bins (Fast R-CNN)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def one(feat, box):
+        c, h, w = feat.shape
+        x1 = jnp.floor(box[0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.floor(box[1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.ceil(box[2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.ceil(box[3] * spatial_scale).astype(jnp.int32)
+        # dense mask formulation: for each output bin take the max over the
+        # bin's index range (static shapes; bins clamp to >= 1 px)
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+        rh = jnp.maximum(y2 - y1, 1) / ph
+        rw = jnp.maximum(x2 - x1, 1) / pw
+        bin_y = jnp.clip(((ys - y1) / rh), -1, ph).astype(jnp.int32)  # [h]
+        bin_x = jnp.clip(((xs - x1) / rw), -1, pw).astype(jnp.int32)
+        onehot_y = (bin_y[None, :] == jnp.arange(ph)[:, None]) & \
+            (ys[None, :] >= y1) & (ys[None, :] < jnp.maximum(y2, y1 + 1))
+        onehot_x = (bin_x[None, :] == jnp.arange(pw)[:, None]) & \
+            (xs[None, :] >= x1) & (xs[None, :] < jnp.maximum(x2, x1 + 1))
+        neg = jnp.finfo(feat.dtype).min
+        masked = jnp.where(onehot_y[None, :, None, :, None] &
+                           onehot_x[None, None, :, None, :],
+                           feat[:, None, None, :, :], neg)
+        return masked.max(axis=(3, 4))
+
+    def prim(feat, bx, bn):
+        img_of = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                            total_repeat_length=bx.shape[0])
+        return jax.vmap(one)(feat[img_of], bx)
+
+    return apply_op("roi_pool", prim, (_t(x), _t(boxes), _t(boxes_num)))
