@@ -27,6 +27,7 @@ from .auto_parallel import (  # noqa: F401
 from .auto_parallel.api import (  # noqa: F401
     ShardingStage1, ShardingStage2, ShardingStage3,
 )
+from .auto_parallel.engine import DistModel, Strategy, to_static  # noqa: F401
 from .auto_parallel.process_mesh import get_mesh, set_mesh  # noqa: F401
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401

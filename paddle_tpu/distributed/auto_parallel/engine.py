@@ -1,0 +1,274 @@
+"""Semi-auto ``to_static``: DistModel / Engine (reference:
+python/paddle/distributed/auto_parallel/api.py:2131 ``to_static``,
+auto_parallel/static/engine.py:99 ``Engine``).
+
+Where the reference lowers the dygraph model to a static program, runs SPMD
+inference + pass pipeline (amp / recompute / gradient-merge) and hands the
+result to an executor, the TPU-native engine traces ONE jitted train/eval
+step over the functionalized layer: DistTensor placements ride along as
+NamedShardings on the parameter arrays, GSPMD plays the SPMD-inference role,
+and the pass hooks map to trace-time transforms (amp.auto_cast context →
+dtype passes; jax.checkpoint → recompute pass).  The optimizer update is the
+same pure update kernel the eager optimizers use (optimizer._adam_update &
+co), so eager and static training share one set of update semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...utils import extract_buffers, extract_params, functional_call
+
+
+class Strategy:
+    """reference auto_parallel/strategy.py — pass configuration."""
+
+    class _Amp:
+        def __init__(self):
+            self.enable = False
+            self.dtype = "bfloat16"
+            self.level = "O1"
+
+    class _Recompute:
+        def __init__(self):
+            self.enable = False
+
+    def __init__(self):
+        self.amp = Strategy._Amp()
+        self.recompute = Strategy._Recompute()
+
+
+def _global_norm_clip(grads: Dict[str, Any], clip_norm: float):
+    leaves = jax.tree_util.tree_leaves(grads)
+    gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                      for g in leaves))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gn, 1e-12))
+    return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype),
+                                  grads)
+
+
+def _functional_update(opt, params, grads, state, t, lr):
+    """One optimizer step as a pure function, dispatching on the eager
+    optimizer's class and reusing its update kernels."""
+    from ... import optimizer as O
+
+    wd = float(opt._weight_decay or 0.0)
+    new_params, new_state = {}, {}
+    for name, p in params.items():
+        g = grads[name].astype(p.dtype)
+        st = state.get(name, {})
+        if isinstance(opt, O.AdamW):
+            m = st.get("moment1", jnp.zeros_like(p, jnp.float32))
+            v = st.get("moment2", jnp.zeros_like(p, jnp.float32))
+            pf, m, v = O._adam_update(p.astype(jnp.float32),
+                                      g.astype(jnp.float32), m, v, lr,
+                                      opt._beta1, opt._beta2, opt._epsilon,
+                                      t, wd)
+            new_params[name] = pf.astype(p.dtype)
+            new_state[name] = {"moment1": m, "moment2": v}
+        elif isinstance(opt, O.Adam):
+            if wd:
+                g = g + wd * p
+            m = st.get("moment1", jnp.zeros_like(p, jnp.float32))
+            v = st.get("moment2", jnp.zeros_like(p, jnp.float32))
+            pf, m, v = O._adam_update(p.astype(jnp.float32),
+                                      g.astype(jnp.float32), m, v, lr,
+                                      opt._beta1, opt._beta2, opt._epsilon,
+                                      t, None)
+            new_params[name] = pf.astype(p.dtype)
+            new_state[name] = {"moment1": m, "moment2": v}
+        elif isinstance(opt, O.Momentum):
+            v = st.get("velocity", jnp.zeros_like(p))
+            pf, v = O._momentum_update(p, g, v, lr, opt._momentum,
+                                       opt._use_nesterov, wd)
+            new_params[name] = pf
+            new_state[name] = {"velocity": v}
+        elif isinstance(opt, O.SGD):
+            if wd:
+                g = g + wd * p
+            new_params[name] = p - lr * g
+            new_state[name] = {}
+        else:
+            raise NotImplementedError(
+                f"to_static supports SGD/Momentum/Adam/AdamW; got "
+                f"{type(opt).__name__} — run it eagerly or add a functional "
+                f"rule in engine._functional_update")
+    return new_params, new_state
+
+
+class DistModel:
+    """reference auto_parallel/api.py DistModel (:2131 区) — the callable
+    returned by ``dist.to_static``.  Modes follow the reference contract:
+
+      m = dist.to_static(layer, loader, loss, opt)
+      m.train(); loss = m(x, y)      # one jitted SPMD train step
+      m.eval();  loss = m(x, y)      # jitted forward + loss
+      m.predict(); out = m(x)        # jitted forward
+    """
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        self._layer = layer
+        self._loader = loader
+        self._loss = loss
+        self._opt = optimizer
+        self._strategy = strategy or Strategy()
+        self._params = extract_params(layer)     # arrays keep NamedShardings
+        self._buffers = extract_buffers(layer)
+        self._opt_state: Dict[str, Dict[str, Any]] = {}
+        self._step = jnp.zeros((), jnp.int32)
+        if optimizer is not None and loss is not None:
+            self._mode = "train"
+        elif loss is not None:
+            self._mode = "eval"
+        else:
+            self._mode = "predict"
+        self._jitted: Dict[str, Callable] = {}
+
+    # ---- mode switches (reference DistModel.train/eval/predict) ----
+    def train(self):
+        if self._loss is None or self._opt is None:
+            raise RuntimeError("train mode needs both loss and optimizer")
+        self._mode = "train"
+        return self
+
+    def eval(self):
+        if self._loss is None:
+            raise RuntimeError("eval mode needs a loss")
+        self._mode = "eval"
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        return self
+
+    # ---- program construction ----
+    def _forward(self, params, args):
+        """Pure forward honoring the amp / recompute pass hooks (the
+        reference Engine's pass pipeline, as trace-time transforms)."""
+        def with_amp(p_, xs_):
+            def raw():
+                out = functional_call(self._layer, p_,
+                                      *[Tensor(x) for x in xs_])
+                return _as_array(out)
+            if self._strategy.amp.enable:
+                from ... import amp as _amp
+                with _amp.auto_cast(enable=True,
+                                    level=self._strategy.amp.level,
+                                    dtype=self._strategy.amp.dtype):
+                    return raw()
+            return raw()
+
+        if self._strategy.recompute.enable:
+            return jax.checkpoint(with_amp)(params, args)
+        return with_amp(params, args)
+
+    def _train_fn(self):
+        def step(params, opt_state, t, lr, xs, label):
+            def fl(p_):
+                out = self._forward(p_, xs)
+                return _as_array(self._loss(_as_tensor(out), Tensor(label)))
+
+            loss, grads = jax.value_and_grad(fl)(params)
+            clip = getattr(self._opt, "_grad_clip", None)
+            if clip is not None:
+                clip_norm = getattr(clip, "clip_norm", None)
+                if clip_norm is not None:
+                    grads = _global_norm_clip(grads, float(clip_norm))
+            new_params, new_state = _functional_update(
+                self._opt, params, grads, opt_state,
+                t.astype(jnp.float32) + 1.0, lr)
+            return loss, new_params, new_state
+        return step
+
+    def _eval_fn(self):
+        def step(params, xs, label):
+            out = self._forward(params, xs)
+            return _as_array(self._loss(_as_tensor(out), Tensor(label)))
+        return step
+
+    def _predict_fn(self):
+        def step(params, xs):
+            return _as_array(self._forward(params, xs))
+        return step
+
+    # ---- execution ----
+    def __call__(self, *args):
+        args = tuple(a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                     for a in args)
+        if self._mode == "train":
+            fn = self._jitted.get("train")
+            if fn is None:
+                fn = self._jitted["train"] = jax.jit(
+                    self._train_fn(), donate_argnums=(0, 1))
+            *xs, label = args
+            lr = jnp.float32(self._opt.get_lr())
+            loss, self._params, self._opt_state = fn(
+                self._params, self._opt_state, self._step, lr,
+                tuple(xs), label)
+            self._step = self._step + 1
+            return Tensor(loss)
+        if self._mode == "eval":
+            fn = self._jitted.get("eval")
+            if fn is None:
+                fn = self._jitted["eval"] = jax.jit(self._eval_fn())
+            *xs, label = args
+            return Tensor(fn(self._params, tuple(xs), label))
+        fn = self._jitted.get("predict")
+        if fn is None:
+            fn = self._jitted["predict"] = jax.jit(self._predict_fn())
+        out = fn(self._params, args)
+        return jax.tree_util.tree_map(Tensor, out) \
+            if isinstance(out, (tuple, list)) else Tensor(out)
+
+    # ---- state (reference DistModel.dist_state_dict / state_dict) ----
+    def state_dict(self, mode="all"):
+        out = {}
+        if mode in ("all", "param"):
+            out.update({k: Tensor(v) for k, v in self._params.items()})
+        if mode in ("all", "opt"):
+            for pname, accs in self._opt_state.items():
+                for aname, arr in accs.items():
+                    out[f"{pname}.{aname}"] = Tensor(arr)
+        return out
+
+    def set_state_dict(self, state):
+        for k, v in state.items():
+            arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            if k in self._params:
+                self._params[k] = jax.device_put(
+                    arr, self._params[k].sharding)
+            else:
+                pname, aname = k.rsplit(".", 1)
+                self._opt_state.setdefault(pname, {})[aname] = arr
+
+    # write the trained params back into the eager layer
+    def sync_to_layer(self):
+        from ...utils import load_params
+        load_params(self._layer, self._params)
+
+
+def _as_array(x):
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (tuple, list)):
+        return type(x)(_as_array(v) for v in x)
+    return x
+
+
+def _as_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, (tuple, list)):
+        return type(x)(_as_tensor(v) for v in x)
+    return Tensor(x)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              metrics=None) -> DistModel:
+    """reference: auto_parallel/api.py:2131 — build the static DistModel."""
+    return DistModel(layer, loader, loss, optimizer, strategy, metrics)
