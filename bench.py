@@ -102,6 +102,56 @@ def _run_config(model_kwargs, batch, seq, steps, on_tpu):
     }
 
 
+def _run_decode(on_tpu):
+    """Serving decode throughput (paged-KV Pallas kernel): tokens/s for a
+    batch-16 continuous decode and ms/token at batch 1 (VERDICT r2 item 1)."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import GenerationConfig, LlamaGenerator
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        batch, prompt_len, new_tokens, max_seq = 16, 128, 128, 512
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, prompt_len, new_tokens, max_seq = 2, 8, 8, 64
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+
+    out = {}
+    for b, tag in ((batch, "decode_tok_per_sec"), (1, "decode_b1")):
+        gen = LlamaGenerator(model, max_batch=b, max_seq_len=max_seq,
+                             page_size=32, prefill_bucket=prompt_len)
+        prompts = [list(rng.integers(1, cfg.vocab_size, prompt_len))
+                   for _ in range(b)]
+        short, full = max(2, new_tokens // 8), new_tokens
+        gen.generate(prompts, GenerationConfig(max_new_tokens=full))  # warmup
+        # isolate steady-state decode: diff a short and a full run so the
+        # (identical) prefill cost cancels out of the rate
+        t0 = time.perf_counter()
+        gen.generate(prompts, GenerationConfig(max_new_tokens=short))
+        t_short = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        gen.generate(prompts, GenerationConfig(max_new_tokens=full))
+        t_full = time.perf_counter() - t0
+        per_step = (t_full - t_short) / (full - short)
+        if tag == "decode_tok_per_sec":
+            out[tag] = round(b / per_step, 1)
+            out["decode_batch"] = b
+        else:
+            out["decode_ms_per_token_b1"] = round(per_step * 1e3, 3)
+        del gen
+    return out
+
+
 def main():
     import jax
 
@@ -120,6 +170,11 @@ def main():
             result = _run_config(mk, batch, seq, steps, on_tpu)
             if i > 0:
                 result["degraded"] = i  # ran a fallback rung, not the flagship
+            try:
+                result.update(_run_decode(on_tpu))
+            except Exception as e:
+                result["decode_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+                traceback.print_exc(file=sys.stderr)
             print(json.dumps(result))
             return 0
         except Exception as e:  # OOM or anything else: degrade, never die

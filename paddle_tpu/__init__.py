@@ -91,7 +91,7 @@ _SUBMODULES = [
     "nn", "optimizer", "amp", "io", "jit", "autograd", "framework", "vision",
     "linalg", "fft", "signal", "incubate", "metric", "sparse", "profiler",
     "hapi", "device", "distributed", "distribution", "static", "audio",
-    "text", "quantization", "utils",
+    "text", "quantization", "utils", "inference",
 ]
 
 

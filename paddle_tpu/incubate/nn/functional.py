@@ -139,7 +139,71 @@ def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train"):
     return F.dropout(x, p=p, training=training, mode=mode) + y
 
 
-def masked_multihead_attention(x, cache_kv=None, **kw):
-    raise NotImplementedError(
-        "decode-time fused attention lands with the inference stack; "
-        "use kernels.flash_attention for training")
+def decode_attention(q, k_cache, v_cache, block_tables, context_lens):
+    """Paged-KV single-token decode attention (reference fused op family:
+    block_multi_head_attention — paddle/phi/kernels/fusion/gpu/
+    block_multi_head_attention_kernel.cu).  Thin Tensor wrapper over the
+    Pallas kernel in kernels.paged_attention; the full serving loop lives
+    in paddle_tpu.inference."""
+    from ...kernels.paged_attention import paged_attention
+
+    def prim(q_, kc, vc, bt, cl):
+        return paged_attention(q_, kc, vc, bt, cl)
+
+    args = tuple(a if isinstance(a, Tensor) else Tensor(a)
+                 for a in (q, k_cache, v_cache, block_tables, context_lens))
+    return apply_op("decode_attention", prim, args)
+
+
+block_multihead_attention = decode_attention
+
+
+def masked_multihead_attention(x, cache_kv, seq_lens, **kw):
+    """Dense-cache single-token decode attention (reference ops.yaml:
+    masked_multihead_attention — paddle/phi/kernels/fusion/gpu/
+    masked_multihead_attention_kernel.cu behavior surface).
+
+    x: [B, 3*num_head*head_dim] packed QKV for the new token;
+    cache_kv: [2, B, num_head, max_seq, head_dim]; seq_lens: [B] tokens
+    already cached.  Returns (out [B, num_head*head_dim], updated cache).
+    For paged serving use ``decode_attention``/paddle_tpu.inference.
+    """
+    import math
+
+    shape = cache_kv.shape
+    num_head, head_dim = int(shape[2]), int(shape[4])
+
+    def prim(x_, cache, lens):
+        B = x_.shape[0]
+        qkv = x_.reshape(B, 3, num_head, head_dim)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [B, h, d]
+        lens = lens.astype(jnp.int32)
+        bidx = jnp.arange(B)
+        cache = cache.at[0, bidx, :, lens].set(k)
+        cache = cache.at[1, bidx, :, lens].set(v)
+        kc, vc = cache[0], cache[1]                    # [B, h, S, d]
+        s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                       kc.astype(jnp.float32)) / math.sqrt(head_dim)
+        S = kc.shape[2]
+        mask = jnp.arange(S)[None, None, :] <= lens[:, None, None]
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", p, vc.astype(jnp.float32))
+        return out.reshape(B, num_head * head_dim).astype(x_.dtype), cache
+
+    args = tuple(a if isinstance(a, Tensor) else Tensor(a)
+                 for a in (x, cache_kv, seq_lens))
+    return apply_op("masked_multihead_attention", prim, args)
+
+
+def number_count(numbers, upper_range):
+    """Occurrences of each id in [0, upper_range) (reference ops.yaml:
+    number_count — the MoE expert-load counting op,
+    paddle/fluid/operators/number_count_op.cu behavior)."""
+    def prim(ids):
+        return jnp.bincount(ids.reshape(-1).astype(jnp.int32),
+                            length=upper_range).astype(jnp.int64)
+
+    return apply_op("number_count", prim,
+                    (numbers if isinstance(numbers, Tensor)
+                     else Tensor(numbers),))

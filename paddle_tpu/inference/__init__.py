@@ -1,0 +1,157 @@
+"""Inference & deployment API (reference: paddle/fluid/inference/, 88.9k LoC;
+Python wrapper python/paddle/inference/).
+
+``Config`` / ``create_predictor`` / ``Predictor`` mirror the reference's
+AnalysisPredictor surface (paddle/fluid/inference/api/analysis_predictor.h:105)
+over the TPU-native deployment artifact: a jit.save'd StableHLO program +
+weights.  Where the reference runs an IR-pass pipeline over a ProgramDesc,
+here the saved program was already optimized by XLA at export; "analysis"
+is the XLA compile at first run.
+
+LLM serving (paged-KV decode) lives in ``inference.generation`` /
+``inference.kv_cache``; this module is the generic load-and-run seam.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .generation import GenerationConfig, LlamaGenerator, generate
+from .kv_cache import PagedKVCache, PageAllocator
+
+__all__ = [
+    "Config", "Predictor", "create_predictor", "PredictorTensor",
+    "GenerationConfig", "LlamaGenerator", "generate",
+    "PagedKVCache", "PageAllocator",
+]
+
+
+class Config:
+    """Predictor configuration (reference paddle.inference.Config).
+
+    ``prog_file`` is the path prefix handed to ``jit.save`` (the loader
+    reads ``<prefix>.stablehlo`` + ``<prefix>.pdiparams``).  GPU/TensorRT/
+    MKLDNN toggles from the reference are accepted and ignored — device
+    placement on TPU is owned by PJRT/XLA.
+    """
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self._prog = prog_file
+        self._input_names: Optional[List[str]] = None
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self._prog = prog_file
+
+    def prog_file(self) -> Optional[str]:
+        return self._prog
+
+    def set_input_names(self, names: List[str]):
+        self._input_names = list(names)
+
+    # accepted-for-compat no-ops (XLA owns these decisions on TPU)
+    def enable_use_gpu(self, *a, **k):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def enable_memory_optim(self, *a, **k):
+        pass
+
+    def switch_ir_optim(self, flag: bool = True):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass
+
+
+class PredictorTensor:
+    """Zero-copy-style IO handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[jnp.ndarray] = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = jnp.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"tensor {self.name!r} has no value")
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+
+class Predictor:
+    """Run a deployed (StableHLO) program with the AnalysisPredictor API."""
+
+    def __init__(self, config: Config):
+        from ..jit import load
+        if config.prog_file() is None:
+            raise ValueError("Config has no model path (set_model)")
+        self._layer = load(config.prog_file())
+        n_in = self._n_program_inputs()
+        names = config._input_names or [f"x{i}" for i in range(n_in)]
+        self._inputs: Dict[str, PredictorTensor] = {
+            n: PredictorTensor(n) for n in names}
+        self._input_order = names
+        self._outputs: Dict[str, PredictorTensor] = {}
+        self._output_order: List[str] = []
+
+    def _n_program_inputs(self) -> int:
+        ex = self._layer._exported
+        # exported signature: (params, buffers, *inputs)
+        return len(ex.in_avals) - len(self._layer._params) \
+            - len(self._layer._buffers)
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_order)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute; with ``inputs`` given, returns outputs directly
+        (convenience form), else uses the handle protocol."""
+        if inputs is not None:
+            for n, a in zip(self._input_order, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(a))
+        args = [self._inputs[n]._value for n in self._input_order]
+        if any(a is None for a in args):
+            missing = [n for n in self._input_order
+                       if self._inputs[n]._value is None]
+            raise RuntimeError(f"inputs not set: {missing}")
+        out = self._layer(*args)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        self._output_order = [f"out{i}" for i in range(len(outs))]
+        self._outputs = {}
+        for n, o in zip(self._output_order, outs):
+            t = PredictorTensor(n)
+            t._value = o._data if hasattr(o, "_data") else jnp.asarray(o)
+            self._outputs[n] = t
+        if inputs is not None:
+            return [np.asarray(self._outputs[n]._value)
+                    for n in self._output_order]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_order)
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return self._outputs[name]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

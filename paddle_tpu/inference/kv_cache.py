@@ -1,0 +1,131 @@
+"""Paged KV cache: device page pool + host page allocator.
+
+The serving-side memory manager the reference implements inside
+block_multi_head_attention (paddle/phi/kernels/fusion/gpu/
+block_multi_head_attention_kernel.cu — block tables, per-sequence page
+lists) and AnalysisPredictor's buffer management
+(paddle/fluid/inference/api/analysis_predictor.h:105).
+
+TPU-first split of responsibilities:
+- **Device**: one K and one V pool, laid out head-major
+  ``[layers, kv_heads, num_pages, page_size, head_dim]`` — static shapes,
+  donated through the jitted decode step so XLA updates pages in place,
+  and each (head, page) tile is a native ``[page_size, head_dim]`` VMEM
+  block for the Pallas kernel.  The decode step must treat the pool as
+  read-only until one batched end-of-step commit (see generation.py) —
+  a scan that carries the cache copies all of it every step.
+- **Host**: a free-list page allocator (pure Python — page bookkeeping is
+  control flow, not math) producing the int32 block tables / context-lens /
+  slot-mapping operands the Pallas kernel consumes via scalar prefetch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PageAllocator:
+    """Free-list allocator mapping sequence ids to page lists."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._pages: Dict[int, List[int]] = {}     # seq id -> page ids
+        self._lens: Dict[int, int] = {}            # seq id -> token count
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def context_len(self, seq_id: int) -> int:
+        return self._lens[seq_id]
+
+    def _grow(self, seq_id: int, new_len: int) -> None:
+        pages = self._pages[seq_id]
+        need = -(-new_len // self.page_size)       # ceil
+        while len(pages) < need:
+            if not self._free:
+                raise MemoryError(
+                    f"KV cache exhausted: {self.num_pages} pages in use")
+            pages.append(self._free.pop())
+        self._lens[seq_id] = new_len
+
+    def allocate(self, seq_id: int, num_tokens: int) -> np.ndarray:
+        """Register a new sequence with ``num_tokens`` prompt tokens.
+        Returns the flat slot ids [num_tokens] its KV rows must be
+        written to."""
+        if seq_id in self._pages:
+            raise ValueError(f"sequence {seq_id} already allocated")
+        self._pages[seq_id] = []
+        self._lens[seq_id] = 0
+        self._grow(seq_id, num_tokens)
+        return self.slots(seq_id, 0, num_tokens)
+
+    def extend(self, seq_id: int, num_tokens: int = 1) -> np.ndarray:
+        """Append token slots to an existing sequence (decode step)."""
+        start = self._lens[seq_id]
+        self._grow(seq_id, start + num_tokens)
+        return self.slots(seq_id, start, num_tokens)
+
+    def slots(self, seq_id: int, start: int, count: int) -> np.ndarray:
+        pages = self._pages[seq_id]
+        pos = np.arange(start, start + count)
+        page_ids = np.asarray(pages, np.int32)[pos // self.page_size]
+        return (page_ids * self.page_size + pos % self.page_size).astype(np.int32)
+
+    def free(self, seq_id: int) -> None:
+        for p in self._pages.pop(seq_id):
+            self._free.append(p)
+        del self._lens[seq_id]
+
+    def block_table(self, seq_ids: Sequence[int],
+                    max_pages: Optional[int] = None) -> np.ndarray:
+        """[batch, max_pages] int32 table (padded with 0 — kernel masks by
+        context_lens so pad entries only need to be *valid* page ids)."""
+        rows = [self._pages[s] for s in seq_ids]
+        width = max_pages if max_pages is not None else max(
+            (len(r) for r in rows), default=1)
+        width = max(width, 1)
+        out = np.zeros((len(rows), width), np.int32)
+        for i, r in enumerate(rows):
+            if len(r) > width:
+                raise ValueError(
+                    f"sequence needs {len(r)} pages > table width {width}")
+            out[i, :len(r)] = r
+        return out
+
+    def context_lens(self, seq_ids: Sequence[int]) -> np.ndarray:
+        return np.asarray([self._lens[s] for s in seq_ids], np.int32)
+
+
+class PagedKVCache:
+    """Device KV pool for all layers + the allocator that addresses it."""
+
+    def __init__(self, num_layers: int, num_pages: int, page_size: int,
+                 num_kv_heads: int, head_dim: int, dtype="bfloat16"):
+        self.num_layers = num_layers
+        self.page_size = page_size
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        dt = jnp.dtype(dtype)
+        shape = (num_layers, num_kv_heads, num_pages, page_size, head_dim)
+        self.k = jnp.zeros(shape, dt)
+        self.v = jnp.zeros(shape, dt)
+        self.allocator = PageAllocator(num_pages, page_size)
+
+    @property
+    def arrays(self):
+        return self.k, self.v
+
+    def update(self, k, v) -> None:
+        """Store the cache arrays returned by a jitted (donating) step."""
+        self.k, self.v = k, v
+
+    @staticmethod
+    def pages_for(max_batch: int, max_seq_len: int, page_size: int) -> int:
+        return max_batch * (-(-max_seq_len // page_size))

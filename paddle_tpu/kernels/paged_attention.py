@@ -1,0 +1,257 @@
+"""Paged-KV decode attention for TPU (Pallas) — the serving hot op.
+
+Replaces the reference's fused decode kernels
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu and
+masked_multihead_attention): one query token per sequence attends its whole
+KV history, which lives in fixed-size *pages* scattered through a global
+cache and addressed by a per-sequence block table (vLLM-style paged KV).
+
+TPU-first design:
+- The KV cache is laid out **head-major**, ``[kv_heads, num_pages,
+  page_size, head_dim]``, so one (head, page) tile is a ``[page_size,
+  head_dim]`` VMEM block — native (sublane, lane) shape for the MXU, with
+  no squeezed dimension inside the tile.
+- The block table and context lengths ride in as **scalar-prefetch**
+  operands (`pltpu.PrefetchScalarGridSpec`): the index map reads
+  ``block_table[b, i]`` to DMA exactly the pages the sequence owns, so HBM
+  traffic is O(context), never O(max_context).
+- GQA is native: the grid is (batch, kv_heads, pages) and each program
+  holds the ``group = q_heads // kv_heads`` query rows for one KV head —
+  K/V pages are fetched ONCE per group, not per query head.
+- Online softmax (m, l, acc) carries across the page axis in VMEM scratch,
+  which persists along the innermost grid dimension.
+
+Falls back to an XLA gather+masked-softmax reference off-TPU (tests use it
+as the numerics oracle; ``FLAGS_paged_attention_interpret=1`` runs the real
+kernel in interpreter mode).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import flags
+
+NEG_INF = -1e30
+_I0 = np.int32(0)  # index-map literal: bare 0 would be int64 under x64 mode
+
+flags.define_flag("paged_attention_interpret", False,
+                  "Run the Pallas paged-attention kernel in interpreter mode "
+                  "on CPU (tests only; TPU always uses the compiled path).")
+
+_MIN_GROUP = 8  # pad query-group rows to the f32 sublane count
+
+
+def _reference_paged_attention(q, k_cache, v_cache, block_tables,
+                               context_lens, with_lse=False):
+    """XLA oracle: gather pages, masked softmax. q: [B, qh, d]."""
+    b, qh, d = q.shape
+    kvh, n_pages, page_size, _ = k_cache.shape
+    group = qh // kvh
+    max_pages = block_tables.shape[1]
+
+    flat = block_tables.reshape(-1)
+    k = jnp.take(k_cache, flat, axis=1)          # [kvh, B*P, page, d]
+    v = jnp.take(v_cache, flat, axis=1)
+    k = k.reshape(kvh, b, max_pages * page_size, d)
+    v = v.reshape(kvh, b, max_pages * page_size, d)
+
+    qg = q.reshape(b, kvh, group, d).astype(jnp.float32)
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bhgd,hbsd->bhgs", qg, k.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_pages * page_size)
+    mask = pos[None, :] < context_lens[:, None]            # [B, S]
+    s = jnp.where(mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgs,hbsd->bhgd", p, v.astype(jnp.float32))
+    out = out.reshape(b, qh, d).astype(q.dtype)
+    if not with_lse:
+        return out
+    lse = jax.scipy.special.logsumexp(s, axis=-1)          # [B, kvh, g]
+    return out, lse.reshape(b, qh)
+
+
+def _paged_attn_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       m_ref, l_ref, acc_ref, *, page_size, scale):
+    """One (batch, kv_head, page) program: online-softmax over one KV page.
+
+    bt_ref/cl_ref are scalar-prefetched (block table, context lens); the
+    page to visit was already selected by the k/v index maps.
+    """
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    n_i = pl.num_programs(2)
+    ctx = cl_ref[b]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+
+    # pages wholly beyond the context contribute nothing — skip the math
+    # (their DMA was clamped to page 0 host-side)
+    used = i * page_size < ctx
+
+    @pl.when(used)
+    def _compute():
+        q = q_ref[...].astype(jnp.float32) * jnp.float32(scale)  # [g, d]
+        k = k_ref[...].astype(jnp.float32)                       # [page, d]
+        v = v_ref[...].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [g, page]
+        pos = i * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < ctx, s, jnp.float32(NEG_INF))
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(i == n_i - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...], jnp.float32(1e-30))
+        o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[...] = m_ref[...] + jnp.log(l)
+
+
+def _pallas_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
+                            interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, qh, d = q.shape
+    kvh, n_pages, page_size, _ = k_cache.shape
+    group = qh // kvh
+    max_pages = block_tables.shape[1]
+    gp = max(group, _MIN_GROUP)
+
+    qg = q.reshape(b, kvh, group, d)
+    if gp != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+
+    # unused table entries must still be valid page ids for the DMA
+    bt = jnp.clip(block_tables, 0, n_pages - 1).astype(jnp.int32)
+    cl = context_lens.astype(jnp.int32)
+
+    kernel = functools.partial(_paged_attn_kernel, page_size=page_size,
+                               scale=1.0 / math.sqrt(d))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kvh, max_pages),
+        in_specs=[
+            pl.BlockSpec((None, None, gp, d),
+                         lambda b_, h, i, bt_, cl_: (b_, h, _I0, _I0)),
+            pl.BlockSpec((None, None, page_size, d),
+                         lambda b_, h, i, bt_, cl_: (h, bt_[b_, i], _I0, _I0)),
+            pl.BlockSpec((None, None, page_size, d),
+                         lambda b_, h, i, bt_, cl_: (h, bt_[b_, i], _I0, _I0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, gp, d),
+                         lambda b_, h, i, bt_, cl_: (b_, h, _I0, _I0)),
+            pl.BlockSpec((None, None, gp, 1),
+                         lambda b_, h, i, bt_, cl_: (b_, h, _I0, _I0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((gp, 1), jnp.float32),
+            pltpu.VMEM((gp, 1), jnp.float32),
+            pltpu.VMEM((gp, d), jnp.float32),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((b, kvh, gp, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, kvh, gp, 1), jnp.float32)],
+        interpret=interpret,
+    )(bt, cl, qg, k_cache, v_cache)
+    return (out[:, :, :group, :].reshape(b, qh, d),
+            lse[:, :, :group, 0].reshape(b, qh))
+
+
+def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
+                    with_lse=False):
+    """Single-token decode attention over a paged KV cache.
+
+    Args:
+      q:            [batch, num_q_heads, head_dim] — this step's query.
+      k_cache:      [num_kv_heads, num_pages, page_size, head_dim].
+      v_cache:      same shape as k_cache.
+      block_tables: [batch, max_pages_per_seq] int32 page ids (pad with 0).
+      context_lens: [batch] int32 — number of cache tokens to attend.
+      with_lse:     also return the per-query logsumexp ([batch, q_heads],
+                    fp32) so the caller can merge extra keys (e.g. the
+                    current token, which need not be written to the cache
+                    before the call) via online-softmax combination.
+
+    Returns [batch, num_q_heads, head_dim] (and lse when requested).
+    """
+    b, qh, d = q.shape
+    kvh, _, page_size, _ = k_cache.shape
+    if qh % kvh:
+        raise ValueError(f"q heads ({qh}) must be a multiple of kv heads ({kvh})")
+    on_tpu = jax.default_backend() == "tpu"
+    interpret = flags.flag("paged_attention_interpret")
+    # f32 sublane is 8; bf16 packs 16 — page_size must tile the sublane dim
+    ok = page_size % 8 == 0 and d % 128 in (0, 64)
+    if (on_tpu or interpret) and ok:
+        out, lse = _pallas_paged_attention(
+            q, k_cache, v_cache, block_tables, context_lens,
+            interpret=not on_tpu)
+        return (out, lse) if with_lse else out
+    return _reference_paged_attention(q, k_cache, v_cache, block_tables,
+                                      context_lens, with_lse=with_lse)
+
+
+def write_kv_pages(k_cache, v_cache, k_new, v_new, slot_mapping):
+    """Scatter new KV rows into the paged cache.
+
+    k_new/v_new: [n_tokens, kv_heads, head_dim]; slot_mapping: [n_tokens]
+    int32 flat slots (page_id * page_size + offset; -1 = drop the token).
+    Returns updated (k_cache, v_cache).  Donate the caches under jit and
+    XLA performs the scatter in place.
+    """
+    kvh, n_pages, page_size, d = k_cache.shape
+    flat_k = k_cache.reshape(kvh, n_pages * page_size, d)
+    flat_v = v_cache.reshape(kvh, n_pages * page_size, d)
+    slots = slot_mapping.astype(jnp.int32)
+    # dropped tokens (-1) are redirected out of range; mode="drop" elides them
+    safe = jnp.where(slots >= 0, slots, n_pages * page_size)
+    kn = jnp.swapaxes(k_new, 0, 1).astype(flat_k.dtype)   # [kvh, n, d]
+    vn = jnp.swapaxes(v_new, 0, 1).astype(flat_v.dtype)
+    flat_k = flat_k.at[:, safe].set(kn, mode="drop")
+    flat_v = flat_v.at[:, safe].set(vn, mode="drop")
+    return (flat_k.reshape(k_cache.shape), flat_v.reshape(v_cache.shape))
+
+
+def write_kv_pages_all_layers(k_cache, v_cache, k_all, v_all, slot_mapping):
+    """One scatter committing every layer's new KV rows.
+
+    k_cache/v_cache: [layers, kv_heads, num_pages, page_size, head_dim];
+    k_all/v_all: [layers, n_tokens, kv_heads, head_dim]; slot_mapping:
+    [n_tokens] (-1 = drop).  A single batched scatter (all layers share the
+    slot vector) keeps the decode step's cache strictly read-before-write:
+    attention reads the pre-step cache, the commit happens once at the end,
+    and XLA aliases the donated buffers in place.
+    """
+    L, kvh, n_pages, page_size, d = k_cache.shape
+    flat_k = k_cache.reshape(L, kvh, n_pages * page_size, d)
+    flat_v = v_cache.reshape(L, kvh, n_pages * page_size, d)
+    slots = slot_mapping.astype(jnp.int32)
+    safe = jnp.where(slots >= 0, slots, n_pages * page_size)
+    kn = jnp.swapaxes(k_all, 1, 2).astype(flat_k.dtype)   # [L, kvh, n, d]
+    vn = jnp.swapaxes(v_all, 1, 2).astype(flat_v.dtype)
+    flat_k = flat_k.at[:, :, safe].set(kn, mode="drop")
+    flat_v = flat_v.at[:, :, safe].set(vn, mode="drop")
+    return (flat_k.reshape(k_cache.shape), flat_v.reshape(v_cache.shape))

@@ -152,3 +152,128 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def _extract_chunks(tags, scheme, num_chunk_types):
+    """Decode (chunk_type, begin, end) spans from a tag sequence.
+
+    Tag encoding follows the reference chunk_eval op
+    (paddle/fluid/operators/chunk_eval_op.cc): for IOB each chunk type t
+    owns tags (2t = B-t, 2t+1 = I-t); IOE uses (I-t, E-t); IOBES uses
+    (B, I, E, S) per type; ``plain`` gives one tag per type.  The 'O'
+    (outside) tag is the largest id.
+    """
+    scheme = scheme.lower()
+    width = {"plain": 1, "iob": 2, "ioe": 2, "iobes": 4}[scheme]
+    outside = num_chunk_types * width
+    chunks = []
+    start = None
+    cur_type = None
+
+    def flush(end):
+        nonlocal start, cur_type
+        if start is not None:
+            chunks.append((cur_type, start, end))
+        start, cur_type = None, None
+
+    for i, tag in enumerate(list(tags)):
+        tag = int(tag)
+        if tag >= outside or tag < 0:
+            flush(i - 1)
+            continue
+        ctype, pos = tag // width, tag % width
+        if scheme == "plain":
+            if cur_type != ctype:
+                flush(i - 1)
+                start, cur_type = i, ctype
+        elif scheme == "iob":
+            if pos == 0:                      # B: always starts a chunk
+                flush(i - 1)
+                start, cur_type = i, ctype
+            elif cur_type != ctype:           # I of a different type
+                flush(i - 1)
+                start, cur_type = i, ctype
+        elif scheme == "ioe":
+            if cur_type != ctype:
+                flush(i - 1)
+                start, cur_type = i, ctype
+            if pos == 1:                      # E: ends the chunk
+                flush(i)
+        else:                                  # iobes
+            if pos == 0:                      # B
+                flush(i - 1)
+                start, cur_type = i, ctype
+            elif pos == 3:                    # S: single-token chunk
+                flush(i - 1)
+                chunks.append((ctype, i, i))
+            elif pos == 2:                    # E
+                if cur_type != ctype:
+                    flush(i - 1)
+                    start, cur_type = i, ctype
+                flush(i)
+            else:                             # I
+                if cur_type != ctype:
+                    flush(i - 1)
+                    start, cur_type = i, ctype
+    flush(len(list(tags)) - 1)
+    return set(chunks)
+
+
+def chunk_eval(inference, label, chunk_scheme, num_chunk_types,
+               seq_lens=None, excluded_chunk_types=None):
+    """Chunk-detection precision/recall/F1 (reference ops.yaml: chunk_eval —
+    paddle/fluid/operators/chunk_eval_op.cc; sequence-labeling NER metric).
+
+    inference/label: [B, T] int tag matrices; seq_lens: [B] valid lengths.
+    Returns (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks) — host-side numpy (a metric, not a jitted op).
+    """
+    inference, label = _np(inference), _np(label)
+    if inference.ndim == 1:
+        inference, label = inference[None], label[None]
+    B = inference.shape[0]
+    excluded = set(excluded_chunk_types or ())
+    n_inf = n_lab = n_cor = 0
+    for b in range(B):
+        ln = int(seq_lens[b]) if seq_lens is not None else inference.shape[1]
+        inf = _extract_chunks(inference[b, :ln], chunk_scheme, num_chunk_types)
+        lab = _extract_chunks(label[b, :ln], chunk_scheme, num_chunk_types)
+        inf = {c for c in inf if c[0] not in excluded}
+        lab = {c for c in lab if c[0] not in excluded}
+        n_inf += len(inf)
+        n_lab += len(lab)
+        n_cor += len(inf & lab)
+    precision = n_cor / n_inf if n_inf else 0.0
+    recall = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * precision * recall / (precision + recall) \
+        if precision + recall else 0.0
+    return precision, recall, f1, n_inf, n_lab, n_cor
+
+
+class ChunkEvaluator(Metric):
+    """Streaming chunk F1 (reference: paddlenlp-style ChunkEvaluator over
+    the chunk_eval op)."""
+
+    def __init__(self, chunk_scheme, num_chunk_types, name="chunk"):
+        self._scheme = chunk_scheme
+        self._n = num_chunk_types
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self._inf = self._lab = self._cor = 0
+
+    def update(self, inference, label, seq_lens=None):
+        _, _, _, i, l, c = chunk_eval(inference, label, self._scheme,
+                                      self._n, seq_lens)
+        self._inf += i
+        self._lab += l
+        self._cor += c
+
+    def accumulate(self):
+        p = self._cor / self._inf if self._inf else 0.0
+        r = self._cor / self._lab if self._lab else 0.0
+        return 2 * p * r / (p + r) if p + r else 0.0
+
+    def name(self):
+        return self._name

@@ -251,14 +251,47 @@ class BatchNorm3D(_NormBase):
 
 
 class SyncBatchNorm(_NormBase):
-    """On TPU under jit+GSPMD batch stats are computed over the global batch
-    automatically (XLA lowers the mean/var reductions as cross-replica when the
-    batch dim is sharded), so SyncBatchNorm == BatchNorm here.
-    Reference: python/paddle/nn/layer/norm.py SyncBatchNorm (NCCL allreduce path).
+    """Batch norm with cross-device batch statistics (reference:
+    python/paddle/nn/layer/norm.py SyncBatchNorm, NCCL allreduce of
+    count/sum/sum_sq).  Here the reduction is a ``lax.psum`` over the
+    process group's mesh axis inside shard_map/pmap (eager DP path);
+    under jit+GSPMD with a batch-sharded input, plain BatchNorm already
+    reduces globally, so both paths give reference semantics.
     """
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 group=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format)
+        self._group = group
+
+    def forward(self, x):
+        return F.sync_batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum,
+            epsilon=self._epsilon, data_format=self._data_format,
+            group=self._group)
 
     @classmethod
     def convert_sync_batchnorm(cls, layer):
+        """Recursively replace BatchNorm* sublayers with SyncBatchNorm,
+        keeping parameters and running stats (reference classmethod)."""
+        if isinstance(layer, _NormBase) and not isinstance(layer, cls):
+            new = cls(layer._num_features, momentum=layer._momentum,
+                      epsilon=layer._epsilon,
+                      data_format=layer._data_format)
+            new.weight = layer.weight
+            new.bias = layer.bias
+            new._mean = layer._mean
+            new._variance = layer._variance
+            if layer.training:
+                new.train()
+            else:
+                new.eval()
+            return new
+        for name, sub in list(layer.named_children()):
+            setattr(layer, name, cls.convert_sync_batchnorm(sub))
         return layer
 
 
@@ -616,6 +649,24 @@ class PairwiseDistance(Layer):
         from ..ops.math import subtract
         return linalg.norm(subtract(x, y), p=self.p, axis=-1, keepdim=self.keepdim)
 
+
+class CTCLoss(_Loss):
+    """reference python/paddle/nn/layer/loss.py CTCLoss (warpctc slot)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__(reduction)
+        self.blank = blank
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)
+
+
+# ---------------- recurrent layers ----------------
+from .rnn import (BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN,  # noqa: E402,F401
+                  RNNCellBase, SimpleRNN, SimpleRNNCell)
 
 # utils namespace parity
 from . import utils  # noqa: E402,F401

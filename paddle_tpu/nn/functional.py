@@ -910,6 +910,367 @@ def square_error_cost(input, label):  # noqa: A002
     return apply_op("square_error_cost", lambda a, b: jnp.square(a - b), (_t(input), _t(label)))
 
 
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference ops.yaml: gather_tree —
+    paddle/phi/kernels/cpu/gather_tree_kernel.cc behavior).
+
+    ids/parents: [max_time, batch, beam].  Walks parent pointers from the
+    last step back to the first as one reverse ``lax.scan``.
+    """
+    def prim(ids_, parents_):
+        T, B, W = ids_.shape
+        beam = jnp.arange(W, dtype=parents_.dtype)[None, :].repeat(B, axis=0)
+
+        def step(carry, xs):
+            sel = carry                        # [B, W] beam index at t+1
+            ids_t, par_t = xs
+            out = jnp.take_along_axis(ids_t, sel, axis=1)
+            sel_prev = jnp.take_along_axis(par_t, sel, axis=1)
+            return sel_prev, out
+
+        _, outs = jax.lax.scan(step, beam, (ids_, parents_), reverse=True)
+        return outs
+
+    return apply_op("gather_tree", prim, (_t(ids), _t(parents)))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-family margin softmax CE (reference ops.yaml:
+    margin_cross_entropy — paddle/phi/kernels/gpu/margin_cross_entropy_kernel.cu).
+    logits are cosine similarities in [-1, 1]; the target class logit
+    becomes cos(m1*theta + m2) - m3, all scaled by ``scale``.
+
+    Model-parallel class sharding (the reference's ``group`` path) is
+    expressed on TPU by sharding the class dim under GSPMD — the softmax
+    reductions lower to cross-replica collectives automatically.
+    """
+    def prim(lg, lb):
+        cos = jnp.clip(lg.astype(jnp.float32), -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(lb, lg.shape[-1], dtype=jnp.float32)
+        out = jnp.where(onehot > 0, tgt, cos) * scale
+        lse = jax.scipy.special.logsumexp(out, axis=-1)
+        gold = jnp.sum(out * onehot, axis=-1)
+        loss = lse - gold
+        loss = _reduce_loss(loss, reduction)
+        if return_softmax:
+            return loss, jax.nn.softmax(out, axis=-1)
+        return loss
+
+    return apply_op("margin_cross_entropy", prim, (_t(logits), _t(label)))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample ``num_samples`` class centers always including the positives
+    (reference ops.yaml: class_center_sample, used with
+    margin_cross_entropy for large-class-count TP training).
+
+    Returns (remapped_label, sampled_class_indices[num_samples]).
+    Static output size (TPU-friendly).  All positives are included as long
+    as the batch has <= num_samples distinct positive classes (size the
+    call accordingly, as the reference requires); beyond that a random
+    num_samples-subset of the positives is kept and dropped labels remap
+    to num_samples - 1 rather than silently aliasing another class.
+    """
+    def prim(lb):
+        pos = jnp.zeros((num_classes,), jnp.int32).at[lb].set(1)
+        # order: positives first, then the rest — both in random order
+        noise = jax.random.uniform(rnd.next_key(), (num_classes,))
+        rank = jnp.argsort(-pos.astype(jnp.float32) + noise * 0.5)
+        sampled = jnp.sort(rank[:num_samples]).astype(lb.dtype)
+        # remap: position of each label inside `sampled`
+        idx = jnp.searchsorted(sampled, lb)
+        idx_c = jnp.clip(idx, 0, num_samples - 1)
+        found = jnp.take(sampled, idx_c) == lb
+        remapped = jnp.where(found, idx_c,
+                             num_samples - 1).astype(lb.dtype)
+        return remapped, sampled
+
+    return apply_op("class_center_sample", prim, (_t(label),))
+
+
+def rnnt_loss(logits, labels, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-Transducer loss (reference: paddle.nn.functional.rnnt_loss backed
+    by warprnnt — paddle/phi/kernels/gpu/warprnnt_kernel.cu behavior;
+    log_softmax applied internally).
+
+    logits: [B, maxT, maxU+1, V]; labels: [B, maxU].  Forward variable
+    alpha over the (t, u) lattice: one ``lax.scan`` over t with the
+    in-step u-recurrence unrolled as a second scan (log-space throughout).
+    """
+    NEG = -1e30
+
+    def prim(lg, lb, t_len, u_len):
+        B, T, U1, V = lg.shape
+        U = U1 - 1
+        lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        blank_lp = lp[..., blank]                          # [B, T, U+1]
+        lab_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], lb[:, None, :, None].astype(jnp.int32),
+            axis=-1)[..., 0]                               # [B, T, U]
+
+        u_idx = jnp.arange(U1)
+
+        def u_scan(alpha_t, blank_col, lab_col):
+            """alpha for one t from alpha at t-1: first the horizontal
+            (blank, t-1 -> t) move, then the vertical (label) recurrence."""
+            horiz = alpha_t + blank_col                    # [B, U+1]
+
+            def vstep(carry, xs):
+                h_u, lab_prev = xs                         # [B], [B]
+                prev = carry                               # alpha[t, u-1]
+                cur = jnp.logaddexp(h_u, prev + lab_prev)
+                return cur, cur
+
+            # u = 0 has no vertical move
+            init = horiz[:, 0]
+            _, rest = jax.lax.scan(
+                vstep, init,
+                (horiz[:, 1:].T, lab_col.T))
+            return jnp.concatenate([init[:, None], rest.T], axis=1)
+
+        # t = 0 row: alpha[0, u] = sum of label emissions up to u
+        lab0 = jnp.concatenate(
+            [jnp.zeros((B, 1)), jnp.cumsum(lab_lp[:, 0, :], axis=-1)],
+            axis=1)
+        alpha0 = jnp.where(u_idx[None, :] <= U, lab0, NEG)
+
+        def body(alpha, xs):
+            blank_col, lab_col = xs
+            new = u_scan(alpha, blank_col, lab_col)
+            return new, new
+
+        _, rest = jax.lax.scan(
+            body, alpha0,
+            (jnp.moveaxis(blank_lp[:, :-1, :], 1, 0),
+             jnp.moveaxis(lab_lp[:, 1:, :], 1, 0)))
+        all_alpha = jnp.concatenate([alpha0[None], rest], axis=0)  # [T,B,U+1]
+
+        t_idx = jnp.clip(t_len.astype(jnp.int32) - 1, 0, T - 1)
+        a_fin = all_alpha[t_idx, jnp.arange(B)]            # [B, U+1]
+        a_end = jnp.take_along_axis(
+            a_fin, u_len.astype(jnp.int32)[:, None], axis=1)[:, 0]
+        final_blank = jnp.take_along_axis(
+            blank_lp[jnp.arange(B), t_idx], u_len.astype(jnp.int32)[:, None],
+            axis=1)[:, 0]
+        loss = -(a_end + final_blank)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("rnnt_loss", prim,
+                    (_t(logits), _t(labels), _t(input_lengths),
+                     _t(label_lengths)))
+
+
+def edit_distance(hyps, refs, hyp_lengths, ref_lengths, normalized=True,
+                  name=None):
+    """Batched Levenshtein distance (reference ops.yaml: edit_distance —
+    paddle/phi/kernels/cpu/edit_distance_kernel.cc behavior, padded-tensor
+    form).  hyps: [B, maxH]; refs: [B, maxR]; returns ([B] distances,
+    [B] sequence count).  One lax.scan over hypothesis positions carrying
+    the DP row — static shapes, batch-vectorized.
+    """
+    def prim(h, r, hl, rl):
+        B, maxH = h.shape
+        maxR = r.shape[1]
+        hl = hl.astype(jnp.int32)
+        rl = rl.astype(jnp.int32)
+        j_idx = jnp.arange(maxR + 1)
+        row0 = jnp.broadcast_to(j_idx.astype(jnp.float32), (B, maxR + 1))
+
+        def step(row, xs):
+            h_tok, i = xs                       # [B], scalar
+            sub_cost = (h_tok[:, None] != r).astype(jnp.float32)  # [B, maxR]
+            diag = row[:, :-1] + sub_cost
+            up = row[:, 1:] + 1.0
+
+            def left_scan(carry, cols):
+                d_col, u_col = cols
+                cur = jnp.minimum(jnp.minimum(d_col, u_col), carry + 1.0)
+                return cur, cur
+
+            first = jnp.full((B,), 0.0) + (i + 1.0)
+            _, rest = jax.lax.scan(left_scan, first, (diag.T, up.T))
+            new = jnp.concatenate([first[:, None], rest.T], axis=1)
+            # rows beyond each hypothesis length stay frozen
+            return jnp.where((i < hl)[:, None], new, row), None
+
+        row, _ = jax.lax.scan(step, row0,
+                              (h.T, jnp.arange(maxH, dtype=jnp.int32)))
+        dist = jnp.take_along_axis(row, rl[:, None], axis=1)[:, 0]
+        if normalized:
+            dist = dist / jnp.maximum(rl.astype(jnp.float32), 1.0)
+        return dist, jnp.full((1,), B, jnp.int64)
+
+    return apply_op("edit_distance", prim,
+                    (_t(hyps), _t(refs), _t(hyp_lengths), _t(ref_lengths)))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """Connectionist Temporal Classification loss (reference behavior:
+    paddle.nn.functional.ctc_loss backed by warpctc —
+    paddle/phi/kernels/gpu/warpctc_kernel.cu; softmax is applied to the
+    logits internally, warpctc semantics).
+
+    TPU-first formulation: the alpha forward recursion over the extended
+    label sequence runs as one ``lax.scan`` over time in log space — static
+    shapes, batch vectorized; gradients come from jax autodiff through the
+    scan (no hand-written beta pass needed).
+
+    Args:
+      log_probs:     [max_T, batch, num_classes] unnormalized logits.
+      labels:        [batch, max_label_len] int labels (padded arbitrarily).
+      input_lengths: [batch] int.
+      label_lengths: [batch] int (>= 1).
+      blank:         blank class id.
+      reduction:     'mean' divides each loss by its label length then
+                     averages (reference semantics); 'sum' | 'none'.
+      norm_by_times: divide each sequence's loss by its input length
+                     (reference warpctc grad normalization), applied
+                     before the reduction.
+    """
+    NEG = -1e30
+
+    def prim(lp, lab, in_len, lab_len):
+        T, B, C = lp.shape
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        L = lab.shape[1]
+        S = 2 * L + 1
+        s_idx = jnp.arange(S)
+        lab_at = lab[:, jnp.clip((s_idx - 1) // 2, 0, max(L - 1, 0))]
+        ext = jnp.where(s_idx[None, :] % 2 == 0, blank, lab_at)   # [B, S]
+        # diagonal skip s-2 -> s allowed for label positions with a label
+        # different from the one two back (standard CTC topology)
+        ext_prev2 = jnp.concatenate(
+            [jnp.full((B, 2), -1, ext.dtype), ext[:, :-2]], axis=1)
+        allow_skip = (s_idx[None, :] % 2 == 1) & (ext != ext_prev2)
+
+        def emit(lp_t):
+            return jnp.take_along_axis(lp_t, ext, axis=-1)        # [B, S]
+
+        alpha0 = jnp.where(s_idx[None, :] < 2, emit(lp[0]), NEG)
+
+        def step(alpha, lp_t):
+            a1 = alpha
+            a2 = jnp.concatenate(
+                [jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+            a3 = jnp.where(
+                allow_skip,
+                jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]],
+                                axis=1),
+                NEG)
+            m = jnp.maximum(jnp.maximum(a1, a2), a3)
+            tot = m + jnp.log(jnp.exp(a1 - m) + jnp.exp(a2 - m)
+                              + jnp.exp(a3 - m))
+            new = tot + emit(lp_t)
+            return new, new
+
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+
+        t_idx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
+        a_last = alphas[t_idx, jnp.arange(B)]                     # [B, S]
+        sl = 2 * lab_len.astype(jnp.int32)
+        a_end = jnp.take_along_axis(a_last, sl[:, None], axis=1)[:, 0]
+        a_end2 = jnp.take_along_axis(
+            a_last, jnp.maximum(sl - 1, 0)[:, None], axis=1)[:, 0]
+        m = jnp.maximum(a_end, a_end2)
+        ll = m + jnp.log(jnp.exp(a_end - m) + jnp.exp(a_end2 - m))
+        loss = -ll                                                # [B]
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(
+                lab_len.astype(jnp.float32), 1.0))
+        return _reduce_loss(loss, reduction)
+
+    return apply_op("ctc_loss", prim,
+                    (_t(log_probs), _t(labels), _t(input_lengths),
+                     _t(label_lengths)))
+
+
+def sync_batch_norm(x, running_mean, running_var, weight=None, bias=None,
+                    training=False, momentum=0.9, epsilon=1e-5,
+                    data_format="NCHW", group=None, name=None):
+    """Batch norm with batch statistics reduced across the data-parallel
+    group (reference: python/paddle/nn/layer/norm.py SyncBatchNorm, kernel
+    paddle/phi/kernels/gpu/sync_batch_norm_kernel.cu — NCCL allreduce of
+    (count, sum, sum_sq)).
+
+    TPU-native: inside a shard_map/pmap context over the group's mesh axis
+    the partial (count, sum, sum_sq) are combined with ``lax.psum`` — the
+    direct analog of the reference's allreduce.  Outside any parallel
+    context (or with world size 1) it degenerates to plain batch_norm.
+    Under jit+GSPMD with a batch-sharded input, plain batch_norm already
+    computes global statistics (XLA emits the cross-replica reduction), so
+    this explicit form is only needed for the eager/shard_map path.
+    """
+    x = _t(x)
+    if not training:
+        return batch_norm(x, running_mean, running_var, weight, bias,
+                          training=False, momentum=momentum, epsilon=epsilon,
+                          data_format=data_format)
+
+    ch_dim = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_dim)
+    shape = [1] * x.ndim
+    shape[ch_dim] = x.shape[ch_dim]
+
+    axis_name = None
+    if group is None:
+        from ..distributed.group import get_group
+        group = get_group(0)
+    if group is not None and getattr(group, "nranks", 1) > 1:
+        axis_name = group.axis_name
+
+    arr = x._data
+    n_local = jnp.asarray(
+        np.prod([arr.shape[i] for i in axes]), jnp.float32)
+    s = jnp.sum(arr.astype(jnp.float32), axis=axes)
+    ss = jnp.sum(jnp.square(arr.astype(jnp.float32)), axis=axes)
+    if axis_name is not None:
+        try:
+            n = jax.lax.psum(n_local, axis_name)
+            s = jax.lax.psum(s, axis_name)
+            ss = jax.lax.psum(ss, axis_name)
+        except NameError:          # not inside a mapped context: local stats
+            n = n_local
+    else:
+        n = n_local
+    mean = s / n
+    var = ss / n - jnp.square(mean)
+
+    if running_mean is not None:
+        running_mean._data = momentum * running_mean._data + \
+            (1 - momentum) * mean
+        running_var._data = momentum * running_var._data + \
+            (1 - momentum) * var
+
+    def prim(a, *wb):
+        out = (a - mean.reshape(shape).astype(a.dtype)) * \
+            jax.lax.rsqrt(var.reshape(shape) + epsilon).astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("sync_batch_norm", prim, tuple(args))
+
+
 def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
                        reduction="sum", name=None):
     args = [_t(logit), _t(label)] + ([_t(normalizer)] if normalizer is not None else [])

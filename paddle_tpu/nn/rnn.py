@@ -1,0 +1,462 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU cells + multi-layer wrappers.
+
+Reference behavior surface: python/paddle/nn/layer/rnn.py (SimpleRNNCell
+:741, LSTMCell :918 — gate order i,f,g,o — GRUCell :1144 — gate order
+r,z,c with h = z*h_prev + (1-z)*c_tilde) and the cudnn_lstm/gru/rnn
+kernels the coverage report previously parked as "no TPU analog".
+
+TPU-first design: the recurrence is a single ``lax.scan`` over time whose
+step does one fused ``[B, I] @ [I, G*H]`` matmul per direction — XLA keeps
+the scan body resident and the MXU busy; there is no per-timestep Python.
+Variable-length sequences are masked inside the scan (state freezes and
+outputs zero past each row's length — matching the reference's
+sequence_length semantics), so the whole batch stays one static-shape
+program.  Weight layout matches the reference exactly
+(``weight_ih: [G*H, I]``, ``weight_hh: [G*H, H]``, per-gate concatenation)
+so checkpoints and the torch oracle line up 1:1.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._prim import apply_op, _t
+from . import functional as F
+from . import initializer as I
+from .layer import Layer, LayerList
+
+
+def _uniform_std(hidden_size):
+    std = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-std, std)
+
+
+class RNNCellBase(Layer):
+    """Base for single-step cells (reference rnn.py RNNCellBase)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shapes = shape or self.state_shape
+        if isinstance(shapes[0], (tuple, list)):
+            return tuple(
+                Tensor(jnp.full((batch,) + tuple(s), init_value,
+                                batch_ref._data.dtype)) for s in shapes)
+        return Tensor(jnp.full((batch,) + tuple(shapes), init_value,
+                               batch_ref._data.dtype))
+
+    def _make_params(self, gates: int, input_size: int, hidden_size: int,
+                     weight_ih_attr=None, weight_hh_attr=None,
+                     bias_ih_attr=None, bias_hh_attr=None):
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [gates * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [gates * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter(
+            [gates * hidden_size], attr=None, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter(
+            [gates * hidden_size], attr=None, is_bias=True,
+            default_initializer=init)
+
+    def _weights(self):
+        """The four weight Tensors (zero stand-ins for absent biases) —
+        passed as apply_op args so grads accumulate on the Parameters."""
+        zeros = Tensor(jnp.zeros([self.weight_ih.shape[0]],
+                                 self.weight_ih._data.dtype))
+        return (self.weight_ih, self.weight_hh,
+                self.bias_ih if self.bias_ih is not None else zeros,
+                self.bias_hh if self.bias_hh is not None else zeros)
+
+
+def _lstm_step(h, c, xt, wih, whh, bih, bhh):
+    gates = xt @ wih.T + bih + h @ whh.T + bhh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c_new = f * c + i * jnp.tanh(g)
+    return o * jnp.tanh(c_new), c_new
+
+
+def _gru_step(h, xt, wih, whh, bih, bhh):
+    xg = xt @ wih.T + bih
+    hg = h @ whh.T + bhh
+    x_r, x_z, x_c = jnp.split(xg, 3, axis=-1)
+    h_r, h_z, h_c = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(x_r + h_r)
+    z = jax.nn.sigmoid(x_z + h_z)
+    c = jnp.tanh(x_c + r * h_c)
+    return z * h + (1.0 - z) * c
+
+
+def _simple_step(h, xt, wih, whh, bih, bhh, act):
+    return act(xt @ wih.T + bih + h @ whh.T + bhh)
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h_t = act(W_ih x_t + b_ih + W_hh h_{t-1} + b_hh) (reference :741)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        self._act = jnp.tanh if activation == "tanh" else jax.nn.relu
+        self._make_params(1, input_size, hidden_size, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        x = _t(inputs)
+        h = states if states is not None else self.get_initial_states(x)
+        act = self._act
+        out = apply_op("simple_rnn_cell",
+                       lambda xt, hh, wi, wh, bi, bh:
+                       _simple_step(hh, xt, wi, wh, bi, bh, act),
+                       (x, _t(h), self.weight_ih, self.weight_hh)
+                       + self._bias_args())
+        return out, out
+
+    def _bias_args(self):
+        zeros = Tensor(jnp.zeros([self.weight_ih.shape[0]],
+                                 self.weight_ih._data.dtype))
+        return (self.bias_ih if self.bias_ih is not None else zeros,
+                self.bias_hh if self.bias_hh is not None else zeros)
+
+
+class LSTMCell(RNNCellBase):
+    """Gate order i,f,g,o (reference :918, chunk order :1118-1123)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self._make_params(4, input_size, hidden_size, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        x = _t(inputs)
+        if states is None:
+            states = self.get_initial_states(x)
+        h, c = states
+        zeros = Tensor(jnp.zeros([self.weight_ih.shape[0]],
+                                 self.weight_ih._data.dtype))
+        bi = self.bias_ih if self.bias_ih is not None else zeros
+        bh = self.bias_hh if self.bias_hh is not None else zeros
+        h_new, c_new = apply_op(
+            "lstm_cell",
+            lambda xt, hh, cc, wi, wh, bi_, bh_:
+            _lstm_step(hh, cc, xt, wi, wh, bi_, bh_),
+            (x, _t(h), _t(c), self.weight_ih, self.weight_hh, bi, bh))
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    """Gate order r,z,c; h = z*h + (1-z)*c_tilde (reference :1144)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self._make_params(3, input_size, hidden_size, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        x = _t(inputs)
+        h = states if states is not None else self.get_initial_states(x)
+        zeros = Tensor(jnp.zeros([self.weight_ih.shape[0]],
+                                 self.weight_ih._data.dtype))
+        bi = self.bias_ih if self.bias_ih is not None else zeros
+        bh = self.bias_hh if self.bias_hh is not None else zeros
+        out = apply_op(
+            "gru_cell",
+            lambda xt, hh, wi, wh, bi_, bh_:
+            _gru_step(hh, xt, wi, wh, bi_, bh_),
+            (x, _t(h), self.weight_ih, self.weight_hh, bi, bh))
+        return out, out
+
+
+# ---------------------------------------------------------------------------
+# scan-based sequence runners (raw-array prims; grads flow through jax.vjp)
+# ---------------------------------------------------------------------------
+
+def _scan_layer(mode, x, h0, c0, wih, whh, bih, bhh, seq_len, reverse, act):
+    """x: [B, T, I] → (y [B, T, H], hT [B, H], cT [B, H]).
+
+    With seq_len (int32 [B]): state freezes and y is 0 beyond each length.
+    ``reverse`` runs right-to-left but masks as if the sequence were
+    left-aligned (reference BiRNN semantics for variable length)."""
+    T = x.shape[1]
+    xs = jnp.swapaxes(x, 0, 1)                     # [T, B, I]
+    ts = jnp.arange(T, dtype=jnp.int32)
+    if reverse:
+        xs = xs[::-1]
+        ts = ts[::-1]
+
+    def step(carry, inp):
+        h, c = carry
+        xt, t = inp
+        if mode == "LSTM":
+            h_new, c_new = _lstm_step(h, c, xt, wih, whh, bih, bhh)
+        elif mode == "GRU":
+            h_new, c_new = _gru_step(h, xt, wih, whh, bih, bhh), c
+        else:
+            h_new, c_new = _simple_step(h, xt, wih, whh, bih, bhh, act), c
+        if seq_len is not None:
+            valid = (t < seq_len)[:, None]
+            h_new = jnp.where(valid, h_new, h)
+            c_new = jnp.where(valid, c_new, c)
+            y = jnp.where(valid, h_new, jnp.zeros_like(h_new))
+        else:
+            y = h_new
+        return (h_new, c_new), y
+
+    (hT, cT), ys = jax.lax.scan(step, (h0, c0), (xs, ts))
+    if reverse:
+        ys = ys[::-1]
+    return jnp.swapaxes(ys, 0, 1), hT, cT
+
+
+class RNN(Layer):
+    """Wrap a cell into a sequence runner (reference rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if not isinstance(self.cell, (LSTMCell, GRUCell, SimpleRNNCell)):
+            return self._forward_custom_cell(inputs, initial_states,
+                                             sequence_length)
+        mode = ("LSTM" if isinstance(self.cell, LSTMCell)
+                else "GRU" if isinstance(self.cell, GRUCell) else "RNN")
+        x = _t(inputs)
+        if self.time_major:
+            x = Tensor(jnp.swapaxes(x._data, 0, 1))
+        B = x.shape[0]
+        H = self.cell.hidden_size
+        dt = x._data.dtype
+        if initial_states is None:
+            h0 = Tensor(jnp.zeros((B, H), dt))
+            c0 = Tensor(jnp.zeros((B, H), dt))
+        elif mode == "LSTM":
+            h0, c0 = initial_states
+        else:
+            h0, c0 = initial_states, Tensor(jnp.zeros((B, H), dt))
+        seq = None if sequence_length is None else \
+            _t(sequence_length)._data.astype(jnp.int32)
+        act = getattr(self.cell, "_act", None)
+        rev = self.is_reverse
+
+        def prim(xa, h0a, c0a, wi, wh, bi, bh):
+            return _scan_layer(mode, xa, h0a, c0a, wi, wh, bi, bh, seq,
+                               rev, act)
+
+        wi, wh, bi, bh = self.cell._weights()
+        y, hT, cT = apply_op(f"rnn_{mode.lower()}", prim,
+                             (x, _t(h0), _t(c0), wi, wh, bi, bh))
+        if self.time_major:
+            y = Tensor(jnp.swapaxes(y._data, 0, 1))
+        states = (hT, cT) if mode == "LSTM" else hT
+        return y, states
+
+    def _forward_custom_cell(self, inputs, initial_states, sequence_length):
+        """Arbitrary user cells (reference RNN contract): step the cell's
+        own forward in a Python loop.  The built-in cells take the fused
+        lax.scan path instead; custom cells trade that for generality."""
+        from ..ops.manipulation import stack
+        x = _t(inputs)
+        if self.time_major:
+            x = Tensor(jnp.swapaxes(x._data, 0, 1))
+        T = x.shape[1]
+        states = initial_states if initial_states is not None else \
+            self.cell.get_initial_states(x[:, 0])
+        seq = None if sequence_length is None else \
+            _t(sequence_length)._data.astype(jnp.int32)
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outs = [None] * T
+        for t in steps:
+            out, new_states = self.cell(Tensor(x._data[:, t]), states)
+            if seq is not None:
+                valid = (t < seq)[:, None]
+                out = Tensor(jnp.where(valid, out._data,
+                                       jnp.zeros_like(out._data)))
+                new_states = jax.tree_util.tree_map(
+                    lambda n, o: Tensor(jnp.where(
+                        valid, _t(n)._data, _t(o)._data)),
+                    new_states, states,
+                    is_leaf=lambda v: isinstance(v, Tensor))
+            outs[t] = out
+            states = new_states
+        y = stack(outs, axis=1)
+        if self.time_major:
+            y = Tensor(jnp.swapaxes(y._data, 0, 1))
+        return y, states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells over the same input (reference rnn.py BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw, self.cell_bw = cell_fw, cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        s_fw = s_bw = None
+        if initial_states is not None:
+            s_fw, s_bw = initial_states
+        y_fw, st_fw = self.rnn_fw(inputs, s_fw, sequence_length)
+        y_bw, st_bw = self.rnn_bw(inputs, s_bw, sequence_length)
+        y = Tensor(jnp.concatenate([y_fw._data, y_bw._data], axis=-1))
+        return y, (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer, optionally bidirectional stack (reference rnn.py RNNBase)."""
+
+    MODE = "RNN"
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(f"unknown direction {direction!r}")
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+
+        cells = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else \
+                hidden_size * self.num_directions
+            for _ in range(self.num_directions):
+                cells.append(self._make_cell(
+                    in_sz, hidden_size, weight_ih_attr, weight_hh_attr,
+                    bias_ih_attr, bias_hh_attr))
+        self.cells = LayerList(cells)
+
+    def _make_cell(self, in_sz, hidden, wih, whh, bih, bhh):
+        if self.MODE == "LSTM":
+            return LSTMCell(in_sz, hidden, wih, whh, bih, bhh)
+        if self.MODE == "GRU":
+            return GRUCell(in_sz, hidden, wih, whh, bih, bhh)
+        return SimpleRNNCell(in_sz, hidden, self.activation, wih, whh,
+                             bih, bhh)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = _t(inputs)
+        if self.time_major:
+            x = Tensor(jnp.swapaxes(x._data, 0, 1))
+        B = x.shape[0]
+        D, L, H = self.num_directions, self.num_layers, self.hidden_size
+        dt = x._data.dtype
+        lstm = self.MODE == "LSTM"
+
+        if initial_states is None:
+            h0 = jnp.zeros((L * D, B, H), dt)
+            c0 = jnp.zeros((L * D, B, H), dt)
+        elif lstm:
+            h0, c0 = _t(initial_states[0])._data, _t(initial_states[1])._data
+        else:
+            h0 = _t(initial_states)._data
+            c0 = jnp.zeros((L * D, B, H), dt)
+        seq = None if sequence_length is None else \
+            _t(sequence_length)._data.astype(jnp.int32)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        mode = self.MODE
+
+        h_outs, c_outs = [], []
+        for layer in range(L):
+            dir_ys = []
+            for d in range(D):
+                idx = layer * D + d
+                cell = self.cells[idx]
+                wi, wh, bi, bh = cell._weights()
+
+                def prim(xa, h0a, c0a, wi_, wh_, bi_, bh_, rev=bool(d)):
+                    return _scan_layer(mode, xa, h0a, c0a, wi_, wh_, bi_,
+                                       bh_, seq, rev, act)
+
+                y, hT, cT = apply_op(
+                    f"rnn_{mode.lower()}", prim,
+                    (x, Tensor(h0[idx]), Tensor(c0[idx]), wi, wh, bi, bh))
+                dir_ys.append(y)
+                h_outs.append(hT)
+                c_outs.append(cT)
+            x = dir_ys[0] if D == 1 else \
+                Tensor(jnp.concatenate([t._data for t in dir_ys], axis=-1))
+            if self.dropout > 0 and layer < L - 1:
+                x = F.dropout(x, p=self.dropout, training=self.training)
+
+        from ..ops.manipulation import stack
+        h_fin = stack(h_outs, axis=0)
+        if self.time_major:
+            x = Tensor(jnp.swapaxes(x._data, 0, 1))
+        if lstm:
+            return x, (h_fin, stack(c_outs, axis=0))
+        return x, h_fin
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN"
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr, name)
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr, name)

@@ -45,6 +45,17 @@ RENAMES = {
     "memory_efficient_attention":
         "nn.functional.scaled_dot_product_attention",
     "masked_multihead_attention": "incubate.nn.functional.decode_attention",
+    "lstm": "nn.LSTM (lax.scan cells)",
+    "cudnn_lstm": "nn.LSTM (lax.scan cells)",
+    "attention_lstm": "nn.LSTM + nn.MultiHeadAttention (XLA fuses)",
+    "gru": "nn.GRU",
+    "gru_unit": "nn.GRUCell",
+    "rnn": "nn.SimpleRNN/LSTM/GRU",
+    "warpctc": "nn.functional.ctc_loss (lax.scan forward DP)",
+    "warprnnt": "nn.functional.rnnt_loss",
+    "viterbi_decode": "text.viterbi_decode",
+    "crf_decoding": "text.viterbi_decode",
+    "chunk_eval": "metric.chunk_eval",
     "fused_softmax_mask": "nn.functional.fused_softmax_mask",
     "fused_softmax_mask_upper_triangle":
         "nn.functional.fused_softmax_mask_upper_triangle",
@@ -164,6 +175,8 @@ DELEGATED = {
     "prune_gate_by_capacity": "incubate MoE gate",
     "random_routing": "incubate MoE gate",
     "assign_pos": "incubate MoE dispatch (one-hot matmul formulation)",
+    "beam_search": "inference.generation decode loop (+ F.gather_tree)",
+    "beam_search_decode": "inference.generation decode loop",
     "memcpy_d2h": "Tensor.cpu() / device_put (PJRT)",
     "memcpy_h2d": "Tensor.cuda()/to device (PJRT)",
     "copy_to": "Tensor.to (PJRT)",
@@ -190,10 +203,8 @@ DELEGATED = {
 
 # CUDA/NPU-runtime or retired-subsystem specifics with no TPU analog
 NOT_APPLICABLE = {
-    "cudnn_lstm", "attention_lstm", "gru", "gru_unit", "lstm", "rnn",
-    "sequence_conv", "sequence_pool", "im2sequence", "crf_decoding",
-    "ctc_align", "warpctc", "warprnnt", "beam_search", "gather_tree",
-    "viterbi_decode", "edit_distance",
+    "sequence_conv", "sequence_pool", "im2sequence",
+    "ctc_align",
     "pyramid_hash", "tdm_child", "tdm_sampler", "rank_attention",
     "batch_fc", "shuffle_batch", "match_matrix_tensor", "cvm",
     "graph_khop_sampler", "graph_sample_neighbors", "reindex_graph",
@@ -209,13 +220,11 @@ NOT_APPLICABLE = {
     "accuracy_check", "depend", "share_data",
     "add_position_encoding",
     "fused_batch_norm_act", "fused_bn_add_activation",
-    "sync_batch_norm",
     "prior_box", "box_clip", "box_coder", "bipartite_match",
     "collect_fpn_proposals", "generate_proposals", "matrix_nms",
     "detection_map", "yolo_box", "yolo_box_head", "yolo_box_post",
     "yolo_loss", "psroi_pool", "deformable_conv", "correlation",
     "affine_channel", "shuffle_channel",
-    "class_center_sample", "margin_cross_entropy",
     "identity_loss", "hinge_loss",
     "merge_selected_rows", "is_empty",
 }

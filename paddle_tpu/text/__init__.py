@@ -14,11 +14,14 @@ from ..ops._prim import apply_op
 
 def viterbi_decode(potentials, transition_params, lengths=None,
                    include_bos_eos_tag=True, name=None):
-    """reference: python/paddle/text/viterbi_decode.py (CRF decoding).
+    """reference: python/paddle/text/viterbi_decode.py (CRF decoding;
+    kernel paddle/phi/kernels/cpu/viterbi_decode_kernel.cc).
 
     ``lengths`` masks padded timesteps: past a sequence's length the score is
     frozen and backpointers are identity, so the returned path repeats the
-    last valid tag over the padding.
+    last valid tag over the padding.  With ``include_bos_eos_tag`` the last
+    two tag ids are BOS/EOS: BOS→tag transitions are added at t=0 and
+    tag→EOS at each sequence's end (reference semantics).
     """
     import jax
 
@@ -36,6 +39,9 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         # pot: [B, T, N]; trans: [N, N]
         N = pot.shape[-1]
         identity = jnp.arange(N, dtype=jnp.int32)[None, :]
+        # BOS/EOS (last two ids) are never intermediate path states
+        tag_ok = (jnp.arange(N) < N - 2)[None, :] if include_bos_eos_tag \
+            else None
 
         def step(carry, inp):
             score = carry
@@ -43,6 +49,8 @@ def viterbi_decode(potentials, transition_params, lengths=None,
             cand = score[:, :, None] + trans[None]         # [B, prev, cur]
             best = cand.max(axis=1) + emit
             idx = cand.argmax(axis=1).astype(jnp.int32)
+            if tag_ok is not None:
+                best = jnp.where(tag_ok, best, -1e30)
             if lens_arr is not None:
                 active = (tstep < lens_arr)[:, None]
                 best = jnp.where(active, best, score)
@@ -50,10 +58,14 @@ def viterbi_decode(potentials, transition_params, lengths=None,
             return best, idx
 
         init = pot[:, 0]
+        if include_bos_eos_tag:
+            init = jnp.where(tag_ok, init + trans[N - 2][None, :], -1e30)
         ts = jnp.arange(1, T, dtype=jnp.int32)
         ts_b = jnp.broadcast_to(ts[:, None], (T - 1, pot.shape[0]))
         final, backs = jax.lax.scan(step, init,
                                     (jnp.swapaxes(pot, 0, 1)[1:], ts_b))
+        if include_bos_eos_tag:
+            final = final + trans[:, N - 1][None, :]       # tag -> EOS
         best_last = final.argmax(-1).astype(jnp.int32)
 
         def backtrack(carry, bp):

@@ -1,0 +1,218 @@
+"""Tests for the sequence/decode op family added in round 3: gather_tree,
+edit_distance, viterbi_decode (BOS/EOS), margin_cross_entropy,
+class_center_sample, rnnt_loss, number_count, masked_multihead_attention,
+chunk_eval — the ops VERDICT r2 flagged as wrongly parked in NOT_APPLICABLE.
+Oracles are brute-force numpy implementations (reference kernels cited in
+each op's docstring)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
+import paddle_tpu.metric as metric
+import paddle_tpu.nn.functional as F
+import paddle_tpu.text as text
+
+
+def test_gather_tree_backtrace():
+    # T=3, B=1, beam=2; hand-traced backpointers
+    ids = np.asarray([[[1, 2]], [[3, 4]], [[5, 6]]], np.int32)
+    parents = np.asarray([[[0, 0]], [[0, 0]], [[1, 0]]], np.int32)
+    out = F.gather_tree(paddle.to_tensor(ids), paddle.to_tensor(parents))
+    o = out.numpy()
+    # beam 0 at t=2 came from beam 1 at t=1, which came from beam 0 at t=0
+    np.testing.assert_array_equal(o[:, 0, 0], [1, 4, 5])
+    np.testing.assert_array_equal(o[:, 0, 1], [1, 3, 6])
+
+
+def _lev(a, b):
+    m, n = len(a), len(b)
+    dp = np.zeros((m + 1, n + 1))
+    dp[:, 0] = np.arange(m + 1)
+    dp[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                           dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return dp[m, n]
+
+
+def test_edit_distance_vs_bruteforce(rng):
+    B, H, R = 4, 7, 6
+    hyps = rng.integers(0, 5, (B, H)).astype(np.int32)
+    refs = rng.integers(0, 5, (B, R)).astype(np.int32)
+    hl = np.asarray([7, 5, 3, 1], np.int32)
+    rl = np.asarray([6, 6, 2, 4], np.int32)
+    dist, _ = F.edit_distance(paddle.to_tensor(hyps), paddle.to_tensor(refs),
+                              paddle.to_tensor(hl), paddle.to_tensor(rl),
+                              normalized=False)
+    d = dist.numpy()
+    for b in range(B):
+        expect = _lev(list(hyps[b, :hl[b]]), list(refs[b, :rl[b]]))
+        assert d[b] == expect, f"row {b}: {d[b]} != {expect}"
+
+
+def test_edit_distance_normalized(rng):
+    hyps = np.asarray([[1, 2, 3]], np.int32)
+    refs = np.asarray([[1, 9, 3, 4]], np.int32)
+    dist, cnt = F.edit_distance(
+        paddle.to_tensor(hyps), paddle.to_tensor(refs),
+        paddle.to_tensor(np.asarray([3], np.int32)),
+        paddle.to_tensor(np.asarray([4], np.int32)), normalized=True)
+    np.testing.assert_allclose(dist.numpy(), [2.0 / 4.0])
+
+
+def test_viterbi_decode_bruteforce(rng):
+    """Max-score path vs exhaustive enumeration, incl. BOS/EOS tags."""
+    B, T, C = 2, 4, 5                       # tags 0..2 real, 3=BOS, 4=EOS
+    pot = rng.standard_normal((B, T, C)).astype(np.float32)
+    trans = rng.standard_normal((C, C)).astype(np.float32)
+    lens = np.asarray([4, 3], np.int32)
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=True)
+    s, p = scores.numpy(), paths.numpy()
+    n_real = C - 2
+    for b in range(B):
+        best, best_path = -1e30, None
+        for cand in itertools.product(range(n_real), repeat=int(lens[b])):
+            sc = trans[C - 2, cand[0]] + pot[b, 0, cand[0]]
+            for t in range(1, len(cand)):
+                sc += trans[cand[t - 1], cand[t]] + pot[b, t, cand[t]]
+            sc += trans[cand[-1], C - 1]
+            if sc > best:
+                best, best_path = sc, cand
+        np.testing.assert_allclose(s[b], best, rtol=1e-5)
+        np.testing.assert_array_equal(p[b, :lens[b]], best_path)
+
+
+def test_margin_cross_entropy_numpy_oracle(rng):
+    B, C = 4, 10
+    cos = np.clip(rng.standard_normal((B, C)) * 0.4, -1, 1).astype(np.float32)
+    label = rng.integers(0, C, B).astype(np.int32)
+    m1, m2, m3, s = 1.0, 0.5, 0.0, 64.0
+    loss = F.margin_cross_entropy(paddle.to_tensor(cos),
+                                  paddle.to_tensor(label),
+                                  margin1=m1, margin2=m2, margin3=m3,
+                                  scale=s, reduction="none")
+    theta = np.arccos(cos)
+    mod = cos.copy()
+    for b in range(B):
+        mod[b, label[b]] = np.cos(m1 * theta[b, label[b]] + m2) - m3
+    logits = mod * s
+    lse = np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1)) + \
+        logits.max(-1)
+    expect = lse - logits[np.arange(B), label]
+    np.testing.assert_allclose(loss.numpy(), expect, rtol=1e-4, atol=1e-4)
+
+
+def test_class_center_sample_properties(rng):
+    paddle.seed(3)
+    label = rng.integers(0, 40, (16,)).astype(np.int32)
+    remapped, sampled = F.class_center_sample(paddle.to_tensor(label), 40, 12)
+    r, smp = remapped.numpy(), sampled.numpy()
+    assert smp.shape == (12,) and len(set(smp.tolist())) == 12
+    for lb, rm in zip(label, r):
+        assert smp[rm] == lb          # positives present & correctly remapped
+
+
+def _rnnt_brute(lp, lab, T, U, blank):
+    """Enumerate all monotone (t,u) paths: T blanks + U labels interleaved."""
+    from itertools import combinations
+    total = -np.inf
+    steps = T + U
+    for lab_pos in combinations(range(steps), U):
+        t = u = 0
+        s = 0.0
+        ok = True
+        for i in range(steps):
+            if i in lab_pos:
+                if u >= U or t >= T:
+                    ok = False
+                    break
+                s += lp[t, u, lab[u]]
+                u += 1
+            else:
+                if t >= T:
+                    ok = False
+                    break
+                s += lp[t, u, blank]
+                t += 1
+        if ok and t == T and u == U:
+            total = np.logaddexp(total, s)
+    return -total
+
+
+def test_rnnt_loss_vs_bruteforce(rng):
+    B, T, U, V = 2, 3, 2, 4
+    logits = rng.standard_normal((B, T, U + 1, V)).astype(np.float32)
+    labels = rng.integers(1, V, (B, U)).astype(np.int32)
+    t_lens = np.asarray([3, 2], np.int32)
+    u_lens = np.asarray([2, 1], np.int32)
+    got = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(t_lens), paddle.to_tensor(u_lens),
+                      blank=0, reduction="none").numpy()
+    lp = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                         .sum(-1, keepdims=True)) - \
+        logits.max(-1, keepdims=True)
+    for b in range(B):
+        expect = _rnnt_brute(lp[b], labels[b], int(t_lens[b]),
+                             int(u_lens[b]), 0)
+        np.testing.assert_allclose(got[b], expect, rtol=1e-4, atol=1e-4)
+
+
+def test_number_count(rng):
+    ids = rng.integers(0, 6, (3, 7)).astype(np.int32)
+    out = IF.number_count(paddle.to_tensor(ids), 6).numpy()
+    np.testing.assert_array_equal(out, np.bincount(ids.ravel(), minlength=6))
+
+
+def test_masked_multihead_attention_oracle(rng):
+    B, h, d, S = 2, 2, 4, 6
+    x = rng.standard_normal((B, 3 * h * d)).astype(np.float32)
+    cache = rng.standard_normal((2, B, h, S, d)).astype(np.float32)
+    lens = np.asarray([2, 4], np.int32)
+    out, new_cache = IF.masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache),
+        paddle.to_tensor(lens), num_head=h, head_dim=d)
+    o, nc = out.numpy(), new_cache.numpy()
+    qkv = x.reshape(B, 3, h, d)
+    for b in range(B):
+        L = lens[b] + 1
+        for hh in range(h):
+            keys = np.concatenate(
+                [cache[0, b, hh, :lens[b]], qkv[b, 1, hh][None]], 0)
+            vals = np.concatenate(
+                [cache[1, b, hh, :lens[b]], qkv[b, 2, hh][None]], 0)
+            s = keys @ qkv[b, 0, hh] / np.sqrt(d)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            expect = p @ vals
+            np.testing.assert_allclose(o[b, hh * d:(hh + 1) * d], expect,
+                                       rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(nc[0, b, :, lens[b]], qkv[b, 1],
+                                   rtol=1e-6)
+
+
+def test_chunk_eval_iob():
+    # tags for 2 types under IOB: 0=B-0 1=I-0 2=B-1 3=I-1 4=O
+    label = [[0, 1, 4, 2, 3, 4]]
+    infer = [[0, 1, 4, 2, 4, 4]]           # second chunk truncated -> wrong
+    p, r, f1, ni, nl, nc = metric.chunk_eval(infer, label, "iob", 2)
+    assert (ni, nl, nc) == (2, 2, 1)
+    assert p == 0.5 and r == 0.5 and abs(f1 - 0.5) < 1e-9
+    # perfect match
+    p, r, f1, *_ = metric.chunk_eval(label, label, "iob", 2)
+    assert f1 == 1.0
+
+
+def test_chunk_evaluator_streaming():
+    ev = metric.ChunkEvaluator("iob", 2)
+    ev.update([[0, 1, 4]], [[0, 1, 4]])
+    ev.update([[2, 3]], [[0, 1]])
+    assert 0.0 < ev.accumulate() < 1.0
+    ev.reset()
+    assert ev.accumulate() == 0.0
