@@ -37,9 +37,33 @@ class Strategy:
         def __init__(self):
             self.enable = False
 
+    class _GradientMerge:
+        """reference gradient_merge pass (distributed/passes/
+        auto_parallel_gradient_merge.py): accumulate k_steps of gradients,
+        apply the optimizer every k-th call."""
+
+        def __init__(self):
+            self.enable = False
+            self.k_steps = 1
+            self.avg = True
+
+    class _Pipeline:
+        """reference pipeline-scheduler pass hook.  Under one jitted SPMD
+        step the schedule surface is micro-batch accumulation (F-then-B
+        over micro_batches inside the step); stage-parallel schedules
+        (GPipe/1F1B/VPP over a 'pp' mesh axis) live in
+        models.pretrain.PretrainStep."""
+
+        def __init__(self):
+            self.enable = False
+            self.micro_batches = 1
+            self.schedule_mode = "FThenB"
+
     def __init__(self):
         self.amp = Strategy._Amp()
         self.recompute = Strategy._Recompute()
+        self.gradient_merge = Strategy._GradientMerge()
+        self.pipeline = Strategy._Pipeline()
 
 
 def _global_norm_clip(grads: Dict[str, Any], clip_norm: float):
@@ -51,52 +75,181 @@ def _global_norm_clip(grads: Dict[str, Any], clip_norm: float):
                                   grads)
 
 
-def _functional_update(opt, params, grads, state, t, lr):
-    """One optimizer step as a pure function, dispatching on the eager
-    optimizer's class and reusing its update kernels."""
+# ---------------------------------------------------------------------------
+# functional optimizer-update registry
+#
+# One rule per optimizer family, mirroring the eager `_update_param` math
+# exactly (same accumulator names, same wd placement, traced step count for
+# bias correction).  Out-of-tree optimizers hook in with
+# ``register_update_rule`` — no isinstance chain to extend.
+# ---------------------------------------------------------------------------
+
+UPDATE_RULES: Dict[type, Callable] = {}
+
+
+def register_update_rule(opt_cls):
+    """Register ``fn(opt, p, g, st, t, lr, wd) -> (new_p, new_st)`` as the
+    functional update for ``opt_cls`` (subclass resolution via MRO)."""
+    def deco(fn):
+        UPDATE_RULES[opt_cls] = fn
+        return fn
+    return deco
+
+
+def _rule_for(opt):
+    for klass in type(opt).__mro__:
+        if klass in UPDATE_RULES:
+            return UPDATE_RULES[klass]
+    raise NotImplementedError(
+        f"no functional update rule for {type(opt).__name__}; add one with "
+        "paddle_tpu.distributed.auto_parallel.engine.register_update_rule")
+
+
+def _register_builtin_rules():
     from ... import optimizer as O
 
-    wd = float(opt._weight_decay or 0.0)
+    @register_update_rule(O.SGD)
+    def _sgd(opt, p, g, st, t, lr, wd):
+        if wd:
+            g = g + wd * p
+        return p - lr * g, {}
+
+    @register_update_rule(O.Momentum)
+    def _momentum(opt, p, g, st, t, lr, wd):
+        v = st.get("velocity", jnp.zeros_like(p))
+        pf, v_new = O._momentum_update(p, g, v, lr, opt._momentum,
+                                       opt._use_nesterov, wd)
+        return pf, {"velocity": v_new}
+
+    @register_update_rule(O.Adam)
+    def _adam(opt, p, g, st, t, lr, wd):
+        if wd:
+            g = g + wd * p                 # plain Adam: L2 into the grad
+        m = st.get("moment1", jnp.zeros_like(p, jnp.float32))
+        v = st.get("moment2", jnp.zeros_like(p, jnp.float32))
+        pf, m, v = O._adam_update(p.astype(jnp.float32),
+                                  g.astype(jnp.float32), m, v, lr,
+                                  opt._beta1, opt._beta2, opt._epsilon,
+                                  t, None)
+        return pf.astype(p.dtype), {"moment1": m, "moment2": v}
+
+    @register_update_rule(O.AdamW)
+    def _adamw(opt, p, g, st, t, lr, wd):
+        m = st.get("moment1", jnp.zeros_like(p, jnp.float32))
+        v = st.get("moment2", jnp.zeros_like(p, jnp.float32))
+        pf, m, v = O._adam_update(p.astype(jnp.float32),
+                                  g.astype(jnp.float32), m, v, lr,
+                                  opt._beta1, opt._beta2, opt._epsilon,
+                                  t, wd)                  # decoupled decay
+        return pf.astype(p.dtype), {"moment1": m, "moment2": v}
+
+    @register_update_rule(O.Adamax)
+    def _adamax(opt, p, g, st, t, lr, wd):
+        if wd:
+            g = g + wd * p
+        m = st.get("moment", jnp.zeros_like(p))
+        u = st.get("inf_norm", jnp.zeros_like(p))
+        m_new = opt._beta1 * m + (1 - opt._beta1) * g
+        u_new = jnp.maximum(opt._beta2 * u, jnp.abs(g))
+        pf = p - (lr / (1 - opt._beta1 ** t)) * m_new / (u_new + opt._epsilon)
+        return pf, {"moment": m_new, "inf_norm": u_new}
+
+    @register_update_rule(O.RMSProp)
+    def _rmsprop(opt, p, g, st, t, lr, wd):
+        if wd:
+            g = g + wd * p
+        ms = st.get("mean_square", jnp.zeros_like(p))
+        ms_new = opt._rho * ms + (1 - opt._rho) * jnp.square(g)
+        new_st = {"mean_square": ms_new}
+        if opt._centered:
+            mg = st.get("mean_grad", jnp.zeros_like(p))
+            mg_new = opt._rho * mg + (1 - opt._rho) * g
+            denom = jnp.sqrt(ms_new - jnp.square(mg_new) + opt._epsilon)
+            new_st["mean_grad"] = mg_new
+        else:
+            denom = jnp.sqrt(ms_new + opt._epsilon)
+        vel = st.get("velocity", jnp.zeros_like(p))
+        vel_new = opt._momentum * vel + lr * g / denom
+        new_st["velocity"] = vel_new
+        return p - vel_new, new_st
+
+    @register_update_rule(O.Adagrad)
+    def _adagrad(opt, p, g, st, t, lr, wd):
+        if wd:
+            g = g + wd * p
+        acc = st.get("moment",
+                     jnp.full(p.shape, opt._init_acc, p.dtype))
+        acc_new = acc + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc_new) + opt._epsilon), \
+            {"moment": acc_new}
+
+    @register_update_rule(O.Adadelta)
+    def _adadelta(opt, p, g, st, t, lr, wd):
+        if wd:
+            g = g + wd * p
+        sg = st.get("avg_squared_grad", jnp.zeros_like(p))
+        su = st.get("avg_squared_update", jnp.zeros_like(p))
+        sg_new = opt._rho * sg + (1 - opt._rho) * jnp.square(g)
+        update = jnp.sqrt(su + opt._epsilon) / \
+            jnp.sqrt(sg_new + opt._epsilon) * g
+        su_new = opt._rho * su + (1 - opt._rho) * jnp.square(update)
+        return p - lr * update, {"avg_squared_grad": sg_new,
+                                 "avg_squared_update": su_new}
+
+    @register_update_rule(O.Lamb)
+    def _lamb(opt, p, g, st, t, lr, wd, name=None):
+        m = st.get("moment1", jnp.zeros_like(p))
+        v = st.get("moment2", jnp.zeros_like(p))
+        m_new = opt._beta1 * m + (1 - opt._beta1) * g
+        v_new = opt._beta2 * v + (1 - opt._beta2) * jnp.square(g)
+        mhat = m_new / (1 - opt._beta1 ** t)
+        vhat = v_new / (1 - opt._beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + opt._epsilon)
+        # exclusion mirrors the eager rule; in the functional context the
+        # predicate sees the parameter's qualified name
+        if wd and (opt._exclude_fn is None or not opt._exclude_fn(name)):
+            r = r + wd * p
+        w_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m_new, "moment2": v_new}
+
+    @register_update_rule(O.Lars)
+    def _lars(opt, p, g, st, t, lr, wd):
+        w_norm = jnp.linalg.norm(p)
+        g_norm = jnp.linalg.norm(g)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            opt._lars_coeff * w_norm /
+            (g_norm + wd * w_norm + opt._lars_eps), 1.0)
+        v = st.get("velocity", jnp.zeros_like(p))
+        v_new = opt._momentum * v + lr * local_lr * (g + wd * p)
+        return p - v_new, {"velocity": v_new}
+
+
+_register_builtin_rules()
+
+
+def _functional_update(opt, params, grads, state, t, lr, name_map=None):
+    """One optimizer step as a pure function via the rule registry.
+    ``name_map`` translates the qualified param keys to the eager
+    ``Parameter.name`` values so user predicates (apply_decay_param_fun,
+    Lamb's exclude fn) see the same names as in eager training."""
+    import inspect
+
+    rule = _rule_for(opt)
+    takes_name = "name" in inspect.signature(rule).parameters
+    decay_fn = getattr(opt, "_apply_decay_param_fun", None)
+    wd_base = float(opt._weight_decay or 0.0)
     new_params, new_state = {}, {}
     for name, p in params.items():
+        eager_name = name_map.get(name, name) if name_map else name
         g = grads[name].astype(p.dtype)
-        st = state.get(name, {})
-        if isinstance(opt, O.AdamW):
-            m = st.get("moment1", jnp.zeros_like(p, jnp.float32))
-            v = st.get("moment2", jnp.zeros_like(p, jnp.float32))
-            pf, m, v = O._adam_update(p.astype(jnp.float32),
-                                      g.astype(jnp.float32), m, v, lr,
-                                      opt._beta1, opt._beta2, opt._epsilon,
-                                      t, wd)
-            new_params[name] = pf.astype(p.dtype)
-            new_state[name] = {"moment1": m, "moment2": v}
-        elif isinstance(opt, O.Adam):
-            if wd:
-                g = g + wd * p
-            m = st.get("moment1", jnp.zeros_like(p, jnp.float32))
-            v = st.get("moment2", jnp.zeros_like(p, jnp.float32))
-            pf, m, v = O._adam_update(p.astype(jnp.float32),
-                                      g.astype(jnp.float32), m, v, lr,
-                                      opt._beta1, opt._beta2, opt._epsilon,
-                                      t, None)
-            new_params[name] = pf.astype(p.dtype)
-            new_state[name] = {"moment1": m, "moment2": v}
-        elif isinstance(opt, O.Momentum):
-            v = st.get("velocity", jnp.zeros_like(p))
-            pf, v = O._momentum_update(p, g, v, lr, opt._momentum,
-                                       opt._use_nesterov, wd)
-            new_params[name] = pf
-            new_state[name] = {"velocity": v}
-        elif isinstance(opt, O.SGD):
-            if wd:
-                g = g + wd * p
-            new_params[name] = p - lr * g
-            new_state[name] = {}
-        else:
-            raise NotImplementedError(
-                f"to_static supports SGD/Momentum/Adam/AdamW; got "
-                f"{type(opt).__name__} — run it eagerly or add a functional "
-                f"rule in engine._functional_update")
+        wd = 0.0 if (decay_fn is not None and not decay_fn(eager_name)) \
+            else wd_base
+        kw = {"name": eager_name} if takes_name else {}
+        new_params[name], new_state[name] = rule(
+            opt, p, g, state.get(name, {}), t, lr, wd, **kw)
     return new_params, new_state
 
 
@@ -117,10 +270,19 @@ class DistModel:
         self._loss = loss
         self._opt = optimizer
         self._strategy = strategy or Strategy()
-        self._params = extract_params(layer)     # arrays keep NamedShardings
+        # copy the arrays: the jitted step donates its param buffers, and
+        # donating the layer's own arrays would invalidate the eager model
+        self._params = {k: jnp.array(v) for k, v in
+                        extract_params(layer).items()}  # keep NamedShardings
         self._buffers = extract_buffers(layer)
+        # qualified key -> eager Parameter.name (user decay predicates see
+        # the same names static as eager)
+        self._param_names = {k: getattr(p, "name", None) or k
+                             for k, p in layer.named_parameters()}
         self._opt_state: Dict[str, Dict[str, Any]] = {}
         self._step = jnp.zeros((), jnp.int32)
+        self._gacc = None                    # gradient-merge accumulator
+        self._merge_calls = 0
         if optimizer is not None and loss is not None:
             self._mode = "train"
         elif loss is not None:
@@ -167,22 +329,76 @@ class DistModel:
             return jax.checkpoint(with_amp)(params, args)
         return with_amp(params, args)
 
-    def _train_fn(self):
-        def step(params, opt_state, t, lr, xs, label):
-            def fl(p_):
-                out = self._forward(p_, xs)
-                return _as_array(self._loss(_as_tensor(out), Tensor(label)))
+    def _loss_and_grads(self, params, xs, label):
+        """Loss + grads, honoring the pipeline (micro-batch F-then-B) pass."""
+        def fl(p_, xs_, lbl_):
+            out = self._forward(p_, xs_)
+            return _as_array(self._loss(_as_tensor(out), Tensor(lbl_)))
 
-            loss, grads = jax.value_and_grad(fl)(params)
-            clip = getattr(self._opt, "_grad_clip", None)
-            if clip is not None:
-                clip_norm = getattr(clip, "clip_norm", None)
-                if clip_norm is not None:
-                    grads = _global_norm_clip(grads, float(clip_norm))
-            new_params, new_state = _functional_update(
-                self._opt, params, grads, opt_state,
-                t.astype(jnp.float32) + 1.0, lr)
-            return loss, new_params, new_state
+        pl = self._strategy.pipeline
+        M = pl.micro_batches if pl.enable else 1
+        if M <= 1:
+            return jax.value_and_grad(fl)(params, xs, label)
+
+        B = label.shape[0]
+        if B % M:
+            raise ValueError(f"micro_batches ({M}) must divide batch ({B})")
+        xs_m = tuple(x.reshape((M, B // M) + x.shape[1:]) for x in xs)
+        lbl_m = label.reshape((M, B // M) + label.shape[1:])
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+        def micro(carry, xs_lbl):
+            loss_sum, g_sum = carry
+            xs_, lbl_ = xs_lbl
+            l, g = jax.value_and_grad(fl)(params, xs_, lbl_)
+            g_sum = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), g_sum, g)
+            return (loss_sum + l, g_sum), None
+
+        (loss_sum, g_sum), _ = jax.lax.scan(
+            micro, (jnp.float32(0.0), zeros), (xs_m, lbl_m))
+        grads = jax.tree_util.tree_map(lambda g: g / M, g_sum)
+        return loss_sum / M, grads
+
+    def _apply_grads(self, params, opt_state, grads, t, lr):
+        clip = getattr(self._opt, "_grad_clip", None)
+        if clip is not None:
+            clip_norm = getattr(clip, "clip_norm", None)
+            if clip_norm is not None:
+                grads = _global_norm_clip(grads, float(clip_norm))
+        return _functional_update(
+            self._opt, params, grads, opt_state,
+            t.astype(jnp.float32) + 1.0, lr, name_map=self._param_names)
+
+    def _train_fn(self, apply_update: bool):
+        """One jitted train call.  With gradient_merge, non-apply calls only
+        accumulate grads (reference gradient-merge pass); the k-th call
+        merges, clips and steps the optimizer.  Without gradient_merge the
+        step carries no accumulator at all."""
+        gm = self._strategy.gradient_merge
+        k = gm.k_steps if gm.enable else 1
+
+        if not gm.enable:
+            def step(params, opt_state, t, lr, xs, label):
+                loss, grads = self._loss_and_grads(params, xs, label)
+                new_params, new_state = self._apply_grads(
+                    params, opt_state, grads, t, lr)
+                return loss, new_params, new_state
+            return step
+
+        def step(params, opt_state, gacc, t, lr, xs, label):
+            loss, grads = self._loss_and_grads(params, xs, label)
+            gacc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), gacc, grads)
+            if not apply_update:
+                return loss, params, opt_state, gacc
+            merged = jax.tree_util.tree_map(
+                lambda g: g / k if gm.avg else g, gacc)
+            new_params, new_state = self._apply_grads(
+                params, opt_state, merged, t, lr)
+            gacc = jax.tree_util.tree_map(jnp.zeros_like, gacc)
+            return loss, new_params, new_state, gacc
         return step
 
     def _eval_fn(self):
@@ -201,16 +417,34 @@ class DistModel:
         args = tuple(a._data if isinstance(a, Tensor) else jnp.asarray(a)
                      for a in args)
         if self._mode == "train":
-            fn = self._jitted.get("train")
-            if fn is None:
-                fn = self._jitted["train"] = jax.jit(
-                    self._train_fn(), donate_argnums=(0, 1))
+            gm = self._strategy.gradient_merge
             *xs, label = args
             lr = jnp.float32(self._opt.get_lr())
-            loss, self._params, self._opt_state = fn(
-                self._params, self._opt_state, self._step, lr,
+            if not gm.enable:
+                fn = self._jitted.get("train")
+                if fn is None:
+                    fn = self._jitted["train"] = jax.jit(
+                        self._train_fn(True), donate_argnums=(0, 1))
+                loss, self._params, self._opt_state = fn(
+                    self._params, self._opt_state, self._step, lr,
+                    tuple(xs), label)
+                self._step = self._step + 1
+                return Tensor(loss)
+            apply_update = (self._merge_calls + 1) % gm.k_steps == 0
+            key = ("train", apply_update)
+            fn = self._jitted.get(key)
+            if fn is None:
+                fn = self._jitted[key] = jax.jit(
+                    self._train_fn(apply_update), donate_argnums=(0, 1, 2))
+            if self._gacc is None:
+                self._gacc = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), self._params)
+            loss, self._params, self._opt_state, self._gacc = fn(
+                self._params, self._opt_state, self._gacc, self._step, lr,
                 tuple(xs), label)
-            self._step = self._step + 1
+            self._merge_calls += 1
+            if apply_update:
+                self._step = self._step + 1   # one optimizer step per merge
             return Tensor(loss)
         if self._mode == "eval":
             fn = self._jitted.get("eval")

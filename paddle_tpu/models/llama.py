@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +48,14 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
+    # MoE (Mixtral-style: every layer's MLP becomes a top-k expert mixture;
+    # 0 experts = dense).  Reference surface: incubate MoELayer
+    # (python/paddle/incubate/distributed/models/moe/moe_layer.py:263) and
+    # BASELINE.md config 5.
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 0.01
     # parallel knobs (consumed by llama_shard_plan / trainer)
     tensor_parallel: bool = False
     sequence_parallel: bool = False
@@ -80,13 +88,44 @@ class LlamaConfig:
         return LlamaConfig(**{**dict(hidden_size=5120, intermediate_size=13824,
                                      num_hidden_layers=40, num_attention_heads=40), **kw})
 
-    def num_params(self) -> int:
-        h, i, v, L = (self.hidden_size, self.intermediate_size,
-                      self.vocab_size, self.num_hidden_layers)
+    @staticmethod
+    def mixtral_tiny(**kw) -> "LlamaConfig":
+        """Mixtral-shaped MoE test config (BASELINE.md config 5 family)."""
+        base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, max_position_embeddings=128,
+                    moe_num_experts=4, moe_top_k=2, dtype="float32")
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    def _per_layer_params(self) -> Tuple[int, int]:
+        """(dense per-layer params, expert-bank per-layer params)."""
+        h, i = self.hidden_size, self.intermediate_size
         kvh = self.num_key_value_heads * self.head_dim
-        per_layer = h * h + 2 * h * kvh + h * h + 3 * h * i + 2 * h
-        emb = v * h * (1 if self.tie_word_embeddings else 2)
-        return L * per_layer + emb + h
+        attn = h * h + 2 * h * kvh + h * h + 2 * h
+        if self.moe_num_experts:
+            gate = h * self.moe_num_experts
+            return attn + gate, self.moe_num_experts * 3 * h * i
+        return attn + 3 * h * i, 0
+
+    def num_params(self) -> int:
+        dense, experts = self._per_layer_params()
+        emb = self.vocab_size * self.hidden_size * \
+            (1 if self.tie_word_embeddings else 2)
+        return self.num_hidden_layers * (dense + experts) + emb + \
+            self.hidden_size
+
+    def num_active_params(self) -> int:
+        """Params touched per token (MoE: only top_k of E experts) — the
+        N in the 6*N*T MFU formula for sparse models (BASELINE.md)."""
+        if not self.moe_num_experts:
+            return self.num_params()
+        dense, experts = self._per_layer_params()
+        active = experts * self.moe_top_k // self.moe_num_experts
+        emb = self.vocab_size * self.hidden_size * \
+            (1 if self.tie_word_embeddings else 2)
+        return self.num_hidden_layers * (dense + active) + emb + \
+            self.hidden_size
 
 
 def _rope_cos_sin(seq_len: int, head_dim: int, theta: float, dtype):
@@ -159,13 +198,8 @@ class LlamaAttention(Layer):
                     apply_rotary_pos_emb(ka, cos, sin))
 
         q, k = apply_op("fused_rope", rope_prim, (q, k))
-        if c.num_key_value_heads != c.num_attention_heads:
-            rep = c.num_attention_heads // c.num_key_value_heads
-
-            def repeat_prim(ka, va):
-                return (jnp.repeat(ka, rep, axis=2), jnp.repeat(va, rep, axis=2))
-
-            k, v = apply_op("repeat_kv", repeat_prim, (k, v))
+        # GQA is native in the kernel: grouped K/V go in un-repeated, so
+        # K/V residuals and backward bandwidth stay heads/kv_heads smaller
         out = flash_attention(q, k, v, causal=True)
         out = out.reshape([b, s, c.num_attention_heads * c.head_dim])
         return self.o_proj(out)
@@ -186,6 +220,101 @@ class LlamaMLP(Layer):
         up = self.up_proj(x)
         act = apply_op("swiglu", lambda g, u: swiglu(g, u), (gate, up))
         return self.down_proj(act)
+
+
+def moe_mlp_forward(x, gate_w, w_gate, w_up, w_down, *, top_k,
+                    capacity_factor, eval_capacity=False):
+    """Capacity-bounded top-k expert mixture over a SwiGLU FFN — the
+    compiled-step MoE math (reference mechanism surface: MoELayer +
+    global_scatter/gather capacity alltoall, moe_layer.py:263 /
+    moe_utils.py:20,:153; gating per GShard/Mixtral).
+
+    TPU-first formulation: scatter-add dispatch into a static
+    ``[E, capacity, H]`` buffer and gather-combine — static shapes, no
+    [N, E, C] one-hot dispatch tensor (O(N*E*C) memory), no host control
+    flow.  Under GSPMD with the expert dim sharded over the 'ep' mesh axis
+    XLA lowers the scatter/gather into the EP collectives.
+
+    x: [B, S, H]; gate_w: [H, E]; w_gate/w_up: [E, H, I]; w_down: [E, I, H].
+    Returns (y [B, S, H], aux_loss scalar fp32).
+    """
+    B, S, H = x.shape
+    E = gate_w.shape[-1]
+    N = B * S
+    k = top_k
+    xf = x.reshape(N, H)
+
+    logits = (xf.astype(jnp.float32) @ gate_w.astype(jnp.float32))  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)                  # [N, k]
+    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+
+    # GShard load-balancing aux: E * sum_e mean_prob_e * frac_tokens_e
+    me = probs.mean(axis=0)
+    ce = jnp.zeros((E,), jnp.float32).at[topi[:, 0]].add(1.0) / N
+    aux = E * jnp.sum(me * ce)
+
+    cap = max(1, int(N * k * capacity_factor / E))
+    # k-major priority: every token's first choice beats any second choice
+    idx_flat = topi.T.reshape(k * N)                      # [kN]
+    gate_flat = topv.T.reshape(k * N).astype(jnp.float32)
+    oh = jax.nn.one_hot(idx_flat, E, dtype=jnp.float32)
+    pos = jnp.sum(jnp.cumsum(oh, axis=0) * oh - oh, axis=-1)  # 0-based slot
+    pos = pos.astype(jnp.int32)
+    keep = pos < cap
+    slot = jnp.where(keep, idx_flat * cap + pos, E * cap)  # OOB -> dropped
+
+    x_rep = jnp.tile(xf, (k, 1))                          # [kN, H]
+    buf = jnp.zeros((E * cap, H), x.dtype)
+    buf = buf.at[slot].add(x_rep, mode="drop")
+    expert_in = buf.reshape(E, cap, H)
+
+    h1 = jax.nn.silu(jnp.einsum("ech,ehi->eci", expert_in, w_gate)) * \
+        jnp.einsum("ech,ehi->eci", expert_in, w_up)
+    out_e = jnp.einsum("eci,eih->ech", h1, w_down).reshape(E * cap, H)
+
+    gathered = jnp.take(out_e, jnp.minimum(slot, E * cap - 1), axis=0)
+    yf = gathered * (gate_flat * keep.astype(jnp.float32))[:, None] \
+        .astype(x.dtype)
+    y = yf.reshape(k, N, H).sum(axis=0).reshape(B, S, H)
+    return y, aux
+
+
+class LlamaMoEMLP(Layer):
+    """Mixtral-style MoE FFN block (drop-in for LlamaMLP when
+    config.moe_num_experts > 0).  Expert banks are single stacked
+    parameters [E, H, I] so the 'ep' mesh axis shards them directly."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        c = config
+        self.config = c
+        E, H, I = c.moe_num_experts, c.hidden_size, c.intermediate_size
+        init_h = _scaled_init(H)
+        init_i = _scaled_init(I)
+        self.gate = _ParamLinear(H, E, c.dtype, init_h)
+        self.experts_gate = self.create_parameter(
+            [E, H, I], default_initializer=init_h)
+        self.experts_up = self.create_parameter(
+            [E, H, I], default_initializer=init_h)
+        self.experts_down = self.create_parameter(
+            [E, I, H], default_initializer=init_i)
+        self._last_aux = None
+
+    def forward(self, x):
+        c = self.config
+
+        def prim(xa, gw, wg, wu, wd):
+            y, aux = moe_mlp_forward(
+                xa, gw, wg, wu, wd, top_k=c.moe_top_k,
+                capacity_factor=c.moe_capacity_factor)
+            return y, aux
+
+        y, aux = apply_op("moe_mlp", prim,
+                          (x, self.gate.weight, self.experts_gate,
+                           self.experts_up, self.experts_down))
+        self._last_aux = aux
+        return y
 
 
 class _ParamLinear(Layer):
@@ -213,7 +342,8 @@ class LlamaDecoderLayer(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
         self.self_attn = LlamaAttention(config)
-        self.mlp = LlamaMLP(config)
+        self.mlp = LlamaMoEMLP(config) if config.moe_num_experts \
+            else LlamaMLP(config)
         self.input_layernorm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps,
                                             config.dtype)
         self.post_attention_layernorm = LlamaRMSNorm(config.hidden_size,

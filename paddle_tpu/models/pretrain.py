@@ -43,6 +43,7 @@ class ParallelConfig:
     dp: int = 1
     pp: int = 1
     mp: int = 1
+    ep: int = 1                  # expert parallel (MoE expert-bank sharding)
     micro_batches: int = 1
     schedule: str = "gpipe"      # pipeline schedule: gpipe | interleave | 1f1b
     virtual_pp: int = 1          # VPP chunks per stage (schedule="interleave")
@@ -61,21 +62,32 @@ class ParallelConfig:
 
     @property
     def n_devices(self):
-        return self.dp * self.pp * self.mp
+        return self.dp * self.pp * self.ep * self.mp
 
 
 def build_mesh(pc: ParallelConfig, devices=None) -> Mesh:
+    """Hybrid mesh ('dp', 'pp', 'ep', 'mp') — the reference's 5-axis
+    topology (fleet/base/topology.py) as named mesh axes; 'ep' innermost
+    of the coarse axes so expert all-to-all rides the fastest ICI hops."""
     devices = np.asarray(devices if devices is not None else jax.devices())
     n = pc.n_devices
     if devices.size < n:
         raise ValueError(f"need {n} devices, have {devices.size}")
-    return Mesh(devices.ravel()[:n].reshape(pc.dp, pc.pp, pc.mp),
-                ("dp", "pp", "mp"))
+    return Mesh(devices.ravel()[:n].reshape(pc.dp, pc.pp, pc.ep, pc.mp),
+                ("dp", "pp", "ep", "mp"))
 
 
 def _block_spec(name: str) -> Tuple[Optional[str], ...]:
-    """Megatron TP PartitionSpec entries for one decoder-layer param (without
-    the stacking dims) — mirrors llama_shard_plan."""
+    """Megatron TP + expert-parallel PartitionSpec entries for one
+    decoder-layer param (without the stacking dims) — mirrors
+    llama_shard_plan; MoE expert banks shard experts over 'ep' and the
+    FFN width over 'mp' (sub-mesh experts, reference api.py:447)."""
+    if name.endswith(("mlp.experts_gate", "mlp.experts_up")):
+        return ("ep", None, "mp")
+    if name.endswith("mlp.experts_down"):
+        return ("ep", "mp", None)
+    if name.endswith("mlp.gate.weight"):
+        return (None, None)      # router: replicated
     if name.endswith(("q_proj.weight", "k_proj.weight", "v_proj.weight",
                       "gate_proj.weight", "up_proj.weight")):
         return (None, "mp")      # column parallel
@@ -101,6 +113,23 @@ class PretrainStep:
         if self.pc.schedule == "1f1b" and self.pc.virtual_pp > 1:
             raise ValueError("interleaved 1F1B is not implemented; use "
                              "schedule='interleave' or virtual_pp=1")
+        self._moe = bool(config.moe_num_experts)
+        if self._moe and self.pc.pp > 1:
+            raise NotImplementedError(
+                "MoE + pipeline parallel is not wired yet; use the "
+                "dp x ep x mp mesh (pp=1) for MoE configs")
+        if self._moe and self.pc.micro_batches > 1:
+            raise NotImplementedError(
+                "MoE ignores micro_batches (the MoE path runs a plain "
+                "layer scan); set micro_batches=1")
+        if self.pc.ep > 1:
+            if not self._moe:
+                raise ValueError("ep > 1 requires a MoE config "
+                                 "(moe_num_experts > 0)")
+            if config.moe_num_experts % self.pc.ep:
+                raise ValueError(
+                    f"ep ({self.pc.ep}) must divide moe_num_experts "
+                    f"({config.moe_num_experts})")
         self._virtual = self.pc.virtual_pp if self.pc.schedule == "interleave" \
             else 1
         groups = self.pc.pp * self._virtual
@@ -195,16 +224,17 @@ class PretrainStep:
     def _forward_loss(self, params, ids, labels):
         C = self.pc.loss_chunks
         if C <= 1:
-            logits = self._logits(params, ids)
+            h, aux = self._hidden(params, ids)
+            logits = (h @ params["head"]).astype(jnp.float32)
             logits = jax.lax.with_sharding_constraint(
                 logits, NamedSharding(self.mesh, P("dp", None, "mp")))
             lse = jax.scipy.special.logsumexp(logits, axis=-1)
             gold = jnp.take_along_axis(logits, labels[..., None],
                                        axis=-1)[..., 0]
-            return (lse - gold).mean()
+            return (lse - gold).mean() + aux
         # chunked CE: head matmul + logsumexp per token chunk under remat, so
         # peak memory holds one [N/C, V] fp32 block instead of [B, T, V]
-        h = self._hidden(params, ids)
+        h, aux = self._hidden(params, ids)
         H = h.shape[-1]
         hf = h.reshape(-1, H)
         lf = labels.reshape(-1)
@@ -224,14 +254,14 @@ class PretrainStep:
             return (lse - gold).sum()
 
         total = jax.lax.map(chunk_loss, (hc, lc)).sum()
-        return total / N
+        return total / N + aux
 
     def _logits(self, params, ids):
-        c = self.config
-        h = self._hidden(params, ids)
+        h, _ = self._hidden(params, ids)
         return (h @ params["head"]).astype(jnp.float32)   # [B, T, V]
 
     def _hidden(self, params, ids):
+        """Returns (final-norm hidden states, weighted MoE aux loss)."""
         c, pc = self.config, self.pc
         mesh = self.mesh
         B, T = ids.shape
@@ -253,6 +283,33 @@ class PretrainStep:
                     y, NamedSharding(mesh, P("dp", "mp", None)))
             return y
 
+        from ..kernels.rms_norm import rms_norm_fp32
+
+        if self._moe:
+            # dp x ep x mp: plain scan over layers (pp=1 enforced in init),
+            # accumulating each block's load-balancing aux loss.  The aux
+            # tracer is read off the template's MoE submodule right after
+            # the functional call — same trace, so it composes with scan.
+            def block_aux(lp, x):
+                y = block(lp, x)
+                aux = template.mlp._last_aux
+                return y, aux._data if isinstance(aux, Tensor) else aux
+
+            if pc.remat:
+                block_aux = jax.checkpoint(block_aux)
+
+            blocks = {k: v.reshape((c.num_hidden_layers,) + v.shape[2:])
+                      for k, v in params["blocks"].items()}
+
+            def body(carry, lp):
+                x, aux = carry
+                y, a = block_aux(lp, x)
+                return (y, aux + a), None
+
+            (h, aux), _ = jax.lax.scan(body, (h, jnp.float32(0.0)), blocks)
+            h = rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
+            return h, c.moe_aux_loss_weight * aux
+
         if pc.remat:
             block = jax.checkpoint(block)
 
@@ -272,8 +329,8 @@ class PretrainStep:
         h = out.reshape(B, T, c.hidden_size)
 
         # final rms norm (fp32 accumulation); head applied by caller
-        from ..kernels.rms_norm import rms_norm_fp32
-        return rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
+        return rms_norm_fp32(h, params["norm"], c.rms_norm_eps), \
+            jnp.float32(0.0)
 
     # ---- 1F1B: manual grad plumbing (loss computed per-microbatch at the
     # last stage; embed grads recovered from the pipeline's input cotangent) --
@@ -397,9 +454,11 @@ class PretrainStep:
 
     # ---- accounting (BASELINE.md MFU formula) ----
     def flops_per_token(self, include_remat: bool = False) -> float:
-        """6*N per token; with include_remat, adds the 2*N recompute forward.
-        BASELINE.md requires MFU reported both ways — callers pick."""
-        n = self.config.num_params()
+        """6*N per token (N = ACTIVE params — for MoE only the top_k
+        experts a token routes through count, BASELINE.md config 5); with
+        include_remat, adds the 2*N recompute forward.  BASELINE.md
+        requires MFU reported both ways — callers pick."""
+        n = self.config.num_active_params()
         f = 6.0 * n
         if include_remat and self.pc.remat:
             f += 2.0 * n

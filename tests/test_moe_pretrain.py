@@ -1,0 +1,124 @@
+"""MoE in the flagship compiled step (VERDICT r2 item 2): expert-parallel
+mesh axis, capacity-bounded dispatch numerics, and end-to-end training on
+dp x ep x mp.  Reference mechanism: incubate MoELayer + capacity alltoall
+(moe_layer.py:263, moe_utils.py:20/:153); BASELINE.md config 5."""
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                     LlamaMoEMLP, moe_mlp_forward)
+from paddle_tpu.models.pretrain import ParallelConfig, PretrainStep, build_mesh
+
+
+def _moe_oracle(x, gate_w, wg, wu, wd, top_k):
+    """Per-token dense reference: route each token through its top-k
+    experts with renormalized gates (no capacity)."""
+    import jax.nn as jnn
+    import jax.numpy as jnp
+    B, S, H = x.shape
+    xf = np.asarray(x).reshape(-1, H)
+    logits = xf @ np.asarray(gate_w)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    out = np.zeros_like(xf)
+    for n in range(xf.shape[0]):
+        top = np.argsort(-probs[n])[:top_k]
+        w = probs[n, top] / probs[n, top].sum()
+        for e, wt in zip(top, w):
+            h1 = xf[n] @ np.asarray(wg)[e]
+            h2 = xf[n] @ np.asarray(wu)[e]
+            act = h1 / (1 + np.exp(-h1)) * h2
+            out[n] += wt * (act @ np.asarray(wd)[e])
+    return out.reshape(B, S, H)
+
+
+def test_moe_mlp_matches_dense_oracle(rng):
+    import jax.numpy as jnp
+    B, S, H, I, E, k = 2, 8, 16, 32, 4, 2
+    x = jnp.asarray(rng.standard_normal((B, S, H)), jnp.float32)
+    gate_w = jnp.asarray(rng.standard_normal((H, E)) * 0.5, jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((E, H, I)) * 0.2, jnp.float32)
+    wu = jnp.asarray(rng.standard_normal((E, H, I)) * 0.2, jnp.float32)
+    wd = jnp.asarray(rng.standard_normal((E, I, H)) * 0.2, jnp.float32)
+
+    # capacity large enough that nothing drops -> exact parity
+    y, aux = moe_mlp_forward(x, gate_w, wg, wu, wd, top_k=k,
+                             capacity_factor=float(E))
+    expect = _moe_oracle(x, gate_w, wg, wu, wd, k)
+    np.testing.assert_allclose(np.asarray(y), expect, rtol=2e-4, atol=2e-4)
+    assert float(aux) > 0.9      # E * sum(f*p) ~ 1 for near-uniform routing
+
+
+def test_moe_capacity_drops_tokens(rng):
+    """With capacity 1 slot per expert, overflow tokens contribute zero."""
+    import jax.numpy as jnp
+    B, S, H, I, E = 1, 8, 8, 16, 2
+    x = jnp.asarray(rng.standard_normal((B, S, H)), jnp.float32)
+    gate_w = jnp.zeros((H, E), jnp.float32)   # uniform router
+    wg = jnp.asarray(rng.standard_normal((E, H, I)) * 0.2, jnp.float32)
+    wu = jnp.asarray(rng.standard_normal((E, H, I)) * 0.2, jnp.float32)
+    wd = jnp.asarray(rng.standard_normal((E, I, H)) * 0.2, jnp.float32)
+    # N*k*cf/E = 8*1*0.25/2 = 1 slot per expert
+    y, _ = moe_mlp_forward(x, gate_w, wg, wu, wd, top_k=1,
+                           capacity_factor=0.25)
+    nonzero_rows = np.abs(np.asarray(y).reshape(-1, H)).sum(-1) > 1e-6
+    assert nonzero_rows.sum() <= 2   # at most one token per expert survives
+
+
+def test_moe_eager_model_forward():
+    paddle.seed(0)
+    cfg = LlamaConfig.mixtral_tiny()
+    model = LlamaForCausalLM(cfg)
+    assert isinstance(model.llama.layers[0].mlp, LlamaMoEMLP)
+    ids = paddle.to_tensor(np.arange(32, dtype=np.int32).reshape(1, 32) % 250)
+    logits, loss = model(ids, labels=ids)
+    assert np.isfinite(float(loss.numpy()))
+
+
+@pytest.mark.parametrize("zero1", [False, True])
+def test_moe_pretrain_step_dp_ep_mp(rng, zero1):
+    """One compiled step on the dp2 x ep2 x mp2 mesh: finite decreasing
+    loss, expert banks actually sharded over 'ep'."""
+    cfg = LlamaConfig.mixtral_tiny()
+    pc = ParallelConfig(dp=2, ep=2, mp=2, zero1=zero1)
+    ps = PretrainStep(cfg, pc)
+    state = ps.init_state(seed=0)
+
+    spec = state["params"]["blocks"]["mlp.experts_gate"].sharding.spec
+    assert "ep" in [s for s in spec if s is not None], \
+        f"expert bank not ep-sharded: {spec}"
+
+    ids, labels = ps.shard_batch(
+        rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int32),
+        rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int32))
+    losses = []
+    for _ in range(4):
+        state, loss = ps.train_step(state, ids, labels)
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_moe_requires_ep_compatible_config():
+    cfg = LlamaConfig.tiny()                       # dense
+    with pytest.raises(ValueError):
+        PretrainStep(cfg, ParallelConfig(ep=2, mp=1, dp=4))
+    moe = LlamaConfig.mixtral_tiny()               # 4 experts
+    with pytest.raises(ValueError):
+        PretrainStep(moe, ParallelConfig(ep=3, dp=1, mp=1))
+    with pytest.raises(NotImplementedError):
+        PretrainStep(moe, ParallelConfig(pp=2, micro_batches=2))
+
+
+def test_moe_active_param_accounting():
+    cfg = LlamaConfig.mixtral_tiny()
+    total, active = cfg.num_params(), cfg.num_active_params()
+    assert active < total
+    dense, experts = cfg._per_layer_params()
+    expected = cfg.num_hidden_layers * (
+        dense + experts * cfg.moe_top_k // cfg.moe_num_experts) + \
+        2 * cfg.vocab_size * cfg.hidden_size + cfg.hidden_size
+    assert active == expected
