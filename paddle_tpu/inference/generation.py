@@ -163,7 +163,6 @@ class LlamaGenerator:
         c = self.config
         B, T = ids.shape
         cos, sin = self._cos[:T], self._sin[:T]
-        rep = c.num_attention_heads // c.num_key_value_heads
         h = jnp.take(params["embed"], ids, axis=0)
 
         def layer(carry, xs):
@@ -182,10 +181,7 @@ class LlamaGenerator:
                 kcl, vcl, k.reshape(B * T, c.num_key_value_heads, c.head_dim),
                 v.reshape(B * T, c.num_key_value_heads, c.head_dim),
                 slot_mapping.reshape(B * T))
-            if rep > 1:
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
-            attn = _flash_attention_arrays(q, k, v, True)
+            attn = _flash_attention_arrays(q, k, v, True)  # GQA in-kernel
             x = x + (attn.reshape(B, T, -1) @ lp["self_attn.o_proj.weight"])
             y = rms_norm_fp32(x, lp["post_attention_layernorm.weight"],
                               c.rms_norm_eps)

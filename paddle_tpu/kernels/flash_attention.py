@@ -1,18 +1,31 @@
 """Flash attention for TPU (Pallas), forward + backward.
 
 Replaces paddle/phi/kernels/gpu/flash_attn_kernel.cu:587 (forward) and
-paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu (backward).  Design is the
-standard online-softmax blocked algorithm mapped to TPU: Q blocks stay
-resident in VMEM while K/V blocks stream; running max/denominator keep
-numerics stable in fp32 regardless of input dtype.  The forward additionally
-emits the per-row logsumexp so the backward can recompute attention
-probabilities blockwise — dQ and dK/dV are dedicated Pallas kernels with fp32
-accumulators and NO [T, T] score materialization (FlashAttention-2 backward).
+paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu (backward); the feature
+surface (GQA, attention mask, varlen) mirrors the reference flash_attn
+signature.  Design is the online-softmax blocked algorithm mapped to TPU:
+
+- **KV streaming via the grid**: the KV-block loop is the innermost grid
+  dimension, with the online-softmax state (m, l, acc) carried in VMEM
+  scratch across it.  VMEM holds one Q block + one KV block at a time, so
+  sequence length is bounded by HBM, not VMEM — 16k+ contexts work.
+- **Causal skipping**: KV blocks entirely above the diagonal are skipped
+  with `pl.when`, and their index maps are clamped to the last needed
+  block so Mosaic's consecutive-same-block DMA elision makes the skipped
+  fetches free.  Causal costs ~half of full attention, as it should.
+- **GQA in-kernel**: the grid iterates query heads and the K/V index maps
+  select `h // group`, so grouped K/V are never materialized per q-head
+  (the bwd dK/dV kernel emits per-q-head partials, summed over each group
+  outside — one [g] reduction instead of a host-side repeat).
+- **Masking modes**, composable with causal: an additive fp32 mask
+  ([b, h|1, sq, sk], streamed blockwise — the reference's attn_mask), and
+  a segment mode (int seg ids per token, O(T) memory) which gives the
+  packed/varlen block-diagonal mask without any [T, T] materialization.
 
 Layout convention matches the paddle API: [batch, seq, heads, head_dim].
 Falls back to an XLA-fused reference on CPU (tests) — same math; set
-``FLAGS_flash_attention_interpret=1`` to run the Pallas kernels in interpreter
-mode on CPU (used by tests to validate the exact kernel code paths).
+``FLAGS_flash_attention_interpret=1`` to run the Pallas kernels in
+interpreter mode on CPU (used by tests to validate the exact kernel code).
 """
 
 from __future__ import annotations
@@ -36,192 +49,248 @@ flags.define_flag("flash_attention_interpret", False,
                   "on CPU (tests only; TPU always uses the compiled path).")
 
 
-def _reference_attention(q, k, v, causal):
-    """XLA-fused reference: used on CPU and as the numerics oracle in tests."""
-    out, _ = _reference_attention_lse(q, k, v, causal)
+# --------------------------------------------------------------------------
+# XLA reference (CPU fallback + numerics oracle)
+# --------------------------------------------------------------------------
+
+def _reference_attention(q, k, v, causal, mask=None, seg_q=None, seg_k=None):
+    out, _ = _reference_attention_lse(q, k, v, causal, mask, seg_q, seg_k)
     return out
 
 
-def _reference_attention_lse(q, k, v, causal):
-    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+def _reference_attention_lse(q, k, v, causal, mask=None, seg_q=None,
+                             seg_k=None):
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)   # [b, h, sq, d]
     kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
     vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    group = qh.shape[1] // kh.shape[1]
+    if group > 1:
+        kh = jnp.repeat(kh, group, axis=1)
+        vh = jnp.repeat(vh, group, axis=1)
     scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if mask is not None:
+        scores = scores + mask.astype(jnp.float32)
     if causal:
         sq, sk = scores.shape[-2], scores.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        scores = jnp.where(mask, scores, NEG_INF)
-    lse = jax.scipy.special.logsumexp(scores, axis=-1)     # [b, h, sq]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(cm, scores, NEG_INF)
+    if seg_q is not None:
+        sm = seg_q[:, :, None] == seg_k[:, None, :]          # [b, sq, sk]
+        scores = jnp.where(sm[:, None], scores, NEG_INF)
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)       # [b, h, sq]
     probs = jnp.exp(scores - lse[..., None])
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype), lse
 
 
 # --------------------------------------------------------------------------
-# forward kernel
+# kernel helpers
 # --------------------------------------------------------------------------
 
-def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_kv, kv_len,
-                   causal, scale, block_q, q_len):
-    """One (batch*head, q_block) program: stream KV blocks with online softmax."""
+def _apply_masks(s, i, j, *, block_q, block_kv, causal, diag_off,
+                 mask_blk, segq_blk, segk_blk):
+    """Additive mask + causal + segment masking on one score block."""
+    if mask_blk is not None:
+        s = s + mask_blk
+    if causal:
+        q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_pos + diag_off >= k_pos, s, jnp.float32(NEG_INF))
+    if segq_blk is not None:
+        s = jnp.where(segq_blk == jnp.swapaxes(segk_blk, 0, 1), s,
+                      jnp.float32(NEG_INF))
+    return s
+
+
+def _needed(i, block_q, block_kv, diag_off):
+    """Last KV block index a causal q-block i touches."""
+    return (i * block_q + block_q - 1 + diag_off) // block_kv
+
+
+
+
+# --------------------------------------------------------------------------
+# forward kernel: grid (b, hq, q_blocks, kv_blocks) — kv innermost
+# --------------------------------------------------------------------------
+
+def _fa_fwd_kernel(*refs, block_q, block_kv, causal, scale, q_len, kv_len,
+                   has_mask, has_seg):
     from jax.experimental import pallas as pl
 
-    # NOTE: scalar literals inside the kernel must be wrapped to f32:
-    # in the mosaic lowering (unlike plain jax weak typing) they
-    # materialise as f64 under x64 mode and tpu.truncf f64->f32 has
-    # no legalization
-    q = q_ref[:].astype(jnp.float32) * jnp.float32(scale)  # [block_q, d]
-    q_idx = pl.program_id(1)
+    it = iter(refs)
+    q_ref = next(it)
+    k_ref = next(it)
+    v_ref = next(it)
+    mask_ref = next(it) if has_mask else None
+    segq_ref = next(it) if has_seg else None
+    segk_ref = next(it) if has_seg else None
+    o_ref = next(it)
+    lse_ref = next(it)
+    m_sc, l_sc, acc_sc = next(it), next(it), next(it)
 
-    m = jnp.full((q.shape[0], 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((q.shape[0], 1), jnp.float32)
-    acc = jnp.zeros((q.shape[0], v_ref.shape[-1]), jnp.float32)
-
-    num_kv = kv_len // block_kv
-    # query i attends keys j <= i + (kv_len - q_len), matching the reference
-    # tril(k=sk-sq) semantics (decode: sq < sk attends the whole prefix)
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    n_j = pl.num_programs(3)
     diag_off = kv_len - q_len
 
-    def compute(i, carry):
-        m, l, acc = carry
-        k = k_ref[pl.ds(i * block_kv, block_kv), :].astype(jnp.float32)
-        v = v_ref[pl.ds(i * block_kv, block_kv), :].astype(jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, NEG_INF, m_sc.dtype)
+        l_sc[...] = jnp.zeros(l_sc.shape, l_sc.dtype)
+        acc_sc[...] = jnp.zeros(acc_sc.shape, acc_sc.dtype)
+
+    run = True if not causal else \
+        (j <= _needed(i, block_q, block_kv, diag_off))
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[...].astype(jnp.float32) * jnp.float32(scale)
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [bq, bkv]
-        if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = i * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos + diag_off >= k_pos, s, jnp.float32(NEG_INF))
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+                                preferred_element_type=jnp.float32)
+        s = _apply_masks(
+            s, i, j, block_q=block_q, block_kv=block_kv, causal=causal,
+            diag_off=diag_off,
+            mask_blk=mask_ref[...] if has_mask else None,
+            segq_blk=segq_ref[...] if has_seg else None,
+            segk_blk=segk_ref[...] if has_seg else None)
+        m_prev, l_prev = m_sc[...], l_sc[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = alpha * acc + jax.lax.dot_general(
+        alpha = jnp.exp(m_prev - m_new)
+        m_sc[...] = m_new
+        l_sc[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_sc[...] = alpha * acc_sc[...] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
 
-    if causal:
-        # static trip count (mosaic cannot lower a dynamic-bound loop), but
-        # skip fully-above-diagonal KV blocks via cond so causal costs ~half
-        def body(i, carry):
-            needed = i * block_kv <= q_idx * block_q + block_q - 1 + diag_off
-            return jax.lax.cond(needed, lambda c: compute(i, c),
-                                lambda c: c, carry)
-    else:
-        body = compute
-
-    # int32 bounds: x64 mode would promote bare ints to int64, which the
-    # mosaic lowering cannot convert
-    m, l, acc = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_kv), body,
-                                  (m, l, acc))
-    l = jnp.maximum(l, jnp.float32(1e-30))
-    o_ref[:] = (acc / l).astype(o_ref.dtype)
-    lse_ref[:] = m + jnp.log(l)          # [block_q, 1]
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        l = jnp.maximum(l_sc[...], jnp.float32(1e-30))
+        o_ref[...] = (acc_sc[...] / l).astype(o_ref.dtype)
+        lse_ref[...] = m_sc[...] + jnp.log(l)
 
 
 # --------------------------------------------------------------------------
-# backward kernels (FlashAttention-2 style: dQ kernel + dK/dV kernel)
+# backward kernels (FlashAttention-2: dQ kernel + per-q-head dK/dV kernel)
 # --------------------------------------------------------------------------
 
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                      *, block_kv, kv_len, causal, scale, block_q, q_len):
-    """One (batch*head, q_block) program: dQ = scale * sum_j dS_ij k_j,
-    recomputing P blockwise from the saved logsumexp."""
+def _fa_bwd_dq_kernel(*refs, block_q, block_kv, causal, scale, q_len, kv_len,
+                      has_mask, has_seg):
     from jax.experimental import pallas as pl
 
-    q = q_ref[:].astype(jnp.float32) * jnp.float32(scale)   # [bq, d]
-    do = do_ref[:].astype(jnp.float32)                      # [bq, d]
-    lse = lse_ref[:]                                        # [bq, 1]
-    delta = delta_ref[:]                                    # [bq, 1]
-    q_idx = pl.program_id(1)
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (next(it) for _ in
+                                                       range(6))
+    mask_ref = next(it) if has_mask else None
+    segq_ref = next(it) if has_seg else None
+    segk_ref = next(it) if has_seg else None
+    dq_ref = next(it)
+    acc_sc = next(it)
+
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    n_j = pl.num_programs(3)
     diag_off = kv_len - q_len
 
-    def compute(i, acc):
-        k = k_ref[pl.ds(i * block_kv, block_kv), :].astype(jnp.float32)
-        v = v_ref[pl.ds(i * block_kv, block_kv), :].astype(jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros(acc_sc.shape, acc_sc.dtype)
+
+    run = True if not causal else \
+        (j <= _needed(i, block_q, block_kv, diag_off))
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[...].astype(jnp.float32) * jnp.float32(scale)
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...]
+        delta = delta_ref[...]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [bq, bkv]
-        if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = i * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos + diag_off >= k_pos, s, jnp.float32(NEG_INF))
-        p = jnp.exp(s - lse)                 # masked entries exp(-inf) -> 0
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)  # [bq, bkv]
-        ds = p * (dp - delta)
-        return acc + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
-
-    if causal:
-        def body(i, acc):
-            needed = i * block_kv <= q_idx * block_q + block_q - 1 + diag_off
-            return jax.lax.cond(needed, lambda a: compute(i, a),
-                                lambda a: a, acc)
-    else:
-        body = compute
-
-    num_kv = kv_len // block_kv
-    acc = jnp.zeros((q.shape[0], q_ref.shape[-1]), jnp.float32)
-    acc = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_kv), body, acc)
-    dq_ref[:] = (acc * jnp.float32(scale)).astype(dq_ref.dtype)
-
-
-def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, *, block_kv, kv_len, causal, scale,
-                       block_q, q_len):
-    """One (batch*head, kv_block) program: dV = P^T dO, dK = scale * dS^T q,
-    streaming Q blocks."""
-    from jax.experimental import pallas as pl
-
-    k = k_ref[:].astype(jnp.float32)                        # [bkv, d]
-    v = v_ref[:].astype(jnp.float32)                        # [bkv, d]
-    kv_idx = pl.program_id(1)
-    diag_off = kv_len - q_len
-
-    def compute(j, carry):
-        dk_acc, dv_acc = carry
-        q = q_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32) \
-            * jnp.float32(scale)                            # [bq, d]
-        do = do_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(j * block_q, block_q), :]       # [bq, 1]
-        delta = delta_ref[pl.ds(j * block_q, block_q), :]   # [bq, 1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [bq, bkv]
-        if causal:
-            q_pos = j * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = kv_idx * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos + diag_off >= k_pos, s, jnp.float32(NEG_INF))
-        p = jnp.exp(s - lse)                                # [bq, bkv]
-        dv_acc = dv_acc + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [bkv, d]
+                                preferred_element_type=jnp.float32)
+        s = _apply_masks(
+            s, i, j, block_q=block_q, block_kv=block_kv, causal=causal,
+            diag_off=diag_off,
+            mask_blk=mask_ref[...] if has_mask else None,
+            segq_blk=segq_ref[...] if has_seg else None,
+            segk_blk=segk_ref[...] if has_seg else None)
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        # q above is pre-scaled, so this already carries the `scale` factor
-        dk_acc = dk_acc + jax.lax.dot_general(
+        acc_sc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        dq_ref[...] = (acc_sc[...] * jnp.float32(scale)).astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(*refs, block_q, block_kv, causal, scale, q_len,
+                       kv_len, has_mask, has_seg):
+    """Grid (b, hq, kv_blocks, q_blocks): per-Q-HEAD dK/dV partials for one
+    KV block, streaming Q blocks; group partials are summed outside."""
+    from jax.experimental import pallas as pl
+
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (next(it) for _ in
+                                                       range(6))
+    mask_ref = next(it) if has_mask else None
+    segq_ref = next(it) if has_seg else None
+    segk_ref = next(it) if has_seg else None
+    dk_ref, dv_ref = next(it), next(it)
+    dk_sc, dv_sc = next(it), next(it)
+
+    kv_idx = pl.program_id(2)
+    jq = pl.program_id(3)
+    n_q = pl.num_programs(3)
+    diag_off = kv_len - q_len
+
+    @pl.when(jq == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros(dk_sc.shape, dk_sc.dtype)
+        dv_sc[...] = jnp.zeros(dv_sc.shape, dv_sc.dtype)
+
+    # q block jq touches this kv block iff its LAST row reaches it
+    run = True if not causal else \
+        (jq * block_q + block_q - 1 + diag_off >= kv_idx * block_kv)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[...].astype(jnp.float32) * jnp.float32(scale)
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...]
+        delta = delta_ref[...]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = _apply_masks(
+            s, jq, kv_idx, block_q=block_q, block_kv=block_kv, causal=causal,
+            diag_off=diag_off,
+            mask_blk=mask_ref[...] if has_mask else None,
+            segq_blk=segq_ref[...] if has_seg else None,
+            segk_blk=segk_ref[...] if has_seg else None)
+        p = jnp.exp(s - lse)
+        dv_sc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # q is pre-scaled, so this carries the `scale` factor already
+        dk_sc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [bkv, d]
-        return dk_acc, dv_acc
+            preferred_element_type=jnp.float32)
 
-    if causal:
-        def body(j, carry):
-            # q block j touches this kv block iff its LAST query row sits at
-            # or beyond the kv block's first key position
-            needed = j * block_q + block_q - 1 + diag_off >= kv_idx * block_kv
-            return jax.lax.cond(needed, lambda c: compute(j, c),
-                                lambda c: c, carry)
-    else:
-        body = compute
-
-    num_q = q_len // block_q
-    d = k_ref.shape[-1]
-    init = (jnp.zeros((k.shape[0], d), jnp.float32),
-            jnp.zeros((k.shape[0], v_ref.shape[-1]), jnp.float32))
-    dk_acc, dv_acc = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_q), body, init)
-    dk_ref[:] = dk_acc.astype(dk_ref.dtype)
-    dv_ref[:] = dv_acc.astype(dv_ref.dtype)
+    @pl.when(jq == n_q - 1)
+    def _finalize():
+        dk_ref[...] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_sc[...].astype(dv_ref.dtype)
 
 
 # --------------------------------------------------------------------------
@@ -246,142 +315,277 @@ def _blocks_for(sq, sk, d):
     return block_q, block_kv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash_attention_arrays(q, k, v, causal):
-    return _fa_forward_impl(q, k, v, causal)
+def _heads_first(x):
+    return jnp.swapaxes(x, 1, 2)             # [b, s, h, d] -> [b, h, s, d]
 
 
-def _fa_forward_impl(q, k, v, causal):
-    mode = _pallas_mode()
-    blocks = _blocks_for(q.shape[1], k.shape[1], q.shape[-1])
-    if q.dtype == jnp.float64 or mode is None or blocks is None:
-        return _reference_attention(q, k, v, causal)
-    out, _ = _fa_pallas_forward(q, k, v, causal, blocks, mode)
-    return out
-
-
-def _flatten_heads(x):
-    b, s, h, d = x.shape
-    return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
-
-
-def _fa_pallas_forward(q, k, v, causal, blocks, mode):
+def _specs_common(has_mask, has_seg, mask_heads, group, blocks, sq, sk, d,
+                  causal, dkv_layout=False):
+    """(in_specs for q,k,v[,mask][,segq,segk]) given the masking modes.
+    Index-map convention: grid = (b, h, X, Y).  With causal, the streamed
+    operand's block index is clamped to the last/first needed block, so the
+    skipped iterations re-fetch the same block and Mosaic elides the DMA —
+    causal skipping costs no bandwidth."""
     from jax.experimental import pallas as pl
 
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+    block_q, block_kv = blocks
+    g = np.int32(max(group, 1))
+    diag_off = sk - sq
+
+    if not dkv_layout:          # fwd/dq: X = q block i, Y = kv block j
+        def jc(i, j):           # clamped kv block index
+            if not causal:
+                return j
+            return jnp.minimum(j, _needed(i, block_q, block_kv, diag_off))
+        qmap = lambda b, h, i, j: (b, h, i, _I0)
+        kvmap = lambda b, h, i, j: (b, h // g, jc(i, j), _I0)
+        mmap = (lambda b, h, i, j: (b, _I0 if mask_heads == 1 else h,
+                                    i, jc(i, j)))
+        sqmap = lambda b, h, i, j: (b, i, _I0)
+        skmap = lambda b, h, i, j: (b, jc(i, j), _I0)
+    else:                       # dkv: X = kv block, Y = q block (streamed)
+        def qc(kv, jq):         # clamp to the first q block that reaches kv
+            if not causal:
+                return jq
+            first = jnp.maximum(
+                (kv * block_kv - diag_off - block_q + 1), 0) // block_q
+            return jnp.maximum(jq, first)
+        qmap = lambda b, h, kv, jq: (b, h, qc(kv, jq), _I0)
+        kvmap = lambda b, h, kv, jq: (b, h // g, kv, _I0)
+        mmap = (lambda b, h, kv, jq: (b, _I0 if mask_heads == 1 else h,
+                                      qc(kv, jq), kv))
+        sqmap = lambda b, h, kv, jq: (b, qc(kv, jq), _I0)
+        skmap = lambda b, h, kv, jq: (b, kv, _I0)
+
+    specs = [
+        pl.BlockSpec((None, None, block_q, d), qmap),
+        pl.BlockSpec((None, None, block_kv, d), kvmap),
+        pl.BlockSpec((None, None, block_kv, d), kvmap),
+    ]
+    if has_mask:
+        specs.append(pl.BlockSpec((None, None, block_q, block_kv), mmap))
+    if has_seg:
+        specs.append(pl.BlockSpec((None, block_q, 1), sqmap))
+        specs.append(pl.BlockSpec((None, block_kv, 1), skmap))
+    return specs, qmap
+
+
+def _prep_mask_segs(mask, seg_q, seg_k):
+    has_mask = mask is not None
+    has_seg = seg_q is not None
+    mask_heads = mask.shape[1] if has_mask else 0
+    extra = []
+    if has_mask:
+        extra.append(mask.astype(jnp.float32))
+    if has_seg:
+        # float32 carries segment ids exactly below 2^24; keeps every
+        # kernel operand a float (simplest Mosaic layout path)
+        extra.append(seg_q.astype(jnp.float32)[:, :, None])
+        extra.append(seg_k.astype(jnp.float32)[:, :, None])
+    return has_mask, has_seg, mask_heads, extra
+
+
+def _fa_pallas_forward(q, k, v, causal, mask, seg_q, seg_k, blocks, mode):
+    from jax.experimental import pallas as pl
+
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    group = hq // hkv
     block_q, block_kv = blocks
     scale = 1.0 / math.sqrt(d)
-    # fold batch & heads into the grid's first axis; layout [b*h, s, d]
-    qf, kf, vf = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
+    has_mask, has_seg, mask_heads, extra = _prep_mask_segs(mask, seg_q, seg_k)
 
-    kernel = functools.partial(_fa_fwd_kernel, block_kv=block_kv, kv_len=sk,
-                               causal=causal, scale=scale, block_q=block_q,
-                               q_len=sq)
-    out, lse = pl.pallas_call(
+    kernel = functools.partial(
+        _fa_fwd_kernel, block_q=block_q, block_kv=block_kv, causal=causal,
+        scale=scale, q_len=sq, kv_len=sk, has_mask=has_mask, has_seg=has_seg)
+    in_specs, qmap = _specs_common(has_mask, has_seg, mask_heads, group,
+                                   blocks, sq, sk, d, causal)
+    return _fwd_call(kernel, b, hq, sq, sk, d, blocks, in_specs, qmap,
+                     q, k, v, extra, mode)
+
+
+def _fwd_call(kernel, b, hq, sq, sk, d, blocks, in_specs, qmap, q, k, v,
+              extra, mode):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    block_q, block_kv = blocks
+    qf, kf, vf = _heads_first(q), _heads_first(k), _heads_first(v)
+    return pl.pallas_call(
         kernel,
-        grid=(b * h, sq // block_q),
-        # index maps use int32 literals: x64 mode would make bare `0` an
-        # int64, which mosaic refuses to return from the index-map func
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, _I0)),
-            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, _I0, _I0)),
-            pl.BlockSpec((None, sk, d), lambda bh, i: (bh, _I0, _I0)),
-        ],
+        grid=(b, hq, sq // block_q, sk // block_kv),
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, _I0)),
-            pl.BlockSpec((None, block_q, 1), lambda bh, i: (bh, i, _I0)),
+            pl.BlockSpec((None, None, block_q, d), qmap),
+            pl.BlockSpec((None, None, block_q, 1), qmap),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=(mode == "interpret"),
-    )(qf, kf, vf)
-    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2), lse
+    )(qf, kf, vf, *extra)
 
 
-def _fa_pallas_backward(q, k, v, out, lse, g, causal, blocks, mode):
+def _fa_pallas_backward(q, k, v, out, lse, g, causal, mask, seg_q, seg_k,
+                        blocks, mode):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    group = hq // hkv
     block_q, block_kv = blocks
     scale = 1.0 / math.sqrt(d)
+    has_mask, has_seg, mask_heads, extra = _prep_mask_segs(mask, seg_q, seg_k)
 
-    qf, kf, vf = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
-    of, gf = _flatten_heads(out), _flatten_heads(g)
-    # delta_i = dO_i . O_i  (rowwise): cheap elementwise, fused by XLA
-    delta = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), axis=-1,
-                    keepdims=True)                          # [b*h, sq, 1]
+    qf, kf, vf = _heads_first(q), _heads_first(k), _heads_first(v)
+    of, gf = _heads_first(out), _heads_first(g)
+    delta = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32),
+                    axis=-1, keepdims=True)          # [b, hq, sq, 1]
 
-    common = dict(block_kv=block_kv, kv_len=sk, causal=causal, scale=scale,
-                  block_q=block_q, q_len=sq)
-    qspec = pl.BlockSpec((None, block_q, d), lambda bh, i: (bh, i, _I0))
-    kfull = pl.BlockSpec((None, sk, d), lambda bh, i: (bh, _I0, _I0))
-    qfull = pl.BlockSpec((None, sq, d), lambda bh, i: (bh, _I0, _I0))
-    rowspec = pl.BlockSpec((None, block_q, 1), lambda bh, i: (bh, i, _I0))
-    rowfull = pl.BlockSpec((None, sq, 1), lambda bh, i: (bh, _I0, _I0))
-    kvspec = pl.BlockSpec((None, block_kv, d), lambda bh, i: (bh, i, _I0))
+    common = dict(block_q=block_q, block_kv=block_kv, causal=causal,
+                  scale=scale, q_len=sq, kv_len=sk, has_mask=has_mask,
+                  has_seg=has_seg)
 
+    # ---- dQ: grid (b, hq, q_blocks, kv_blocks) ----
+    in_specs, qmap = _specs_common(has_mask, has_seg, mask_heads, group,
+                                   blocks, sq, sk, d, causal)
+    # q,k,v + do,lse,delta share q-block/row indexing
+    rowmap = qmap
+    dq_specs = in_specs[:3] + [
+        pl.BlockSpec((None, None, block_q, d), qmap),
+        pl.BlockSpec((None, None, block_q, 1), rowmap),
+        pl.BlockSpec((None, None, block_q, 1), rowmap),
+    ] + in_specs[3:]
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, **common),
-        grid=(b * h, sq // block_q),
-        in_specs=[qspec, kfull, kfull, qspec, rowspec, rowspec],
-        out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=(b, hq, sq // block_q, sk // block_kv),
+        in_specs=dq_specs,
+        out_specs=pl.BlockSpec((None, None, block_q, d), qmap),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=(mode == "interpret"),
-    )(qf, kf, vf, gf, lse, delta)
+    )(qf, kf, vf, gf, lse, delta, *extra)
 
-    dk, dv = pl.pallas_call(
+    # ---- dK/dV: grid (b, hq, kv_blocks, q_blocks), per-q-head partials ----
+    in_specs2, qmap2 = _specs_common(has_mask, has_seg, mask_heads, group,
+                                     blocks, sq, sk, d, causal,
+                                     dkv_layout=True)
+    dkv_specs = in_specs2[:3] + [
+        pl.BlockSpec((None, None, block_q, d), qmap2),
+        pl.BlockSpec((None, None, block_q, 1), qmap2),
+        pl.BlockSpec((None, None, block_q, 1), qmap2),
+    ] + in_specs2[3:]
+    outmap = lambda bb, h, kv, jq: (bb, h, kv, _I0)
+    dk_p, dv_p = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, **common),
-        grid=(b * h, sk // block_kv),
-        in_specs=[qfull, kvspec, kvspec, qfull, rowfull, rowfull],
-        out_specs=[kvspec, kvspec],
-        out_shape=[jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)],
+        grid=(b, hq, sk // block_kv, sq // block_q),
+        in_specs=dkv_specs,
+        out_specs=[pl.BlockSpec((None, None, block_kv, d), outmap),
+                   pl.BlockSpec((None, None, block_kv, d), outmap)],
+        out_shape=[jax.ShapeDtypeStruct((b, hq, sk, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b, hq, sk, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_kv, d), jnp.float32),
+                        pltpu.VMEM((block_kv, d), jnp.float32)],
         interpret=(mode == "interpret"),
-    )(qf, kf, vf, gf, lse, delta)
+    )(qf, kf, vf, gf, lse, delta, *extra)
 
-    def unflatten(x, s):
-        return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
-    return unflatten(dq, sq), unflatten(dk, sk), unflatten(dv, sk)
+    # sum q-head partials within each KV group
+    dk = dk_p.reshape(b, hkv, group, sk, d).sum(axis=2)
+    dv = dv_p.reshape(b, hkv, group, sk, d).sum(axis=2)
 
-
-def _fa_fwd_rule(q, k, v, causal):
-    mode = _pallas_mode()
-    blocks = _blocks_for(q.shape[1], k.shape[1], q.shape[-1])
-    if q.dtype == jnp.float64 or mode is None or blocks is None:
-        out, lse = _reference_attention_lse(q, k, v, causal)
-        return out, (q, k, v, None, None)
-    out, lse = _fa_pallas_forward(q, k, v, causal, blocks, mode)
-    return out, (q, k, v, out, lse)
-
-
-def _fa_bwd_rule(causal, res, g):
-    q, k, v, out, lse = res
-    mode = _pallas_mode()
-    blocks = _blocks_for(q.shape[1], k.shape[1], q.shape[-1])
-    if out is None or mode is None or blocks is None:
-        # fallback: vjp of the XLA-fused reference (CPU tests, odd shapes)
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal), q, k, v)
-        return vjp(g)
-    return _fa_pallas_backward(q, k, v, out, lse, g, causal, blocks, mode)
-
-
-_flash_attention_arrays.defvjp(_fa_fwd_rule, _fa_bwd_rule)
-
-
-def flash_attention(query, key, value, causal=False):
-    """Tensor-level flash attention, layout [b, s, h, d]."""
-    args = tuple(a if isinstance(a, Tensor) else Tensor(a) for a in (query, key, value))
-    return apply_op("flash_attention",
-                    lambda q, k, v: _flash_attention_arrays(q, k, v, causal), args)
+    unf = lambda x: jnp.swapaxes(x, 1, 2)
+    return (unf(dq), unf(dk).astype(k.dtype), unf(dv).astype(v.dtype))
 
 
 # --------------------------------------------------------------------------
-# varlen (unpadded) attention
+# custom_vjp plumbing.  mask / seg operands are non-differentiable data:
+# their cotangents are zeros.
+# --------------------------------------------------------------------------
+
+_NO_MASK = None
+
+
+def _fa_supported(q, k, causal, mask, seg_q):
+    mode = _pallas_mode()
+    blocks = _blocks_for(q.shape[1], k.shape[1], q.shape[-1])
+    if q.dtype == jnp.float64 or mode is None or blocks is None:
+        return None, None
+    if mask is not None:
+        bq, bkv = blocks
+        if mask.shape[-2] % bq or mask.shape[-1] % bkv:
+            return None, None
+    return mode, blocks
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fa_core(q, k, v, causal, mask, seg_q, seg_k):
+    out, _ = _fa_core_fwd(q, k, v, causal, mask, seg_q, seg_k)
+    return out
+
+
+def _fa_core_fwd(q, k, v, causal, mask, seg_q, seg_k):
+    mode, blocks = _fa_supported(q, k, causal, mask, seg_q)
+    if mode is None:
+        out, lse = _reference_attention_lse(q, k, v, causal, mask, seg_q,
+                                            seg_k)
+        return out, (q, k, v, mask, seg_q, seg_k, None, None)
+    out, lse = _fa_pallas_forward(q, k, v, causal, mask, seg_q, seg_k,
+                                  blocks, mode)
+    return jnp.swapaxes(out, 1, 2), (q, k, v, mask, seg_q, seg_k,
+                                     jnp.swapaxes(out, 1, 2), lse)
+
+
+def _fa_core_bwd(causal, res, g):
+    q, k, v, mask, seg_q, seg_k, out, lse = res
+    zeros = lambda t: None if t is None else jnp.zeros_like(t)
+    if out is None:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal, mask,
+                                                    seg_q, seg_k), q, k, v)
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, zeros(mask), zeros(seg_q), zeros(seg_k)
+    mode, blocks = _fa_supported(q, k, causal, mask, seg_q)
+    dq, dk, dv = _fa_pallas_backward(q, k, v, out, lse, g, causal, mask,
+                                     seg_q, seg_k, blocks, mode)
+    return dq, dk, dv, zeros(mask), zeros(seg_q), zeros(seg_k)
+
+
+_fa_core.defvjp(_fa_core_fwd, _fa_core_bwd)
+
+
+def _flash_attention_arrays(q, k, v, causal, mask=None, seg_q=None,
+                            seg_k=None):
+    return _fa_core(q, k, v, causal, mask, seg_q, seg_k)
+
+
+def flash_attention(query, key, value, causal=False, attn_mask=None):
+    """Tensor-level flash attention, layout [b, s, h, d].
+
+    GQA-native: key/value may have fewer heads (a divisor of the query
+    heads).  ``attn_mask``: additive fp32 mask [b, 1|h, sq, sk] (reference
+    flash_attn attn_mask surface), streamed blockwise by the kernel.
+    """
+    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+    args = tuple(a if isinstance(a, Tensor) else Tensor(a) for a in args)
+
+    if attn_mask is not None:
+        def prim(q, k, v, m):
+            return _flash_attention_arrays(q, k, v, causal, mask=m)
+    else:
+        def prim(q, k, v):
+            return _flash_attention_arrays(q, k, v, causal)
+    return apply_op("flash_attention", prim, args)
+
+
+# --------------------------------------------------------------------------
+# varlen (unpadded) attention — segment-aware Pallas path
 # --------------------------------------------------------------------------
 
 def _segments_from_cu(cu, total):
@@ -397,28 +601,45 @@ def flash_attn_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k, causal=False):
     flash_attn_unpadded / flash_attn_varlen_qkvpacked).
 
     q/k/v: [total_tokens, heads, dim] — sequences packed back-to-back;
-    cu_seqlens: [batch+1] cumulative lengths.  Tokens only attend within
-    their own segment (block-diagonal mask), causally if requested.
+    cu_seqlens: [batch+1] cumulative lengths.  Tokens attend only within
+    their own segment, causally if requested.
 
-    XLA-fused segment-mask formulation: on TPU the perf path for training is
-    the padded-batch Pallas kernel (flash_attention); this op exists for the
-    packed-sequence API and inference prefill over ragged batches.
+    Runs the segment-masking mode of the Pallas flash kernels: per-token
+    int segment ids (O(total) memory) are streamed beside the Q/KV blocks
+    and compared in-kernel, so no [T, T] mask is ever materialized — the
+    blocked online-softmax is identical to the padded path.  With causal,
+    global positions order tokens inside each segment (packing preserves
+    order), so the plain causal test composes with the segment test; this
+    requires cu_seqlens_q == cu_seqlens_k (self-attention packing), the
+    reference's varlen training case.
     """
     def prim(q_, k_, v_, cq, ck):
         tq, h, d = q_.shape
         tk = k_.shape[0]
-        seg_q, pos_q = _segments_from_cu(cq, tq)
-        seg_k, pos_k = _segments_from_cu(ck, tk)
-        scale = 1.0 / math.sqrt(d)
-        s = jnp.einsum("qhd,khd->hqk", q_.astype(jnp.float32),
-                       k_.astype(jnp.float32)) * scale
-        mask = seg_q[:, None] == seg_k[None, :]
         if causal:
-            mask = jnp.logical_and(mask, pos_q[:, None] >= pos_k[None, :])
-        s = jnp.where(mask[None], s, NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("hqk,khd->qhd", p, v_.astype(jnp.float32))
-        return out.astype(q_.dtype)
+            # causal ordering uses global packed positions, valid only for
+            # identical q/k packings — reject what we cannot honor
+            if tq != tk or cq.shape != ck.shape:
+                raise ValueError(
+                    "flash_attn_varlen(causal=True) requires identical "
+                    "q/k packings (cu_seqlens_q == cu_seqlens_k)")
+            try:                     # value check only when concrete
+                same = bool(jnp.all(cq == ck))
+            except jax.errors.TracerBoolConversionError:
+                same = True
+            if not same:
+                raise ValueError(
+                    "flash_attn_varlen(causal=True): cu_seqlens_q and "
+                    "cu_seqlens_k differ")
+        seg_q, _ = _segments_from_cu(cq, tq)
+        seg_k, _ = _segments_from_cu(ck, tk)
+        # float32 ids: exact below 2^24, and float primals keep the
+        # custom_vjp cotangent plumbing uniform
+        out = _flash_attention_arrays(
+            q_[None], k_[None], v_[None], causal,
+            seg_q=seg_q[None].astype(jnp.float32),
+            seg_k=seg_k[None].astype(jnp.float32))
+        return out[0]
 
     return apply_op("flash_attn_varlen",
                     prim,
