@@ -200,6 +200,63 @@ def _run_moe(on_tpu):
     }
 
 
+def _run_gpt2_compiled_vs_eager(on_tpu):
+    """BASELINE.md config 2: GPT-2 eager (per-op tape dispatch) vs
+    jit.to_static tokens/s — the one target with a hard ratio
+    (compiled >= 1.5x eager)."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import InputSpec, to_static
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    if on_tpu:
+        cfg = GPTConfig.gpt2_base(max_position_embeddings=512)
+        batch, seq, steps = 8, 512, 5
+    else:
+        cfg = GPTConfig.tiny()
+        batch, seq, steps = 2, 32, 2
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    def fwd_loss(i, l):
+        _, loss = model(i, labels=l)
+        return loss
+
+    # eager: per-op dispatch through the tape
+    loss = fwd_loss(ids, labels)
+    jax.block_until_ready(loss._data)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = fwd_loss(ids, labels)
+    jax.block_until_ready(loss._data)
+    eager_tps = batch * seq * steps / (time.perf_counter() - t0)
+
+    # compiled: one whole-program XLA executable via jit.to_static
+    static = to_static(fwd_loss, input_spec=[
+        InputSpec([batch, seq], "int32"), InputSpec([batch, seq], "int32")])
+    loss = static(ids, labels)
+    jax.block_until_ready(loss._data)
+    t0 = time.perf_counter()
+    for _ in range(steps * 4):
+        loss = static(ids, labels)
+    jax.block_until_ready(loss._data)
+    static_tps = batch * seq * steps * 4 / (time.perf_counter() - t0)
+
+    return {
+        "gpt2_eager_tok_per_sec": round(eager_tps, 1),
+        "gpt2_compiled_tok_per_sec": round(static_tps, 1),
+        "gpt2_compiled_over_eager": round(static_tps / eager_tps, 2),
+    }
+
+
 def main():
     import jax
 
@@ -227,6 +284,11 @@ def main():
                 result.update(_run_moe(on_tpu))
             except Exception as e:
                 result["moe_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+                traceback.print_exc(file=sys.stderr)
+            try:
+                result.update(_run_gpt2_compiled_vs_eager(on_tpu))
+            except Exception as e:
+                result["gpt2_error"] = f"{type(e).__name__}: {str(e)[:150]}"
                 traceback.print_exc(file=sys.stderr)
             print(json.dumps(result))
             return 0

@@ -30,7 +30,12 @@ class DebugMode(Enum):
 
 
 class TensorCheckerConfig:
-    """reference debugging.py:173."""
+    """reference debugging.py:173 — full surface: op allow/skip lists,
+    debug-step window, abort-vs-report modes, findings log (output_dir).
+
+    Per-op hook: core.autograd consults the active config on every eager
+    kernel output when FLAGS_check_nan_inf is on.
+    """
 
     def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
                  output_dir=None, checked_op_list=None, skipped_op_list=None,
@@ -38,17 +43,64 @@ class TensorCheckerConfig:
         self.enable = enable
         self.debug_mode = debug_mode
         self.output_dir = output_dir
-        self.checked_op_list = checked_op_list
-        self.skipped_op_list = skipped_op_list
-        self.debug_step = debug_step
+        self.checked_op_list = set(checked_op_list) if checked_op_list else None
+        self.skipped_op_list = set(skipped_op_list) if skipped_op_list else None
+        self.debug_step = debug_step         # (start, end) step window
+        self.stack_height_limit = stack_height_limit
+        self._step = 0
+        self.findings: list = []             # [(step, op, n_nan, n_inf)]
+
+    # ---- consulted by core.autograd._check_nan_inf ----
+    def should_check(self, op_name: str) -> bool:
+        if not self.enable:
+            return False
+        if self.debug_step is not None:
+            start, end = self.debug_step
+            if not (start <= self._step < end):
+                return False
+        if self.skipped_op_list and op_name in self.skipped_op_list:
+            return False
+        if self.checked_op_list is not None:
+            return op_name in self.checked_op_list
+        return True
+
+    def report(self, op_name: str, arr) -> bool:
+        """Record a NaN/Inf hit; returns True when the mode aborts."""
+        n_nan = int(jnp.isnan(arr).sum())
+        n_inf = int(jnp.isinf(arr).sum())
+        self.findings.append((self._step, op_name, n_nan, n_inf))
+        if self.output_dir is not None:
+            import os
+            os.makedirs(self.output_dir, exist_ok=True)
+            with open(os.path.join(self.output_dir,
+                                   "tensor_checker.log"), "a") as f:
+                f.write(f"step={self._step} op={op_name} "
+                        f"nan={n_nan} inf={n_inf}\n")
+        return self.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT
+
+    def update_step_id(self, step: int):
+        """reference: the checker tracks the training step for debug_step
+        windows; call once per optimizer step."""
+        self._step = int(step)
+
+
+_ACTIVE_CHECKER: Optional[TensorCheckerConfig] = None
+
+
+def active_checker_config() -> Optional[TensorCheckerConfig]:
+    return _ACTIVE_CHECKER
 
 
 def enable_tensor_checker(config: TensorCheckerConfig):
+    global _ACTIVE_CHECKER
     if config.enable:
+        _ACTIVE_CHECKER = config
         flags.set_flags({"check_nan_inf": True})
 
 
 def disable_tensor_checker():
+    global _ACTIVE_CHECKER
+    _ACTIVE_CHECKER = None
     flags.set_flags({"check_nan_inf": False})
 
 

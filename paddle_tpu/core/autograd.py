@@ -148,10 +148,19 @@ def _amp_cast(name, arrays, amp):
 
 
 def _check_nan_inf(name, arrays):
+    # the active TensorCheckerConfig (amp.debugging) scopes which ops are
+    # checked, which steps, and whether a hit aborts or only reports
+    from ..amp import debugging as _dbg
+    cfg = _dbg.active_checker_config()
+    if cfg is not None and not cfg.should_check(name):
+        return
     for a in arrays:
         if hasattr(a, "dtype") and dtypes.is_floating_point(np.dtype(a.dtype)):
             if not bool(jnp.isfinite(a).all()):
-                raise FloatingPointError(f"NaN/Inf detected in output of op '{name}'")
+                if cfg is not None and not cfg.report(name, a):
+                    continue                   # CHECK-only modes: log, go on
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output of op '{name}'")
 
 
 _jit_cache: dict = {}

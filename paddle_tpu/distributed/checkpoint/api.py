@@ -88,8 +88,10 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
                 arr = v._data
                 args[k] = ocp.ArrayRestoreArgs(sharding=arr.sharding,
                                                dtype=arr.dtype)
-            elif isinstance(v, (jax.Array, np.ndarray)):
-                args[k] = ocp.RestoreArgs()
+            elif isinstance(v, jax.Array):
+                # raw arrays reshard into their current placement too
+                args[k] = ocp.ArrayRestoreArgs(sharding=v.sharding,
+                                               dtype=v.dtype)
             else:
                 args[k] = ocp.RestoreArgs()
         return args

@@ -128,3 +128,6 @@ distributed_optimizer = fleet.distributed_optimizer
 get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
 worker_index = fleet.worker_index
 worker_num = fleet.worker_num
+
+from . import elastic  # noqa: E402,F401
+from .elastic import ElasticManager, ElasticProgram, ElasticStatus  # noqa: E402,F401

@@ -1,0 +1,145 @@
+"""Elastic training: watch the device set, checkpoint, rebuild, resume.
+
+Reference: ElasticManager (python/paddle/distributed/fleet/elastic/
+manager.py:125) — ranks register in etcd, a watcher detects node
+join/leave and signals the launcher to kill and relaunch trainers with the
+new world size; recovery happens by checkpoint-resume.
+
+TPU-native redesign: under jax's single-controller model the "node set" is
+the visible device set, and relaunching per-rank processes is replaced by
+rebuilding the mesh inside the controller:
+
+  watch devices -> (on change) save checkpoint -> rebuild mesh + jitted
+  step at the new world size -> restore state -> continue
+
+The training program plugs in through ``ElasticProgram`` (build / step /
+save / load), so the manager owns only the watch-resize-resume loop — the
+single-controller analog of the reference's relaunch loop.  Device-set
+changes are injectable (``device_fn``), which is also how tests simulate a
+resize on the virtual CPU mesh without real hardware failures.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+
+
+class ElasticStatus(enum.IntEnum):
+    """Mirror of the reference's manager status surface."""
+    COMPLETED = 1
+    ERROR = 2
+    HOLD = 3
+    RESTART = 4
+    EXIT = 5
+
+
+class ElasticProgram:
+    """What the manager drives.  Implement these four:
+
+    - ``build(devices, restore)``: construct the mesh/train step for this
+      device set; when ``restore`` is True, load the latest checkpoint
+      (returned by your own ``load``) into the new topology.  Returns the
+      training state.
+    - ``step(state)``: one training step; returns the new state.
+    - ``save(state)``: write a checkpoint (called before every rebuild).
+    - ``steps_done(state)``: global step counter, for resume accounting.
+    """
+
+    def build(self, devices: Sequence[Any], restore: bool):
+        raise NotImplementedError
+
+    def step(self, state):
+        raise NotImplementedError
+
+    def save(self, state) -> None:
+        raise NotImplementedError
+
+    def steps_done(self, state) -> int:
+        raise NotImplementedError
+
+
+class ElasticManager:
+    """Single-controller elastic loop (reference manager.py:125).
+
+    Args:
+      program: the ElasticProgram to drive.
+      device_fn: returns the CURRENT usable device list (default
+        jax.devices); swap it in tests to simulate join/leave.
+      min_devices: below this the manager holds (reference np range
+        semantics: elastic waits for the cluster to heal).
+      watch_interval: seconds between device-set polls in ``hold``.
+      max_resizes: safety bound on rebuilds (None = unbounded).
+    """
+
+    def __init__(self, program: ElasticProgram, *,
+                 device_fn: Callable[[], Sequence[Any]] = jax.devices,
+                 min_devices: int = 1, watch_interval: float = 1.0,
+                 max_resizes: Optional[int] = None):
+        self.program = program
+        self._device_fn = device_fn
+        self.min_devices = min_devices
+        self.watch_interval = watch_interval
+        self.max_resizes = max_resizes
+        self.resizes = 0
+        self.history: list = []              # [(step, old_n, new_n)]
+
+    # ---- watch ----
+    def _devices(self):
+        return tuple(self._device_fn())
+
+    def watch(self, current) -> ElasticStatus:
+        """One poll (reference ElasticManager.watch): RESTART on change,
+        HOLD when the cluster is below min_devices, else COMPLETED."""
+        now = self._devices()
+        if len(now) < self.min_devices:
+            return ElasticStatus.HOLD
+        if now != current:
+            return ElasticStatus.RESTART
+        return ElasticStatus.COMPLETED
+
+    def _wait_healthy(self):
+        while len(self._devices()) < self.min_devices:
+            time.sleep(self.watch_interval)
+        return self._devices()
+
+    # ---- the loop ----
+    def run(self, max_steps: int):
+        """Train to ``max_steps`` global steps, surviving device-set
+        changes by checkpoint + rebuild + resume."""
+        devices = self._wait_healthy()
+        state = self.program.build(devices, restore=False)
+        while self.program.steps_done(state) < max_steps:
+            status = self.watch(devices)
+            if status in (ElasticStatus.RESTART, ElasticStatus.HOLD):
+                if self.max_resizes is not None and \
+                        self.resizes >= self.max_resizes:
+                    raise RuntimeError(
+                        f"elastic: exceeded max_resizes={self.max_resizes}")
+                self.program.save(state)
+                old_n = len(devices)
+                devices = self._wait_healthy()
+                self.history.append(
+                    (self.program.steps_done(state), old_n, len(devices)))
+                state = self.program.build(devices, restore=True)
+                self.resizes += 1
+                continue
+            try:
+                state = self.program.step(state)
+            except jax.errors.JaxRuntimeError:
+                # a device computation died mid-step: the in-flight state is
+                # suspect, so do NOT checkpoint it — resume from the last
+                # good checkpoint (programs treat a missing checkpoint as a
+                # fresh start)
+                if self.max_resizes is not None and \
+                        self.resizes >= self.max_resizes:
+                    raise RuntimeError(
+                        f"elastic: exceeded max_resizes={self.max_resizes}")
+                devices = self._wait_healthy()
+                state = self.program.build(devices, restore=True)
+                self.resizes += 1
+        return state

@@ -374,9 +374,221 @@ def pipeline_1f1b_grads(mesh, axis: str, stage_fn: Callable,
                            *consts)
 
 
+def zbh1_schedule(S: int, M: int):
+    """The ZBH1 work layout: per (stage, tick), which of F/B/W units run.
+
+    Mirrors the reference zero-bubble pass
+    (python/paddle/distributed/passes/pipeline_scheduler_pass/
+    pipeline_zero_bubble.py:62 ZBH1: split the weight-grad W out of the
+    combined backward B so W fills the cooldown bubble).  Unit timing:
+      F(f) at tick t = f + s
+      B(b) at tick t = b + (2S - 1 - s)   (input-grad only — the
+                                           inter-stage dependency chain)
+      W(w) at tick t = w + (2S - 1)       (weight-grad, deferred s ticks
+                                           after its B — stage 0 runs W
+                                           with B, stage S-1 defers most)
+    Total ticks 2S + M - 1; each stage does M F, M B and M W units, and
+    every W lands in a slot where plain 1F1B idles its weight-grad work.
+    Returns {(s, t): set of ('F'|'B'|'W', microbatch)}.
+    """
+    table = {}
+    T = 2 * S + M - 1
+    for s in range(S):
+        for t in range(T):
+            units = set()
+            f = t - s
+            if 0 <= f < M:
+                units.add(("F", f))
+            b = t - (2 * S - 1 - s)
+            if 0 <= b < M:
+                units.add(("B", b))
+            w = t - (2 * S - 1)
+            if 0 <= w < M:
+                units.add(("W", w))
+            if units:
+                table[(s, t)] = units
+    return table
+
+
+def pipeline_zbh1_grads(mesh, axis: str, stage_fn: Callable,
+                        loss_fn: Callable, stage_params: Any, loss_params: Any,
+                        microbatches, labels, *consts):
+    """Zero-bubble H1 schedule: 1F1B with the weight-grad (W) split from the
+    input-grad (B) and deferred into the cooldown slots.
+
+    Reference: pipeline_zero_bubble.py:62 (ZBH1).  The B pass pulls back
+    ONLY the activation cotangent (the inter-stage critical path: XLA DCEs
+    the dθ computations out of it); the W pass replays the stage vjp for
+    the saved (checkpointed input, received cotangent) pair s ticks later
+    and accumulates dθ/d(loss params).  Stage 0 defers nothing; stage S-1
+    defers W by S-1 ticks — exactly the paper's triangle of W fills.
+
+    In this SPMD lockstep runtime every stage executes every tick, so the
+    tick count (2S + M - 1, `zbh1_schedule`) matches plain 1F1B and the
+    split's wall-clock value comes from XLA overlapping the off-critical-
+    path W matmuls with the cotangent ppermute inside each tick; the
+    schedule structure (and its MPMD benefit, for a future multi-executable
+    runtime) is the reference's.  Costs one extra forward recompute per
+    microbatch vs combined 1F1B.
+
+    Same contract as `pipeline_1f1b_grads`.
+    """
+    S = mesh.shape[axis]
+    M = microbatches.shape[0]
+    if S == 1:
+        return pipeline_1f1b_grads(mesh, axis, stage_fn, loss_fn,
+                                   stage_params, loss_params, microbatches,
+                                   labels, *consts)
+
+    W_ring = 2 * S - 1
+    T = 2 * S + M - 1
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def per_stage(params_local, micro, lbls, lparams, *cs):
+        params = jax.tree_util.tree_map(lambda l: l[0], params_local)
+        s = lax.axis_index(axis)
+        mb_shape = micro[0]
+
+        def vary(x):
+            return lax.pcast(x, (axis,), to="varying")
+
+        lparams = jax.tree_util.tree_map(vary, lparams)
+
+        fwd_carry = vary(jnp.zeros_like(mb_shape))
+        bwd_carry = vary(jnp.zeros_like(mb_shape))
+        inbuf = vary(jnp.zeros((W_ring,) + mb_shape.shape, mb_shape.dtype))
+        gybuf = vary(jnp.zeros((W_ring,) + mb_shape.shape, mb_shape.dtype))
+        glbuf = vary(jnp.zeros((W_ring,), jnp.float32))
+        dmicro = vary(jnp.zeros_like(micro))
+        gacc = jax.tree_util.tree_map(
+            lambda l: vary(jnp.zeros(l.shape, jnp.float32)), params)
+        glp_acc = jax.tree_util.tree_map(
+            lambda l: vary(jnp.zeros(l.shape, jnp.float32)), lparams)
+        loss_acc = vary(jnp.float32(0.0))
+
+        def tick(carry, t):
+            (fwd_carry, bwd_carry, inbuf, gybuf, glbuf, dmicro, gacc,
+             glp_acc, loss_acc) = carry
+
+            # ---- reads first: ring slots are reused within the tick ----
+            b = t - (2 * S - 1 - s)
+            b_valid = jnp.logical_and(b >= 0, b < M)
+            bc = jnp.clip(b, 0, M - 1)
+            xb = lax.dynamic_index_in_dim(inbuf, bc % W_ring, 0,
+                                          keepdims=False)
+
+            w = t - (2 * S - 1)
+            w_valid = jnp.logical_and(w >= 0, w < M)
+            wc = jnp.clip(w, 0, M - 1)
+            xw = lax.dynamic_index_in_dim(inbuf, wc % W_ring, 0,
+                                          keepdims=False)
+            gyw_saved = lax.dynamic_index_in_dim(gybuf, wc % W_ring, 0,
+                                                 keepdims=False)
+            glw_saved = lax.dynamic_index_in_dim(glbuf, wc % W_ring, 0,
+                                                 keepdims=False)
+
+            # ---- forward: F(f = t - s) ----
+            f = t - s
+            f_valid = jnp.logical_and(f >= 0, f < M)
+            fc = jnp.clip(f, 0, M - 1)
+            x0 = lax.dynamic_index_in_dim(micro, fc, 0, keepdims=False)
+            x = jnp.where(s == 0, x0, fwd_carry)
+            y = stage_fn(params, x, *cs)
+            inbuf = jnp.where(
+                f_valid,
+                lax.dynamic_update_index_in_dim(inbuf, x, fc % W_ring, 0),
+                inbuf)
+
+            # ---- B pass: input-grad only (critical path) ----
+            lbl_b = lax.dynamic_index_in_dim(lbls, bc, 0, keepdims=False)
+
+            def fwd_loss_x(x_):
+                y_ = stage_fn(params, x_, *cs)
+                return y_, loss_fn(y_, lbl_b, lparams)
+
+            (_, loss_b), vjp_x = jax.vjp(fwd_loss_x, xb)
+            is_last = (s == S - 1)
+            gy_seed = jnp.where(jnp.logical_or(is_last,
+                                               jnp.logical_not(b_valid)),
+                                jnp.zeros_like(y), bwd_carry).astype(y.dtype)
+            gl_seed = jnp.where(jnp.logical_and(is_last, b_valid),
+                                jnp.float32(1.0), jnp.float32(0.0))
+            (dx,) = vjp_x((gy_seed, gl_seed))
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(is_last, b_valid), loss_b, 0.0)
+            dmicro = jnp.where(
+                jnp.logical_and(s == 0, b_valid),
+                lax.dynamic_update_index_in_dim(
+                    dmicro, dx.astype(dmicro.dtype), bc, 0),
+                dmicro)
+
+            # save the B seed for the deferred W pass
+            gybuf = jnp.where(
+                b_valid,
+                lax.dynamic_update_index_in_dim(
+                    gybuf, gy_seed.astype(mb_shape.dtype), bc % W_ring, 0),
+                gybuf)
+            glbuf = jnp.where(
+                b_valid,
+                lax.dynamic_update_index_in_dim(glbuf, gl_seed, bc % W_ring,
+                                                0),
+                glbuf)
+
+            # ---- W pass: weight-grad W(w = t - (2S-1)) ----
+            # stage 0 has zero deferral (w == b there): use the fresh seed
+            gyw = jnp.where(s == 0, gy_seed.astype(mb_shape.dtype),
+                            gyw_saved)
+            glw = jnp.where(s == 0, gl_seed, glw_saved)
+            xw_eff = jnp.where(s == 0, xb, xw)
+
+            def fwd_loss_p(p_, lp_):
+                y_ = stage_fn(p_, xw_eff, *cs)
+                lblw = lax.dynamic_index_in_dim(lbls, wc, 0, keepdims=False)
+                lblw = jnp.where(s == 0, lbl_b, lblw)
+                return y_, loss_fn(y_, lblw, lp_)
+
+            _, vjp_p = jax.vjp(fwd_loss_p, params, lparams)
+            gp, glp = vjp_p((gyw.astype(y.dtype), glw))
+            do_w = jnp.where(s == 0, b_valid, w_valid)
+            gacc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(do_w, g.astype(jnp.float32), 0.0),
+                gacc, gp)
+            glp_acc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(do_w, g.astype(jnp.float32), 0.0),
+                glp_acc, glp)
+
+            fwd_carry = lax.ppermute(y, axis, fwd_perm)
+            bwd_carry = lax.ppermute(dx.astype(mb_shape.dtype), axis,
+                                     bwd_perm)
+            return (fwd_carry, bwd_carry, inbuf, gybuf, glbuf, dmicro, gacc,
+                    glp_acc, loss_acc), None
+
+        carry = (fwd_carry, bwd_carry, inbuf, gybuf, glbuf, dmicro, gacc,
+                 glp_acc, loss_acc)
+        carry, _ = lax.scan(tick, carry, jnp.arange(T))
+        (_, _, _, _, _, dmicro, gacc, glp_acc, loss_acc) = carry
+
+        gacc = jax.tree_util.tree_map(lambda l: l[None], gacc)
+        loss = lax.psum(loss_acc, axis)
+        glp = jax.tree_util.tree_map(lambda l: lax.psum(l, axis), glp_acc)
+        dmicro = lax.psum(dmicro * (s == 0).astype(dmicro.dtype), axis)
+        return loss, gacc, glp, dmicro
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+                P(), P(), jax.tree_util.tree_map(lambda _: P(), loss_params),
+                ) + tuple(P() for _ in consts)
+    out_specs = (P(), jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+                 jax.tree_util.tree_map(lambda _: P(), loss_params), P())
+    return jax.shard_map(per_stage, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, axis_names={axis},
+                         )(stage_params, microbatches, labels, loss_params,
+                           *consts)
+
+
 def num_pipeline_ticks(num_micro: int, num_stages: int, virtual: int = 1,
                        schedule: str = "gpipe") -> int:
-    if schedule == "1f1b":
+    if schedule in ("1f1b", "zbh1"):
         return 2 * num_stages + num_micro - 1
     if virtual > 1:
         return virtual * num_micro + num_stages - 1

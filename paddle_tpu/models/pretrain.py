@@ -33,7 +33,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
 from ..distributed.pipeline_spmd import (interleave_chunk_order,
-                                         pipeline_1f1b_grads, pipeline_apply)
+                                         pipeline_1f1b_grads,
+                                         pipeline_apply,
+                                         pipeline_zbh1_grads)
 from ..utils import extract_params, functional_call, stack_params
 from .llama import LlamaConfig, LlamaDecoderLayer, _rope_cos_sin, _scaled_init
 
@@ -45,7 +47,7 @@ class ParallelConfig:
     mp: int = 1
     ep: int = 1                  # expert parallel (MoE expert-bank sharding)
     micro_batches: int = 1
-    schedule: str = "gpipe"      # pipeline schedule: gpipe | interleave | 1f1b
+    schedule: str = "gpipe"      # gpipe | interleave | 1f1b | zbh1
     virtual_pp: int = 1          # VPP chunks per stage (schedule="interleave")
     sequence_parallel: bool = False
     zero1: bool = False          # shard optimizer moments over dp
@@ -108,9 +110,9 @@ class PretrainStep:
         self.mesh = mesh if mesh is not None else build_mesh(self.pc)
         self.lr, self.wd = learning_rate, weight_decay
         self.b1, self.b2, self.eps = beta1, beta2, eps
-        if self.pc.schedule not in ("gpipe", "interleave", "1f1b"):
+        if self.pc.schedule not in ("gpipe", "interleave", "1f1b", "zbh1"):
             raise ValueError(f"unknown pipeline schedule {self.pc.schedule!r}")
-        if self.pc.schedule == "1f1b" and self.pc.virtual_pp > 1:
+        if self.pc.schedule in ("1f1b", "zbh1") and self.pc.virtual_pp > 1:
             raise ValueError("interleaved 1F1B is not implemented; use "
                              "schedule='interleave' or virtual_pp=1")
         self._moe = bool(config.moe_num_experts)
@@ -390,7 +392,9 @@ class PretrainStep:
 
             return jax.lax.map(chunk_loss, (hc, lc)).sum()
 
-        loss_sum, d_blocks, d_lp, d_micro = pipeline_1f1b_grads(
+        grads_fn = pipeline_zbh1_grads if self.pc.schedule == "zbh1" \
+            else pipeline_1f1b_grads
+        loss_sum, d_blocks, d_lp, d_micro = grads_fn(
             mesh, "pp", stage_fn, loss_fn, params["blocks"], loss_params,
             micro, lbl_micro)
 
@@ -435,7 +439,7 @@ class PretrainStep:
     # ---- the jitted step ----
     def train_step(self, state, ids, labels):
         if self._jit_step is None:
-            if self.pc.schedule == "1f1b":
+            if self.pc.schedule in ("1f1b", "zbh1"):
                 def step(state, ids, labels):
                     loss, grads = self._loss_and_grads_1f1b(
                         state["params"], ids, labels)
@@ -468,3 +472,50 @@ class PretrainStep:
         sh = NamedSharding(self.mesh, P("dp", None))
         return (jax.device_put(jnp.asarray(ids), sh),
                 jax.device_put(jnp.asarray(labels), sh))
+
+    # ---- cross-topology checkpoints (reference:
+    # fleet/utils/pp_parallel_adaptor.py — convert PP checkpoints across
+    # pipeline configurations; distributed/checkpoint metadata reshard) ----
+    def canonical_state(self, state) -> Dict[str, Any]:
+        """Topology-independent view of a training state: stacked block
+        leaves become ``[num_layers, ...]`` in true layer order (the
+        [G, L/G] stage grouping and any interleave permutation undone).
+        Save THIS; any PretrainStep topology can restore it."""
+        L = self.config.num_hidden_layers
+        inv = np.argsort(np.asarray(
+            interleave_chunk_order(self.pc.pp, self._virtual))) \
+            if self._virtual > 1 else None
+
+        def fix(v):
+            if inv is not None:
+                v = v[np.asarray(inv)]
+            return v.reshape((L,) + v.shape[2:])
+
+        out = dict(state)
+        for key in ("params", "m", "v"):
+            sub = dict(state[key])
+            sub["blocks"] = {k: fix(val)
+                             for k, val in state[key]["blocks"].items()}
+            out[key] = sub
+        return out
+
+    def restore_canonical(self, canonical) -> Dict[str, Any]:
+        """Place a canonical checkpoint (host or device arrays) into THIS
+        topology's freshly-sharded state layout."""
+        G = self.pc.pp * self._virtual
+        L = self.config.num_hidden_layers
+        order = np.asarray(interleave_chunk_order(self.pc.pp, self._virtual))
+        target = self.init_state(seed=0)
+
+        def put(src, dst):
+            src = np.asarray(src)
+            if src.shape != dst.shape:       # [L, ...] -> [G, L/G, ...]
+                src = src.reshape((G, L // G) + src.shape[1:])
+                if self._virtual > 1:
+                    src = src[order]
+            if isinstance(dst.sharding, jax.sharding.NamedSharding):
+                return jax.device_put(src.astype(dst.dtype), dst.sharding)
+            return jnp.asarray(src.astype(dst.dtype))
+
+        return jax.tree_util.tree_map(lambda s, d: put(s, d),
+                                      canonical, target)

@@ -1,0 +1,44 @@
+// Demo out-of-tree kernels for the custom-op seam
+// (paddle_tpu.utils.cpp_extension).  The framework-side contract they
+// exercise is the reference's PD_BUILD_OP surface
+// (paddle/fluid/framework/custom_operator.cc); the ABI here is the XLA FFI.
+
+#include <cmath>
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+// out = scale * x + y  (elementwise, fp32)
+static ffi::Error AxpyImpl(ffi::Buffer<ffi::F32> x, ffi::Buffer<ffi::F32> y,
+                           float scale, ffi::ResultBuffer<ffi::F32> out) {
+  const size_t n = x.element_count();
+  const float* xd = x.typed_data();
+  const float* yd = y.typed_data();
+  float* od = out->typed_data();
+  for (size_t i = 0; i < n; ++i) od[i] = scale * xd[i] + yd[i];
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(AxpyHandler, AxpyImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Attr<float>("scale")
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+// out = x^3  (has a simple analytic grad for the VJP-hook demo)
+static ffi::Error CubeImpl(ffi::Buffer<ffi::F32> x,
+                           ffi::ResultBuffer<ffi::F32> out) {
+  const size_t n = x.element_count();
+  const float* xd = x.typed_data();
+  float* od = out->typed_data();
+  for (size_t i = 0; i < n; ++i) od[i] = xd[i] * xd[i] * xd[i];
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(CubeHandler, CubeImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());

@@ -28,28 +28,56 @@ def _t(x):
 
 # ---- weight-only quantization (inference) ----
 
+def _pack_int4(q):
+    """[in, out] int8 in [-7, 7] -> [in/2, out] int8, two nibbles per byte
+    (low nibble = even row, high nibble = odd row).  True 4-bit storage:
+    the packed weight is the only HBM-resident copy at half the int8
+    footprint (reference weight-only int4,
+    paddle/phi/kernels/fusion/gpu/weight_only_linear_kernel.cu)."""
+    if q.shape[0] % 2:
+        q = jnp.pad(q, ((0, 1),) + ((0, 0),) * (q.ndim - 1))
+    lo = q[0::2] & 0x0F
+    hi = jnp.left_shift(q[1::2], 4)
+    return (lo | hi).astype(jnp.int8)
+
+
+def _unpack_int4(p, rows):
+    """[in/2, out] packed -> [rows, out] int8 with sign extension (the
+    arithmetic-shift idiom: (x << 4) >> 4 recovers the signed low nibble)."""
+    lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+    hi = jnp.right_shift(p, 4)
+    full = jnp.stack([lo, hi], axis=1).reshape((-1,) + p.shape[1:])
+    return full[:rows]
+
+
 def weight_quantize(x, algo="weight_only_int8", name=None):
     """reference ops.yaml: weight_quantize.  x: [in, out] fp weight ->
-    (quantized int8 weight, per-out-channel fp32 scale).
+    (quantized weight, per-out-channel fp32 scale).
 
-    int4 uses the int8 container clipped to [-7, 7] (TPU has no int4
-    storage; the bandwidth win of true 4-bit packing needs a Pallas unpack
-    kernel — tracked as a kernels/ follow-up)."""
+    int8: [in, out] int8.  int4: TRUE 4-bit packing — [ceil(in/2), out]
+    int8 holding two nibbles per byte (see _pack_int4)."""
     if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
         raise ValueError(f"unknown weight_quantize algo {algo!r}")
-    qmax = 7.0 if algo == "weight_only_int4" else 127.0
+    int4 = algo == "weight_only_int4"
+    qmax = 7.0 if int4 else 127.0
     w = _t(x)._data
     scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / qmax
     scale = jnp.maximum(scale, 1e-10)
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -qmax, qmax)
-    return Tensor(q.astype(jnp.int8)), Tensor(scale)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                 -qmax, qmax).astype(jnp.int8)
+    if int4:
+        q = _pack_int4(q)
+    return Tensor(q), Tensor(scale)
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float32",
-                      name=None):
-    """reference ops.yaml: weight_dequantize."""
+                      name=None, in_features=None):
+    """reference ops.yaml: weight_dequantize.  For int4, ``in_features``
+    recovers an odd original row count (default: 2 * packed rows)."""
     q = _t(x)._data
     s = _t(scale)._data
+    if algo == "weight_only_int4":
+        q = _unpack_int4(q, in_features or 2 * q.shape[0])
     return Tensor((q.astype(jnp.float32) * s).astype(jnp.dtype(out_dtype)))
 
 
@@ -63,9 +91,12 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     if weight_scale is None:
         raise ValueError(
             "weight_only_linear requires weight_scale (from weight_quantize)")
+    int4 = weight_dtype == "int4"
 
     def prim(a, qw, *rest):
         s = rest[0]
+        if int4:
+            qw = _unpack_int4(qw, a.shape[-1])
         w = qw.astype(a.dtype) * s.astype(a.dtype)
         y = a @ w
         if len(rest) > 1:

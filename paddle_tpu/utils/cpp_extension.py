@@ -1,0 +1,179 @@
+"""Custom C++ op seam: compile, register and call out-of-tree kernels.
+
+Reference surface: paddle.utils.cpp_extension.load + PD_BUILD_OP
+(paddle/fluid/framework/custom_operator.cc) and the C kernel ABI
+(paddle/phi/capi/) — the "bring your own kernel" seam the reference treats
+as a first-class product feature.
+
+TPU-native redesign: the foreign-function boundary is the **XLA FFI**
+(jax.ffi) — the same custom-call ABI XLA itself uses.  ``load`` compiles
+C++ sources (which include ``xla/ffi/api/ffi.h`` from
+``get_include()``) into a shared library with g++, dlopens it, registers
+each exported ``XLA_FFI_DEFINE_HANDLER_SYMBOL`` with
+``jax.ffi.register_ffi_target``, and returns a module whose attributes are
+callable ops — traceable under jit, composable with custom VJPs, and
+recorded in the framework OP_REGISTRY like any built-in.
+
+Custom calls execute on the registered platform (CPU here — on TPU,
+device-side compute belongs in Pallas kernels; FFI covers host kernels,
+IO, and CPU deployments, the same scope as the reference's custom ops).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._prim import OP_REGISTRY, apply_op, register_op
+
+
+def get_include() -> str:
+    """Include dir holding xla/ffi/api/ffi.h (compile your sources with
+    ``-I get_include()``)."""
+    return jax.ffi.include_dir()
+
+
+def _compile(name: str, sources: Sequence[str], build_directory: str,
+             extra_cflags: Sequence[str], verbose: bool) -> str:
+    os.makedirs(build_directory, exist_ok=True)
+    out = os.path.join(build_directory, f"{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    stamp = out + ".srchash"
+    import hashlib
+    h = hashlib.sha256()
+    for s in srcs:
+        h.update(open(s, "rb").read())
+    h.update(" ".join(extra_cflags).encode())   # flag changes bust the cache
+    digest = h.hexdigest()
+    if os.path.exists(out) and os.path.exists(stamp) and \
+            open(stamp).read() == digest:
+        return out                          # cached build
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           f"-I{get_include()}", *extra_cflags, *srcs, "-o", out]
+    if verbose:
+        print("cpp_extension:", " ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    with open(stamp, "w") as f:
+        f.write(digest)
+    return out
+
+
+class CustomOpModule:
+    """What ``load`` returns: each op is an attribute; ``raw(name)`` gives
+    the array-level callable for composition with jax transforms."""
+
+    def __init__(self, name):
+        self._name = name
+        self._ops: Dict[str, Callable] = {}
+
+    def _add(self, op_name, fn):
+        self._ops[op_name] = fn
+        setattr(self, op_name, fn)
+
+    def __repr__(self):
+        return f"<CustomOpModule {self._name}: {sorted(self._ops)}>"
+
+
+def load(name: str, sources: Sequence[str], functions: Dict[str, dict],
+         extra_cflags: Sequence[str] = (), build_directory: Optional[str] = None,
+         verbose: bool = False) -> CustomOpModule:
+    """Compile + register custom ops (reference cpp_extension.load).
+
+    Args:
+      name: extension name (also the .so stem).
+      sources: C++ files defining handlers via XLA_FFI_DEFINE_HANDLER_SYMBOL.
+      functions: {op_name: spec} where spec has:
+        - "symbol": exported handler symbol (default: op_name)
+        - "out_like": int index — output takes shape/dtype of that input
+          arg; or a callable (*args, **attrs) -> jax.ShapeDtypeStruct
+        - "vjp": optional callable (residuals, cotangent) -> input
+          cotangents tuple, with residuals = (args, out); registering it
+          makes the op differentiable (the custom-grad seam of
+          PD_BUILD_GRAD_OP)
+        - "attrs": names of static (non-array) keyword attributes, passed
+          to the kernel through the FFI attr channel
+      build_directory: defaults to ``<first source dir>/build``.
+
+    Returns a CustomOpModule with one Tensor-level callable per op.
+    """
+    build_directory = build_directory or os.path.join(
+        os.path.dirname(os.path.abspath(sources[0])), "build")
+    so = _compile(name, sources, build_directory, tuple(extra_cflags),
+                  verbose)
+    lib = ctypes.cdll.LoadLibrary(so)
+    mod = CustomOpModule(name)
+
+    for op_name, spec in functions.items():
+        symbol = spec.get("symbol", op_name)
+        target = f"{name}.{op_name}"
+        jax.ffi.register_ffi_target(
+            target, jax.ffi.pycapsule(getattr(lib, symbol)), platform="cpu")
+        mod._add(op_name, _make_op(target, op_name, spec))
+    return mod
+
+
+def _make_op(target: str, op_name: str, spec: dict) -> Callable:
+    out_like = spec.get("out_like", 0)
+    vjp = spec.get("vjp")
+    attr_names = tuple(spec.get("attrs", ()))
+    # one array-level callable per attr binding, built once and cached:
+    # stable function identity keeps autograd's per-op jit cache hitting,
+    # and the custom_vjp wrapper closes over the SAME attrs it forwards
+    fn_cache: Dict[tuple, Callable] = {}
+
+    def _raw_for(attrs: dict) -> Callable:
+        import numpy as np
+
+        def coerce(v):
+            # bare python floats would decode as f64 (x64 mode); C++
+            # handlers overwhelmingly bind Attr<float>
+            return np.float32(v) if isinstance(v, float) else v
+
+        bound = {k: coerce(attrs[k]) for k in attr_names if k in attrs}
+
+        def raw(*arrays):
+            if callable(out_like):
+                out_spec = out_like(*arrays, **attrs)
+            else:
+                ref = arrays[out_like]
+                out_spec = jax.ShapeDtypeStruct(ref.shape, ref.dtype)
+            return jax.ffi.ffi_call(target, out_spec)(*arrays, **bound)
+
+        return raw
+
+    def _fn_for(attrs: dict) -> Callable:
+        key = tuple(sorted(attrs.items()))
+        fn = fn_cache.get(key)
+        if fn is not None:
+            return fn
+        raw = _raw_for(attrs)
+        if vjp is not None:
+            core = jax.custom_vjp(raw)
+
+            def fwd(*arrays):
+                out = raw(*arrays)
+                return out, (arrays, out)
+
+            def bwd(res, g):
+                return tuple(vjp(res, g))
+
+            core.defvjp(fwd, bwd)
+            fn = core
+        else:
+            fn = raw
+        fn_cache[key] = fn
+        return fn
+
+    def tensor_op(*args, **attrs):
+        arrs = tuple(a if isinstance(a, Tensor) else Tensor(a) for a in args)
+        return apply_op(op_name, _fn_for(attrs), arrs)
+
+    tensor_op.raw = _fn_for({})
+    register_op(op_name, tensor_op.raw)
+    return tensor_op

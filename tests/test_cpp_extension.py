@@ -1,0 +1,90 @@
+"""Custom-op / FFI seam tests (VERDICT r2 item 9): compile a real C++
+kernel with g++ against the XLA FFI headers, register it, run it eagerly
+and under jit, and differentiate through the VJP hook.  Reference:
+paddle/fluid/framework/custom_operator.cc (PD_BUILD_OP), paddle/phi/capi/."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops._prim import OP_REGISTRY
+from paddle_tpu.utils import cpp_extension
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "paddle_tpu", "native",
+                    "ops", "demo_ops.cc")
+
+
+@pytest.fixture(scope="module")
+def demo_ops(tmp_path_factory):
+    def cube_vjp(res, g):
+        (x,), _ = res
+        return (3.0 * jnp.square(x) * g,)
+
+    return cpp_extension.load(
+        "demo_ops", [_SRC],
+        functions={
+            "custom_axpy": {"symbol": "AxpyHandler", "out_like": 0,
+                            "attrs": ("scale",)},
+            "custom_cube": {"symbol": "CubeHandler", "out_like": 0,
+                            "vjp": cube_vjp},
+        },
+        build_directory=str(tmp_path_factory.mktemp("ext_build")))
+
+
+def test_ffi_op_eager(demo_ops, rng):
+    x = rng.standard_normal(32).astype(np.float32)
+    y = rng.standard_normal(32).astype(np.float32)
+    out = demo_ops.custom_axpy(paddle.to_tensor(x), paddle.to_tensor(y),
+                               scale=2.5)
+    np.testing.assert_allclose(out.numpy(), 2.5 * x + y, rtol=1e-6)
+
+
+def test_ffi_op_under_jit(demo_ops, rng):
+    x = jnp.asarray(rng.standard_normal((4, 8)).astype(np.float32))
+
+    @jax.jit
+    def f(a):
+        return demo_ops.custom_cube.raw(a) + 1.0
+
+    np.testing.assert_allclose(np.asarray(f(x)), np.asarray(x) ** 3 + 1,
+                               rtol=1e-6)
+
+
+def test_ffi_op_vjp_hook(demo_ops, rng):
+    """The registered VJP makes the custom kernel differentiable, through
+    both jax.grad and the framework tape."""
+    x = rng.standard_normal(16).astype(np.float32)
+
+    g = jax.grad(lambda a: demo_ops.custom_cube.raw(a).sum())(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g), 3 * x ** 2, rtol=1e-5)
+
+    t = paddle.to_tensor(x)
+    t.stop_gradient = False
+    demo_ops.custom_cube(t).sum().backward()
+    np.testing.assert_allclose(t.grad.numpy(), 3 * x ** 2, rtol=1e-5)
+
+
+def test_ffi_op_in_registry(demo_ops):
+    assert "custom_axpy" in OP_REGISTRY and "custom_cube" in OP_REGISTRY
+
+
+def test_ffi_build_cache(demo_ops, tmp_path):
+    """Recompiling identical sources hits the srchash cache."""
+    mod = cpp_extension.load(
+        "demo_ops2", [_SRC],
+        functions={"custom_axpy2": {"symbol": "AxpyHandler",
+                                    "attrs": ("scale",)}},
+        build_directory=str(tmp_path))
+    stamp = tmp_path / "demo_ops2.so.srchash"
+    assert stamp.exists()
+    mtime = os.path.getmtime(tmp_path / "demo_ops2.so")
+    cpp_extension.load(
+        "demo_ops2", [_SRC],
+        functions={"custom_axpy2b": {"symbol": "AxpyHandler",
+                                     "attrs": ("scale",)}},
+        build_directory=str(tmp_path))
+    assert os.path.getmtime(tmp_path / "demo_ops2.so") == mtime

@@ -118,6 +118,9 @@ def test_pipeline_single_stage_scan(rng):
           virtual_pp=2), "pp2v2_interleave"),
     (dict(dp=2, pp=2, micro_batches=4, schedule="1f1b", remat=True),
      "pp2_1f1b"),
+    (dict(pp=2, mp=2, micro_batches=4, schedule="zbh1"), "pp2_zbh1"),
+    (dict(dp=2, pp=4, micro_batches=8, schedule="zbh1", remat=True),
+     "pp4_zbh1_remat"),
 ])
 def test_pretrain_hybrid_parity(rng, pcfg_kw, name):
     from paddle_tpu.models.llama import LlamaConfig
@@ -188,3 +191,68 @@ def test_llama_shard_plan(rng):
     ids = paddle.to_tensor(rng.integers(0, 256, (2, 8)))
     logits, loss = m(ids, labels=ids)
     assert np.isfinite(float(loss.item()))
+
+
+def test_zbh1_schedule_structure():
+    """The ZBH1 work table must match the zero-bubble paper's H1 layout
+    (reference pipeline_zero_bubble.py:62): W split from B, deferred by the
+    stage index, filling the slots where plain 1F1B has no weight work."""
+    from paddle_tpu.distributed.pipeline_spmd import (num_pipeline_ticks,
+                                                      zbh1_schedule)
+
+    S, M = 4, 8
+    table = zbh1_schedule(S, M)
+    T = num_pipeline_ticks(M, S, schedule="zbh1")
+    assert T == 2 * S + M - 1
+
+    for s in range(S):
+        units = [u for (ss, t), us in table.items() if ss == s for u in us]
+        for kind in "FBW":
+            got = sorted(m for k, m in units if k == kind)
+            assert got == list(range(M)), f"stage {s} {kind}: {got}"
+        # B(b) runs at b + 2S-1-s; its W(b) runs exactly s ticks later
+        for b in range(M):
+            t_b = b + 2 * S - 1 - s
+            t_w = b + 2 * S - 1
+            assert ("B", b) in table[(s, t_b)]
+            assert ("W", b) in table[(s, t_w)]
+        # stage 0 never defers; the last stage defers W by S-1 ticks
+    # cooldown fill: in the last S-1 ticks every stage still has W work
+    # (the slots 1F1B leaves as pure bubble on non-final stages)
+    for t in range(T - (S - 1), T):
+        for s in range(S):
+            kinds = {k for k, _ in table.get((s, t), set())}
+            assert "W" in kinds, f"no W fill at stage {s} tick {t}"
+
+
+def test_zbh1_grads_match_1f1b(rng):
+    """Same loss AND gradients from the split-backward schedule."""
+    import jax
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.pipeline_spmd import (pipeline_1f1b_grads,
+                                                      pipeline_zbh1_grads)
+
+    S, M, mb, Dm = 4, 6, 2, 8
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("dp", "pp"))
+    w = jnp.asarray(rng.standard_normal((S, Dm, Dm)).astype(np.float32)) * 0.3
+    head = jnp.asarray(rng.standard_normal((Dm,)).astype(np.float32))
+    micro = jnp.asarray(rng.standard_normal((M, mb, Dm)).astype(np.float32))
+    lbls = jnp.asarray(rng.standard_normal((M, mb)).astype(np.float32))
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p)
+
+    def loss_fn(y, lbl, lp):
+        return jnp.sum(jnp.square(y @ lp["head"] - lbl))
+
+    args = (mesh, "pp", stage_fn, loss_fn, w, {"head": head}, micro, lbls)
+    l1, g1, glp1, dm1 = pipeline_1f1b_grads(*args)
+    l2, g2, glp2, dm2 = pipeline_zbh1_grads(*args)
+
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(glp1["head"]),
+                               np.asarray(glp2["head"]), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dm1), np.asarray(dm2),
+                               rtol=1e-4, atol=1e-5)
