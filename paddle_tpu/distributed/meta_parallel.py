@@ -45,9 +45,13 @@ class ShardingParallel(_WrapperBase):
 
 
 class SegmentParallel(_WrapperBase):
-    """reference segment_parallel.py:26 — sequence split over the sep axis;
-    activations are sharded on the sequence dim by the model's sharding
-    constraints (see models.llama sequence sharding)."""
+    """reference segment_parallel.py:26 — sequence split over the sep axis.
+
+    The working sep path is models.pretrain.ParallelConfig(sep=N): the mesh
+    carries a 'sep' axis, activations are sharded P(dp, 'sep', ...) on the
+    sequence dim, and attention reshards seq<->heads around the kernel
+    (Ulysses all-to-all as GSPMD constraints — models/llama.py
+    context_parallel).  This eager wrapper stays an API shim."""
 
 
 class TensorParallel(_WrapperBase):

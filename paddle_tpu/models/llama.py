@@ -155,6 +155,46 @@ def swiglu(gate, up):
     return jax.nn.silu(gate) * up
 
 
+# ---- segment/context parallelism (the reference's SEP axis) ----
+#
+# DeepSpeed-Ulysses expressed as GSPMD resharding: activations live
+# seq-sharded over 'sep'; around attention q/k/v are re-constrained to
+# HEAD-sharded (full sequence locally) and the output back to seq-sharded.
+# GSPMD lowers each constraint switch to the all-to-all the reference's
+# SegmentParallel groups perform explicitly (fleet/meta_parallel/
+# segment_parallel.py:26 + topology 'sep' axis, SURVEY.md §5.7).
+_SEP_MESH = None
+
+
+class context_parallel:
+    """Activate sep-axis attention resharding while tracing a model whose
+    activations are sharded P(dp, 'sep', ...) on the sequence dim."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        global _SEP_MESH
+        self._prev = _SEP_MESH
+        _SEP_MESH = self.mesh
+        return self
+
+    def __exit__(self, *exc):
+        global _SEP_MESH
+        _SEP_MESH = self._prev
+        return False
+
+
+def _sep_constrain(x, spec_entries):
+    """with_sharding_constraint against the active sep mesh (no-op when
+    context parallelism is inactive)."""
+    if _SEP_MESH is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(_SEP_MESH, PartitionSpec(*spec_entries)))
+
+
 class LlamaRMSNorm(Layer):
     """fp32-accumulating RMSNorm (fused_rms_norm slot)."""
 
@@ -198,9 +238,24 @@ class LlamaAttention(Layer):
                     apply_rotary_pos_emb(ka, cos, sin))
 
         q, k = apply_op("fused_rope", rope_prim, (q, k))
+        if _SEP_MESH is not None:
+            # Ulysses switch: seq-sharded -> head-sharded (GSPMD emits the
+            # sep all-to-all); attention then sees the full sequence with
+            # heads/sep per device
+            def to_heads(qa, ka, va):
+                return (_sep_constrain(qa, ("dp", None, "sep", None)),
+                        _sep_constrain(ka, ("dp", None, "sep", None)),
+                        _sep_constrain(va, ("dp", None, "sep", None)))
+
+            q, k, v = apply_op("sep_all2all_qkv", to_heads, (q, k, v))
         # GQA is native in the kernel: grouped K/V go in un-repeated, so
         # K/V residuals and backward bandwidth stay heads/kv_heads smaller
         out = flash_attention(q, k, v, causal=True)
+        if _SEP_MESH is not None:
+            out = apply_op(
+                "sep_all2all_out",
+                lambda oa: _sep_constrain(oa, ("dp", "sep", None, None)),
+                (out,))
         out = out.reshape([b, s, c.num_attention_heads * c.head_dim])
         return self.o_proj(out)
 

@@ -46,6 +46,7 @@ class ParallelConfig:
     pp: int = 1
     mp: int = 1
     ep: int = 1                  # expert parallel (MoE expert-bank sharding)
+    sep: int = 1                 # segment/context parallel (Ulysses seq shard)
     micro_batches: int = 1
     schedule: str = "gpipe"      # gpipe | interleave | 1f1b | zbh1
     virtual_pp: int = 1          # VPP chunks per stage (schedule="interleave")
@@ -64,19 +65,20 @@ class ParallelConfig:
 
     @property
     def n_devices(self):
-        return self.dp * self.pp * self.ep * self.mp
+        return self.dp * self.pp * self.sep * self.ep * self.mp
 
 
 def build_mesh(pc: ParallelConfig, devices=None) -> Mesh:
-    """Hybrid mesh ('dp', 'pp', 'ep', 'mp') — the reference's 5-axis
-    topology (fleet/base/topology.py) as named mesh axes; 'ep' innermost
-    of the coarse axes so expert all-to-all rides the fastest ICI hops."""
+    """Hybrid mesh ('dp', 'pp', 'sep', 'ep', 'mp') — the reference's 5-axis
+    topology (fleet/base/topology.py) as named mesh axes; 'sep'/'ep'
+    inward of dp/pp so their all-to-alls ride the fastest ICI hops."""
     devices = np.asarray(devices if devices is not None else jax.devices())
     n = pc.n_devices
     if devices.size < n:
         raise ValueError(f"need {n} devices, have {devices.size}")
-    return Mesh(devices.ravel()[:n].reshape(pc.dp, pc.pp, pc.ep, pc.mp),
-                ("dp", "pp", "ep", "mp"))
+    return Mesh(
+        devices.ravel()[:n].reshape(pc.dp, pc.pp, pc.sep, pc.ep, pc.mp),
+        ("dp", "pp", "sep", "ep", "mp"))
 
 
 def _block_spec(name: str) -> Tuple[Optional[str], ...]:
@@ -124,6 +126,22 @@ class PretrainStep:
             raise NotImplementedError(
                 "MoE ignores micro_batches (the MoE path runs a plain "
                 "layer scan); set micro_batches=1")
+        if self.pc.sep > 1:
+            if self._moe:
+                raise NotImplementedError(
+                    "sep (context parallel) + MoE is not wired; the MoE "
+                    "scan path does not activate the Ulysses resharding")
+            if self.pc.pp > 1:
+                raise NotImplementedError(
+                    "sep (context parallel) + pipeline parallel is not "
+                    "wired; use pp=1")
+            if config.num_key_value_heads % self.pc.sep or \
+                    config.num_attention_heads % self.pc.sep:
+                raise ValueError(
+                    f"sep ({self.pc.sep}) must divide both attention heads "
+                    f"({config.num_attention_heads}) and kv heads "
+                    f"({config.num_key_value_heads}) for the Ulysses "
+                    "head-sharded attention phase")
         if self.pc.ep > 1:
             if not self._moe:
                 raise ValueError("ep > 1 requires a MoE config "
@@ -283,9 +301,32 @@ class PretrainStep:
             if pc.sequence_parallel and pc.pp == 1:
                 y = jax.lax.with_sharding_constraint(
                     y, NamedSharding(mesh, P("dp", "mp", None)))
+            if pc.sep > 1:
+                # context parallel: activations stay seq-sharded over 'sep'
+                # between blocks (attention internally reshards to heads —
+                # the Ulysses all-to-all pair, models/llama.py)
+                y = jax.lax.with_sharding_constraint(
+                    y, NamedSharding(mesh, P("dp", "sep", None)))
             return y
 
         from ..kernels.rms_norm import rms_norm_fp32
+
+        if pc.sep > 1 and not self._moe:
+            # plain scan with the sep attention context active
+            from .llama import context_parallel
+            if pc.remat:
+                block = jax.checkpoint(block)
+            blocks = {k: v.reshape((c.num_hidden_layers,) + v.shape[2:])
+                      for k, v in params["blocks"].items()}
+            h = jax.lax.with_sharding_constraint(
+                h, NamedSharding(mesh, P("dp", "sep", None)))
+
+            with context_parallel(mesh):
+                def body(carry, lp):
+                    return block(lp, carry), None
+                h, _ = jax.lax.scan(body, h, blocks)
+            h = rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
+            return h, jnp.float32(0.0)
 
         if self._moe:
             # dp x ep x mp: plain scan over layers (pp=1 enforced in init),

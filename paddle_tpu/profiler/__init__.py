@@ -43,8 +43,18 @@ class TracerEventType(Enum):
     UserDefined = 8
 
 
-_HOST_EVENTS = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+# name -> [count, total_s, max_s, min_s, TracerEventType]
+_HOST_EVENTS = defaultdict(lambda: [0, 0.0, 0.0, float("inf"), None])
 _ACTIVE = []
+
+
+class SortedKeys(Enum):
+    """reference profiler_statistic.py SortedKeys."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    Calls = 4
 
 
 class RecordEvent:
@@ -53,6 +63,7 @@ class RecordEvent:
 
     def __init__(self, name: str, event_type=TracerEventType.UserDefined):
         self.name = name
+        self.event_type = event_type
         self._t0 = None
 
     def begin(self):
@@ -60,9 +71,13 @@ class RecordEvent:
 
     def end(self):
         if self._t0 is not None:
+            dt = time.perf_counter() - self._t0
             ev = _HOST_EVENTS[self.name]
             ev[0] += 1
-            ev[1] += time.perf_counter() - self._t0
+            ev[1] += dt
+            ev[2] = max(ev[2], dt)
+            ev[3] = min(ev[3], dt)
+            ev[4] = self.event_type
             self._t0 = None
 
     def __enter__(self):
@@ -146,6 +161,7 @@ class Profiler:
 
     def start(self):
         _HOST_EVENTS.clear()
+        _install_op_hook()
         self._last_step_t = time.perf_counter()
         # with a scheduler, tracing starts/stops around RECORD windows in
         # step(); without one the whole start()-stop() span is traced
@@ -161,6 +177,8 @@ class Profiler:
         self._stop_trace()
         if self in _ACTIVE:
             _ACTIVE.remove(self)
+        if not _ACTIVE:
+            _remove_op_hook()
 
     def step(self, num_samples: Optional[int] = None):
         now = time.perf_counter()
@@ -195,17 +213,62 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        """Host-span summary table (the profiler_statistic.py slot)."""
-        rows = sorted(_HOST_EVENTS.items(), key=lambda kv: -kv[1][1])
-        width = max([len(k) for k, _ in rows] + [16])
-        print(f"{'Name':<{width}} {'Calls':>8} {'Total(ms)':>12} {'Avg(ms)':>12}")
-        print("-" * (width + 36))
-        for name, (count, total) in rows:
-            print(f"{name:<{width}} {count:>8} {total * 1000:>12.3f} "
-                  f"{total * 1000 / max(count, 1):>12.3f}")
+        """Statistic tables (reference profiler_statistic.py): an overview
+        by event type plus per-type breakdowns (Operator table = the
+        framework's per-op dispatch spans, recorded automatically while the
+        profiler is active) with Calls/Total/Avg/Max/Min/Ratio columns.
+        Device-side kernel timings live in the exported XPlane trace.
+
+        Returns {event_type_name: [(name, calls, total_s, avg_s, max_s,
+        min_s), ...]} for programmatic use.
+        """
+        key_idx = {SortedKeys.CPUTotal: lambda r: -r[2],
+                   SortedKeys.CPUAvg: lambda r: -r[3],
+                   SortedKeys.CPUMax: lambda r: -r[4],
+                   SortedKeys.CPUMin: lambda r: r[5],
+                   SortedKeys.Calls: lambda r: -r[1]}
+        sort_key = key_idx.get(sorted_by, lambda r: -r[2])
+
+        by_type = defaultdict(list)
+        grand_total = 0.0
+        for name, (cnt, tot, mx, mn, ttype) in _HOST_EVENTS.items():
+            tname = (ttype or TracerEventType.UserDefined).name
+            by_type[tname].append(
+                (name, cnt, tot, tot / max(cnt, 1), mx,
+                 mn if mn != float("inf") else 0.0))
+            grand_total += tot
+
+        unit = 1000.0 if time_unit == "ms" else 1.0
+
+        # overview table (reference: general summary by event type)
+        print("---------------- Event Summary ----------------")
+        print(f"{'Event Type':<16} {'Calls':>8} {'Total(' + time_unit + ')':>14} "
+              f"{'Ratio (%)':>10}")
+        for tname, rows in sorted(by_type.items(),
+                                  key=lambda kv: -sum(r[2] for r in kv[1])):
+            tot = sum(r[2] for r in rows)
+            calls = sum(r[1] for r in rows)
+            ratio = 100.0 * tot / grand_total if grand_total else 0.0
+            print(f"{tname:<16} {calls:>8} {tot * unit:>14.3f} {ratio:>10.1f}")
+
+        out = {}
+        for tname, rows in by_type.items():
+            rows = sorted(rows, key=sort_key)
+            out[tname] = rows
+            if not op_detail and tname == "Operator":
+                continue
+            width = max([len(r[0]) for r in rows] + [16])
+            print(f"\n---------------- {tname} Summary ----------------")
+            print(f"{'Name':<{width}} {'Calls':>8} {'Total':>12} {'Avg':>10} "
+                  f"{'Max':>10} {'Min':>10} {'Ratio%':>8}")
+            for name, cnt, tot, avg, mx, mn in rows:
+                ratio = 100.0 * tot / grand_total if grand_total else 0.0
+                print(f"{name:<{width}} {cnt:>8} {tot * unit:>12.3f} "
+                      f"{avg * unit:>10.3f} {mx * unit:>10.3f} "
+                      f"{mn * unit:>10.3f} {ratio:>8.1f}")
         if self._trace_dir:
             print(f"\nDevice trace (XPlane/perfetto): {self._trace_dir}")
-        return rows
+        return out
 
     def export(self, path: str, format: str = "json"):
         """Copy the captured trace to ``path`` (call after stop())."""
@@ -237,3 +300,33 @@ def profile(**kwargs):
 
 def load_profiler_result(path: str):
     raise NotImplementedError("load the XPlane trace with tensorboard/xprof")
+
+
+# ---------------------------------------------------------------------------
+# per-op dispatch instrumentation (the reference's api profiler spans inside
+# generated API calls — paddle/phi/api/profiler/)
+# ---------------------------------------------------------------------------
+
+_ORIG_APPLY = None
+
+
+def _install_op_hook():
+    global _ORIG_APPLY
+    if _ORIG_APPLY is not None:
+        return
+    from ..core import autograd as _engine
+    _ORIG_APPLY = _engine.apply
+
+    def profiled_apply(name, prim, tensor_args, kwargs=None):
+        with RecordEvent(name, TracerEventType.Operator):
+            return _ORIG_APPLY(name, prim, tensor_args, kwargs)
+
+    _engine.apply = profiled_apply
+
+
+def _remove_op_hook():
+    global _ORIG_APPLY
+    if _ORIG_APPLY is not None:
+        from ..core import autograd as _engine
+        _engine.apply = _ORIG_APPLY
+        _ORIG_APPLY = None

@@ -1,22 +1,29 @@
-"""paddle.sparse (reference: python/paddle/sparse/ — SparseCooTensor/
-SparseCsrTensor creation + ops; C++ paddle/phi/core/sparse_coo_tensor.h).
+"""paddle.sparse (reference: python/paddle/sparse/ — creation.py
+sparse_coo_tensor/sparse_csr_tensor, unary.py ~25 value ops, binary.py
+matmul/masked_matmul/mv/add..., multiary.py addmm; C++ kernels under
+paddle/phi/kernels/sparse/).
 
-TPU-native engine: jax.experimental.sparse BCOO (XLA-compiled sparse ops).
+TPU-native engine: jax.experimental.sparse BCOO/BCSR payloads.  Value-wise
+unary ops act on the stored values only (every implemented op maps 0 -> 0,
+the COO invariant); matmul/mv lower to XLA's sparse dot; masked products
+compute ONLY the masked positions (O(nnz * k)); elementwise sparse-sparse
+add/subtract concatenate + coalesce indices.  Ops with no sparse-native XLA
+lowering yet (conv3d, pooling) run densify -> dense kernel -> re-sparsify
+and say so in their docstrings — functional parity first, kernels later.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import sparse as jsparse
 
 from ..core.tensor import Tensor
-from ..ops._prim import apply_op
 
 
 class SparseCooTensor:
-    """Sparse COO tensor over a BCOO payload (dense mirror only materialized
-    by to_dense)."""
+    """Sparse COO tensor over a BCOO payload."""
 
     def __init__(self, bcoo, name=None):
         self._bcoo = bcoo
@@ -26,6 +33,10 @@ class SparseCooTensor:
     @property
     def shape(self):
         return list(self._bcoo.shape)
+
+    @property
+    def ndim(self):
+        return len(self._bcoo.shape)
 
     @property
     def dtype(self):
@@ -43,20 +54,77 @@ class SparseCooTensor:
     def to_dense(self) -> Tensor:
         return Tensor(self._bcoo.todense())
 
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+            jsparse.bcoo_sum_duplicates(self._bcoo)))
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(jsparse.bcoo_sum_duplicates(self._bcoo))
+
     def is_sparse_coo(self):
         return True
+
+    def is_sparse_csr(self):
+        return False
 
     def __repr__(self):
         return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
                 f"dtype={self.dtype.name})")
 
 
+class SparseCsrTensor:
+    """Sparse CSR tensor over a BCSR payload (reference
+    paddle/phi/core/sparse_csr_tensor.h surface)."""
+
+    def __init__(self, bcsr, name=None):
+        self._bcsr = bcsr
+        self.name = name or "sparse_csr"
+        self.stop_gradient = True
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._bcsr.dtype)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._bcsr.indices)
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcsr.data)
+
+    def nnz(self) -> int:
+        return int(self._bcsr.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=None) -> SparseCooTensor:
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype.name})")
+
+
+# ---------------------------------------------------------------------------
+# creation (reference creation.py)
+# ---------------------------------------------------------------------------
+
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
                       stop_gradient=True) -> SparseCooTensor:
-    """reference: python/paddle/sparse/creation.py sparse_coo_tensor.
-
-    indices: [ndim, nnz]; values: [nnz, ...].
-    """
+    """indices: [ndim, nnz]; values: [nnz, ...]."""
     idx = np.asarray(indices.numpy() if isinstance(indices, Tensor) else indices)
     val = jnp.asarray(values.numpy() if isinstance(values, Tensor) else values,
                       dtype=dtype)
@@ -66,38 +134,222 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
     return SparseCooTensor(bcoo)
 
 
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCsrTensor:
+    conv = lambda v: np.asarray(v.numpy() if isinstance(v, Tensor) else v)
+    val = jnp.asarray(conv(values), dtype=dtype)
+    bcsr = jsparse.BCSR((val, jnp.asarray(conv(cols)),
+                         jnp.asarray(conv(crows))), shape=tuple(shape))
+    return SparseCsrTensor(bcsr)
+
+
 def to_dense(x):
-    return x.to_dense() if isinstance(x, SparseCooTensor) else x
+    return x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
+        else x
 
 
-def _dense_to_coo(x: Tensor, n_batch=0) -> SparseCooTensor:
-    return SparseCooTensor(jsparse.BCOO.fromdense(x._data, n_batch=n_batch))
+def _dense_to_coo(x, n_batch=0) -> SparseCooTensor:
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return SparseCooTensor(jsparse.BCOO.fromdense(arr, n_batch=n_batch))
 
 
-def matmul(x, y):
-    """sparse @ dense (reference sparse/binary.py matmul)."""
+def _dense_to_csr(x) -> SparseCsrTensor:
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return SparseCsrTensor(jsparse.BCSR.fromdense(arr))
+
+
+# ---------------------------------------------------------------------------
+# unary value ops (reference unary.py) — all implemented maps keep f(0) = 0
+# ---------------------------------------------------------------------------
+
+def _unary(fn):
+    def op(x, name=None):
+        # coalesce first: nonlinear f must see the SUMMED value at
+        # duplicate indices (f(a+b), not f(a)+f(b))
+        if isinstance(x, SparseCsrTensor):
+            b = jsparse.bcoo_sum_duplicates(x._bcsr.to_bcoo())
+            return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+                jsparse.BCOO((fn(b.data), b.indices), shape=b.shape)))
+        b = jsparse.bcoo_sum_duplicates(x._bcoo)
+        return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices),
+                                            shape=b.shape))
+    return op
+
+
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+abs = _unary(jnp.abs)  # noqa: A001
+neg = _unary(jnp.negative)
+expm1 = _unary(jnp.expm1)
+rad2deg = _unary(jnp.rad2deg)
+deg2rad = _unary(jnp.deg2rad)
+isnan = _unary(jnp.isnan)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    out = _unary(lambda v: v.astype(value_dtype) if value_dtype else v)(x)
+    if index_dtype is not None:
+        if isinstance(out, SparseCsrTensor):
+            b = out._bcsr
+            out = SparseCsrTensor(jsparse.BCSR(
+                (b.data, b.indices.astype(index_dtype),
+                 b.indptr.astype(index_dtype)), shape=b.shape))
+        else:
+            b = out._bcoo
+            out = SparseCooTensor(jsparse.BCOO(
+                (b.data, b.indices.astype(index_dtype)), shape=b.shape))
+    return out
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def transpose(x, perm, name=None):
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+            jsparse.bcoo_transpose(x._bcsr.to_bcoo(),
+                                   permutation=tuple(perm))))
+    return SparseCooTensor(
+        jsparse.bcoo_transpose(x._bcoo, permutation=tuple(perm)))
+
+
+def reshape(x, shape, name=None):
+    out = jsparse.bcoo_reshape(
+        x._bcoo if isinstance(x, SparseCooTensor) else x._bcsr.to_bcoo(),
+        new_sizes=tuple(shape))
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+            jsparse.bcoo_sum_duplicates(out)))
+    return SparseCooTensor(out)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    b = x._bcoo if isinstance(x, SparseCooTensor) else x._bcsr.to_bcoo()
+    dense = b.todense().sum(axis=axis, keepdims=keepdim)
+    if dtype:
+        dense = dense.astype(dtype)
+    return Tensor(dense)
+
+
+# ---------------------------------------------------------------------------
+# binary (reference binary.py)
+# ---------------------------------------------------------------------------
+
+def _as_bcoo(x):
     if isinstance(x, SparseCooTensor):
-        yb = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x._bcsr.to_bcoo()
+    raise TypeError(f"expected a sparse tensor, got {type(x).__name__}")
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (reference sparse/binary.py matmul; csr and coo)."""
+    yb = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    if isinstance(x, SparseCsrTensor):
+        return Tensor(x._bcsr @ yb)
+    if isinstance(x, SparseCooTensor):
         return Tensor(x._bcoo @ yb)
-    raise TypeError("sparse.matmul expects a SparseCooTensor lhs")
+    raise TypeError("sparse.matmul expects a sparse lhs")
 
 
-def add(x, y):
-    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-        return SparseCooTensor(jsparse.bcoo_add_(x._bcoo, y._bcoo)
-                               if hasattr(jsparse, "bcoo_add_")
-                               else jsparse.BCOO.fromdense(
-                                   x._bcoo.todense() + y._bcoo.todense()))
-    raise TypeError("sparse.add expects SparseCooTensors")
+def mv(x, vec, name=None):
+    return matmul(x, vec)
 
 
-def relu(x: SparseCooTensor) -> SparseCooTensor:
-    import jax
-    b = x._bcoo
-    return SparseCooTensor(jsparse.BCOO((jax.nn.relu(b.data), b.indices),
-                                        shape=b.shape))
+def masked_matmul(x, y, mask, name=None):
+    """(x @ y) evaluated ONLY at mask's nonzero positions (reference
+    binary.py masked_matmul — the SDDMM kernel).  O(nnz * k) compute:
+    gathers the needed rows/cols, never the dense product."""
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    ya = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    csr_out = isinstance(mask, SparseCsrTensor)
+    b = jsparse.bcoo_sum_duplicates(_as_bcoo(mask))
+    rows, cols = b.indices[:, 0], b.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xa[rows], ya.T[cols])
+    out = jsparse.BCOO((vals.astype(xa.dtype), b.indices), shape=b.shape)
+    return SparseCsrTensor(jsparse.BCSR.from_bcoo(out)) if csr_out \
+        else SparseCooTensor(out)
 
 
-# API-parity namespaces
-class nn:
-    pass
+def add(x, y, name=None):
+    """sparse + sparse: concatenate indices and coalesce (pure COO math)."""
+    if list(x.shape) != list(y.shape):
+        raise ValueError(f"sparse.add shape mismatch: {x.shape} vs {y.shape}")
+    bx, by = _as_bcoo(x), _as_bcoo(y)
+    merged = jsparse.BCOO(
+        (jnp.concatenate([bx.data, by.data]),
+         jnp.concatenate([bx.indices, by.indices])), shape=tuple(bx.shape))
+    out = jsparse.bcoo_sum_duplicates(merged)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(out))
+    return SparseCooTensor(out)
+
+
+def subtract(x, y, name=None):
+    return add(x, _unary(jnp.negative)(y))
+
+
+def multiply(x, y, name=None):
+    """Elementwise sparse * sparse.  Densify -> multiply -> re-sparsify
+    (no intersection kernel yet; the result's sparsity is the overlap)."""
+    bx, by = _as_bcoo(x), _as_bcoo(y)
+    out = jsparse.BCOO.fromdense(bx.todense() * by.todense())
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(out))
+    return SparseCooTensor(out)
+
+
+def divide(x, y, name=None):
+    """x / y over x's stored positions (dense semantics there: a stored
+    value over an implicit zero IS inf/nan, not silently dropped)."""
+    if list(x.shape) != list(y.shape):
+        raise ValueError(
+            f"sparse.divide shape mismatch: {x.shape} vs {y.shape}")
+    bx = jsparse.bcoo_sum_duplicates(_as_bcoo(x))
+    y_dense = _as_bcoo(y).todense()
+    denom = y_dense[tuple(bx.indices[:, i]
+                          for i in range(bx.indices.shape[1]))]
+    out = jsparse.BCOO((bx.data / denom, bx.indices), shape=tuple(bx.shape))
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(out))
+    return SparseCooTensor(out)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def mask_as(x, mask, name=None):
+    """Keep x's values at mask's sparsity pattern (reference mask_as)."""
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    csr_out = isinstance(mask, SparseCsrTensor)
+    b = jsparse.bcoo_sum_duplicates(_as_bcoo(mask))
+    vals = xa[tuple(b.indices[:, i] for i in range(b.indices.shape[1]))]
+    out = jsparse.BCOO((vals, b.indices), shape=tuple(b.shape))
+    return SparseCsrTensor(jsparse.BCSR.from_bcoo(out)) if csr_out \
+        else SparseCooTensor(out)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """beta * input + alpha * (x @ y) (reference multiary.py addmm)."""
+    prod = matmul(x, y)
+    inp = input._data if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor(beta * inp + alpha * prod._data)
+
+
+from . import nn  # noqa: E402,F401

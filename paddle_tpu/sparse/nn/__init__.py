@@ -1,0 +1,144 @@
+"""paddle.sparse.nn (reference: python/paddle/sparse/nn/layer/)."""
+
+from __future__ import annotations
+
+from ...nn.layer import Layer
+from ...nn import initializer as I
+from . import functional  # noqa: F401
+from . import functional as F
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)
+
+
+class _SparseConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False, dims=3,
+                 bias_attr=None):
+        super().__init__()
+        ks = [kernel_size] * dims if isinstance(kernel_size, int) \
+            else list(kernel_size)
+        self.weight = self.create_parameter(
+            ks + [in_channels // groups, out_channels],
+            default_initializer=I.XavierNormal())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], is_bias=True)
+        self._cfg = (stride, padding, dilation, groups)
+        self._subm = subm
+        self._dims = dims
+
+    def forward(self, x):
+        stride, padding, dilation, groups = self._cfg
+        if self._dims == 3:
+            fn = F.subm_conv3d if self._subm else F.conv3d
+        else:
+            fn = F.conv2d
+        return fn(x, self.weight, self.bias, stride, padding, dilation,
+                  groups)
+
+
+class Conv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(in_channels, out_channels, kernel_size, subm=False,
+                         dims=3, **kw)
+
+
+class SubmConv3D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(in_channels, out_channels, kernel_size, subm=True,
+                         dims=3, **kw)
+
+
+class Conv2D(_SparseConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(in_channels, out_channels, kernel_size, subm=False,
+                         dims=2, **kw)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self._cfg = (kernel_size, stride, padding)
+
+    def forward(self, x):
+        return F.max_pool3d(x, *self._cfg)
+
+
+class BatchNorm(Layer):
+    """Sparse batch norm: normalize the stored values per channel
+    (reference sparse/nn/layer/norm.py BatchNorm — stats over nnz only)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 data_format="NDHWC"):
+        super().__init__()
+        import jax.numpy as jnp
+        from ...core.tensor import Tensor as T
+        self._momentum, self._eps = momentum, epsilon
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter([num_features], is_bias=True)
+        self.register_buffer("_mean", T(jnp.zeros(num_features)))
+        self.register_buffer("_variance", T(jnp.ones(num_features)))
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+        from .. import SparseCooTensor
+        b = jsparse.bcoo_sum_duplicates(x._bcoo)
+        vals = b.data.astype(jnp.float32)
+        C = self.weight.shape[0]
+        if vals.ndim == 2:                     # dense trailing channel dim
+            ch = None
+            if self.training:
+                mean, var = vals.mean(axis=0), vals.var(axis=0)
+            else:
+                mean, var = self._mean._data, self._variance._data
+            out = (vals - mean) * jax.lax.rsqrt(var + self._eps) * \
+                self.weight._data + self.bias._data
+        else:                                  # channel is a sparse coord
+            ch = b.indices[:, -1]
+            if self.training:
+                cnt = jnp.maximum(
+                    jax.ops.segment_sum(jnp.ones_like(vals), ch,
+                                        num_segments=C), 1.0)
+                mean = jax.ops.segment_sum(vals, ch, num_segments=C) / cnt
+                var = jax.ops.segment_sum(jnp.square(vals), ch,
+                                          num_segments=C) / cnt - \
+                    jnp.square(mean)
+            else:
+                mean, var = self._mean._data, self._variance._data
+            out = (vals - mean[ch]) * jax.lax.rsqrt(var[ch] + self._eps) * \
+                self.weight._data[ch] + self.bias._data[ch]
+        if self.training:
+            self._mean._data = self._momentum * self._mean._data + \
+                (1 - self._momentum) * mean
+            self._variance._data = self._momentum * self._variance._data + \
+                (1 - self._momentum) * var
+        return SparseCooTensor(jsparse.BCOO((out.astype(b.data.dtype),
+                                             b.indices), shape=b.shape))

@@ -1,0 +1,167 @@
+"""paddle.sparse.nn.functional (reference: python/paddle/sparse/nn/
+functional/ — activation.py relu/relu6/leaky_relu/softmax, conv.py
+conv2d/conv3d/subm_conv*, pooling.py max_pool3d, transformer.py attention).
+
+Value-wise activations run on stored values (f(0)=0 preserved).  Sparse
+softmax is a per-row segment softmax over the stored values only — the
+reference's semantics ("softmax over the non-zero entries of each row").
+Sparse attention = SDDMM (masked_matmul) + sparse softmax + spmm, each
+O(nnz).  Convolutions and pooling run densify -> XLA conv -> re-sparsify
+(functional parity; the reference's gather-scatter conv kernels are a
+perf follow-up), with subm_* variants re-masking to the input sparsity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ...core.tensor import Tensor
+from jax.experimental import sparse as jsparse  # noqa: F811
+from .. import (SparseCooTensor, SparseCsrTensor, _as_bcoo, _dense_to_coo,
+                _unary, mask_as, masked_matmul)
+
+relu = _unary(jax.nn.relu)
+relu6 = _unary(lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary(lambda v: jnp.where(v >= 0, v, negative_slope * v))(x)
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over stored values (reference sparse softmax:
+    only the nnz entries participate; zeros stay zero)."""
+    if axis not in (-1, len(x.shape) - 1):
+        raise ValueError("sparse softmax supports the last axis only")
+    csr_out = isinstance(x, SparseCsrTensor)
+    b = jsparse.bcoo_sum_duplicates(_as_bcoo(x))
+    if len(b.shape) != 2:
+        raise ValueError("sparse softmax expects a 2-D sparse matrix")
+    rows = b.indices[:, 0]
+    n_rows = b.shape[0]
+    vals = b.data.astype(jnp.float32)
+    row_max = jax.ops.segment_max(vals, rows, num_segments=n_rows)
+    row_max = jnp.where(jnp.isfinite(row_max), row_max, 0.0)
+    e = jnp.exp(vals - row_max[rows])
+    denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+    out = jsparse.BCOO(((e / denom[rows]).astype(b.data.dtype), b.indices),
+                       shape=b.shape)
+    return SparseCsrTensor(jsparse.BCSR.from_bcoo(out)) if csr_out \
+        else SparseCooTensor(out)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-pattern attention (reference transformer.py attention over a
+    CSR mask): scores only at mask positions (SDDMM), sparse softmax,
+    then sparse @ V.  query/key/value: [seq, dim] dense per head.
+    key_padding_mask: [seq_k] (0 = masked key); attn_mask: [seq_q, seq_k]
+    additive or 0/1 — both applied to the masked scores before softmax."""
+    import math
+    q = query._data if isinstance(query, Tensor) else jnp.asarray(query)
+    k = key._data if isinstance(key, Tensor) else jnp.asarray(key)
+    v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = masked_matmul(Tensor(q * scale), Tensor(k.T), sparse_mask)
+    b = _as_bcoo(scores)
+    rows, cols = b.indices[:, 0], b.indices[:, 1]
+    vals = b.data
+    if key_padding_mask is not None:
+        kpm = key_padding_mask._data if isinstance(key_padding_mask, Tensor)             else jnp.asarray(key_padding_mask)
+        vals = jnp.where(kpm[cols] != 0, vals, -1e30)
+    if attn_mask is not None:
+        am = attn_mask._data if isinstance(attn_mask, Tensor)             else jnp.asarray(attn_mask)
+        entries = am[rows, cols]
+        if am.dtype == jnp.bool_ or bool(
+                jnp.all((entries == 0) | (entries == 1))):
+            vals = jnp.where(entries != 0, vals, -1e30)
+        else:
+            vals = vals + entries
+    scores = SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))
+    probs = softmax(scores)
+    from .. import matmul as sp_matmul
+    return sp_matmul(probs, Tensor(v))
+
+
+def _dense_conv(x, weight, bias, stride, padding, dilation, groups, dims):
+    lhs = x[None] if x.ndim == dims + 1 else x
+    # NDHWC input, DHWIO weight (paddle sparse conv layout)
+    dn = jax.lax.conv_dimension_numbers(
+        lhs.shape, weight.shape,
+        ("NDHWC", "DHWIO", "NDHWC") if dims == 3 else
+        ("NHWC", "HWIO", "NHWC"))
+    pad = [(p, p) for p in ([padding] * dims if isinstance(padding, int)
+                            else list(padding))]
+    strides = [stride] * dims if isinstance(stride, int) else list(stride)
+    rhs_dil = [dilation] * dims if isinstance(dilation, int) \
+        else list(dilation)
+    out = jax.lax.conv_general_dilated(
+        lhs.astype(jnp.float32), weight.astype(jnp.float32), strides, pad,
+        rhs_dilation=rhs_dil, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse 3-D conv (reference conv.py conv3d).  Densify -> XLA conv ->
+    re-sparsify; x: SparseCooTensor [N, D, H, W, C] (or unbatched
+    [D, H, W, C] — rank preserved), weight dense [kD, kH, kW, Cin, Cout]."""
+    xd = x.to_dense()._data
+    w = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    b = bias._data if isinstance(bias, Tensor) else bias
+    out = _dense_conv(xd, w, b, stride, padding, dilation, groups, 3)
+    if xd.ndim == 4:                       # drop the batch dim we added
+        out = out[0]
+    return _dense_to_coo(out.astype(xd.dtype))
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold conv: the output's ACTIVE SITES are exactly the input's
+    occupied spatial locations (reference subm_conv3d semantics) — the
+    active set never dilates, whatever the kernel support."""
+    dense_out = conv3d(x, weight, bias, stride, padding, dilation, groups,
+                       data_format).to_dense()._data
+    if list(dense_out.shape[:-1]) != list(x.shape)[:-1]:
+        raise ValueError(
+            "subm_conv3d requires spatially-same output (stride 1, "
+            "same padding)")
+    mask_b = jsparse.bcoo_sum_duplicates(_as_bcoo(x))
+    spatial = mask_b.indices[:, :-1]        # drop the channel coordinate
+    occ = jnp.zeros(dense_out.shape[:-1], dense_out.dtype)
+    occ = occ.at[tuple(spatial[:, i] for i in range(spatial.shape[1]))].set(
+        1.0)
+    return _dense_to_coo(dense_out * occ[..., None])
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    xd = x.to_dense()._data
+    w = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    b = bias._data if isinstance(bias, Tensor) else bias
+    out = _dense_conv(xd, w, b, stride, padding, dilation, groups, 2)
+    if xd.ndim == 3:                       # drop the batch dim we added
+        out = out[0]
+    return _dense_to_coo(out.astype(xd.dtype))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Sparse max pool (reference pooling.py): densify -> reduce_window."""
+    xd = x.to_dense()._data
+    ks = [kernel_size] * 3 if isinstance(kernel_size, int) \
+        else list(kernel_size)
+    st = ks if stride is None else (
+        [stride] * 3 if isinstance(stride, int) else list(stride))
+    pad = [padding] * 3 if isinstance(padding, int) else list(padding)
+    window = (1, *ks, 1)
+    strides = (1, *st, 1)
+    pads = ((0, 0), *[(p, p) for p in pad], (0, 0))
+    out = jax.lax.reduce_window(xd, -jnp.inf, jax.lax.max, window, strides,
+                                pads)
+    return _dense_to_coo(out.astype(xd.dtype))

@@ -119,6 +119,8 @@ def test_pipeline_single_stage_scan(rng):
     (dict(dp=2, pp=2, micro_batches=4, schedule="1f1b", remat=True),
      "pp2_1f1b"),
     (dict(pp=2, mp=2, micro_batches=4, schedule="zbh1"), "pp2_zbh1"),
+    (dict(dp=2, sep=2, mp=2), "dp2_sep2_mp2_ulysses"),
+    (dict(sep=2, mp=2, remat=True), "sep2_mp2_remat"),
     (dict(dp=2, pp=4, micro_batches=8, schedule="zbh1", remat=True),
      "pp4_zbh1_remat"),
 ])

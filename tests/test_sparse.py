@@ -1,0 +1,269 @@
+"""Sparse COO/CSR op set tests (VERDICT r2 missing #7; reference
+python/paddle/sparse/ surface, kernels paddle/phi/kernels/sparse/).
+Oracles are the dense computations on .to_dense()."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sp
+
+
+def _random_coo(rng, shape=(6, 8), nnz=10, seed_vals=None):
+    idx = np.stack([rng.integers(0, s, nnz) for s in shape])
+    vals = (seed_vals if seed_vals is not None
+            else rng.standard_normal(nnz).astype(np.float32))
+    return sp.sparse_coo_tensor(idx, vals, shape=shape), idx, vals
+
+
+def test_coo_creation_accessors(rng):
+    t, idx, vals = _random_coo(rng)
+    assert t.is_sparse_coo() and not t.is_sparse_csr()
+    assert t.shape == [6, 8] and t.nnz() == 10
+    dense = t.to_dense().numpy()
+    expect = np.zeros((6, 8), np.float32)
+    for (i, j), v in zip(idx.T, vals):
+        expect[i, j] += v
+    np.testing.assert_allclose(dense, expect, rtol=1e-6)
+
+
+def test_csr_creation_roundtrip(rng):
+    crows = np.array([0, 2, 3, 5])
+    cols = np.array([1, 3, 2, 0, 3])
+    vals = rng.standard_normal(5).astype(np.float32)
+    t = sp.sparse_csr_tensor(crows, cols, vals, shape=(3, 4))
+    assert t.is_sparse_csr() and t.nnz() == 5
+    np.testing.assert_array_equal(t.crows().numpy(), crows)
+    coo = t.to_sparse_coo()
+    np.testing.assert_allclose(coo.to_dense().numpy(), t.to_dense().numpy())
+    back = coo.to_sparse_csr()
+    np.testing.assert_allclose(back.to_dense().numpy(), t.to_dense().numpy())
+
+
+@pytest.mark.parametrize("op,npf", [
+    ("sin", np.sin), ("tanh", np.tanh), ("sqrt", lambda v: np.sqrt(np.abs(v))),
+    ("square", np.square), ("abs", np.abs), ("neg", np.negative),
+    ("expm1", np.expm1), ("log1p", lambda v: np.log1p(np.abs(v))),
+])
+def test_unary_value_ops(rng, op, npf):
+    nnz = 8
+    vals = np.abs(rng.standard_normal(nnz)).astype(np.float32) \
+        if op in ("sqrt", "log1p") else rng.standard_normal(nnz).astype(np.float32)
+    t, idx, _ = _random_coo(rng, nnz=nnz, seed_vals=vals)
+    out = getattr(sp, op)(t)
+    np.testing.assert_allclose(np.sort(out.values().numpy()),
+                               np.sort(npf(vals)), rtol=1e-5, atol=1e-6)
+    # f(0) = 0: dense parity everywhere
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               npf(t.to_dense().numpy()), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_matmul_coo_csr(rng):
+    t, _, _ = _random_coo(rng, shape=(5, 7), nnz=12)
+    d = rng.standard_normal((7, 3)).astype(np.float32)
+    out = sp.matmul(t, paddle.to_tensor(d))
+    np.testing.assert_allclose(out.numpy(), t.to_dense().numpy() @ d,
+                               rtol=1e-5)
+    csr = t.to_sparse_csr()
+    out2 = sp.matmul(csr, paddle.to_tensor(d))
+    np.testing.assert_allclose(out2.numpy(), t.to_dense().numpy() @ d,
+                               rtol=1e-5)
+    v = rng.standard_normal(7).astype(np.float32)
+    np.testing.assert_allclose(sp.mv(t, paddle.to_tensor(v)).numpy(),
+                               t.to_dense().numpy() @ v, rtol=1e-5)
+
+
+def test_masked_matmul_sddmm(rng):
+    x = rng.standard_normal((5, 6)).astype(np.float32)
+    y = rng.standard_normal((6, 4)).astype(np.float32)
+    mask, idx, _ = _random_coo(rng, shape=(5, 4), nnz=7)
+    out = sp.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+    dense = out.to_dense().numpy()
+    full = x @ y
+    mask_dense = (mask.to_dense().numpy() != 0)
+    np.testing.assert_allclose(dense[mask_dense], full[mask_dense],
+                               rtol=1e-5)
+    assert np.all(dense[~mask_dense] == 0)
+
+
+def test_add_subtract_coalesce(rng):
+    a, _, _ = _random_coo(rng, nnz=6)
+    b, _, _ = _random_coo(rng, nnz=9)
+    np.testing.assert_allclose(
+        sp.add(a, b).to_dense().numpy(),
+        a.to_dense().numpy() + b.to_dense().numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        sp.subtract(a, b).to_dense().numpy(),
+        a.to_dense().numpy() - b.to_dense().numpy(), rtol=1e-6)
+
+
+def test_multiply_divide(rng):
+    a, _, _ = _random_coo(rng, nnz=6)
+    b, _, _ = _random_coo(rng, nnz=9)
+    np.testing.assert_allclose(
+        sp.multiply(a, b).to_dense().numpy(),
+        a.to_dense().numpy() * b.to_dense().numpy(), rtol=1e-6)
+
+
+def test_transpose_reshape_sum(rng):
+    t, _, _ = _random_coo(rng, shape=(4, 6), nnz=8)
+    tt = sp.transpose(t, [1, 0])
+    np.testing.assert_allclose(tt.to_dense().numpy(),
+                               t.to_dense().numpy().T, rtol=1e-6)
+    rs = sp.reshape(t, [6, 4])
+    np.testing.assert_allclose(rs.to_dense().numpy(),
+                               t.to_dense().numpy().reshape(6, 4), rtol=1e-6)
+    np.testing.assert_allclose(sp.sum(t).numpy(),
+                               t.to_dense().numpy().sum(), rtol=1e-5)
+    np.testing.assert_allclose(sp.sum(t, axis=1).numpy(),
+                               t.to_dense().numpy().sum(1), rtol=1e-5)
+
+
+def test_mask_as_and_addmm(rng):
+    x = rng.standard_normal((4, 5)).astype(np.float32)
+    mask, _, _ = _random_coo(rng, shape=(4, 5), nnz=6)
+    m = sp.mask_as(paddle.to_tensor(x), mask)
+    md = m.to_dense().numpy()
+    keep = mask.to_dense().numpy() != 0
+    np.testing.assert_allclose(md[keep], x[keep], rtol=1e-6)
+    assert np.all(md[~keep] == 0)
+
+    inp = rng.standard_normal((4, 3)).astype(np.float32)
+    d = rng.standard_normal((5, 3)).astype(np.float32)
+    spm, _, _ = _random_coo(rng, shape=(4, 5), nnz=7)
+    out = sp.addmm(paddle.to_tensor(inp), spm, paddle.to_tensor(d),
+                   beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(
+        out.numpy(), 0.5 * inp + 2.0 * (spm.to_dense().numpy() @ d),
+        rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# sparse.nn
+# ---------------------------------------------------------------------------
+
+def test_sparse_relu_softmax(rng):
+    t, _, vals = _random_coo(rng, shape=(4, 6), nnz=8)
+    r = sp.nn.functional.relu(t)
+    np.testing.assert_allclose(r.to_dense().numpy(),
+                               np.maximum(t.to_dense().numpy(), 0), rtol=1e-6)
+
+    s = sp.nn.functional.softmax(t.coalesce())
+    sd = s.to_dense().numpy()
+    td = t.to_dense().numpy()
+    for i in range(4):
+        nz = td[i] != 0
+        if nz.sum() == 0:
+            continue
+        e = np.exp(td[i][nz] - td[i][nz].max())
+        np.testing.assert_allclose(np.sort(sd[i][nz]), np.sort(e / e.sum()),
+                                   rtol=1e-5)
+
+
+def test_sparse_attention(rng):
+    S, D = 6, 4
+    q = rng.standard_normal((S, D)).astype(np.float32)
+    k = rng.standard_normal((S, D)).astype(np.float32)
+    v = rng.standard_normal((S, D)).astype(np.float32)
+    # full mask -> must equal dense softmax attention
+    idx = np.stack(np.meshgrid(np.arange(S), np.arange(S),
+                               indexing="ij")).reshape(2, -1)
+    mask = sp.sparse_coo_tensor(idx, np.ones(S * S, np.float32),
+                                shape=(S, S))
+    out = sp.nn.functional.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                     paddle.to_tensor(v), mask)
+    scores = q @ k.T / np.sqrt(D)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), p @ v, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_conv3d_and_subm(rng):
+    paddle.seed(0)
+    x = np.zeros((1, 4, 4, 4, 2), np.float32)
+    pts = rng.integers(0, 4, (5, 3))
+    for p in pts:
+        x[0, p[0], p[1], p[2]] = rng.standard_normal(2)
+    xs = sp._dense_to_coo(paddle.to_tensor(x))
+
+    conv = sp.nn.Conv3D(2, 3, kernel_size=3, padding=1)
+    out = conv(xs)
+    assert out.shape == [1, 4, 4, 4, 3]
+
+    subm = sp.nn.SubmConv3D(1, 3, kernel_size=3, padding=1)
+    # channel-count change: compare sparsity PATTERN on the spatial dims
+    out2 = subm(sp._dense_to_coo(paddle.to_tensor(
+        np.broadcast_to(x[..., :1], x[..., :1].shape).copy())))
+    od = out2.to_dense().numpy()
+    occupied = np.abs(x[..., :1]).sum(-1) != 0
+    assert np.all(np.abs(od).sum(-1)[~occupied] == 0), \
+        "submanifold conv must not grow the active set"
+
+
+def test_sparse_maxpool_batchnorm(rng):
+    x = rng.standard_normal((1, 4, 4, 4, 3)).astype(np.float32)
+    x[np.abs(x) < 0.8] = 0.0
+    xs = sp._dense_to_coo(paddle.to_tensor(x))
+    out = sp.nn.functional.max_pool3d(xs, kernel_size=2, stride=2)
+    expect = x.reshape(1, 2, 2, 2, 2, 2, 2, 3).max(axis=(2, 4, 6))
+    np.testing.assert_allclose(out.to_dense().numpy(), expect, rtol=1e-6)
+
+    bn = sp.nn.BatchNorm(3)
+    bn.train()
+    y = bn(xs.coalesce())
+    vals = y.values().numpy()
+    assert np.isfinite(vals).all()
+    np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-4)
+
+def test_divide_preserves_inf_semantics(rng):
+    """x / y over x's support: stored-over-implicit-zero is inf, not 0."""
+    x = sp.sparse_coo_tensor(np.array([[0, 1], [0, 1]]),
+                             np.array([5.0, 4.0], np.float32), shape=(2, 2))
+    y = sp.sparse_coo_tensor(np.array([[1], [1]]),
+                             np.array([2.0], np.float32), shape=(2, 2))
+    out = sp.divide(x, y)
+    vals = dict(zip(map(tuple, np.asarray(out._bcoo.indices)),
+                    np.asarray(out._bcoo.data)))
+    assert np.isinf(vals[(0, 0)])          # 5 / 0
+    np.testing.assert_allclose(vals[(1, 1)], 2.0)
+    with pytest.raises(ValueError):
+        sp.add(x, sp.sparse_coo_tensor(np.array([[0], [0]]),
+                                       np.array([1.0], np.float32),
+                                       shape=(3, 3)))
+
+
+def test_unary_coalesces_duplicates():
+    t = sp.sparse_coo_tensor(np.array([[0, 0], [0, 0]]),
+                             np.array([1.0, 1.0], np.float32), shape=(2, 2))
+    out = sp.square(t)
+    np.testing.assert_allclose(out.to_dense().numpy()[0, 0], 4.0)  # (1+1)^2
+
+
+def test_attention_masks_applied(rng):
+    S, D = 4, 8
+    q = rng.standard_normal((S, D)).astype(np.float32)
+    k = rng.standard_normal((S, D)).astype(np.float32)
+    v = rng.standard_normal((S, D)).astype(np.float32)
+    idx = np.stack(np.meshgrid(np.arange(S), np.arange(S),
+                               indexing="ij")).reshape(2, -1)
+    mask = sp.sparse_coo_tensor(idx, np.ones(S * S, np.float32), shape=(S, S))
+    kpm = np.array([1, 1, 1, 0], np.float32)      # key 3 masked
+    out = sp.nn.functional.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                     paddle.to_tensor(v), mask,
+                                     key_padding_mask=paddle.to_tensor(kpm))
+    scores = q @ k.T / np.sqrt(D)
+    scores[:, 3] = -1e30
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), p @ v, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_conv_unbatched_rank_preserved(rng):
+    paddle.seed(0)
+    x = np.zeros((4, 4, 4, 2), np.float32)
+    x[1, 2, 3] = [1.0, -1.0]
+    xs = sp._dense_to_coo(paddle.to_tensor(x))
+    conv = sp.nn.Conv3D(2, 3, kernel_size=3, padding=1)
+    out = conv(xs)
+    assert out.shape == [4, 4, 4, 3]
