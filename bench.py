@@ -257,6 +257,49 @@ def _run_gpt2_compiled_vs_eager(on_tpu):
     }
 
 
+def _run_dit(on_tpu):
+    """BASELINE.md config 4: DiT diffusion training imgs/sec + MFU
+    (target: functional + profiled)."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.models.dit import DiTConfig, DiTTrainStep
+
+    if on_tpu:
+        # DiT-L/2 on 32x32x4 latents (the SD-latent geometry), bf16
+        cfg = DiTConfig.dit_l_2(dtype="bfloat16")
+        batch, steps = 64, 8
+    else:
+        cfg = DiTConfig.tiny()
+        batch, steps = 4, 2
+
+    step = DiTTrainStep(cfg, dp=1, mp=1, remat=on_tpu)
+    state = step.init_state(seed=0)
+    rng = np.random.default_rng(0)
+    x0 = rng.standard_normal(
+        (batch, cfg.in_channels, cfg.input_size, cfg.input_size)).astype(
+        "bfloat16" if on_tpu else "float32")
+    t = rng.integers(0, step.diffusion.num_timesteps, (batch,)).astype("int32")
+    y = rng.integers(0, cfg.num_classes, (batch,)).astype("int32")
+    noise = rng.standard_normal(x0.shape).astype(x0.dtype)
+    args = step.shard_batch(x0, t, y, noise)
+    state, loss = step.train_step(state, *args)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step.train_step(state, *args)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    imgs_per_sec = batch * steps / dt
+    peak = _peak_flops(jax.devices()[0])
+    return {
+        "dit_imgs_per_sec": round(imgs_per_sec, 1),
+        "dit_mfu": round(imgs_per_sec * step.flops_per_image() / peak, 4),
+        "dit_params": cfg.num_params(),
+        "dit_loss": round(float(loss), 4),
+    }
+
+
 def main():
     import jax
 
@@ -289,6 +332,11 @@ def main():
                 result.update(_run_gpt2_compiled_vs_eager(on_tpu))
             except Exception as e:
                 result["gpt2_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+                traceback.print_exc(file=sys.stderr)
+            try:
+                result.update(_run_dit(on_tpu))
+            except Exception as e:
+                result["dit_error"] = f"{type(e).__name__}: {str(e)[:150]}"
                 traceback.print_exc(file=sys.stderr)
             print(json.dumps(result))
             return 0

@@ -253,3 +253,609 @@ def kl_divergence(p: Distribution, q: Distribution):
         return p.kl_divergence(q)
     raise NotImplementedError(
         f"kl_divergence not registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+# ================= widened distribution families =================
+# (reference: python/paddle/distribution/{gamma,laplace,gumbel,geometric,
+#  cauchy,chi2,lognormal,multinomial,multivariate_normal,poisson,student_t,
+#  binomial,continuous_bernoulli,exponential_family,independent,
+#  lkj_cholesky}.py — behavior surface, TPU-native math)
+
+class ExponentialFamily(Distribution):
+    """Marker base for natural-exponential-family members (reference
+    exponential_family.py); entropy via Bregman identity is overridden
+    per-family here since each closed form is known."""
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.concentration / self.rate,
+                                       self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.concentration / self.rate ** 2,
+                                       self.batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        g = jax.random.gamma(next_key(), jnp.broadcast_to(
+            self.concentration, shape), shape)
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - gammaln(a))
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a, b = self.concentration, self.rate
+        out = a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a)
+        return Tensor(jnp.broadcast_to(out, self.batch_shape))
+
+    def kl_divergence(self, other: "Gamma"):
+        from jax.scipy.special import digamma, gammaln
+        a1, b1, a2, b2 = (self.concentration, self.rate,
+                          other.concentration, other.rate)
+        out = ((a1 - a2) * digamma(a1) - gammaln(a1) + gammaln(a2)
+               + a2 * (jnp.log(b1) - jnp.log(b2)) + a1 * (b2 - b1) / b1)
+        return Tensor(out)
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df = _arr(df)
+        self.df = df
+        super().__init__(df / 2.0, jnp.asarray(0.5))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(math.sqrt(2.0) * self.scale,
+                                       self.batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape, jnp.float32,
+                               minval=-0.5 + 1e-7, maxval=0.5)
+        return Tensor(self.loc - self.scale * jnp.sign(u)
+                      * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                       self.batch_shape))
+
+    def cdf(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, q):
+        q = _arr(q)
+        t = q - 0.5
+        return Tensor(self.loc - self.scale * jnp.sign(t)
+                      * jnp.log1p(-2 * jnp.abs(t)))
+
+    def kl_divergence(self, other: "Laplace"):
+        d = jnp.abs(self.loc - other.loc)
+        r = self.scale / other.scale
+        out = (jnp.log(other.scale) - jnp.log(self.scale) + d / other.scale
+               + r * jnp.exp(-d / self.scale) - 1)
+        return Tensor(out)
+
+
+_EULER = 0.5772156649015329
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc + self.scale * _EULER,
+                                       self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            (math.pi ** 2 / 6) * self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        g = jax.random.gumbel(next_key(), shape, jnp.float32)
+        return Tensor(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.scale) + 1 + _EULER,
+                                       self.batch_shape))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(jnp.exp(-jnp.exp(-z)))
+
+
+class Geometric(Distribution):
+    """P(X=k) = p (1-p)^(k-1), k = 1, 2, ... (reference geometric.py
+    convention: number of trials to first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_ = _arr(probs)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs_) / self.probs_ ** 2)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape, jnp.float32,
+                               minval=1e-7, maxval=1.0)
+        return Tensor(jnp.ceil(jnp.log(u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        k = _arr(value)
+        return Tensor((k - 1) * jnp.log1p(-self.probs_)
+                      + jnp.log(self.probs_))
+
+    def entropy(self):
+        p = self.probs_
+        q = 1 - p
+        return Tensor(-(q * jnp.log(q) + p * jnp.log(p)) / p)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape, jnp.float32,
+                               minval=1e-7, maxval=1.0 - 1e-7)
+        return Tensor(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z * z)))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                       self.batch_shape))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(jnp.arctan(z) / math.pi + 0.5)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor(jnp.expm1(s2) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        z = jax.random.normal(next_key(), shape, jnp.float32)
+        return Tensor(jnp.exp(self.loc + self.scale * z))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lv = jnp.log(v)
+        return Tensor(-((lv - self.loc) ** 2) / (2 * self.scale ** 2)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi) - lv)
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            self.loc + 0.5 + 0.5 * math.log(2 * math.pi)
+            + jnp.log(self.scale), self.batch_shape))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.poisson(next_key(), self.rate, shape)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        k = _arr(value)
+        return Tensor(k * jnp.log(self.rate) - self.rate - gammaln(k + 1))
+
+    def entropy(self):
+        # exact series where the mass fits under k < 128 (rate < ~80);
+        # Edgeworth expansion 0.5 log(2*pi*e*lam) - 1/(12 lam) - 1/(24 lam^2)
+        # for large rates, where truncating the series would silently
+        # drop all the probability mass
+        from jax.scipy.special import gammaln
+        lam = jnp.atleast_1d(self.rate)
+        ks = jnp.arange(0, 128, dtype=jnp.float32)
+        logp = ks[:, None] * jnp.log(lam.reshape(-1)) - lam.reshape(-1) \
+            - gammaln(ks + 1)[:, None]
+        series = -(jnp.exp(logp) * logp).sum(0).reshape(lam.shape)
+        asymptotic = (0.5 * jnp.log(2 * math.pi * math.e * lam)
+                      - 1 / (12 * lam) - 1 / (24 * lam ** 2))
+        ent = jnp.where(lam < 80.0, series, asymptotic)
+        return Tensor(ent.reshape(self.rate.shape))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.where(self.df > 1, self.loc, jnp.nan), self.batch_shape))
+
+    @property
+    def variance(self):
+        var = jnp.where(self.df > 2,
+                        self.scale ** 2 * self.df / (self.df - 2), jnp.inf)
+        return Tensor(jnp.broadcast_to(
+            jnp.where(self.df > 1, var, jnp.nan), self.batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        t = jax.random.t(next_key(), jnp.broadcast_to(self.df, shape), shape)
+        return Tensor(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        z = (_arr(value) - self.loc) / self.scale
+        df = self.df
+        out = (gammaln((df + 1) / 2) - gammaln(df / 2)
+               - 0.5 * jnp.log(df * math.pi) - jnp.log(self.scale)
+               - (df + 1) / 2 * jnp.log1p(z * z / df))
+        return Tensor(out)
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        df = self.df
+        out = ((df + 1) / 2 * (digamma((df + 1) / 2) - digamma(df / 2))
+               + 0.5 * jnp.log(df) + betaln(df / 2, 0.5)
+               + jnp.log(self.scale))
+        return Tensor(jnp.broadcast_to(out, self.batch_shape))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _arr(total_count)
+        self.probs_ = _arr(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs_.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        n = jnp.broadcast_to(self.total_count, shape).astype(jnp.float32)
+        p = jnp.broadcast_to(self.probs_, shape)
+        return Tensor(jax.random.binomial(next_key(), n, p, shape)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        k = _arr(value)
+        n, p = self.total_count, self.probs_
+        eps = 1e-12
+        comb = gammaln(n + 1) - gammaln(k + 1) - gammaln(n - k + 1)
+        return Tensor(comb + k * jnp.log(p + eps)
+                      + (n - k) * jnp.log1p(-p + eps))
+
+
+class ContinuousBernoulli(Distribution):
+    """reference continuous_bernoulli.py: density proportional to
+    p^x (1-p)^(1-x) on [0, 1] with normalizer C(p)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs_ = _arr(probs)
+        self._lims = lims
+        super().__init__(self.probs_.shape)
+
+    def _log_norm(self):
+        p = self.probs_
+        lo, hi = self._lims
+        safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+        cut = jnp.logical_and(p > lo, p < hi)
+        # C(p) = 2 atanh(1-2p) / (1-2p), C(1/2) = 2
+        x = 1 - 2 * safe
+        log_c = jnp.log(jnp.abs(2 * jnp.arctanh(x))) - jnp.log(jnp.abs(x))
+        # Taylor around p=1/2: log C ~ log 2 + (2/3) eps^2, eps = p - 1/2
+        eps2 = (p - 0.5) ** 2
+        taylor = math.log(2.0) + (4.0 / 3.0) * eps2
+        return jnp.where(cut, taylor, log_c)
+
+    @property
+    def mean(self):
+        p = jnp.clip(self.probs_, 1e-6, 1 - 1e-6)
+        x = 1 - 2 * p
+        m = p / (2 * p - 1) + 1 / (2 * jnp.arctanh(x))
+        # Taylor around p = 1/2: E[X] ~ 1/2 + (p - 1/2)/3
+        return Tensor(jnp.where(jnp.abs(x) < 1e-3, 0.5 + (p - 0.5) / 3.0, m))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape, jnp.float32,
+                               minval=1e-6, maxval=1 - 1e-6)
+        p = jnp.clip(self.probs_, 1e-6, 1 - 1e-6)
+        near = jnp.abs(p - 0.5) < 1e-3
+        # icdf: log(u(2p-1)/(1-p) + 1) / log(p/(1-p))
+        ratio = jnp.log1p(u * (2 * p - 1) / (1 - p)) \
+            / jnp.log(p / (1 - p))
+        return Tensor(jnp.where(near, u, ratio))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs_, 1e-6, 1 - 1e-6)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                      + self._log_norm())
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _arr(probs)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        k = self.probs_.shape[-1]
+        logits = jnp.broadcast_to(jnp.log(self.probs_ + 1e-12),
+                                  shape + (k,))
+        draws = jax.random.categorical(
+            next_key(), logits[..., None, :],
+            shape=shape + (self.total_count,))
+        return Tensor(jax.nn.one_hot(draws, k, dtype=jnp.float32).sum(-2))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        x = _arr(value)
+        n = jnp.asarray(self.total_count, jnp.float32)
+        return Tensor(gammaln(n + 1) - gammaln(x + 1).sum(-1)
+                      + (x * jnp.log(self.probs_ + 1e-12)).sum(-1))
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 precision_matrix=None, name=None):
+        self.loc = _arr(loc)
+        if scale_tril is not None:
+            self._L = _arr(scale_tril)
+        elif covariance_matrix is not None:
+            self._L = jnp.linalg.cholesky(_arr(covariance_matrix))
+        elif precision_matrix is not None:
+            prec = _arr(precision_matrix)
+            self._L = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        else:
+            raise ValueError("one of covariance_matrix/scale_tril/"
+                             "precision_matrix is required")
+        super().__init__(jnp.broadcast_shapes(self.loc.shape[:-1],
+                                              self._L.shape[:-2]),
+                         self.loc.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc,
+                                       self.batch_shape + self.event_shape))
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._L @ jnp.swapaxes(self._L, -1, -2))
+
+    @property
+    def variance(self):
+        cov = self._L @ jnp.swapaxes(self._L, -1, -2)
+        return Tensor(jnp.broadcast_to(
+            jnp.diagonal(cov, axis1=-2, axis2=-1),
+            self.batch_shape + self.event_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape + self.event_shape
+        z = jax.random.normal(next_key(), shape, jnp.float32)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i", self._L, z))
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        d = self.loc.shape[-1]
+        diff = _arr(value) - self.loc
+        y = jax.scipy.linalg.solve_triangular(self._L, diff[..., None],
+                                              lower=True)[..., 0]
+        half_logdet = jnp.log(jnp.diagonal(self._L, axis1=-2, axis2=-1)).sum(-1)
+        return Tensor(-0.5 * (y * y).sum(-1) - half_logdet
+                      - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        half_logdet = jnp.log(jnp.diagonal(self._L, axis1=-2, axis2=-1)).sum(-1)
+        out = 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+        return Tensor(jnp.broadcast_to(out, self.batch_shape))
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost batch dims of a base distribution as event
+    dims (reference independent.py): log_prob sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)._data
+        axes = tuple(range(lp.ndim - self.rank, lp.ndim))
+        return Tensor(lp.sum(axis=axes))
+
+    def entropy(self):
+        e = self.base.entropy()._data
+        axes = tuple(range(e.ndim - self.rank, e.ndim))
+        return Tensor(e.sum(axis=axes))
+
+
+class LKJCholesky(Distribution):
+    """Cholesky factor of an LKJ-distributed correlation matrix (reference
+    lkj_cholesky.py).  Sampling via the C-vine / partial-correlation
+    construction; density p(L) ∝ Π_i L_ii^(d - i + 2η - 2) with the
+    multivariate-beta normalizer."""
+
+    def __init__(self, dim, concentration=1.0, name=None):
+        self.dim = int(dim)
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape,
+                         (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        d = self.dim
+        shape = tuple(shape) + self.batch_shape
+        eta = jnp.broadcast_to(self.concentration, shape)
+        # C-vine: partial correlations P[k,i] ~ 2 Beta(b_k, b_k) - 1,
+        # b_k = eta + (d - 1 - k)/2 (1-based tree level k); accumulated
+        # correlations R come from the recursion over the RAW partials P
+        key_beta = lambda b: jax.random.beta(next_key(), b, b, shape) * 2 - 1
+        P = [[None] * d for _ in range(d)]
+        R = [[None] * d for _ in range(d)]
+        for k in range(d - 1):
+            b = eta + (d - 2 - k) / 2.0
+            for i in range(k + 1, d):
+                P[k][i] = key_beta(b)
+                p = P[k][i]
+                for l in range(k - 1, -1, -1):
+                    p = p * jnp.sqrt((1 - P[l][i] ** 2)
+                                     * (1 - P[l][k] ** 2)) + P[l][i] * P[l][k]
+                R[k][i] = p
+        # assemble correlation matrix
+        corr = jnp.ones(shape + (d, d), jnp.float32)
+        for k in range(d - 1):
+            for i in range(k + 1, d):
+                r = jnp.asarray(R[k][i], jnp.float32)
+                corr = corr.at[..., k, i].set(r)
+                corr = corr.at[..., i, k].set(r)
+        # jitter for numerical PD-ness
+        corr = corr + 1e-6 * jnp.eye(d)
+        L = jnp.linalg.cholesky(corr)
+        # renormalize rows so diag(L L^T) == 1 exactly
+        L = L / jnp.linalg.norm(L, axis=-1, keepdims=True)
+        return Tensor(L)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        L = _arr(value)
+        d = self.dim
+        eta = self.concentration
+        order = jnp.arange(2, d + 1, dtype=jnp.float32)
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        unnorm = ((d - order + 2 * eta[..., None] - 2)
+                  * jnp.log(diag)).sum(-1)
+        # normalizer (multivariate beta; page 1999 of Lewandowski et al.)
+        dm1 = d - 1
+        alpha = eta + 0.5 * dm1
+        js = jnp.arange(1, dm1 + 1, dtype=jnp.float32)
+        # mvlgamma(alpha - 1/2, dm1) = (dm1(dm1-1)/4) log pi
+        #   + sum_{j=1..dm1} lgamma(alpha - 1/2 + (1-j)/2)
+        mvlgamma = (dm1 * (dm1 - 1) / 4) * math.log(math.pi) + \
+            gammaln(alpha[..., None] - 0.5 - (js - 1) / 2).sum(-1)
+        denom = dm1 * gammaln(alpha)
+        pi_const = 0.5 * dm1 * math.log(math.pi)
+        return Tensor(unnorm - (pi_const + mvlgamma - denom))
+
+
+from .transform import (  # noqa: E402,F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+    Transform, TransformedDistribution,
+)

@@ -1,0 +1,330 @@
+"""Distribution transforms (reference: python/paddle/distribution/
+transform.py — Transform base + the standard bijector set, and
+transformed_distribution.py).
+
+Each transform is a bijector with forward/inverse and log|det J| in both
+directions; TransformedDistribution pushes a base distribution through a
+chain of them.  All math is jnp (jit-safe); Tensor wrappers at the API edge.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    def forward(self, x):
+        return Tensor(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._forward_log_det_jacobian(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        y = _arr(y)
+        return Tensor(-self._forward_log_det_jacobian(self._inverse(y)))
+
+    # event dims consumed/produced (0 = elementwise)
+    _domain_event_rank = 0
+    _codomain_event_rank = 0
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-6, 1 - 1e-6))
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2 (log 2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """x -> softmax(x) over the last dim (surjection onto the simplex)."""
+    _type = Type.OTHER
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    """R^(k) -> k+1 simplex via stick-breaking (bijection)."""
+    _type = Type.BIJECTION
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate([z, jnp.ones_like(z[..., :1])], axis=-1)
+        cum = jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), jnp.cumprod(1 - z, axis=-1)], axis=-1)
+        return zpad * cum
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        cum = 1 - jnp.cumsum(y[..., :-1], axis=-1)
+        cum_shift = jnp.concatenate(
+            [jnp.ones_like(y[..., :1]), cum[..., :-1]], axis=-1)
+        z = y[..., :-1] / jnp.maximum(cum_shift, 1e-12)
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        cum = jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), jnp.cumprod(1 - z, axis=-1)[..., :-1]],
+            axis=-1)
+        # d y_i / d x_i = sigmoid'(t) * remaining stick
+        return (jnp.log(z) + jnp.log1p(-z) + jnp.log(jnp.maximum(cum, 1e-12))) \
+            .sum(-1)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._domain_event_rank = len(self.in_event_shape)
+        self._codomain_event_rank = len(self.out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class IndependentTransform(Transform):
+    """Treat the rightmost dims of an elementwise transform as event dims
+    (sums the log-det over them)."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._domain_event_rank = base._domain_event_rank + self.rank
+        self._codomain_event_rank = base._codomain_event_rank + self.rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base._forward_log_det_jacobian(x)
+        axes = tuple(range(ld.ndim - self.rank, ld.ndim))
+        return ld.sum(axis=axes)
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms along slices of the given axis."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, fn_name, v):
+        parts = jnp.split(v, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(p.squeeze(self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("_forward_log_det_jacobian", x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._domain_event_rank = max(
+            [t._domain_event_rank for t in self.transforms] or [0])
+        self._codomain_event_rank = max(
+            [t._codomain_event_rank for t in self.transforms] or [0])
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        lds = []
+        for t in self.transforms:
+            lds.append(t._forward_log_det_jacobian(x))
+            x = t._forward(x)
+        # elementwise stages produce per-element log-dets; reduce every
+        # stage's ldj to the narrowest (already event-reduced) rank so the
+        # sum is over consistent batch shapes
+        min_ndim = min(ld.ndim for ld in lds) if lds else 0
+        total = 0.0
+        for ld in lds:
+            if ld.ndim > min_ndim:
+                ld = ld.sum(axis=tuple(range(min_ndim, ld.ndim)))
+            total = total + ld
+        return total
+
+
+class TransformedDistribution:
+    """Push ``base`` through ``transforms`` (reference
+    transformed_distribution.py).  log_prob uses the change of variables
+    with the inverse log-det; sample maps base samples forward."""
+
+    def __init__(self, base, transforms):
+        from . import Distribution
+        assert isinstance(base, Distribution)
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.chain = ChainTransform(list(transforms))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.chain.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self.chain.forward(x)
+
+    def log_prob(self, value):
+        y = _arr(value)
+        event_rank = self.chain._codomain_event_rank
+        x = self.chain._inverse(y)
+        base_lp = self.base.log_prob(Tensor(x))._data
+        ld = self.chain._forward_log_det_jacobian(x)
+        # reduce any extra elementwise dims to the event rank
+        extra = ld.ndim - base_lp.ndim
+        if extra > 0:
+            ld = ld.sum(axis=tuple(range(ld.ndim - extra, ld.ndim)))
+        return Tensor(base_lp - ld)
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data))

@@ -6,7 +6,8 @@ built TPU-first — bf16 compute, flash-attention Pallas kernel, GSPMD
 sharding plan over the hybrid mesh (dp/mp/pp/sep axes).
 """
 
-from . import gpt, llama  # noqa: F401
+from . import dit, gpt, llama  # noqa: F401
+from .dit import DiT, DiTConfig, DiTTrainStep, GaussianDiffusion  # noqa: F401
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, llama_shard_plan,
 )

@@ -1,0 +1,584 @@
+"""DiT — Diffusion Transformer family (BASELINE.md config 4).
+
+Reference behavior surface: the Stable-Diffusion / DiT training stack the
+reference serves through its ecosystem (PaddleMIX ppdiffusers on top of
+python/paddle/nn + fused attention ops); BASELINE.md config 4 requires a
+functional + profiled diffusion model at framework level: a noise-prediction
+transformer, the DDPM/DDIM schedule math, and an imgs/sec + MFU bench rung.
+
+TPU-first design decisions:
+- patchify is reshape + one matmul (MXU), not an im2col conv;
+- adaLN-Zero conditioning (shift/scale/gate from timestep+class embedding)
+  — pure elementwise, XLA fuses it into the surrounding matmuls;
+- attention over patch tokens goes through the Pallas flash kernel when the
+  sequence is block-aligned, else a fused jnp path (short sequences);
+- the whole denoiser is scan-able: DiTBlock params stack into [L, ...]
+  pytrees exactly like the Llama pretrain path, so pp/mp shardings and
+  remat apply unchanged;
+- the sampler (DDIM) is a lax.fori_loop over timesteps — one compiled
+  program regardless of step count.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer import Layer, LayerList
+from ..ops._prim import apply_op
+from .llama import _ParamLinear, _scaled_init
+
+
+@dataclass
+class DiTConfig:
+    """DiT-{S,B,L,XL}/p geometry (scaling follows the DiT paper family)."""
+    input_size: int = 32            # latent spatial size (SD latents: 32x32)
+    patch_size: int = 2
+    in_channels: int = 4            # SD latent channels
+    hidden_size: int = 1152
+    depth: int = 28
+    num_heads: int = 16
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000
+    class_dropout_prob: float = 0.1
+    learn_sigma: bool = False       # eps-only prediction (MSE on noise)
+    dtype: str = "bfloat16"
+
+    @property
+    def seq_len(self) -> int:
+        return (self.input_size // self.patch_size) ** 2
+
+    @property
+    def out_channels(self) -> int:
+        return self.in_channels * (2 if self.learn_sigma else 1)
+
+    @staticmethod
+    def tiny(**kw) -> "DiTConfig":
+        base = dict(input_size=8, patch_size=2, in_channels=3, hidden_size=64,
+                    depth=2, num_heads=4, num_classes=10, dtype="float32")
+        base.update(kw)
+        return DiTConfig(**base)
+
+    @staticmethod
+    def dit_s_2(**kw) -> "DiTConfig":
+        return DiTConfig(**{**dict(hidden_size=384, depth=12, num_heads=6), **kw})
+
+    @staticmethod
+    def dit_b_2(**kw) -> "DiTConfig":
+        return DiTConfig(**{**dict(hidden_size=768, depth=12, num_heads=12), **kw})
+
+    @staticmethod
+    def dit_l_2(**kw) -> "DiTConfig":
+        return DiTConfig(**{**dict(hidden_size=1024, depth=24, num_heads=16), **kw})
+
+    @staticmethod
+    def dit_xl_2(**kw) -> "DiTConfig":
+        return DiTConfig(**{**dict(hidden_size=1152, depth=28, num_heads=16), **kw})
+
+    def num_params(self) -> int:
+        h = self.hidden_size
+        i = int(h * self.mlp_ratio)
+        p2c = self.patch_size ** 2 * self.in_channels
+        per_block = (4 * h * h + 2 * h * i) + 6 * h * h + 6 * h  # attn+mlp+adaLN
+        final = h * (self.patch_size ** 2 * self.out_channels) + 2 * h * h
+        embed = p2c * h + self.seq_len * h + \
+            (self.num_classes + 1) * h + (256 * h + h * h)       # patch/pos/label/time
+        return self.depth * per_block + final + embed
+
+    def flops_per_image(self) -> float:
+        """Forward+backward matmul flops for one image through the denoiser
+        (6·params·tokens analog, computed from the actual block shapes)."""
+        h = self.hidden_size
+        i = int(h * self.mlp_ratio)
+        s = self.seq_len
+        attn_proj = 4 * h * h          # qkv+o per token
+        attn_sdpa = 2 * s * h          # qk^T + av per token
+        mlp = 2 * h * i
+        adaln = 6 * h * h / s          # conditioning MLP is per-image
+        per_token = self.depth * (attn_proj + attn_sdpa + mlp + adaln)
+        per_token += self.patch_size ** 2 * self.in_channels * h \
+            + h * self.patch_size ** 2 * self.out_channels
+        return 6.0 * per_token * s     # fwd(2) + bwd(4) flops per MAC
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal timestep features [b, dim] (fp32 tables, DDPM standard)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period) *
+                    jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    emb = jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    if dim % 2:
+        emb = jnp.pad(emb, ((0, 0), (0, 1)))
+    return emb
+
+
+class TimestepEmbedder(Layer):
+    def __init__(self, hidden_size: int, dtype, freq_dim: int = 256):
+        super().__init__(dtype=dtype)
+        self.freq_dim = freq_dim
+        self.fc1 = _ParamLinear(freq_dim, hidden_size, dtype, _scaled_init(freq_dim))
+        self.fc2 = _ParamLinear(hidden_size, hidden_size, dtype,
+                                _scaled_init(hidden_size))
+
+    def forward(self, t):
+        emb = apply_op("timestep_embed",
+                       lambda tv: timestep_embedding(tv, self.freq_dim),
+                       (t,))
+        return self.fc2(F.silu(self.fc1(emb)))
+
+
+class LabelEmbedder(Layer):
+    """Class embedding with a null slot for classifier-free guidance."""
+
+    def __init__(self, num_classes: int, hidden_size: int, dtype):
+        super().__init__(dtype=dtype)
+        self.num_classes = num_classes
+        self.table = self.create_parameter(
+            [num_classes + 1, hidden_size],
+            default_initializer=_scaled_init(hidden_size))
+
+    def forward(self, y):
+        return apply_op("label_embed",
+                        lambda tab, yv: jnp.take(tab, yv, axis=0),
+                        (self.table, y))
+
+
+def modulate(x, shift, scale):
+    return x * (1.0 + scale[:, None, :]) + shift[:, None, :]
+
+
+def _layernorm_no_affine(x, eps: float = 1e-6):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+
+
+def _patch_attention(q, k, v):
+    """[b, s, h, d] attention over patch tokens.  Uses the Pallas flash
+    kernel for block-aligned long sequences; otherwise a fused jnp SDPA
+    (at DiT's 64-1024 tokens XLA's fusion is already MXU-bound)."""
+    b, s, h, d = q.shape
+    if s >= 512 and s % 128 == 0:
+        from ..kernels.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=False)
+    scale = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def dit_block_forward(xa, ca, bp, num_heads: int):
+    """adaLN-Zero transformer block on raw arrays.  ``bp`` is the block's
+    param dict {qkv, proj, fc1, fc2, ada_w, ada_b} — the same pytree the
+    compiled step stacks to [L, ...] and scans over."""
+    h = xa.shape[-1]
+    mod = jax.nn.silu(ca) @ bp["ada_w"] + bp["ada_b"]            # [b, 6h]
+    sa_shift, sa_scale, sa_gate, mlp_shift, mlp_scale, mlp_gate = \
+        jnp.split(mod, 6, axis=-1)
+    b, s, _ = xa.shape
+    y = modulate(_layernorm_no_affine(xa), sa_shift, sa_scale)
+    qkv = (y @ bp["qkv"]).reshape(b, s, 3, num_heads, h // num_heads)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    att = _patch_attention(q, k, v).reshape(b, s, h) @ bp["proj"]
+    xa = xa + sa_gate[:, None, :] * att
+    y = modulate(_layernorm_no_affine(xa), mlp_shift, mlp_scale)
+    y = jax.nn.gelu(y @ bp["fc1"], approximate=True) @ bp["fc2"]
+    return xa + mlp_gate[:, None, :] * y
+
+
+class DiTBlock(Layer):
+    """Transformer block with adaLN-Zero conditioning (gates init to 0 so
+    each block starts as identity — DiT's stabilized training trick)."""
+
+    def __init__(self, config: DiTConfig):
+        super().__init__(dtype=config.dtype)
+        c = config
+        self.config = c
+        h = c.hidden_size
+        i = int(h * c.mlp_ratio)
+        init = _scaled_init(h)
+        self.qkv = _ParamLinear(h, 3 * h, c.dtype, init)
+        self.proj = _ParamLinear(h, h, c.dtype, init)
+        self.fc1 = _ParamLinear(h, i, c.dtype, init)
+        self.fc2 = _ParamLinear(i, h, c.dtype, _scaled_init(i))
+        # adaLN modulation: cond -> 6*h (shift/scale/gate for attn and mlp)
+        self.ada_w = self.create_parameter(
+            [h, 6 * h], default_initializer=lambda s, dt: jnp.zeros(s, dt))
+        self.ada_b = self.create_parameter(
+            [6 * h], default_initializer=lambda s, dt: jnp.zeros(s, dt))
+
+    def _block_params(self):
+        return {"qkv": self.qkv.weight._data, "proj": self.proj.weight._data,
+                "fc1": self.fc1.weight._data, "fc2": self.fc2.weight._data,
+                "ada_w": self.ada_w._data, "ada_b": self.ada_b._data}
+
+    def forward(self, x, cond):
+        c = self.config
+
+        def block_prim(xa, ca, qkv_w, proj_w, fc1_w, fc2_w, ada_w, ada_b):
+            bp = {"qkv": qkv_w, "proj": proj_w, "fc1": fc1_w, "fc2": fc2_w,
+                  "ada_w": ada_w, "ada_b": ada_b}
+            return dit_block_forward(xa, ca, bp, c.num_heads)
+
+        return apply_op(
+            "dit_block", block_prim,
+            (x, cond, self.qkv.weight, self.proj.weight, self.fc1.weight,
+             self.fc2.weight, self.ada_w, self.ada_b))
+
+
+class FinalLayer(Layer):
+    def __init__(self, config: DiTConfig):
+        super().__init__(dtype=config.dtype)
+        c = config
+        h = c.hidden_size
+        out = c.patch_size ** 2 * c.out_channels
+        self.ada_w = self.create_parameter(
+            [h, 2 * h], default_initializer=lambda s, dt: jnp.zeros(s, dt))
+        self.ada_b = self.create_parameter(
+            [2 * h], default_initializer=lambda s, dt: jnp.zeros(s, dt))
+        # zero-init head: the denoiser starts by predicting 0 noise
+        self.head = self.create_parameter(
+            [h, out], default_initializer=lambda s, dt: jnp.zeros(s, dt))
+
+    def forward(self, x, cond):
+        def prim(xa, ca, ada_w, ada_b, head_w):
+            mod = jax.nn.silu(ca) @ ada_w + ada_b
+            shift, scale = jnp.split(mod, 2, axis=-1)
+            return modulate(_layernorm_no_affine(xa), shift, scale) @ head_w
+
+        return apply_op("dit_final", prim,
+                        (x, cond, self.ada_w, self.ada_b, self.head))
+
+
+class DiT(Layer):
+    """Noise-prediction transformer: (x_t [b,c,H,W], t [b], y [b]) -> eps."""
+
+    def __init__(self, config: DiTConfig):
+        super().__init__(dtype=config.dtype)
+        c = config
+        self.config = c
+        p2c = c.patch_size ** 2 * c.in_channels
+        self.patch_proj = _ParamLinear(p2c, c.hidden_size, c.dtype,
+                                       _scaled_init(p2c))
+        self.pos_embed = self.create_parameter(
+            [c.seq_len, c.hidden_size],
+            default_initializer=lambda s, dt:
+                (jax.random.normal(_poskey(), s, jnp.float32) * 0.02).astype(dt))
+        self.t_embedder = TimestepEmbedder(c.hidden_size, c.dtype)
+        self.y_embedder = LabelEmbedder(c.num_classes, c.hidden_size, c.dtype)
+        self.blocks = LayerList([DiTBlock(c) for _ in range(c.depth)])
+        self.final = FinalLayer(c)
+
+    # ---- patch <-> image ----
+    def patchify(self, x):
+        """[b, c, H, W] -> [b, s, p*p*c] by reshape/transpose only."""
+        c = self.config
+        p = c.patch_size
+        g = c.input_size // p
+
+        def prim(xa):
+            b = xa.shape[0]
+            xa = xa.reshape(b, c.in_channels, g, p, g, p)
+            xa = xa.transpose(0, 2, 4, 3, 5, 1)          # b, gh, gw, p, p, c
+            return xa.reshape(b, g * g, p * p * c.in_channels)
+
+        return apply_op("dit_patchify", prim, (x,))
+
+    def unpatchify(self, x):
+        c = self.config
+        p = c.patch_size
+        g = c.input_size // p
+
+        def prim(xa):
+            b = xa.shape[0]
+            xa = xa.reshape(b, g, g, p, p, c.out_channels)
+            xa = xa.transpose(0, 5, 1, 3, 2, 4)          # b, c, gh, p, gw, p
+            return xa.reshape(b, c.out_channels, g * p, g * p)
+
+        return apply_op("dit_unpatchify", prim, (x,))
+
+    def forward(self, x, t, y):
+        c = self.config
+        h = self.patch_proj(self.patchify(x))
+        h = apply_op("dit_pos", lambda ha, pe: ha + pe[None], (h, self.pos_embed))
+        cond = self.t_embedder(t) + self.y_embedder(y)
+        for blk in self.blocks:
+            h = blk(h, cond)
+        return self.unpatchify(self.final(h, cond))
+
+
+def _poskey():
+    from ..core.random import next_key
+    return next_key()
+
+
+# ---- diffusion schedule (DDPM/DDIM math) ----
+
+class GaussianDiffusion:
+    """Linear or cosine beta schedule; eps-prediction training target and a
+    DDIM sampler compiled as one lax.fori_loop program."""
+
+    def __init__(self, num_timesteps: int = 1000, schedule: str = "cosine"):
+        self.num_timesteps = int(num_timesteps)
+        T = self.num_timesteps
+        if schedule == "linear":
+            betas = np.linspace(1e-4, 0.02, T, dtype=np.float64)
+        elif schedule == "cosine":
+            s = 0.008
+            ts = np.arange(T + 1, dtype=np.float64) / T
+            f = np.cos((ts + s) / (1 + s) * math.pi / 2) ** 2
+            betas = np.clip(1 - f[1:] / f[:-1], 0, 0.999)
+        else:
+            raise ValueError(f"unknown schedule {schedule!r}")
+        alphas_bar = np.cumprod(1.0 - betas)
+        self.sqrt_ab = jnp.asarray(np.sqrt(alphas_bar), jnp.float32)
+        self.sqrt_1mab = jnp.asarray(np.sqrt(1 - alphas_bar), jnp.float32)
+
+    def q_sample(self, x0, t, noise):
+        """x_t = sqrt(ab_t) x0 + sqrt(1-ab_t) eps  (t: int [b])."""
+        a = self.sqrt_ab[t][:, None, None, None].astype(x0.dtype)
+        b = self.sqrt_1mab[t][:, None, None, None].astype(x0.dtype)
+        return a * x0 + b * noise
+
+    def training_loss(self, model_fn, x0, t, y, key,
+                      null_label: Optional[int] = None,
+                      class_dropout_prob: float = 0.0):
+        """MSE(eps_hat, eps) in fp32 — the DDPM simple loss.  With
+        ``null_label``/``class_dropout_prob`` set, labels are dropped to the
+        null class so the CFG unconditional branch gets trained."""
+        key, nk, dk = jax.random.split(key, 3)
+        noise = jax.random.normal(nk, x0.shape, x0.dtype)
+        if null_label is not None and class_dropout_prob > 0:
+            drop = jax.random.uniform(dk, y.shape) < class_dropout_prob
+            y = jnp.where(drop, null_label, y)
+        x_t = self.q_sample(x0, t, noise)
+        eps_hat = model_fn(x_t, t, y)
+        if eps_hat.shape[1] != x0.shape[1]:          # learn_sigma: eps half
+            eps_hat = eps_hat[:, : x0.shape[1]]
+        d = (eps_hat.astype(jnp.float32) - noise.astype(jnp.float32))
+        return jnp.mean(d * d)
+
+    def ddim_sample(self, model_fn, shape, y, key, steps: int = 50,
+                    eta: float = 0.0, guidance_scale: float = 1.0,
+                    null_label: Optional[int] = None):
+        """Deterministic (eta=0) DDIM with optional classifier-free
+        guidance.  One fori_loop — step count is static, shapes static."""
+        T = self.num_timesteps
+        ts = jnp.asarray(
+            np.linspace(T - 1, 0, steps).round().astype(np.int64))
+        key, nk = jax.random.split(key)
+        x = jax.random.normal(nk, shape, jnp.float32)
+        b = shape[0]
+
+        def eps_of(x_t, t_scalar):
+            tb = jnp.full((b,), t_scalar, jnp.int32)
+            if guidance_scale != 1.0 and null_label is not None:
+                nulls = jnp.full((b,), null_label, jnp.int32)
+                e_c = model_fn(x_t, tb, y)
+                e_u = model_fn(x_t, tb, nulls)
+                return e_u + guidance_scale * (e_c - e_u)
+            return model_fn(x_t, tb, y)
+
+        def body(i, carry):
+            x, key = carry
+            t = ts[i]
+            t_next = jnp.where(i + 1 < steps, ts[jnp.minimum(i + 1, steps - 1)], -1)
+            eps = eps_of(x.astype(jnp.float32), t).astype(jnp.float32)
+            if eps.shape[1] != shape[1]:
+                eps = eps[:, : shape[1]]
+            ab_t = self.sqrt_ab[t] ** 2
+            ab_n = jnp.where(t_next >= 0,
+                             self.sqrt_ab[jnp.maximum(t_next, 0)] ** 2, 1.0)
+            x0 = (x - jnp.sqrt(1 - ab_t) * eps) / jnp.sqrt(ab_t)
+            sigma = eta * jnp.sqrt((1 - ab_n) / (1 - ab_t)) * \
+                jnp.sqrt(1 - ab_t / ab_n)
+            dir_xt = jnp.sqrt(jnp.maximum(1 - ab_n - sigma ** 2, 0.0)) * eps
+            key, nk = jax.random.split(key)
+            noise = jnp.where(t_next >= 0,
+                              sigma * jax.random.normal(nk, shape, jnp.float32),
+                              0.0)
+            return jnp.sqrt(ab_n) * x0 + dir_xt + noise, key
+
+        x, _ = jax.lax.fori_loop(0, steps, body, (x, key))
+        return x
+
+
+# ---- compiled training step (bench config 4: imgs/sec + MFU) ----
+
+def dit_patchify_raw(xa, c: DiTConfig):
+    p = c.patch_size
+    g = c.input_size // p
+    b = xa.shape[0]
+    xa = xa.reshape(b, c.in_channels, g, p, g, p)
+    xa = xa.transpose(0, 2, 4, 3, 5, 1)
+    return xa.reshape(b, g * g, p * p * c.in_channels)
+
+
+def dit_unpatchify_raw(xa, c: DiTConfig):
+    p = c.patch_size
+    g = c.input_size // p
+    b = xa.shape[0]
+    xa = xa.reshape(b, g, g, p, p, c.out_channels)
+    xa = xa.transpose(0, 5, 1, 3, 2, 4)
+    return xa.reshape(b, c.out_channels, g * p, g * p)
+
+
+class DiTTrainStep:
+    """Jitted diffusion training step over a (dp, mp) mesh.
+
+    dp shards the image batch; mp (optional) Megatron-shards each block's
+    qkv/fc1 on the output dim and proj/fc2 on the input dim — GSPMD emits
+    the column/row-parallel collectives.  Blocks are scanned (one compiled
+    block body regardless of depth) with optional per-block remat."""
+
+    def __init__(self, config: DiTConfig, dp: int = 1, mp: int = 1,
+                 remat: bool = False, lr: float = 1e-4,
+                 weight_decay: float = 0.0, betas=(0.9, 0.999),
+                 diffusion: Optional[GaussianDiffusion] = None,
+                 devices=None):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        self.config = config
+        self.dp, self.mp = dp, mp
+        self.remat = remat
+        self.lr, self.wd, self.betas = lr, weight_decay, betas
+        self.diffusion = diffusion or GaussianDiffusion()
+        devices = devices if devices is not None else jax.devices()
+        n = dp * mp
+        assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
+        self.mesh = Mesh(
+            np.asarray(devices[:n]).reshape(dp, mp), ("dp", "mp"))
+        self._P, self._NS = P, NamedSharding
+        self._step = None
+
+    # sharding specs for the stacked-params pytree
+    def _spec(self, name: str):
+        P = self._P
+        if self.mp == 1:
+            return P()
+        return {"blocks.qkv": P(None, None, "mp"),
+                "blocks.fc1": P(None, None, "mp"),
+                "blocks.proj": P(None, "mp", None),
+                "blocks.fc2": P(None, "mp", None)}.get(name, P())
+
+    def init_state(self, seed: int = 0):
+        from ..core import random as prandom
+        prandom.seed(seed)
+        c = self.config
+        model = DiT(c)
+        from ..utils import extract_params, stack_params
+        blocks = stack_params(
+            [blk._block_params() for blk in model.blocks])
+        params = {
+            "patch": model.patch_proj.weight._data,
+            "pos": model.pos_embed._data,
+            "t_fc1": model.t_embedder.fc1.weight._data,
+            "t_fc2": model.t_embedder.fc2.weight._data,
+            "label": model.y_embedder.table._data,
+            "blocks": blocks,
+            "final_ada_w": model.final.ada_w._data,
+            "final_ada_b": model.final.ada_b._data,
+            "final_head": model.final.head._data,
+        }
+        NS = self._NS
+        put = lambda v, name: jax.device_put(
+            v, NS(self.mesh, self._spec(name)))
+        params = {k: ({bk: put(bv, f"blocks.{bk}") for bk, bv in v.items()}
+                      if k == "blocks" else put(v, k))
+                  for k, v in params.items()}
+        zeros = jax.tree_util.tree_map(
+            lambda p: jax.device_put(jnp.zeros(p.shape, jnp.float32),
+                                     p.sharding), params)
+        return {"params": params, "m": zeros,
+                "v": jax.tree_util.tree_map(jnp.copy, zeros),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def eps_fn(self, params, x, t, y):
+        c = self.config
+        h = dit_patchify_raw(x.astype(params["patch"].dtype), c) @ params["patch"]
+        h = h + params["pos"][None]
+        temb = timestep_embedding(t, 256).astype(params["t_fc1"].dtype)
+        temb = jax.nn.silu(temb @ params["t_fc1"]) @ params["t_fc2"]
+        cond = temb + jnp.take(params["label"], y, axis=0)
+
+        def body(carry, bp):
+            return dit_block_forward(carry, cond, bp, c.num_heads), None
+
+        if self.remat:
+            body = jax.checkpoint(body)
+        h, _ = jax.lax.scan(body, h, params["blocks"])
+        mod = jax.nn.silu(cond) @ params["final_ada_w"] + params["final_ada_b"]
+        shift, scale = jnp.split(mod, 2, axis=-1)
+        out = modulate(_layernorm_no_affine(h), shift, scale) @ params["final_head"]
+        return dit_unpatchify_raw(out, c)
+
+    def _loss(self, params, x0, t, y, noise, step):
+        c = self.config
+        if c.class_dropout_prob > 0:
+            # train the null-class row so classifier-free guidance works;
+            # deterministic per-step key keeps the jitted step pure
+            dk = jax.random.fold_in(jax.random.PRNGKey(0xD17), step)
+            drop = jax.random.uniform(dk, y.shape) < c.class_dropout_prob
+            y = jnp.where(drop, c.num_classes, y)
+        x_t = self.diffusion.q_sample(x0, t, noise)
+        eps_hat = self.eps_fn(params, x_t, t, y)
+        if eps_hat.shape[1] != x0.shape[1]:
+            eps_hat = eps_hat[:, : x0.shape[1]]
+        d = eps_hat.astype(jnp.float32) - noise.astype(jnp.float32)
+        return jnp.mean(d * d)
+
+    def _update(self, state, grads):
+        b1, b2 = self.betas
+        step = state["step"] + 1
+        bc1 = 1 - b1 ** step.astype(jnp.float32)
+        bc2 = 1 - b2 ** step.astype(jnp.float32)
+
+        def upd(p, g, m, v):
+            gf = g.astype(jnp.float32)
+            m = b1 * m + (1 - b1) * gf
+            v = b2 * v + (1 - b2) * gf * gf
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + 1e-8)
+            if self.wd:
+                u = u + self.wd * p.astype(jnp.float32)
+            return (p.astype(jnp.float32) - self.lr * u).astype(p.dtype), m, v
+
+        out = jax.tree_util.tree_map(
+            upd, state["params"], grads, state["m"], state["v"],
+            is_leaf=lambda x: isinstance(x, (jax.Array, jax.core.Tracer)))
+        flat, treedef = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, tuple))
+        p = treedef.unflatten([f[0] for f in flat])
+        m = treedef.unflatten([f[1] for f in flat])
+        v = treedef.unflatten([f[2] for f in flat])
+        return {"params": p, "m": m, "v": v, "step": step}
+
+    def train_step(self, state, x0, t, y, noise):
+        if self._step is None:
+            NS, P = self._NS, self._P
+            batch_sh = NS(self.mesh, P("dp"))
+
+            @jax.jit
+            def step(state, x0, t, y, noise):
+                loss, grads = jax.value_and_grad(self._loss)(
+                    state["params"], x0, t, y, noise, state["step"])
+                return self._update(state, grads), loss
+
+            self._batch_sh = batch_sh
+            self._step = step
+        return self._step(state, x0, t, y, noise)
+
+    def shard_batch(self, x0, t, y, noise):
+        sh = self._NS(self.mesh, self._P("dp"))
+        return tuple(jax.device_put(jnp.asarray(a), sh)
+                     for a in (x0, t, y, noise))
+
+    def flops_per_image(self) -> float:
+        return self.config.flops_per_image()

@@ -484,6 +484,14 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     stride = _pair(stride, nd)
     dilation = _pair(dilation, nd)
     p = _pair(padding, nd)
+    x = _t(x)
+    if data_format == "NHWC":
+        x = x.transpose([0, 3, 1, 2])
+    if output_size is not None:
+        w_ = _t(weight)
+        output_padding = _tconv_output_padding(
+            [int(v) for v in output_size][-2:], list(x.shape[2:4]),
+            stride, p, [w_.shape[2], w_.shape[3]], dilation)
 
     def prim(a, w, *b):
         # weight layout [in, out//groups, kH, kW] (paddle transpose-conv convention)
@@ -501,8 +509,9 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
         if b:
             out = out + b[0].reshape(1, -1, 1, 1)
         return out
-    args = (_t(x), _t(weight)) + ((_t(bias),) if bias is not None else ())
-    return apply_op("conv2d_transpose", prim, args)
+    args = (x, _t(weight)) + ((_t(bias),) if bias is not None else ())
+    out = apply_op("conv2d_transpose", prim, args)
+    return out.transpose([0, 2, 3, 1]) if data_format == "NHWC" else out
 
 
 # ================= pooling =================
@@ -567,19 +576,48 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode
     return apply_op("avg_pool1d", prim, (_t(x),))
 
 
+def _adaptive_avg_matrix(n_in, n_out, dtype):
+    """[n_out, n_in] averaging matrix with torch's adaptive bins:
+    bin i spans [floor(i*n_in/n_out), ceil((i+1)*n_in/n_out))."""
+    w = np.zeros((n_out, n_in), np.float32)
+    for i in range(n_out):
+        lo = (i * n_in) // n_out
+        hi = -(-((i + 1) * n_in) // n_out)      # ceil div
+        w[i, lo:hi] = 1.0 / (hi - lo)
+    return jnp.asarray(w, dtype)
+
+
+def _adaptive_pool_axis(a, axis, n_out, reduce_mean=True):
+    """Adaptively pool one axis.  Divisor case stays a reshape (cheap);
+    otherwise an averaging-matrix contraction (mean) or per-bin max."""
+    n_in = a.shape[axis]
+    if n_in % n_out == 0:
+        k = n_in // n_out
+        m = jnp.moveaxis(a, axis, -1)
+        m = m.reshape(m.shape[:-1] + (n_out, k))
+        m = jnp.mean(m, -1) if reduce_mean else jnp.max(m, -1)
+        return jnp.moveaxis(m, -1, axis)
+    if reduce_mean:
+        w = _adaptive_avg_matrix(n_in, n_out, a.dtype)
+        m = jnp.tensordot(jnp.moveaxis(a, axis, -1), w.T, axes=1)
+        return jnp.moveaxis(m, -1, axis)
+    m = jnp.moveaxis(a, axis, -1)
+    bins = []
+    for i in range(n_out):
+        lo = (i * n_in) // n_out
+        hi = -(-((i + 1) * n_in) // n_out)
+        bins.append(jnp.max(m[..., lo:hi], axis=-1))
+    return jnp.moveaxis(jnp.stack(bins, axis=-1), -1, axis)
+
+
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
     out_hw = _pair(output_size, 2)
 
     def prim(a):
-        if data_format == "NCHW":
-            n, c, h, w = a.shape
-            oh, ow = out_hw
-            a_ = a.reshape(n, c, oh, h // oh, ow, w // ow)
-            return jnp.mean(a_, axis=(3, 5))
-        n, h, w, c = a.shape
-        oh, ow = out_hw
-        a_ = a.reshape(n, oh, h // oh, ow, w // ow, c)
-        return jnp.mean(a_, axis=(2, 4))
+        axes = (2, 3) if data_format == "NCHW" else (1, 2)
+        for ax, o in zip(axes, out_hw):
+            a = _adaptive_pool_axis(a, ax, o, reduce_mean=True)
+        return a
     return apply_op("adaptive_avg_pool2d", prim, (_t(x),))
 
 
@@ -587,18 +625,15 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     out_hw = _pair(output_size, 2)
 
     def prim(a):
-        n, c, h, w = a.shape
-        oh, ow = out_hw
-        a_ = a.reshape(n, c, oh, h // oh, ow, w // ow)
-        return jnp.max(a_, axis=(3, 5))
+        for ax, o in zip((2, 3), out_hw):
+            a = _adaptive_pool_axis(a, ax, o, reduce_mean=False)
+        return a
     return apply_op("adaptive_max_pool2d", prim, (_t(x),))
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
     def prim(a):
-        n, c, l = a.shape
-        o = int(output_size)
-        return jnp.mean(a.reshape(n, c, o, l // o), axis=3)
+        return _adaptive_pool_axis(a, 2, int(output_size), reduce_mean=True)
     return apply_op("adaptive_avg_pool1d", prim, (_t(x),))
 
 
@@ -622,11 +657,36 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
     jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
 
+    def _ac_weights(n_in, n_out, dtype):
+        """[n_out, n_in] two-tap linear interpolation matrix with
+        align_corners=True coordinates (src = i*(in-1)/(out-1))."""
+        if n_out == 1 or n_in == 1:
+            w = jnp.zeros((n_out, n_in), dtype).at[:, 0].set(1.0)
+            return w
+        src = jnp.arange(n_out, dtype=jnp.float32) * (n_in - 1) / (n_out - 1)
+        lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, n_in - 2)
+        frac = src - lo
+        w = jnp.zeros((n_out, n_in), jnp.float32)
+        rows = jnp.arange(n_out)
+        w = w.at[rows, lo].add(1.0 - frac).at[rows, lo + 1].add(frac)
+        return w.astype(dtype)
+
     def prim(a):
         if data_format.startswith("NC"):
             out_shape = a.shape[:2] + tuple(size)
+            spatial_axes = list(range(2, a.ndim))
         else:
             out_shape = (a.shape[0],) + tuple(size) + (a.shape[-1],)
+            spatial_axes = list(range(1, a.ndim - 1))
+        if align_corners and jmode == "linear":
+            # separable two-tap resample per spatial dim (torch/paddle
+            # align_corners=True semantics, which jax.image.resize lacks)
+            out = a
+            for ax, n_out in zip(spatial_axes, size):
+                w = _ac_weights(out.shape[ax], n_out, out.dtype)
+                out = jnp.moveaxis(
+                    jnp.tensordot(w, jnp.moveaxis(out, ax, 0), axes=1), 0, ax)
+            return out
         return jax.image.resize(a, out_shape, method=jmode)
     return apply_op("interpolate", prim, (x,))
 
@@ -1734,3 +1794,249 @@ def spectral_norm(weight, n_power_iterations=1, eps=1e-12, dim=0, name=None):
         sigma = u @ wm @ v
         return w / jnp.maximum(sigma, eps)
     return apply_op("spectral_norm", prim, (_t(weight),))
+
+
+# ================= transpose convs (1d/3d) =================
+
+def _tconv_output_padding(output_size, in_spatial, stride, padding, kernel,
+                          dilation):
+    """Solve output_padding so the transpose conv yields output_size
+    (paddle semantics: output_size picks among the stride-many valid
+    inverse sizes)."""
+    op = []
+    for o, i, s, p, k, d in zip(output_size, in_spatial, stride, padding,
+                                kernel, dilation):
+        base = (i - 1) * s - 2 * p + (k - 1) * d + 1
+        extra = int(o) - base
+        if not (0 <= extra < s):
+            raise ValueError(
+                f"output_size {o} unreachable: valid range "
+                f"[{base}, {base + s - 1}] for this stride/pad/kernel")
+        op.append(extra)
+    return tuple(op)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", output_size=None, name=None):
+    """1d transpose conv via the 2d path with a unit spatial axis."""
+    x = _t(x)
+    w = _t(weight)
+    if data_format == "NLC":
+        x = x.transpose([0, 2, 1])
+    if output_size is not None:
+        output_padding = _tconv_output_padding(
+            [int(v) for v in (output_size if isinstance(output_size, (list, tuple))
+                              else [output_size])][-1:],
+            [x.shape[2]], [_pair(stride, 1)[0]], [_pair(padding, 1)[0]],
+            [w.shape[2]], [_pair(dilation, 1)[0]])[0]
+    x4 = x.reshape([x.shape[0], x.shape[1], 1, x.shape[2]])
+    w4 = w.reshape([w.shape[0], w.shape[1], 1, w.shape[2]])
+    out = conv2d_transpose(
+        x4, w4, bias=bias, stride=(1, _pair(stride, 1)[0]),
+        padding=(0, _pair(padding, 1)[0]),
+        output_padding=(0, _pair(output_padding, 1)[0]),
+        groups=groups, dilation=(1, _pair(dilation, 1)[0]))
+    out = out.reshape([out.shape[0], out.shape[1], out.shape[3]])
+    return out.transpose([0, 2, 1]) if data_format == "NLC" else out
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", output_size=None, name=None):
+    nd = 3
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    p = _pair(padding, nd)
+    x = _t(x)
+    if data_format == "NDHWC":
+        x = x.transpose([0, 4, 1, 2, 3])
+    if output_size is not None:
+        w_ = _t(weight)
+        op = _tconv_output_padding(
+            [int(v) for v in output_size][-3:], list(x.shape[2:5]),
+            stride, p, [w_.shape[2], w_.shape[3], w_.shape[4]], dilation)
+    else:
+        op = _pair(output_padding, nd)
+
+    def prim(a, w, *b):
+        # weight layout [in, out//groups, kD, kH, kW]
+        w_t = jnp.swapaxes(w, 0, 1)
+        w_t = jnp.flip(w_t, axis=(-3, -2, -1))
+        ks = [(w.shape[2 + i] - 1) * dilation[i] + 1 for i in range(nd)]
+        pad_cfg = [(ks[i] - 1 - p[i], ks[i] - 1 - p[i] + op[i])
+                   for i in range(nd)]
+        dn = jax.lax.conv_dimension_numbers(
+            a.shape, w_t.shape, ("NCDHW", "OIDHW", "NCDHW"))
+        out = jax.lax.conv_general_dilated(
+            a, w_t, window_strides=(1, 1, 1), padding=pad_cfg,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1, 1)
+        return out
+    args = (x, _t(weight)) + ((_t(bias),) if bias is not None else ())
+    out = apply_op("conv3d_transpose", prim, args)
+    return out.transpose([0, 2, 3, 4, 1]) if data_format == "NDHWC" else out
+
+
+# ================= adaptive pools (1d/3d) =================
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    def prim(a):
+        return _adaptive_pool_axis(a, 2, int(output_size), reduce_mean=False)
+    return apply_op("adaptive_max_pool1d", prim, (_t(x),))
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    out = _pair(output_size, 3)
+
+    def prim(a):
+        for ax, o in zip((2, 3, 4), out):
+            a = _adaptive_pool_axis(a, ax, o, reduce_mean=True)
+        return a
+    return apply_op("adaptive_avg_pool3d", prim, (_t(x),))
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _pair(output_size, 3)
+
+    def prim(a):
+        for ax, o in zip((2, 3, 4), out):
+            a = _adaptive_pool_axis(a, ax, o, reduce_mean=False)
+        return a
+    return apply_op("adaptive_max_pool3d", prim, (_t(x),))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    k = _pair(kernel_size, 1)[0]
+    s = _pair(stride if stride is not None else kernel_size, 1)[0]
+    p = _pair(padding, 1)[0]
+
+    def prim(a, ind):
+        n, c, l = a.shape
+        if output_size is not None:
+            ol = int(output_size[-1])
+        else:
+            ol = (l - 1) * s - 2 * p + k
+        out = jnp.zeros((n, c, ol), a.dtype)
+        return out.at[jnp.arange(n)[:, None, None],
+                      jnp.arange(c)[None, :, None], ind].add(a)
+    return apply_op("max_unpool1d", prim, (_t(x), _t(indices)))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(_t(x), _pair(padding, 4), mode="constant", value=0.0,
+               data_format=data_format)
+
+
+# ================= additional losses =================
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    def prim(a, y):
+        loss = jnp.log1p(jnp.exp(-y * a))
+        return _reduce_loss(loss, reduction)
+    return apply_op("soft_margin_loss", prim, (_t(input), _t(label)))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean", name=None):
+    def prim(a, y, *w):
+        loss = -(y * jax.nn.log_sigmoid(a) + (1 - y) * jax.nn.log_sigmoid(-a))
+        if w:
+            loss = loss * w[0]
+        loss = loss.mean(axis=-1)
+        return _reduce_loss(loss, reduction)
+    args = (_t(input), _t(label)) + ((_t(weight),) if weight is not None else ())
+    return apply_op("multi_label_soft_margin_loss", prim, args)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,  # noqa: A002
+                      reduction="mean", name=None):
+    def prim(a, y, *w):
+        n, c = a.shape
+        correct = a[jnp.arange(n), y][:, None]
+        m = jnp.maximum(0.0, margin - correct + a)
+        if p != 1:
+            m = m ** p
+        if w:
+            m = m * w[0][y][:, None]
+        mask = 1.0 - jax.nn.one_hot(y, c, dtype=a.dtype)
+        loss = (m * mask).sum(axis=1) / c
+        return _reduce_loss(loss, reduction)
+    args = (_t(input), _t(label)) + ((_t(weight),) if weight is not None else ())
+    return apply_op("multi_margin_loss", prim, args)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,  # noqa: A002
+                     epsilon=1e-8, reduction="mean", name=None):
+    def prim(a, y):
+        if log_input:
+            loss = jnp.exp(a) - y * a
+        else:
+            loss = a - y * jnp.log(a + epsilon)
+        if full:
+            # Stirling approximation for the y! term (y > 1 only)
+            stirling = y * jnp.log(y + epsilon) - y + \
+                0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce_loss(loss, reduction)
+    return apply_op("poisson_nll_loss", prim, (_t(input), _t(label)))
+
+
+def gaussian_nll_loss(input, label, variance, full=False,  # noqa: A002
+                      epsilon=1e-6, reduction="mean", name=None):
+    def prim(a, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (a - y) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, a.dtype))
+        return _reduce_loss(loss, reduction)
+    return apply_op("gaussian_nll_loss", prim,
+                    (_t(input), _t(label), _t(variance)))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    dist = distance_function if distance_function is not None else \
+        lambda a, b: jnp.linalg.norm(a - b, axis=-1)
+
+    def prim(a, p, n):
+        d_pos = dist(a, p)
+        d_neg = dist(a, n)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(p, n))
+        loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+        return _reduce_loss(loss, reduction)
+    return apply_op("triplet_margin_with_distance_loss", prim,
+                    (_t(input), _t(positive), _t(negative)))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """reference: python/paddle/nn/functional/loss.py dice_loss behavior —
+    1 - 2|X∩Y| / (|X|+|Y|) over the flattened non-batch dims."""
+    def prim(a, y):
+        n = a.shape[0]
+        yf = jax.nn.one_hot(y.reshape(n, -1), a.shape[-1], dtype=a.dtype) \
+            if y.shape != a.shape else y.reshape(n, -1)
+        af = a.reshape(n, -1)
+        yf = yf.reshape(n, -1)
+        inter = (af * yf).sum(axis=1)
+        union = af.sum(axis=1) + yf.sum(axis=1)
+        return jnp.mean(1.0 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op("dice_loss", prim, (_t(input), _t(label)))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """reference loss.py npair_loss: cross-entropy over anchor·positiveᵀ
+    similarities + L2 on the embeddings."""
+    def prim(a, p, y):
+        sim = a @ p.T                                   # [n, n]
+        tgt = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = tgt / tgt.sum(axis=1, keepdims=True)
+        ce = -(jax.nn.log_softmax(sim, axis=1) * tgt).sum(axis=1).mean()
+        l2 = (jnp.sum(a * a) + jnp.sum(p * p)) / a.shape[0]
+        return ce + l2_reg * l2 * 0.25
+    return apply_op("npair_loss", prim, (_t(anchor), _t(positive), _t(labels)))

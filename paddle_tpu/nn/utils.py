@@ -1,7 +1,16 @@
-"""nn.utils parity helpers (reference: python/paddle/nn/utils/)."""
+"""nn.utils parity helpers (reference: python/paddle/nn/utils/).
+
+weight_norm / spectral_norm are real reparameterizations, implemented as
+forward-pre-hooks on the wrapped layer (the TPU-native analog of the
+reference's in-place parameter surgery in weight_norm_hook.py /
+spectral_norm_hook.py): the underlying direction/raw parameters stay
+trainable; the effective ``weight`` is recomputed from them on every call,
+so autograd flows through the reparameterization.
+"""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -21,13 +30,145 @@ def vector_to_parameters(vec, parameters, name=None):
         offset += n
 
 
+def _norm_except(v, dim):
+    """L2 norm over all axes except ``dim`` (kept, broadcastable)."""
+    axes = tuple(i for i in range(v.ndim) if i != dim % v.ndim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
 def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as g * v / ||v|| (reference
+    weight_norm_hook.py behavior).  Adds ``<name>_g``/``<name>_v``
+    parameters; the effective weight is rebuilt by a forward-pre-hook."""
+    w = getattr(layer, name)
+    dim = 0 if dim is None else dim
+    v0 = w._data
+    g0 = _norm_except(v0, dim)
+    g = layer.create_parameter(
+        list(g0.shape), default_initializer=lambda s, dt: g0.astype(dt))
+    v = layer.create_parameter(
+        list(v0.shape), default_initializer=lambda s, dt: v0.astype(dt))
+    layer.add_parameter(f"{name}_g", g)
+    layer.add_parameter(f"{name}_v", v)
+    # the original weight is no longer a trainable parameter
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, inputs):
+        from ..ops._prim import apply_op
+        eff = apply_op(
+            "weight_norm_recompute",
+            lambda gv, vv: gv * vv / jnp.maximum(_norm_except(vv, dim), 1e-12),
+            (getattr(lyr, f"{name}_g"), getattr(lyr, f"{name}_v")))
+        object.__setattr__(lyr, name, eff)
+        return None
+
+    helper = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_hook = helper
+    layer._weight_norm_cfg = (name, dim)
+    _recompute(layer, None)
     return layer
 
 
 def remove_weight_norm(layer, name="weight"):
+    """Fold g * v/||v|| back into a plain ``weight`` parameter."""
+    if not hasattr(layer, "_weight_norm_hook"):
+        return layer
+    nm, dim = layer._weight_norm_cfg
+    g = getattr(layer, f"{nm}_g")._data
+    v = getattr(layer, f"{nm}_v")._data
+    eff = g * v / jnp.maximum(_norm_except(v, dim), 1e-12)
+    layer._weight_norm_hook.remove()
+    del layer._parameters[f"{nm}_g"]
+    del layer._parameters[f"{nm}_v"]
+    if hasattr(layer, nm):
+        try:
+            object.__delattr__(layer, nm)
+        except AttributeError:
+            pass
+    w = layer.create_parameter(
+        list(eff.shape), default_initializer=lambda s, dt: eff.astype(dt))
+    layer.add_parameter(nm, w)
+    del layer._weight_norm_hook, layer._weight_norm_cfg
     return layer
 
 
-def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide ``layer.<name>`` by its largest singular value, estimated by
+    persistent power iteration (reference spectral_norm_hook.py): u/v vectors
+    live as buffers and are refined once per forward."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    wd = w._data
+    rows = wd.shape[dim]
+    cols = int(np.prod(wd.shape)) // rows
+    key = jax.random.PRNGKey(np.random.randint(0, 2 ** 31 - 1))
+    k1, k2 = jax.random.split(key)
+    u0 = jax.random.normal(k1, (rows,), jnp.float32)
+    v0 = jax.random.normal(k2, (cols,), jnp.float32)
+    layer.register_buffer(f"{name}_u", Tensor(u0 / jnp.linalg.norm(u0)))
+    layer.register_buffer(f"{name}_v", Tensor(v0 / jnp.linalg.norm(v0)))
+    orig = layer.create_parameter(
+        list(wd.shape), default_initializer=lambda s, dt: wd.astype(dt))
+    layer.add_parameter(f"{name}_orig", orig)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, inputs):
+        from ..ops._prim import apply_op
+        w_orig = getattr(lyr, f"{name}_orig")
+        u = getattr(lyr, f"{name}_u")._data
+        v = getattr(lyr, f"{name}_v")._data
+        wm_stop = jnp.moveaxis(jax.lax.stop_gradient(w_orig._data), dim, 0) \
+            .reshape(rows, cols).astype(jnp.float32)
+        for _ in range(max(1, n_power_iterations)):
+            v = wm_stop.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = wm_stop @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        lyr._buffers[f"{name}_u"] = Tensor(u)
+        lyr._buffers[f"{name}_v"] = Tensor(v)
+
+        def prim(wo):
+            wm = jnp.moveaxis(wo, dim, 0).reshape(rows, cols)
+            sigma = (u.astype(wo.dtype) @ wm @ v.astype(wo.dtype))
+            return wo / jnp.maximum(sigma, eps)
+
+        eff = apply_op("spectral_norm_recompute", prim, (w_orig,))
+        object.__setattr__(lyr, name, eff)
+        return None
+
+    helper = layer.register_forward_pre_hook(_recompute)
+    layer._spectral_norm_hook = helper
+    _recompute(layer, None)
     return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip over ``p.grad`` (reference
+    python/paddle/nn/utils/clip_grad_norm_.py)."""
+    params = [p for p in parameters if getattr(p, "grad", None) is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(p.grad._data)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(p.grad._data) ** norm_type) for p in params])) \
+            ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite total norm in clip_grad_norm_")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad._data = (p.grad._data * scale).astype(p.grad._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in parameters:
+        if getattr(p, "grad", None) is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)

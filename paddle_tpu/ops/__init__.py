@@ -90,7 +90,8 @@ _METHOD_SOURCES = {
     "lgamma": math.lgamma, "digamma": math.digamma, "nan_to_num": math.nan_to_num,
     # manipulation
     "reshape": manipulation.reshape, "reshape_": manipulation.reshape_,
-    "flatten": manipulation.flatten, "transpose": manipulation.transpose,
+    "flatten": manipulation.flatten, "unflatten": manipulation.unflatten,
+    "transpose": manipulation.transpose,
     "squeeze": manipulation.squeeze, "unsqueeze": manipulation.unsqueeze,
     "split": manipulation.split, "chunk": manipulation.chunk, "tile": manipulation.tile,
     "expand": manipulation.expand, "expand_as": manipulation.expand_as,

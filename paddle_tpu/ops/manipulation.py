@@ -45,6 +45,14 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
     return reshape(x, new_shape)
 
 
+def unflatten(x, axis, shape, name=None):
+    """Split one dim into the given shape (inverse of flatten over that dim)."""
+    x = _t(x)
+    axis = axis % x.ndim
+    new_shape = list(x.shape[:axis]) + list(shape) + list(x.shape[axis + 1:])
+    return reshape(x, new_shape)
+
+
 def transpose(x, perm=None, name=None):
     x = _t(x)
     if perm is None:
@@ -213,9 +221,9 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # n
         k = len(pad) // 2
         width = [(0, 0)] * nd
         if data_format.upper() in ("NCHW", "NCL", "NCDHW"):
-            dims = list(range(nd - k, nd))
-        else:
-            dims = list(range(1, 1 + k))
+            dims = list(range(nd - 1, nd - k - 1, -1))
+        else:  # channels-last: spatial dims end at nd-2
+            dims = list(range(nd - 2, nd - 2 - k, -1))
         for i, d in enumerate(dims):
             width[d] = (pad[2 * i], pad[2 * i + 1])
     jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]

@@ -1,0 +1,103 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py behavior —
+dense blocks with concatenated features + transition layers)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...nn.layer import Layer, Sequential
+from ...ops.manipulation import concat
+
+_ARCH = {
+    121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+    169: (6, 12, 32, 32), 201: (6, 12, 48, 32), 264: (6, 12, 64, 48),
+}
+
+
+class _DenseLayer(Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x):
+        out = self.conv1(nn.functional.relu(self.norm1(x)))
+        out = self.conv2(nn.functional.relu(self.norm2(out)))
+        return concat([x, self.dropout(out)], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(in_c)
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(nn.functional.relu(self.norm(x))))
+
+
+class DenseNet(Layer):
+    def __init__(self, layers: int = 121, growth_rate=None, bn_size: int = 4,
+                 dropout: float = 0.0, num_classes: int = 1000):
+        super().__init__()
+        assert layers in _ARCH, f"supported: {sorted(_ARCH)}"
+        block_cfg = _ARCH[layers]
+        growth_rate = growth_rate or (48 if layers == 161 else 32)
+        init_c = 2 * growth_rate
+        self.num_classes = num_classes
+        self.stem = Sequential(
+            nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_c), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        blocks = []
+        c = init_c
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                blocks.append(_DenseLayer(c, growth_rate, bn_size, dropout))
+                c += growth_rate
+            if i != len(block_cfg) - 1:
+                blocks.append(_Transition(c, c // 2))
+                c //= 2
+        self.blocks = Sequential(*blocks)
+        self.norm_final = nn.BatchNorm2D(c)
+        if num_classes > 0:
+            self.fc = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.blocks(x)
+        x = nn.functional.relu(self.norm_final(x))
+        x = nn.functional.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _densenet(layers, pretrained, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled"
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

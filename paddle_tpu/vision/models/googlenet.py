@@ -1,0 +1,96 @@
+"""GoogLeNet / Inception v1 (reference: python/paddle/vision/models/
+googlenet.py behavior — Inception modules with aux classifiers)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...nn.layer import Layer, Sequential
+from ...ops.manipulation import concat
+
+
+def _conv_relu(in_c, out_c, kernel, stride=1, padding=0):
+    return Sequential(
+        nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding),
+        nn.ReLU(),
+    )
+
+
+class Inception(Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _conv_relu(in_c, c1, 1)
+        self.b2 = Sequential(_conv_relu(in_c, c3r, 1),
+                             _conv_relu(c3r, c3, 3, padding=1))
+        self.b3 = Sequential(_conv_relu(in_c, c5r, 1),
+                             _conv_relu(c5r, c5, 5, padding=2))
+        self.b4 = Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                             _conv_relu(in_c, proj, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class _AuxHead(Layer):
+    def __init__(self, in_c, num_classes):
+        super().__init__()
+        self.conv = _conv_relu(in_c, 128, 1)
+        self.fc1 = nn.Linear(128 * 4 * 4, 1024)
+        self.fc2 = nn.Linear(1024, num_classes)
+        self.dropout = nn.Dropout(0.7)
+
+    def forward(self, x):
+        x = nn.functional.adaptive_avg_pool2d(x, 4)
+        x = self.conv(x).flatten(1)
+        x = nn.functional.relu(self.fc1(x))
+        return self.fc2(self.dropout(x))
+
+
+class GoogLeNet(Layer):
+    """Returns (main, aux1, aux2) logits in train mode, main in eval."""
+
+    def __init__(self, num_classes: int = 1000, with_aux: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_aux = with_aux
+        self.stem = Sequential(
+            _conv_relu(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, ceil_mode=True),
+            _conv_relu(64, 64, 1), _conv_relu(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, ceil_mode=True),
+        )
+        self.inc3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+        self.inc4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+        self.inc5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        self.dropout = nn.Dropout(0.4)
+        self.fc = nn.Linear(1024, num_classes)
+        if with_aux:
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.inc3b(self.inc3a(x)))
+        x = self.inc4a(x)
+        a1 = self.aux1(x) if self.with_aux and self.training else None
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        a2 = self.aux2(x) if self.with_aux and self.training else None
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        x = nn.functional.adaptive_avg_pool2d(x, 1).flatten(1)
+        out = self.fc(self.dropout(x))
+        if self.with_aux and self.training:
+            return out, a1, a2
+        return out
+
+
+def googlenet(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled"
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,145 @@
+"""Inception v3 (reference: python/paddle/vision/models/inceptionv3.py
+behavior — factorized inception blocks A-E)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...nn.layer import Layer, Sequential
+from ...ops.manipulation import concat
+
+
+def _conv_bn(in_c, out_c, kernel, stride=1, padding=0):
+    return Sequential(
+        nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                  bias_attr=False),
+        nn.BatchNorm2D(out_c), nn.ReLU(),
+    )
+
+
+class InceptionA(Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _conv_bn(in_c, 64, 1)
+        self.b2 = Sequential(_conv_bn(in_c, 48, 1),
+                             _conv_bn(48, 64, 5, padding=2))
+        self.b3 = Sequential(_conv_bn(in_c, 64, 1),
+                             _conv_bn(64, 96, 3, padding=1),
+                             _conv_bn(96, 96, 3, padding=1))
+        self.b4 = Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                             _conv_bn(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class InceptionB(Layer):
+    """Grid reduction 35x35 -> 17x17."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _conv_bn(in_c, 384, 3, stride=2)
+        self.b2 = Sequential(_conv_bn(in_c, 64, 1),
+                             _conv_bn(64, 96, 3, padding=1),
+                             _conv_bn(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.pool(x)], axis=1)
+
+
+class InceptionC(Layer):
+    """Factorized 7x7 branches."""
+
+    def __init__(self, in_c, mid):
+        super().__init__()
+        self.b1 = _conv_bn(in_c, 192, 1)
+        self.b2 = Sequential(_conv_bn(in_c, mid, 1),
+                             _conv_bn(mid, mid, (1, 7), padding=(0, 3)),
+                             _conv_bn(mid, 192, (7, 1), padding=(3, 0)))
+        self.b3 = Sequential(_conv_bn(in_c, mid, 1),
+                             _conv_bn(mid, mid, (7, 1), padding=(3, 0)),
+                             _conv_bn(mid, mid, (1, 7), padding=(0, 3)),
+                             _conv_bn(mid, mid, (7, 1), padding=(3, 0)),
+                             _conv_bn(mid, 192, (1, 7), padding=(0, 3)))
+        self.b4 = Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                             _conv_bn(in_c, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class InceptionD(Layer):
+    """Grid reduction 17x17 -> 8x8."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = Sequential(_conv_bn(in_c, 192, 1),
+                             _conv_bn(192, 320, 3, stride=2))
+        self.b2 = Sequential(_conv_bn(in_c, 192, 1),
+                             _conv_bn(192, 192, (1, 7), padding=(0, 3)),
+                             _conv_bn(192, 192, (7, 1), padding=(3, 0)),
+                             _conv_bn(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.pool(x)], axis=1)
+
+
+class InceptionE(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _conv_bn(in_c, 320, 1)
+        self.b2_stem = _conv_bn(in_c, 384, 1)
+        self.b2_a = _conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.b2_b = _conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.b3_stem = Sequential(_conv_bn(in_c, 448, 1),
+                                  _conv_bn(448, 384, 3, padding=1))
+        self.b3_a = _conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.b4 = Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                             _conv_bn(in_c, 192, 1))
+
+    def forward(self, x):
+        b2 = self.b2_stem(x)
+        b3 = self.b3_stem(x)
+        return concat([
+            self.b1(x),
+            concat([self.b2_a(b2), self.b2_b(b2)], axis=1),
+            concat([self.b3_a(b3), self.b3_b(b3)], axis=1),
+            self.b4(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes: int = 1000):
+        super().__init__()
+        self.num_classes = num_classes
+        self.stem = Sequential(
+            _conv_bn(3, 32, 3, stride=2), _conv_bn(32, 32, 3),
+            _conv_bn(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _conv_bn(64, 80, 1), _conv_bn(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160), InceptionC(768, 160),
+            InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048),
+        )
+        self.dropout = nn.Dropout(0.5)
+        if num_classes > 0:
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.blocks(x)
+        x = nn.functional.adaptive_avg_pool2d(x, 1).flatten(1)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled"
+    return InceptionV3(**kwargs)

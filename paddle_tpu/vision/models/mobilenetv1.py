@@ -1,0 +1,53 @@
+"""MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py
+behavior — depthwise-separable conv stacks)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...nn.layer import Layer, Sequential
+
+
+def _conv_bn(in_c, out_c, kernel, stride=1, padding=0, groups=1):
+    return Sequential(
+        nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                  groups=groups, bias_attr=False),
+        nn.BatchNorm2D(out_c),
+        nn.ReLU(),
+    )
+
+
+def _depthwise_separable(in_c, out_c, stride):
+    return Sequential(
+        _conv_bn(in_c, in_c, 3, stride=stride, padding=1, groups=in_c),
+        _conv_bn(in_c, out_c, 1),
+    )
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+        super().__init__()
+        self.num_classes = num_classes
+        s = lambda c: max(1, int(c * scale))
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+               (1024, 2), (1024, 1)]
+        layers = [_conv_bn(3, s(32), 3, stride=2, padding=1)]
+        in_c = s(32)
+        for out_c, stride in cfg:
+            layers.append(_depthwise_separable(in_c, s(out_c), stride))
+            in_c = s(out_c)
+        self.features = Sequential(*layers)
+        if num_classes > 0:
+            self.fc = nn.Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = nn.functional.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled"
+    return MobileNetV1(scale=scale, **kwargs)

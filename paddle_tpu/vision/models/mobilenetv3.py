@@ -1,0 +1,107 @@
+"""MobileNetV3 small/large (reference: python/paddle/vision/models/
+mobilenetv3.py behavior — SE blocks + hardswish)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...nn.layer import Layer, Sequential
+from .mobilenetv2 import _make_divisible
+
+
+class SqueezeExcite(Layer):
+    def __init__(self, channels, reduction=4):
+        super().__init__()
+        mid = _make_divisible(channels // reduction)
+        self.fc1 = nn.Conv2D(channels, mid, 1)
+        self.fc2 = nn.Conv2D(mid, channels, 1)
+
+    def forward(self, x):
+        s = nn.functional.adaptive_avg_pool2d(x, 1)
+        s = nn.functional.relu(self.fc1(s))
+        s = nn.functional.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _MBV3Block(Layer):
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        act_layer = nn.Hardswish if act == "HS" else nn.ReLU
+        layers = []
+        if exp_c != in_c:
+            layers += [nn.Conv2D(in_c, exp_c, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp_c), act_layer()]
+        layers += [nn.Conv2D(exp_c, exp_c, kernel, stride=stride,
+                             padding=(kernel - 1) // 2, groups=exp_c,
+                             bias_attr=False),
+                   nn.BatchNorm2D(exp_c)]
+        if use_se:
+            layers.append(SqueezeExcite(exp_c))
+        layers += [act_layer(),
+                   nn.Conv2D(exp_c, out_c, 1, bias_attr=False),
+                   nn.BatchNorm2D(out_c)]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+_LARGE = [
+    # k, exp, out, se, act, s
+    (3, 16, 16, False, "RE", 1), (3, 64, 24, False, "RE", 2),
+    (3, 72, 24, False, "RE", 1), (5, 72, 40, True, "RE", 2),
+    (5, 120, 40, True, "RE", 1), (5, 120, 40, True, "RE", 1),
+    (3, 240, 80, False, "HS", 2), (3, 200, 80, False, "HS", 1),
+    (3, 184, 80, False, "HS", 1), (3, 184, 80, False, "HS", 1),
+    (3, 480, 112, True, "HS", 1), (3, 672, 112, True, "HS", 1),
+    (5, 672, 160, True, "HS", 2), (5, 960, 160, True, "HS", 1),
+    (5, 960, 160, True, "HS", 1),
+]
+
+_SMALL = [
+    (3, 16, 16, True, "RE", 2), (3, 72, 24, False, "RE", 2),
+    (3, 88, 24, False, "RE", 1), (5, 96, 40, True, "HS", 2),
+    (5, 240, 40, True, "HS", 1), (5, 240, 40, True, "HS", 1),
+    (5, 120, 48, True, "HS", 1), (5, 144, 48, True, "HS", 1),
+    (5, 288, 96, True, "HS", 2), (5, 576, 96, True, "HS", 1),
+    (5, 576, 96, True, "HS", 1),
+]
+
+
+class MobileNetV3(Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000):
+        super().__init__()
+        self.num_classes = num_classes
+        s = lambda c: _make_divisible(c * scale)
+        in_c = s(16)
+        layers = [nn.Conv2D(3, in_c, 3, stride=2, padding=1, bias_attr=False),
+                  nn.BatchNorm2D(in_c), nn.Hardswish()]
+        for k, exp, out, se, act, stride in config:
+            layers.append(_MBV3Block(in_c, s(exp), s(out), k, stride, se, act))
+            in_c = s(out)
+        last_conv = s(config[-1][1])
+        layers += [nn.Conv2D(in_c, last_conv, 1, bias_attr=False),
+                   nn.BatchNorm2D(last_conv), nn.Hardswish()]
+        self.features = Sequential(*layers)
+        if num_classes > 0:
+            self.classifier = Sequential(
+                nn.Linear(last_conv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = nn.functional.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled"
+    return MobileNetV3(_LARGE, 1280, scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled"
+    return MobileNetV3(_SMALL, 1024, scale=scale, **kwargs)

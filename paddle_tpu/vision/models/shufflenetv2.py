@@ -1,0 +1,112 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py
+behavior — channel split + shuffle units)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...nn.layer import Layer, Sequential
+from ...ops.manipulation import concat, split
+
+
+def _conv_bn(in_c, out_c, kernel, stride=1, groups=1, act=True):
+    pad = (kernel - 1) // 2
+    layers = [nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=pad,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act:
+        layers.append(nn.ReLU())
+    return Sequential(*layers)
+
+
+class InvertedResidualUnit(Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = Sequential(
+                _conv_bn(in_c // 2, branch_c, 1),
+                _conv_bn(branch_c, branch_c, 3, stride=1, groups=branch_c,
+                         act=False),
+                _conv_bn(branch_c, branch_c, 1),
+            )
+        else:
+            self.branch1 = Sequential(
+                _conv_bn(in_c, in_c, 3, stride=stride, groups=in_c, act=False),
+                _conv_bn(in_c, branch_c, 1),
+            )
+            self.branch2 = Sequential(
+                _conv_bn(in_c, branch_c, 1),
+                _conv_bn(branch_c, branch_c, 3, stride=stride, groups=branch_c,
+                         act=False),
+                _conv_bn(branch_c, branch_c, 1),
+            )
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return nn.functional.channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    _STAGE_OUT = {
+        0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+        0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+        1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048),
+    }
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+        super().__init__()
+        self.num_classes = num_classes
+        stem_c, c2, c3, c4, last_c = self._STAGE_OUT[scale]
+        self.conv1 = _conv_bn(3, stem_c, 3, stride=2)
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = stem_c
+        for out_c, repeat in ((c2, 4), (c3, 8), (c4, 4)):
+            units = [InvertedResidualUnit(in_c, out_c, 2)]
+            for _ in range(repeat - 1):
+                units.append(InvertedResidualUnit(out_c, out_c, 1))
+            stages.append(Sequential(*units))
+            in_c = out_c
+        self.stages = Sequential(*stages)
+        self.conv_last = _conv_bn(in_c, last_c, 1)
+        if num_classes > 0:
+            self.fc = nn.Linear(last_c, num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.stages(x)
+        x = self.conv_last(x)
+        x = nn.functional.adaptive_avg_pool2d(x, 1)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled"
+    return ShuffleNetV2(0.25, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled"
+    return ShuffleNetV2(0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled"
+    return ShuffleNetV2(1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled"
+    return ShuffleNetV2(1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled"
+    return ShuffleNetV2(2.0, **kwargs)

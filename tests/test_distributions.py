@@ -1,0 +1,273 @@
+"""Widened paddle.distribution tests (reference: python/paddle/distribution/).
+
+log_prob/entropy numerics are oracle-checked against torch.distributions;
+sampling is checked by moment-matching on large draws.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+torch = pytest.importorskip("torch")
+td = torch.distributions
+
+
+def _lp(dist, value):
+    return np.asarray(dist.log_prob(value)._data)
+
+
+def test_gamma_oracle(rng):
+    a = np.asarray([0.5, 2.0, 5.0], "float32")
+    b = np.asarray([1.0, 0.5, 2.0], "float32")
+    x = np.asarray([0.3, 1.7, 2.2], "float32")
+    got = _lp(D.Gamma(a, b), x)
+    want = td.Gamma(torch.tensor(a), torch.tensor(b)) \
+        .log_prob(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(D.Gamma(a, b).entropy()._data),
+        td.Gamma(torch.tensor(a), torch.tensor(b)).entropy().numpy(),
+        rtol=1e-5)
+    # KL matches torch
+    got_kl = np.asarray(D.Gamma(a, b).kl_divergence(D.Gamma(b, a))._data)
+    want_kl = td.kl_divergence(td.Gamma(torch.tensor(a), torch.tensor(b)),
+                               td.Gamma(torch.tensor(b), torch.tensor(a))).numpy()
+    np.testing.assert_allclose(got_kl, want_kl, rtol=1e-4)
+
+
+def test_laplace_oracle(rng):
+    loc = np.asarray([0.0, 1.0], "float32")
+    scale = np.asarray([1.0, 2.5], "float32")
+    x = np.asarray([-1.0, 3.0], "float32")
+    p, q = D.Laplace(loc, scale), td.Laplace(torch.tensor(loc), torch.tensor(scale))
+    np.testing.assert_allclose(_lp(p, x), q.log_prob(torch.tensor(x)).numpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p.entropy()._data),
+                               q.entropy().numpy(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p.cdf(x)._data),
+                               q.cdf(torch.tensor(x)).numpy(), rtol=1e-5)
+    qv = np.asarray([0.2, 0.8], "float32")
+    np.testing.assert_allclose(np.asarray(p.icdf(qv)._data),
+                               q.icdf(torch.tensor(qv)).numpy(), rtol=1e-5)
+    got_kl = np.asarray(D.Laplace(loc, scale).kl_divergence(
+        D.Laplace(scale, loc + 1))._data)
+    want_kl = td.kl_divergence(
+        q, td.Laplace(torch.tensor(scale), torch.tensor(loc + 1))).numpy()
+    np.testing.assert_allclose(got_kl, want_kl, rtol=1e-4)
+
+
+def test_gumbel_oracle(rng):
+    loc = np.asarray([0.0, 2.0], "float32")
+    scale = np.asarray([1.0, 3.0], "float32")
+    x = np.asarray([0.5, 1.0], "float32")
+    p = D.Gumbel(loc, scale)
+    q = td.Gumbel(torch.tensor(loc), torch.tensor(scale))
+    np.testing.assert_allclose(_lp(p, x), q.log_prob(torch.tensor(x)).numpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p.mean._data), q.mean.numpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p.variance._data),
+                               q.variance.numpy(), rtol=1e-5)
+
+
+def test_cauchy_chi2_student_oracle(rng):
+    x = np.asarray([0.5, 2.0], "float32")
+    p = D.Cauchy(np.float32(0.0), np.float32(1.5))
+    q = td.Cauchy(0.0, 1.5)
+    np.testing.assert_allclose(_lp(p, x), q.log_prob(torch.tensor(x)).numpy(),
+                               rtol=1e-5)
+    df = np.asarray([3.0, 7.0], "float32")
+    np.testing.assert_allclose(
+        _lp(D.Chi2(df), x),
+        td.Chi2(torch.tensor(df)).log_prob(torch.tensor(x)).numpy(), rtol=1e-5)
+    p = D.StudentT(df, np.float32(0.5), np.float32(2.0))
+    q = td.StudentT(torch.tensor(df), 0.5, 2.0)
+    np.testing.assert_allclose(_lp(p, x), q.log_prob(torch.tensor(x)).numpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p.entropy()._data),
+                               q.entropy().numpy(), rtol=1e-5)
+
+
+def test_poisson_binomial_geometric_oracle(rng):
+    rate = np.asarray([1.0, 4.0], "float32")
+    k = np.asarray([2.0, 3.0], "float32")
+    np.testing.assert_allclose(
+        _lp(D.Poisson(rate), k),
+        td.Poisson(torch.tensor(rate)).log_prob(torch.tensor(k)).numpy(),
+        rtol=1e-5)
+    n = np.asarray([10.0, 10.0], "float32")
+    pr = np.asarray([0.3, 0.7], "float32")
+    np.testing.assert_allclose(
+        _lp(D.Binomial(n, pr), k),
+        td.Binomial(torch.tensor(n), torch.tensor(pr))
+        .log_prob(torch.tensor(k)).numpy(), rtol=1e-4)
+    # paddle counts trials (k >= 1); torch counts failures (k >= 0)
+    np.testing.assert_allclose(
+        _lp(D.Geometric(pr), k),
+        td.Geometric(torch.tensor(pr)).log_prob(torch.tensor(k - 1)).numpy(),
+        rtol=1e-5)
+
+
+def test_lognormal_oracle(rng):
+    x = np.asarray([0.5, 2.0], "float32")
+    p = D.LogNormal(np.float32(0.3), np.float32(0.8))
+    q = td.LogNormal(0.3, 0.8)
+    np.testing.assert_allclose(_lp(p, x), q.log_prob(torch.tensor(x)).numpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(p.mean._data), float(q.mean), rtol=1e-5)
+    np.testing.assert_allclose(float(p.variance._data), float(q.variance),
+                               rtol=1e-4)
+
+
+def test_multinomial_multivariate_normal_oracle(rng):
+    probs = np.asarray([0.2, 0.3, 0.5], "float32")
+    x = np.asarray([2.0, 3.0, 5.0], "float32")
+    np.testing.assert_allclose(
+        _lp(D.Multinomial(10, probs), x),
+        td.Multinomial(10, torch.tensor(probs))
+        .log_prob(torch.tensor(x)).numpy(), rtol=1e-5)
+
+    loc = np.asarray([0.5, -1.0], "float32")
+    cov = np.asarray([[2.0, 0.4], [0.4, 1.0]], "float32")
+    v = np.asarray([0.1, 0.2], "float32")
+    p = D.MultivariateNormal(loc, covariance_matrix=cov)
+    q = td.MultivariateNormal(torch.tensor(loc),
+                              covariance_matrix=torch.tensor(cov))
+    np.testing.assert_allclose(_lp(p, v), q.log_prob(torch.tensor(v)).numpy(),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(p.entropy()._data),
+                               q.entropy().numpy(), rtol=1e-4)
+    s = np.asarray(p.sample((4000,))._data)
+    np.testing.assert_allclose(s.mean(0), loc, atol=0.15)
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.2)
+
+
+def test_independent_wrapper(rng):
+    base = D.Normal(np.zeros((3, 4), "float32"), np.ones((3, 4), "float32"))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (3,)
+    assert ind.event_shape == (4,)
+    x = rng.standard_normal((3, 4)).astype("float32")
+    got = _lp(ind, x)
+    want = td.Independent(td.Normal(torch.zeros(3, 4), torch.ones(3, 4)), 1) \
+        .log_prob(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_continuous_bernoulli_oracle(rng):
+    probs = np.asarray([0.2, 0.5, 0.9], "float32")
+    x = np.asarray([0.1, 0.6, 0.7], "float32")
+    got = _lp(D.ContinuousBernoulli(probs), x)
+    want = td.ContinuousBernoulli(torch.tensor(probs)) \
+        .log_prob(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+    s = np.asarray(D.ContinuousBernoulli(probs).sample((5000,))._data)
+    want_mean = td.ContinuousBernoulli(torch.tensor(probs)).mean.numpy()
+    np.testing.assert_allclose(s.mean(0), want_mean, atol=0.03)
+
+
+def test_lkj_cholesky(rng):
+    p = D.LKJCholesky(3, 1.5)
+    L = np.asarray(p.sample((200,))._data)
+    # valid cholesky factors of correlation matrices
+    R = L @ np.swapaxes(L, -1, -2)
+    np.testing.assert_allclose(np.diagonal(R, axis1=-2, axis2=-1), 1.0,
+                               atol=1e-4)
+    assert (np.linalg.eigvalsh(R) > -1e-5).all()
+    # log_prob matches torch's
+    q = td.LKJCholesky(3, 1.5)
+    Lt = q.sample((4,))
+    got = _lp(p, Lt.numpy())
+    want = q.log_prob(Lt).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_transforms_roundtrip_and_logdet(rng):
+    x = rng.standard_normal((5,)).astype("float32")
+    for t, tt in [
+        (D.ExpTransform(), td.transforms.ExpTransform()),
+        (D.SigmoidTransform(), td.transforms.SigmoidTransform()),
+        (D.TanhTransform(), td.transforms.TanhTransform()),
+        (D.AffineTransform(1.5, -2.0), td.transforms.AffineTransform(1.5, -2.0)),
+    ]:
+        y = np.asarray(t.forward(x)._data)
+        want_y = tt(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(y, want_y, rtol=1e-4, atol=1e-5)
+        back = np.asarray(t.inverse(y)._data)
+        np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-4)
+        ld = np.asarray(t.forward_log_det_jacobian(x)._data)
+        want_ld = tt.log_abs_det_jacobian(
+            torch.tensor(x), torch.tensor(want_y)).numpy()
+        np.testing.assert_allclose(ld, want_ld, rtol=1e-4, atol=1e-5)
+
+
+def test_stick_breaking_transform(rng):
+    x = rng.standard_normal((4,)).astype("float32")
+    t = D.StickBreakingTransform()
+    y = np.asarray(t.forward(x)._data)
+    assert y.shape == (5,)
+    np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+    assert (y > 0).all()
+    back = np.asarray(t.inverse(y)._data)
+    np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-4)
+    tt = td.transforms.StickBreakingTransform()
+    want_ld = tt.log_abs_det_jacobian(
+        torch.tensor(x), torch.tensor(y)).numpy()
+    got_ld = np.asarray(t.forward_log_det_jacobian(x)._data)
+    np.testing.assert_allclose(got_ld, want_ld, rtol=1e-4, atol=1e-5)
+
+
+def test_transformed_distribution_lognormal_equiv(rng):
+    base = D.Normal(np.float32(0.2), np.float32(0.7))
+    tdist = D.TransformedDistribution(base, D.ExpTransform())
+    x = np.asarray([0.5, 1.5, 3.0], "float32")
+    got = _lp(tdist, x)
+    want = _lp(D.LogNormal(np.float32(0.2), np.float32(0.7)), x)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    s = np.asarray(tdist.sample((2000,))._data)
+    assert (s > 0).all()
+
+
+def test_sampling_moments(rng):
+    n = 6000
+    cases = [
+        (D.Gamma(np.float32(3.0), np.float32(2.0)), 1.5, 0.75),
+        (D.Laplace(np.float32(1.0), np.float32(0.5)), 1.0, 0.5),
+        (D.Gumbel(np.float32(0.0), np.float32(1.0)), 0.5772, np.pi ** 2 / 6),
+        (D.Poisson(np.float32(3.0)), 3.0, 3.0),
+        (D.LogNormal(np.float32(0.0), np.float32(0.5)),
+         np.exp(0.125), (np.exp(0.25) - 1) * np.exp(0.25)),
+    ]
+    for dist, mean, var in cases:
+        s = np.asarray(dist.sample((n,))._data)
+        np.testing.assert_allclose(s.mean(), mean, rtol=0.1, atol=0.05)
+        np.testing.assert_allclose(s.var(), var, rtol=0.2, atol=0.1)
+
+
+def test_poisson_entropy_large_rate():
+    # torch Poisson.entropy is unimplemented; oracle by direct summation
+    def exact(lam, kmax=2000):
+        from scipy.stats import poisson as sp
+        return float(sp(lam).entropy())
+    got = float(np.asarray(D.Poisson(np.float32(100.0)).entropy()._data))
+    np.testing.assert_allclose(got, exact(100.0), rtol=1e-3)
+    got_small = np.asarray(D.Poisson(np.asarray([1.0, 30.0], "float32"))
+                           .entropy()._data)
+    np.testing.assert_allclose(got_small, [exact(1.0), exact(30.0)],
+                               rtol=1e-3)
+
+
+def test_chain_transform_mixed_event_rank(rng):
+    """Elementwise + event-reducing stages in one chain: ldj shapes reduce
+    consistently (regression: broadcast error / wrong sum)."""
+    x = rng.standard_normal((7, 4)).astype("float32")  # B != k
+    chain = D.ChainTransform([D.ExpTransform(), D.SoftmaxTransform()])
+    y = chain.forward(x)
+    assert tuple(np.asarray(y._data).shape) == (7, 4)
+    t = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                          D.StickBreakingTransform()])
+    ld = t.forward_log_det_jacobian(x)
+    assert np.asarray(ld._data).shape == (7,)
+    assert np.isfinite(np.asarray(ld._data)).all()

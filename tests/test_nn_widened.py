@@ -1,0 +1,305 @@
+"""Widened nn layer surface tests (reference: python/paddle/nn/layer/).
+
+Torch-oracle numerics for the new losses and reparameterizations; shape and
+behavior checks for the new pool/pad/conv/transformer layers.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn.utils import (
+    clip_grad_norm_, clip_grad_value_, remove_weight_norm, spectral_norm,
+    weight_norm,
+)
+
+torch = pytest.importorskip("torch")
+T = paddle.to_tensor
+
+
+def _np(x):
+    return np.asarray(x._data)
+
+
+# ---------------- losses vs torch ----------------
+
+def test_soft_margin_loss_oracle(rng):
+    a = rng.standard_normal((4, 5)).astype("float32")
+    y = np.sign(rng.standard_normal((4, 5))).astype("float32")
+    got = _np(F.soft_margin_loss(T(a), T(y)))
+    want = torch.nn.functional.soft_margin_loss(
+        torch.tensor(a), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_multi_label_soft_margin_oracle(rng):
+    a = rng.standard_normal((4, 5)).astype("float32")
+    y = (rng.random((4, 5)) > 0.5).astype("float32")
+    got = _np(F.multi_label_soft_margin_loss(T(a), T(y)))
+    want = torch.nn.functional.multilabel_soft_margin_loss(
+        torch.tensor(a), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_multi_margin_loss_oracle(rng):
+    a = rng.standard_normal((6, 4)).astype("float32")
+    y = rng.integers(0, 4, 6).astype("int64")
+    got = _np(F.multi_margin_loss(T(a), T(y.astype("int32"))))
+    want = torch.nn.functional.multi_margin_loss(
+        torch.tensor(a), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_poisson_nll_oracle(rng):
+    a = rng.standard_normal((4, 5)).astype("float32")
+    y = rng.poisson(2.0, (4, 5)).astype("float32")
+    for log_input in (True, False):
+        for full in (True, False):
+            got = _np(F.poisson_nll_loss(T(np.abs(a) + 0.1), T(y),
+                                         log_input=log_input, full=full))
+            want = torch.nn.functional.poisson_nll_loss(
+                torch.tensor(np.abs(a) + 0.1), torch.tensor(y),
+                log_input=log_input, full=full).numpy()
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_gaussian_nll_oracle(rng):
+    a = rng.standard_normal((4, 5)).astype("float32")
+    y = rng.standard_normal((4, 5)).astype("float32")
+    var = (rng.random((4, 5)) + 0.1).astype("float32")
+    got = _np(F.gaussian_nll_loss(T(a), T(y), T(var)))
+    want = torch.nn.functional.gaussian_nll_loss(
+        torch.tensor(a), torch.tensor(y), torch.tensor(var)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_triplet_with_distance_oracle(rng):
+    a = rng.standard_normal((5, 8)).astype("float32")
+    p = rng.standard_normal((5, 8)).astype("float32")
+    n = rng.standard_normal((5, 8)).astype("float32")
+    got = _np(F.triplet_margin_with_distance_loss(T(a), T(p), T(n), swap=True))
+    want = torch.nn.functional.triplet_margin_with_distance_loss(
+        torch.tensor(a), torch.tensor(p), torch.tensor(n), swap=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_conv1d_transpose_oracle(rng):
+    x = rng.standard_normal((2, 3, 10)).astype("float32")
+    w = rng.standard_normal((3, 4, 3)).astype("float32")
+    got = _np(F.conv1d_transpose(T(x), T(w), stride=2, padding=1))
+    want = torch.nn.functional.conv_transpose1d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv3d_transpose_oracle(rng):
+    x = rng.standard_normal((2, 3, 4, 4, 4)).astype("float32")
+    w = rng.standard_normal((3, 2, 3, 3, 3)).astype("float32")
+    got = _np(F.conv3d_transpose(T(x), T(w), stride=2, padding=1,
+                                 output_padding=1))
+    want = torch.nn.functional.conv_transpose3d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1,
+        output_padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_adaptive_pools_oracle(rng):
+    x = rng.standard_normal((2, 3, 8, 8, 8)).astype("float32")
+    got = _np(F.adaptive_avg_pool3d(T(x), 2))
+    want = torch.nn.functional.adaptive_avg_pool3d(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-6)
+    got = _np(F.adaptive_max_pool3d(T(x), 2))
+    want = torch.nn.functional.adaptive_max_pool3d(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-6)
+    x1 = rng.standard_normal((2, 3, 12)).astype("float32")
+    got = _np(F.adaptive_max_pool1d(T(x1), 4))
+    want = torch.nn.functional.adaptive_max_pool1d(torch.tensor(x1), 4).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-6)
+
+
+def test_max_unpool1d_roundtrip(rng):
+    x = rng.standard_normal((2, 3, 8)).astype("float32")
+    tx = torch.tensor(x)
+    pooled, idx = torch.nn.functional.max_pool1d(tx, 2, return_indices=True)
+    got = _np(F.max_unpool1d(T(pooled.numpy()),
+                             T(idx.numpy().astype("int32")), 2))
+    want = torch.nn.functional.max_unpool1d(pooled, idx, 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+# ---------------- layer classes ----------------
+
+def test_bilinear_layer_oracle(rng):
+    x1 = rng.standard_normal((4, 3)).astype("float32")
+    x2 = rng.standard_normal((4, 5)).astype("float32")
+    layer = nn.Bilinear(3, 5, 2)
+    got = _np(layer(T(x1), T(x2)))
+    tl = torch.nn.Bilinear(3, 5, 2)
+    with torch.no_grad():
+        tl.weight.copy_(torch.tensor(np.asarray(layer.weight._data)))
+        tl.bias.copy_(torch.tensor(np.asarray(layer.bias._data)[0]))
+    want = tl(torch.tensor(x1), torch.tensor(x2)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_full_shapes(rng):
+    model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32)
+    model.eval()
+    src = T(rng.standard_normal((2, 6, 16)).astype("float32"))
+    tgt = T(rng.standard_normal((2, 5, 16)).astype("float32"))
+    out = model(src, tgt)
+    assert tuple(out.shape) == (2, 5, 16)
+    mask = model.generate_square_subsequent_mask(5)
+    assert tuple(mask.shape) == (5, 5)
+    out2 = model(src, tgt, tgt_mask=mask)
+    assert np.isfinite(_np(out2)).all()
+
+
+def test_transformer_decoder_causal_mask_matters(rng):
+    """With a causal mask, position 0 of the target can't see later
+    positions: perturbing tgt[t>0] must not change out[0]."""
+    layer = nn.TransformerDecoderLayer(16, 4, 32, dropout=0.0)
+    dec = nn.TransformerDecoder(layer, 2)
+    dec.eval()
+    src = rng.standard_normal((1, 6, 16)).astype("float32")
+    tgt = rng.standard_normal((1, 5, 16)).astype("float32")
+    mask = nn.Transformer(16, 4, 1, 1, 32).generate_square_subsequent_mask(5)
+    out1 = _np(dec(T(tgt), T(src), tgt_mask=mask))
+    tgt2 = tgt.copy()
+    tgt2[0, 3:] += 10.0
+    out2 = _np(dec(T(tgt2), T(src), tgt_mask=mask))
+    np.testing.assert_allclose(out1[0, 0], out2[0, 0], rtol=1e-4, atol=1e-5)
+    assert not np.allclose(out1[0, 4], out2[0, 4])
+
+
+def test_weight_norm_roundtrip(rng):
+    lin = nn.Linear(4, 3)
+    x = T(rng.standard_normal((2, 4)).astype("float32"))
+    y0 = _np(lin(x))
+    weight_norm(lin)
+    names = [n for n, _ in lin.named_parameters()]
+    assert "weight_g" in names and "weight_v" in names and "weight" not in names
+    np.testing.assert_allclose(_np(lin(x)), y0, rtol=1e-5, atol=1e-6)
+    loss = (lin(x) ** 2).sum()
+    loss.backward()
+    assert lin.weight_g.grad is not None and lin.weight_v.grad is not None
+    remove_weight_norm(lin)
+    names = [n for n, _ in lin.named_parameters()]
+    assert "weight" in names and "weight_g" not in names
+    np.testing.assert_allclose(_np(lin(x)), y0, rtol=1e-5, atol=1e-6)
+
+
+def test_spectral_norm_normalizes(rng):
+    lin = nn.Linear(6, 6)
+    lin.weight.set_value(5.0 * np.asarray(lin.weight._data))
+    x = T(rng.standard_normal((2, 6)).astype("float32"))
+    spectral_norm(lin)
+    for _ in range(40):
+        lin(x)
+    s = np.linalg.svd(_np_weight(lin), compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-2)
+
+
+def _np_weight(lin):
+    return np.asarray(lin.weight._data)
+
+
+def test_clip_grad_norm(rng):
+    lin = nn.Linear(4, 3)
+    x = T(rng.standard_normal((2, 4)).astype("float32"))
+    (lin(x) ** 2).sum().backward()
+    total = clip_grad_norm_(lin.parameters(), 0.1)
+    g = np.concatenate([np.asarray(p.grad._data).ravel()
+                        for p in lin.parameters()])
+    assert np.linalg.norm(g) <= 0.1 + 1e-5
+    assert float(total._data) > 0
+    clip_grad_value_(lin.parameters(), 1e-3)
+    for p in lin.parameters():
+        assert np.abs(np.asarray(p.grad._data)).max() <= 1e-3 + 1e-9
+
+
+def test_pads_and_shuffles(rng):
+    x = rng.standard_normal((2, 4, 6, 6)).astype("float32")
+    assert tuple(nn.ZeroPad2D([1, 2, 3, 4])(T(x)).shape) == (2, 4, 13, 9)
+    assert tuple(nn.PixelUnshuffle(2)(T(x)).shape) == (2, 16, 3, 3)
+    got = _np(nn.ChannelShuffle(2)(T(x)))
+    want = torch.nn.functional.channel_shuffle(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(got, want)
+    x3 = rng.standard_normal((2, 4, 6)).astype("float32")
+    assert tuple(nn.ZeroPad1D([2, 1])(T(x3)).shape) == (2, 4, 9)
+    x5 = rng.standard_normal((2, 4, 3, 3, 3)).astype("float32")
+    assert tuple(nn.ZeroPad3D([1, 1, 1, 1, 1, 1])(T(x5)).shape) == (2, 4, 5, 5, 5)
+
+
+def test_unflatten_layer(rng):
+    x = rng.standard_normal((2, 12, 3)).astype("float32")
+    out = nn.Unflatten(1, [3, 4])(T(x))
+    assert tuple(out.shape) == (2, 3, 4, 3)
+    np.testing.assert_allclose(_np(out), x.reshape(2, 3, 4, 3))
+
+
+def test_upsampling_layers(rng):
+    x = rng.standard_normal((1, 2, 4, 4)).astype("float32")
+    up_n = nn.UpsamplingNearest2D(scale_factor=2)(T(x))
+    assert tuple(up_n.shape) == (1, 2, 8, 8)
+    up_b = nn.UpsamplingBilinear2D(scale_factor=2)(T(x))
+    want = torch.nn.functional.interpolate(
+        torch.tensor(x), scale_factor=2, mode="bilinear",
+        align_corners=True).numpy()
+    np.testing.assert_allclose(_np(up_b), want, rtol=1e-4, atol=1e-5)
+
+
+def test_rnnt_loss_layer_runs(rng):
+    b, t, u, v = 2, 4, 3, 5
+    logits = rng.standard_normal((b, t, u, v)).astype("float32")
+    labels = rng.integers(1, v, (b, u - 1)).astype("int32")
+    loss = nn.RNNTLoss()(T(logits), T(labels),
+                         T(np.full((b,), t, "int32")),
+                         T(np.full((b,), u - 1, "int32")))
+    assert np.isfinite(float(loss._data))
+
+
+def test_adaptive_pool_non_divisor_oracle(rng):
+    """Regression: adaptive pools must support non-divisor sizes (and
+    upsampling bins) with torch's bin boundaries."""
+    x = rng.standard_normal((2, 3, 5, 7)).astype("float32")
+    got = _np(F.adaptive_avg_pool2d(T(x), (3, 4)))
+    want = torch.nn.functional.adaptive_avg_pool2d(
+        torch.tensor(x), (3, 4)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-6)
+    got = _np(F.adaptive_max_pool2d(T(x), (3, 4)))
+    want = torch.nn.functional.adaptive_max_pool2d(
+        torch.tensor(x), (3, 4)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-6)
+    # 1x1 input pooled UP to 6x6 (the AlexNet-on-small-input case)
+    x1 = rng.standard_normal((1, 4, 1, 1)).astype("float32")
+    got = _np(F.adaptive_avg_pool2d(T(x1), 6))
+    want = torch.nn.functional.adaptive_avg_pool2d(torch.tensor(x1), 6).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-6)
+    x3 = rng.standard_normal((2, 3, 5)).astype("float32")
+    got = _np(F.adaptive_avg_pool1d(T(x3), 3))
+    want = torch.nn.functional.adaptive_avg_pool1d(torch.tensor(x3), 3).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-6)
+
+
+def test_conv_transpose_output_size_and_format(rng):
+    x = rng.standard_normal((2, 3, 10)).astype("float32")
+    w = rng.standard_normal((3, 4, 3)).astype("float32")
+    out = F.conv1d_transpose(T(x), T(w), stride=2, output_size=[22])
+    assert tuple(out.shape) == (2, 4, 22)
+    with pytest.raises(ValueError):
+        F.conv1d_transpose(T(x), T(w), stride=2, output_size=[40])
+    # NLC round-trips through the NCL path
+    x_nlc = np.transpose(x, (0, 2, 1)).copy()
+    out_nlc = F.conv1d_transpose(T(x_nlc), T(w), stride=2, data_format="NLC")
+    out_ncl = F.conv1d_transpose(T(x), T(w), stride=2)
+    np.testing.assert_allclose(np.asarray(out_nlc._data),
+                               np.transpose(np.asarray(out_ncl._data),
+                                            (0, 2, 1)), rtol=1e-5)
+    x2 = rng.standard_normal((1, 3, 6, 6)).astype("float32")
+    w2 = rng.standard_normal((3, 2, 3, 3)).astype("float32")
+    out2 = F.conv2d_transpose(T(x2), T(w2), stride=2, output_size=[14, 14])
+    assert tuple(out2.shape) == (1, 2, 14, 14)

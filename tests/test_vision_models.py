@@ -1,0 +1,92 @@
+"""Vision model zoo tests (reference: python/paddle/vision/models/).
+
+Small spatial inputs keep single-CPU CI fast; every family is constructed
+and run forward, and one family is trained one step to check grads flow.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+T = paddle.to_tensor
+
+
+def _img(rng, size=64, batch=1):
+    return T(rng.standard_normal((batch, 3, size, size)).astype("float32"))
+
+
+def _check(out, num_classes=10):
+    assert tuple(out.shape) == (1, num_classes)
+    assert np.isfinite(np.asarray(out._data)).all()
+
+
+def test_alexnet(rng):
+    _check(M.alexnet(num_classes=10)(_img(rng)))
+
+
+def test_vgg(rng):
+    _check(M.vgg11(num_classes=10)(_img(rng)))
+    _check(M.vgg11(batch_norm=True, num_classes=10)(_img(rng)))
+
+
+def test_squeezenet(rng):
+    _check(M.squeezenet1_0(num_classes=10)(_img(rng)))
+    _check(M.squeezenet1_1(num_classes=10)(_img(rng)))
+
+
+def test_mobilenets(rng):
+    _check(M.mobilenet_v1(scale=0.25, num_classes=10)(_img(rng, 32)))
+    _check(M.mobilenet_v2(scale=0.35, num_classes=10)(_img(rng, 32)))
+
+
+def test_mobilenet_v3(rng):
+    _check(M.mobilenet_v3_small(scale=0.5, num_classes=10)(_img(rng, 32)))
+
+
+def test_shufflenet(rng):
+    _check(M.shufflenet_v2_x0_25(num_classes=10)(_img(rng, 32)))
+
+
+def test_densenet(rng):
+    _check(M.densenet121(num_classes=10)(_img(rng, 32)))
+
+
+def test_googlenet(rng):
+    m = M.googlenet(num_classes=10)
+    m.eval()
+    _check(m(_img(rng, 64)))
+    m.train()
+    out = m(_img(rng, 128))
+    assert isinstance(out, tuple) and len(out) == 3
+    for o in out:
+        _check(o)
+
+
+def test_inception_v3(rng):
+    _check(M.inception_v3(num_classes=10)(_img(rng, 96)))
+
+
+def test_resnext(rng):
+    _check(M.resnext50_32x4d(num_classes=10)(_img(rng, 32)))
+
+
+def test_wide_resnet(rng):
+    _check(M.wide_resnet101_2(num_classes=10)(_img(rng, 32)))
+
+
+def test_vision_model_trains(rng):
+    """One SGD step on the smallest new family: loss finite, params move."""
+    m = M.mobilenet_v2(scale=0.25, num_classes=4)
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    x = _img(rng, 32, batch=2)
+    y = T(np.asarray([0, 3], "int64"))
+    before = np.asarray(m.features[0][0].weight._data).copy()
+    loss = paddle.nn.CrossEntropyLoss()(m(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    assert np.isfinite(float(loss._data))
+    after = np.asarray(m.features[0][0].weight._data)
+    assert not np.allclose(before, after)
