@@ -37,17 +37,20 @@ __version__ = version
 
 
 def disable_static(place=None):
-    """Eager (dygraph) mode is the only mode; kept for API parity."""
+    from . import static as _static
+    _static.disable_static()
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static "
-        "(XLA compilation) instead.")
+    """Switch to static capture/replay mode (static.Program + Executor over
+    the op-record seam; see paddle_tpu/static/__init__.py)."""
+    from . import static as _static
+    _static.enable_static()
 
 
 def in_dynamic_mode():
-    return True
+    from . import static as _static
+    return not _static.in_static_mode()
 
 
 _device = [None]

@@ -57,20 +57,145 @@ class functional:
         return Tensor(fb.astype(dtype))
 
 
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    """Window function table (reference: python/paddle/audio/functional/
+    window.py surface — the scipy-style periodic/symmetric windows)."""
+    n = int(win_length)
+    m = n if fftbins else n - 1
+    k = np.arange(n, dtype="float64")
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / max(m, 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / max(m, 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / max(m, 1))
+             + 0.08 * np.cos(4 * np.pi * k / max(m, 1)))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * k / max(m, 1) - 1.0)
+    elif name in ("rect", "ones", "boxcar"):
+        w = np.ones(n)
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((k - m / 2) / std) ** 2)
+    elif name == "cosine":
+        w = np.sin(np.pi * (k + 0.5) / n)
+    elif name == "triang":
+        w = 1.0 - np.abs((k - (n - 1) / 2) / ((n + n % 2) / 2))
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w.astype(dtype))
+
+
+def _power_to_db(magnitude, ref_value=1.0, amin=1e-10, top_db=None):
+    x = magnitude
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return log_spec
+
+
+def _create_dct_np(n_mfcc, n_mels, norm="ortho"):
+    k = np.arange(n_mels, dtype="float64")
+    basis = np.cos(np.pi / n_mels * (k + 0.5)[None, :]
+                   * np.arange(n_mfcc, dtype="float64")[:, None])
+    if norm == "ortho":
+        basis[0] *= 1.0 / math.sqrt(2.0)
+        basis *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return basis  # [n_mfcc, n_mels]
+
+
+functional.get_window = staticmethod(get_window)
+functional.power_to_db = staticmethod(
+    lambda magnitude, ref_value=1.0, amin=1e-10, top_db=None:
+        Tensor(_power_to_db(magnitude._data if isinstance(magnitude, Tensor)
+                            else jnp.asarray(magnitude),
+                            ref_value, amin, top_db)))
+functional.create_dct = staticmethod(
+    lambda n_mfcc, n_mels, norm="ortho", dtype="float32":
+        Tensor(_create_dct_np(n_mfcc, n_mels, norm).T.astype(dtype)))
+
+
 class features:
+    """Audio feature extraction layers (reference: python/paddle/audio/
+    features/layers.py — Spectrogram, MelSpectrogram, LogMelSpectrogram,
+    MFCC).  Built on signal.stft; framing/FFT/mel-projection are all
+    static-shape jnp ops, so the layers jit cleanly."""
+
     class Spectrogram:
         def __init__(self, n_fft=512, hop_length=None, win_length=None,
-                     power=2.0, **kw):
+                     window="hann", power=2.0, center=True,
+                     pad_mode="reflect", dtype="float32"):
             self.n_fft = n_fft
             self.hop = hop_length or n_fft // 4
             self.win = win_length or n_fft
             self.power = power
+            self.center, self.pad_mode = center, pad_mode
+            self.window = get_window(window, self.win, dtype="float64")
 
         def __call__(self, x):
-            arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-            window = jnp.hanning(self.win)
-            n_frames = 1 + (arr.shape[-1] - self.win) // self.hop
-            frames = jnp.stack([arr[..., i * self.hop:i * self.hop + self.win]
-                                for i in range(n_frames)], axis=-2)
-            spec = jnp.abs(jnp.fft.rfft(frames * window, n=self.n_fft)) ** self.power
-            return Tensor(jnp.swapaxes(spec, -1, -2))
+            from ..signal import stft
+            spec = stft(x, self.n_fft, self.hop, self.win,
+                        window=self.window, center=self.center,
+                        pad_mode=self.pad_mode)
+            arr = spec._data
+
+            def prim(s):
+                mag = jnp.abs(s)
+                return mag if self.power == 1.0 else mag ** self.power
+            return Tensor(prim(arr).astype(jnp.float32))
+
+    class MelSpectrogram:
+        def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                     win_length=None, window="hann", power=2.0, center=True,
+                     pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                     htk=False, norm="slaney", dtype="float32"):
+            self.spectrogram = features.Spectrogram(
+                n_fft, hop_length, win_length, window, power, center, pad_mode)
+            self.fbank = functional.compute_fbank_matrix(
+                sr, n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk,
+                norm=norm, dtype=dtype)      # [n_mels, F]
+
+        def __call__(self, x):
+            spec = self.spectrogram(x)._data           # [..., F, T]
+            mel = jnp.einsum("mf,...ft->...mt", self.fbank._data, spec)
+            return Tensor(mel)
+
+    class LogMelSpectrogram:
+        def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                     win_length=None, window="hann", power=2.0, center=True,
+                     pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                     htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                     top_db=None, dtype="float32"):
+            self.mel = features.MelSpectrogram(
+                sr, n_fft, hop_length, win_length, window, power, center,
+                pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+            self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+        def __call__(self, x):
+            m = self.mel(x)._data
+            return Tensor(_power_to_db(m, self.ref_value, self.amin,
+                                       self.top_db))
+
+    class MFCC:
+        def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                     win_length=None, window="hann", power=2.0, center=True,
+                     pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                     htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                     top_db=None, dtype="float32"):
+            self.logmel = features.LogMelSpectrogram(
+                sr, n_fft, hop_length, win_length, window, power, center,
+                pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+                top_db, dtype)
+            self.dct = jnp.asarray(_create_dct_np(n_mfcc, n_mels),
+                                   jnp.float32)  # [n_mfcc, n_mels]
+
+        def __call__(self, x):
+            lm = self.logmel(x)._data                  # [..., n_mels, T]
+            return Tensor(jnp.einsum("cm,...mt->...ct", self.dct, lm))

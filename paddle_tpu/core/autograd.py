@@ -222,7 +222,10 @@ def apply(name: str, prim: Callable, tensor_args: Sequence, kwargs: dict | None 
                     out = prim(*arrays, **kwargs)
         if flags.flag("check_nan_inf") and not tracing:
             _check_nan_inf(name, out if isinstance(out, (tuple, list)) else (out,))
-        return _wrap_outputs(out, None)
+        res = _wrap_outputs(out, None)
+        if _STATIC_RECORD_HOOK is not None:
+            _STATIC_RECORD_HOOK(name, prim, kwargs, tensor_args, res)
+        return res
 
     # close over only the NON-diff inputs: diff arrays arrive as arguments,
     # and keeping a second reference to them (or their amp-cast copies) here
@@ -250,7 +253,16 @@ def apply(name: str, prim: Callable, tensor_args: Sequence, kwargs: dict | None 
     )
     if flags.flag("check_nan_inf"):
         _check_nan_inf(name, flat)
-    return _wrap_outputs(out, node)
+    res = _wrap_outputs(out, node)
+    if _STATIC_RECORD_HOOK is not None:
+        _STATIC_RECORD_HOOK(name, prim, kwargs, tensor_args, res)
+    return res
+
+
+# paddle.static's Program capture hook: when set, every apply() call is
+# reported as (op_name, prim, kwargs, input_tensors, output_tensors) —
+# the seam static.program_guard records through (see static/__init__.py)
+_STATIC_RECORD_HOOK = None
 
 
 def _wrap_outputs(out, node):

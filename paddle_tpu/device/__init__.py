@@ -166,6 +166,24 @@ class _MemNamespace:
     def empty_cache():
         pass
 
+    @classmethod
+    def memory_summary(cls, device=None):
+        """Human-readable allocator state (paddle.device.cuda.memory_summary
+        analog over the PJRT allocator stats)."""
+        s = cls._stats(_dev_id(device))
+        if not s:
+            return "memory stats unavailable on this backend"
+        gib = 1024 ** 3
+        lines = ["| allocator stat            |        value |"]
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_reserved",
+                    "bytes_limit", "largest_alloc_size", "num_allocs"):
+            if key in s:
+                v = s[key]
+                shown = f"{v / gib:10.3f} GiB" if "bytes" in key or \
+                    "size" in key else f"{v:14d}"
+                lines.append(f"| {key:25} | {shown:>12} |")
+        return "\n".join(lines)
+
     @staticmethod
     def device_count():
         return jax.device_count()

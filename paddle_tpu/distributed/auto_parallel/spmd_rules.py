@@ -1,0 +1,294 @@
+"""SPMD inference rules + validation layer.
+
+Reference surface: paddle/phi/infermeta/spmd_rules/ (113 rule files —
+matmul.cc, elementwise.cc, reduction.cc, embedding.cc, layer_norm.cc,
+softmax.cc, transpose.cc, reshape.cc, concat.cc, split.cc,
+cross_entropy_with_softmax.cc, flash_attention.cc, ...).
+
+TPU-native role: GSPMD does the actual propagation inside XLA, so these
+rules are not needed to RUN — they exist to PREDICT and VALIDATE.  Each
+rule answers: given input ``dims_mapping``s (paddle's convention: one mesh
+-dim index per tensor dim, -1 = replicated), what output mapping will
+propagation produce, and which axes end up PARTIAL (pending psum)?  The
+test matrix in tests/test_spmd_rules.py then checks every rule against
+what XLA's GSPMD actually produces on a virtual mesh — the rule layer is
+continuously validated against the real partitioner, which is stronger
+than the reference's unit tests against its own C++ implementations.
+
+``dims_mapping`` example on mesh (dp=2, mp=4): a [B, H] tensor sharded
+batch-over-dp, hidden-over-mp is ``[0, 1]``; replicated is ``[-1, -1]``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass
+class SpmdInfo:
+    """Result of a rule: per-output dims_mapping + partial mesh dims."""
+    out_dims_mappings: List[List[int]]
+    partial_dims: List[int] = field(default_factory=list)
+
+    @property
+    def single(self) -> List[int]:
+        assert len(self.out_dims_mappings) == 1
+        return self.out_dims_mappings[0]
+
+
+def _check(dm: Sequence[int], ndim: int, name: str):
+    assert len(dm) == ndim, f"{name}: dims_mapping {dm} rank != {ndim}"
+    used = [d for d in dm if d >= 0]
+    assert len(used) == len(set(used)), \
+        f"{name}: mesh dim used twice in {dm}"
+
+
+def elementwise_rule(*dims_mappings: Sequence[int]) -> SpmdInfo:
+    """Broadcast-aligned elementwise: per output dim, the first sharded
+    input wins; conflicting shardings must agree (else resharding)."""
+    ndim = max(len(dm) for dm in dims_mappings)
+    out = [-1] * ndim
+    for dm in dims_mappings:
+        pad = [-1] * (ndim - len(dm)) + list(dm)
+        for i, d in enumerate(pad):
+            if d >= 0 and out[i] == -1:
+                out[i] = d
+    return SpmdInfo([out])
+
+
+def matmul_rule(x_dm: Sequence[int], y_dm: Sequence[int],
+                trans_x: bool = False, trans_y: bool = False) -> SpmdInfo:
+    """[.., M, K] @ [.., K, N]: M from x, N from y; a sharded contracted
+    K produces a PARTIAL output (psum pending over that mesh dim)."""
+    x = list(x_dm)
+    y = list(y_dm)
+    if trans_x:
+        x[-1], x[-2] = x[-2], x[-1]
+    if trans_y:
+        y[-1], y[-2] = y[-2], y[-1]
+    batch = x[:-2]
+    m, kx = x[-2], x[-1]
+    ky, n = y[-2], y[-1]
+    partial = [kx] if (kx >= 0 and kx == ky) else []
+    out = batch + [m, n]
+    # contracted-dim mismatch (only one side sharded): propagation
+    # replicates the sharded side first, no partial
+    return SpmdInfo([out], partial_dims=partial)
+
+
+def reduction_rule(x_dm: Sequence[int], axis, keepdim: bool = False) -> SpmdInfo:
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    axes = [a % len(x_dm) for a in axes]
+    out = []
+    partial = []
+    for i, d in enumerate(x_dm):
+        if i in axes:
+            if d >= 0:
+                partial.append(d)
+            if keepdim:
+                out.append(-1)
+        else:
+            out.append(d)
+    return SpmdInfo([out], partial_dims=partial)
+
+
+def embedding_rule(ids_dm: Sequence[int], table_dm: Sequence[int]) -> SpmdInfo:
+    """ids [..]; table [V, H] -> out [.., H].  Vocab-sharded table (mp on
+    dim 0) yields a PARTIAL output — the TP embedding's masked-lookup+psum."""
+    out = list(ids_dm) + [table_dm[1]]
+    partial = [table_dm[0]] if table_dm[0] >= 0 else []
+    return SpmdInfo([out], partial_dims=partial)
+
+
+def softmax_rule(x_dm: Sequence[int], axis: int = -1) -> SpmdInfo:
+    """Softmax axis must be unsharded; propagation clears it."""
+    out = list(x_dm)
+    out[axis % len(out)] = -1
+    return SpmdInfo([out])
+
+
+def layer_norm_rule(x_dm: Sequence[int], begin_norm_axis: int = -1) -> SpmdInfo:
+    out = list(x_dm)
+    bn = begin_norm_axis % len(out)
+    for i in range(bn, len(out)):
+        out[i] = -1
+    return SpmdInfo([out])
+
+
+def transpose_rule(x_dm: Sequence[int], perm: Sequence[int]) -> SpmdInfo:
+    return SpmdInfo([[x_dm[p] for p in perm]])
+
+
+def reshape_rule(x_dm: Sequence[int], src_shape: Sequence[int],
+                 dst_shape: Sequence[int]) -> SpmdInfo:
+    """Dimension-factorization reshape: a sharding survives iff its dim
+    maps to a dst dim whose size is a multiple of it (leading position in
+    the factor group); everything else replicates."""
+    out = [-1] * len(dst_shape)
+    si = di = 0
+    while si < len(src_shape) and di < len(dst_shape):
+        if src_shape[si] == dst_shape[di]:
+            out[di] = x_dm[si]
+            si += 1
+            di += 1
+        elif src_shape[si] > dst_shape[di]:
+            # src dim splits into several dst dims: sharding moves to the
+            # leading dst factor
+            prod = 1
+            d0 = di
+            while di < len(dst_shape) and prod < src_shape[si]:
+                prod *= dst_shape[di]
+                di += 1
+            out[d0] = x_dm[si]
+            si += 1
+        else:
+            # src dims merge: merged dim takes the leading src sharding
+            prod = 1
+            s0 = si
+            while si < len(src_shape) and prod < dst_shape[di]:
+                prod *= src_shape[si]
+                si += 1
+            out[di] = x_dm[s0]
+            di += 1
+    return SpmdInfo([out])
+
+
+def concat_rule(dims_mappings: Sequence[Sequence[int]], axis: int) -> SpmdInfo:
+    ndim = len(dims_mappings[0])
+    axis = axis % ndim
+    out = [-1] * ndim
+    for dm in dims_mappings:
+        for i, d in enumerate(dm):
+            if i != axis and d >= 0 and out[i] == -1:
+                out[i] = d
+    return SpmdInfo([out])
+
+
+def split_rule(x_dm: Sequence[int], num: int, axis: int) -> SpmdInfo:
+    out = list(x_dm)
+    out[axis % len(out)] = -1            # split axis must be unsharded
+    return SpmdInfo([out] * num)
+
+
+def cross_entropy_rule(logits_dm: Sequence[int],
+                       labels_dm: Sequence[int]) -> SpmdInfo:
+    """softmax+CE over the class dim: class-sharded logits give a PARTIAL
+    loss (the TP parallel-cross-entropy psum)."""
+    out = list(logits_dm[:-1])
+    partial = [logits_dm[-1]] if logits_dm[-1] >= 0 else []
+    return SpmdInfo([out], partial_dims=partial)
+
+
+def flash_attention_rule(q_dm: Sequence[int], k_dm: Sequence[int],
+                         v_dm: Sequence[int]) -> SpmdInfo:
+    """[b, s, h, d] attention: batch/head shardings pass through; the
+    seq dim of K/V must be full locally (sep handled by resharding around
+    the kernel); head_dim unsharded."""
+    out = [q_dm[0], q_dm[1], q_dm[2], -1]
+    return SpmdInfo([out])
+
+
+RULES: Dict[str, object] = {
+    "elementwise": elementwise_rule,
+    "matmul": matmul_rule,
+    "reduction": reduction_rule,
+    "embedding": embedding_rule,
+    "softmax": softmax_rule,
+    "layer_norm": layer_norm_rule,
+    "transpose": transpose_rule,
+    "reshape": reshape_rule,
+    "concat": concat_rule,
+    "split": split_rule,
+    "cross_entropy_with_softmax": cross_entropy_rule,
+    "flash_attention": flash_attention_rule,
+}
+
+
+def infer_spmd(op: str, *args, **kwargs) -> SpmdInfo:
+    """Rule dispatch (reference SpmdRuleFactory): infer output placements
+    for ``op`` from input dims_mappings."""
+    if op not in RULES:
+        raise KeyError(f"no spmd rule registered for {op!r}; "
+                       f"known: {sorted(RULES)}")
+    return RULES[op](*args, **kwargs)
+
+
+# -------------------------------------------------- mesh <-> jax bridging
+
+def dims_mapping_to_spec(dm: Sequence[int], mesh_axis_names: Sequence[str]):
+    """dims_mapping -> jax PartitionSpec entries."""
+    from jax.sharding import PartitionSpec as P
+    return P(*[None if d < 0 else mesh_axis_names[d] for d in dm])
+
+
+def sharding_to_dims_mapping(sharding, ndim: int,
+                             mesh_axis_names: Sequence[str]) -> List[int]:
+    """NamedSharding -> dims_mapping (PARTIAL/replicated axes -> -1)."""
+    from jax.sharding import NamedSharding
+    if not isinstance(sharding, NamedSharding):
+        return [-1] * ndim
+    spec = list(sharding.spec) + [None] * (ndim - len(sharding.spec))
+    out = []
+    for entry in spec[:ndim]:
+        if entry is None:
+            out.append(-1)
+        elif isinstance(entry, (tuple, list)):
+            out.append(mesh_axis_names.index(entry[0]) if entry else -1)
+        else:
+            out.append(mesh_axis_names.index(entry))
+    return out
+
+
+def validate_rule(op: str, fn, input_shapes, input_dms, mesh,
+                  rule_args=(), rule_kwargs=None, check_partial=True):
+    """Run ``fn`` under jit with inputs sharded per ``input_dms`` and
+    compare XLA's actual output sharding against the rule's prediction.
+    Returns (predicted, actual) dims_mappings; raises on mismatch of the
+    non-partial dims.  This is the per-op validation harness the
+    reference keeps as spmd_rules unit tests."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    names = list(mesh.axis_names)
+    info = infer_spmd(op, *list(input_dms) + list(rule_args),
+                      **(rule_kwargs or {}))
+    args = []
+    for shape, dm in zip(input_shapes, input_dms):
+        arr = jnp.asarray(
+            np.random.default_rng(0).standard_normal(shape), jnp.float32)
+        args.append(jax.device_put(
+            arr, NamedSharding(mesh, dims_mapping_to_spec(dm, names))))
+    out = jax.jit(fn)(*args)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    actual = [sharding_to_dims_mapping(o.sharding, o.ndim, names)
+              for o in outs]
+    for pred, act, o in zip(info.out_dims_mappings, actual, outs):
+        for i, (p, a) in enumerate(zip(pred, act)):
+            # GSPMD may further shard replicated dims; a predicted
+            # sharding must be preserved exactly
+            if p >= 0 and a != p:
+                raise AssertionError(
+                    f"{op}: predicted dim {i} on mesh axis {names[p]}, "
+                    f"XLA produced {act}")
+    return info, actual
+
+
+def get_spmd_rule(op_name: str):
+    """Look up the rule for a REGISTERED framework op: consults the op
+    registry's spmd_rule tag first (table ops are tagged elementwise/
+    reduction at registration), then the rule table by name — the
+    SpmdRuleFactory::GetSpmdRule surface."""
+    from ...ops._prim import OP_REGISTRY
+    entry = OP_REGISTRY.get(op_name)
+    if entry and entry.get("spmd_rule"):
+        tag = entry["spmd_rule"]
+        if tag in RULES:
+            return RULES[tag]
+        if tag == "MatmulInferSpmd":
+            return RULES["matmul"]
+    if op_name in RULES:
+        return RULES[op_name]
+    raise KeyError(f"no spmd rule for op {op_name!r}")

@@ -1,15 +1,34 @@
-"""paddle.distributed.rpc (reference: python/paddle/distributed/rpc/ over
-brpc).
+"""paddle.distributed.rpc (reference: python/paddle/distributed/rpc/
+rpc.py over the brpc C++ transport).
 
-Single-controller SPMD has one process per host; in-process "rpc" is a
-direct call.  Cross-host rpc requires a transport this round does not ship;
-the API raises with guidance rather than silently faking multi-host.
+TPU-native split: the DATA plane is XLA collectives over ICI/DCN (never
+rpc); this module is the CONTROL plane — arbitrary-function calls between
+worker processes, used for coordination (parameter-server-style setups,
+elastic orchestration, user tooling).  Transport is a threaded TCP server
+per worker with length-prefixed pickle frames, and a master-endpoint
+rendezvous that mirrors the reference's init_rpc contract:
+
+- rank 0 binds ``master_endpoint`` and collects (name, rank, ip, port)
+  registrations from every worker, then broadcasts the worker table;
+- every worker runs a request server on an ephemeral port, executing
+  incoming (fn, args, kwargs) and returning the result or the exception;
+- ``rpc_sync`` blocks on the reply; ``rpc_async`` returns a Future served
+  by a daemon thread.
+
+world_size == 1 short-circuits to in-process calls (no sockets), so
+single-process usage has zero overhead.
 """
 
 from __future__ import annotations
 
+import pickle
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import Callable
+from typing import Callable, Dict, Optional
 
 
 @dataclass
@@ -20,48 +39,220 @@ class WorkerInfo:
     port: int = 0
 
 
-_STATE = {"name": None, "inited": False}
+_STATE = {
+    "name": None, "rank": 0, "world_size": 1, "inited": False,
+    "workers": {},           # name -> WorkerInfo
+    "server": None,          # _Server
+    "pool": None,            # ThreadPoolExecutor for rpc_async
+}
 
+
+# ------------------------------------------------------------ wire format
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed the connection")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+# ---------------------------------------------------------- request server
+
+class _Server:
+    """Per-worker request server: executes incoming (fn, args, kwargs)."""
+
+    def __init__(self):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("0.0.0.0", 0))
+        self.sock.listen(64)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        self.sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket):
+        try:
+            with conn:
+                req = _recv_msg(conn)
+                if req.get("kind") == "call":
+                    try:
+                        out = req["fn"](*req.get("args", ()),
+                                        **(req.get("kwargs") or {}))
+                        _send_msg(conn, {"ok": True, "value": out})
+                    except Exception as e:  # ship the exception back
+                        _send_msg(conn, {"ok": False, "error": e})
+                elif req.get("kind") == "ping":
+                    _send_msg(conn, {"ok": True, "value": "pong"})
+        except Exception:
+            pass
+
+    def close(self):
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------------- rendezvous
+
+def _master_rendezvous(endpoint: str, my_info: WorkerInfo,
+                       world_size: int, timeout: float) -> Dict[str, WorkerInfo]:
+    host, port = endpoint.rsplit(":", 1)
+    port = int(port)
+    if my_info.rank == 0:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(world_size)
+        srv.settimeout(timeout)
+        workers = {my_info.name: my_info}
+        conns = []
+        while len(workers) < world_size:
+            conn, _ = srv.accept()
+            info = _recv_msg(conn)
+            workers[info.name] = info
+            conns.append(conn)
+        table = {n: w for n, w in workers.items()}
+        for conn in conns:
+            _send_msg(conn, table)
+            conn.close()
+        srv.close()
+        return table
+    deadline = time.time() + timeout
+    last_err = None
+    while time.time() < deadline:
+        try:
+            conn = socket.create_connection((host, port), timeout=2.0)
+        except OSError as e:                 # master not up yet: retry
+            last_err = e
+            time.sleep(0.1)
+            continue
+        try:
+            with conn:
+                # registered: the table arrives only once ALL workers have
+                # joined, so wait with the remaining rendezvous budget (a
+                # short timeout here would cause spurious re-registrations
+                # that leave dead connections in the master's conns list)
+                conn.settimeout(max(deadline - time.time(), 1.0))
+                _send_msg(conn, my_info)
+                return _recv_msg(conn)
+        except OSError as e:
+            raise TimeoutError(
+                f"rpc rendezvous with {endpoint}: registered but the worker "
+                f"table never arrived (is every rank up?): {e}") from e
+    raise TimeoutError(f"rpc rendezvous with {endpoint} failed: {last_err}")
+
+
+# -------------------------------------------------------------- public API
 
 def init_rpc(name: str, rank: int = 0, world_size: int = 1,
-             master_endpoint: str = None):
-    if world_size > 1:
-        raise NotImplementedError(
-            "multi-host rpc transport is not shipped; use "
-            "paddle_tpu.distributed collectives / jax.distributed")
-    _STATE.update(name=name, inited=True)
+             master_endpoint: Optional[str] = None,
+             timeout: float = 60.0):
+    if world_size <= 1:
+        _STATE.update(name=name, rank=0, world_size=1, inited=True,
+                      workers={name: WorkerInfo(name, 0)})
+        return
+    assert master_endpoint, "multi-worker rpc needs master_endpoint host:port"
+    server = _Server()
+    my_ip = socket.gethostbyname(socket.gethostname())
+    info = WorkerInfo(name, rank, my_ip, server.port)
+    workers = _master_rendezvous(master_endpoint, info, world_size, timeout)
+    _STATE.update(name=name, rank=rank, world_size=world_size, inited=True,
+                  workers=workers, server=server,
+                  pool=ThreadPoolExecutor(max_workers=8))
+
+
+def _call_remote(to: str, fn: Callable, args, kwargs, timeout):
+    _require()
+    if _STATE["world_size"] == 1 or to == _STATE["name"]:
+        return fn(*(args or ()), **(kwargs or {}))
+    w = _STATE["workers"].get(to)
+    if w is None:
+        raise ValueError(f"unknown rpc worker {to!r}; known: "
+                         f"{sorted(_STATE['workers'])}")
+    with socket.create_connection((w.ip, w.port),
+                                  timeout=timeout or 60.0) as conn:
+        _send_msg(conn, {"kind": "call", "fn": fn, "args": args or (),
+                         "kwargs": kwargs or {}})
+        rep = _recv_msg(conn)
+    if rep["ok"]:
+        return rep["value"]
+    raise rep["error"]
 
 
 def rpc_sync(to: str, fn: Callable, args=None, kwargs=None, timeout=None):
-    _require()
-    return fn(*(args or ()), **(kwargs or {}))
-
-
-class _Future:
-    def __init__(self, value):
-        self._v = value
-
-    def wait(self):
-        return self._v
+    return _call_remote(to, fn, args, kwargs, timeout)
 
 
 def rpc_async(to: str, fn: Callable, args=None, kwargs=None, timeout=None):
     _require()
-    return _Future(fn(*(args or ()), **(kwargs or {})))
+    if _STATE["pool"] is None:          # single-process fast path
+        fut = Future()
+        try:
+            fut.set_result(fn(*(args or ()), **(kwargs or {})))
+        except Exception as e:
+            fut.set_exception(e)
+        return _FutureShim(fut)
+    return _FutureShim(_STATE["pool"].submit(
+        _call_remote, to, fn, args, kwargs, timeout))
 
 
-def get_worker_info(name: str = None) -> WorkerInfo:
+class _FutureShim:
+    """paddle-style .wait() over concurrent.futures.Future."""
+
+    def __init__(self, fut: Future):
+        self._fut = fut
+
+    def wait(self, timeout=None):
+        return self._fut.result(timeout)
+
+    def done(self):
+        return self._fut.done()
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
     _require()
-    return WorkerInfo(name or _STATE["name"], 0)
+    return _STATE["workers"][name or _STATE["name"]]
 
 
 def get_all_worker_infos():
     _require()
-    return [get_worker_info()]
+    return sorted(_STATE["workers"].values(), key=lambda w: w.rank)
 
 
-def shutdown():
-    _STATE["inited"] = False
+def shutdown(graceful: bool = True):
+    if _STATE["server"] is not None:
+        _STATE["server"].close()
+    if _STATE["pool"] is not None:
+        _STATE["pool"].shutdown(wait=graceful)
+    _STATE.update(inited=False, server=None, pool=None, workers={})
 
 
 def _require():

@@ -1,1 +1,228 @@
+"""paddle.incubate.nn fused layers (reference: python/paddle/incubate/nn/
+layer/fused_transformer.py — FusedMultiHeadAttention, FusedFeedForward,
+FusedTransformerEncoderLayer; fused_linear.py FusedLinear).
+
+On TPU "fused" means: route attention through the Pallas flash kernel and
+express the rest as single jnp expressions XLA fuses into the surrounding
+matmuls — the layer classes exist for API parity and to guarantee the fused
+path (no per-op eager dispatch inside forward)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
 from . import functional  # noqa: F401
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn.layer import Layer
+from ...ops._prim import apply_op
+
+
+def _ln(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return (((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b)
+
+
+class FusedLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = [out_features, in_features] if transpose_weight else \
+            [in_features, out_features]
+        from ...nn import initializer as I
+        self.weight = self.create_parameter(shape, attr=weight_attr,
+                                            default_initializer=I.XavierNormal())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_features], attr=None, is_bias=True)
+
+    def forward(self, x):
+        return functional.fused_linear(x, self.weight, self.bias,
+                                       self.transpose_weight)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN multi-head self-attention with residual, matching the
+    reference fused_attention op's fused epilogue (LN + qkv + flash
+    attention + out proj + dropout + residual add)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim, self.num_heads = embed_dim, num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        from ...nn import initializer as I
+        init = I.XavierNormal()
+        self.qkv_weight = self.create_parameter(
+            [embed_dim, 3 * embed_dim], attr=qkv_weight_attr,
+            default_initializer=init)
+        self.qkv_bias = self.create_parameter([3 * embed_dim], is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr,
+            default_initializer=init)
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=lambda s, d: jnp.ones(s, d))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        e, h, hd = self.embed_dim, self.num_heads, self.head_dim
+        training = self.training
+        attn_drop = self.attn_dropout_rate if training else 0.0
+        if attn_drop > 0:
+            from ...core.random import next_key
+            drop_key = next_key()
+
+        def prim(x, qkv_w, qkv_b, lin_w, lin_b, ln_w, ln_b, *rest):
+            mask = rest[0] if rest else None
+            if self.normalize_before:
+                x = _ln(x, ln_w, ln_b, self.epsilon)
+            b, s, _ = x.shape
+            qkv = (x @ qkv_w + qkv_b).reshape(b, s, 3, h, hd)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            if s >= 256 and s % 128 == 0 and mask is None and attn_drop == 0:
+                from ...kernels.flash_attention import flash_attention as fa
+                out = fa(q, k, v, causal=False)
+                out = out._data if isinstance(out, Tensor) else out
+            else:
+                scale = 1.0 / math.sqrt(hd)
+                logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+                if mask is not None:
+                    logits = logits + mask
+                p = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+                if attn_drop > 0:
+                    keep = jax.random.bernoulli(drop_key, 1 - attn_drop,
+                                                p.shape)
+                    p = jnp.where(keep, p / (1 - attn_drop), 0.0)
+                out = jnp.einsum("bhst,bthd->bshd", p, v)
+            # fused epilogue stops before the residual add: the projection
+            # dropout (reference fused_attention semantics) must act on the
+            # projection only, never the identity path
+            return out.reshape(b, s, e) @ lin_w + lin_b
+
+        args = [query, self.qkv_weight, self.qkv_bias, self.linear_weight,
+                self.linear_bias, self.ln_scale, self.ln_bias]
+        if attn_mask is not None:
+            args.append(attn_mask)
+        if self.normalize_before:
+            proj = apply_op("fused_multihead_attention", prim, tuple(args))
+            proj = F.dropout(proj, self.dropout_rate, training=training)
+            return query + proj
+        proj = apply_op("fused_multihead_attention", prim, tuple(args))
+        proj = F.dropout(proj, self.dropout_rate, training=training)
+        y = query + proj
+
+        def post_ln(v, w, bb):
+            return _ln(v, w, bb, self.epsilon)
+        return apply_op("fused_mha_post_ln", post_ln,
+                        (y, self.ln_scale, self.ln_bias))
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        self.dropout_rate = dropout_rate
+        self.act = {"relu": jax.nn.relu,
+                    "gelu": lambda v: jax.nn.gelu(v, approximate=True)}[activation]
+        from ...nn import initializer as I
+        init = I.XavierNormal()
+        self.w1 = self.create_parameter([d_model, dim_feedforward],
+                                        default_initializer=init)
+        self.b1 = self.create_parameter([dim_feedforward], is_bias=True)
+        self.w2 = self.create_parameter([dim_feedforward, d_model],
+                                        default_initializer=init)
+        self.b2 = self.create_parameter([d_model], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [d_model], default_initializer=lambda s, d: jnp.ones(s, d))
+        self.ln_bias = self.create_parameter([d_model], is_bias=True)
+
+    def forward(self, src, cache=None):
+        def prim(x, w1, b1, w2, b2, ln_w, ln_b):
+            if self.normalize_before:
+                x = _ln(x, ln_w, ln_b, self.epsilon)
+            return self.act(x @ w1 + b1) @ w2 + b2
+
+        # dropout hits the FFN branch only; the residual path stays intact
+        # (reference fused_feedforward places dropout before the add)
+        ffn = apply_op("fused_feedforward", prim,
+                       (src, self.w1, self.b1, self.w2, self.b2,
+                        self.ln_scale, self.ln_bias))
+        ffn = F.dropout(ffn, self.dropout_rate, training=self.training)
+        y = src + ffn
+        if self.normalize_before:
+            return y
+
+        def post_ln(v, w, b):
+            return _ln(v, w, b, self.epsilon)
+        return apply_op("fused_ffn_post_ln", post_ln,
+                        (y, self.ln_scale, self.ln_bias))
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, name=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate if attn_dropout_rate
+            is not None else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation,
+            act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    def __init__(self, embed_dim, dropout_rate=0.5, epsilon=1e-5,
+                 bias_attr=None, epsilon_attr=None, name=None):
+        super().__init__()
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=lambda s, d: jnp.ones(s, d))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, x, residual):
+        # add the bias PARAMETER directly (a detached copy would cut its
+        # gradient path and freeze it at init)
+        y = functional.fused_dropout_add(
+            x + self.linear_bias, residual,
+            p=self.dropout_rate, training=self.training)
+
+        def prim(v, w, b):
+            return _ln(v, w, b, self.epsilon)
+        return apply_op("fused_bias_dropout_residual_ln", prim,
+                        (y, self.ln_scale, self.ln_bias))

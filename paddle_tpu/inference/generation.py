@@ -35,6 +35,7 @@ width keep recompiles bounded.
 from __future__ import annotations
 
 import math
+from collections import deque
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
@@ -372,3 +373,179 @@ def generate(model: LlamaForCausalLM, prompts, gen: Optional[GenerationConfig] =
                            max(64, max_len),
                            model.config.max_position_embeddings), **kw)
     return g.generate(prompts, gen)
+
+
+class Request:
+    """One in-flight generation request of the continuous-batching engine."""
+
+    __slots__ = ("req_id", "prompt", "max_new_tokens", "output", "done")
+
+    def __init__(self, req_id, prompt, max_new_tokens):
+        self.req_id = req_id
+        self.prompt = list(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.output: List[int] = []
+        self.done = False
+
+
+class ContinuousBatchingEngine:
+    """vLLM-style continuous batching over the paged-KV decode path
+    (reference product surface: the fused multi-transformer serving stack,
+    analysis_predictor + block_multihead_attention).
+
+    Requests are admitted into free batch slots BETWEEN decode steps:
+    admission runs one full-width prefill (inactive rows carry -1 slot
+    mappings, so they write nothing), then every step decodes all active
+    slots together.  Finished sequences (EOS / budget / cache-full) free
+    their pages and their slot immediately, so short requests leave and new
+    ones join without draining the batch — decode utilization stays high
+    under mixed-length traffic."""
+
+    def __init__(self, model: LlamaForCausalLM, *, max_batch: int = 8,
+                 gen: Optional[GenerationConfig] = None, **kw):
+        self.gen_cfg = gen or GenerationConfig()
+        self.g = LlamaGenerator(model, max_batch=max_batch, **kw)
+        B = max_batch
+        self.B = B
+        self._prefill, self._decode = self.g._jit_for(self.gen_cfg)
+        self.key = jax.random.key(self.gen_cfg.seed)
+        self.tokens = jnp.zeros((B,), jnp.int32)
+        self.positions = jnp.zeros((B,), jnp.int32)
+        self.finished = jnp.ones((B,), bool)        # inactive == finished
+        self.slot_req: List[Optional[Request]] = [None] * B
+        self.host_lens = np.zeros((B,), np.int64)
+        self.new_counts = np.zeros((B,), np.int64)  # generated so far
+        self.waiting: "deque[Request]" = deque()
+        self._done_at_admit: List[Request] = []
+        self.completed: dict = {}            # req_id -> generated tokens
+        self._next_id = 0
+        self._bt = np.full((B, self.g.pages_per_seq), 0, np.int32)
+        self._bt_dev = jnp.asarray(self._bt)
+
+    # ---- public api ----
+    def add_request(self, prompt: Sequence[int],
+                    max_new_tokens: Optional[int] = None) -> int:
+        rid = self._next_id
+        self._next_id += 1
+        req = Request(rid, prompt,
+                      max_new_tokens or self.gen_cfg.max_new_tokens)
+        self.waiting.append(req)
+        return rid
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(r is not None for r in self.slot_req)
+
+    def run(self) -> dict:
+        """Drive to completion; returns {req_id: generated tokens} for every
+        request completed so far (incl. during earlier manual step() calls)."""
+        while self.has_work():
+            self.step()
+        return dict(self.completed)
+
+    # ---- engine step ----
+    def step(self) -> List[Request]:
+        self._admit()
+        done: List[Request] = list(self._done_at_admit)
+        self._done_at_admit.clear()
+        for r in done:
+            self.completed[r.req_id] = r.output
+        if all(r is None for r in self.slot_req):
+            return done
+        self.tokens, self.positions, self.finished, _all_done, kc, vc, \
+            self.key = self._decode(
+                self.g.params, *self.g.cache.arrays, self.tokens,
+                self.positions, self.finished, self._bt_dev, self.key)
+        self.g.cache.update(kc, vc)
+        toks = np.asarray(self.tokens)
+        fin = np.asarray(self.finished)
+        alloc = self.g.cache.allocator
+        grew = False
+        for b in range(self.B):
+            req = self.slot_req[b]
+            if req is None:
+                continue
+            req.output.append(int(toks[b]))
+            self.new_counts[b] += 1
+            self.host_lens[b] += 1
+            eos = (self.gen_cfg.eos_token_id is not None
+                   and int(toks[b]) == self.gen_cfg.eos_token_id)
+            if eos or fin[b] or self.new_counts[b] >= req.max_new_tokens \
+                    or self.host_lens[b] >= self.g.max_seq_len:
+                req.done = True
+                alloc.free(req.req_id)
+                self.slot_req[b] = None
+                self.finished = self.finished.at[b].set(True)
+                self.completed[req.req_id] = req.output
+                done.append(req)
+                continue
+            # grow a page ahead of the next boundary crossing
+            if self.host_lens[b] % self.g.page_size == 0 and \
+                    alloc.context_len(req.req_id) <= self.host_lens[b]:
+                alloc.extend(req.req_id,
+                             min(self.g.page_size,
+                                 self.g.max_seq_len - int(self.host_lens[b])))
+                self._bt[b] = alloc.block_table(
+                    [req.req_id], max_pages=self.g.pages_per_seq)[0]
+                grew = True
+        if grew:
+            self._bt_dev = jnp.asarray(self._bt)  # one upload per step
+        return done
+
+    # ---- admission (prefill newly scheduled requests) ----
+    def _admit(self):
+        free = [b for b in range(self.B) if self.slot_req[b] is None]
+        if not free or not self.waiting:
+            return
+        alloc = self.g.cache.allocator
+        admitted = []
+        while free and self.waiting:
+            req = self.waiting[0]
+            # truncate ONCE here; every later length (pages, host_lens,
+            # positions) derives from the truncated prompt
+            req.prompt = req.prompt[: self.g.max_seq_len - 1]
+            need = -(-len(req.prompt) // self.g.page_size)
+            if alloc.free_pages < need:
+                break                         # wait for pages to free up
+            self.waiting.popleft()
+            admitted.append((free.pop(0), req))
+        if not admitted:
+            return
+        T = self.g._bucket(max(len(r.prompt) for _, r in admitted))
+        ids = np.zeros((self.B, T), np.int32)
+        slot_map = np.full((self.B, T), -1, np.int32)
+        last_pos = np.zeros((self.B,), np.int32)
+        for b, req in admitted:
+            p = req.prompt
+            ids[b, :len(p)] = np.asarray(p, np.int32)
+            slot_map[b, :len(p)] = alloc.allocate(req.req_id, len(p))
+            last_pos[b] = len(p) - 1
+        first, kc, vc, self.key = self._prefill(
+            self.g.params, *self.g.cache.arrays, jnp.asarray(ids),
+            jnp.asarray(slot_map), jnp.asarray(last_pos), self.key)
+        self.g.cache.update(kc, vc)
+        first_host = np.asarray(first)
+        mask = np.zeros((self.B,), bool)
+        for b, req in admitted:
+            tok = int(first_host[b])
+            req.output.append(tok)
+            # the prefill-sampled token itself may already finish the
+            # request (budget of 1, or EOS right away)
+            eos = (self.gen_cfg.eos_token_id is not None
+                   and tok == self.gen_cfg.eos_token_id)
+            if eos or req.max_new_tokens <= 1:
+                req.done = True
+                alloc.free(req.req_id)
+                self._done_at_admit.append(req)
+                continue
+            mask[b] = True
+            self.slot_req[b] = req
+            self.host_lens[b] = len(req.prompt)
+            self.new_counts[b] = 1
+            self._bt[b] = alloc.block_table(
+                [req.req_id], max_pages=self.g.pages_per_seq)[0]
+        m = jnp.asarray(mask)
+        self.tokens = jnp.where(m, first, self.tokens)
+        self.positions = jnp.where(
+            m, jnp.asarray(self.host_lens.astype(np.int32)), self.positions)
+        self.finished = jnp.where(m, jnp.zeros((), bool), self.finished)
+        self._bt_dev = jnp.asarray(self._bt)

@@ -1,0 +1,239 @@
+"""Reusable Pallas block-primitive library — the KPS slot.
+
+Replaces the role of paddle/phi/kernels/primitive/ (compute_primitives.h,
+datamover_primitives.h, functor_primitives.h): a small library of composable
+building blocks that custom TPU kernels assemble, instead of every kernel
+hand-rolling its own tiling/softmax/reduction machinery.
+
+What the reference exposes as ElementwiseBinary/Reduce/ReadData/WriteData
+templates maps here to:
+
+- tiling helpers (``cdiv``, ``round_up_to``, ``pick_block``) that encode the
+  MXU/VPU tile constraints (last dim 128; sublane multiple by dtype);
+- ``elementwise_kernel`` / ``reduce_kernel`` — build a Pallas kernel from a
+  pure jnp function (the ElementwiseKernel/ReduceKernel generators);
+- ``matmul_kernel`` — a tiled MXU matmul with fp32 accumulation scratch and
+  optional fused epilogue (bias/activation), the GEMM primitive custom
+  fused ops start from;
+- ``OnlineSoftmax`` — the streaming (m, l, acc) update shared by flash /
+  paged attention kernels;
+- ``unpack_int4`` / ``dequant_int8`` — the weight-dequant blocks used by the
+  quantized matmul paths.
+
+Everything works under ``interpret=True`` on CPU, which is how the tests
+validate the exact kernel code without a chip.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# ---------------------------------------------------------------- tiling
+
+_SUBLANE = {jnp.dtype("float32"): 8, jnp.dtype("bfloat16"): 16,
+            jnp.dtype("int8"): 32, jnp.dtype("float16"): 16}
+LANE = 128
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up_to(x: int, mult: int) -> int:
+    return cdiv(x, mult) * mult
+
+
+def min_tile(dtype) -> tuple:
+    """Minimum legal (sublane, lane) tile for a dtype on TPU."""
+    return (_SUBLANE.get(jnp.dtype(dtype), 8), LANE)
+
+
+def pick_block(dim: int, dtype, target: int = 512, axis: str = "sublane") -> int:
+    """Largest tile-aligned block size <= target that divides ``dim`` if
+    possible, else the aligned target (caller pads)."""
+    base = LANE if axis == "lane" else _SUBLANE.get(jnp.dtype(dtype), 8)
+    best = base
+    b = base
+    while b <= min(dim, target):
+        if dim % b == 0:
+            best = b
+        b *= 2
+    return best
+
+
+# ------------------------------------------------------ kernel generators
+
+def elementwise_kernel(fn: Callable, block: int = 1024,
+                       interpret: bool = False):
+    """Build a Pallas kernel computing ``fn(*arrays)`` elementwise over
+    equally-shaped inputs.  ``fn`` is any jnp-pure function — the
+    ElementwiseKernel generator."""
+
+    def kernel(*refs):
+        ins = refs[:-1]
+        out = refs[-1]
+        out[...] = fn(*[r[...] for r in ins])
+
+    def apply(*arrays):
+        a0 = arrays[0]
+        flat = [a.reshape(-1) for a in arrays]
+        n = flat[0].shape[0]
+        bp = round_up_to(min(block, n), LANE)
+        pad = round_up_to(n, bp)
+        flat = [jnp.pad(f, (0, pad - n)) for f in flat]
+        out = pl.pallas_call(
+            kernel,
+            grid=(pad // bp,),
+            in_specs=[pl.BlockSpec((bp,), lambda i: (i,))] * len(flat),
+            out_specs=pl.BlockSpec((bp,), lambda i: (i,)),
+            out_shape=jax.ShapeDtypeStruct((pad,), a0.dtype),
+            interpret=interpret,
+        )(*flat)
+        return out[:n].reshape(a0.shape)
+
+    return apply
+
+
+def reduce_kernel(fn: Callable, init: float, block_rows: int = 256,
+                  interpret: bool = False):
+    """Build a Pallas kernel reducing the LAST axis of a 2D array with the
+    associative ``fn`` (jnp.maximum, jnp.add via lambda, ...) — the
+    ReduceKernel generator (row-wise / "higher-dim" reduce)."""
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = functools.reduce(
+            fn, [x_ref[...][:, i] for i in range(x_ref.shape[1])])
+
+    def apply(x):
+        rows, cols = x.shape
+        br = min(block_rows, rows)
+        if rows % br:
+            br = 1
+        out = pl.pallas_call(
+            kernel,
+            grid=(rows // br,),
+            in_specs=[pl.BlockSpec((br, cols), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((br,), lambda i: (i,)),
+            out_shape=jax.ShapeDtypeStruct((rows,), x.dtype),
+            interpret=interpret,
+        )(x)
+        return out
+
+    return apply
+
+
+def matmul_kernel(block_m: int = 256, block_n: int = 256, block_k: int = 512,
+                  epilogue: Optional[Callable] = None,
+                  out_dtype=None, interpret: bool = False):
+    """Tiled MXU matmul [M, K] @ [K, N] with an fp32 VMEM accumulator
+    carried across the K grid dim, and an optional fused epilogue applied
+    on the final K step (bias add, activation, scaling — the fused-GEMM
+    base the reference builds its fusion kernels on)."""
+
+    def kernel(x_ref, w_ref, o_ref, acc_ref):
+        k_idx = pl.program_id(2)
+
+        @pl.when(k_idx == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        acc_ref[...] += jnp.dot(x_ref[...], w_ref[...],
+                                preferred_element_type=jnp.float32)
+
+        @pl.when(k_idx == pl.num_programs(2) - 1)
+        def _emit():
+            acc = acc_ref[...]
+            if epilogue is not None:
+                acc = epilogue(acc)
+            o_ref[...] = acc.astype(o_ref.dtype)
+
+    def apply(x, w):
+        m, k = x.shape
+        k2, n = w.shape
+        assert k == k2
+        bm, bn, bk = (min(block_m, m), min(block_n, n), min(block_k, k))
+        mp, np_, kp = (round_up_to(m, bm), round_up_to(n, bn),
+                       round_up_to(k, bk))
+        xp = jnp.pad(x, ((0, mp - m), (0, kp - k)))
+        wp = jnp.pad(w, ((0, kp - k), (0, np_ - n)))
+        dt = out_dtype or x.dtype
+        out = pl.pallas_call(
+            kernel,
+            grid=(mp // bm, np_ // bn, kp // bk),
+            in_specs=[pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+                      pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j))],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((mp, np_), dt),
+            scratch_shapes=[pl_scratch((bm, bn))],
+            interpret=interpret,
+        )(xp, wp)
+        return out[:m, :n]
+
+    return apply
+
+
+def pl_scratch(shape, dtype=jnp.float32):
+    """VMEM scratch accumulator spec (version-portable helper)."""
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, dtype)
+
+
+# ------------------------------------------------- streaming softmax state
+
+class OnlineSoftmax:
+    """The (m, l, acc) online-softmax update — the shared core of the
+    flash-attention and paged-decode kernels.  Static methods so kernels
+    use it directly on refs or values."""
+
+    @staticmethod
+    def init(block_q: int, dim: int):
+        return (jnp.full((block_q,), -1e30, jnp.float32),   # running max
+                jnp.zeros((block_q,), jnp.float32),          # running sum
+                jnp.zeros((block_q, dim), jnp.float32))      # weighted acc
+
+    @staticmethod
+    def update(state, scores, values):
+        """state=(m, l, acc); scores [bq, bk] fp32; values [bk, d]."""
+        m, l, acc = state
+        m_new = jnp.maximum(m, scores.max(-1))
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[:, None])
+        l_new = l * correction + p.sum(-1)
+        acc_new = acc * correction[:, None] + \
+            p.astype(values.dtype) @ values
+        return m_new, l_new, acc_new
+
+    @staticmethod
+    def finalize(state):
+        m, l, acc = state
+        return acc / jnp.maximum(l, 1e-30)[:, None]
+
+    @staticmethod
+    def lse(state):
+        m, l, _ = state
+        return m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+# ------------------------------------------------------ dequant primitives
+
+def unpack_int4(packed, orig_cols: int):
+    """Sign-extending unpack of two int4 nibbles per int8 byte
+    [r, c/2] -> [r, c] (the weight-only int4 matmul's load primitive;
+    mirrors quantization.weight_only_linear's packing)."""
+    low = jnp.left_shift(packed, 4)
+    low = jnp.right_shift(low, 4)                        # arithmetic shift
+    high = jnp.right_shift(packed, 4)
+    out = jnp.stack([low, high], axis=-1).reshape(packed.shape[0], -1)
+    return out[:, :orig_cols]
+
+
+def dequant_int8(q, scale, axis: int = -1):
+    """Per-channel int8 -> float dequant block."""
+    s = jnp.expand_dims(scale, axis=tuple(
+        i for i in range(q.ndim) if i != (axis % q.ndim)))
+    return q.astype(s.dtype) * s

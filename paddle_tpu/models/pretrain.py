@@ -52,6 +52,11 @@ class ParallelConfig:
     virtual_pp: int = 1          # VPP chunks per stage (schedule="interleave")
     sequence_parallel: bool = False
     zero1: bool = False          # shard optimizer moments over dp
+    zero3: bool = False          # shard PARAMETERS over dp too (gather on
+    #                              use: GSPMD all-gathers each scan step's
+    #                              layer slice — the stage-3 semantics of
+    #                              reference sharding_stage_3.py, overlap
+    #                              scheduled by XLA instead of hooks)
     remat: bool = False          # jax.checkpoint each decoder layer
     loss_chunks: int = 1         # chunked CE: never materialize [B,T,V] fp32
     m_dtype: str = "float32"     # AdamW first-moment storage dtype. bf16 is
@@ -164,13 +169,27 @@ class PretrainStep:
     # ---- parameter init & sharding ----
     def _shardings(self, sample_params) -> Dict[str, Any]:
         mesh = self.mesh
+        zero3 = self.pc.zero3 and self.pc.dp > 1
         out = {}
         for k, v in sample_params["blocks"].items():
-            out_k = ("pp", None) + _block_spec(k)[:np.ndim(v) - 2]
-            out[k] = NamedSharding(mesh, P(*out_k))
+            entries = list(("pp", None) + _block_spec(k)[:np.ndim(v) - 2])
+            if zero3:
+                # stage-3: lay the param over dp on the first free divisible
+                # dim (prefer the within-stage layer dim: the all-gather then
+                # fetches exactly one scan step's weights at a time)
+                for d in range(1, len(entries)):
+                    if entries[d] is None and v.shape[d] % self.pc.dp == 0 \
+                            and v.shape[d] >= self.pc.dp:
+                        entries[d] = "dp"
+                        break
+            out[k] = NamedSharding(mesh, P(*entries))
+        emb = ("mp", "dp") if zero3 and \
+            sample_params["embed"].shape[1] % self.pc.dp == 0 else ("mp", None)
+        head = ("dp", "mp") if zero3 and \
+            sample_params["head"].shape[0] % self.pc.dp == 0 else (None, "mp")
         return {
-            "embed": NamedSharding(mesh, P("mp", None)),
-            "head": NamedSharding(mesh, P(None, "mp")),
+            "embed": NamedSharding(mesh, P(*emb)),
+            "head": NamedSharding(mesh, P(*head)),
             "norm": NamedSharding(mesh, P(None)),
             "blocks": out,
         }
@@ -214,9 +233,12 @@ class PretrainStep:
         def moment_like(p, dtype):
             m = jnp.zeros(p.shape, jnp.dtype(dtype))
             sh_ = p.sharding
-            if self.pc.zero1 and self.pc.dp > 1 and isinstance(sh_, NamedSharding):
+            if self.pc.zero1 and self.pc.dp > 1 and \
+                    isinstance(sh_, NamedSharding) and \
+                    "dp" not in jax.tree_util.tree_leaves(list(sh_.spec)):
                 # ZeRO-1: shard fp32 moments over the (otherwise replicated)
-                # dp axis along the first divisible unsharded dim
+                # dp axis along the first divisible unsharded dim (zero3
+                # params already carry dp; moments inherit it via sharding)
                 spec = list(sh_.spec) + [None] * (len(p.shape) - len(sh_.spec))
                 for d, entry in enumerate(spec):
                     if entry is None and p.shape[d] % self.pc.dp == 0 and \

@@ -11,11 +11,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
-from . import creation, indexing, linalg, manipulation, math, random_ops, search
+from . import (creation, extras, indexing, linalg, manipulation, math,
+               random_ops, search)
 from ._prim import OP_REGISTRY, apply_op  # noqa: F401
 
 # ---- re-export everything public ----
-_MODULES = (creation, math, manipulation, linalg, search, random_ops)
+_MODULES = (creation, math, manipulation, linalg, search, random_ops, extras)
 __all__ = []
 for _m in _MODULES:
     for _name in dir(_m):
@@ -25,6 +26,11 @@ for _m in _MODULES:
         if callable(_obj) and getattr(_obj, "__module__", "").startswith("paddle_tpu"):
             globals()[_name] = _obj
             __all__.append(_name)
+
+# ---- inplace `op_` variant family (reference generate_inplace_fn) ----
+for _name, _fn in extras.install_inplace_variants(dict(globals())).items():
+    globals()[_name] = _fn
+    __all__.append(_name)
 
 
 # ---- operator dunders ----
@@ -130,3 +136,16 @@ for _name, _fn in _METHOD_SOURCES.items():
     setattr(Tensor, _name, _fn)
 
 inverse = linalg.inv
+
+# extras + inplace family as Tensor methods too
+for _name in ("sgn", "take", "isin", "nanquantile", "frexp", "cdist",
+              "view_as", "diagonal_scatter", "select_scatter",
+              "slice_scatter", "masked_scatter", "vander",
+              "cholesky_inverse", "matrix_exp", "multigammaln",
+              "is_floating_point", "is_integer", "is_complex",
+              "cumulative_trapezoid", "isneginf", "isposinf", "isreal"):
+    setattr(Tensor, _name, getattr(extras, _name))
+setattr(Tensor, "unfold", extras.unfold)
+for _name in list(__all__):
+    if _name.endswith("_") and not hasattr(Tensor, _name):
+        setattr(Tensor, _name, globals()[_name])

@@ -52,7 +52,7 @@ def unary_op(name, fn):
         return apply_op(name_, fn, (_t(x),))
     name_ = name
     op.__name__ = name
-    register_op(name, fn)
+    register_op(name, fn, spmd_rule="elementwise")
     return op
 
 
@@ -65,7 +65,7 @@ def binary_op(name, fn):
         return apply_op(name_, fn, (x if xt or not yt else x, y))
     name_ = name
     op.__name__ = name
-    register_op(name, fn)
+    register_op(name, fn, spmd_rule="elementwise")
     return op
 
 
@@ -80,5 +80,5 @@ def reduce_op(name, fn, dtype_arg=False):
         return apply_op(name_, lambda a: fn(a, **kw), (_t(x),))
     name_ = name
     op.__name__ = name
-    register_op(name, fn)
+    register_op(name, fn, spmd_rule="reduction")
     return op

@@ -1,0 +1,406 @@
+"""Remaining public tensor-op surface (reference: python/paddle/tensor/
+math.py / manipulation.py / linalg.py stragglers) + the inplace `op_`
+variant family.
+
+Inplace semantics under jax: arrays are immutable, so ``x.op_()`` computes
+functionally and rebinds the Tensor's buffer (same observable behavior as
+the reference's in-place kernels for eager code; the autograd tape keeps
+the functional result)."""
+
+from __future__ import annotations
+
+import math
+from itertools import combinations as _pycomb
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._prim import apply_op
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+# ---------------------------------------------------------------- creation
+
+def vander(x, n=None, increasing=False, name=None):
+    def prim(v):
+        return jnp.vander(v, N=n, increasing=increasing)
+    return apply_op("vander", prim, (_t(x),))
+
+
+def fill_constant(shape, dtype, value, name=None):
+    from .. import dtypes
+    return Tensor(jnp.full([int(s) for s in shape], value,
+                           dtypes.convert_dtype(dtype)))
+
+
+def block_diag(inputs, name=None):
+    def prim(*arrs):
+        return jax.scipy.linalg.block_diag(*[jnp.atleast_2d(a) for a in arrs])
+    return apply_op("block_diag", prim, tuple(_t(i) for i in inputs))
+
+
+def polar(abs, angle, name=None):  # noqa: A002
+    def prim(r, theta):
+        return (r * jnp.cos(theta) + 1j * r * jnp.sin(theta)) \
+            .astype(jnp.complex64)
+    return apply_op("polar", prim, (_t(abs), _t(angle)))
+
+
+# ------------------------------------------------------------------- math
+
+def sgn(x, name=None):
+    """sign for real; x/|x| for complex (reference math.py sgn)."""
+    def prim(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.maximum(mag, 1e-38))
+        return jnp.sign(v)
+    return apply_op("sgn", prim, (_t(x),))
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+
+    def prim(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+    return apply_op("add_n", prim, tuple(_t(i) for i in inputs))
+
+
+def increment(x, value=1.0, name=None):
+    x = _t(x)
+    out = apply_op("increment", lambda v: v + value, (x,))
+    x._data = out._data
+    return x
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather (reference math.py take): out[i] = x.flat[idx[i]]."""
+    def prim(v, idx):
+        flat = v.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            idx = ((idx % n) + n) % n
+        elif mode == "clip":
+            idx = jnp.clip(idx, -n, n - 1)
+        idx = jnp.where(idx < 0, idx + n, idx)
+        return jnp.take(flat, idx)
+    return apply_op("take", prim, (_t(x), _t(index)))
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    def prim(v, t):
+        out = jnp.isin(v, t)
+        return ~out if invert else out
+    return apply_op("isin", prim, (_t(x), _t(test_x)))
+
+
+def isneginf(x, name=None):
+    return apply_op("isneginf", jnp.isneginf, (_t(x),))
+
+
+def isposinf(x, name=None):
+    return apply_op("isposinf", jnp.isposinf, (_t(x),))
+
+
+def isreal(x, name=None):
+    return apply_op("isreal", jnp.isreal, (_t(x),))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    def prim(v):
+        return jnp.nanquantile(v, q, axis=axis, keepdims=keepdim,
+                               method=interpolation)
+    return apply_op("nanquantile", prim, (_t(x),))
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    def prim(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else \
+            (jnp.min(v), jnp.max(v))
+        return jnp.linspace(lo, hi, bins + 1).astype(jnp.float32)
+    return apply_op("histogram_bin_edges", prim, (_t(input),))
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def prim(v, *maybe_x):
+        d = dx if dx is not None else 1.0
+        n = v.shape[axis]
+        a = jnp.take(v, jnp.arange(0, n - 1), axis=axis)
+        b = jnp.take(v, jnp.arange(1, n), axis=axis)
+        if maybe_x:
+            xs = maybe_x[0]
+            xa = jnp.take(xs, jnp.arange(0, n - 1), axis=axis)
+            xb = jnp.take(xs, jnp.arange(1, n), axis=axis)
+            steps = xb - xa
+        else:
+            steps = d
+        return jnp.cumsum((a + b) / 2.0 * steps, axis=axis)
+    args = (_t(y),) + ((_t(x),) if x is not None else ())
+    return apply_op("cumulative_trapezoid", prim, args)
+
+
+def frexp(x, name=None):
+    def prim(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(jnp.int32)
+    return apply_op("frexp", prim, (_t(x),))
+
+
+def multigammaln(x, p, name=None):
+    from jax.scipy.special import gammaln
+
+    def prim(v):
+        js = jnp.arange(1, p + 1, dtype=v.dtype)
+        return (p * (p - 1) / 4.0) * math.log(math.pi) + \
+            gammaln(v[..., None] + (1 - js) / 2.0).sum(-1)
+    return apply_op("multigammaln", prim, (_t(x),))
+
+
+def matrix_exp(x, name=None):
+    return apply_op("matrix_exp", jax.scipy.linalg.expm, (_t(x),))
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def prim(L):
+        eye = jnp.eye(L.shape[-1], dtype=L.dtype)
+        if upper:
+            inv = jax.scipy.linalg.solve_triangular(L, eye, lower=False)
+            return inv @ inv.T
+        inv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+        return inv.T @ inv
+    return apply_op("cholesky_inverse", prim, (_t(x),))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def prim(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum((diff * diff).sum(-1), 0.0))
+        if p == float("inf"):
+            return jnp.abs(diff).max(-1)
+        return (jnp.abs(diff) ** p).sum(-1) ** (1.0 / p)
+    return apply_op("cdist", prim, (_t(x), _t(y)))
+
+
+def cartesian_prod(x, name=None):
+    if isinstance(x, Tensor):
+        x = [x]
+    if len(x) == 1:
+        return _t(x[0])
+
+    def prim(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return apply_op("cartesian_prod", prim, tuple(_t(i) for i in x))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    def prim(v):
+        n = v.shape[0]
+        if with_replacement:
+            import itertools
+            idx = np.asarray(list(
+                itertools.combinations_with_replacement(range(n), r)),
+                dtype=np.int32)
+        else:
+            idx = np.asarray(list(_pycomb(range(n), r)), dtype=np.int32)
+        if idx.size == 0:
+            return jnp.zeros((0, r), v.dtype)
+        return v[jnp.asarray(idx)]
+    return apply_op("combinations", prim, (_t(x),))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype="float32", name=None):
+    from ..core.random import next_key
+    from .. import dtypes
+    z = jax.random.normal(next_key(), tuple(shape or ()), jnp.float32)
+    return Tensor(jnp.exp(mean + std * z).astype(dtypes.convert_dtype(dtype)))
+
+
+def standard_gamma(alpha, name=None):
+    from ..core.random import next_key
+    a = _t(alpha)
+    return Tensor(jax.random.gamma(next_key(), a._data, a._data.shape))
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference linalg.svd_lowrank behavior)."""
+    from ..core.random import next_key
+
+    def prim(a, *maybe_m):
+        A = a - maybe_m[0] if maybe_m else a
+        m, n = A.shape[-2:]
+        k = min(q, m, n)
+        G = jax.random.normal(next_key(), A.shape[:-2] + (n, k), A.dtype)
+        Y = A @ G
+        for _ in range(niter):
+            Y = A @ (A.swapaxes(-1, -2) @ Y)
+        Q, _ = jnp.linalg.qr(Y)
+        B = Q.swapaxes(-1, -2) @ A
+        u, s, vh = jnp.linalg.svd(B, full_matrices=False)
+        return Q @ u, s, vh.swapaxes(-1, -2)
+    args = (_t(x),) + ((_t(M),) if M is not None else ())
+    return apply_op("svd_lowrank", prim, args)
+
+
+# --------------------------------------------------------- scatter family
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def prim(v, src):
+        perm = [i for i in range(v.ndim) if i not in
+                (axis1 % v.ndim, axis2 % v.ndim)] + \
+            [axis1 % v.ndim, axis2 % v.ndim]
+        inv = np.argsort(perm)
+        vt = jnp.transpose(v, perm)
+        h, w = vt.shape[-2], vt.shape[-1]
+        rows = jnp.arange(max(0, -offset), max(0, -offset) + src.shape[-1])
+        cols = rows + offset
+        vt = vt.at[..., rows, cols].set(src)
+        return jnp.transpose(vt, inv)
+    return apply_op("diagonal_scatter", prim, (_t(x), _t(y)))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def prim(v, src):
+        idx = [slice(None)] * v.ndim
+        idx[axis] = index
+        return v.at[tuple(idx)].set(src)
+    return apply_op("select_scatter", prim, (_t(x), _t(values)))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def prim(v, src):
+        idx = [slice(None)] * v.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(s, e, st)
+        return v.at[tuple(idx)].set(src)
+    return apply_op("slice_scatter", prim, (_t(x), _t(value)))
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions of x with consecutive elements of value
+    (reference manipulation.py masked_scatter)."""
+    def prim(v, m, src):
+        flat_m = m.reshape(-1)
+        # position of each True among Trues
+        pos = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        gathered = jnp.take(src.reshape(-1),
+                            jnp.clip(pos, 0, src.size - 1))
+        out = jnp.where(flat_m, gathered, v.reshape(-1))
+        return out.reshape(v.shape)
+    return apply_op("masked_scatter", prim, (_t(x), _t(mask), _t(value)))
+
+
+# ------------------------------------------------------------ dtype preds
+
+def is_floating_point(x) -> bool:
+    from .. import dtypes
+    return dtypes.is_floating_point(_t(x).dtype)
+
+
+def is_integer(x) -> bool:
+    return jnp.issubdtype(jnp.dtype(_t(x)._data.dtype), jnp.integer)
+
+
+def is_complex(x) -> bool:
+    return jnp.issubdtype(jnp.dtype(_t(x)._data.dtype), jnp.complexfloating)
+
+
+def is_empty(x) -> Tensor:
+    return Tensor(jnp.asarray(_t(x)._data.size == 0))
+
+
+# --------------------------------------------------------------- printing
+
+_PRINT_OPTS = {"precision": 8, "threshold": 1000, "edgeitems": 3,
+               "linewidth": 80, "sci_mode": None}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference framework.set_printoptions — applied to numpy rendering."""
+    for k, v in (("precision", precision), ("threshold", threshold),
+                 ("edgeitems", edgeitems), ("sci_mode", sci_mode),
+                 ("linewidth", linewidth)):
+        if v is not None:
+            _PRINT_OPTS[k] = v
+    np.set_printoptions(
+        precision=_PRINT_OPTS["precision"],
+        threshold=_PRINT_OPTS["threshold"],
+        edgeitems=_PRINT_OPTS["edgeitems"],
+        linewidth=_PRINT_OPTS["linewidth"],
+        suppress=(_PRINT_OPTS["sci_mode"] is False))
+
+
+def view_as(x, other, name=None):
+    return _t(x).reshape(list(_t(other).shape))
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis`` (tensor-method unfold; the nn Unfold
+    im2col is separate)."""
+    def prim(v):
+        n = v.shape[axis]
+        starts = jnp.arange(0, n - size + 1, step)
+        windows = [jnp.take(v, starts + i, axis=axis) for i in range(size)]
+        return jnp.stack(windows, axis=-1)
+    return apply_op("tensor_unfold", prim, (_t(x),))
+
+
+# ------------------------------------------------------- inplace variants
+
+def _make_inplace(fn_name, fn):
+    def inplace(x, *args, **kwargs):
+        # run the functional op on a proxy that carries x's CURRENT autograd
+        # node, so the tape's recorded input keeps pointing upstream after
+        # x is rebound to the result (rebinding x itself would make the new
+        # node its own input and orphan the producer)
+        proxy = Tensor(x._data, stop_gradient=x.stop_gradient)
+        proxy._node = getattr(x, "_node", None)
+        proxy._slot = getattr(x, "_slot", 0)
+        out = fn(proxy, *args, **kwargs)
+        x._data = out._data
+        x.stop_gradient = out.stop_gradient
+        x._node = getattr(out, "_node", None)
+        x._slot = getattr(out, "_slot", 0)
+        return x
+    inplace.__name__ = fn_name
+    return inplace
+
+
+def install_inplace_variants(ns: dict):
+    """Generate the `op_` family for every unary-ish op in ``ns`` that has a
+    same-shape functional base (reference generate_inplace_fn)."""
+    bases = ["abs", "acos", "asin", "atan", "ceil", "cos", "cosh", "exp",
+             "expm1", "floor", "log", "log2", "log10", "log1p", "neg",
+             "reciprocal", "round", "rsqrt", "sigmoid", "sin", "sinh",
+             "sqrt", "square", "tan", "tanh", "trunc", "frac", "erf",
+             "erfinv", "digamma", "lgamma", "logit", "i0", "gammaln",
+             "add", "subtract", "multiply", "divide", "floor_divide",
+             "remainder", "pow", "clip", "lerp", "copysign", "hypot",
+             "ldexp", "gcd", "lcm", "nan_to_num", "sinc",
+             "logical_and", "logical_or", "logical_xor", "logical_not",
+             "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+             "equal", "not_equal", "greater_equal", "greater_than",
+             "less_equal", "less_than", "cumsum", "cumprod",
+             "fill_diagonal", "squeeze", "unsqueeze", "flatten",
+             "tril", "triu", "cast", "scatter", "index_add", "index_put",
+             "masked_fill", "put_along_axis", "t", "transpose"]
+    made = {}
+    for b in bases:
+        fn = ns.get(b)
+        if fn is None or f"{b}_" in ns:
+            continue
+        made[f"{b}_"] = _make_inplace(f"{b}_", fn)
+    return made

@@ -144,6 +144,11 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static import _static_minimize
+        if _static_minimize(self, loss):
+            # static capture: the Executor's training replay performs
+            # backward + step against the recorded program on every run
+            return None, None
         loss.backward()
         self.step()
         return None, None

@@ -302,3 +302,68 @@ def fake_channel_wise_dequantize_max_abs(x, scales, quant_bits=(8,),
         return v.astype(jnp.float32) * (s.reshape(shape) / qmax)
     return apply_op("fake_channel_wise_dequantize_max_abs", prim,
                     (_t(x), _t(scales)))
+
+
+# ================= fp8 (reference: paddle fp8 fused kernel family) =================
+
+_FP8_DTYPES = {"e4m3": "float8_e4m3fn", "float8_e4m3fn": "float8_e4m3fn",
+               "e5m2": "float8_e5m2", "float8_e5m2": "float8_e5m2"}
+_FP8_MAX = {"float8_e4m3fn": 448.0, "float8_e5m2": 57344.0}
+
+
+def fp8_quantize(x, scale=None, dtype="e4m3"):
+    """Scaled cast to fp8: returns (fp8 tensor, fp32 scale).  With no
+    scale given, uses amax/dtype_max (the delayed-scaling recipe's first
+    step).  x * 1/scale is representable in the fp8 range."""
+    jdt = jnp.dtype(_FP8_DTYPES[dtype])
+    arr = _t(x)._data
+
+    def prim(v, *maybe_scale):
+        vf = v.astype(jnp.float32)
+        if maybe_scale:
+            s = maybe_scale[0].astype(jnp.float32)
+        else:
+            s = jnp.max(jnp.abs(vf)) / _FP8_MAX[str(jdt)]
+            s = jnp.maximum(s, 1e-12)
+        return (vf / s).astype(jdt), s
+
+    if scale is not None:
+        q, s = apply_op("fp8_quantize", prim, (Tensor(arr), _t(scale)))
+    else:
+        q, s = apply_op("fp8_quantize", prim, (Tensor(arr),))
+    return q, s
+
+
+def fp8_dequantize(x, scale, out_dtype="float32"):
+    def prim(v, s):
+        return (v.astype(jnp.float32) * s.astype(jnp.float32)) \
+            .astype(jnp.dtype(out_dtype))
+    return apply_op("fp8_dequantize", prim, (_t(x), _t(scale)))
+
+
+def fp8_gemm(x, x_scale, w, w_scale, bias=None, out_dtype="bfloat16"):
+    """fp8 x fp8 matmul with fp32 accumulation and per-tensor descale —
+    the fused_gemm_epilogue fp8 path.  On TPU the fp8 operands feed the
+    MXU natively (XLA lowers dot(f8, f8, preferred=f32) onto hardware fp8
+    where the generation supports it; elsewhere it widens)."""
+    def prim(a, sa, b, sb, *maybe_bias):
+        acc = jax.lax.dot_general(
+            a, b, (((a.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out = acc * (sa.astype(jnp.float32) * sb.astype(jnp.float32))
+        if maybe_bias:
+            out = out + maybe_bias[0].astype(jnp.float32)
+        return out.astype(jnp.dtype(out_dtype))
+    args = (_t(x), _t(x_scale), _t(w), _t(w_scale)) + \
+        ((_t(bias),) if bias is not None else ())
+    return apply_op("fp8_gemm", prim, args)
+
+
+def fp8_linear(x, weight, bias=None, dtype="e4m3", out_dtype=None):
+    """Dynamic-scaling fp8 linear: quantize activation + weight per call,
+    fp8 matmul, descale.  out dtype defaults to the input dtype."""
+    xin = _t(x)
+    out_dt = out_dtype or str(xin._data.dtype)
+    qx, sx = fp8_quantize(xin, dtype=dtype)
+    qw, sw = fp8_quantize(weight, dtype=dtype)
+    return fp8_gemm(qx, sx, qw, sw, bias=bias, out_dtype=out_dt)

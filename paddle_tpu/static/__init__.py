@@ -1,31 +1,292 @@
-"""paddle.static compatibility surface (reference: python/paddle/static/).
+"""paddle.static — working static-graph surface (reference:
+python/paddle/static/ Program/Executor/program_guard/data).
 
-The legacy ProgramDesc static-graph mode is not ported (SURVEY.md §7.5);
-this module keeps the names that remain meaningful under the XLA
-compilation model: InputSpec, save/load_inference_model (jit.save/load),
-and informative errors for the rest.
+TPU-native design: the reference's ProgramDesc IR is replaced by CAPTURE +
+REPLAY over the framework's single op-dispatch seam (core.autograd.apply).
+Inside ``program_guard`` every executed op is recorded into the active
+``Program`` as (prim, input slots, output slots); ``static.data`` creates
+named feed slots.  ``Executor.run`` replays the recorded DAG against new
+feed values:
+
+- inference programs (no optimizer) replay as one ``jax.jit``-compiled
+  pure function over (feeds, parameters) — the XLA whole-program path, the
+  same executable shape ``jit.to_static`` produces;
+- training programs (built with ``optimizer.minimize(loss)``) replay
+  through the eager autograd so ``backward`` + the optimizer update run
+  against the ORIGINAL Parameter objects — parameters live across ``run``
+  calls exactly like scope variables in the reference executor.
+
+This keeps the user-visible contract (build once, feed/fetch many times,
+parameters persist in the scope) while the execution model stays jax.
 """
 
 from __future__ import annotations
 
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes
+from ..core import autograd as _autograd
+from ..core.tensor import Tensor
 from ..jit import InputSpec  # noqa: F401
 
-
-def _no_static(name):
-    def fn(*a, **k):
-        raise NotImplementedError(
-            f"paddle_tpu has no legacy static-graph {name}; use "
-            "paddle_tpu.jit.to_static (XLA whole-program compilation) instead")
-    fn.__name__ = name
-    return fn
+_static_mode = False
 
 
-Program = _no_static("Program")
-program_guard = _no_static("program_guard")
-Executor = _no_static("Executor")
-default_main_program = _no_static("default_main_program")
-default_startup_program = _no_static("default_startup_program")
-data = _no_static("data")
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+class Program:
+    """Recorded op DAG + feed registry (ProgramDesc slot)."""
+
+    def __init__(self):
+        self.ops: List[dict] = []          # {prim, kwargs, in, out, name}
+        self.feeds: Dict[str, int] = {}    # feed name -> slot
+        self._slot_of: Dict[int, int] = {}  # id(Tensor) -> slot
+        self._tensors: Dict[int, Tensor] = {}  # slot -> Tensor (capture refs)
+        self._nslots = 0
+        self._minimize: Optional[dict] = None
+        self.random_seed = None
+
+    # ---- slot management ----
+    def _slot(self, t: Tensor, create: bool = True) -> int:
+        key = id(t)
+        if key not in self._slot_of:
+            if not create:
+                raise KeyError
+            self._slot_of[key] = self._nslots
+            self._tensors[self._nslots] = t
+            self._nslots += 1
+        return self._slot_of[key]
+
+    def _record(self, name, prim, kwargs, inputs, outputs):
+        in_slots = []
+        for a in inputs:
+            if isinstance(a, Tensor):
+                in_slots.append(("slot", self._slot(a)))
+            else:
+                in_slots.append(("const", a))
+        outs = outputs if isinstance(outputs, (tuple, list)) else (outputs,)
+        out_slots = [self._slot(o) for o in outs if isinstance(o, Tensor)]
+        self.ops.append({"name": name, "prim": prim, "kwargs": kwargs or {},
+                         "in": in_slots, "out": out_slots})
+
+    def register_feed(self, name: str, t: Tensor):
+        self.feeds[name] = self._slot(t)
+
+    # ---- introspection (reference Program.block surface, minimal) ----
+    def num_ops(self) -> int:
+        return len(self.ops)
+
+    def __repr__(self):
+        lines = [f"Program({len(self.ops)} ops, feeds={list(self.feeds)})"]
+        for op in self.ops[:50]:
+            lines.append(f"  {op['name']}: {op['in'] and len(op['in'])} -> "
+                         f"{op['out']}")
+        return "\n".join(lines)
+
+    def parameters(self) -> List[Tensor]:
+        from ..nn.layer import Parameter
+        seen, out = set(), []
+        for t in self._tensors.values():
+            if isinstance(t, Parameter) and id(t) not in seen:
+                seen.add(id(t))
+                out.append(t)
+        return out
+
+    # ---- replay ----
+    def _replay(self, env: Dict[int, Tensor], upto: Optional[int] = None,
+                start: int = 0):
+        """Execute recorded ops [start:upto] over ``env`` (slot -> Tensor).
+        Slots not in env resolve to their captured tensors (parameters
+        resolve LIVE so updates between runs are visible)."""
+        def get(slot):
+            if slot in env:
+                return env[slot]
+            return self._tensors[slot]
+
+        ops = self.ops[start:upto]
+        for op in ops:
+            args = [get(s) if kind == "slot" else s
+                    for kind, s in [(k, v) for k, v in op["in"]]]
+            out = _autograd.apply(op["name"], op["prim"], args, op["kwargs"])
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for slot, o in zip(op["out"], outs):
+                env[slot] = o
+        return env
+
+
+_default_main = Program()
+_default_startup = Program()
+_active: Optional[Program] = None
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class program_guard:
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        global _active
+        self._prev = _active
+        self._prev_hook = _autograd._STATIC_RECORD_HOOK
+        _active = self.main
+        _autograd._STATIC_RECORD_HOOK = self.main._record
+        return self
+
+    def __exit__(self, *exc):
+        global _active
+        _active = self._prev
+        _autograd._STATIC_RECORD_HOOK = self._prev_hook
+        return False
+
+
+def data(name: str, shape: Sequence[int], dtype="float32", lod_level=0):
+    """Named feed placeholder.  Dynamic dims (None/-1) capture as 1; replay
+    re-executes with the fed shapes (prims are shape-polymorphic)."""
+    prog = _active if _active is not None else _default_main
+    cap_shape = [1 if (s is None or int(s) < 0) else int(s) for s in shape]
+    t = Tensor(jnp.zeros(cap_shape, dtypes.convert_dtype(dtype)))
+    t.stop_gradient = True
+    prog.register_feed(name, t)
+    return t
+
+
+class Executor:
+    """Replay engine (reference static.Executor).  place is accepted for
+    API parity; jax owns placement."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._jit_cache = {}
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy: bool = True):
+        program = program or _default_main
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if not program.ops and program._minimize is None:
+            return []                         # startup program: no-op
+
+        missing = [n for n in program.feeds if n not in feed]
+        if missing:
+            raise KeyError(
+                f"feed is missing placeholder(s) {missing}; program feeds "
+                f"are {sorted(program.feeds)}")
+        env: Dict[int, Tensor] = {}
+        for fname, slot in program.feeds.items():
+            v = feed[fname]
+            arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            env[slot] = Tensor(arr)
+
+        if program._minimize is not None:
+            out = self._run_train(program, env, fetch_list)
+        else:
+            out = self._run_infer(program, env, fetch_list)
+        if return_numpy:
+            return [np.asarray(o._data) for o in out]
+        return list(out)
+
+    # training replay: eager autograd against live Parameters
+    def _run_train(self, program, env, fetch_list):
+        mz = program._minimize
+        env = program._replay(env, upto=mz["op_index"])
+        loss = env[mz["loss_slot"]]
+        opt = mz["optimizer"]
+        loss.backward()
+        if opt is not None:      # append_backward-only programs: grads only
+            opt.step()
+            opt.clear_grad()
+        # only ops recorded AFTER minimize (metrics etc.); re-running the
+        # forward would double compute and report the post-step loss
+        program._replay(env, start=mz["op_index"])
+        return [env[program._slot(t, create=False)] if id(t) in
+                program._slot_of else t for t in fetch_list]
+
+    # inference replay: whole program under jax.jit
+    def _run_infer(self, program, env, fetch_list):
+        fetch_slots = []
+        for t in fetch_list:
+            fetch_slots.append(program._slot(t, create=False))
+        feed_slots = sorted(env)
+        params = program.parameters()
+        key = (id(program), len(program.ops), tuple(fetch_slots),
+               tuple(feed_slots),
+               tuple(env[s]._data.shape for s in feed_slots))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            def pure(feed_arrays, param_arrays):
+                local = {s: Tensor(a) for s, a in
+                         zip(feed_slots, feed_arrays)}
+                saved = [(p, p._data) for p in params]
+                try:
+                    for p, a in zip(params, param_arrays):
+                        p._data = a
+                    with _autograd.no_grad():
+                        program._replay(local)
+                finally:
+                    for p, a in saved:
+                        p._data = a
+                return [local[s]._data for s in fetch_slots]
+
+            fn = self._jit_cache[key] = jax.jit(pure)
+        outs = fn([env[s]._data for s in feed_slots],
+                  [p._data for p in params])
+        return [Tensor(o) for o in outs]
+
+    def close(self):
+        pass
+
+
+def _attach_minimize(program: Program, optimizer, loss: Tensor):
+    program._minimize = {
+        "optimizer": optimizer,
+        "loss_slot": program._slot(loss, create=False),
+        "op_index": len(program.ops),
+    }
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """reference static.append_backward: in capture mode the gradient ops
+    are appended at replay by the training Executor path; this records the
+    intent when called without an optimizer."""
+    prog = _active if _active is not None else _default_main
+    if prog._minimize is None:
+        prog._minimize = {"optimizer": None,
+                          "loss_slot": prog._slot(loss, create=False),
+                          "op_index": len(prog.ops)}
+    return []
+
+
+# optimizer.minimize integration: record rather than step when capturing
+def _static_minimize(optimizer, loss):
+    if _active is None:
+        return False
+    _attach_minimize(_active, optimizer, loss)
+    return True
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
@@ -40,3 +301,9 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 
 class amp:
     """paddle.static.amp parity shim."""
+
+
+# keep the legacy names importable
+__all__ = ["Program", "program_guard", "Executor", "data", "enable_static",
+           "disable_static", "default_main_program",
+           "default_startup_program", "append_backward", "InputSpec"]

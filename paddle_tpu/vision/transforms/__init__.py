@@ -132,3 +132,309 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size, interpolation)(img)
+
+
+# ---------------- widened transform set (reference transforms.py) ----------------
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        if np.random.random() < self.prob:
+            arr = arr[::-1].copy()
+        return arr
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        if isinstance(padding, numbers.Number):
+            padding = (padding,) * 4
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding                   # left, top, right, bottom
+        self.fill = fill
+        self.mode = {"constant": "constant", "reflect": "reflect",
+                     "edge": "edge", "symmetric": "symmetric"}[padding_mode]
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        l, t, r, b = self.padding
+        kw = {"constant_values": self.fill} if self.mode == "constant" else {}
+        return np.pad(arr, ((t, b), (l, r), (0, 0)), mode=self.mode, **kw)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img).astype("float32")
+        gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+                + 0.114 * arr[..., 2])[..., None]
+        out = np.repeat(gray, self.num_output_channels, axis=-1)
+        return out.astype(np.asarray(img).dtype)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        angle = np.random.uniform(*self.degrees)
+        return rotate(arr, angle, fill=self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                crop = arr[i:i + ch, j:j + cw]
+                return Resize(self.size, self.interpolation)._apply_image(crop)
+        return Resize(self.size, self.interpolation)._apply_image(arr)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        return adjust_brightness(img, 1 + np.random.uniform(
+            -self.value, self.value))
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        return adjust_contrast(img, 1 + np.random.uniform(
+            -self.value, self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        return adjust_saturation(img, 1 + np.random.uniform(
+            -self.value, self.value))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.ts))
+        for i in order:
+            img = self.ts[i]._apply_image(img)
+        return img
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob, self.scale, self.ratio, self.value = \
+            prob, scale, ratio, value
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img).copy()
+        if np.random.random() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                arr[i:i + eh, j:j + ew] = self.value
+                break
+        return arr
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees, self.translate, self.scale_rng = degrees, translate, scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        h, w = arr.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        s = np.random.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        return _affine(arr, angle, (tx, ty), s, fill=self.fill)
+
+
+# ---------------- functional ops (reference transforms/functional.py) ----------------
+
+def hflip(img):
+    return _to_hwc(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _to_hwc(img)[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    return _to_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)._apply_image(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)._apply_image(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _to_hwc(img)
+    dt = arr.dtype
+    hi = 255.0 if dt == np.uint8 else None
+    out = arr.astype("float32") * brightness_factor
+    if hi:
+        out = np.clip(out, 0, hi)
+    return out.astype(dt)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _to_hwc(img)
+    dt = arr.dtype
+    mean = arr.astype("float32").mean()
+    out = (arr.astype("float32") - mean) * contrast_factor + mean
+    if dt == np.uint8:
+        out = np.clip(out, 0, 255)
+    return out.astype(dt)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _to_hwc(img)
+    dt = arr.dtype
+    f = arr.astype("float32")
+    gray = (0.299 * f[..., 0] + 0.587 * f[..., 1]
+            + 0.114 * f[..., 2])[..., None]
+    out = gray + (f - gray) * saturation_factor
+    if dt == np.uint8:
+        out = np.clip(out, 0, 255)
+    return out.astype(dt)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in turns, [-0.5, 0.5]) via HSV round-trip."""
+    assert -0.5 <= hue_factor <= 0.5
+    arr = _to_hwc(img)
+    dt = arr.dtype
+    f = arr.astype("float32") / (255.0 if dt == np.uint8 else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc = f.max(-1)
+    minc = f.min(-1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    dn = np.maximum(d, 1e-12)
+    rc = (maxc - r) / dn
+    gc = (maxc - g) / dn
+    bc = (maxc - b) / dn
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = np.where(d == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    fr = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * fr)
+    t = v * (1 - s * (1 - fr))
+    i = i.astype("int32") % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1)
+    if dt == np.uint8:
+        out = np.clip(out * 255.0, 0, 255)
+    return out.astype(dt)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)._apply_image(img)
+
+
+def _affine(arr, angle, translate=(0.0, 0.0), scale=1.0, fill=0):
+    """Inverse-mapped nearest-neighbor affine about the image center."""
+    h, w = arr.shape[:2]
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    a = np.deg2rad(angle)
+    cos, sin = np.cos(a) / scale, np.sin(a) / scale
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    xs = cos * (xx - cx - translate[0]) + sin * (yy - cy - translate[1]) + cx
+    ys = -sin * (xx - cx - translate[0]) + cos * (yy - cy - translate[1]) + cy
+    xi = np.round(xs).astype("int64")
+    yi = np.round(ys).astype("int64")
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    out = np.full_like(arr, fill)
+    out[valid] = arr[yi[valid], xi[valid]]
+    return out
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    return _affine(_to_hwc(img), angle, fill=fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = _to_hwc(img)
+    if not inplace:
+        arr = arr.copy()
+    arr[i:i + h, j:j + w] = v
+    return arr

@@ -194,3 +194,55 @@ def test_fake_quantize(rng):
     assert err < np.abs(x.numpy()).max() / 100  # 8-bit quantization error
     q.sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), np.ones(64), rtol=1e-6)  # STE
+
+
+# ---------------- audio features (widened) ----------------
+
+def test_audio_feature_layers(rng):
+    import torch
+    from paddle_tpu.audio import features, functional as AF, get_window
+    sr = 8000
+    t = np.arange(sr // 2, dtype="float32") / sr
+    x = paddle.to_tensor(np.sin(2 * np.pi * 800 * t))
+    spec = features.Spectrogram(n_fft=256, hop_length=128)(x)
+    assert tuple(spec.shape)[0] == 129
+    f_peak = float(np.asarray(spec._data).mean(-1).argmax()) * sr / 256
+    assert abs(f_peak - 800) < 65
+    mel = features.MelSpectrogram(sr=sr, n_fft=256, hop_length=128,
+                                  n_mels=20)(x)
+    assert tuple(mel.shape)[0] == 20
+    logmel = features.LogMelSpectrogram(sr=sr, n_fft=256, hop_length=128,
+                                        n_mels=20, top_db=60.0)(x)
+    lm = np.asarray(logmel._data)
+    assert lm.max() - lm.min() <= 60.0 + 1e-3
+    mfcc = features.MFCC(sr=sr, n_mfcc=13, n_fft=256, hop_length=128,
+                         n_mels=20)(x)
+    assert tuple(mfcc.shape)[0] == 13
+    np.testing.assert_allclose(
+        np.asarray(get_window("hann", 128)._data),
+        torch.hann_window(128, periodic=True).numpy(), atol=1e-6)
+
+
+def test_device_memory_summary():
+    from paddle_tpu import device
+    s = device.cuda.memory_summary()
+    assert isinstance(s, str) and len(s) > 0
+
+
+def test_fp8_quantization(rng):
+    from paddle_tpu import quantization as Q
+    x = paddle.to_tensor(rng.standard_normal((8, 16)).astype("float32"))
+    w = paddle.to_tensor(rng.standard_normal((16, 4)).astype("float32"))
+    q, s = Q.fp8_quantize(x)
+    assert str(q._data.dtype) == "float8_e4m3fn"
+    back = np.asarray(Q.fp8_dequantize(q, s)._data)
+    xref = np.asarray(x._data)
+    assert np.abs(back - xref).max() / np.abs(xref).max() < 0.1
+    out = np.asarray(Q.fp8_linear(x, w)._data, dtype="float32")
+    want = xref @ np.asarray(w._data)
+    assert np.abs(out - want).max() / np.abs(want).max() < 0.15
+    # e5m2 variant + explicit scale path
+    q2, s2 = Q.fp8_quantize(x, dtype="e5m2")
+    assert str(q2._data.dtype) == "float8_e5m2"
+    q3, s3 = Q.fp8_quantize(x, scale=s, dtype="e4m3")
+    np.testing.assert_allclose(float(s3._data), float(s._data))

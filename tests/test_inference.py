@@ -239,3 +239,82 @@ def test_predictor_over_saved_program(tmp_path):
     # convenience form
     got2 = pred.run([x])[0]
     np.testing.assert_allclose(got2, got)
+
+
+# ---------------- continuous batching ----------------
+
+def test_continuous_batching_parity_and_staggering(rng):
+    from paddle_tpu.inference.generation import (
+        ContinuousBatchingEngine, GenerationConfig, LlamaGenerator)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    gc = GenerationConfig(max_new_tokens=5, do_sample=False)
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+
+    base = LlamaGenerator(model, max_batch=4, max_seq_len=64,
+                          page_size=8).generate(prompts, gc)
+
+    # batch-at-once engine matches the static generator exactly (greedy)
+    eng = ContinuousBatchingEngine(model, max_batch=4, gen=gc,
+                                   max_seq_len=64, page_size=8)
+    ids = [eng.add_request(p) for p in prompts]
+    out = eng.run()
+    assert [out[i] for i in ids] == base
+
+    # more requests than slots: all complete, earlier ones still exact
+    eng2 = ContinuousBatchingEngine(model, max_batch=2, gen=gc,
+                                    max_seq_len=64, page_size=8)
+    ids2 = [eng2.add_request(p) for p in prompts + [[2, 2], [9]]]
+    out2 = eng2.run()
+    assert all(len(out2[i]) == 5 for i in ids2)
+    for i in range(3):
+        assert out2[ids2[i]] == base[i]
+
+
+def test_continuous_batching_mid_stream_admission(rng):
+    """A request added while another is mid-decode gets picked up."""
+    from paddle_tpu.inference.generation import (
+        ContinuousBatchingEngine, GenerationConfig)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    gc = GenerationConfig(max_new_tokens=4, do_sample=False)
+    eng = ContinuousBatchingEngine(model, max_batch=2, gen=gc,
+                                   max_seq_len=64, page_size=8)
+    r1 = eng.add_request([1, 2, 3])
+    eng.step()                       # r1 admitted + first decode
+    r2 = eng.add_request([7, 8])     # joins while r1 is running
+    results = {}
+    while eng.has_work():
+        for req in eng.step():
+            results[req.req_id] = req.output
+    assert len(results[r1]) == 4 and len(results[r2]) == 4
+
+
+def test_continuous_batching_budget_and_eos_at_prefill(rng):
+    from paddle_tpu.inference.generation import (
+        ContinuousBatchingEngine, GenerationConfig)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    gc = GenerationConfig(max_new_tokens=3, do_sample=False)
+    eng = ContinuousBatchingEngine(model, max_batch=2, gen=gc,
+                                   max_seq_len=64, page_size=8)
+    # max_new_tokens=1 must yield exactly ONE token (the prefill sample)
+    r1 = eng.add_request([1, 2, 3], max_new_tokens=1)
+    out = eng.run()
+    assert len(out[r1]) == 1
+
+    # eos on the prefill token ends the request with a single eos
+    first_tok = out[r1][0]
+    gc2 = GenerationConfig(max_new_tokens=5, do_sample=False,
+                           eos_token_id=first_tok)
+    eng2 = ContinuousBatchingEngine(model, max_batch=2, gen=gc2,
+                                    max_seq_len=64, page_size=8)
+    r2 = eng2.add_request([1, 2, 3])
+    out2 = eng2.run()
+    assert out2[r2] == [first_tok]

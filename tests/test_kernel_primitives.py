@@ -1,0 +1,93 @@
+"""Pallas block-primitive library tests (KPS slot) — all kernels run in
+interpreter mode on CPU, validating the exact kernel code."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels import primitives as P
+
+
+def test_tiling_helpers():
+    assert P.cdiv(10, 3) == 4
+    assert P.round_up_to(100, 128) == 128
+    assert P.min_tile(jnp.bfloat16) == (16, 128)
+    assert P.min_tile(jnp.float32) == (8, 128)
+    # divides when possible
+    assert P.pick_block(1024, jnp.float32, target=512) == 512
+    assert 1024 % P.pick_block(1024, jnp.float32) == 0
+
+
+def test_elementwise_kernel(rng):
+    fn = P.elementwise_kernel(lambda a, b: jax.nn.silu(a) * b,
+                              interpret=True)
+    x = jnp.asarray(rng.standard_normal((37, 19)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((37, 19)), jnp.float32)
+    got = fn(x, y)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(jax.nn.silu(x) * y),
+                               rtol=1e-6)
+
+
+def test_reduce_kernel(rng):
+    x = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    rmax = P.reduce_kernel(jnp.maximum, -np.inf, interpret=True)
+    np.testing.assert_allclose(np.asarray(rmax(x)),
+                               np.asarray(x.max(-1)), rtol=1e-6)
+    radd = P.reduce_kernel(jnp.add, 0.0, interpret=True)
+    np.testing.assert_allclose(np.asarray(radd(x)),
+                               np.asarray(x.sum(-1)), rtol=1e-5)
+
+
+def test_matmul_kernel(rng):
+    x = jnp.asarray(rng.standard_normal((100, 70)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((70, 50)), jnp.float32)
+    mm = P.matmul_kernel(block_m=32, block_n=32, block_k=32, interpret=True)
+    np.testing.assert_allclose(np.asarray(mm(x, w)), np.asarray(x @ w),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_matmul_kernel_epilogue(rng):
+    x = jnp.asarray(rng.standard_normal((16, 24)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((24, 8)), jnp.float32)
+    mm = P.matmul_kernel(block_m=8, block_n=8, block_k=8,
+                         epilogue=lambda acc: jax.nn.relu(acc) * 2.0,
+                         interpret=True)
+    want = np.asarray(jax.nn.relu(x @ w) * 2.0)
+    np.testing.assert_allclose(np.asarray(mm(x, w)), want, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_online_softmax_matches_full(rng):
+    """Streaming (m, l, acc) over KV blocks == full softmax attention."""
+    bq, kv, d = 8, 64, 16
+    scores = jnp.asarray(rng.standard_normal((bq, kv)), jnp.float32)
+    values = jnp.asarray(rng.standard_normal((kv, d)), jnp.float32)
+    state = P.OnlineSoftmax.init(bq, d)
+    for i in range(0, kv, 16):
+        state = P.OnlineSoftmax.update(
+            state, scores[:, i:i + 16], values[i:i + 16])
+    got = np.asarray(P.OnlineSoftmax.finalize(state))
+    want = np.asarray(jax.nn.softmax(scores, -1) @ values)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    lse = np.asarray(P.OnlineSoftmax.lse(state))
+    want_lse = np.asarray(jax.scipy.special.logsumexp(scores, -1))
+    np.testing.assert_allclose(lse, want_lse, rtol=1e-5)
+
+
+def test_unpack_int4_roundtrip(rng):
+    vals = rng.integers(-8, 8, (4, 10)).astype("int8")
+    low = vals[:, 0::2] & 0x0F
+    high = vals[:, 1::2] & 0x0F
+    packed = jnp.asarray((high << 4) | low, jnp.int8)
+    got = np.asarray(P.unpack_int4(packed, 10))
+    np.testing.assert_array_equal(got, vals)
+
+
+def test_dequant_int8(rng):
+    q = jnp.asarray(rng.integers(-128, 127, (6, 4)), jnp.int8)
+    scale = jnp.asarray(rng.random(4) + 0.1, jnp.float32)
+    got = np.asarray(P.dequant_int8(q, scale, axis=-1))
+    want = np.asarray(q, "float32") * np.asarray(scale)[None, :]
+    np.testing.assert_allclose(got, want, rtol=1e-6)

@@ -121,3 +121,99 @@ def test_incubate_fused_ops(rng):
     s3 = sin_t[::-1][None, :, None, :]
     expect3 = np.concatenate([x1 * c3 - x2 * s3, x2 * c3 + x1 * s3], axis=-1)
     np.testing.assert_allclose(qr3.numpy(), expect3, rtol=1e-5, atol=1e-6)
+
+
+# ---------------- incubate fused layers ----------------
+
+def test_fused_multihead_attention_parity(rng):
+    """FusedMHA == manual LN/qkv/softmax/proj with the same params."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.incubate import nn as inn
+    paddle.seed(0)
+    attn = inn.FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                       attn_dropout_rate=0.0)
+    attn.eval()
+    x = np.random.default_rng(0).standard_normal((2, 6, 32)).astype("float32")
+    out = np.asarray(attn(paddle.to_tensor(x))._data)
+
+    qkv_w = np.asarray(attn.qkv_weight._data)
+    qkv_b = np.asarray(attn.qkv_bias._data)
+    lin_w = np.asarray(attn.linear_weight._data)
+    lin_b = np.asarray(attn.linear_bias._data)
+    ln_w = np.asarray(attn.ln_scale._data)
+    ln_b = np.asarray(attn.ln_bias._data)
+    qkv = (x @ qkv_w + qkv_b).reshape(2, 6, 3, 4, 8)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    logits = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(8.0)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    att = np.einsum("bhst,bthd->bshd", p, v).reshape(2, 6, 32)
+    y = x + (att @ lin_w + lin_b)
+    mu = y.mean(-1, keepdims=True)
+    var = ((y - mu) ** 2).mean(-1, keepdims=True)
+    want = (y - mu) / np.sqrt(var + 1e-5) * ln_w + ln_b
+    np.testing.assert_allclose(out, want, rtol=1e-3, atol=1e-4)
+
+
+def test_fused_encoder_layer_trains(rng):
+    from paddle_tpu.incubate import nn as inn
+    paddle.seed(0)
+    enc = inn.FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+    x = paddle.to_tensor(
+        np.random.default_rng(1).standard_normal((2, 5, 16)).astype("float32"))
+    loss = (enc(x) ** 2).sum()
+    loss.backward()
+    grads = [p.grad for p in enc.parameters()]
+    assert all(g is not None for g in grads)
+    assert len(grads) == 12
+
+
+def test_fused_linear_and_bias_dropout_residual_ln(rng):
+    from paddle_tpu.incubate import nn as inn
+    paddle.seed(0)
+    lin = inn.FusedLinear(8, 4)
+    x = paddle.to_tensor(
+        np.random.default_rng(2).standard_normal((3, 8)).astype("float32"))
+    out = lin(x)
+    want = np.asarray(x._data) @ np.asarray(lin.weight._data) + \
+        np.asarray(lin.bias._data)
+    np.testing.assert_allclose(np.asarray(out._data), want, rtol=1e-5)
+    bdr = inn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+    y = bdr(x, x)
+    assert tuple(y.shape) == (3, 8)
+    assert np.isfinite(np.asarray(y._data)).all()
+
+
+def test_fused_dropout_hits_branch_not_residual(rng):
+    """Regression: dropout must act on the attention/FFN branch only — with
+    p=1.0 the output reduces exactly to the residual (+post-LN)."""
+    import jax.numpy as jnp
+    from paddle_tpu.incubate import nn as inn
+    paddle.seed(0)
+    attn = inn.FusedMultiHeadAttention(16, 4, dropout_rate=1.0 - 1e-7,
+                                       attn_dropout_rate=0.0,
+                                       normalize_before=True)
+    attn.train()
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 4, 16)).astype("float32"))
+    out = np.asarray(attn(x)._data)
+    # branch fully dropped -> pre-LN output == residual == x
+    np.testing.assert_allclose(out, np.asarray(x._data), rtol=1e-4, atol=1e-4)
+
+    ffn = inn.FusedFeedForward(16, 32, dropout_rate=1.0 - 1e-7,
+                               normalize_before=True)
+    ffn.train()
+    out = np.asarray(ffn(x)._data)
+    np.testing.assert_allclose(out, np.asarray(x._data), rtol=1e-4, atol=1e-4)
+
+
+def test_fused_bias_dropout_residual_ln_bias_gets_grad(rng):
+    from paddle_tpu.incubate import nn as inn
+    paddle.seed(0)
+    bdr = inn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+    x = paddle.to_tensor(
+        np.random.default_rng(1).standard_normal((3, 8)).astype("float32"))
+    (bdr(x, x) ** 2).sum().backward()
+    assert bdr.linear_bias.grad is not None
+    assert np.abs(np.asarray(bdr.linear_bias.grad._data)).max() > 0

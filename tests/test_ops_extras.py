@@ -1,0 +1,150 @@
+"""Extended tensor-op surface tests (reference: python/paddle/tensor/
+stragglers + the generated inplace `op_` family)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+torch = pytest.importorskip("torch")
+T = paddle.to_tensor
+
+
+def _np(x):
+    return np.asarray(x._data)
+
+
+def test_take_modes(rng):
+    x = rng.standard_normal((3, 4)).astype("float32")
+    idx = np.asarray([0, 5, 11], "int64")
+    np.testing.assert_allclose(
+        _np(paddle.take(T(x), T(idx.astype("int32")))),
+        torch.take(torch.tensor(x), torch.tensor(idx)).numpy())
+    # wrap mode
+    got = _np(paddle.take(T(x), T(np.asarray([13], "int32")), mode="wrap"))
+    np.testing.assert_allclose(got, x.reshape(-1)[[1]])
+
+
+def test_sgn_isin_addn(rng):
+    x = np.asarray([-2., 0., 3.], "float32")
+    np.testing.assert_allclose(_np(paddle.sgn(T(x))), np.sign(x))
+    a = np.asarray([1, 2, 3, 4], "int32")
+    got = _np(paddle.isin(T(a), T(np.asarray([2, 4], "int32"))))
+    np.testing.assert_array_equal(got, [False, True, False, True])
+    got = _np(paddle.isin(T(a), T(np.asarray([2], "int32")), invert=True))
+    np.testing.assert_array_equal(got, [True, False, True, True])
+    xs = [rng.standard_normal((2, 2)).astype("float32") for _ in range(3)]
+    np.testing.assert_allclose(_np(paddle.add_n([T(v) for v in xs])),
+                               sum(xs), rtol=1e-6)
+
+
+def test_scatter_family_oracle(rng):
+    x = rng.standard_normal((4, 4)).astype("float32")
+    d = rng.standard_normal((4,)).astype("float32")
+    np.testing.assert_allclose(
+        _np(paddle.diagonal_scatter(T(x), T(d))),
+        torch.diagonal_scatter(torch.tensor(x), torch.tensor(d)).numpy())
+    v = rng.standard_normal((4,)).astype("float32")
+    np.testing.assert_allclose(
+        _np(paddle.select_scatter(T(x), T(v), 0, 2)),
+        torch.select_scatter(torch.tensor(x), torch.tensor(v), 0, 2).numpy())
+    s = rng.standard_normal((2, 4)).astype("float32")
+    np.testing.assert_allclose(
+        _np(paddle.slice_scatter(T(x), T(s), [0], [1], [3], [1])),
+        torch.slice_scatter(torch.tensor(x), torch.tensor(s), 0, 1, 3).numpy())
+    mask = rng.random((4, 4)) > 0.5
+    src = rng.standard_normal((16,)).astype("float32")
+    np.testing.assert_allclose(
+        _np(paddle.masked_scatter(T(x), T(mask), T(src))),
+        torch.tensor(x).masked_scatter(
+            torch.tensor(mask), torch.tensor(src)).numpy())
+
+
+def test_linalg_extras_oracle(rng):
+    a = rng.standard_normal((5, 3)).astype("float32")
+    b = rng.standard_normal((7, 3)).astype("float32")
+    np.testing.assert_allclose(
+        _np(paddle.cdist(T(a), T(b))),
+        torch.cdist(torch.tensor(a), torch.tensor(b)).numpy(),
+        rtol=1e-4, atol=1e-5)
+    m = rng.standard_normal((3, 3)).astype("float32") * 0.3
+    np.testing.assert_allclose(
+        _np(paddle.matrix_exp(T(m))),
+        torch.matrix_exp(torch.tensor(m)).numpy(), rtol=1e-4, atol=1e-5)
+    spd = m @ m.T + 3 * np.eye(3, dtype="float32")
+    L = np.linalg.cholesky(spd).astype("float32")
+    np.testing.assert_allclose(
+        _np(paddle.cholesky_inverse(T(L))),
+        np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    # svd_lowrank reconstructs a rank-2 matrix
+    U0 = rng.standard_normal((12, 2)).astype("float32")
+    V0 = rng.standard_normal((2, 8)).astype("float32")
+    A = U0 @ V0
+    U, S, V = paddle.svd_lowrank(T(A), q=4)
+    rec = _np(U) * _np(S)[None, :] @ _np(V).T
+    np.testing.assert_allclose(rec, A, rtol=1e-3, atol=1e-3)
+
+
+def test_misc_extras(rng):
+    x = np.asarray([1., 2., 3.], "float32")
+    np.testing.assert_allclose(
+        _np(paddle.vander(T(x))),
+        np.vander(x), rtol=1e-6)
+    bd = _np(paddle.block_diag([T(np.ones((2, 2), "float32")),
+                                T(np.full((1, 1), 5.0, "float32"))]))
+    assert bd.shape == (3, 3) and bd[2, 2] == 5.0 and bd[0, 2] == 0.0
+    ct = _np(paddle.cumulative_trapezoid(T(x)))
+    np.testing.assert_allclose(ct, [1.5, 4.0], rtol=1e-6)
+    m, e = paddle.frexp(T(np.asarray([8., 0.5], "float32")))
+    np.testing.assert_allclose(_np(m) * 2.0 ** _np(e), [8., 0.5])
+    mg = _np(paddle.multigammaln(T(np.asarray([3.0], "float32")), 2))
+    want = torch.special.multigammaln(torch.tensor([3.0]), 2).numpy()
+    np.testing.assert_allclose(mg, want, rtol=1e-5)
+    cp = _np(paddle.cartesian_prod([T(np.asarray([1., 2.], "float32")),
+                                    T(np.asarray([3., 4.], "float32"))]))
+    assert cp.shape == (4, 2)
+    comb = _np(paddle.combinations(T(np.asarray([1., 2., 3.], "float32"))))
+    np.testing.assert_allclose(comb, [[1, 2], [1, 3], [2, 3]])
+    assert paddle.is_floating_point(T(x))
+    assert paddle.is_integer(T(np.asarray([1], "int32")))
+    assert not bool(_np(paddle.is_empty(T(x))))
+    nq = _np(paddle.nanquantile(
+        T(np.asarray([1., np.nan, 3.], "float32")), 0.5))
+    np.testing.assert_allclose(nq, 2.0)
+    un = _np(T(np.arange(10, dtype="float32")).unfold(0, 4, 2))
+    want = torch.arange(10, dtype=torch.float32).unfold(0, 4, 2).numpy()
+    np.testing.assert_allclose(un, want)
+
+
+def test_inplace_family(rng):
+    y = T(np.asarray([1., 4., 9.], "float32"))
+    out = y.sqrt_()
+    assert out is y
+    np.testing.assert_allclose(_np(y), [1., 2., 3.])
+    z = T(np.asarray([1., 2.], "float32"))
+    z.add_(T(np.asarray([10., 20.], "float32")))
+    np.testing.assert_allclose(_np(z), [11., 22.])
+    z.clip_(0.0, 15.0)
+    np.testing.assert_allclose(_np(z), [11., 15.])
+    # autograd flows through the rebound chain
+    w = T(np.asarray([2., 3.], "float32"))
+    w.stop_gradient = False
+    out = w * w
+    out.exp_()
+    out.sum().backward()
+    wv = np.asarray([2., 3.])
+    np.testing.assert_allclose(_np(w.grad),
+                               2 * wv * np.exp(wv ** 2), rtol=1e-4)
+    # module-level form exists for the whole family
+    for name in ("exp_", "tanh_", "floor_", "multiply_", "tril_", "cast_"):
+        assert hasattr(paddle, name), name
+
+
+def test_increment_and_fill_constant():
+    x = T(np.zeros((2,), "float32"))
+    paddle.increment(x, 5.0)
+    np.testing.assert_allclose(_np(x), [5., 5.])
+    c = paddle.fill_constant([2, 3], "float32", 7.0)
+    np.testing.assert_allclose(_np(c), np.full((2, 3), 7.0))
+    paddle.set_printoptions(precision=3)
+    paddle.set_printoptions(precision=8)

@@ -1,0 +1,83 @@
+"""distributed.rpc transport tests (reference: python/paddle/distributed/
+rpc/).  Real multi-process TCP path: two worker processes rendezvous on a
+master endpoint and call functions on each other."""
+
+import multiprocessing as mp
+import socket
+
+import pytest
+
+from paddle_tpu.distributed import rpc
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_single_process_rpc():
+    rpc.init_rpc("solo")
+    assert rpc.rpc_sync("solo", lambda a, b: a + b, args=(2, 3)) == 5
+    fut = rpc.rpc_async("solo", lambda: "hi")
+    assert fut.wait() == "hi"
+    info = rpc.get_worker_info()
+    assert info.name == "solo" and info.rank == 0
+    assert len(rpc.get_all_worker_infos()) == 1
+    rpc.shutdown()
+    with pytest.raises(RuntimeError):
+        rpc.rpc_sync("solo", lambda: 1)
+
+
+def _sq(x):
+    return x * x
+
+
+def _worker1(ep, q):
+    try:
+        rpc.init_rpc("w1", rank=1, world_size=2, master_endpoint=ep,
+                     timeout=30)
+        # call back into worker0 while it is also serving
+        got = rpc.rpc_sync("w0", _sq, args=(7,))
+        q.put(("w1", got, [w.name for w in rpc.get_all_worker_infos()]))
+        # stay alive long enough to serve w0's requests
+        import time
+        time.sleep(3.0)
+        rpc.shutdown()
+    except Exception as e:  # surface failures to the assert side
+        q.put(("w1-error", repr(e), None))
+
+
+def test_two_process_rpc():
+    ep = f"127.0.0.1:{_free_port()}"
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p1 = ctx.Process(target=_worker1, args=(ep, q), daemon=True)
+    p1.start()
+    try:
+        rpc.init_rpc("w0", rank=0, world_size=2, master_endpoint=ep,
+                     timeout=30)
+        assert sorted(w.name for w in rpc.get_all_worker_infos()) == \
+            ["w0", "w1"]
+        # sync call into the other process
+        assert rpc.rpc_sync("w1", _sq, args=(9,), timeout=20) == 81
+        # async call
+        fut = rpc.rpc_async("w1", _sq, args=(4,), timeout=20)
+        assert fut.wait(20) == 16
+        # remote exception propagates
+        with pytest.raises(ZeroDivisionError):
+            rpc.rpc_sync("w1", _div0, timeout=20)
+        tag, got, names = q.get(timeout=30)
+        assert tag == "w1", got
+        assert got == 49 and sorted(names) == ["w0", "w1"]
+    finally:
+        rpc.shutdown()
+        p1.join(timeout=10)
+        if p1.is_alive():
+            p1.terminate()
+
+
+def _div0():
+    return 1 / 0

@@ -1,0 +1,129 @@
+"""SPMD rule layer: predictions validated against GSPMD's actual
+partitioning on the virtual 8-device mesh (reference:
+paddle/phi/infermeta/spmd_rules/ + its unit tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.auto_parallel import spmd_rules as R
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    return Mesh(devs, ("dp", "mp"))
+
+
+def test_elementwise_rule():
+    info = R.infer_spmd("elementwise", [0, -1], [0, 1])
+    assert info.single == [0, 1]
+    # broadcasting: [H] + [B, H]
+    info = R.infer_spmd("elementwise", [1], [0, 1])
+    assert info.single == [0, 1]
+
+
+def test_matmul_rule_cases():
+    # column-parallel: x[B,K] @ w[K,N/mp] -> [B, N/mp]
+    assert R.infer_spmd("matmul", [0, -1], [-1, 1]).single == [0, 1]
+    # row-parallel: x[B,K/mp] @ w[K/mp,N] -> partial over mp
+    info = R.infer_spmd("matmul", [0, 1], [1, -1])
+    assert info.single == [0, -1] and info.partial_dims == [1]
+    # transposes
+    assert R.infer_spmd("matmul", [-1, 0], [-1, 1],
+                        trans_x=True).single == [0, 1]
+
+
+def test_reduction_embedding_softmax_rules():
+    info = R.infer_spmd("reduction", [0, 1], axis=1)
+    assert info.single == [0] and info.partial_dims == [1]
+    info = R.infer_spmd("embedding", [0, -1], [1, -1])
+    assert info.single == [0, -1, -1] and info.partial_dims == [1]
+    assert R.infer_spmd("softmax", [0, 1], axis=-1).single == [0, -1]
+    assert R.infer_spmd("layer_norm", [0, 1]).single == [0, -1]
+
+
+def test_reshape_transpose_concat_split_rules():
+    assert R.infer_spmd("transpose", [0, -1, 1], [2, 0, 1]).single == [1, 0, -1]
+    # [B, S, H] -> [B*S, H] merge keeps leading sharding
+    assert R.infer_spmd("reshape", [0, -1, 1], (4, 8, 16),
+                        (32, 16)).single == [0, 1]
+    # [B, H] -> [B, h, d] split moves sharding to leading factor
+    assert R.infer_spmd("reshape", [0, 1], (4, 16), (4, 2, 8)).single == \
+        [0, 1, -1]
+    assert R.infer_spmd("concat", [[0, -1], [0, -1]], axis=1).single == [0, -1]
+    outs = R.infer_spmd("split", [0, 1], 2, axis=1).out_dims_mappings
+    assert outs == [[0, -1], [0, -1]]
+    info = R.infer_spmd("cross_entropy_with_softmax", [0, 1], [0])
+    assert info.single == [0] and info.partial_dims == [1]
+
+
+def test_validate_matmul_column_parallel(mesh):
+    info, actual = R.validate_rule(
+        "matmul", lambda x, w: x @ w,
+        input_shapes=[(8, 16), (16, 32)], input_dms=[[0, -1], [-1, 1]],
+        mesh=mesh)
+    assert info.single == [0, 1]
+
+
+def test_validate_matmul_row_parallel_partial(mesh):
+    """Row-parallel matmul: rule predicts partial-over-mp; with an explicit
+    output constraint XLA inserts the psum and the result is dp-sharded."""
+    from jax.lax import with_sharding_constraint
+
+    def fn(x, w):
+        out = x @ w
+        return with_sharding_constraint(
+            out, NamedSharding(mesh, P("dp", None)))
+
+    info, actual = R.validate_rule(
+        "matmul", fn, input_shapes=[(8, 16), (16, 32)],
+        input_dms=[[0, 1], [1, -1]], mesh=mesh)
+    assert info.partial_dims == [1]
+    assert actual[0][0] == 0
+
+
+def test_validate_elementwise_and_softmax(mesh):
+    R.validate_rule("elementwise", jnp.add,
+                    input_shapes=[(8, 32), (8, 32)],
+                    input_dms=[[0, 1], [0, 1]], mesh=mesh)
+    R.validate_rule("softmax", lambda x: jax.nn.softmax(x, -1),
+                    input_shapes=[(8, 32)], input_dms=[[0, -1]], mesh=mesh,
+                    rule_kwargs={"axis": -1})
+
+
+def test_validate_transpose_and_reduction(mesh):
+    R.validate_rule("transpose", lambda x: jnp.transpose(x, (1, 0)),
+                    input_shapes=[(8, 32)], input_dms=[[0, 1]], mesh=mesh,
+                    rule_args=([1, 0],))
+    info, actual = R.validate_rule(
+        "reduction", lambda x: x.sum(0),
+        input_shapes=[(8, 32)], input_dms=[[0, 1]], mesh=mesh,
+        rule_args=(0,))
+    # the kept dim stays on mp
+    assert actual[0][0] == 1
+
+
+def test_rule_registry_unknown_op():
+    with pytest.raises(KeyError):
+        R.infer_spmd("not_an_op", [0])
+
+
+def test_dims_mapping_roundtrip(mesh):
+    spec = R.dims_mapping_to_spec([0, -1, 1], ("dp", "mp"))
+    assert spec == P("dp", None, "mp")
+    x = jax.device_put(jnp.zeros((4, 2, 8)), NamedSharding(mesh, spec))
+    assert R.sharding_to_dims_mapping(x.sharding, 3, ("dp", "mp")) == \
+        [0, -1, 1]
+
+
+def test_registry_rule_bridge():
+    from paddle_tpu.distributed.auto_parallel.spmd_rules import get_spmd_rule
+    assert get_spmd_rule("exp")([0, 1]).single == [0, 1]
+    assert get_spmd_rule("add")([0, -1], [0, 1]).single == [0, 1]
+    assert get_spmd_rule("matmul")([0, -1], [-1, 1]).single == [0, 1]
+    assert get_spmd_rule("sum")([0, 1], axis=1).partial_dims == [1]
+    with pytest.raises(KeyError):
+        get_spmd_rule("definitely_not_an_op")

@@ -90,3 +90,44 @@ def test_vision_model_trains(rng):
     assert np.isfinite(float(loss._data))
     after = np.asarray(m.features[0][0].weight._data)
     assert not np.allclose(before, after)
+
+
+# ---------------- widened transforms ----------------
+
+def test_widened_transforms(rng):
+    from paddle_tpu.vision import transforms as TR
+    img = rng.integers(0, 256, (32, 48, 3)).astype("uint8")
+    np.random.seed(0)
+    assert TR.RandomVerticalFlip(1.0)(img).shape == (32, 48, 3)
+    assert TR.Pad(4)(img).shape == (40, 56, 3)
+    assert TR.Pad((1, 2))(img).shape == (36, 50, 3)
+    assert TR.Grayscale(3)(img).shape == (32, 48, 3)
+    assert TR.RandomRotation(30)(img).shape == (32, 48, 3)
+    assert TR.RandomResizedCrop(16)(img).shape == (16, 16, 3)
+    assert TR.ColorJitter(0.4, 0.4, 0.4, 0.1)(img).shape == (32, 48, 3)
+    out = TR.RandomErasing(1.0, value=7)(img)
+    assert (out == 7).any()
+    assert TR.RandomAffine(20, translate=(0.1, 0.1),
+                           scale=(0.8, 1.2))(img).shape == (32, 48, 3)
+
+
+def test_transform_functional_numerics(rng):
+    from paddle_tpu.vision import transforms as TR
+    img = rng.integers(0, 256, (8, 8, 3)).astype("uint8")
+    np.testing.assert_array_equal(TR.hflip(img), img[:, ::-1])
+    np.testing.assert_array_equal(TR.vflip(img), img[::-1])
+    np.testing.assert_array_equal(TR.crop(img, 2, 3, 4, 5),
+                                  img[2:6, 3:8])
+    g = TR.to_grayscale(img, 1)
+    want = (0.299 * img[..., 0] + 0.587 * img[..., 1]
+            + 0.114 * img[..., 2]).astype("uint8")
+    assert np.abs(g[..., 0].astype(int) - want.astype(int)).max() <= 1
+    # hue round-trip: identity shift and full-turn shift are no-ops
+    h0 = TR.adjust_hue(img, 0.0)
+    assert np.abs(h0.astype(int) - img.astype(int)).max() <= 2
+    # brightness on float images has no clipping at 1.0
+    f = img.astype("float32") / 255.0
+    np.testing.assert_allclose(TR.adjust_brightness(f, 2.0), f * 2.0,
+                               rtol=1e-6)
+    r = TR.rotate(f, 0.0)
+    np.testing.assert_allclose(r, f, rtol=1e-6)
