@@ -1,0 +1,161 @@
+"""Per-config benchmark harness (BASELINE.md: "Measurement harness to live
+in benchmarks/ of this repo with per-config JSON results").
+
+Usage:
+    python benchmarks/run.py [config ...]
+configs: resnet gpt2 llama dit moe decode all   (default: all)
+
+Each config writes benchmarks/results/<config>.json.  The driver-facing
+single-line bench stays `bench.py` at the repo root; this harness is the
+full BASELINE ladder, config 1 (ResNet-50 dygraph) included.
+"""
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+# `--cpu` (or PADDLE_TPU_BENCH_CPU=1) pins the CPU backend BEFORE jax
+# initializes — the ambient environment may force a TPU platform whose
+# tunnel hangs jax.devices() forever when down
+if "--cpu" in sys.argv or os.environ.get("PADDLE_TPU_BENCH_CPU"):
+    sys.argv = [a for a in sys.argv if a != "--cpu"]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+RESULTS = pathlib.Path(__file__).resolve().parent / "results"
+
+
+def _on_tpu():
+    import jax
+    return jax.devices()[0].platform == "tpu"
+
+
+def run_resnet():
+    """BASELINE config 1: ResNet-50 dygraph single-device imgs/sec +
+    compiled (to_static) imgs/sec; correctness = finite decreasing loss."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.jit import InputSpec, to_static
+    from paddle_tpu.vision.models import resnet18, resnet50
+
+    on_tpu = _on_tpu()
+    # CPU smoke: resnet18 at 32px keeps the eager per-op path tractable
+    batch, size, steps = (32, 224, 5) if on_tpu else (2, 32, 2)
+    paddle.seed(0)
+    model = (resnet50 if on_tpu else resnet18)(num_classes=1000)
+    optimizer = opt.Momentum(0.1, parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(
+        rng.standard_normal((batch, 3, size, size)).astype("float32"))
+    y = paddle.to_tensor(rng.integers(0, 1000, batch).astype("int64"))
+    loss_fn = nn.CrossEntropyLoss()
+
+    def train_step(xb, yb):
+        loss = loss_fn(model(xb), yb)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        return loss
+
+    losses = [float(train_step(x, y)._data)]        # warmup + correctness
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train_step(x, y)
+    jax.block_until_ready(loss._data)
+    eager_ips = batch * steps / (time.perf_counter() - t0)
+    losses.append(float(loss._data))
+
+    model.eval()
+    fwd = to_static(lambda xb: model(xb),
+                    input_spec=[InputSpec([batch, 3, size, size], "float32")])
+    out = fwd(x)
+    jax.block_until_ready(out._data)
+    t0 = time.perf_counter()
+    for _ in range(steps * 4):
+        out = fwd(x)
+    jax.block_until_ready(out._data)
+    compiled_ips = batch * steps * 4 / (time.perf_counter() - t0)
+    return {
+        "config": "resnet50_dygraph" if on_tpu else "resnet18_dygraph_smoke",
+        "eager_train_imgs_per_sec": round(eager_ips, 2),
+        "compiled_infer_imgs_per_sec": round(compiled_ips, 2),
+        "loss_first": round(losses[0], 4), "loss_last": round(losses[-1], 4),
+        "finite": bool(np.isfinite(losses).all()),
+        "batch": batch, "image_size": size,
+    }
+
+
+def run_llama():
+    import bench
+    return {"config": "llama_hybrid",
+            **bench._run_config(*_llama_args(), on_tpu=_on_tpu())}
+
+
+def _llama_args():
+    import dataclasses
+
+    import bench
+    from paddle_tpu.models.llama import LlamaConfig
+    if _on_tpu():
+        mk, b, s, st = bench._tpu_configs()[0]
+        return (mk, b, s, st)
+    return (dataclasses.asdict(LlamaConfig.tiny()), 4, 64, 2)
+
+
+def run_gpt2():
+    import bench
+    return {"config": "gpt2_compiled_vs_eager",
+            **bench._run_gpt2_compiled_vs_eager(_on_tpu())}
+
+
+def run_dit():
+    import bench
+    return {"config": "dit_diffusion", **bench._run_dit(_on_tpu())}
+
+
+def run_moe():
+    import bench
+    return {"config": "moe_expert_parallel", **bench._run_moe(_on_tpu())}
+
+
+def run_decode():
+    import bench
+    return {"config": "serving_decode", **bench._run_decode(_on_tpu())}
+
+
+CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
+           "dit": run_dit, "moe": run_moe, "decode": run_decode}
+
+
+def main(argv):
+    names = argv or ["all"]
+    if "all" in names:
+        names = list(CONFIGS)
+    RESULTS.mkdir(exist_ok=True)
+    failed = 0
+    for name in names:
+        t0 = time.perf_counter()
+        try:
+            result = CONFIGS[name]()
+            result["wall_s"] = round(time.perf_counter() - t0, 2)
+        except Exception as e:  # record the failure, keep the ladder going
+            import traceback
+            traceback.print_exc()
+            result = {"config": name, "error": f"{type(e).__name__}: {e}"}
+            failed += 1
+        path = RESULTS / f"{name}.json"
+        path.write_text(json.dumps(result, indent=2) + "\n")
+        print(f"{name}: {json.dumps(result)}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

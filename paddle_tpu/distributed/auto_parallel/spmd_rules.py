@@ -46,14 +46,18 @@ def _check(dm: Sequence[int], ndim: int, name: str):
 
 def elementwise_rule(*dims_mappings: Sequence[int]) -> SpmdInfo:
     """Broadcast-aligned elementwise: per output dim, the first sharded
-    input wins; conflicting shardings must agree (else resharding)."""
+    input wins; a mesh dim already claimed by an earlier output dim is
+    skipped (conflicting cross-dim shardings resolve by resharding the
+    later input, so the output replicates there)."""
     ndim = max(len(dm) for dm in dims_mappings)
     out = [-1] * ndim
+    used = set()
     for dm in dims_mappings:
         pad = [-1] * (ndim - len(dm)) + list(dm)
         for i, d in enumerate(pad):
-            if d >= 0 and out[i] == -1:
+            if d >= 0 and out[i] == -1 and d not in used:
                 out[i] = d
+                used.add(d)
     return SpmdInfo([out])
 
 
@@ -241,12 +245,14 @@ def sharding_to_dims_mapping(sharding, ndim: int,
 
 
 def validate_rule(op: str, fn, input_shapes, input_dms, mesh,
-                  rule_args=(), rule_kwargs=None, check_partial=True):
+                  rule_args=(), rule_kwargs=None):
     """Run ``fn`` under jit with inputs sharded per ``input_dms`` and
     compare XLA's actual output sharding against the rule's prediction.
     Returns (predicted, actual) dims_mappings; raises on mismatch of the
-    non-partial dims.  This is the per-op validation harness the
-    reference keeps as spmd_rules unit tests."""
+    non-partial dims (partials are not observable post-SPMD: GSPMD
+    discharges them into collectives before the output materializes).
+    This is the per-op validation harness the reference keeps as
+    spmd_rules unit tests."""
     import jax
     import jax.numpy as jnp
     import numpy as np

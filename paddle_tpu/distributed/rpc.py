@@ -104,9 +104,18 @@ class _Server:
                     try:
                         out = req["fn"](*req.get("args", ()),
                                         **(req.get("kwargs") or {}))
-                        _send_msg(conn, {"ok": True, "value": out})
+                        reply = {"ok": True, "value": out}
                     except Exception as e:  # ship the exception back
-                        _send_msg(conn, {"ok": False, "error": e})
+                        reply = {"ok": False, "error": e}
+                    try:
+                        _send_msg(conn, reply)
+                    except Exception as e:
+                        # unpicklable value/exception: still answer, with a
+                        # stringified error instead of a dead connection
+                        _send_msg(conn, {"ok": False, "error": RuntimeError(
+                            f"rpc reply not serializable: {e!r}; original "
+                            f"reply ok={reply['ok']}: "
+                            f"{reply.get('value', reply.get('error'))!r:.500}")})
                 elif req.get("kind") == "ping":
                     _send_msg(conn, {"ok": True, "value": "pong"})
         except Exception:

@@ -146,6 +146,8 @@ class FusedFeedForward(Layer):
         self.normalize_before = normalize_before
         self.epsilon = epsilon
         self.dropout_rate = dropout_rate
+        self.act_dropout_rate = act_dropout_rate \
+            if act_dropout_rate is not None else dropout_rate
         self.act = {"relu": jax.nn.relu,
                     "gelu": lambda v: jax.nn.gelu(v, approximate=True)}[activation]
         from ...nn import initializer as I
@@ -161,10 +163,19 @@ class FusedFeedForward(Layer):
         self.ln_bias = self.create_parameter([d_model], is_bias=True)
 
     def forward(self, src, cache=None):
+        act_drop = self.act_dropout_rate if self.training else 0.0
+        if act_drop > 0:
+            from ...core.random import next_key
+            drop_key = next_key()
+
         def prim(x, w1, b1, w2, b2, ln_w, ln_b):
             if self.normalize_before:
                 x = _ln(x, ln_w, ln_b, self.epsilon)
-            return self.act(x @ w1 + b1) @ w2 + b2
+            h = self.act(x @ w1 + b1)
+            if act_drop > 0:       # reference: dropout between act and w2
+                keep = jax.random.bernoulli(drop_key, 1 - act_drop, h.shape)
+                h = jnp.where(keep, h / (1 - act_drop), 0.0)
+            return h @ w2 + b2
 
         # dropout hits the FFN branch only; the residual path stays intact
         # (reference fused_feedforward places dropout before the add)

@@ -451,6 +451,27 @@ class ContinuousBatchingEngine:
             self.completed[r.req_id] = r.output
         if all(r is None for r in self.slot_req):
             return done
+        # grow pages BEFORE decoding: the write position (== host_lens) must
+        # already be inside the allocated table, else the block-table pad
+        # entry (page 0) silently receives another sequence's KV — exact
+        # page-multiple prompts hit this on their very first decode
+        alloc = self.g.cache.allocator
+        grew_pre = False
+        for b in range(self.B):
+            req = self.slot_req[b]
+            if req is None:
+                continue
+            while alloc.context_len(req.req_id) <= int(self.host_lens[b]) \
+                    and alloc.context_len(req.req_id) < self.g.max_seq_len:
+                alloc.extend(req.req_id,
+                             min(self.g.page_size,
+                                 self.g.max_seq_len
+                                 - alloc.context_len(req.req_id)))
+                self._bt[b] = alloc.block_table(
+                    [req.req_id], max_pages=self.g.pages_per_seq)[0]
+                grew_pre = True
+        if grew_pre:
+            self._bt_dev = jnp.asarray(self._bt)
         self.tokens, self.positions, self.finished, _all_done, kc, vc, \
             self.key = self._decode(
                 self.g.params, *self.g.cache.arrays, self.tokens,
@@ -458,8 +479,6 @@ class ContinuousBatchingEngine:
         self.g.cache.update(kc, vc)
         toks = np.asarray(self.tokens)
         fin = np.asarray(self.finished)
-        alloc = self.g.cache.allocator
-        grew = False
         for b in range(self.B):
             req = self.slot_req[b]
             if req is None:
@@ -478,17 +497,6 @@ class ContinuousBatchingEngine:
                 self.completed[req.req_id] = req.output
                 done.append(req)
                 continue
-            # grow a page ahead of the next boundary crossing
-            if self.host_lens[b] % self.g.page_size == 0 and \
-                    alloc.context_len(req.req_id) <= self.host_lens[b]:
-                alloc.extend(req.req_id,
-                             min(self.g.page_size,
-                                 self.g.max_seq_len - int(self.host_lens[b])))
-                self._bt[b] = alloc.block_table(
-                    [req.req_id], max_pages=self.g.pages_per_seq)[0]
-                grew = True
-        if grew:
-            self._bt_dev = jnp.asarray(self._bt)  # one upload per step
         return done
 
     # ---- admission (prefill newly scheduled requests) ----

@@ -224,8 +224,12 @@ class _NormBase(Layer):
             default_initializer=I.Constant(1.0))
         self.bias = None if bias_attr is False else self.create_parameter(
             [num_features], attr=None if bias_attr in (None, True) else bias_attr, is_bias=True)
-        self.register_buffer("_mean", Tensor(jnp.zeros(num_features)))
-        self.register_buffer("_variance", Tensor(jnp.ones(num_features)))
+        # explicit fp32: jnp default under x64 would make these float64 and
+        # poison eval-mode compute (f64 x f32 conv dtype mismatch)
+        self.register_buffer("_mean",
+                             Tensor(jnp.zeros(num_features, jnp.float32)))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones(num_features, jnp.float32)))
 
     def forward(self, x):
         return F.batch_norm(x, self._mean, self._variance, self.weight, self.bias,

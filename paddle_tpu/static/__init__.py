@@ -34,16 +34,26 @@ from ..core.tensor import Tensor
 from ..jit import InputSpec  # noqa: F401
 
 _static_mode = False
+_default_hook = None        # the exact hook object enable_static installed
 
 
 def enable_static():
-    global _static_mode
+    """Canonical workflow support: after enable_static(), ops record into
+    ``default_main_program()`` even without an explicit program_guard."""
+    global _static_mode, _default_hook
     _static_mode = True
+    if _autograd._STATIC_RECORD_HOOK is None or \
+            _autograd._STATIC_RECORD_HOOK is _default_hook:
+        _default_hook = _default_main._record
+        _autograd._STATIC_RECORD_HOOK = _default_hook
 
 
 def disable_static():
-    global _static_mode
+    global _static_mode, _default_hook
     _static_mode = False
+    if _autograd._STATIC_RECORD_HOOK is _default_hook:
+        _autograd._STATIC_RECORD_HOOK = None
+    _default_hook = None
 
 
 def in_static_mode() -> bool:
@@ -283,9 +293,11 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
 
 # optimizer.minimize integration: record rather than step when capturing
 def _static_minimize(optimizer, loss):
-    if _active is None:
+    prog = _active if _active is not None else \
+        (_default_main if _static_mode else None)
+    if prog is None:
         return False
-    _attach_minimize(_active, optimizer, loss)
+    _attach_minimize(prog, optimizer, loss)
     return True
 
 

@@ -318,3 +318,27 @@ def test_continuous_batching_budget_and_eos_at_prefill(rng):
     r2 = eng2.add_request([1, 2, 3])
     out2 = eng2.run()
     assert out2[r2] == [first_tok]
+
+
+def test_continuous_batching_exact_page_multiple_prompts(rng):
+    """Regression: a prompt whose length is an exact page multiple must get
+    a fresh page BEFORE its first decode write — with the stale table it
+    corrupted another sequence's page 0."""
+    from paddle_tpu.inference.generation import (
+        ContinuousBatchingEngine, GenerationConfig, LlamaGenerator)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    gc = GenerationConfig(max_new_tokens=6, do_sample=False)
+    p8 = list(range(1, 9))            # len == page_size
+    p16 = list(range(1, 17))          # len == 2 * page_size
+    p3 = [5, 6, 7]
+    prompts = [p3, p8, p16]
+    base = LlamaGenerator(model, max_batch=4, max_seq_len=64,
+                          page_size=8).generate(prompts, gc)
+    eng = ContinuousBatchingEngine(model, max_batch=4, gen=gc,
+                                   max_seq_len=64, page_size=8)
+    ids = [eng.add_request(p) for p in prompts]
+    out = eng.run()
+    assert [out[i] for i in ids] == base

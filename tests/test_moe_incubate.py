@@ -217,3 +217,19 @@ def test_fused_bias_dropout_residual_ln_bias_gets_grad(rng):
     (bdr(x, x) ** 2).sum().backward()
     assert bdr.linear_bias.grad is not None
     assert np.abs(np.asarray(bdr.linear_bias.grad._data)).max() > 0
+
+
+def test_fused_ffn_act_dropout_applied(rng):
+    """Regression: act_dropout_rate must hit the activation between the
+    two matmuls — with p~1 only bias b2 survives the FFN branch."""
+    from paddle_tpu.incubate import nn as inn
+    paddle.seed(0)
+    ffn = inn.FusedFeedForward(8, 16, dropout_rate=0.0,
+                               act_dropout_rate=1.0 - 1e-7,
+                               normalize_before=True)
+    ffn.train()
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 3, 8)).astype("float32"))
+    out = np.asarray(ffn(x)._data)
+    want = np.asarray(x._data) + np.asarray(ffn.b2._data)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)

@@ -303,3 +303,19 @@ def test_conv_transpose_output_size_and_format(rng):
     w2 = rng.standard_normal((3, 2, 3, 3)).astype("float32")
     out2 = F.conv2d_transpose(T(x2), T(w2), stride=2, output_size=[14, 14])
     assert tuple(out2.shape) == (1, 2, 14, 14)
+
+
+def test_batchnorm_eval_dtype_stays_f32(rng):
+    """Regression: running-stat buffers must be fp32 even under x64 —
+    float64 stats poisoned eval-mode convs downstream."""
+    bn = nn.BatchNorm2D(4)
+    assert str(bn._mean._data.dtype) == "float32"
+    assert str(bn._variance._data.dtype) == "float32"
+    bn.eval()
+    x = rng.standard_normal((2, 4, 6, 6)).astype("float32")
+    out = bn(T(x))
+    assert str(out._data.dtype) == "float32"
+    # eval BN output feeds a conv without dtype errors
+    conv = nn.Conv2D(4, 2, 3)
+    y = conv(out)
+    assert str(y._data.dtype) == "float32"

@@ -81,3 +81,36 @@ def test_two_process_rpc():
 
 def _div0():
     return 1 / 0
+
+
+def _unpicklable():
+    return lambda: 1  # local lambdas don't pickle
+
+
+def test_rpc_unpicklable_reply_surfaces_error():
+    ep = f"127.0.0.1:{_free_port()}"
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p1 = ctx.Process(target=_worker_idle, args=(ep, q), daemon=True)
+    p1.start()
+    try:
+        rpc.init_rpc("m0", rank=0, world_size=2, master_endpoint=ep,
+                     timeout=30)
+        with pytest.raises(RuntimeError, match="not serializable"):
+            rpc.rpc_sync("m1", _unpicklable, timeout=20)
+    finally:
+        rpc.shutdown()
+        p1.join(timeout=10)
+        if p1.is_alive():
+            p1.terminate()
+
+
+def _worker_idle(ep, q):
+    try:
+        rpc.init_rpc("m1", rank=1, world_size=2, master_endpoint=ep,
+                     timeout=30)
+        import time
+        time.sleep(4.0)
+        rpc.shutdown()
+    except Exception as e:
+        q.put(repr(e))

@@ -127,3 +127,12 @@ def test_registry_rule_bridge():
     assert get_spmd_rule("sum")([0, 1], axis=1).partial_dims == [1]
     with pytest.raises(KeyError):
         get_spmd_rule("definitely_not_an_op")
+
+
+def test_elementwise_rule_no_duplicate_mesh_dim():
+    """Regression: conflicting cross-dim shardings must not map one mesh
+    axis to two output dims."""
+    info = R.infer_spmd("elementwise", [0, -1], [-1, 0])
+    used = [d for d in info.single if d >= 0]
+    assert len(used) == len(set(used))
+    assert info.single == [0, -1]

@@ -133,3 +133,23 @@ def test_fetched_loss_is_pre_step(rng):
     # run() returned the post-step loss, l1 would equal l2's pre-step value
     l2, = exe.run(main, feed={"x": xd, "y": yd}, fetch_list=[loss])
     assert not np.allclose(l1, l2)
+
+
+def test_enable_static_default_program_flow(rng):
+    """Canonical workflow: enable_static() -> build ops with no
+    program_guard -> Executor().run on the default program."""
+    import paddle_tpu.static as S
+    # fresh default program for isolation
+    S._default_main = S.Program()
+    S.disable_static()
+    S.enable_static()
+    try:
+        x = S.data("x", [None, 4], "float32")
+        y = x * 3.0
+        assert S.default_main_program().num_ops() >= 1
+        xd = rng.standard_normal((2, 4)).astype("float32")
+        got, = S.Executor().run(feed={"x": xd}, fetch_list=[y])
+        np.testing.assert_allclose(got, xd * 3.0, rtol=1e-6)
+    finally:
+        S.disable_static()
+        S._default_main = S.Program()
