@@ -93,8 +93,8 @@ def is_compiled_with_tpu() -> bool:
 _SUBMODULES = [
     "nn", "optimizer", "amp", "io", "jit", "autograd", "framework", "vision",
     "linalg", "fft", "signal", "incubate", "metric", "sparse", "profiler",
-    "hapi", "device", "distributed", "distribution", "static", "audio",
-    "text", "quantization", "utils", "inference",
+    "hapi", "hub", "device", "distributed", "distribution", "static", "audio",
+    "text", "quantization", "utils", "inference", "regularizer",
 ]
 
 
@@ -109,9 +109,11 @@ def __getattr__(name):
         from .framework import io as _fio
         globals()["save"], globals()["load"] = _fio.save, _fio.load
         return globals()[name]
-    if name in ("Model", "summary"):
+    if name in ("Model", "summary", "flops"):
         from . import hapi as _hapi
+        from .hapi.summary import flops as _flops
         globals()["Model"], globals()["summary"] = _hapi.Model, _hapi.summary
+        globals()["flops"] = _flops
         return globals()[name]
     if name == "DataParallel":
         from .distributed.parallel import DataParallel as _DP

@@ -60,3 +60,72 @@ def summary(net, input_size=None, dtypes=None, input=None):
     print(f"Trainable params: {trainable:,}")
     print(f"Non-trainable params: {total - trainable:,}")
     return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Per-layer FLOP count via forward hooks (reference:
+    python/paddle/hapi/dynamic_flops.py).  Counts multiply-accumulates as
+    2 FLOPs for convs/linears; norm/activation/pool layers count their
+    elementwise cost.  Returns total FLOPs for one forward pass."""
+    import numpy as np
+
+    from .. import nn
+    from ..core.tensor import Tensor
+
+    custom_ops = custom_ops or {}
+    counts = []
+    handles = []
+
+    def count(layer, inputs, output):
+        x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+        if not isinstance(x, Tensor) or not isinstance(output, Tensor):
+            return
+        n_out = int(np.prod(output.shape))
+        fl = 0
+        conv_types = tuple(c for c in (getattr(nn, "Conv1D", None),
+                                       getattr(nn, "Conv2D", None),
+                                       getattr(nn, "Conv3D", None)) if c)
+        if type(layer) in custom_ops:
+            fl = custom_ops[type(layer)](layer, x, output)
+        elif isinstance(layer, conv_types):
+            k = int(np.prod(layer._kernel_size))
+            cin = layer._in_channels // layer._groups
+            fl = 2 * n_out * cin * k
+        elif isinstance(layer, nn.Linear):
+            fl = 2 * n_out * int(layer.weight.shape[0])
+        elif isinstance(layer, (nn.BatchNorm, nn.BatchNorm1D, nn.BatchNorm2D,
+                                nn.BatchNorm3D, nn.LayerNorm, nn.GroupNorm)):
+            fl = 2 * n_out
+        elif isinstance(layer, (nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh,
+                                nn.SiLU, nn.Hardswish, nn.Softmax)):
+            fl = n_out
+        elif isinstance(layer, (nn.AvgPool1D, nn.AvgPool2D,
+                                nn.AdaptiveAvgPool2D)):
+            fl = n_out
+        if fl:
+            counts.append((layer.full_name() if hasattr(layer, "full_name")
+                           else type(layer).__name__, fl))
+
+    leaves = [m for m in net.sublayers(include_self=True)
+              if not list(m.children())] if hasattr(net, "sublayers") else []
+    if not leaves:
+        leaves = [net]
+    for m in leaves:
+        handles.append(m.register_forward_post_hook(count))
+    try:
+        import jax.numpy as jnp
+        x = Tensor(jnp.zeros(tuple(input_size), jnp.float32))
+        was_training = net.training
+        net.eval()
+        net(x)
+        if was_training:
+            net.train()
+    finally:
+        for h in handles:
+            h.remove()
+    total = sum(f for _, f in counts)
+    if print_detail:
+        for name, f in counts:
+            print(f"{name:40s} {f:>15,d}")
+        print(f"{'Total':40s} {total:>15,d}")
+    return total

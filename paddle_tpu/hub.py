@@ -1,0 +1,71 @@
+"""paddle.hub (reference: python/paddle/hapi/hub.py).
+
+Local-source loading is fully supported: a hub repo is a directory with a
+``hubconf.py`` exposing entrypoint callables (and an optional
+``dependencies`` list).  The github/gitee sources require network egress,
+which this environment forbids — they raise with guidance instead of
+silently downloading.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+HUB_CONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, HUB_CONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {HUB_CONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(mod, "dependencies", [])
+    missing = [d for d in deps if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(f"hub repo requires missing packages: {missing}")
+    return mod
+
+
+def _check_source(source: str):
+    if source not in ("local",):
+        raise NotImplementedError(
+            f"hub source {source!r} needs network egress; clone the repo "
+            "and use source='local'")
+
+
+def list(repo_dir: str, source: str = "local",  # noqa: A001
+         force_reload: bool = False) -> List[str]:
+    """Entrypoint names exposed by the repo's hubconf."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return sorted(n for n in dir(mod)
+                  if callable(getattr(mod, n)) and not n.startswith("_"))
+
+
+def help(repo_dir: str, model: str, source: str = "local",  # noqa: A001
+         force_reload: bool = False) -> str:
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"no entrypoint {model!r}; have {list(repo_dir)}")
+    return fn.__doc__ or ""
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"no entrypoint {model!r}; have {list(repo_dir)}")
+    return fn(**kwargs)

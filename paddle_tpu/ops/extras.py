@@ -237,12 +237,14 @@ def standard_gamma(alpha, name=None):
 def svd_lowrank(x, q=6, niter=2, M=None, name=None):
     """Randomized low-rank SVD (reference linalg.svd_lowrank behavior)."""
     from ..core.random import next_key
+    key = next_key()   # OUTSIDE the prim: next_key mutates the global key,
+    #                    which must never happen inside a traced function
 
     def prim(a, *maybe_m):
         A = a - maybe_m[0] if maybe_m else a
         m, n = A.shape[-2:]
         k = min(q, m, n)
-        G = jax.random.normal(next_key(), A.shape[:-2] + (n, k), A.dtype)
+        G = jax.random.normal(key, A.shape[:-2] + (n, k), A.dtype)
         Y = A @ G
         for _ in range(niter):
             Y = A @ (A.swapaxes(-1, -2) @ Y)

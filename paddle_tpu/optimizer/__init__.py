@@ -107,7 +107,16 @@ class Optimizer:
         self._step_count += 1
         for p, g in params_grads:
             garr = g._data if isinstance(g, Tensor) else g
-            wd = self._decay_for(p)
+            # per-parameter regularizer (ParamAttr(regularizer=...)) wins
+            # over the optimizer-wide decay (reference precedence); the
+            # adjusted grad then flows through the NORMAL path so master
+            # weights and dtype casts still apply
+            reg = getattr(p, "regularizer", None)
+            if reg is not None:
+                garr = garr + reg(p._data).astype(garr.dtype)
+                wd = 0.0
+            else:
+                wd = self._decay_for(p)
             if self._use_master_weights and p._data.dtype in (
                     jnp.float16, jnp.bfloat16):
                 orig_dtype = p._data.dtype
@@ -427,3 +436,146 @@ class Lars(Momentum):
 
 
 LarsMomentumOptimizer = Lars
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with closure-driven line search (reference:
+    python/paddle/optimizer/lbfgs.py).  ``step(closure)`` re-evaluates the
+    loss as the strong-Wolfe/armijo search probes points; history is the
+    standard two-loop recursion over (s, y) pairs."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay=weight_decay,
+                         grad_clip=grad_clip, name=name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval or max_iter * 5 // 4
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s, self._y = [], []
+        self._prev_flat_grad = None
+
+    # ---- flat views over the param group ----
+    def _flat_params(self):
+        return jnp.concatenate([p._data.reshape(-1).astype(jnp.float32)
+                                for p in self._params])
+
+    def _flat_grad(self):
+        pgs = [(p, p.grad) for p in self._params if p.grad is not None]
+        if self._grad_clip is not None and pgs:
+            pgs = self._grad_clip(pgs)
+        clipped = {id(p): g for p, g in pgs}
+        parts = []
+        for p in self._params:
+            g = clipped.get(id(p))
+            arr = (g._data if isinstance(g, Tensor) else g) \
+                if g is not None else jnp.zeros(p._data.size)
+            parts.append(arr.reshape(-1).astype(jnp.float32))
+        flat = jnp.concatenate(parts)
+        if self._weight_decay:
+            flat = flat + float(self._weight_decay) * self._flat_params()
+        return flat
+
+    def _assign(self, flat):
+        off = 0
+        for p in self._params:
+            n = int(np.prod(p._data.shape)) if p._data.shape else 1
+            p._data = flat[off:off + n].reshape(p._data.shape) \
+                .astype(p._data.dtype)
+            off += n
+
+    def _direction(self, g):
+        """Two-loop recursion: H_k approx applied to -g."""
+        q = -g
+        alphas = []
+        for s, y in reversed(list(zip(self._s, self._y))):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            q = q * (jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y), 1e-10))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + (a - b) * s
+        return q
+
+    def step(self, closure=None):
+        assert closure is not None, "LBFGS.step requires a closure"
+
+        def evaluate():
+            self.clear_grad()
+            loss = closure()
+            return float(loss._data if hasattr(loss, "_data") else loss)
+
+        loss = evaluate()
+        evals = 1
+        for _ in range(self.max_iter):
+            g = self._flat_grad()
+            if float(jnp.abs(g).max()) <= self.tol_grad:
+                break
+            d = self._direction(g)
+            x0 = self._flat_params()
+            g0, loss0 = g, loss
+            # backtracking armijo (the 'strong_wolfe' option uses the same
+            # probe loop with the curvature check added)
+            t = self.get_lr() if not self._s else 1.0
+            dg0 = float(jnp.vdot(g0, d))
+            if dg0 > -1e-15:     # not a descent direction: reset history
+                self._s, self._y = [], []
+                d = -g0
+                dg0 = float(jnp.vdot(g0, d))
+            ok = False
+            best_armijo = None               # (t, loss) armijo-only fallback
+            for _ls in range(20):
+                self._assign(x0 + t * d)
+                loss = evaluate()
+                evals += 1
+                armijo = loss <= loss0 + 1e-4 * t * dg0
+                wolfe = armijo
+                if armijo and self.line_search_fn == "strong_wolfe":
+                    if best_armijo is None or loss < best_armijo[1]:
+                        best_armijo = (t, loss)
+                    g_new = self._flat_grad()
+                    if abs(float(jnp.vdot(g_new, d))) > 0.9 * abs(dg0):
+                        wolfe = False
+                if wolfe:
+                    ok = True
+                    break
+                t *= 0.5
+                if evals >= self.max_eval:
+                    break
+            if not ok and best_armijo is not None:
+                # curvature condition unattainable on the halving grid (it
+                # tightens as t->0): take the best sufficient-decrease point
+                # rather than stalling with zero progress
+                t, _ = best_armijo
+                self._assign(x0 + t * d)
+                loss = evaluate()    # refresh grads at the accepted point
+                evals += 1
+                ok = True
+            if not ok:
+                self._assign(x0)
+                loss = loss0
+                break
+            g_new = self._flat_grad()
+            s = self._flat_params() - x0
+            y = g_new - g0
+            if float(jnp.vdot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if float(jnp.abs(s).max()) <= self.tol_change or \
+                    abs(loss - loss0) <= self.tol_change:
+                break
+            if evals >= self.max_eval:
+                break
+        from ..core.tensor import Tensor
+        return Tensor(jnp.asarray(loss, jnp.float32))

@@ -1,0 +1,39 @@
+"""paddle.regularizer (reference: python/paddle/regularizer.py — L1Decay /
+L2Decay appended to gradients per-parameter).
+
+A regularizer attaches via ``ParamAttr(regularizer=...)`` (stored on the
+Parameter) or an optimizer's ``weight_decay=`` argument; optimizers add
+``reg(param)`` to the gradient before the update, with the per-parameter
+attachment taking precedence over the optimizer-wide one (reference
+append_regularization_ops behavior)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param_array):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param_array):
+        return self.coeff * jnp.sign(param_array)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param_array):
+        return self.coeff * param_array
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"

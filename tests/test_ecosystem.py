@@ -246,3 +246,23 @@ def test_fp8_quantization(rng):
     assert str(q2._data.dtype) == "float8_e5m2"
     q3, s3 = Q.fp8_quantize(x, scale=s, dtype="e4m3")
     np.testing.assert_allclose(float(s3._data), float(s._data))
+
+
+def test_hub_local_source(tmp_path):
+    from paddle_tpu import hub
+    (tmp_path / "hubconf.py").write_text(
+        'dependencies = ["numpy"]\n'
+        'def tiny_mlp(hidden=8):\n'
+        '    """A tiny MLP entrypoint."""\n'
+        '    import paddle_tpu.nn as nn\n'
+        '    return nn.Sequential(nn.Linear(4, hidden), nn.ReLU(),\n'
+        '                         nn.Linear(hidden, 2))\n')
+    d = str(tmp_path)
+    assert hub.list(d) == ["tiny_mlp"]
+    assert "tiny MLP" in hub.help(d, "tiny_mlp")
+    m = hub.load(d, "tiny_mlp", hidden=16)
+    out = m(paddle.to_tensor(np.zeros((2, 4), "float32")))
+    assert tuple(out.shape) == (2, 2)
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        hub.load(d, "tiny_mlp", source="github")

@@ -196,3 +196,116 @@ class TestOptimizers:
         lin.bias.grad = P.zeros_like(lin.bias)
         o.step()
         assert (np.abs(lin.weight.numpy()) <= np.abs(w0) + 1e-7).all()
+
+
+def test_lbfgs_converges_quadratic(rng):
+    P.seed(0)
+    lin = nn.Linear(4, 1, bias_attr=False)
+    A = P.to_tensor(rng.standard_normal((64, 4)).astype("float32"))
+    w_true = np.asarray([1.0, -2.0, 0.5, 3.0], "float32")
+    y = P.to_tensor((np.asarray(A._data) @ w_true)[:, None])
+    lb = opt.LBFGS(learning_rate=1.0, max_iter=30,
+                         line_search_fn="strong_wolfe",
+                         parameters=lin.parameters())
+
+    def closure():
+        loss = ((lin(A) - y) ** 2).mean()
+        loss.backward()
+        return loss
+
+    final = lb.step(closure)
+    assert float(final._data) < 1e-6
+    w_hat = np.asarray(lin.weight._data).ravel()
+    np.testing.assert_allclose(w_hat, w_true, atol=1e-4)
+
+
+def test_flops_counter(rng):
+    m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                      nn.Flatten(), nn.Linear(8 * 8 * 8, 10))
+    f = P.flops(m, [1, 3, 8, 8])
+    conv_fl = 2 * (8 * 8 * 8) * 3 * 9
+    lin_fl = 2 * 10 * 512
+    assert f >= conv_fl + lin_fl
+    assert f < 2 * (conv_fl + lin_fl)
+
+
+def test_regularizer_per_param_precedence(rng):
+    from paddle_tpu.regularizer import L1Decay, L2Decay
+    P.seed(0)
+    lin = nn.Linear(4, 3, weight_attr=nn.ParamAttr(regularizer=L2Decay(0.5)))
+    x = P.to_tensor(rng.standard_normal((2, 4)).astype("float32"))
+    # optimizer-wide decay 0: only the attached L2 acts on weight
+    o = opt.SGD(1.0, parameters=lin.parameters())
+    w0 = np.asarray(lin.weight._data).copy()
+    b0 = np.asarray(lin.bias._data).copy()
+    loss = lin(x).sum()
+    loss.backward()
+    gw = np.asarray(lin.weight.grad._data)
+    gb = np.asarray(lin.bias.grad._data)
+    o.step()
+    np.testing.assert_allclose(np.asarray(lin.weight._data),
+                               w0 - (gw + 0.5 * w0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(lin.bias._data), b0 - gb,
+                               rtol=1e-5, atol=1e-6)
+    # L1 sign behavior
+    lin2 = nn.Linear(2, 2, bias_attr=False,
+                     weight_attr=nn.ParamAttr(regularizer=L1Decay(0.1)))
+    w0 = np.asarray(lin2.weight._data).copy()
+    (lin2(P.to_tensor(np.zeros((1, 2), "float32"))).sum() * 0).backward()
+    opt.SGD(1.0, parameters=lin2.parameters()).step()
+    np.testing.assert_allclose(np.asarray(lin2.weight._data),
+                               w0 - 0.1 * np.sign(w0), rtol=1e-5, atol=1e-6)
+
+
+def test_lbfgs_weight_decay_and_clip(rng):
+    """Regression: LBFGS must honor weight_decay and grad_clip."""
+    P.seed(0)
+    lin = nn.Linear(3, 1, bias_attr=False)
+    A = P.to_tensor(rng.standard_normal((16, 3)).astype("float32"))
+    y = P.to_tensor(rng.standard_normal((16, 1)).astype("float32"))
+
+    def make(wd):
+        P.seed(0)
+        l2 = nn.Linear(3, 1, bias_attr=False)
+        lb = opt.LBFGS(learning_rate=1.0, max_iter=25, weight_decay=wd,
+                       parameters=l2.parameters())
+
+        def closure():
+            loss = ((l2(A) - y) ** 2).mean()
+            loss.backward()
+            return loss
+        lb.step(closure)
+        return np.asarray(l2.weight._data)
+
+    w_plain = make(0.0)
+    w_decay = make(1.0)
+    # ridge solution has strictly smaller norm than the OLS solution
+    assert np.linalg.norm(w_decay) < np.linalg.norm(w_plain)
+    # grad_clip path executes without error
+    lb = opt.LBFGS(learning_rate=1.0, max_iter=3,
+                   grad_clip=nn.ClipGradByGlobalNorm(0.1),
+                   parameters=lin.parameters())
+
+    def closure():
+        loss = ((lin(A) - y) ** 2).mean()
+        loss.backward()
+        return loss
+    out = lb.step(closure)
+    assert np.isfinite(float(out._data))
+
+
+def test_regularizer_respects_master_weights(rng):
+    """Per-param regularizer must flow through the master-weight path:
+    a bf16 param keeps its dtype after the update."""
+    from paddle_tpu.regularizer import L2Decay
+    P.seed(0)
+    lin = nn.Linear(4, 2, weight_attr=nn.ParamAttr(regularizer=L2Decay(0.1)))
+    import jax.numpy as jnp
+    lin.weight._data = lin.weight._data.astype(jnp.bfloat16)
+    o = opt.SGD(0.1, parameters=lin.parameters())
+    o._use_master_weights = True
+    x = P.to_tensor(rng.standard_normal((2, 4)).astype("float32"))
+    lin(x).sum().backward()
+    o.step()
+    assert str(lin.weight._data.dtype) == "bfloat16"
+    assert id(lin.weight) in o._master_weights
