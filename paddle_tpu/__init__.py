@@ -95,6 +95,7 @@ _SUBMODULES = [
     "linalg", "fft", "signal", "incubate", "metric", "sparse", "profiler",
     "hapi", "hub", "device", "distributed", "distribution", "static", "audio",
     "text", "quantization", "utils", "inference", "regularizer",
+    "geometric", "sysconfig", "onnx",
 ]
 
 
@@ -115,6 +116,14 @@ def __getattr__(name):
         globals()["Model"], globals()["summary"] = _hapi.Model, _hapi.summary
         globals()["flops"] = _flops
         return globals()[name]
+    if name == "callbacks":
+        from .hapi import callbacks as _cb
+        globals()["callbacks"] = _cb
+        return _cb
+    if name == "batch":
+        from .batch import batch as _batch
+        globals()["batch"] = _batch
+        return _batch
     if name == "DataParallel":
         from .distributed.parallel import DataParallel as _DP
         globals()["DataParallel"] = _DP

@@ -1,0 +1,250 @@
+"""paddle.geometric — graph-learning ops (reference:
+python/paddle/geometric/ — math.py segment reductions, message_passing/
+send_u_recv & send_ue_recv & send_uv, reindex.py, sampling/).
+
+TPU-native formulation: segment reductions lower to jax.ops.segment_* /
+scatter-reduce (static num_segments keeps shapes compile-time known — pass
+``count`` when the tensor's segment count can't be inferred from data);
+message passing is gather + segment-reduce, which XLA fuses into the
+surrounding compute.  Neighbor sampling and reindexing are host-side graph
+preprocessing (numpy), exactly as the reference runs them on CPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops._prim import _t, apply_op
+
+_COMB = None  # filled below (jnp elementwise combiners for message ops)
+
+
+def _num_segments(segment_ids, count):
+    if count is not None:
+        return int(count)
+    arr = segment_ids._data if isinstance(segment_ids, Tensor) else segment_ids
+    return int(np.asarray(arr).max()) + 1 if arr.size else 0
+
+
+# ---------------------------------------------------------- segment math
+
+def segment_sum(data, segment_ids, count: Optional[int] = None, name=None):
+    n = _num_segments(segment_ids, count)
+
+    def prim(d, s):
+        return jax.ops.segment_sum(d, s, num_segments=n)
+    return apply_op("segment_sum", prim, (_t(data), _t(segment_ids)))
+
+
+def segment_mean(data, segment_ids, count: Optional[int] = None, name=None):
+    n = _num_segments(segment_ids, count)
+
+    def prim(d, s):
+        return _reduce(d, s, n, "mean")
+    return apply_op("segment_mean", prim, (_t(data), _t(segment_ids)))
+
+
+def segment_min(data, segment_ids, count: Optional[int] = None, name=None):
+    n = _num_segments(segment_ids, count)
+
+    def prim(d, s):
+        return _reduce(d, s, n, "min")
+    return apply_op("segment_min", prim, (_t(data), _t(segment_ids)))
+
+
+def segment_max(data, segment_ids, count: Optional[int] = None, name=None):
+    n = _num_segments(segment_ids, count)
+
+    def prim(d, s):
+        return _reduce(d, s, n, "max")
+    return apply_op("segment_max", prim, (_t(data), _t(segment_ids)))
+
+
+# ------------------------------------------------------- message passing
+
+_POOLS = ("sum", "mean", "max", "min")
+_COMB = {"add": jnp.add, "sub": jnp.subtract,
+         "mul": jnp.multiply, "div": jnp.divide}
+
+
+def _reduce(msgs, dst, n, pool):
+    if pool == "sum":
+        return jax.ops.segment_sum(msgs, dst, num_segments=n)
+    if pool == "mean":
+        tot = jax.ops.segment_sum(msgs, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype),
+                                  dst, num_segments=n)
+        return tot / jnp.maximum(cnt.reshape((n,) + (1,) * (msgs.ndim - 1)), 1)
+    fn = jax.ops.segment_max if pool == "max" else jax.ops.segment_min
+    out = fn(msgs, dst, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],)), dst, num_segments=n)
+    return jnp.where(cnt.reshape((n,) + (1,) * (msgs.ndim - 1)) > 0, out, 0) \
+        .astype(msgs.dtype)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None, name=None):
+    """Gather x[src] and reduce onto dst (reference
+    message_passing/send_recv.py send_u_recv)."""
+    assert reduce_op in _POOLS, reduce_op
+    x = _t(x)
+    n = int(out_size) if out_size else x.shape[0]
+
+    def prim(xa, s, d):
+        return _reduce(jnp.take(xa, s, axis=0), d, n, reduce_op)
+    return apply_op("send_u_recv", prim,
+                    (x, _t(src_index), _t(dst_index)))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size: Optional[int] = None,
+                 name=None):
+    """Combine x[src] with edge features y, reduce onto dst."""
+    assert reduce_op in _POOLS, reduce_op
+    x = _t(x)
+    n = int(out_size) if out_size else x.shape[0]
+    comb = _COMB[message_op]
+
+    def prim(xa, ya, s, d):
+        return _reduce(comb(jnp.take(xa, s, axis=0), ya), d, n, reduce_op)
+    return apply_op("send_ue_recv", prim,
+                    (x, _t(y), _t(src_index), _t(dst_index)))
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
+    """Per-edge message x[src] (op) y[dst] — no reduction."""
+    comb = _COMB[message_op]
+
+    def prim(xa, ya, s, d):
+        return comb(jnp.take(xa, s, axis=0), jnp.take(ya, d, axis=0))
+    return apply_op("send_uv", prim,
+                    (_t(x), _t(y), _t(src_index), _t(dst_index)))
+
+
+# ------------------------------------------------- reindex & sampling
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact the union of center nodes x and their neighbor lists to
+    local ids (reference reindex.py reindex_graph): returns
+    (reindexed_src, reindexed_dst, out_nodes)."""
+    xs = np.asarray(_t(x)._data)
+    nb = np.asarray(_t(neighbors)._data)
+    cnt = np.asarray(_t(count)._data)
+    order = {}
+    out_nodes = []
+    for v in xs.tolist():
+        if v not in order:
+            order[v] = len(out_nodes)
+            out_nodes.append(v)
+    for v in nb.tolist():
+        if v not in order:
+            order[v] = len(out_nodes)
+            out_nodes.append(v)
+    reindex_src = np.asarray([order[v] for v in nb.tolist()], np.int64)
+    dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(np.asarray(out_nodes, np.int64))))
+
+
+def reindex_heter_graph(x, neighbors_list, count_list, value_buffer=None,
+                        index_buffer=None, name=None):
+    outs_src, outs_dst = [], []
+    xs = np.asarray(_t(x)._data)
+    order = {}
+    out_nodes = []
+    for v in xs.tolist():
+        if v not in order:
+            order[v] = len(out_nodes)
+            out_nodes.append(v)
+    for nb in neighbors_list:
+        for v in np.asarray(_t(nb)._data).tolist():
+            if v not in order:
+                order[v] = len(out_nodes)
+                out_nodes.append(v)
+    for nb, cnt in zip(neighbors_list, count_list):
+        nb_a = np.asarray(_t(nb)._data)
+        cnt_a = np.asarray(_t(cnt)._data)
+        outs_src.append(Tensor(jnp.asarray(
+            np.asarray([order[v] for v in nb_a.tolist()], np.int64))))
+        outs_dst.append(Tensor(jnp.asarray(
+            np.repeat(np.arange(len(xs), dtype=np.int64), cnt_a))))
+    return outs_src, outs_dst, Tensor(jnp.asarray(
+        np.asarray(out_nodes, np.int64)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     eids=None, return_eids: bool = False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling over a CSC graph (reference
+    sampling/neighbors.py).  Host-side (graph preprocessing)."""
+    r = np.asarray(_t(row)._data)
+    cp = np.asarray(_t(colptr)._data)
+    nodes = np.asarray(_t(input_nodes)._data)
+    if return_eids:
+        if eids is None:
+            raise ValueError("return_eids=True requires eids")
+        eids_a = np.asarray(_t(eids)._data)
+    rng = np.random.default_rng()
+    out_nb, out_cnt, out_eids = [], [], []
+    for v in nodes.tolist():
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < len(idx):
+            idx = rng.choice(idx, size=sample_size, replace=False)
+        out_nb.append(r[idx])
+        out_cnt.append(len(idx))
+        if return_eids:
+            out_eids.append(eids_a[idx])
+    nb = np.concatenate(out_nb) if out_nb else np.zeros((0,), r.dtype)
+    res = (Tensor(jnp.asarray(nb)),
+           Tensor(jnp.asarray(np.asarray(out_cnt, np.int64))))
+    if return_eids:
+        e = np.concatenate(out_eids) if out_eids else np.zeros((0,), np.int64)
+        return res + (Tensor(jnp.asarray(e)),)
+    return res
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size: int = -1, eids=None,
+                              return_eids: bool = False, name=None):
+    """Weight-proportional sampling without replacement."""
+    r = np.asarray(_t(row)._data)
+    cp = np.asarray(_t(colptr)._data)
+    w = np.asarray(_t(edge_weight)._data)
+    nodes = np.asarray(_t(input_nodes)._data)
+    if return_eids:
+        if eids is None:
+            raise ValueError("return_eids=True requires eids")
+        eids_a = np.asarray(_t(eids)._data)
+    rng = np.random.default_rng()
+    out_nb, out_cnt, out_eids = [], [], []
+    for v in nodes.tolist():
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < len(idx):
+            p = w[lo:hi].astype(np.float64)
+            p = p / p.sum()
+            idx = rng.choice(idx, size=sample_size, replace=False, p=p)
+        out_nb.append(r[idx])
+        out_cnt.append(len(idx))
+        if return_eids:
+            out_eids.append(eids_a[idx])
+    nb = np.concatenate(out_nb) if out_nb else np.zeros((0,), r.dtype)
+    res = (Tensor(jnp.asarray(nb)),
+           Tensor(jnp.asarray(np.asarray(out_cnt, np.int64))))
+    if return_eids:
+        e = np.concatenate(out_eids) if out_eids else np.zeros((0,), np.int64)
+        return res + (Tensor(jnp.asarray(e)),)
+    return res
+
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
+           "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+           "reindex_heter_graph", "sample_neighbors",
+           "weighted_sample_neighbors"]

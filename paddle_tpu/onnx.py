@@ -1,0 +1,10 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py via paddle2onnx).
+
+ONNX export needs the paddle2onnx converter, which has no TPU/StableHLO
+path; the portable export format here is StableHLO via jit.save."""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "onnx export has no XLA converter; use paddle_tpu.jit.save "
+        "(StableHLO — portable serialized program) instead")

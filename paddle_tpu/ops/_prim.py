@@ -47,29 +47,29 @@ def _axis(axis):
     return int(axis)
 
 
-def unary_op(name, fn):
+def unary_op(name, fn, spmd_rule="elementwise"):
     def op(x, name=None):
         return apply_op(name_, fn, (_t(x),))
     name_ = name
     op.__name__ = name
-    register_op(name, fn, spmd_rule="elementwise")
+    register_op(name, fn, spmd_rule=spmd_rule)
     return op
 
 
-def binary_op(name, fn):
+def binary_op(name, fn, spmd_rule="elementwise"):
     def op(x, y, name=None):
         xt = isinstance(x, Tensor)
         yt = isinstance(y, Tensor)
         if not xt and not yt:
             x = Tensor(x)
-        return apply_op(name_, fn, (x if xt or not yt else x, y))
+        return apply_op(name_, fn, (x, y))
     name_ = name
     op.__name__ = name
-    register_op(name, fn, spmd_rule="elementwise")
+    register_op(name, fn, spmd_rule=spmd_rule)
     return op
 
 
-def reduce_op(name, fn, dtype_arg=False):
+def reduce_op(name, fn, dtype_arg=False, spmd_rule="reduction"):
     from .. import dtypes
 
     def op(x, axis=None, keepdim=False, name=None, dtype=None):
@@ -80,5 +80,5 @@ def reduce_op(name, fn, dtype_arg=False):
         return apply_op(name_, lambda a: fn(a, **kw), (_t(x),))
     name_ = name
     op.__name__ = name
-    register_op(name, fn, spmd_rule="reduction")
+    register_op(name, fn, spmd_rule=spmd_rule)
     return op

@@ -59,6 +59,9 @@ def render(schema_path: str = SCHEMA) -> str:
         if kind not in _FACTORY:
             raise ValueError(f"unknown kind {kind!r} for op {op}")
         extra = ", dtype_arg=True" if entry.get("dtype_arg") else ""
+        if entry.get("spmd_rule"):
+            # per-op override of the kind's default propagation rule
+            extra += f", spmd_rule={entry['spmd_rule']!r}"
         noqa = "  # noqa: A001" if op in (
             "abs", "round", "pow", "sum", "max", "min", "all", "any") else ""
         lines.append(f'{op} = {_FACTORY[kind]}("{op}", {impl}{extra}){noqa}')

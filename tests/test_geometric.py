@@ -1,0 +1,126 @@
+"""paddle.geometric tests (reference: python/paddle/geometric/ — segment
+math, message passing, reindex, neighbor sampling)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+T = paddle.to_tensor
+
+
+def _np(x):
+    return np.asarray(x._data)
+
+
+def test_segment_reductions(rng):
+    data = T(np.arange(12, dtype="float32").reshape(6, 2))
+    seg = T(np.asarray([0, 0, 1, 1, 1, 3], "int32"))
+    np.testing.assert_allclose(
+        _np(G.segment_sum(data, seg)),
+        [[2, 4], [18, 21], [0, 0], [10, 11]])
+    np.testing.assert_allclose(_np(G.segment_mean(data, seg))[1], [6, 7])
+    np.testing.assert_allclose(_np(G.segment_max(data, seg)),
+                               [[2, 3], [8, 9], [0, 0], [10, 11]])
+    np.testing.assert_allclose(_np(G.segment_min(data, seg)),
+                               [[0, 1], [4, 5], [0, 0], [10, 11]])
+    # explicit count widens the output
+    out = G.segment_sum(data, seg, count=6)
+    assert tuple(out.shape) == (6, 2)
+
+
+def test_segment_sum_grad(rng):
+    data = T(rng.standard_normal((5, 3)).astype("float32"))
+    data.stop_gradient = False
+    seg = T(np.asarray([0, 1, 1, 2, 2], "int32"))
+    out = G.segment_sum(data, seg)
+    out.sum().backward()
+    np.testing.assert_allclose(_np(data.grad), np.ones((5, 3)))
+
+
+def test_message_passing(rng):
+    x = T(np.asarray([[1., 2.], [3., 4.], [5., 6.]], "float32"))
+    src = T(np.asarray([0, 1, 2, 0], "int32"))
+    dst = T(np.asarray([1, 2, 1, 0], "int32"))
+    np.testing.assert_allclose(_np(G.send_u_recv(x, src, dst, "sum")),
+                               [[1, 2], [6, 8], [3, 4]])
+    np.testing.assert_allclose(_np(G.send_u_recv(x, src, dst, "mean")),
+                               [[1, 2], [3, 4], [3, 4]])
+    np.testing.assert_allclose(_np(G.send_u_recv(x, src, dst, "max")),
+                               [[1, 2], [5, 6], [3, 4]])
+    ew = T(np.full((4, 2), 10.0, "float32"))
+    np.testing.assert_allclose(
+        _np(G.send_ue_recv(x, ew, src, dst, "add", "sum")),
+        [[11, 12], [26, 28], [13, 14]])
+    msg = G.send_uv(x, x, src, dst, "mul")
+    np.testing.assert_allclose(_np(msg),
+                               [[3, 8], [15, 24], [15, 24], [1, 4]])
+
+
+def test_reindex_graph(rng):
+    rs, rd, nodes = G.reindex_graph(
+        T(np.asarray([10, 20], "int64")),
+        T(np.asarray([20, 30, 10, 40], "int64")),
+        T(np.asarray([2, 2], "int64")))
+    assert _np(nodes).tolist() == [10, 20, 30, 40]
+    assert _np(rs).tolist() == [1, 2, 0, 3]
+    assert _np(rd).tolist() == [0, 0, 1, 1]
+    srcs, dsts, hnodes = G.reindex_heter_graph(
+        T(np.asarray([10, 20], "int64")),
+        [T(np.asarray([20, 30], "int64")), T(np.asarray([40], "int64"))],
+        [T(np.asarray([1, 1], "int64")), T(np.asarray([1, 0], "int64"))])
+    assert _np(hnodes).tolist() == [10, 20, 30, 40]
+    assert len(srcs) == 2 and len(dsts) == 2
+
+
+def test_sample_neighbors(rng):
+    # CSC: neighbors of 0 -> [1, 2]; of 1 -> [2]; of 2 -> []
+    row = T(np.asarray([1, 2, 2], "int64"))
+    colptr = T(np.asarray([0, 2, 3, 3], "int64"))
+    nb, cnt = G.sample_neighbors(row, colptr,
+                                 T(np.asarray([0, 1, 2], "int64")))
+    assert _np(cnt).tolist() == [2, 1, 0]
+    assert sorted(_np(nb)[:2].tolist()) == [1, 2]
+    nb1, cnt1 = G.sample_neighbors(row, colptr,
+                                   T(np.asarray([0], "int64")),
+                                   sample_size=1)
+    assert _np(cnt1).tolist() == [1] and _np(nb1)[0] in (1, 2)
+    w = T(np.asarray([1.0, 1e-9, 1.0], "float32"))
+    nbw, cntw = G.weighted_sample_neighbors(
+        row, colptr, w, T(np.asarray([0], "int64")), sample_size=1)
+    assert _np(cntw).tolist() == [1]
+
+
+def test_misc_shims():
+    reader = paddle.batch(lambda: iter([1, 2, 3, 4, 5]), 2)
+    assert list(reader()) == [[1, 2], [3, 4], [5]]
+    assert list(paddle.batch(lambda: iter([1, 2, 3]), 2,
+                             drop_last=True)()) == [[1, 2]]
+    import paddle_tpu.sysconfig as sysconfig
+    assert sysconfig.get_include().endswith("include")
+    with pytest.raises(NotImplementedError):
+        paddle.onnx.export(None, "x")
+    from paddle_tpu import callbacks
+    assert hasattr(callbacks, "EarlyStopping")
+
+
+def test_sample_neighbors_eids(rng):
+    row = T(np.asarray([1, 2, 2], "int64"))
+    colptr = T(np.asarray([0, 2, 3, 3], "int64"))
+    eids = T(np.asarray([100, 101, 102], "int64"))
+    nb, cnt, e = G.sample_neighbors(row, colptr,
+                                    T(np.asarray([0, 1], "int64")),
+                                    eids=eids, return_eids=True)
+    assert _np(e).tolist() == [100, 101, 102]
+    nbw, cntw, ew = G.weighted_sample_neighbors(
+        row, colptr, T(np.ones(3, "float32")),
+        T(np.asarray([1], "int64")), eids=eids, return_eids=True)
+    assert _np(ew).tolist() == [102]
+    with pytest.raises(ValueError):
+        G.sample_neighbors(row, colptr, T(np.asarray([0], "int64")),
+                           return_eids=True)
+    with pytest.raises(ValueError):
+        G.weighted_sample_neighbors(row, colptr, T(np.ones(3, "float32")),
+                                    T(np.asarray([0], "int64")),
+                                    return_eids=True)
