@@ -219,7 +219,8 @@ class Tensor:
 class Parameter(Tensor):
     """Trainable tensor (reference: paddle.base.framework.Parameter)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip", "is_distributed")
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average",
+                 "need_clip", "is_distributed", "_asp_mask")
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)

@@ -2,6 +2,8 @@
 
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401
 
 
 def autograd_enabled():

@@ -1,0 +1,109 @@
+"""paddle.incubate.asp — automatic structured (n:m) sparsity (reference:
+python/paddle/incubate/asp/ — supported_layer_list, utils get_mask_1d/2d,
+prune_model, decorate).
+
+TPU-native note: there is no sparse-MXU path, so n:m sparsity here is a
+TRAINING technique (mask maintenance so a model converges under the
+sparsity pattern); the masked weights stay dense in compute.  The pruning
+math (magnitude-based n-in-m group selection) matches the reference."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..optimizer import Optimizer
+
+# masks live ON the parameter object (p._asp_mask) — no global registry, so
+# no leak across models and no stale-mask risk from CPython id() reuse
+_EXCLUDED: set = set()   # excluded layer names / parameter names
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float((arr != 0).sum() / arr.size) if arr.size else 0.0
+
+
+def get_mask_1d(weight, n: int = 2, m: int = 4):
+    """Keep the n largest-|w| entries of every m-length group along the
+    flattened weight (reference utils.get_mask_1d).  Sizes that are not a
+    multiple of m are zero-padded for the selection and sliced back, so
+    every layer prunes (reference pads the same way)."""
+    arr = np.asarray(weight._data if isinstance(weight, Tensor) else weight)
+    size = arr.size
+    pad = (-size) % m
+    flat = np.concatenate([np.abs(arr).reshape(-1),
+                           np.zeros(pad, arr.dtype)]).reshape(-1, m)
+    order = np.argsort(-flat, axis=1)
+    mask = np.zeros_like(flat, dtype=np.float32)
+    rows = np.arange(flat.shape[0])[:, None]
+    mask[rows, order[:, :n]] = 1.0
+    return Tensor(jnp.asarray(mask.reshape(-1)[:size].reshape(arr.shape)))
+
+
+def check_mask_1d(mat, n: int = 2, m: int = 4) -> bool:
+    arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    if arr.size % m:
+        return False
+    groups = (arr.reshape(-1, m) != 0).sum(axis=1)
+    return bool((groups <= n).all())
+
+
+def set_excluded_layers(param_names, main_program=None):
+    for name in param_names:
+        _EXCLUDED.add(name)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _prunable(model):
+    for name, layer in model.named_sublayers() if hasattr(
+            model, "named_sublayers") else []:
+        if not isinstance(layer, nn.Linear):
+            continue
+        # exclusion matches the layer name, the weight's qualified name, or
+        # the Parameter's own name (reference passes param names)
+        w_name = getattr(layer.weight, "name", None)
+        if name in _EXCLUDED or f"{name}.weight" in _EXCLUDED or \
+                (w_name and w_name in _EXCLUDED):
+            continue
+        yield name, layer
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Apply n:m magnitude pruning to every supported (Linear) layer and
+    record the masks so ``decorate``d optimizers keep the pattern."""
+    pruned = {}
+    for name, layer in _prunable(model):
+        w = layer.weight
+        mask = get_mask_1d(w, n, m)
+        w._data = w._data * mask._data.astype(w._data.dtype)
+        if with_mask:
+            w._asp_mask = mask._data
+        pruned[name] = mask
+    return pruned
+
+
+def decorate(optimizer: Optimizer) -> Optimizer:
+    """Wrap optimizer.step so masked weights stay zero through training
+    (the reference's OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+
+    def step(*args, **kwargs):
+        out = orig_step(*args, **kwargs)
+        for p in optimizer._params:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._data = p._data * mask.astype(p._data.dtype)
+        return out
+
+    optimizer.step = step
+    optimizer._asp_decorated = True
+    return optimizer

@@ -1,0 +1,151 @@
+"""paddle.incubate.optimizer (reference: python/paddle/incubate/optimizer/
+lookahead.py LookAhead, modelaverage.py ModelAverage, gradient_merge.py).
+
+Wrapper optimizers: each wraps an inner optimizer and adds slow-weight
+state; the math is pure jnp over the parameter buffers."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    """k fast steps with the inner optimizer, then slow weights interpolate
+    toward the fast weights: slow += alpha * (fast - slow); fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        assert isinstance(inner_optimizer, Optimizer)
+        super().__init__(inner_optimizer._learning_rate,
+                         inner_optimizer._parameter_list)
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        # slow weights start AT the initial parameters (reference inits the
+        # slow accumulator at creation, so the first sync pulls back toward
+        # the starting point rather than being a no-op)
+        self._slow: Dict[int, jnp.ndarray] = {
+            id(p): p._data.copy() for p in self._params}
+        self._k_count = 0
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k:
+            return
+        for p in self._params:
+            slow = self._slow[id(p)]
+            slow = slow + self.alpha * (p._data - slow)
+            # keep our own buffer: the inner optimizer's jitted update
+            # DONATES p._data, which would invalidate a shared reference
+            self._slow[id(p)] = slow
+            p._data = slow.copy()
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        state = self.inner_optimizer.state_dict()
+        for i, p in enumerate(self._params):
+            if id(p) in self._slow:
+                state[f"lookahead_slow_{i}"] = Tensor(self._slow[id(p)])
+        state["@lookahead_k_count"] = self._k_count
+        return state
+
+    def set_state_dict(self, state_dict):
+        self._k_count = int(state_dict.pop("@lookahead_k_count", 0))
+        for i, p in enumerate(self._params):
+            key = f"lookahead_slow_{i}"
+            if key in state_dict:
+                v = state_dict.pop(key)
+                self._slow[id(p)] = v._data if isinstance(v, Tensor) else v
+        self.inner_optimizer.set_state_dict(state_dict)
+
+
+class ModelAverage(Optimizer):
+    """Maintain a running average of parameters over steps; apply()/restore()
+    swap the averaged weights in and out for evaluation (reference
+    modelaverage.py semantics, EMA-free simple average over a window)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(0.0, parameters)
+        self.rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._sum: Dict[int, jnp.ndarray] = {}
+        self._num_updates = 0
+        self._backup: Dict[int, jnp.ndarray] = {}
+
+    def step(self):
+        self._num_updates += 1
+        for p in self._params:
+            acc = self._sum.get(id(p))
+            # copy on first touch: p._data will be donated by the inner
+            # optimizer's next jitted update
+            self._sum[id(p)] = p._data.copy() if acc is None \
+                else acc + p._data
+        # bound the window: restart the average when it grows past max
+        window = min(self.max_window,
+                     max(self.min_window,
+                         int(self._num_updates * self.rate) or 1))
+        if self._num_updates > window:
+            for p in self._params:
+                self._sum[id(p)] = p._data.copy()
+            self._num_updates = 1
+
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            if id(p) in self._sum and self._num_updates > 0:
+                # don't clobber an existing backup: a second apply() before
+                # restore() would otherwise back up the AVERAGED weights
+                if id(p) not in self._backup:
+                    self._backup[id(p)] = p._data.copy()
+                p._data = (self._sum[id(p)] / self._num_updates) \
+                    .astype(p._data.dtype)
+        return _ApplyCtx(self) if need_restore else None
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+
+
+    def state_dict(self):
+        state = super().state_dict()
+        for i, p in enumerate(self._params):
+            if id(p) in self._sum:
+                state[f"modelavg_sum_{i}"] = Tensor(self._sum[id(p)])
+        state["@modelavg_num_updates"] = self._num_updates
+        return state
+
+    def set_state_dict(self, state_dict):
+        self._num_updates = int(state_dict.pop("@modelavg_num_updates", 0))
+        for i, p in enumerate(self._params):
+            key = f"modelavg_sum_{i}"
+            if key in state_dict:
+                v = state_dict.pop(key)
+                self._sum[id(p)] = v._data if isinstance(v, Tensor) else v
+        super().set_state_dict(state_dict)
+
+
+class _ApplyCtx:
+    def __init__(self, ma):
+        self.ma = ma
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.ma.restore()
+        return False

@@ -1,0 +1,163 @@
+"""incubate.asp (n:m sparsity) + incubate.optimizer (LookAhead/ModelAverage)
+tests (reference: python/paddle/incubate/{asp,optimizer}/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate import asp
+from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage
+
+
+def test_asp_prune_and_maintain(rng):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    o = asp.decorate(opt.SGD(0.1, parameters=m.parameters()))
+    asp.prune_model(m)
+    assert abs(asp.calculate_density(m[0].weight) - 0.5) < 1e-6
+    assert asp.check_mask_1d(m[0].weight)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+    y = paddle.to_tensor(rng.integers(0, 4, 4).astype("int64"))
+    losses = []
+    for _ in range(5):
+        loss = nn.CrossEntropyLoss()(m(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss._data))
+    # sparsity survives training AND training still converges
+    assert abs(asp.calculate_density(m[0].weight) - 0.5) < 1e-6
+    assert losses[-1] < losses[0]
+
+
+def test_asp_mask_math(rng):
+    w = paddle.to_tensor(
+        np.asarray([[5., 0.1, 4., 0.2], [0.1, 3., 0.2, 2.]], "float32"))
+    mask = asp.get_mask_1d(w, n=2, m=4)
+    np.testing.assert_allclose(np.asarray(mask._data),
+                               [[1, 0, 1, 0], [0, 1, 0, 1]])
+    assert asp.check_mask_1d(paddle.to_tensor(
+        np.asarray(w._data) * np.asarray(mask._data)))
+    assert not asp.check_mask_1d(w)  # dense fails the 2:4 check
+    asp.set_excluded_layers(["0"])
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    asp.prune_model(m)
+    try:
+        assert asp.calculate_density(m[0].weight) == 1.0   # excluded
+        assert abs(asp.calculate_density(m[1].weight) - 0.5) < 1e-6
+    finally:
+        asp.reset_excluded_layers()
+
+
+def test_lookahead(rng):
+    paddle.seed(0)
+    lin = nn.Linear(4, 2)
+    la = LookAhead(opt.SGD(0.1, parameters=lin.parameters()), alpha=0.5, k=2)
+    x = paddle.to_tensor(rng.standard_normal((4, 4)).astype("float32"))
+    w0 = np.asarray(lin.weight._data).copy()
+    snaps = []
+    for i in range(4):
+        loss = (lin(x) ** 2).sum()
+        loss.backward()
+        la.step()
+        la.clear_grad()
+        snaps.append(np.asarray(lin.weight._data).copy())
+    assert not np.allclose(w0, snaps[-1])
+    sd = la.state_dict()
+    assert "@lookahead_k_count" in sd
+    la.set_state_dict(sd)  # round-trips
+
+
+def test_model_average_apply_restore(rng):
+    paddle.seed(0)
+    lin = nn.Linear(4, 2)
+    inner = opt.SGD(0.5, parameters=lin.parameters())
+    ma = ModelAverage(0.5, parameters=lin.parameters())
+    x = paddle.to_tensor(rng.standard_normal((4, 4)).astype("float32"))
+    history = []
+    for i in range(5):
+        loss = (lin(x) ** 2).sum()
+        loss.backward()
+        inner.step()
+        inner.clear_grad()
+        ma.step()
+        history.append(np.asarray(lin.weight._data).copy())
+    cur = np.asarray(lin.weight._data).copy()
+    with ma.apply():
+        avg = np.asarray(lin.weight._data).copy()
+        # averaged weights equal the running mean of the history
+        np.testing.assert_allclose(avg, np.mean(history, axis=0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(lin.weight._data), cur)
+
+
+def test_asp_non_divisible_and_param_name_exclusion(rng):
+    # non-divisible size still prunes via padding (15 % 4 != 0)
+    w = paddle.to_tensor(rng.standard_normal((5, 3)).astype("float32"))
+    mask = asp.get_mask_1d(w, n=2, m=4)
+    kept = np.asarray(mask._data).sum()
+    assert kept <= 2 * np.ceil(15 / 4)
+    assert kept < 15  # actually pruned
+    # exclusion by parameter-style name also works
+    asp.set_excluded_layers(["0.weight"])
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    try:
+        asp.prune_model(m)
+        assert asp.calculate_density(m[0].weight) == 1.0
+        assert abs(asp.calculate_density(m[1].weight) - 0.5) < 1e-6
+    finally:
+        asp.reset_excluded_layers()
+
+
+def test_lookahead_first_sync_pulls_to_init(rng):
+    """Regression: slow weights start at the INITIAL params, so the first
+    sync must move fast weights back toward the start."""
+    paddle.seed(0)
+    lin = nn.Linear(4, 2, bias_attr=False)
+    w_init = np.asarray(lin.weight._data).copy()
+    la = LookAhead(opt.SGD(0.5, parameters=lin.parameters()), alpha=0.5, k=2)
+    x = paddle.to_tensor(rng.standard_normal((4, 4)).astype("float32"))
+    for i in range(2):
+        ((lin(x) ** 2).sum()).backward()
+        la.step()
+        la.clear_grad()
+    w_after = np.asarray(lin.weight._data)
+    # pure SGD would land at w_sgd; lookahead lands halfway to w_init
+    paddle.seed(0)
+    lin2 = nn.Linear(4, 2, bias_attr=False)
+    sgd = opt.SGD(0.5, parameters=lin2.parameters())
+    for i in range(2):
+        ((lin2(x) ** 2).sum()).backward()
+        sgd.step()
+        sgd.clear_grad()
+    w_sgd = np.asarray(lin2.weight._data)
+    np.testing.assert_allclose(w_after, w_init + 0.5 * (w_sgd - w_init),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_model_average_state_roundtrip_and_double_apply(rng):
+    paddle.seed(0)
+    lin = nn.Linear(4, 2)
+    inner = opt.SGD(0.5, parameters=lin.parameters())
+    ma = ModelAverage(0.5, parameters=lin.parameters())
+    x = paddle.to_tensor(rng.standard_normal((4, 4)).astype("float32"))
+    for _ in range(3):
+        ((lin(x) ** 2).sum()).backward()
+        inner.step()
+        inner.clear_grad()
+        ma.step()
+    sd = ma.state_dict()
+    assert "@modelavg_num_updates" in sd
+    ma2 = ModelAverage(0.5, parameters=lin.parameters())
+    ma2.set_state_dict(sd)
+    cur = np.asarray(lin.weight._data).copy()
+    ma2.apply(need_restore=False)
+    avg1 = np.asarray(lin.weight._data).copy()
+    assert not np.allclose(cur, avg1)
+    # second apply must NOT clobber the original backup
+    ma2.apply(need_restore=False)
+    ma2.restore()
+    np.testing.assert_allclose(np.asarray(lin.weight._data), cur)
