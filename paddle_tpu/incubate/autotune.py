@@ -1,0 +1,30 @@
+"""paddle.incubate.autotune (reference: python/paddle/incubate/autotune.py
+set_config for kernel/layout/dataloader autotuning).
+
+XLA owns kernel autotuning on TPU (latency-measured GEMM/conv algorithm
+pick happens inside the compiler); this surface records the requested
+config and applies the pieces that have a TPU-side meaning."""
+
+from __future__ import annotations
+
+import json
+
+_CONFIG = {"kernel": {"enable": True},      # XLA always autotunes
+           "layout": {"enable": False},     # layouts are compiler-chosen
+           "dataloader": {"enable": False}}
+
+
+def set_config(config=None):
+    global _CONFIG
+    if config is None:
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    for key, val in config.items():
+        _CONFIG.setdefault(key, {}).update(val)
+
+
+def get_config():
+    import copy
+    return copy.deepcopy(_CONFIG)   # snapshot: mutations must not leak back

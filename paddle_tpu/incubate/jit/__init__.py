@@ -1,0 +1,52 @@
+"""paddle.incubate.jit (reference: python/paddle/incubate/jit/inference
+decorator) — wraps a callable or Layer for compiled inference via
+to_static under no-grad."""
+
+from __future__ import annotations
+
+import functools
+
+
+def inference(function=None, cache_static_model=True, **kwargs):
+    """Decorator: compile the wrapped callable/Layer with jit.to_static and
+    run it under no-grad (the XLA executable IS the inference engine).
+
+    ``cache_static_model=False`` rebuilds the compiled function on every
+    call (no guard cache) — matches the reference flag's "don't reuse the
+    saved static model" intent.  Unknown options are rejected rather than
+    silently dropped."""
+    if kwargs:
+        raise TypeError(f"inference() got unsupported options: "
+                        f"{sorted(kwargs)}")
+
+    def wrap(fn):
+        from ... import jit as _jit
+        from ...core import autograd as _ag
+        from ...nn.layer import Layer
+
+        if isinstance(fn, Layer):
+            # keep the Layer interface: compile forward, run it no-grad
+            orig_forward = fn.forward
+            static = _jit.to_static(orig_forward)
+
+            @functools.wraps(orig_forward)
+            def fwd(*args, **kw):
+                call = static if cache_static_model else \
+                    _jit.to_static(orig_forward)
+                with _ag.no_grad():
+                    return call(*args, **kw)
+
+            fn.forward = fwd
+            return fn
+
+        static = _jit.to_static(fn)
+
+        @functools.wraps(fn)
+        def run(*args, **kw):
+            call = static if cache_static_model else _jit.to_static(fn)
+            with _ag.no_grad():
+                return call(*args, **kw)
+
+        return run
+
+    return wrap(function) if function is not None else wrap

@@ -161,3 +161,45 @@ def test_model_average_state_roundtrip_and_double_apply(rng):
     ma2.apply(need_restore=False)
     ma2.restore()
     np.testing.assert_allclose(np.asarray(lin.weight._data), cur)
+
+
+def test_autotune_and_jit_inference(rng):
+    import copy
+    from paddle_tpu.incubate import autotune
+    from paddle_tpu.incubate import jit as ijit
+    saved = copy.deepcopy(autotune._CONFIG)
+    try:
+        autotune.set_config({"kernel": {"enable": True,
+                                        "tuning_range": [1, 3]}})
+        snap = autotune.get_config()
+        assert snap["kernel"]["tuning_range"] == [1, 3]
+        snap["kernel"]["enable"] = False      # snapshot must not leak back
+        assert autotune.get_config()["kernel"]["enable"] is True
+    finally:
+        autotune._CONFIG.clear()
+        autotune._CONFIG.update(saved)
+    paddle.seed(0)
+    lin = nn.Linear(4, 2)
+
+    @ijit.inference
+    def fwd(x):
+        return lin(x)
+
+    x = paddle.to_tensor(rng.standard_normal((3, 4)).astype("float32"))
+    np.testing.assert_allclose(np.asarray(fwd(x)._data),
+                               np.asarray(lin(x)._data), rtol=1e-6)
+
+
+def test_jit_inference_on_layer(rng):
+    from paddle_tpu.incubate import jit as ijit
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.to_tensor(rng.standard_normal((3, 4)).astype("float32"))
+    want = np.asarray(model(x)._data)
+    model = ijit.inference(model)
+    # Layer interface survives
+    assert hasattr(model, "eval") and len(model.parameters()) == 4
+    np.testing.assert_allclose(np.asarray(model(x)._data), want, rtol=1e-5)
+    import pytest as _pytest
+    with _pytest.raises(TypeError):
+        ijit.inference(lambda v: v, bogus_option=1)
