@@ -119,6 +119,26 @@ class Tensor:
     def cpu(self) -> "Tensor":
         return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
 
+    def cuda(self, *args, **kwargs) -> "Tensor":
+        return self            # already accelerator-resident under XLA
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    def dim(self) -> int:
+        return self.ndim
+
+    ndimension = dim
+
+    def element_size(self) -> int:
+        return jnp.dtype(self._data.dtype).itemsize
+
+    def is_contiguous(self) -> bool:
+        return True            # XLA arrays are always dense
+
+    def contiguous(self) -> "Tensor":
+        return self
+
     def to(self, *args, **kwargs) -> "Tensor":
         dtype = kwargs.get("dtype")
         for a in args:

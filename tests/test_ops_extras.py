@@ -148,3 +148,11 @@ def test_increment_and_fill_constant():
     np.testing.assert_allclose(_np(c), np.full((2, 3), 7.0))
     paddle.set_printoptions(precision=3)
     paddle.set_printoptions(precision=8)
+
+
+def test_tensor_portability_methods():
+    t = T(np.asarray([[1.0, 2.0]], "float32"))
+    assert t.dim() == 2 and t.ndimension() == 2
+    assert t.element_size() == 4
+    assert t.is_contiguous() and t.contiguous() is t
+    assert t.cuda() is t and t.pin_memory() is t
