@@ -131,7 +131,7 @@ class Tensor:
     ndimension = dim
 
     def element_size(self) -> int:
-        return jnp.dtype(self._data.dtype).itemsize
+        return self.dtype.itemsize
 
     def is_contiguous(self) -> bool:
         return True            # XLA arrays are always dense

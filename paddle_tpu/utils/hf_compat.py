@@ -1,0 +1,120 @@
+"""HuggingFace / torch checkpoint import (migration tooling).
+
+Reference users bring torch-format Llama checkpoints (HF transformers
+layout); this maps them onto ``models.llama.LlamaForCausalLM``:
+
+- torch Linear stores ``[out, in]`` and computes ``x @ W^T``; our
+  ``_ParamLinear`` stores ``[in, out]`` — weights transpose on the way in;
+- HF checkpoints store q/k projections PERMUTED for the rotate_half
+  (split-half) rotary convention; our kernel uses the original
+  interleaved-pair convention (Meta layout), so q/k rows un-permute:
+  ``w.view(h, 2, d/2, in).transpose(1, 2)`` is the inverse of the
+  conversion HF applied when importing Meta weights.
+
+Numerical parity against transformers' LlamaForCausalLM is asserted in
+tests/test_hf_compat.py — the converted model's logits match HF's to
+float32 tolerance, which doubles as an end-to-end oracle for our whole
+Llama forward (RMSNorm, RoPE, GQA flash attention, SwiGLU).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _to_np(t):
+    if hasattr(t, "detach"):  # torch tensor
+        t = t.detach().cpu()
+        try:
+            return t.numpy()
+        except TypeError:     # bf16/fp16 checkpoints: numpy has no bfloat16
+            return t.float().numpy()
+    return np.asarray(t)
+
+
+def _unpermute_rope_rows(w_out_in: np.ndarray, n_heads: int,
+                         head_dim: int) -> np.ndarray:
+    """[out, in] q/k weight: HF split-half row layout -> interleaved."""
+    out_f, in_f = w_out_in.shape
+    w = w_out_in.reshape(n_heads, 2, head_dim // 2, in_f)
+    w = w.transpose(0, 2, 1, 3)                  # [h, d/2, 2, in]
+    return w.reshape(out_f, in_f)
+
+
+def convert_llama_state_dict(hf_state_dict, config) -> Dict[str, jnp.ndarray]:
+    """HF transformers Llama state_dict -> {our param name: array}.
+
+    ``config`` is our ``LlamaConfig`` (head counts drive the rope
+    un-permutation).  Accepts torch tensors or numpy arrays."""
+    sd = {k: _to_np(v) for k, v in hf_state_dict.items()}
+    # a checkpoint deeper than the config would be silently truncated —
+    # catch the mismatch instead of producing a garbage model
+    stray = [k for k in sd
+             if k.startswith(f"model.layers.{config.num_hidden_layers}.")]
+    if stray:
+        raise ValueError(
+            f"checkpoint has more layers than config.num_hidden_layers="
+            f"{config.num_hidden_layers} (found {stray[0]})")
+    hd = config.head_dim
+    out: Dict[str, jnp.ndarray] = {}
+
+    def put(name, arr, transpose=False):
+        out[name] = jnp.asarray(arr.T if transpose else arr)
+
+    put("llama.embed_tokens.weight", sd["model.embed_tokens.weight"])
+    put("llama.norm.weight", sd["model.norm.weight"])
+    if not config.tie_word_embeddings:
+        if "lm_head.weight" in sd:
+            put("lm_head.weight", sd["lm_head.weight"], transpose=True)
+        else:                 # untied model, tied checkpoint: materialize
+            put("lm_head.weight", sd["model.embed_tokens.weight"],
+                transpose=True)
+
+    for i in range(config.num_hidden_layers):
+        hf = f"model.layers.{i}"
+        us = f"llama.layers.{i}"
+        q = _unpermute_rope_rows(sd[f"{hf}.self_attn.q_proj.weight"],
+                                 config.num_attention_heads, hd)
+        k = _unpermute_rope_rows(sd[f"{hf}.self_attn.k_proj.weight"],
+                                 config.num_key_value_heads, hd)
+        put(f"{us}.self_attn.q_proj.weight", q, transpose=True)
+        put(f"{us}.self_attn.k_proj.weight", k, transpose=True)
+        put(f"{us}.self_attn.v_proj.weight",
+            sd[f"{hf}.self_attn.v_proj.weight"], transpose=True)
+        put(f"{us}.self_attn.o_proj.weight",
+            sd[f"{hf}.self_attn.o_proj.weight"], transpose=True)
+        put(f"{us}.mlp.gate_proj.weight",
+            sd[f"{hf}.mlp.gate_proj.weight"], transpose=True)
+        put(f"{us}.mlp.up_proj.weight",
+            sd[f"{hf}.mlp.up_proj.weight"], transpose=True)
+        put(f"{us}.mlp.down_proj.weight",
+            sd[f"{hf}.mlp.down_proj.weight"], transpose=True)
+        put(f"{us}.input_layernorm.weight",
+            sd[f"{hf}.input_layernorm.weight"])
+        put(f"{us}.post_attention_layernorm.weight",
+            sd[f"{hf}.post_attention_layernorm.weight"])
+    return out
+
+
+def load_hf_llama(model, hf_state_dict) -> None:
+    """Write an HF Llama state_dict into our LlamaForCausalLM in place."""
+    from . import load_params
+    params = convert_llama_state_dict(hf_state_dict, model.config)
+    named = dict(model.named_parameters())
+    missing = sorted(set(named) - set(params))
+    extra = sorted(set(params) - set(named))
+    if missing or extra:
+        raise ValueError(f"state_dict mismatch: missing={missing[:5]} "
+                         f"extra={extra[:5]}")
+    for name, arr in params.items():
+        if tuple(named[name].shape) != tuple(arr.shape):
+            raise ValueError(
+                f"{name}: shape {tuple(arr.shape)} != expected "
+                f"{tuple(named[name].shape)}")
+        # cast to the model's parameter dtype (a bf16-configured model must
+        # not silently end up with the checkpoint's fp32 buffers)
+        params[name] = arr.astype(named[name]._data.dtype)
+    load_params(model, params)

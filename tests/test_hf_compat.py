@@ -1,0 +1,110 @@
+"""HF transformers checkpoint import + numerics oracle.
+
+Loads torch-format Llama weights into our model and asserts logits parity
+with transformers' canonical implementation — an end-to-end oracle over
+RMSNorm, RoPE (convention conversion), GQA attention, and SwiGLU.  The
+greedy-decode test extends the oracle to the paged-KV serving loop.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.utils.hf_compat import convert_llama_state_dict, load_hf_llama
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _pair():
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM as HFLlama
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128,
+                      rms_norm_eps=1e-5, rope_theta=10000.0,
+                      attn_implementation="eager")
+    hf = HFLlama(hf_cfg).eval()
+    paddle.seed(0)
+    ours = LlamaForCausalLM(LlamaConfig.tiny())
+    load_hf_llama(ours, hf.state_dict())
+    return hf, ours
+
+
+def test_logits_match_transformers(rng):
+    hf, ours = _pair()
+    ids = rng.integers(0, 256, (2, 16)).astype("int64")
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    out = ours(paddle.to_tensor(ids.astype("int32")))
+    got = np.asarray(out[0]._data if isinstance(out, tuple) else out._data)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_greedy_decode_matches_transformers(rng):
+    """Paged-KV prefill+decode produces the same greedy continuation as
+    HF generate — the serving loop's numerics oracle."""
+    hf, ours = _pair()
+    from paddle_tpu.inference.generation import (GenerationConfig,
+                                                 LlamaGenerator)
+    prompt = rng.integers(1, 250, 7).astype("int64")
+    with torch.no_grad():
+        hf_out = hf.generate(torch.tensor(prompt[None]), max_new_tokens=8,
+                             do_sample=False)
+    want = hf_out[0, len(prompt):].numpy().tolist()
+    gen = LlamaGenerator(ours, max_batch=2, max_seq_len=64, page_size=8)
+    got = gen.generate([prompt.tolist()],
+                       GenerationConfig(max_new_tokens=8, do_sample=False))[0]
+    assert got == want, (got, want)
+
+
+def test_conversion_shape_validation(rng):
+    hf, ours = _pair()
+    sd = {k: v for k, v in hf.state_dict().items()}
+    bad = dict(sd)
+    del bad["model.norm.weight"]
+    with pytest.raises(KeyError):
+        convert_llama_state_dict(bad, ours.config)
+    params = convert_llama_state_dict(sd, ours.config)
+    assert params["llama.embed_tokens.weight"].shape == (256, 64)
+    assert params["lm_head.weight"].shape == (64, 256)
+    # tied-embedding checkpoints synthesize lm_head from the embedding
+    tied = {k: v for k, v in sd.items() if k != "lm_head.weight"}
+    params2 = convert_llama_state_dict(tied, ours.config)
+    np.testing.assert_allclose(
+        np.asarray(params2["lm_head.weight"]),
+        np.asarray(params2["llama.embed_tokens.weight"]).T)
+
+
+def test_bf16_checkpoint_and_target_dtype(rng):
+    """bf16 torch checkpoints convert; loading casts to the model dtype."""
+    hf, _ = _pair()
+    sd_bf16 = {k: v.to(torch.bfloat16) for k, v in hf.state_dict().items()}
+    cfg = LlamaConfig.tiny(dtype="bfloat16")
+    paddle.seed(0)
+    ours = LlamaForCausalLM(cfg)
+    load_hf_llama(ours, sd_bf16)
+    assert str(ours.llama.embed_tokens.weight._data.dtype) == "bfloat16"
+    # fp32 checkpoint into bf16 model: cast on load
+    paddle.seed(0)
+    ours2 = LlamaForCausalLM(cfg)
+    load_hf_llama(ours2, hf.state_dict())
+    assert str(ours2.llama.layers[0].self_attn.q_proj.weight._data.dtype) \
+        == "bfloat16"
+
+
+def test_tied_embeddings_and_depth_guard(rng):
+    hf, _ = _pair()
+    cfg_tied = LlamaConfig.tiny(tie_word_embeddings=True)
+    paddle.seed(0)
+    tied_model = LlamaForCausalLM(cfg_tied)
+    sd = {k: v for k, v in hf.state_dict().items() if k != "lm_head.weight"}
+    load_hf_llama(tied_model, sd)        # must not raise on missing lm_head
+    # depth mismatch raises instead of silently truncating
+    shallow = LlamaConfig.tiny(num_hidden_layers=1)
+    paddle.seed(0)
+    m1 = LlamaForCausalLM(shallow)
+    with pytest.raises(ValueError, match="more layers"):
+        load_hf_llama(m1, hf.state_dict())
