@@ -1,7 +1,8 @@
 """HuggingFace / torch checkpoint import (migration tooling).
 
-Reference users bring torch-format Llama checkpoints (HF transformers
-layout); this maps them onto ``models.llama.LlamaForCausalLM``:
+Reference users bring torch-format checkpoints (HF transformers layout);
+this maps Llama onto ``models.llama.LlamaForCausalLM`` and GPT-2 onto
+``models.gpt.GPTForCausalLM``.  Llama conventions:
 
 - torch Linear stores ``[out, in]`` and computes ``x @ W^T``; our
   ``_ParamLinear`` stores ``[in, out]`` — weights transpose on the way in;
@@ -11,10 +12,12 @@ layout); this maps them onto ``models.llama.LlamaForCausalLM``:
   ``w.view(h, 2, d/2, in).transpose(1, 2)`` is the inverse of the
   conversion HF applied when importing Meta weights.
 
-Numerical parity against transformers' LlamaForCausalLM is asserted in
-tests/test_hf_compat.py — the converted model's logits match HF's to
-float32 tolerance, which doubles as an end-to-end oracle for our whole
-Llama forward (RMSNorm, RoPE, GQA flash attention, SwiGLU).
+GPT-2's Conv1D layers already store ``[in, out]`` (the nf convention),
+matching ours — those weights copy straight through.
+
+Numerical parity against transformers' canonical implementations is
+asserted in tests/test_hf_compat.py — converted logits match HF to fp32
+tolerance, an end-to-end oracle over both model families' forward math.
 """
 
 from __future__ import annotations
@@ -50,14 +53,7 @@ def convert_llama_state_dict(hf_state_dict, config) -> Dict[str, jnp.ndarray]:
     ``config`` is our ``LlamaConfig`` (head counts drive the rope
     un-permutation).  Accepts torch tensors or numpy arrays."""
     sd = {k: _to_np(v) for k, v in hf_state_dict.items()}
-    # a checkpoint deeper than the config would be silently truncated —
-    # catch the mismatch instead of producing a garbage model
-    stray = [k for k in sd
-             if k.startswith(f"model.layers.{config.num_hidden_layers}.")]
-    if stray:
-        raise ValueError(
-            f"checkpoint has more layers than config.num_hidden_layers="
-            f"{config.num_hidden_layers} (found {stray[0]})")
+    _check_depth(sd, "model.layers", config.num_hidden_layers)
     hd = config.head_dim
     out: Dict[str, jnp.ndarray] = {}
 
@@ -99,10 +95,11 @@ def convert_llama_state_dict(hf_state_dict, config) -> Dict[str, jnp.ndarray]:
     return out
 
 
-def load_hf_llama(model, hf_state_dict) -> None:
-    """Write an HF Llama state_dict into our LlamaForCausalLM in place."""
+def _validate_and_load(model, params) -> None:
+    """Key/shape validation + dtype cast + in-place load (shared by every
+    importer).  Casting matters: a bf16-configured model must not silently
+    end up with the checkpoint's fp32 buffers."""
     from . import load_params
-    params = convert_llama_state_dict(hf_state_dict, model.config)
     named = dict(model.named_parameters())
     missing = sorted(set(named) - set(params))
     extra = sorted(set(params) - set(named))
@@ -114,7 +111,57 @@ def load_hf_llama(model, hf_state_dict) -> None:
             raise ValueError(
                 f"{name}: shape {tuple(arr.shape)} != expected "
                 f"{tuple(named[name].shape)}")
-        # cast to the model's parameter dtype (a bf16-configured model must
-        # not silently end up with the checkpoint's fp32 buffers)
         params[name] = arr.astype(named[name]._data.dtype)
     load_params(model, params)
+
+
+def _check_depth(sd, prefix, num_layers) -> None:
+    """A checkpoint deeper than the config would silently truncate."""
+    stray = [k for k in sd if k.startswith(f"{prefix}.{num_layers}.")]
+    if stray:
+        raise ValueError(
+            f"checkpoint has more layers than config.num_hidden_layers="
+            f"{num_layers} (found {stray[0]})")
+
+
+def load_hf_llama(model, hf_state_dict) -> None:
+    """Write an HF Llama state_dict into our LlamaForCausalLM in place."""
+    _validate_and_load(model,
+                       convert_llama_state_dict(hf_state_dict, model.config))
+
+
+def convert_gpt2_state_dict(hf_state_dict, config) -> Dict[str, jnp.ndarray]:
+    """HF transformers GPT-2 state_dict -> {our param name: array}.
+
+    HF GPT-2 uses Conv1D layers that already store ``[in, out]`` (the nf
+    convention), matching our layout — weights copy straight through."""
+    sd = {k: _to_np(v) for k, v in hf_state_dict.items()}
+    sd = {k[len("transformer."):] if k.startswith("transformer.") else k: v
+          for k, v in sd.items()}
+    _check_depth(sd, "h", config.num_hidden_layers)
+    out: Dict[str, jnp.ndarray] = {}
+    out["gpt.wte"] = jnp.asarray(sd["wte.weight"])
+    out["gpt.wpe"] = jnp.asarray(sd["wpe.weight"])
+    out["gpt.ln_f.weight"] = jnp.asarray(sd["ln_f.weight"])
+    out["gpt.ln_f.bias"] = jnp.asarray(sd["ln_f.bias"])
+    for i in range(config.num_hidden_layers):
+        for ours, hf in (("ln_1.weight", "ln_1.weight"),
+                         ("ln_1.bias", "ln_1.bias"),
+                         ("ln_2.weight", "ln_2.weight"),
+                         ("ln_2.bias", "ln_2.bias"),
+                         ("qkv.weight", "attn.c_attn.weight"),
+                         ("qkv.bias", "attn.c_attn.bias"),
+                         ("proj.weight", "attn.c_proj.weight"),
+                         ("proj.bias", "attn.c_proj.bias"),
+                         ("fc_in.weight", "mlp.c_fc.weight"),
+                         ("fc_in.bias", "mlp.c_fc.bias"),
+                         ("fc_out.weight", "mlp.c_proj.weight"),
+                         ("fc_out.bias", "mlp.c_proj.bias")):
+            out[f"gpt.h.{i}.{ours}"] = jnp.asarray(sd[f"h.{i}.{hf}"])
+    return out
+
+
+def load_hf_gpt2(model, hf_state_dict) -> None:
+    """Write an HF GPT-2 state_dict into our GPTForCausalLM in place."""
+    _validate_and_load(model,
+                       convert_gpt2_state_dict(hf_state_dict, model.config))

@@ -108,3 +108,22 @@ def test_tied_embeddings_and_depth_guard(rng):
     m1 = LlamaForCausalLM(shallow)
     with pytest.raises(ValueError, match="more layers"):
         load_hf_llama(m1, hf.state_dict())
+
+
+def test_gpt2_logits_match_transformers(rng):
+    from transformers import GPT2Config, GPT2LMHeadModel
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.utils.hf_compat import load_hf_gpt2
+    torch.manual_seed(0)
+    hf = GPT2LMHeadModel(GPT2Config(vocab_size=128, n_positions=64,
+                                    n_embd=48, n_layer=2, n_head=4,
+                                    n_inner=96)).eval()
+    paddle.seed(0)
+    ours = GPTForCausalLM(GPTConfig.tiny())
+    load_hf_gpt2(ours, hf.state_dict())
+    ids = rng.integers(0, 128, (2, 12)).astype("int64")
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    out = ours(paddle.to_tensor(ids.astype("int32")))
+    got = np.asarray(out[0]._data if isinstance(out, tuple) else out._data)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
