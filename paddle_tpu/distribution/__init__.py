@@ -248,9 +248,15 @@ class Dirichlet(Distribution):
 
 
 def kl_divergence(p: Distribution, q: Distribution):
-    """reference: python/paddle/distribution/kl.py dispatch."""
-    if hasattr(p, "kl_divergence") and type(p) is type(q):
-        return p.kl_divergence(q)
+    """reference: python/paddle/distribution/kl.py dispatch — an explicit
+    register_kl entry wins (so users can override), then a kl_divergence
+    method on the distribution."""
+    if type(p) is type(q):
+        fn = _KL_REGISTRY.get(type(p))
+        if fn is not None:
+            return fn(p, q)
+        if hasattr(p, "kl_divergence"):
+            return p.kl_divergence(q)
     raise NotImplementedError(
         f"kl_divergence not registered for ({type(p).__name__}, {type(q).__name__})")
 
@@ -859,3 +865,90 @@ from .transform import (  # noqa: E402,F401
     SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
     Transform, TransformedDistribution,
 )
+
+
+# ---- KL registry (reference kl.py REGISTER_KL formulas) ----
+
+def _kl_bernoulli(p, q):
+    eps = 1e-8
+    a, b = p.probs_, q.probs_
+    return Tensor(a * (jnp.log(a + eps) - jnp.log(b + eps))
+                  + (1 - a) * (jnp.log(1 - a + eps) - jnp.log(1 - b + eps)))
+
+
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1.0)
+
+
+def _kl_uniform(p, q):
+    inside = jnp.logical_and(q.low <= p.low, p.high <= q.high)
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    return Tensor(jnp.where(inside, kl, jnp.inf))
+
+
+def _kl_beta(p, q):
+    from jax.scipy.special import betaln, digamma
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    t = betaln(a2, b2) - betaln(a1, b1)
+    return Tensor(t + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                  + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+
+
+def _kl_dirichlet(p, q):
+    from jax.scipy.special import digamma, gammaln
+    a, b = p.concentration, q.concentration
+    a0 = a.sum(-1)
+    t = gammaln(a0) - gammaln(b.sum(-1)) \
+        - (gammaln(a) - gammaln(b)).sum(-1)
+    return Tensor(t + ((a - b) * (digamma(a)
+                                  - digamma(a0)[..., None])).sum(-1))
+
+
+def _kl_geometric(p, q):
+    eps = 1e-8
+    a, b = p.probs_, q.probs_
+    # sum over k>=1 of a(1-a)^(k-1) [log(a/b) + (k-1) log((1-a)/(1-b))]
+    return Tensor(jnp.log(a + eps) - jnp.log(b + eps)
+                  + (1 - a) / a * (jnp.log1p(-a + eps) - jnp.log1p(-b + eps)))
+
+
+def _kl_poisson(p, q):
+    return Tensor(p.rate * (jnp.log(p.rate) - jnp.log(q.rate))
+                  - p.rate + q.rate)
+
+
+def _kl_mvn(p, q):
+    # KL(N(m1, S1) || N(m2, S2)) via the cholesky factors
+    L1, L2 = p._L, q._L
+    d = p.loc.shape[-1]
+    M = jax.scipy.linalg.solve_triangular(L2, L1, lower=True)
+    tr = (M * M).sum((-2, -1))
+    diff = q.loc - p.loc
+    y = jax.scipy.linalg.solve_triangular(L2, diff[..., None],
+                                          lower=True)[..., 0]
+    maha = (y * y).sum(-1)
+    logdet = (jnp.log(jnp.diagonal(L2, axis1=-2, axis2=-1)).sum(-1)
+              - jnp.log(jnp.diagonal(L1, axis1=-2, axis2=-1)).sum(-1))
+    return Tensor(0.5 * (tr + maha - d) + logdet)
+
+
+_KL_REGISTRY = {
+    Bernoulli: _kl_bernoulli,
+    Exponential: _kl_exponential,
+    Uniform: _kl_uniform,
+    Beta: _kl_beta,
+    Dirichlet: _kl_dirichlet,
+    Geometric: _kl_geometric,
+    Poisson: _kl_poisson,
+    MultivariateNormal: _kl_mvn,
+}
+
+
+def register_kl(cls):
+    """Decorator registering a same-type KL formula (reference
+    kl.py register_kl)."""
+    def deco(fn):
+        _KL_REGISTRY[cls] = fn
+        return fn
+    return deco

@@ -271,3 +271,91 @@ def test_chain_transform_mixed_event_rank(rng):
     ld = t.forward_log_det_jacobian(x)
     assert np.asarray(ld._data).shape == (7,)
     assert np.isfinite(np.asarray(ld._data)).all()
+
+
+def test_kl_registry_oracle(rng):
+    cases = [
+        (D.Bernoulli(np.asarray([0.3, 0.7], "float32")),
+         D.Bernoulli(np.asarray([0.5, 0.2], "float32")),
+         td.Bernoulli(torch.tensor([0.3, 0.7])),
+         td.Bernoulli(torch.tensor([0.5, 0.2]))),
+        (D.Exponential(np.asarray([1.0, 3.0], "float32")),
+         D.Exponential(np.asarray([2.0, 1.0], "float32")),
+         td.Exponential(torch.tensor([1.0, 3.0])),
+         td.Exponential(torch.tensor([2.0, 1.0]))),
+        (D.Beta(np.asarray([2.0], "float32"), np.asarray([3.0], "float32")),
+         D.Beta(np.asarray([1.5], "float32"), np.asarray([1.0], "float32")),
+         td.Beta(torch.tensor([2.0]), torch.tensor([3.0])),
+         td.Beta(torch.tensor([1.5]), torch.tensor([1.0]))),
+        (D.Dirichlet(np.asarray([1.0, 2.0, 3.0], "float32")),
+         D.Dirichlet(np.asarray([2.0, 2.0, 2.0], "float32")),
+         td.Dirichlet(torch.tensor([1.0, 2.0, 3.0])),
+         td.Dirichlet(torch.tensor([2.0, 2.0, 2.0]))),
+        (D.Poisson(np.asarray([2.0, 5.0], "float32")),
+         D.Poisson(np.asarray([3.0, 1.0], "float32")),
+         td.Poisson(torch.tensor([2.0, 5.0])),
+         td.Poisson(torch.tensor([3.0, 1.0]))),
+        (D.Geometric(np.asarray([0.4], "float32")),
+         D.Geometric(np.asarray([0.7], "float32")),
+         td.Geometric(torch.tensor([0.4])),
+         td.Geometric(torch.tensor([0.7]))),
+    ]
+    for p, q, tp, tq in cases:
+        got = np.asarray(D.kl_divergence(p, q)._data)
+        want = td.kl_divergence(tp, tq).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                   err_msg=type(p).__name__)
+
+    # uniform: nested support finite, else inf
+    got = np.asarray(D.kl_divergence(
+        D.Uniform(np.float32(0.2), np.float32(0.8)),
+        D.Uniform(np.float32(0.0), np.float32(1.0)))._data)
+    want = float(td.kl_divergence(td.Uniform(0.2, 0.8),
+                                  td.Uniform(0.0, 1.0)))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    assert np.isinf(np.asarray(D.kl_divergence(
+        D.Uniform(np.float32(0.0), np.float32(1.0)),
+        D.Uniform(np.float32(0.2), np.float32(0.8)))._data))
+
+    # multivariate normal
+    locp = np.asarray([0.0, 1.0], "float32")
+    covp = np.asarray([[2.0, 0.3], [0.3, 1.0]], "float32")
+    locq = np.asarray([1.0, 0.0], "float32")
+    covq = np.asarray([[1.0, 0.0], [0.0, 2.0]], "float32")
+    got = np.asarray(D.kl_divergence(
+        D.MultivariateNormal(locp, covariance_matrix=covp),
+        D.MultivariateNormal(locq, covariance_matrix=covq))._data)
+    want = td.kl_divergence(
+        td.MultivariateNormal(torch.tensor(locp), torch.tensor(covp)),
+        td.MultivariateNormal(torch.tensor(locq), torch.tensor(covq))).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    # custom registration hook
+    @D.register_kl(D.Cauchy)
+    def _kl_cauchy(p, q):
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        # closed form: log((s1+s2)^2 + (m1-m2)^2) - log(4 s1 s2)
+        return Tensor(jnp.log((p.scale + q.scale) ** 2
+                              + (p.loc - q.loc) ** 2)
+                      - jnp.log(4 * p.scale * q.scale))
+
+    got = np.asarray(D.kl_divergence(
+        D.Cauchy(np.float32(0.0), np.float32(1.0)),
+        D.Cauchy(np.float32(1.0), np.float32(2.0)))._data)
+    want = td.kl_divergence(td.Cauchy(0.0, 1.0), td.Cauchy(1.0, 2.0)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    D._KL_REGISTRY.pop(D.Cauchy)
+
+    # an explicit registration overrides a method-backed class
+    @D.register_kl(D.Normal)
+    def _const_kl(p, q):
+        from paddle_tpu.core.tensor import Tensor
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(42.0))
+
+    try:
+        out = D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(1.0, 2.0))
+        assert float(out._data) == 42.0
+    finally:
+        D._KL_REGISTRY.pop(D.Normal)
