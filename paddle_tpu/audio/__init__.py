@@ -199,3 +199,9 @@ class features:
         def __call__(self, x):
             lm = self.logmel(x)._data                  # [..., n_mels, T]
             return Tensor(jnp.einsum("cm,...mt->...ct", self.dct, lm))
+
+
+from . import backends  # noqa: E402,F401
+load = backends.load
+save = backends.save
+info = backends.info

@@ -1,0 +1,102 @@
+"""paddle.audio.backends (reference: python/paddle/audio/backends/ —
+wave_backend.py load/save/info over the soundfile/wave libraries).
+
+Zero-dependency WAV I/O via the stdlib ``wave`` module: 16/32-bit PCM read
+and 16-bit PCM write, returning/accepting Tensors shaped [channels, frames]
+(channels_first, the reference default)."""
+
+from __future__ import annotations
+
+import wave as _wave
+from dataclasses import dataclass
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def info(filepath: str) -> AudioInfo:
+    with _wave.open(filepath, "rb") as f:
+        width = f.getsampwidth()
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=width * 8,
+                         encoding="PCM_U" if width == 1 else "PCM_S")
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """-> (waveform Tensor, sample_rate).  normalize=True scales PCM to
+    [-1, 1] float32 (reference wave_backend.load semantics)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(min(frame_offset, f.getnframes()))
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(max(n, 0))
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}.get(width)
+    if dtype is None:
+        raise ValueError(f"unsupported sample width {width}")
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if width == 1:                       # 8-bit WAV is unsigned
+        data = data.astype(np.int16) - 128
+    if normalize:
+        # full-scale by the SOURCE width: 8-bit / 128, 16-bit / 32768, ...
+        scale = float(2 ** (8 * width - 1))
+        wavef = data.astype(np.float32) / scale
+    else:
+        wavef = data.astype(np.float32) if width == 1 else data
+    out = wavef.T if channels_first else wavef
+    return Tensor(np.ascontiguousarray(out)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16):
+    """16-bit PCM write; float input is clipped from [-1, 1]."""
+    if bits_per_sample != 16 or encoding != "PCM_16":
+        raise ValueError("only 16-bit PCM writing is supported")
+    arr = np.asarray(src._data if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        arr = arr[None, :] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T                      # -> [frames, channels]
+    if np.issubdtype(arr.dtype, np.floating):
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype(np.int16)
+    elif arr.dtype == np.int16:
+        pass
+    else:
+        raise ValueError(
+            f"save() takes float waveforms in [-1, 1] or int16 PCM; got "
+            f"{arr.dtype} (rescale or cast explicitly first)")
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(arr).tobytes())
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend():
+    return "wave"
+
+
+def set_backend(backend_name: str):
+    if backend_name not in ("wave",):
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable; only the stdlib 'wave' "
+            "backend ships (zero-egress environment)")

@@ -266,3 +266,45 @@ def test_hub_local_source(tmp_path):
     import pytest as _pytest
     with _pytest.raises(NotImplementedError):
         hub.load(d, "tiny_mlp", source="github")
+
+
+def test_audio_wav_backend_roundtrip(tmp_path):
+    from paddle_tpu import audio
+    sr = 8000
+    wave_f = (0.5 * np.sin(2 * np.pi * 440 *
+                           np.arange(sr // 4) / sr)).astype("float32")
+    path = str(tmp_path / "tone.wav")
+    audio.save(path, paddle.to_tensor(wave_f), sr)
+    meta = audio.info(path)
+    assert meta.sample_rate == sr and meta.num_channels == 1
+    assert meta.bits_per_sample == 16
+    back, sr2 = audio.load(path)
+    assert sr2 == sr
+    got = np.asarray(back._data)[0]
+    np.testing.assert_allclose(got, wave_f, atol=1.0 / 12000)
+    # stereo + offset/num_frames
+    stereo = np.stack([wave_f, -wave_f])
+    p2 = str(tmp_path / "st.wav")
+    audio.save(p2, paddle.to_tensor(stereo), sr)
+    part, _ = audio.load(p2, frame_offset=100, num_frames=50)
+    assert tuple(part.shape) == (2, 50)
+    np.testing.assert_allclose(np.asarray(part._data)[0],
+                               wave_f[100:150], atol=1.0 / 12000)
+    assert audio.backends.list_available_backends() == ["wave"]
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        audio.backends.set_backend("soundfile")
+    # int32 input without explicit conversion is rejected, not wrapped
+    with _pytest.raises(ValueError):
+        audio.save(str(tmp_path / "bad.wav"),
+                   paddle.to_tensor(np.asarray([1, 2], "int32")), sr)
+    # 8-bit files normalize by their own width (full scale ~ 1.0)
+    import wave as _w
+    p8 = str(tmp_path / "u8.wav")
+    with _w.open(p8, "wb") as f:
+        f.setnchannels(1); f.setsampwidth(1); f.setframerate(sr)
+        f.writeframes(np.asarray([255, 128, 0], "uint8").tobytes())
+    w8, _sr = audio.load(p8)
+    got8 = np.asarray(w8._data)[0]
+    np.testing.assert_allclose(got8, [127 / 128, 0.0, -1.0], atol=1e-6)
+    assert audio.info(p8).encoding == "PCM_U"
