@@ -8,12 +8,27 @@ the reference repo publishes no numbers of its own — see BASELINE.md).
 MFU accounting per BASELINE.md: 6*N*T flops/token, reported both without
 ("mfu") and with ("mfu_incl_remat") the 2*N recompute-forward credit.
 
-The bench is memory-aware and un-crashable: it walks a ladder of configs
-(bf16 AdamW moments first, then smaller batch, then a smaller model) and
-ALWAYS emits the JSON line — on total failure the line carries the error.
+The bench is un-killable by design (round-3 lesson: the TPU plugin's backend
+init raised/hung inside ``jax.devices()`` before any bench code ran, and the
+round lost its perf number):
+
+- The default invocation is a PARENT that never imports jax. It probes the
+  backend in a SUBPROCESS with a hard timeout, retries init with backoff
+  (alternating JAX_PLATFORMS pinning), runs the measured ladder in a child
+  with its own timeout, falls back to a CPU smoke run when the TPU cannot be
+  initialized, and on total failure still emits a diagnostic JSON line.
+- ``bench.py --probe`` / ``--child`` are the subprocess entry points.
+
+The measured ladder itself is memory-aware: it walks configs (bf16 AdamW
+moments first, then smaller batch, then a smaller model) so an OOM degrades
+instead of dying. A second, larger model (~1.7B — the most AdamW-trainable
+size on a single 16G chip) is reported alongside the 940M flagship as
+``large_*`` keys.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 import traceback
@@ -300,7 +315,120 @@ def _run_dit(on_tpu):
     }
 
 
-def main():
+def _run_large(on_tpu):
+    """A larger dense model (~1.7B) alongside the 940M flagship — BASELINE's
+    north star is 13B-class, so show MFU holds as the model grows. ~1.7B is
+    the AdamW-trainable ceiling on one 16G chip (bf16 p/g/m/v = 8 bytes per
+    param => 13.4G before activations); beyond that needs the mesh."""
+    import time as _t
+
+    import jax
+    import numpy as np
+
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models.pretrain import ParallelConfig, PretrainStep
+
+    if not on_tpu:
+        return {}  # meaningless on CPU smoke
+    base = dict(vocab_size=32000, hidden_size=2560, intermediate_size=6912,
+                num_attention_heads=20, num_key_value_heads=4,
+                max_position_embeddings=2048, dtype="bfloat16")
+    out = {}
+    # mini memory ladder: layers 22 (~1.67B) -> 18 (~1.4B), batch 4 -> 2
+    for layers, batch in ((22, 4), (22, 2), (18, 2)):
+        try:
+            cfg = LlamaConfig(num_hidden_layers=layers, **base)
+            pc = ParallelConfig(remat=True, loss_chunks=16,
+                                m_dtype="bfloat16", v_dtype="bfloat16")
+            ps = PretrainStep(cfg, pc)
+            state = ps.init_state(seed=0)
+            rng = np.random.default_rng(0)
+            seq, steps = 2048, 8
+            ids, labels = ps.shard_batch(
+                rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
+                rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+            state, loss = ps.train_step(state, ids, labels)
+            jax.block_until_ready(loss)
+            t0 = _t.perf_counter()
+            for _ in range(steps):
+                state, loss = ps.train_step(state, ids, labels)
+            jax.block_until_ready(loss)
+            dt = _t.perf_counter() - t0
+            tok_per_sec = batch * seq * steps / dt
+            peak = _peak_flops(jax.devices()[0])
+            out = {
+                "large_tok_per_sec": round(tok_per_sec, 1),
+                "large_mfu": round(
+                    tok_per_sec * ps.flops_per_token(False) / peak, 4),
+                "large_params": cfg.num_params(),
+                "large_batch": batch,
+                "large_loss": round(float(loss), 4),
+            }
+            break
+        except Exception as e:
+            out = {"large_error": f"{type(e).__name__}: {str(e)[:150]}"}
+            traceback.print_exc(file=sys.stderr)
+    return out
+
+
+def _force_cpu_if_asked():
+    """Env alone is not enough: a site plugin may import jax first and set
+    jax_platforms through the config system, so the env var is ignored.
+    Re-pin through the config API (same trick as tests/conftest.py)."""
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _run_flash_autotune(on_tpu):
+    """Pallas flash-attention block autotune delta (VERDICT r3 item 6):
+    default (512,512) tiling vs the measured winner from the persistent
+    cache, fwd wall-time on a training-shaped attention."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.kernels.flash_attention import _fa_pallas_forward
+
+    if not on_tpu:
+        return {}
+    b, s, h, d = 4, 2048, 16, 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+
+    def run(blocks):
+        fn = jax.jit(lambda a, b_, c: _fa_pallas_forward(
+            a, b_, c, True, None, None, None, blocks, "tpu")[0])
+        jax.block_until_ready(fn(q, k, v))
+        t0 = _t.perf_counter()
+        for _ in range(20):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        return (_t.perf_counter() - t0) / 20 * 1e3
+
+    default = (512, 512)
+    t_def = run(default)
+    # the kernel's own tuner owns key format + candidate rules; reuse it so
+    # the bench can never desynchronize from the production path
+    from paddle_tpu.kernels.flash_attention import _tuned_blocks
+    tuned = _tuned_blocks(q, k, True, None, None, default)
+    t_tuned = run(tuple(tuned))
+    return {
+        "fa_default_ms": round(t_def, 3),
+        "fa_tuned_ms": round(t_tuned, 3),
+        "fa_tuned_blocks": list(tuned),
+        "fa_speedup": round(t_def / t_tuned, 3),
+    }
+
+
+def _child_main():
+    """Measured ladder. Runs inside a parent-supervised subprocess."""
+    _force_cpu_if_asked()
     import jax
 
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -318,26 +446,17 @@ def main():
             result = _run_config(mk, batch, seq, steps, on_tpu)
             if i > 0:
                 result["degraded"] = i  # ran a fallback rung, not the flagship
-            try:
-                result.update(_run_decode(on_tpu))
-            except Exception as e:
-                result["decode_error"] = f"{type(e).__name__}: {str(e)[:150]}"
-                traceback.print_exc(file=sys.stderr)
-            try:
-                result.update(_run_moe(on_tpu))
-            except Exception as e:
-                result["moe_error"] = f"{type(e).__name__}: {str(e)[:150]}"
-                traceback.print_exc(file=sys.stderr)
-            try:
-                result.update(_run_gpt2_compiled_vs_eager(on_tpu))
-            except Exception as e:
-                result["gpt2_error"] = f"{type(e).__name__}: {str(e)[:150]}"
-                traceback.print_exc(file=sys.stderr)
-            try:
-                result.update(_run_dit(on_tpu))
-            except Exception as e:
-                result["dit_error"] = f"{type(e).__name__}: {str(e)[:150]}"
-                traceback.print_exc(file=sys.stderr)
+            for name, fn in (("large", _run_large), ("decode", _run_decode),
+                             ("moe", _run_moe),
+                             ("gpt2", _run_gpt2_compiled_vs_eager),
+                             ("dit", _run_dit),
+                             ("flash", _run_flash_autotune)):
+                try:
+                    result.update(fn(on_tpu))
+                except Exception as e:
+                    result[f"{name}_error"] = (
+                        f"{type(e).__name__}: {str(e)[:150]}")
+                    traceback.print_exc(file=sys.stderr)
             print(json.dumps(result))
             return 0
         except Exception as e:  # OOM or anything else: degrade, never die
@@ -350,6 +469,113 @@ def main():
         "error": "; ".join(errors),
     }))
     return 0
+
+
+def _probe_main():
+    """Print the backend platform; exits nonzero on init failure."""
+    _force_cpu_if_asked()
+    import jax
+
+    d = jax.devices()[0]
+    print(f"PROBE_OK {d.platform} {getattr(d, 'device_kind', '?')}")
+    return 0
+
+
+# ---------------------------------------------------------------- parent ---
+
+def _spawn(argv, env, timeout):
+    """Run a child with a hard timeout; return (rc, stdout, stderr_tail)."""
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)] + argv,
+                           env=env, capture_output=True, text=True,
+                           timeout=timeout)
+        return r.returncode, r.stdout, r.stderr[-2000:]
+    except subprocess.TimeoutExpired as e:
+        err = (e.stderr or b"")
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        return -9, "", f"timeout after {timeout}s; stderr tail: {err[-1500:]}"
+    except Exception as e:  # spawn itself failed
+        return -1, "", f"{type(e).__name__}: {e}"
+
+
+def _extract_json(stdout):
+    """Last stdout line that parses as the bench JSON dict, else None."""
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return obj
+    return None
+
+
+def _parent_main():
+    """Supervise probe + measured child runs; ALWAYS emit one JSON line."""
+    diag = []
+
+    # 1) probe backend init in a throwaway subprocess (it can hang inside
+    #    PJRT client creation — round 3 lost its number exactly there)
+    platform = None
+    probe_plans = [300, 300, 360]  # three tries, ambient env (TPU plugin)
+    for i, tmo in enumerate(probe_plans):
+        env = dict(os.environ)
+        rc, out, err = _spawn(["--probe"], env, tmo)
+        ok = rc == 0 and "PROBE_OK" in out
+        if ok:
+            platform = out.split("PROBE_OK", 1)[1].split()[0]
+            probe_env = env
+            break
+        diag.append(f"probe[{i}] rc={rc}: {err[-300:]}")
+        time.sleep(10 + 10 * i)
+
+    # 2) measured run on the probed backend (2 attempts), with its own timeout
+    if platform is not None:
+        tmo = 2700 if platform == "tpu" else 1500
+        for i in range(2):
+            rc, out, err = _spawn(["--child"], probe_env, tmo)
+            result = _extract_json(out)
+            if result is not None:
+                if diag:
+                    result["bench_diag"] = "; ".join(diag)[:1000]
+                print(json.dumps(result))
+                return 0
+            diag.append(f"child[{i}] rc={rc}: {err[-400:]}")
+            time.sleep(15)
+
+    # 3) TPU unusable: CPU smoke fallback so the round still has a number
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_FORCE_CPU"] = "1"
+    for i in range(2):
+        rc, out, err = _spawn(["--child"], env, 1500)
+        result = _extract_json(out)
+        if result is not None:
+            result["bench_diag"] = ("tpu-unavailable, cpu fallback; " +
+                                    "; ".join(diag))[:1000]
+            print(json.dumps(result))
+            return 0
+        diag.append(f"cpu-child[{i}] rc={rc}: {err[-400:]}")
+
+    # 4) total failure: still one parseable line
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+        "error": "; ".join(diag)[:2000],
+    }))
+    return 0
+
+
+def main():
+    if "--probe" in sys.argv:
+        return _probe_main()
+    if "--child" in sys.argv:
+        return _child_main()
+    return _parent_main()
 
 
 if __name__ == "__main__":
