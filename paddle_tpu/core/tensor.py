@@ -23,6 +23,12 @@ from . import autograd
 
 _tensor_count = [0]
 
+# Graph-break interception stack for jit SOT mode (see jit/_sot.py).  Scope
+# objects expose ``scalar(kind, array)`` and ``traced_repr(array)``.  Kept as
+# a plain module-global list so the scalar-dunder fast path (no jit involved,
+# the common case) pays a single truthiness check.
+_BREAK_SCOPE: List[Any] = []
+
 
 class Tensor:
     __slots__ = (
@@ -97,6 +103,8 @@ class Tensor:
         return np.asarray(self._data)
 
     def item(self, *args):
+        if _BREAK_SCOPE and not args:
+            return _BREAK_SCOPE[-1].scalar("item", self._data)
         return self._data.item(*args)
 
     def tolist(self):
@@ -135,6 +143,9 @@ class Tensor:
 
     def is_contiguous(self) -> bool:
         return True            # XLA arrays are always dense
+
+    def is_selected_rows(self) -> bool:
+        return False           # row-sparse grads override (selected_rows.py)
 
     def contiguous(self) -> "Tensor":
         return self
@@ -176,7 +187,16 @@ class Tensor:
     def set_value(self, value):
         if isinstance(value, Tensor):
             value = value._data
-        self._data = jnp.asarray(value, dtype=self._data.dtype).reshape(self._data.shape)
+        # Copy semantics (reference TensorCopy): ``jnp.asarray`` would alias
+        # the source array, and an alias dies when the source buffer is later
+        # DONATED (the optimizer's in-place update path donates param
+        # buffers) — so a shared-buffer set_value would leave this tensor
+        # pointing at deleted storage.
+        if isinstance(value, jax.Array) and not isinstance(value, jax.core.Tracer):
+            value = jnp.array(value, dtype=self._data.dtype, copy=True)
+        else:
+            value = jnp.asarray(value, dtype=self._data.dtype)
+        self._data = value.reshape(self._data.shape)
         return self
 
     def copy_(self, other, blocking=True):
@@ -204,21 +224,32 @@ class Tensor:
             yield self[i]
 
     def __bool__(self):
+        if _BREAK_SCOPE:
+            return _BREAK_SCOPE[-1].scalar("bool", self._data)
         return bool(self._data)
 
     def __float__(self):
+        if _BREAK_SCOPE:
+            return _BREAK_SCOPE[-1].scalar("float", self._data)
         return float(self._data)
 
     def __int__(self):
+        if _BREAK_SCOPE:
+            return _BREAK_SCOPE[-1].scalar("int", self._data)
         return int(self._data)
 
     def __index__(self):
+        if _BREAK_SCOPE:
+            return _BREAK_SCOPE[-1].scalar("int", self._data)
         return int(self._data)
 
     def __hash__(self):
         return id(self)
 
     def __repr__(self):
+        if _BREAK_SCOPE and _BREAK_SCOPE[-1].traced_repr(self._data):
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                    "<printed at run time>)")
         grad_info = "" if self.stop_gradient else ", stop_gradient=False"
         try:
             data = np.asarray(self._data)

@@ -8,6 +8,12 @@ dtype/static-arg cache key; training works by treating the whole compiled
 program as ONE tape node (``jax.vjp`` of the jitted function gives a compiled
 forward and a compiled backward — the PartialProgramLayer fwd/bwd pair).
 
+The SOT graph-break story (sot/translate.py's bytecode fallback) is redone
+TPU-first in ``_sot.py``: instead of splitting the program at breaks (each
+boundary a host sync), one fused XLA program is kept per observed
+break-value pattern, guarded by break-value probes verified after each run,
+with eager as the always-correct fallback.
+
 jit.save/load use jax.export (StableHLO serialization) — the deployment
 artifact the reference produces as an inference ProgramDesc.
 """
@@ -24,6 +30,7 @@ import numpy as np
 from ..core import autograd as _engine
 from ..core.random import next_key, trace_key_scope
 from ..core.tensor import Parameter, Tensor
+from . import _sot
 
 __all__ = ["to_static", "not_to_static", "enable_to_static", "InputSpec",
            "StaticFunction", "TranslatedLayer", "save", "load"]
@@ -95,10 +102,19 @@ def _static_repr(spec):
 
 
 class StaticFunction:
-    """Guard-cached compiled callable (reference program_translator.py:377)."""
+    """Guard-cached compiled callable (reference program_translator.py:377).
+
+    Per (shape/dtype/static-arg) guard key the function is in one of three
+    modes, degrading only as the code demands (the SOT story, _sot.py):
+
+    - ``whole``: one jax.jit program — the strict dy2static path.
+    - ``sot``:   the trace graph-broke; per break-value pattern a specialized
+                 program runs with guard probes verified after each call.
+    - ``eager``: unsupported construct or pattern explosion; plain eager.
+    """
 
     def __init__(self, function, input_spec=None, build_strategy=None,
-                 full_graph=True):
+                 full_graph=False):
         from ..nn.layer import Layer
 
         self._layer: Optional[Layer] = None
@@ -112,6 +128,7 @@ class StaticFunction:
                 self._layer = None
         self._input_spec = input_spec
         self.build_strategy = build_strategy
+        self._full_graph = full_graph
         self._cache: dict = {}
         self.__name__ = getattr(self._fn, "__name__", "static_fn")
 
@@ -127,12 +144,16 @@ class StaticFunction:
         return params, buffers
 
     def _make_pure(self, spec, n_params, n_buffers, n_inputs, param_objs,
-                   buffer_objs):
+                   buffer_objs, pattern=None):
         """Build prim(*arrays) running the python fn over tracer-backed state.
 
         Array order: params, buffers, key, inputs.  Returns
-        (outputs..., new_buffer_values...); buffer mutation during the trace is
-        captured functionally (the BN running-stats problem of SURVEY §7.4.1).
+        (outputs..., new_buffer_values..., aux_break_probes...); buffer
+        mutation during the trace is captured functionally (the BN
+        running-stats problem of SURVEY §7.4.1).  With ``pattern`` the trace
+        replays journaled break values and emits each traced break value as a
+        float32 guard probe (float32 so the tape's zero-cotangent fill stays
+        a valid vjp tangent; exact for bools and ints < 2**24).
         """
         fn = self._fn
 
@@ -143,15 +164,22 @@ class StaticFunction:
             in_arr = arrays[n_params + n_buffers + 1:]
             saved_p = [t._data for t in param_objs]
             saved_b = [t._data for t in buffer_objs]
+            scope = None if pattern is None else _sot.ReplayScope(pattern)
             try:
                 for t, a in zip(param_objs, p_arr):
                     t._data = a
                 for t, a in zip(buffer_objs, b_arr):
                     t._data = a
-                with trace_key_scope(key):
-                    with _engine.no_grad():
-                        call_args, call_kwargs = _unflatten(spec, list(in_arr))
-                        out = fn(*call_args, **call_kwargs)
+                if scope is not None:
+                    _sot.push(scope)
+                try:
+                    with trace_key_scope(key):
+                        with _engine.no_grad():
+                            call_args, call_kwargs = _unflatten(spec, list(in_arr))
+                            out = fn(*call_args, **call_kwargs)
+                finally:
+                    if scope is not None:
+                        _sot.pop()
                 out_arrays: List = []
                 self._out_spec = _flatten_out(out, out_arrays)
                 new_b = [t._data for t in buffer_objs]
@@ -160,7 +188,14 @@ class StaticFunction:
                     t._data = a
                 for t, a in zip(buffer_objs, saved_b):
                     t._data = a
-            return tuple(out_arrays) + tuple(new_b)
+            if scope is not None:
+                # discovered at trace time, read back by __call__ (the same
+                # side-channel as _out_spec): which journal entries actually
+                # emitted guard probes — concrete-under-trace sites do not
+                self._probes = tuple(scope.probes)
+            aux = () if scope is None else tuple(
+                jnp.asarray(a, jnp.float32) for a in scope.aux)
+            return tuple(out_arrays) + tuple(new_b) + aux
 
         return prim
 
@@ -181,25 +216,97 @@ class StaticFunction:
         )
         entry = self._cache.get(guard)
         if entry is None:
-            prim = self._make_pure(spec, len(params), len(buffers), len(tensors),
-                                   params, buffers)
-            entry = {"prim": prim, "jit": jax.jit(prim), "out_spec": None}
+            entry = {"mode": "whole", "jit": None, "out_spec": None,
+                     "specs": {}, "mru": None}
             self._cache[guard] = entry
+
+        if entry["mode"] == "eager":
+            return self._fn(*args, **kwargs)
 
         key = jax.random.key_data(next_key())
         all_inputs = list(params) + list(buffers) + [Tensor(key)] + tensors
-        flat = _engine.apply(self.__name__, entry["jit"], all_inputs)
-        if not isinstance(flat, tuple):
-            flat = (flat,)
-        if entry["out_spec"] is None:
-            entry["out_spec"] = self._out_spec
-        out_spec = entry["out_spec"]
-        n_out = _count_slots(out_spec)
-        out_tensors = flat[:n_out]
-        new_buffers = flat[n_out:]
-        for b, nb in zip(buffers, new_buffers):
+
+        if entry["mode"] == "whole":
+            if entry["jit"] is None:
+                prim = self._make_pure(spec, len(params), len(buffers),
+                                       len(tensors), params, buffers)
+                entry["jit"] = jax.jit(prim)
+            try:
+                flat = _engine.apply(self.__name__, entry["jit"], all_inputs)
+            except _sot.BREAK_ERRORS:
+                if self._full_graph:
+                    raise
+                entry["mode"] = "sot"  # graph-breaks: specialize below
+                entry["jit"] = None
+            else:
+                if not isinstance(flat, tuple):
+                    flat = (flat,)
+                if entry["out_spec"] is None:
+                    entry["out_spec"] = self._out_spec
+                return self._commit(entry["out_spec"], flat, buffers, 0)
+
+        # ---- SOT mode: try the hot specialization, verify its guards ----
+        if entry["mru"] is not None:
+            srec = entry["specs"][entry["mru"]]
+            try:
+                flat = _engine.apply(self.__name__, srec["jit"], all_inputs)
+            except _sot.BREAK_ERRORS + (_sot.GraphBreakUnsupported,):
+                self._degrade(entry)
+                return self._fn(*args, **kwargs)
+            if not isinstance(flat, tuple):
+                flat = (flat,)
+            if srec["out_spec"] is None:
+                srec["out_spec"] = self._out_spec
+                srec["probes"] = self._probes
+            n_aux = len(srec["probes"])
+            aux = flat[len(flat) - n_aux:] if n_aux else ()
+            if _sot.aux_guard_ok(aux, srec["probes"]):
+                return self._commit(srec["out_spec"], flat, buffers, n_aux)
+            # guard miss: discard the speculative run, take the eager path
+
+        # ---- eager journal run (always correct), then specialize --------
+        rec = _sot.RecordScope()
+        _sot.push(rec)
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            _sot.pop()
+        pattern = tuple(rec.journal)
+        if pattern in entry["specs"]:
+            entry["mru"] = pattern
+        elif len(entry["specs"]) >= _sot._MAX_SPECS:
+            self._degrade(entry)
+        else:
+            prim = self._make_pure(spec, len(params), len(buffers),
+                                   len(tensors), params, buffers,
+                                   pattern=pattern)
+            entry["specs"][pattern] = {"jit": jax.jit(prim),
+                                       "pattern": pattern, "out_spec": None,
+                                       "probes": None}
+            entry["mru"] = pattern
+        return out
+
+    def _commit(self, out_spec, flat, buffers, n_aux):
+        """Split (outs..., new_buffers..., aux...) and commit buffer state."""
+        hi = len(flat) - n_aux
+        n_b = len(buffers)
+        out_tensors = flat[:hi - n_b]
+        for b, nb in zip(buffers, flat[hi - n_b:hi]):
             b._data = nb._data
         return _unflatten_out(out_spec, list(out_tensors))
+
+    def _degrade(self, entry):
+        import warnings
+
+        entry["mode"] = "eager"
+        entry["specs"].clear()
+        entry["mru"] = None
+        warnings.warn(
+            f"to_static({self.__name__}): falling back to eager — the "
+            "function graph-breaks in a way that cannot be specialized "
+            "(unsupported construct under trace, or more than "
+            f"{_sot._MAX_SPECS} distinct break-value patterns)",
+            RuntimeWarning, stacklevel=3)
 
     # -- introspection ---------------------------------------------------
     @property
@@ -246,13 +353,20 @@ def _unflatten_out(spec, tensors):
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, **kwargs):
+              backend=None, full_graph=False, **kwargs):
     """Compile a function/Layer for whole-program XLA execution
-    (reference jit/api.py:196)."""
+    (reference jit/api.py:196).
+
+    ``full_graph=False`` (default, like the reference) allows graph breaks:
+    tensor-dependent Python control flow and prints run via guarded
+    specialization (see ``jit._sot``).  ``full_graph=True`` raises on the
+    first break instead.
+    """
     def decorate(fn):
         from ..nn.layer import Layer
         static = StaticFunction(fn, input_spec=input_spec,
-                                build_strategy=build_strategy)
+                                build_strategy=build_strategy,
+                                full_graph=full_graph)
         if isinstance(fn, Layer):
             fn.forward = static
             return fn

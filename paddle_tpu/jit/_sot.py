@@ -1,0 +1,152 @@
+"""Graph breaks for ``jit.to_static`` — guarded specialization on break values.
+
+The reference handles messy user code in dy2static with SOT bytecode
+translation (python/paddle/jit/sot/translate.py:31): unsupported Python
+(data-dependent branches, prints, scalar conversions) breaks the graph, runs
+eagerly, and capture resumes after the break, with guards on the break points.
+
+TPU-native redesign: splitting the program into per-segment executables is
+the wrong shape for XLA — every boundary is a host sync and a lost fusion.
+Instead we keep ONE fused XLA program per observed *break-value pattern*:
+
+1. Whole-graph trace is attempted first (identical to the strict path).
+2. If the trace hits ``bool()/int()/float()/.item()`` on a traced tensor, the
+   function is switched to SOT mode: it runs EAGERLY once while a
+   ``RecordScope`` journals every break value (the branch actually taken, the
+   scalar actually baked in).
+3. A specialized trace is then compiled with a ``ReplayScope``: each break
+   site returns the journaled concrete value, and the traced tensor feeding
+   it is emitted as an extra scalar OUTPUT of the program.
+4. Later calls run the specialized executable and verify those aux outputs
+   against the journal — the guard on the break points.  On mismatch the call
+   falls back to eager (always-correct path) and compiles a new
+   specialization for the newly observed pattern.
+5. ``print(tensor)`` inside a specialized trace becomes a runtime
+   ``jax.debug.print`` — it fires on every compiled call, like the eager
+   print it replaces.
+
+Unsupported constructs (``.numpy()`` on a traced value, nested breaks inside
+an outer trace) and pattern explosions (> _MAX_SPECS distinct patterns)
+permanently fall back to eager for that (function, guard) — degraded
+performance, never wrong results.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import numpy as np
+
+from ..core import tensor as _tensor_mod
+
+# one compiled specialization per distinct break-value pattern, per guard key
+_MAX_SPECS = 8
+
+# trace-abort exceptions that mean "this function graph-breaks"
+BREAK_ERRORS = (
+    jax.errors.ConcretizationTypeError,     # bool/shape use of a tracer
+    jax.errors.TracerArrayConversionError,  # np.asarray(tracer)
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.TracerBoolConversionError,
+)
+
+
+class GraphBreakUnsupported(RuntimeError):
+    """A break site changed between the eager run and the replay trace
+    (nondeterministic Python), or appeared where it cannot be guarded."""
+
+
+_CAST = {
+    "bool": bool,
+    "int": int,
+    "float": float,
+    "item": lambda a: np.asarray(a).item(),
+}
+
+
+class RecordScope:
+    """Journals break values during an eager run of the function."""
+
+    def __init__(self):
+        self.journal: List[Tuple[str, Any]] = []
+
+    def scalar(self, kind: str, data):
+        v = _CAST[kind](data)  # raises naturally if data is a tracer
+        self.journal.append((kind, v))
+        return v
+
+    def traced_repr(self, data) -> bool:
+        return False  # eager print prints concrete values itself
+
+
+class ReplayScope:
+    """Replays a journal during a specializing trace, collecting the traced
+    break values as aux outputs (the guard probes).
+
+    The journal cursor advances on EVERY scalar() call — including sites
+    whose tensor is concrete under the trace (constant-derived values),
+    which consume their entry but emit no probe (a trace-constant cannot
+    change between calls of the same executable).  ``probes`` records which
+    journal entries actually got probes, so the caller can slice and verify
+    exactly the emitted aux outputs.
+    """
+
+    def __init__(self, pattern: Tuple[Tuple[str, Any], ...]):
+        self.pattern = pattern
+        self.aux: List[Any] = []
+        self.probes: List[Tuple[str, Any]] = []
+        self._i = 0
+
+    def scalar(self, kind: str, data):
+        if self._i >= len(self.pattern):
+            raise GraphBreakUnsupported(
+                "break site appeared during replay that the eager run did "
+                "not record — nondeterministic Python in the traced function")
+        kind_rec, value = self.pattern[self._i]
+        self._i += 1
+        if not isinstance(data, jax.core.Tracer):
+            return _CAST[kind](data)  # trace-constant: no guard needed
+        self.aux.append(data)
+        self.probes.append((kind_rec, value))
+        return value
+
+    def traced_repr(self, data) -> bool:
+        if not isinstance(data, jax.core.Tracer):
+            return False
+        jax.debug.print("Tensor({x})", x=data)
+        return True
+
+
+def push(scope):
+    _tensor_mod._BREAK_SCOPE.append(scope)
+
+
+def pop():
+    _tensor_mod._BREAK_SCOPE.pop()
+
+
+def aux_guard_ok(aux_tensors, pattern) -> bool:
+    """Check compiled-run break values against the journaled pattern.
+
+    Correctness-first: a guard that cannot be verified EXACTLY fails, and
+    failure only costs performance (the call falls back to eager and a fresh
+    specialization).  bool guards are exact.  int guards are exact below
+    2**24 (the float32 probe is exact there) and auto-fail at or above it.
+    float guards allow rtol=1e-6 — fused-vs-eager last-ulp drift only; any
+    real value drift exceeds this and correctly falls back to eager.
+    """
+    for t, (kind, recorded) in zip(aux_tensors, pattern):
+        v = np.asarray(getattr(t, "_data", t)).item()
+        if kind == "bool" or isinstance(recorded, bool):
+            if bool(v) != bool(recorded):
+                return False
+        elif isinstance(recorded, int):
+            if abs(recorded) >= 1 << 24:
+                return False  # beyond exact float32 probes: unverifiable
+            if int(v) != recorded:
+                return False
+        else:
+            if not np.isclose(v, recorded, rtol=1e-6, atol=0.0):
+                return False
+    return True

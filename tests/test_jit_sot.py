@@ -1,0 +1,276 @@
+"""Graph-break (SOT-mode) tests for jit.to_static.
+
+Reference behavior being matched: python/paddle/jit/sot/translate.py:31 —
+dy2static must survive messy user code (data-dependent Python branches,
+prints, scalar conversions) by breaking the graph and falling back, with
+guards on the break points.  Here the TPU-native mechanism is guarded
+specialization (jit/_sot.py): these tests pin the user-visible contract —
+correct results, training end-to-end, and compiled specializations actually
+being used and re-guarded.
+"""
+
+import io
+import warnings
+from contextlib import redirect_stdout
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.jit import to_static
+
+
+def _x(val, shape=(2, 8), seed=0):
+    rng = np.random.default_rng(seed)
+    return P.to_tensor((rng.standard_normal(shape) * 0 + val).astype("float32"))
+
+
+def _rand(shape=(2, 8), seed=0):
+    rng = np.random.default_rng(seed)
+    return P.to_tensor(rng.standard_normal(shape).astype("float32"))
+
+
+class BranchyNet(nn.Layer):
+    """Forward with a data-dependent Python `if` AND a print — the canonical
+    SOT stress case (VERDICT r3 'done' criterion)."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 8)
+        self.alt = nn.Linear(8, 8)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if h.mean() > 0:          # graph break: tensor-dependent branch
+            h = self.alt(h) * 2.0
+        else:
+            h = h - 1.0
+        print(h.mean())           # graph break: print of a tensor
+        return h.sum()
+
+
+class TestGraphBreaks:
+    def test_data_dependent_if_both_branches(self):
+        def f(x):
+            if x.mean() > 0:
+                return x * 2.0
+            return x - 1.0
+
+        sf = to_static(f)
+        pos, neg = _x(1.0), _x(-1.0)
+        # first calls: eager journal; later calls: compiled specialization
+        for _ in range(3):
+            np.testing.assert_allclose(sf(pos).numpy(), f(pos).numpy(),
+                                       rtol=1e-5)
+            np.testing.assert_allclose(sf(neg).numpy(), f(neg).numpy(),
+                                       rtol=1e-5)
+        entry = next(iter(sf._cache.values()))
+        assert entry["mode"] == "sot"
+        assert len(entry["specs"]) == 2  # one per branch pattern
+
+    def test_specialization_is_used_after_warmup(self):
+        calls = []
+
+        def f(x):
+            calls.append(1)
+            if x.sum() > 0:
+                return x + 1.0
+            return x - 1.0
+
+        sf = to_static(f)
+        x = _x(1.0)
+        sf(x)   # whole-trace attempt (py fn runs under trace) + eager journal
+        sf(x)   # compiled specialization path (trace on first jit call)
+        n_before = len(calls)
+        sf(x)   # cache hit: python fn must NOT run again
+        assert len(calls) == n_before
+
+    def test_guard_miss_falls_back_and_respecializes(self):
+        def f(x):
+            if x.sum() > 0:
+                return x * 3.0
+            return x * -5.0
+
+        sf = to_static(f)
+        pos, neg = _x(1.0), _x(-1.0)
+        for _ in range(2):
+            sf(pos)
+        # branch flips: the hot spec's guard fails; eager fallback must be
+        # correct and a second specialization must be built
+        np.testing.assert_allclose(sf(neg).numpy(), (neg * -5.0).numpy(),
+                                   rtol=1e-5)
+        entry = next(iter(sf._cache.values()))
+        assert len(entry["specs"]) == 2
+        # and the new pattern becomes the hot path
+        np.testing.assert_allclose(sf(neg).numpy(), (neg * -5.0).numpy(),
+                                   rtol=1e-5)
+
+    def test_int_conversion_loop(self):
+        def f(x, n):
+            for _ in range(int(n)):   # int() on a tensor: break
+                x = x + 1.0
+            return x
+
+        sf = to_static(f)
+        x = _rand()
+        n3 = P.to_tensor(np.int32(3))
+        n5 = P.to_tensor(np.int32(5))
+        for _ in range(2):
+            np.testing.assert_allclose(sf(x, n3).numpy(), (x + 3.0).numpy(),
+                                       rtol=1e-5)
+            np.testing.assert_allclose(sf(x, n5).numpy(), (x + 5.0).numpy(),
+                                       rtol=1e-5)
+
+    def test_print_inside_forward(self):
+        def f(x):
+            y = x * 2.0
+            print(y)   # must not kill the trace
+            return y.sum()
+
+        sf = to_static(f)
+        x = _rand()
+        for _ in range(3):
+            out = sf(x)
+        np.testing.assert_allclose(out.numpy(), (x * 2.0).sum().numpy(),
+                                   rtol=1e-5)
+
+    def test_full_graph_true_raises(self):
+        @to_static(full_graph=True)
+        def f(x):
+            if x.mean() > 0:
+                return x * 2.0
+            return x
+
+        with pytest.raises(Exception):
+            f(_x(1.0))
+
+    def test_break_free_function_stays_whole_graph(self):
+        @to_static
+        def f(x):
+            return P.tanh(x).sum()
+
+        x = _rand()
+        f(x), f(x)
+        entry = next(iter(f._cache.values()))
+        assert entry["mode"] == "whole"
+
+    def test_unsupported_numpy_degrades_to_eager(self):
+        def f(x):
+            arr = x.numpy()       # not specializable: whole-array guard
+            return x * float(arr.sum() > 0)
+
+        sf = to_static(f)
+        x = _x(1.0)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for _ in range(3):
+                out = sf(x)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-5)
+
+    def test_branchy_layer_trains_end_to_end(self):
+        P.seed(0)
+        net = BranchyNet()
+        ref = BranchyNet()
+        # same weights for the eager reference
+        for (_, p), (_, q) in zip(net.named_parameters(),
+                                  ref.named_parameters()):
+            q.set_value(p)
+        static_net = to_static(net)
+        optimizer = opt.SGD(learning_rate=0.05,
+                            parameters=net.parameters())
+        ref_opt = opt.SGD(learning_rate=0.05, parameters=ref.parameters())
+
+        rng = np.random.default_rng(0)
+        losses, ref_losses = [], []
+        buf = io.StringIO()
+        for step in range(6):
+            x = P.to_tensor(rng.standard_normal((2, 8)).astype("float32"))
+            with redirect_stdout(buf):
+                loss = static_net(x)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            losses.append(float(loss))
+
+            with redirect_stdout(buf):
+                ref_loss = ref(x)
+            ref_loss.backward()
+            ref_opt.step()
+            ref_opt.clear_grad()
+            ref_losses.append(float(ref_loss))
+
+        assert all(np.isfinite(losses))
+        # parity with the eager reference through identical updates
+        np.testing.assert_allclose(losses, ref_losses, rtol=2e-3, atol=2e-5)
+
+    def test_gradients_match_eager(self):
+        P.seed(0)
+        net = BranchyNet()
+        x = _rand(seed=3)
+
+        eager_loss = net(x)
+        eager_loss.backward()
+        eager_grads = [np.asarray(p.grad.numpy()) for p in net.parameters()
+                       if p.grad is not None]
+        net.clear_gradients()
+
+        static_net = to_static(net)
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            for _ in range(3):  # warm into the compiled specialization
+                net.clear_gradients()
+                loss = static_net(x)
+                loss.backward()
+        static_grads = [np.asarray(p.grad.numpy()) for p in net.parameters()
+                        if p.grad is not None]
+        assert len(eager_grads) == len(static_grads)
+        for g0, g1 in zip(eager_grads, static_grads):
+            np.testing.assert_allclose(g0, g1, rtol=1e-4, atol=1e-6)
+
+    def test_concrete_break_site_keeps_journal_in_sync(self):
+        """A bool() on a constant-derived tensor is concrete under the
+        replay trace (no guard probe) while the eager journal records it —
+        the cursor must stay aligned with the input-dependent break that
+        follows, and guard slicing must use the probe count, not the
+        journal length."""
+        c = P.to_tensor(np.float32(2.0))   # captured: concrete under trace
+
+        def f(x):
+            y = x
+            if c:                # bool on a captured concrete tensor:
+                y = y * 2.0      # journal-only site (no guard probe)
+            if y.sum() > 0:      # tracer site: journaled AND guarded
+                y = y + 1.0
+            else:
+                y = y - 5.0
+            return y
+
+        sf = to_static(f)
+        pos, neg = _x(1.0), _x(-1.0)
+        for _ in range(3):
+            np.testing.assert_allclose(sf(pos).numpy(), f(pos).numpy(),
+                                       rtol=1e-6)
+            np.testing.assert_allclose(sf(neg).numpy(), f(neg).numpy(),
+                                       rtol=1e-6)
+        entry = next(iter(sf._cache.values()))
+        assert entry["mode"] == "sot" and len(entry["specs"]) == 2
+        srec = entry["specs"][entry["mru"]]
+        assert len(srec["pattern"]) == 2       # both sites journaled
+        assert len(srec["probes"]) == 1        # only the tracer site guarded
+
+    def test_pattern_explosion_degrades(self):
+        def f(x, n):
+            return x + float(n)   # float() break with ever-new values
+
+        sf = to_static(f)
+        x = _rand()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for i in range(12):
+                v = P.to_tensor(np.float32(i * 1.37))
+                np.testing.assert_allclose(
+                    sf(x, v).numpy(), (x + float(v)).numpy(), rtol=1e-5)
+        entry = next(iter(sf._cache.values()))
+        assert entry["mode"] == "eager"
