@@ -164,6 +164,81 @@ def _check_nan_inf(name, arrays):
 
 
 _jit_cache: dict = {}
+_vjp_cache: dict = {}  # (prim-key, kwargs, diff_idx, arity) -> (fwd, bwd)
+
+
+class _Unkeyable(Exception):
+    pass
+
+
+_VALUE_TYPES = (int, float, bool, str, bytes, type(None), type(Ellipsis))
+
+
+def _cell_key(v, depth):
+    """Hashable *value* identity for a closure cell / default.
+
+    Only immutable value-likes participate: a mutable cell (list, dict,
+    array) could change after the first instance is cached, and an
+    identity-hashed cell (fresh inner function) would just grow the cache
+    per call.  Closure-carrying inner functions recurse into their own key.
+    """
+    if isinstance(v, _VALUE_TYPES):
+        return (type(v).__name__, v)
+    if isinstance(v, type):  # classes/dtype objects: stable module-level ids
+        return ("type", v)
+    if isinstance(v, np.dtype):
+        return ("npdt", str(v))
+    if isinstance(v, (tuple, frozenset)):
+        return ("tup", tuple(_cell_key(x, depth) for x in v))
+    if callable(v):
+        # a module-level callable (jnp.sum, a helper def) is a stable
+        # singleton: identity-keying it cannot grow the cache per call
+        import sys
+        mod = sys.modules.get(getattr(v, "__module__", None))
+        qn = getattr(v, "__qualname__", ".")
+        if mod is not None and "." not in qn and \
+                getattr(mod, qn, None) is v:
+            return ("glob", v)
+        if depth < 3:
+            return _fn_key(v, depth + 1)
+    raise _Unkeyable
+
+
+def _fn_key(fn, depth=0):
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        raise _Unkeyable
+    if getattr(fn, "__self__", None) is not None:
+        # bound method: instances share one code object but carry per-
+        # instance state — keying by code would cross-wire their caches
+        raise _Unkeyable
+    dk = None
+    if fn.__defaults__:
+        dk = tuple(_cell_key(d, depth) for d in fn.__defaults__)
+    kk = None
+    if fn.__kwdefaults__:  # keyword-only defaults (def f(*a, _x=...))
+        kk = tuple((k, _cell_key(v, depth))
+                   for k, v in sorted(fn.__kwdefaults__.items()))
+    ck = None
+    if fn.__closure__:
+        ck = tuple(_cell_key(c.cell_contents, depth) for c in fn.__closure__)
+    return (code, dk, kk, ck)
+
+
+def _prim_key(prim):
+    """Stable cache identity for an op primitive.
+
+    Op sites pass FRESH lambdas every call (``apply_op("linear", lambda ...``)
+    so keying on the function object would never hit and would mint a new
+    jax.jit wrapper per call — worse than no cache.  A function is described
+    by its code object (created once at its definition site) plus the VALUES
+    of its defaults and closure cells; anything not value-keyable falls back
+    to identity, which callers treat as "don't cache".
+    """
+    try:
+        return _fn_key(prim)
+    except (_Unkeyable, ValueError):  # ValueError: empty cell
+        return prim
 
 
 def _hashable(kw: dict):
@@ -209,10 +284,11 @@ def apply(name: str, prim: Callable, tensor_args: Sequence, kwargs: dict | None 
             out = prim(*arrays, **kwargs)
         else:
             hkw = _hashable(kwargs)
-            if hkw is None:
+            pk = _prim_key(prim)
+            if hkw is None or not isinstance(pk, tuple):
                 out = prim(*arrays, **kwargs)
             else:
-                key = (prim, hkw)
+                key = (pk, hkw)
                 fn = _jit_cache.get(key)
                 if fn is None:
                     fn = _jit_cache[key] = jax.jit(partial(prim, **kwargs))
@@ -242,7 +318,58 @@ def apply(name: str, prim: Callable, tensor_args: Sequence, kwargs: dict | None 
             full[i] = d
         return prim(*full, **kwargs)
 
-    out, vjp_fn = jax.vjp(f, *[arrays[i] for i in diff_idx])
+    # Eager dispatch fast path: ``jax.vjp`` RE-TRACES prim on every call —
+    # the per-op overhead the reference's PHI kernel registry exists to kill
+    # (paddle/phi/README.md §1.2).  Cache a jitted forward and a jitted
+    # pullback keyed by (prim, kwargs, diff positions, arity); jax.jit's own
+    # aval cache handles shape/dtype specialization.  The pullback recomputes
+    # the linearization inside jit (rematerialize: one extra fused forward
+    # per backward, traded for never re-tracing in Python).
+    fast = flags.flag("eager_op_jit")
+    if fast:
+        hkw = _hashable(kwargs)
+        pkey = _prim_key(prim)
+        # a shared (code, defaults) key is required: an identity-keyed prim
+        # (closure) would mint a new jit wrapper every call — strictly worse
+        # than the re-traced vjp below
+        if hkw is None or not isinstance(pkey, tuple):
+            fast = False
+    if fast:
+        key = (pkey, hkw, tuple(diff_idx), n_args)
+        cached = _vjp_cache.get(key)
+        if cached is None:
+            didx = tuple(diff_idx)
+
+            def fwd_prim(arrs):
+                return prim(*arrs, **kwargs)
+
+            def bwd_prim(arrs, cots):
+                def f_of_diff(*d):
+                    full = list(arrs)
+                    for i, x in zip(didx, d):
+                        full[i] = x
+                    return prim(*full, **kwargs)
+
+                _, vjp = jax.vjp(f_of_diff, *[arrs[i] for i in didx])
+                return vjp(cots)
+
+            cached = (jax.jit(fwd_prim), jax.jit(bwd_prim))
+            _vjp_cache[key] = cached
+        fwd_jit, bwd_jit = cached
+        try:
+            out = fwd_jit(tuple(arrays))
+        except TypeError:  # non-array static arg snuck through: slow path
+            fast = False
+        else:
+            # The pullback closes over ALL input arrays until backward (the
+            # diff inputs are pinned by node.inputs either way; the delta vs
+            # the slow path's residuals is the non-diff inputs + amp-cast
+            # copies — a bounded constant factor traded for never
+            # re-tracing).  node.release() drops them after backward.
+            arrs_held = tuple(arrays)
+            vjp_fn = lambda cots: bwd_jit(arrs_held, cots)  # noqa: E731
+    if not fast:
+        out, vjp_fn = jax.vjp(f, *[arrays[i] for i in diff_idx])
     single = not isinstance(out, (tuple, list))
     flat = (out,) if single else tuple(out)
     node = GradNode(
@@ -336,7 +463,8 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False):
             if g is None:
                 continue
             for hook in t._hooks:
-                res = hook(_as_tensor(g))
+                from .tensor import Tensor as _T
+                res = hook(g if isinstance(g, _T) else _as_tensor(g))
                 if res is not None:
                     g = res._data if isinstance(res, Tensor) else res
             if t._node is not None and t._node.vjp_fn is not None:
@@ -357,12 +485,22 @@ def _as_tensor(arr):
 
 
 def _accumulate_leaf(t, g):
+    from .selected_rows import SelectedRowsTensor, add_sparse
     from .tensor import Tensor
     if t.stop_gradient and not t._retain_grad:
+        return
+    if isinstance(g, SelectedRowsTensor):
+        if t.grad is None:
+            t.grad = g
+        elif isinstance(t.grad, SelectedRowsTensor):
+            t.grad = add_sparse(t.grad, g)
+        else:  # mixing with a dense grad: densify (correct, loses sparsity)
+            t.grad = Tensor(t.grad._data + g._data, stop_gradient=True)
         return
     if t.grad is None:
         t.grad = Tensor(g, stop_gradient=True)
     else:
+        # a SelectedRowsTensor t.grad densifies implicitly via its _data
         t.grad = Tensor(t.grad._data + g, stop_gradient=True)
 
 

@@ -1,0 +1,115 @@
+"""Row-sparse gradients — the reference's SelectedRows.
+
+Reference: paddle/phi/core/selected_rows.h + the selected-rows kernel family
+(paddle/phi/kernels/selected_rows/ adam/sgd) — embedding gradients carried as
+(rows, values) instead of a dense [vocab, d] array, with optimizers applying
+row-sparse updates.
+
+TPU-native shape: ``SelectedRowsTensor`` subclasses Tensor so it rides the
+existing tape/leaf-accumulation plumbing, but stores ``rows [n]`` +
+``values [n, d]`` and only materializes the dense array if something outside
+the sparse-aware paths (optimizer row updates, global-norm clip) touches
+``_data``.  Gradients are coalesced at creation (unique rows, duplicates
+summed — eager-side np.unique, so no dynamic-shape trouble), which keeps
+norms and accumulation exact.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor
+
+
+class SelectedRowsTensor(Tensor):
+    """A Tensor whose payload is row-sparse: dense shape [dim0, ...] with
+    only ``rows`` populated by ``values``."""
+
+    __slots__ = ("_rows", "_values", "_dense_shape", "_densified")
+
+    def __init__(self, rows, values, dense_shape):
+        self._rows = jnp.asarray(rows, jnp.int32)
+        self._values = jnp.asarray(values)
+        self._dense_shape = tuple(dense_shape)
+        self._densified = None
+        # Tensor.__init__ writes the (ignored) _data placeholder
+        super().__init__(self._values[:0], stop_gradient=True)
+
+    # -- SelectedRows surface (reference selected_rows.h) -----------------
+    @property
+    def rows(self):
+        return Tensor(self._rows)
+
+    @property
+    def values(self):
+        return Tensor(self._values)
+
+    def is_selected_rows(self) -> bool:
+        return True
+
+    @property
+    def shape(self):
+        return list(self._dense_shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._values.dtype)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._dense(), stop_gradient=True)
+
+    def _dense(self):
+        if self._densified is None:
+            z = jnp.zeros(self._dense_shape, self._values.dtype)
+            self._densified = z.at[self._rows].add(self._values)
+        return self._densified
+
+    # anything touching _data gets the dense view (compat escape hatch)
+    @property
+    def _data(self):
+        return self._dense()
+
+    @_data.setter
+    def _data(self, v):  # Tensor.__init__ writes the placeholder; ignore
+        pass
+
+    def __repr__(self):
+        return (f"SelectedRowsTensor(shape={self._dense_shape}, "
+                f"rows={self._rows.shape[0]}, dtype={self.dtype.name})")
+
+
+def coalesce(rows, values):
+    """Sum duplicate rows (host-side unique: gradients are eager here)."""
+    rows_np = np.asarray(rows)
+    uniq, inv = np.unique(rows_np, return_inverse=True)
+    if uniq.shape[0] == rows_np.shape[0]:
+        order = np.argsort(rows_np)
+        return jnp.asarray(rows_np[order], jnp.int32), jnp.asarray(values)[order]
+    summed = jnp.zeros((uniq.shape[0],) + values.shape[1:], values.dtype)
+    summed = summed.at[jnp.asarray(inv)].add(jnp.asarray(values))
+    return jnp.asarray(uniq, jnp.int32), summed
+
+
+def make_sparse_grad(ids, cot, dense_shape, padding_idx=None):
+    """Build a coalesced SelectedRowsTensor grad from embedding cotangents.
+
+    ids: any int shape [...]; cot: [..., d] cotangent of the gathered output.
+    """
+    d = cot.shape[-1]
+    rows = jnp.asarray(ids).reshape(-1)
+    vals = jnp.asarray(cot).reshape(-1, d)
+    if padding_idx is not None:
+        keep = np.asarray(rows) != padding_idx
+        rows = rows[jnp.asarray(keep)]
+        vals = vals[jnp.asarray(keep)]
+    rows, vals = coalesce(rows, vals)
+    return SelectedRowsTensor(rows, vals, dense_shape)
+
+
+def add_sparse(a, b):
+    """Sum two row-sparse grads (gradient accumulation across backwards)."""
+    rows = jnp.concatenate([a._rows, b._rows])
+    vals = jnp.concatenate([a._values, b._values])
+    rows, vals = coalesce(rows, vals)
+    return SelectedRowsTensor(rows, vals, a._dense_shape)

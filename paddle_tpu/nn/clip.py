@@ -57,11 +57,17 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.group_name = group_name
 
     def global_norm_sq(self, params_grads):
+        from ..core.selected_rows import SelectedRowsTensor
+
         sq = None
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 continue
-            s = jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+            if isinstance(g, SelectedRowsTensor):
+                # coalesced rows: the values norm IS the dense-grad norm
+                s = jnp.sum(jnp.square(g._values.astype(jnp.float32)))
+            else:
+                s = jnp.sum(jnp.square(g._data.astype(jnp.float32)))
             sq = s if sq is None else sq + s
         return sq
 
@@ -73,10 +79,19 @@ class ClipGradByGlobalNorm(ClipGradBase):
             sq = sq + extra_norm_sq
         global_norm = jnp.sqrt(sq)
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        from ..core.selected_rows import SelectedRowsTensor
+
         out = []
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+                continue
+            if isinstance(g, SelectedRowsTensor):
+                out.append((p, SelectedRowsTensor(
+                    g._rows,
+                    (g._values.astype(jnp.float32) * scale).astype(
+                        g._values.dtype),
+                    g._dense_shape)))
                 continue
             out.append((p, Tensor((g._data.astype(jnp.float32) * scale).astype(g._data.dtype))))
         return out

@@ -201,7 +201,44 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             mask = (ids == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out)
         return out
+
+    if sparse:
+        out = _sparse_embedding(_t(x), _t(weight), padding_idx, prim)
+        if out is not None:
+            return out
     return apply_op("embedding", prim, (_t(x), _t(weight)))
+
+
+def _sparse_embedding(ids, weight, padding_idx, prim):
+    """sparse=True: backward yields a row-sparse SelectedRows grad instead
+    of a dense [vocab, d] array (reference selected_rows.h + the
+    selected-rows adam/sgd kernels; paddle.nn.functional.embedding sparse=).
+
+    Eager leaf-parameter path only — under jit/trace or for non-leaf
+    weights the caller falls back to the dense op (returns None), which
+    keeps compiled-graph semantics unchanged.  The node is opaque to
+    double-grad (like PyLayer), matching the reference's first-order-only
+    sparse grads.
+    """
+    from ..core import autograd as _ag
+    from ..core.selected_rows import make_sparse_grad
+
+    tracing = isinstance(weight._data, jax.core.Tracer) or \
+        isinstance(ids._data, jax.core.Tracer)
+    if tracing or weight.stop_gradient or weight._node is not None \
+            or not _ag._grad_enabled():
+        return None
+    out_arr = prim(ids._data, weight._data)
+    ids_arr, shape = ids._data, weight._data.shape
+
+    def vjp_fn(cot):
+        return (make_sparse_grad(ids_arr, cot, shape, padding_idx),)
+
+    node = _ag.GradNode("embedding_sparse", vjp_fn, None, [weight],
+                        [(out_arr.shape, out_arr.dtype)], True)
+    out = Tensor(out_arr, stop_gradient=False)
+    out._node, out._slot = node, 0
+    return out
 
 
 def one_hot(x, num_classes, name=None):
@@ -271,16 +308,21 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
         normalized_shape = [normalized_shape]
     n_axes = len(normalized_shape)
 
+    has_w, has_b = weight is not None, bias is not None
+    epsilon = float(epsilon)
+
+    # closure holds only value-keyed scalars so the eager dispatch cache
+    # (core.autograd._prim_key) can reuse the jitted fwd/vjp pair
     def prim(a, *wb):
         axes = tuple(range(a.ndim - n_axes, a.ndim))
         mean = jnp.mean(a, axis=axes, keepdims=True)
         var = jnp.var(a, axis=axes, keepdims=True)
         out = (a - mean) * jax.lax.rsqrt(var + epsilon)
         i = 0
-        if weight is not None:
+        if has_w:
             out = out * wb[i]
             i += 1
-        if bias is not None:
+        if has_b:
             out = out + wb[i]
         return out
     args = [_t(x)]

@@ -77,7 +77,9 @@ def reduce_op(name, fn, dtype_arg=False, spmd_rule="reduction"):
         kw = {"axis": ax, "keepdims": keepdim}
         if dtype_arg and dtype is not None:
             kw["dtype"] = dtypes.convert_dtype(dtype)
-        return apply_op(name_, lambda a: fn(a, **kw), (_t(x),))
+        # kw rides apply's kwargs (not a closure) so the dispatch cache in
+        # core.autograd can key and reuse the jitted fwd/vjp pair
+        return apply_op(name_, lambda a, **k: fn(a, **k), (_t(x),), kw)
     name_ = name
     op.__name__ = name
     register_op(name, fn, spmd_rule=spmd_rule)

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.selected_rows import SelectedRowsTensor
 from ..core.tensor import Parameter, Tensor
 from ..nn.clip import ClipGradBase
 from . import lr  # noqa: F401
@@ -106,6 +107,14 @@ class Optimizer:
         lr_val = self.get_lr()
         self._step_count += 1
         for p, g in params_grads:
+            if isinstance(g, SelectedRowsTensor) and \
+                    getattr(p, "regularizer", None) is None and \
+                    not self._use_master_weights:
+                # row-sparse grad (sparse embedding): selected-rows update
+                # path, never materializing the dense [vocab, d] gradient
+                # (reference phi/kernels/selected_rows/ adam,sgd)
+                self._update_param_sparse(p, g, lr_val, self._decay_for(p))
+                continue
             garr = g._data if isinstance(g, Tensor) else g
             # per-parameter regularizer (ParamAttr(regularizer=...)) wins
             # over the optimizer-wide decay (reference precedence); the
@@ -145,6 +154,14 @@ class Optimizer:
 
     def _update_param(self, p: Parameter, g, lr_val: float, wd: float):
         raise NotImplementedError
+
+    def _update_param_sparse(self, p: Parameter, g, lr_val: float, wd: float):
+        """Row-sparse update; optimizers without a selected-rows kernel
+        densify (correct, loses the memory win)."""
+        garr = g._data  # lazy densify on the SelectedRowsTensor
+        if garr.dtype != p._data.dtype:
+            garr = garr.astype(p._data.dtype)
+        self._update_param(p, garr, lr_val, wd)
 
     def clear_grad(self, set_to_zero=True):
         for p in self._params:
@@ -197,6 +214,16 @@ def _sgd_update(param, grad, lr, wd):
     return param - lr * grad
 
 
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(4,))
+def _sgd_sparse_update(param, rows, values, lr, wd):
+    """Selected-rows SGD (reference phi/kernels/selected_rows/ sgd): only
+    touched rows move; decay is lazy (touched rows), like the reference."""
+    upd = values.astype(param.dtype)
+    if wd:
+        upd = upd + wd * param[rows]
+    return param.at[rows].add(-lr * upd)
+
+
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -204,6 +231,9 @@ class SGD(Optimizer):
 
     def _update_param(self, p, g, lr_val, wd):
         p._data = _sgd_update(p._data, g, lr_val, wd)
+
+    def _update_param_sparse(self, p, g, lr_val, wd):
+        p._data = _sgd_sparse_update(p._data, g._rows, g._values, lr_val, wd)
 
 
 @partial(jax.jit, donate_argnums=(0, 2), static_argnums=(5, 6))
@@ -247,16 +277,81 @@ def _adam_update(param, grad, m, v, lr, beta1, beta2, eps, t, wd, lazy=None):
     return param - lr * update, m_new, v_new
 
 
+@partial(jax.jit, donate_argnums=(0, 3, 4))
+def _adam_sparse_lazy_update(param, rows, values, m, v, lr, beta1, beta2,
+                             eps, t, wd):
+    """Lazy-mode selected-rows Adam (reference selected_rows adam,
+    lazy_mode=True): moments and weights move only on touched rows."""
+    g = values.astype(jnp.float32)
+    m_new = beta1 * m[rows] + (1 - beta1) * g
+    v_new = beta2 * v[rows] + (1 - beta2) * jnp.square(g)
+    mhat = m_new / (1 - beta1 ** t)
+    vhat = v_new / (1 - beta2 ** t)
+    upd = mhat / (jnp.sqrt(vhat) + eps)
+    if wd is not None:
+        upd = upd + wd * param[rows].astype(jnp.float32)
+    param = param.at[rows].add((-lr * upd).astype(param.dtype))
+    return param, m.at[rows].set(m_new.astype(m.dtype)), \
+        v.at[rows].set(v_new.astype(v.dtype))
+
+
+@partial(jax.jit, donate_argnums=(0, 3, 4))
+def _adam_sparse_exact_update(param, rows, values, m, v, lr, beta1, beta2,
+                              eps, t, wd):
+    """Exact selected-rows Adam (lazy_mode=False): identical math to the
+    dense kernel — moments decay everywhere, the gradient contribution is
+    scattered — without ever materializing a dense gradient."""
+    m = beta1 * m
+    m = m.at[rows].add((1 - beta1) * values.astype(m.dtype))
+    v = beta2 * v
+    v = v.at[rows].add((1 - beta2) * jnp.square(values.astype(v.dtype)))
+    mhat = m / (1 - beta1 ** t)
+    vhat = v / (1 - beta2 ** t)
+    upd = mhat / (jnp.sqrt(vhat) + eps)
+    if wd is not None:
+        upd = upd + wd * param
+    return param - lr * upd.astype(param.dtype), m, v
+
+
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
                  multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lazy_mode = lazy_mode
         self._multi_precision = multi_precision
 
     def _acc_names(self):
         return ["moment1", "moment2"]
+
+    def _update_param_sparse(self, p, g, lr_val, wd):
+        """Selected-rows Adam(W): lazy (touched rows only) or exact math,
+        per ``lazy_mode`` — reference selected_rows adam kernels.
+
+        AdamW's decoupled decay is row-independent and rides the kernels'
+        wd argument exactly.  Plain Adam's L2-style decay folds wd*param
+        into the GRADIENT, which makes the effective gradient dense — so
+        exact mode with wd densifies (no sparse kernel can match the dense
+        math there), while lazy mode decays touched rows only (the
+        reference's lazy semantics)."""
+        if not isinstance(self, AdamW) and wd and not self._lazy_mode:
+            return super()._update_param_sparse(p, g, lr_val, wd)
+        if getattr(self, "_lr_ratio", None) is not None:
+            lr_val = lr_val * self._lr_ratio(p)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        values = g._values
+        decoupled = (wd or 0.0) if isinstance(self, AdamW) else None
+        if not isinstance(self, AdamW) and wd:  # lazy L2: touched rows
+            values = values + wd * p._data[g._rows].astype(values.dtype)
+        fn = _adam_sparse_lazy_update if self._lazy_mode \
+            else _adam_sparse_exact_update
+        p._data, m_new, v_new = fn(
+            p._data, g._rows, values, m, v, lr_val, self._beta1, self._beta2,
+            self._epsilon, float(self._step_count), decoupled)
+        self._set_acc("moment1", p, m_new)
+        self._set_acc("moment2", p, v_new)
 
     def _update_param(self, p, g, lr_val, wd):
         # plain Adam applies weight decay as L2 into the gradient

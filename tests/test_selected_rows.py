@@ -1,0 +1,170 @@
+"""Selected-rows (row-sparse) embedding gradients.
+
+Reference: paddle/phi/core/selected_rows.h + phi/kernels/selected_rows/
+(adam, sgd) and nn.functional.embedding(sparse=True) — embedding grads as
+(rows, values) with row-sparse optimizer updates, never materializing the
+dense [vocab, d] gradient.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.core.selected_rows import SelectedRowsTensor
+
+VOCAB, DIM = 50, 8
+
+
+def _ids(*vals):
+    return P.to_tensor(np.asarray(vals, np.int32))
+
+
+def _make(sparse, seed=0):
+    P.seed(seed)
+    emb = nn.Embedding(VOCAB, DIM, sparse=sparse)
+    return emb
+
+
+def test_sparse_grad_is_selected_rows_and_coalesced():
+    emb = _make(True)
+    ids = _ids(3, 7, 3, 9)   # duplicate row 3
+    out = emb(ids)
+    out.sum().backward()
+    g = emb.weight.grad
+    assert isinstance(g, SelectedRowsTensor) and g.is_selected_rows()
+    assert not emb.weight.is_selected_rows()
+    rows = np.asarray(g._rows)
+    np.testing.assert_array_equal(rows, [3, 7, 9])  # coalesced + sorted
+    assert g._values.shape == (3, DIM)
+    # duplicate contributions summed
+    np.testing.assert_allclose(np.asarray(g._values)[0], np.full(DIM, 2.0))
+    # dense view matches a dense-mode backward
+    dense = _make(False)
+    dense.weight.set_value(emb.weight)
+    out2 = dense(ids)
+    out2.sum().backward()
+    np.testing.assert_allclose(np.asarray(g.to_dense().numpy()),
+                               dense.weight.grad.numpy(), rtol=1e-6)
+
+
+def test_padding_idx_rows_dropped():
+    emb = nn.Embedding(VOCAB, DIM, padding_idx=0, sparse=True)
+    out = emb(_ids(0, 5, 0, 6))
+    out.sum().backward()
+    rows = np.asarray(emb.weight.grad._rows)
+    np.testing.assert_array_equal(rows, [5, 6])
+
+
+def test_grad_accumulation_two_backwards():
+    emb = _make(True)
+    emb(_ids(1, 2)).sum().backward()
+    emb(_ids(2, 4)).sum().backward()
+    g = emb.weight.grad
+    assert isinstance(g, SelectedRowsTensor)
+    np.testing.assert_array_equal(np.asarray(g._rows), [1, 2, 4])
+    np.testing.assert_allclose(np.asarray(g._values)[1], np.full(DIM, 2.0))
+
+
+@pytest.mark.parametrize("optim,kw", [
+    ("SGD", {}),
+    ("Adam", dict(lazy_mode=False)),
+    ("AdamW", dict(lazy_mode=False, weight_decay=0.0)),
+])
+def test_sparse_update_matches_dense(optim, kw):
+    """Exact-mode sparse updates == dense updates, bit-for-bit math."""
+    sp = _make(True, seed=1)
+    de = _make(False, seed=1)
+    de.weight.set_value(sp.weight)
+    o_sp = getattr(opt, optim)(learning_rate=0.1,
+                               parameters=sp.parameters(), **kw)
+    o_de = getattr(opt, optim)(learning_rate=0.1,
+                               parameters=de.parameters(), **kw)
+    ids = _ids(3, 7, 3, 9)
+    for _ in range(3):
+        sp(ids).sum().backward()
+        o_sp.step()
+        o_sp.clear_grad()
+        de(ids).sum().backward()
+        o_de.step()
+        o_de.clear_grad()
+    np.testing.assert_allclose(sp.weight.numpy(), de.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lazy_adam_touches_only_live_rows():
+    emb = _make(True, seed=2)
+    before = emb.weight.numpy().copy()
+    o = opt.Adam(learning_rate=0.5, parameters=emb.parameters(),
+                 lazy_mode=True)
+    emb(_ids(4, 11)).sum().backward()
+    o.step()
+    after = emb.weight.numpy()
+    changed = np.where(np.abs(after - before).sum(axis=1) > 0)[0]
+    np.testing.assert_array_equal(changed, [4, 11])
+
+
+def test_sgd_sparse_touches_only_live_rows_and_matches_dense():
+    sp = _make(True, seed=3)
+    de = _make(False, seed=3)
+    de.weight.set_value(sp.weight)
+    before = sp.weight.numpy().copy()
+    o_sp = opt.SGD(learning_rate=0.2, parameters=sp.parameters())
+    o_de = opt.SGD(learning_rate=0.2, parameters=de.parameters())
+    ids = _ids(1, 2, 2)
+    sp(ids).sum().backward()
+    o_sp.step()
+    de(ids).sum().backward()
+    o_de.step()
+    np.testing.assert_allclose(sp.weight.numpy(), de.weight.numpy(),
+                               rtol=1e-6)
+    changed = np.where(
+        np.abs(sp.weight.numpy() - before).sum(axis=1) > 0)[0]
+    np.testing.assert_array_equal(changed, [1, 2])
+
+
+def test_global_norm_clip_preserves_sparsity_and_matches_dense():
+    sp = _make(True, seed=4)
+    de = _make(False, seed=4)
+    de.weight.set_value(sp.weight)
+    clip = nn.ClipGradByGlobalNorm(0.5)
+    o_sp = opt.SGD(learning_rate=0.1, parameters=sp.parameters(),
+                   grad_clip=clip)
+    o_de = opt.SGD(learning_rate=0.1, parameters=de.parameters(),
+                   grad_clip=nn.ClipGradByGlobalNorm(0.5))
+    ids = _ids(5, 5, 8)
+    (sp(ids) * 3.0).sum().backward()
+    assert isinstance(sp.weight.grad, SelectedRowsTensor)
+    o_sp.step()
+    (de(ids) * 3.0).sum().backward()
+    o_de.step()
+    np.testing.assert_allclose(sp.weight.numpy(), de.weight.numpy(),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_memory_grad_is_row_sized_not_vocab_sized():
+    big_vocab = 100_000
+    emb = nn.Embedding(big_vocab, 16, sparse=True)
+    emb(_ids(1, 2, 3)).sum().backward()
+    g = emb.weight.grad
+    assert isinstance(g, SelectedRowsTensor)
+    assert g._values.shape == (3, 16)          # 48 floats, not 1.6M
+    assert g._values.nbytes < 1 << 12
+    assert g.shape == [big_vocab, 16]
+
+
+def test_under_jit_falls_back_to_dense_semantics():
+    """Inside to_static/jit the sparse path must not fire (trace-safe)."""
+    from paddle_tpu.jit import to_static
+
+    emb = _make(True, seed=5)
+
+    @to_static
+    def step(ids):
+        return emb(ids).sum()
+
+    out = step(_ids(2, 3))
+    np.testing.assert_allclose(
+        float(out), float(emb(_ids(2, 3)).sum()), rtol=1e-6)
