@@ -6,10 +6,13 @@ workers (dataloader/worker.py), BatchSampler / DistributedBatchSampler
 
 TPU-native redesign: the loader produces numpy batches on host and only the
 training step moves them to device (jax device_put happens inside to_tensor /
-jit donation), so the loader is pure host code.  Worker parallelism uses
-fork-based worker processes feeding a bounded queue (the shared-memory fast
-path lives in paddle_tpu/lib/, task: native dataloader core) with a
-threaded fallback where fork is unavailable.
+jit donation), so the loader is pure host code.  With ``num_workers>0`` and
+``use_shared_memory=True`` (default) worker parallelism is fork-based worker
+PROCESSES moving batches through the native process-shared ring buffer
+(io/mp_loader.py over native/ringbuf.cc ``shmrb_*``) — CPU-heavy
+decode/augment escapes the GIL onto real cores.  Fallbacks: the in-process
+native ring with worker threads (FLAGS use_native_dataloader), and a pure
+thread pool when fork or the native toolchain is unavailable.
 """
 
 from __future__ import annotations
@@ -487,6 +490,15 @@ class DataLoader:
                                             native_available)
                 if native_available():
                     return _NativePrefetchIterator(self, self.num_workers)
+            # default: fork-based worker processes over the shared-memory
+            # ring (the reference's use_shared_memory multiprocess path)
+            if self.use_shared_memory:
+                from .mp_loader import _MPPrefetchIterator, mp_available
+                if mp_available():
+                    try:
+                        return _MPPrefetchIterator(self, self.num_workers)
+                    except Exception:
+                        pass  # e.g. fork refused: degrade to threads
             return _PrefetchIterator(self, self.num_workers)
         return _MapIterator(self)
 

@@ -1,0 +1,320 @@
+"""Fork-based multiprocess DataLoader workers over the shared-memory ring.
+
+Reference: python/paddle/io/reader.py:262 with ``num_workers>0`` forks worker
+processes (dataloader/worker.py) that move batches to the parent through
+POSIX shared memory.  Same architecture here, TPU-shaped: workers are real
+``fork`` processes (decode/augment escapes the GIL and uses real cores — the
+classic input-pipeline MFU killer on TPU), and batches travel through ONE
+anonymous MAP_SHARED mapping managed by the native process-shared ring
+(native/ringbuf.cc ``shmrb_*``), created before fork so every process
+addresses the same pages.  The parent re-orders by batch index, so batch
+order is deterministic regardless of worker scheduling.
+
+Flow control is the ring itself: workers block (in C, GIL released) on a free
+slot; the parent copies out, releases, and yields in order.  Exceptions and
+slot-overflow batches travel through a side ``multiprocessing.Queue``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import traceback
+from typing import List
+
+import numpy as np
+
+from ..native import SharedRingBuffer, load_library
+from .native_loader import _DTYPE_CODE, _DTYPES, _batch_spec, _flatten_batch
+
+_SENTINEL = None
+
+
+class _ForkUnsafeDataset(Exception):
+    """Dataset output cannot safely cross a fork (device-backed tensors)."""
+
+
+def _holds_device_tensor(sample) -> bool:
+    from ..core.tensor import Tensor
+
+    if isinstance(sample, Tensor):
+        return True
+    if isinstance(sample, dict):
+        return any(_holds_device_tensor(v) for v in sample.values())
+    if isinstance(sample, (list, tuple)):
+        return any(_holds_device_tensor(v) for v in sample)
+    return False
+
+
+def mp_available() -> bool:
+    return hasattr(os, "fork") and load_library() is not None
+
+
+def _serialized_size(arrays: List[np.ndarray], spec_bytes: bytes) -> int:
+    n = 16 + len(spec_bytes)  # idx + n_fields + spec_len
+    for a in arrays:
+        n += 2 + 8 * a.ndim + 8 + a.nbytes
+    return n
+
+
+def _write_batch(view: np.ndarray, batch_idx: int, arrays: List[np.ndarray],
+                 spec_bytes: bytes) -> int:
+    off = 0
+
+    def put(b: bytes):
+        nonlocal off
+        view[off:off + len(b)] = np.frombuffer(b, np.uint8)
+        off += len(b)
+
+    put(struct.pack("<qII", batch_idx, len(arrays), len(spec_bytes)))
+    put(spec_bytes)
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        code = _DTYPE_CODE.get(a.dtype)
+        if code is None:
+            raise TypeError(f"unsupported dtype {a.dtype} for mp loader")
+        put(struct.pack("<BB", code, a.ndim))
+        for d in a.shape:
+            put(struct.pack("<q", d))
+        put(struct.pack("<q", a.nbytes))
+        view[off:off + a.nbytes] = a.view(np.uint8).reshape(-1)
+        off += a.nbytes
+    return off
+
+
+def _read_batch(view: np.ndarray):
+    off = 0
+
+    def take(fmt):
+        nonlocal off
+        n = struct.calcsize(fmt)
+        vals = struct.unpack(fmt, view[off:off + n].tobytes())
+        off += n
+        return vals
+
+    batch_idx, n_fields, spec_len = take("<qII")
+    spec = pickle.loads(view[off:off + spec_len].tobytes())
+    off += spec_len
+    arrays = []
+    for _ in range(n_fields):
+        code, ndim = take("<BB")
+        shape = tuple(take("<q")[0] for _ in range(ndim))
+        (nbytes,) = take("<q")
+        arr = np.frombuffer(view[off:off + nbytes].tobytes(),
+                            dtype=_DTYPES[code])
+        arrays.append(arr.reshape(shape))
+        off += nbytes
+    return batch_idx, spec, arrays
+
+
+def _np_collate(batch):
+    """default_collate_fn in the numpy domain.
+
+    The forked worker inherits the parent's JAX runtime state but not its
+    threads, so ANY device traffic (jnp.asarray in Tensor.__init__,
+    np.asarray on a device array) can deadlock in the child.  Workers
+    therefore collate to plain numpy; the parent wraps into Tensors.
+    """
+    from ..core.tensor import Tensor
+
+    sample = batch[0]
+    if isinstance(sample, Tensor):  # dataset built host tensors
+        return np.stack([np.asarray(s._data) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype="int64")
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype="float32")
+    if isinstance(sample, dict):
+        return {k: _np_collate([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return [_np_collate(list(items)) for items in zip(*batch)]
+    raise TypeError(
+        f"mp DataLoader cannot collate a batch of {type(sample).__name__}")
+
+
+def _worker_main(loader, rb, task_q, side_q, wid, num_workers, seed):
+    """Worker process body.  Runs until the sentinel or ring close."""
+    from . import WorkerInfo, _worker_tls, default_collate_fn
+
+    _worker_tls.info = WorkerInfo(wid, num_workers, loader.dataset, seed + wid)
+    collate = loader.collate_fn
+    if collate is default_collate_fn:
+        collate = _np_collate  # stay off the device in the fork (see above)
+    try:
+        if loader.worker_init_fn is not None:
+            loader.worker_init_fn(wid)
+        while True:
+            task = task_q.get()
+            if task is _SENTINEL:
+                return
+            i, indices = task
+            samples = [loader.dataset[j] for j in indices]
+            batch = collate(samples)
+            arrays = _flatten_batch(batch)
+            spec_bytes = pickle.dumps(_batch_spec(batch))
+            size = _serialized_size(arrays, spec_bytes)
+            if size > rb.slot_bytes:
+                # oversized: spool to a temp file and queue only the path.
+                # (Shipping megabyte pickles through the queue itself can
+                # wedge its feeder thread against the 64K pipe buffer.)
+                import tempfile
+                fd, path = tempfile.mkstemp(prefix="pdtpu_batch_",
+                                            suffix=".bin")
+                with os.fdopen(fd, "wb") as f:
+                    pickle.dump((_batch_spec(batch), arrays), f)
+                side_q.put(("big", i, path))
+                continue
+            slot = -1
+            while slot < 0:
+                if rb.is_closed():
+                    return
+                slot = rb.acquire_write(timeout_ms=500)
+            _write_batch(rb.slot_view(slot), i, arrays, spec_bytes)
+            rb.commit_write(slot, size)
+    except BaseException:
+        try:
+            side_q.put(("err", wid, traceback.format_exc()))
+        except Exception:
+            pass
+
+
+class _MPPrefetchIterator:
+    """Order-preserving iterator over fork-worker-produced batches."""
+
+    def __init__(self, loader, num_workers):
+        import multiprocessing as mp
+        import weakref
+
+        self.loader = loader
+        self.batches = list(iter(loader.batch_sampler))
+        self.next_idx = 0
+        self.pending = {}
+        self.spec = None
+        self.timeout = loader.timeout if loader.timeout else 120.0
+
+        ctx = mp.get_context("fork")
+        # size the slots from a parent-probed sample batch (must pre-exist
+        # the fork); under-estimates degrade to the pickle side queue
+        slot_bytes = 1 << 16
+        if self.batches:
+            probe = [loader.dataset[j] for j in self.batches[0][:1]]
+            if probe and _holds_device_tensor(probe[0]):
+                # the dataset emits device-backed Tensors: converting them
+                # to numpy in a forked child is device traffic and can
+                # deadlock (the child inherits the JAX runtime without its
+                # threads) — tell DataLoader to use the thread path instead
+                raise _ForkUnsafeDataset(
+                    "dataset __getitem__ returns device-backed Tensors")
+            if probe:
+                from . import default_collate_fn
+                cfn = (_np_collate if loader.collate_fn is default_collate_fn
+                       else loader.collate_fn)
+                batch1 = cfn(probe)
+                arrays = _flatten_batch(batch1)
+                per_sample = sum(a.nbytes for a in arrays)
+                est = (per_sample * max(len(b) for b in self.batches)
+                       + 4096)
+                slot_bytes = max(slot_bytes, 2 * est)
+        n_slots = max(2 * num_workers, loader.prefetch_factor * num_workers, 4)
+        self.rb = SharedRingBuffer(slot_bytes, n_slots)
+        self.task_q = ctx.Queue()
+        self.side_q = ctx.Queue()
+        for t in enumerate(self.batches):
+            self.task_q.put(t)
+        for _ in range(num_workers):
+            self.task_q.put(_SENTINEL)
+        self.procs = [
+            ctx.Process(target=_worker_main,
+                        args=(loader, self.rb, self.task_q, self.side_q,
+                              w, num_workers, 0),
+                        daemon=True)
+            for w in range(num_workers)]
+        for p in self.procs:
+            p.start()
+        self._fin = weakref.finalize(self, _MPPrefetchIterator._shutdown,
+                                     self.rb, self.procs)
+
+    def __iter__(self):
+        return self
+
+    def _poll_side(self, block=False):
+        import queue as _q
+        try:
+            kind, a, b = self.side_q.get(
+                timeout=0.05 if block else 0.01)
+        except (_q.Empty, OSError):
+            return
+        if kind == "err":
+            self.close()
+            raise RuntimeError(
+                f"DataLoader worker {a} died:\n{b}")
+        with open(b, "rb") as f:
+            spec, arrays = pickle.load(f)
+        os.unlink(b)
+        if self.spec is None:
+            self.spec = spec
+        self.pending[a] = (spec, arrays)
+
+    def __next__(self):
+        import time
+
+        from .native_loader import _rebuild
+
+        if self.next_idx >= len(self.batches):
+            self.close()
+            raise StopIteration
+        deadline = time.monotonic() + self.timeout
+        while self.next_idx not in self.pending:
+            self._poll_side()
+            slot = self.rb.acquire_read(timeout_ms=50)
+            if slot >= 0:
+                used = self.rb.slot_bytes_used(slot)
+                bidx, spec, arrays = _read_batch(self.rb.slot_view(slot, used))
+                self.rb.release_read(slot)
+                self.pending[bidx] = (spec, arrays)
+                continue
+            if all(not p.is_alive() for p in self.procs):
+                # workers gone: drain remaining ring slots and side items
+                slot = self.rb.acquire_read(timeout_ms=50)
+                while slot >= 0:
+                    used = self.rb.slot_bytes_used(slot)
+                    bidx, spec, arrays = _read_batch(
+                        self.rb.slot_view(slot, used))
+                    self.rb.release_read(slot)
+                    self.pending[bidx] = (spec, arrays)
+                    slot = self.rb.acquire_read(timeout_ms=50)
+                for _ in range(len(self.batches) - self.next_idx):
+                    before = len(self.pending)
+                    self._poll_side(block=True)
+                    if len(self.pending) == before:
+                        break
+                if self.next_idx in self.pending:
+                    break
+                self.close()
+                raise RuntimeError(
+                    "DataLoader workers exited before producing batch "
+                    f"{self.next_idx}")
+            if time.monotonic() > deadline:
+                self.close()
+                raise RuntimeError(
+                    f"DataLoader timed out after {self.timeout}s waiting "
+                    f"for batch {self.next_idx}")
+        spec, arrays = self.pending.pop(self.next_idx)
+        self.next_idx += 1
+        return _rebuild(spec, arrays, pos=[0])
+
+    @staticmethod
+    def _shutdown(rb, procs):
+        rb.close()
+        for p in procs:
+            p.join(timeout=2.0)
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=2.0)
+
+    def close(self):
+        self._fin()

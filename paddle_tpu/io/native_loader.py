@@ -37,6 +37,11 @@ def _flatten_batch(batch) -> List[np.ndarray]:
         for b in batch:
             out.extend(_flatten_batch(b))
         return out
+    if isinstance(batch, dict):
+        out = []
+        for k in sorted(batch):
+            out.extend(_flatten_batch(batch[k]))
+        return out
     if isinstance(batch, Tensor):
         return [np.asarray(batch._data)]
     return [np.asarray(batch)]
@@ -47,6 +52,8 @@ def _batch_spec(batch):
     if isinstance(batch, (list, tuple)):
         return ("L" if isinstance(batch, list) else "U",
                 [_batch_spec(b) for b in batch])
+    if isinstance(batch, dict):
+        return ("D", [(k, _batch_spec(batch[k])) for k in sorted(batch)])
     return ("T", None)
 
 
@@ -56,6 +63,8 @@ def _rebuild(spec, arrays, pos=[0]):
         arr = arrays[pos[0]]
         pos[0] += 1
         return Tensor(arr)
+    if kind == "D":
+        return {k: _rebuild(s, arrays, pos) for k, s in payload}
     vals = [_rebuild(s, arrays, pos) for s in payload]
     return vals if kind == "L" else tuple(vals)
 

@@ -82,6 +82,28 @@ def load_library():
         lib.rb_gather_rows.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_size_t]
+        # process-shared ring (fork-worker DataLoader transport)
+        lib.shmrb_required_bytes.restype = ctypes.c_size_t
+        lib.shmrb_required_bytes.argtypes = [ctypes.c_size_t, ctypes.c_uint32]
+        lib.shmrb_init.restype = ctypes.c_int
+        lib.shmrb_init.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                   ctypes.c_uint32]
+        lib.shmrb_acquire_write.restype = ctypes.c_int
+        lib.shmrb_acquire_write.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.shmrb_commit_write.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                           ctypes.c_size_t]
+        lib.shmrb_acquire_read.restype = ctypes.c_int
+        lib.shmrb_acquire_read.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.shmrb_release_read.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.shmrb_slot_used.restype = ctypes.c_size_t
+        lib.shmrb_slot_used.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.shmrb_slot_capacity.restype = ctypes.c_size_t
+        lib.shmrb_slot_capacity.argtypes = [ctypes.c_void_p]
+        lib.shmrb_slot_ptr.restype = ctypes.c_void_p
+        lib.shmrb_slot_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.shmrb_close.argtypes = [ctypes.c_void_p]
+        lib.shmrb_is_closed.restype = ctypes.c_int
+        lib.shmrb_is_closed.argtypes = [ctypes.c_void_p]
         _LIB[0] = lib
         return lib
 
@@ -138,6 +160,64 @@ class RingBuffer:
             self.destroy()
         except Exception:
             pass
+
+
+class SharedRingBuffer:
+    """Process-shared slot ring inside an anonymous MAP_SHARED mapping.
+
+    Create in the PARENT before forking workers: children inherit the mapping
+    (same physical pages, same virtual address), so slot handoff crosses the
+    process boundary with zero copies beyond the serialize/deserialize memcpy.
+    See shmrb_* in ringbuf.cc.
+    """
+
+    def __init__(self, slot_bytes: int, n_slots: int):
+        import mmap
+
+        self._lib = load_library()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        total = self._lib.shmrb_required_bytes(slot_bytes, n_slots)
+        self._mm = mmap.mmap(-1, total)  # MAP_SHARED | MAP_ANONYMOUS
+        self._buf = ctypes.c_char.from_buffer(self._mm)
+        self._base = ctypes.addressof(self._buf)
+        if self._lib.shmrb_init(self._base, slot_bytes, n_slots) != 0:
+            raise RuntimeError("shmrb_init failed")
+        self.slot_bytes = slot_bytes
+        self.n_slots = n_slots
+
+    def acquire_write(self, timeout_ms: int = -1) -> int:
+        return self._lib.shmrb_acquire_write(self._base, timeout_ms)
+
+    def commit_write(self, slot: int, nbytes: int):
+        self._lib.shmrb_commit_write(self._base, slot, nbytes)
+
+    def acquire_read(self, timeout_ms: int = -1) -> int:
+        return self._lib.shmrb_acquire_read(self._base, timeout_ms)
+
+    def release_read(self, slot: int):
+        self._lib.shmrb_release_read(self._base, slot)
+
+    def slot_view(self, slot: int, nbytes: int = None):
+        import numpy as np
+        ptr = self._lib.shmrb_slot_ptr(self._base, slot)
+        n = self.slot_bytes if nbytes is None else nbytes
+        return np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)), (n,))
+
+    def slot_bytes_used(self, slot: int) -> int:
+        return self._lib.shmrb_slot_used(self._base, slot)
+
+    def close(self):
+        if getattr(self, "_base", None):
+            self._lib.shmrb_close(self._base)
+
+    def is_closed(self) -> bool:
+        return bool(self._lib.shmrb_is_closed(self._base))
+
+    # NOTE: no destroy — the mapping dies with the last process holding it.
+    # (Freeing the ctypes view before the mmap would require dropping
+    # self._buf first; we simply let both be collected together.)
 
 
 def gather_rows(dst, src, idx):
