@@ -586,10 +586,237 @@ def pipeline_zbh1_grads(mesh, axis: str, stage_fn: Callable,
                            *consts)
 
 
+def pipeline_zbvpp_grads(mesh, axis: str, stage_fn: Callable,
+                         loss_fn: Callable, stage_params: Any,
+                         loss_params: Any, microbatches, labels, *consts,
+                         virtual: int = 1):
+    """Zero-bubble x virtual-pipeline (ZBVPP) schedule with manual grads.
+
+    Reference: pipeline_zero_bubble.py:151
+    (``PipelineZeroBubbleVirtualPipelinePass``) — the interleaved-VPP
+    schedule with each backward split into B (input-grad, the inter-stage
+    critical path) and W (weight-grad, deferred into bubble slots).
+
+    SPMD lockstep layout (same runtime model as `pipeline_zbh1_grads`):
+    stage s holds ``virtual`` chunk rows in round order
+    (`interleave_chunk_order`); unit (microbatch m, chunk r) timing is
+
+      F at tick  t = r*M + m + s                       (circular forward)
+      B at tick  t = vM + (v-1-r)*M + m + (S-1-s)      (mirrored wavefront)
+      W at tick  t = B + s = vM + (v-1-r)*M + m + S-1  (stage-proportional
+                                                        deferral; stage 0
+                                                        runs W with B)
+
+    over T = 2vM + S - 1 ticks.  Chunk hand-offs ride the same ring
+    ppermutes as the interleave schedule, with activations parked at stage 0
+    (forward, chunk r -> r+1) and cotangents parked at stage S-1 (backward,
+    chunk r+1 -> r).  As with ZBH1, every stage computes every tick in this
+    lockstep runtime, so the B/W split's wall-clock value comes from XLA
+    overlapping the off-critical-path W work with the cotangent ppermute;
+    the schedule structure is the reference's.  Saved inputs/seeds are
+    buffered per unit ([v*M] slots — the lockstep analog of the reference's
+    per-chunk activation queues).
+
+    Requires M >= S and S >= 2 (use `pipeline_zbh1_grads` for S == 1).
+    Same contract as `pipeline_1f1b_grads`; ``stage_params`` leaves lead
+    with the S*virtual chunk-row dim.
+    """
+    S = mesh.shape[axis]
+    v = int(virtual)
+    M = microbatches.shape[0]
+    if S == 1:
+        raise ValueError("zbvpp needs pp >= 2; use schedule='zbh1' for pp=1")
+    if M < S:
+        raise ValueError(f"zbvpp needs microbatches ({M}) >= stages ({S})")
+    U = v * M
+    T = 2 * U + S - 1
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def per_stage(params_local, micro, lbls, lparams, *cs):
+        # params_local leaves: [v, ...] — this stage's chunks in round order
+        s = lax.axis_index(axis)
+        mb_shape = micro[0]
+
+        def vary(x):
+            return lax.pcast(x, (axis,), to="varying")
+
+        lparams = jax.tree_util.tree_map(vary, lparams)
+
+        def chunk(tree, r):
+            return jax.tree_util.tree_map(
+                lambda l: lax.dynamic_index_in_dim(l, r, 0, keepdims=False),
+                tree)
+
+        fwd_carry = vary(jnp.zeros_like(mb_shape))
+        bwd_carry = vary(jnp.zeros_like(mb_shape))
+        circ_f = vary(jnp.zeros_like(micro))            # stage-0 fwd parking
+        park_b = vary(jnp.zeros_like(micro))            # stage-(S-1) bwd park
+        inbuf = vary(jnp.zeros((U,) + mb_shape.shape, mb_shape.dtype))
+        gybuf = vary(jnp.zeros((U,) + mb_shape.shape, mb_shape.dtype))
+        glbuf = vary(jnp.zeros((U,), jnp.float32))
+        dmicro = vary(jnp.zeros_like(micro))
+        gacc = jax.tree_util.tree_map(
+            lambda l: vary(jnp.zeros(l.shape, jnp.float32)), params_local)
+        glp_acc = jax.tree_util.tree_map(
+            lambda l: vary(jnp.zeros(l.shape, jnp.float32)), lparams)
+        loss_acc = vary(jnp.float32(0.0))
+
+        def tick(carry, t):
+            (fwd_carry, bwd_carry, circ_f, park_b, inbuf, gybuf, glbuf,
+             dmicro, gacc, glp_acc, loss_acc) = carry
+
+            # ---- F unit: f = t - s ----
+            f = t - s
+            f_valid = jnp.logical_and(f >= 0, f < U)
+            fc = jnp.clip(f, 0, U - 1)
+            r_f, m_f = fc // M, fc % M
+            x0_new = lax.dynamic_index_in_dim(micro, m_f, 0, keepdims=False)
+            x0_circ = lax.dynamic_index_in_dim(circ_f, m_f, 0, keepdims=False)
+            x0 = jnp.where(r_f == 0, x0_new, x0_circ)
+            x_in = jnp.where(s == 0, x0, fwd_carry)
+            y = stage_fn(chunk(params_local, r_f), x_in, *cs)
+            inbuf = jnp.where(
+                f_valid,
+                lax.dynamic_update_index_in_dim(inbuf, x_in, fc, 0), inbuf)
+
+            # ---- B unit: k_b = t - vM - (S-1-s) ----
+            k_b = t - U - (S - 1 - s)
+            b_valid = jnp.logical_and(k_b >= 0, k_b < U)
+            kb = jnp.clip(k_b, 0, U - 1)
+            r_b, m_b = v - 1 - kb // M, kb % M
+            u_b = r_b * M + m_b
+            xb = lax.dynamic_index_in_dim(inbuf, u_b, 0, keepdims=False)
+            p_b = chunk(params_local, r_b)
+            lbl_b = lax.dynamic_index_in_dim(lbls, m_b, 0, keepdims=False)
+
+            def fwd_loss_x(x_):
+                y_ = stage_fn(p_b, x_, *cs)
+                return y_, loss_fn(y_, lbl_b, lparams)
+
+            (_, loss_b), vjp_x = jax.vjp(fwd_loss_x, xb)
+            is_loss_unit = jnp.logical_and(s == S - 1, r_b == v - 1)
+            parked = lax.dynamic_index_in_dim(park_b, m_b, 0, keepdims=False)
+            upstream = jnp.where(s == S - 1, parked, bwd_carry)
+            gy_seed = jnp.where(
+                jnp.logical_or(is_loss_unit, jnp.logical_not(b_valid)),
+                jnp.zeros_like(upstream), upstream).astype(y.dtype)
+            gl_seed = jnp.where(jnp.logical_and(is_loss_unit, b_valid),
+                                jnp.float32(1.0), jnp.float32(0.0))
+            (dx,) = vjp_x((gy_seed, gl_seed))
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(is_loss_unit, b_valid), loss_b, 0.0)
+            dmicro = jnp.where(
+                jnp.logical_and(jnp.logical_and(s == 0, r_b == 0), b_valid),
+                lax.dynamic_update_index_in_dim(
+                    dmicro, dx.astype(dmicro.dtype), m_b, 0),
+                dmicro)
+            gybuf = jnp.where(
+                b_valid,
+                lax.dynamic_update_index_in_dim(
+                    gybuf, gy_seed.astype(mb_shape.dtype), u_b, 0), gybuf)
+            glbuf = jnp.where(
+                b_valid,
+                lax.dynamic_update_index_in_dim(glbuf, gl_seed, u_b, 0),
+                glbuf)
+
+            # ---- W unit: k_w = t - vM - (S-1), stage-independent ----
+            k_w = t - U - (S - 1)
+            w_valid = jnp.logical_and(k_w >= 0, k_w < U)
+            kw = jnp.clip(k_w, 0, U - 1)
+            r_w, m_w = v - 1 - kw // M, kw % M
+            u_w = r_w * M + m_w
+            # stage 0 defers nothing (k_w == k_b there): use the fresh pair
+            xw = jnp.where(
+                s == 0, xb,
+                lax.dynamic_index_in_dim(inbuf, u_w, 0, keepdims=False))
+            gyw = jnp.where(
+                s == 0, gy_seed.astype(mb_shape.dtype),
+                lax.dynamic_index_in_dim(gybuf, u_w, 0, keepdims=False))
+            glw = jnp.where(
+                s == 0, gl_seed,
+                lax.dynamic_index_in_dim(glbuf, u_w, 0, keepdims=False))
+            rw_eff = jnp.where(s == 0, r_b, r_w)
+            p_w = chunk(params_local, rw_eff)
+            lbl_w = lax.dynamic_index_in_dim(lbls, m_w, 0, keepdims=False)
+            lbl_w = jnp.where(s == 0, lbl_b, lbl_w)
+
+            def fwd_loss_p(p_, lp_):
+                y_ = stage_fn(p_, xw, *cs)
+                return y_, loss_fn(y_, lbl_w, lp_)
+
+            _, vjp_p = jax.vjp(fwd_loss_p, p_w, lparams)
+            gp, glp = vjp_p((gyw.astype(y.dtype), glw))
+            do_w = jnp.where(s == 0, b_valid, w_valid)
+            gacc = jax.tree_util.tree_map(
+                lambda a, g: lax.dynamic_update_index_in_dim(
+                    a,
+                    lax.dynamic_index_in_dim(a, rw_eff, 0, keepdims=False)
+                    + jnp.where(do_w, g.astype(jnp.float32), 0.0),
+                    rw_eff, 0),
+                gacc, gp)
+            glp_acc = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(do_w, g.astype(jnp.float32), 0.0),
+                glp_acc, glp)
+
+            # ---- ring hand-offs + chunk-transition parking ----
+            fwd_carry = lax.ppermute(y, axis, fwd_perm)
+            bwd_carry = lax.ppermute(dx.astype(mb_shape.dtype), axis,
+                                     bwd_perm)
+            # stage 0 parks the activation arriving from stage S-1's F
+            # (unit f' = t - (S-1), chunks 0..v-2) for its next round
+            fp = t - (S - 1)
+            fpc = jnp.clip(fp, 0, U - 1)
+            park_f = jnp.logical_and(
+                s == 0, jnp.logical_and(fp >= 0, fp < U - M))
+            circ_f = jnp.where(
+                park_f,
+                lax.dynamic_update_index_in_dim(circ_f, fwd_carry, fpc % M,
+                                                0),
+                circ_f)
+            # stage S-1 parks the cotangent arriving from stage 0's B
+            # (unit k_b0 = t - vM - (S-1), chunks v-1..1) for chunk r-1
+            kb0 = t - U - (S - 1)
+            kb0c = jnp.clip(kb0, 0, U - 1)
+            r0 = v - 1 - kb0c // M
+            park_bk = jnp.logical_and(
+                s == S - 1,
+                jnp.logical_and(jnp.logical_and(kb0 >= 0, kb0 < U), r0 >= 1))
+            park_b = jnp.where(
+                park_bk,
+                lax.dynamic_update_index_in_dim(park_b, bwd_carry, kb0c % M,
+                                                0),
+                park_b)
+            return (fwd_carry, bwd_carry, circ_f, park_b, inbuf, gybuf,
+                    glbuf, dmicro, gacc, glp_acc, loss_acc), None
+
+        carry = (fwd_carry, bwd_carry, circ_f, park_b, inbuf, gybuf, glbuf,
+                 dmicro, gacc, glp_acc, loss_acc)
+        carry, _ = lax.scan(tick, carry, jnp.arange(T))
+        (_, _, _, _, _, _, _, dmicro, gacc, glp_acc, loss_acc) = carry
+
+        loss = lax.psum(loss_acc, axis)
+        glp = jax.tree_util.tree_map(lambda l: lax.psum(l, axis), glp_acc)
+        dmicro = lax.psum(dmicro * (s == 0).astype(dmicro.dtype), axis)
+        return loss, gacc, glp, dmicro
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+                P(), P(), jax.tree_util.tree_map(lambda _: P(), loss_params),
+                ) + tuple(P() for _ in consts)
+    out_specs = (P(), jax.tree_util.tree_map(lambda _: P(axis), stage_params),
+                 jax.tree_util.tree_map(lambda _: P(), loss_params), P())
+    return jax.shard_map(per_stage, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, axis_names={axis},
+                         )(stage_params, microbatches, labels, loss_params,
+                           *consts)
+
+
 def num_pipeline_ticks(num_micro: int, num_stages: int, virtual: int = 1,
                        schedule: str = "gpipe") -> int:
     if schedule in ("1f1b", "zbh1"):
         return 2 * num_stages + num_micro - 1
+    if schedule == "zbvpp":
+        return 2 * virtual * num_micro + num_stages - 1
     if virtual > 1:
         return virtual * num_micro + num_stages - 1
     return num_micro + num_stages - 1

@@ -35,7 +35,8 @@ from ..core.tensor import Tensor
 from ..distributed.pipeline_spmd import (interleave_chunk_order,
                                          pipeline_1f1b_grads,
                                          pipeline_apply,
-                                         pipeline_zbh1_grads)
+                                         pipeline_zbh1_grads,
+                                         pipeline_zbvpp_grads)
 from ..utils import extract_params, functional_call, stack_params
 from .llama import LlamaConfig, LlamaDecoderLayer, _rope_cos_sin, _scaled_init
 
@@ -48,8 +49,8 @@ class ParallelConfig:
     ep: int = 1                  # expert parallel (MoE expert-bank sharding)
     sep: int = 1                 # segment/context parallel (Ulysses seq shard)
     micro_batches: int = 1
-    schedule: str = "gpipe"      # gpipe | interleave | 1f1b | zbh1
-    virtual_pp: int = 1          # VPP chunks per stage (schedule="interleave")
+    schedule: str = "gpipe"      # gpipe | interleave | 1f1b | zbh1 | zbvpp
+    virtual_pp: int = 1          # VPP chunks per stage (interleave / zbvpp)
     sequence_parallel: bool = False
     zero1: bool = False          # shard optimizer moments over dp
     zero3: bool = False          # shard PARAMETERS over dp too (gather on
@@ -117,11 +118,12 @@ class PretrainStep:
         self.mesh = mesh if mesh is not None else build_mesh(self.pc)
         self.lr, self.wd = learning_rate, weight_decay
         self.b1, self.b2, self.eps = beta1, beta2, eps
-        if self.pc.schedule not in ("gpipe", "interleave", "1f1b", "zbh1"):
+        if self.pc.schedule not in ("gpipe", "interleave", "1f1b", "zbh1",
+                                    "zbvpp"):
             raise ValueError(f"unknown pipeline schedule {self.pc.schedule!r}")
         if self.pc.schedule in ("1f1b", "zbh1") and self.pc.virtual_pp > 1:
-            raise ValueError("interleaved 1F1B is not implemented; use "
-                             "schedule='interleave' or virtual_pp=1")
+            raise ValueError("1f1b/zbh1 are single-chunk; use "
+                             "schedule='zbvpp' for zero-bubble x VPP")
         self._moe = bool(config.moe_num_experts)
         if self._moe and self.pc.pp > 1:
             raise NotImplementedError(
@@ -155,8 +157,8 @@ class PretrainStep:
                 raise ValueError(
                     f"ep ({self.pc.ep}) must divide moe_num_experts "
                     f"({config.moe_num_experts})")
-        self._virtual = self.pc.virtual_pp if self.pc.schedule == "interleave" \
-            else 1
+        self._virtual = self.pc.virtual_pp \
+            if self.pc.schedule in ("interleave", "zbvpp") else 1
         groups = self.pc.pp * self._virtual
         if config.num_hidden_layers % groups:
             raise ValueError(
@@ -455,11 +457,16 @@ class PretrainStep:
 
             return jax.lax.map(chunk_loss, (hc, lc)).sum()
 
-        grads_fn = pipeline_zbh1_grads if self.pc.schedule == "zbh1" \
-            else pipeline_1f1b_grads
-        loss_sum, d_blocks, d_lp, d_micro = grads_fn(
-            mesh, "pp", stage_fn, loss_fn, params["blocks"], loss_params,
-            micro, lbl_micro)
+        if self.pc.schedule == "zbvpp":
+            loss_sum, d_blocks, d_lp, d_micro = pipeline_zbvpp_grads(
+                mesh, "pp", stage_fn, loss_fn, params["blocks"], loss_params,
+                micro, lbl_micro, virtual=self._virtual)
+        else:
+            grads_fn = pipeline_zbh1_grads if self.pc.schedule == "zbh1" \
+                else pipeline_1f1b_grads
+            loss_sum, d_blocks, d_lp, d_micro = grads_fn(
+                mesh, "pp", stage_fn, loss_fn, params["blocks"], loss_params,
+                micro, lbl_micro)
 
         n_tok = jnp.float32(B * T)
         scale = lambda g: g / n_tok  # noqa: E731  (sum -> mean convention)
@@ -502,7 +509,7 @@ class PretrainStep:
     # ---- the jitted step ----
     def train_step(self, state, ids, labels):
         if self._jit_step is None:
-            if self.pc.schedule in ("1f1b", "zbh1"):
+            if self.pc.schedule in ("1f1b", "zbh1", "zbvpp"):
                 def step(state, ids, labels):
                     loss, grads = self._loss_and_grads_1f1b(
                         state["params"], ids, labels)
