@@ -68,6 +68,12 @@ define_flag("allocator_strategy", "auto_growth", "Kept for API parity; XLA/PJRT 
 define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest.")
 define_flag("flash_attention_block_q", 512, "Pallas flash attention query block.")
 define_flag("flash_attention_block_kv", 512, "Pallas flash attention kv block.")
+define_flag("autotune_enable", True,
+            "Measure-and-cache Pallas kernel tilings on TPU "
+            "(kernels/autotune.py; the phi autotune cache analog).")
+define_flag("autotune_cache_path", "",
+            "Override the on-disk autotune cache location "
+            "(default ~/.cache/paddle_tpu/autotune.json).")
 define_flag("use_native_dataloader", False,
             "Route DataLoader prefetch through the C++ ring-buffer engine "
             "(native/ringbuf.cc). Off by default: with in-process thread "

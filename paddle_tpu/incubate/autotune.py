@@ -1,20 +1,36 @@
 """paddle.incubate.autotune (reference: python/paddle/incubate/autotune.py
 set_config for kernel/layout/dataloader autotuning).
 
-XLA owns kernel autotuning on TPU (latency-measured GEMM/conv algorithm
-pick happens inside the compiler); this surface records the requested
-config and applies the pieces that have a TPU-side meaning."""
+On TPU the autotuning story splits in two:
+
+- XLA autotunes its own kernels (latency-measured GEMM/conv algorithm pick
+  inside the compiler) — always on, nothing to configure.
+- Pallas kernels (flash/paged attention) are tuned by paddle_tpu's own
+  measured block-size search with a persistent cache
+  (``paddle_tpu.kernels.autotune`` — the phi autotune-cache analog,
+  paddle/phi/kernels/autotune/cache.h).  ``set_config({"kernel":
+  {"enable": ...}})`` drives that switch, and ``"cache_path"`` relocates
+  the on-disk cache.
+
+``layout`` / ``dataloader`` tuning have no TPU-side meaning (layouts are
+compiler-chosen; the loader autosizes) — accepted and recorded for API
+compatibility.
+"""
 
 from __future__ import annotations
 
 import json
 
-_CONFIG = {"kernel": {"enable": True},      # XLA always autotunes
+from .. import flags
+from ..kernels import autotune as _kernel_autotune
+
+_CONFIG = {"kernel": {"enable": True},
            "layout": {"enable": False},     # layouts are compiler-chosen
            "dataloader": {"enable": False}}
 
 
 def set_config(config=None):
+    """Reference incubate/autotune.py:set_config."""
     global _CONFIG
     if config is None:
         return
@@ -23,8 +39,19 @@ def set_config(config=None):
             config = json.load(f)
     for key, val in config.items():
         _CONFIG.setdefault(key, {}).update(val)
+    kern = _CONFIG.get("kernel", {})
+    if "enable" in kern:
+        flags.set_flags({"autotune_enable": bool(kern["enable"])})
+    if kern.get("cache_path"):
+        flags.set_flags({"autotune_cache_path": kern["cache_path"]})
+        _kernel_autotune.clear()  # re-read from the new location
 
 
 def get_config():
     import copy
     return copy.deepcopy(_CONFIG)   # snapshot: mutations must not leak back
+
+
+def clear_cache(persist: bool = False):
+    """Drop measured tilings (paddle_tpu extension)."""
+    _kernel_autotune.clear(persist=persist)

@@ -517,11 +517,57 @@ def _fa_supported(q, k, causal, mask, seg_q):
     blocks = _blocks_for(q.shape[1], k.shape[1], q.shape[-1])
     if q.dtype == jnp.float64 or mode is None or blocks is None:
         return None, None
+    if mode == "tpu":
+        blocks = _tuned_blocks(q, k, causal, mask, seg_q, blocks)
     if mask is not None:
         bq, bkv = blocks
         if mask.shape[-2] % bq or mask.shape[-1] % bkv:
             return None, None
     return mode, blocks
+
+
+def _tuned_blocks(q, k, causal, mask, seg_q, default):
+    """Measured (block_q, block_kv) from the persistent autotune cache.
+
+    Key is the full kernel configuration (shape bucket x dtype x masking
+    mode x device kind).  On a cold cache with tuning enabled, candidates
+    are timed via standalone compiled probes on dummy data — legal even
+    when this is reached inside an outer trace, since shapes are static and
+    each probe is its own top-level dispatch.  Forward and backward share
+    the chosen tiling (the backward re-derives it through the same cache
+    key), so the custom_vjp pair stays consistent.
+    """
+    from . import autotune
+
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    key = autotune.make_key(
+        "flash_fwd", sq=sq, sk=sk, d=d, hq=hq, hkv=hkv,
+        dt=str(q.dtype), causal=int(bool(causal)),
+        m=int(mask is not None), s=int(seg_q is not None))
+    cands = [c for c in autotune.flash_attention_candidates(sq, sk, d)
+             if mask is None or
+             (mask.shape[-2] % c[0] == 0 and mask.shape[-1] % c[1] == 0)]
+
+    def bench(blocks):
+        import numpy as np_
+
+        rng = np_.random.default_rng(0)
+        shape_q = (min(b, 1), sq, hq, d)
+        qq = jnp.asarray(rng.standard_normal(shape_q), q.dtype)
+        kk = jnp.asarray(
+            rng.standard_normal((min(b, 1), sk, hkv, d)), q.dtype)
+        vv = jnp.asarray(
+            rng.standard_normal((min(b, 1), sk, hkv, d)), q.dtype)
+
+        fn = jax.jit(lambda a, b_, c: _fa_pallas_forward(
+            a, b_, c, causal, None, None, None, blocks, "tpu")[0])
+
+        def timed():
+            jax.block_until_ready(fn(qq, kk, vv))
+        return timed
+
+    return autotune.lookup_or_tune(key, cands, bench, default)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
