@@ -53,13 +53,15 @@ flags.define_flag("flash_attention_interpret", False,
 # XLA reference (CPU fallback + numerics oracle)
 # --------------------------------------------------------------------------
 
-def _reference_attention(q, k, v, causal, mask=None, seg_q=None, seg_k=None):
-    out, _ = _reference_attention_lse(q, k, v, causal, mask, seg_q, seg_k)
+def _reference_attention(q, k, v, causal, mask=None, seg_q=None, seg_k=None,
+                         drop_p=0.0, seed=None):
+    out, _ = _reference_attention_lse(q, k, v, causal, mask, seg_q, seg_k,
+                                      drop_p, seed)
     return out
 
 
 def _reference_attention_lse(q, k, v, causal, mask=None, seg_q=None,
-                             seg_k=None):
+                             seg_k=None, drop_p=0.0, seed=None):
     qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)   # [b, h, sq, d]
     kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
     vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
@@ -80,6 +82,11 @@ def _reference_attention_lse(q, k, v, causal, mask=None, seg_q=None,
         scores = jnp.where(sm[:, None], scores, NEG_INF)
     lse = jax.scipy.special.logsumexp(scores, axis=-1)       # [b, h, sq]
     probs = jnp.exp(scores - lse[..., None])
+    if drop_p:
+        seed_u32 = jnp.asarray(seed, jnp.float32).reshape(()).astype(
+            jnp.uint32)
+        keep = _drop_keep_dense(probs.shape, seed_u32, drop_p)
+        probs = jnp.where(keep, probs, 0.0) * (1.0 / (1.0 - drop_p))
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype), lse
 
@@ -108,6 +115,49 @@ def _needed(i, block_q, block_kv, diag_off):
     return (i * block_q + block_q - 1 + diag_off) // block_kv
 
 
+def _drop_keep(shape, seed_u32, b, h, row0, col0, drop_p):
+    """Deterministic keep-mask for one score block.
+
+    Counter-based stateless RNG (the threefry/philox family's shape, with a
+    murmur3-finalizer mix): each (seed, batch, head, GLOBAL row, GLOBAL col)
+    position hashes to 32 bits compared against drop_p.  Keying on global
+    positions — not block indices — makes the mask invariant to retiling
+    (the autotuner may pick different blocks for fwd and a rerun) and
+    trivially identical across the three kernels.  Pure uint32 jnp math, so
+    it runs identically under Mosaic, interpret mode, and the dense
+    reference path (reference flash_attn dropout:
+    paddle/phi/kernels/gpu/flash_attn_kernel.cu:53).
+    """
+    rows = jax.lax.broadcasted_iota(jnp.uint32, shape, 0) + jnp.uint32(row0)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, shape, 1) + jnp.uint32(col0)
+    bits = _drop_mix(rows, cols, seed_u32, jnp.uint32(b), jnp.uint32(h))
+    return bits >= jnp.uint32(min(int(drop_p * (1 << 32)), (1 << 32) - 1))
+
+
+def _drop_mix(rows, cols, seed_u32, b_u32, h_u32):
+    z = (rows * jnp.uint32(2654435761)) ^ (cols * jnp.uint32(1013904223))
+    z = z ^ (seed_u32 * jnp.uint32(2246822519)) \
+          ^ (b_u32 * jnp.uint32(3266489917)) \
+          ^ (h_u32 * jnp.uint32(668265263))
+    z ^= z >> 16
+    z *= jnp.uint32(2246822519)
+    z ^= z >> 13
+    z *= jnp.uint32(3266489917)
+    z ^= z >> 16
+    return z
+
+
+def _drop_keep_dense(shape4, seed_u32, drop_p):
+    """The same keep-mask over a dense [b, h, sq, sk] score tensor — used by
+    the reference (non-Pallas) path so both paths drop identical positions."""
+    rows = jax.lax.broadcasted_iota(jnp.uint32, shape4, 2)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, shape4, 3)
+    bs = jax.lax.broadcasted_iota(jnp.uint32, shape4, 0)
+    hs = jax.lax.broadcasted_iota(jnp.uint32, shape4, 1)
+    bits = _drop_mix(rows, cols, seed_u32, bs, hs)
+    return bits >= jnp.uint32(min(int(drop_p * (1 << 32)), (1 << 32) - 1))
+
+
 
 
 # --------------------------------------------------------------------------
@@ -115,7 +165,7 @@ def _needed(i, block_q, block_kv, diag_off):
 # --------------------------------------------------------------------------
 
 def _fa_fwd_kernel(*refs, block_q, block_kv, causal, scale, q_len, kv_len,
-                   has_mask, has_seg):
+                   has_mask, has_seg, drop_p=0.0):
     from jax.experimental import pallas as pl
 
     it = iter(refs)
@@ -125,10 +175,13 @@ def _fa_fwd_kernel(*refs, block_q, block_kv, causal, scale, q_len, kv_len,
     mask_ref = next(it) if has_mask else None
     segq_ref = next(it) if has_seg else None
     segk_ref = next(it) if has_seg else None
+    seed_ref = next(it) if drop_p else None
     o_ref = next(it)
     lse_ref = next(it)
     m_sc, l_sc, acc_sc = next(it), next(it), next(it)
 
+    bb = pl.program_id(0)
+    hh = pl.program_id(1)
     i = pl.program_id(2)
     j = pl.program_id(3)
     n_j = pl.num_programs(3)
@@ -161,7 +214,15 @@ def _fa_fwd_kernel(*refs, block_q, block_kv, causal, scale, q_len, kv_len,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         m_sc[...] = m_new
+        # dropout hits the PROBABILITIES (post-softmax): l keeps the
+        # undropped sum (that is the softmax normalizer), acc gets the
+        # masked/rescaled probs — so out = dropout(softmax(s)) @ v exactly
         l_sc[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        if drop_p:
+            keep = _drop_keep(p.shape,
+                              seed_ref[0, 0].astype(jnp.uint32),
+                              bb, hh, i * block_q, j * block_kv, drop_p)
+            p = jnp.where(keep, p, 0.0) * jnp.float32(1.0 / (1.0 - drop_p))
         acc_sc[...] = alpha * acc_sc[...] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -177,7 +238,7 @@ def _fa_fwd_kernel(*refs, block_q, block_kv, causal, scale, q_len, kv_len,
 # --------------------------------------------------------------------------
 
 def _fa_bwd_dq_kernel(*refs, block_q, block_kv, causal, scale, q_len, kv_len,
-                      has_mask, has_seg):
+                      has_mask, has_seg, drop_p=0.0):
     from jax.experimental import pallas as pl
 
     it = iter(refs)
@@ -186,9 +247,12 @@ def _fa_bwd_dq_kernel(*refs, block_q, block_kv, causal, scale, q_len, kv_len,
     mask_ref = next(it) if has_mask else None
     segq_ref = next(it) if has_seg else None
     segk_ref = next(it) if has_seg else None
+    seed_ref = next(it) if drop_p else None
     dq_ref = next(it)
     acc_sc = next(it)
 
+    bb = pl.program_id(0)
+    hh = pl.program_id(1)
     i = pl.program_id(2)
     j = pl.program_id(3)
     n_j = pl.num_programs(3)
@@ -220,6 +284,13 @@ def _fa_bwd_dq_kernel(*refs, block_q, block_kv, causal, scale, q_len, kv_len,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if drop_p:
+            # dP = mask/(1-p) o (dO V^T); delta = rowsum(dO o O) is already
+            # the dropped-P inner product, so the softmax-bwd form is intact
+            keep = _drop_keep(p.shape,
+                              seed_ref[0, 0].astype(jnp.uint32),
+                              bb, hh, i * block_q, j * block_kv, drop_p)
+            dp = jnp.where(keep, dp, 0.0) * jnp.float32(1.0 / (1.0 - drop_p))
         ds = p * (dp - delta)
         acc_sc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -231,7 +302,7 @@ def _fa_bwd_dq_kernel(*refs, block_q, block_kv, causal, scale, q_len, kv_len,
 
 
 def _fa_bwd_dkv_kernel(*refs, block_q, block_kv, causal, scale, q_len,
-                       kv_len, has_mask, has_seg):
+                       kv_len, has_mask, has_seg, drop_p=0.0):
     """Grid (b, hq, kv_blocks, q_blocks): per-Q-HEAD dK/dV partials for one
     KV block, streaming Q blocks; group partials are summed outside."""
     from jax.experimental import pallas as pl
@@ -242,9 +313,12 @@ def _fa_bwd_dkv_kernel(*refs, block_q, block_kv, causal, scale, q_len,
     mask_ref = next(it) if has_mask else None
     segq_ref = next(it) if has_seg else None
     segk_ref = next(it) if has_seg else None
+    seed_ref = next(it) if drop_p else None
     dk_ref, dv_ref = next(it), next(it)
     dk_sc, dv_sc = next(it), next(it)
 
+    bb = pl.program_id(0)
+    hh = pl.program_id(1)
     kv_idx = pl.program_id(2)
     jq = pl.program_id(3)
     n_q = pl.num_programs(3)
@@ -276,11 +350,22 @@ def _fa_bwd_dkv_kernel(*refs, block_q, block_kv, causal, scale, q_len,
             segq_blk=segq_ref[...] if has_seg else None,
             segk_blk=segk_ref[...] if has_seg else None)
         p = jnp.exp(s - lse)
+        if drop_p:
+            keep = _drop_keep(p.shape,
+                              seed_ref[0, 0].astype(jnp.uint32),
+                              bb, hh, jq * block_q, kv_idx * block_kv,
+                              drop_p)
+            inv = jnp.float32(1.0 / (1.0 - drop_p))
+            pd = jnp.where(keep, p, 0.0) * inv
+        else:
+            pd = p
         dv_sc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pd, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if drop_p:
+            dp = jnp.where(keep, dp, 0.0) * inv
         ds = p * (dp - delta)
         # q is pre-scaled, so this carries the `scale` factor already
         dk_sc[...] += jax.lax.dot_general(
@@ -320,8 +405,8 @@ def _heads_first(x):
 
 
 def _specs_common(has_mask, has_seg, mask_heads, group, blocks, sq, sk, d,
-                  causal, dkv_layout=False):
-    """(in_specs for q,k,v[,mask][,segq,segk]) given the masking modes.
+                  causal, dkv_layout=False, with_seed=False):
+    """(in_specs for q,k,v[,mask][,segq,segk][,seed]) given the masking modes.
     Index-map convention: grid = (b, h, X, Y).  With causal, the streamed
     operand's block index is clamped to the last/first needed block, so the
     skipped iterations re-fetch the same block and Mosaic elides the DMA —
@@ -367,10 +452,12 @@ def _specs_common(has_mask, has_seg, mask_heads, group, blocks, sq, sk, d,
     if has_seg:
         specs.append(pl.BlockSpec((None, block_q, 1), sqmap))
         specs.append(pl.BlockSpec((None, block_kv, 1), skmap))
+    if with_seed:
+        specs.append(pl.BlockSpec((1, 1), lambda *_: (0, 0)))
     return specs, qmap
 
 
-def _prep_mask_segs(mask, seg_q, seg_k):
+def _prep_mask_segs(mask, seg_q, seg_k, drop_p=0.0, seed=None):
     has_mask = mask is not None
     has_seg = seg_q is not None
     mask_heads = mask.shape[1] if has_mask else 0
@@ -382,10 +469,14 @@ def _prep_mask_segs(mask, seg_q, seg_k):
         # kernel operand a float (simplest Mosaic layout path)
         extra.append(seg_q.astype(jnp.float32)[:, :, None])
         extra.append(seg_k.astype(jnp.float32)[:, :, None])
+    if drop_p:
+        # seed < 2^24 rides as float32 like the segment ids
+        extra.append(jnp.asarray(seed, jnp.float32).reshape(1, 1))
     return has_mask, has_seg, mask_heads, extra
 
 
-def _fa_pallas_forward(q, k, v, causal, mask, seg_q, seg_k, blocks, mode):
+def _fa_pallas_forward(q, k, v, causal, mask, seg_q, seg_k, blocks, mode,
+                       drop_p=0.0, seed=None):
     from jax.experimental import pallas as pl
 
     b, sq, hq, d = q.shape
@@ -393,13 +484,16 @@ def _fa_pallas_forward(q, k, v, causal, mask, seg_q, seg_k, blocks, mode):
     group = hq // hkv
     block_q, block_kv = blocks
     scale = 1.0 / math.sqrt(d)
-    has_mask, has_seg, mask_heads, extra = _prep_mask_segs(mask, seg_q, seg_k)
+    has_mask, has_seg, mask_heads, extra = _prep_mask_segs(
+        mask, seg_q, seg_k, drop_p, seed)
 
     kernel = functools.partial(
         _fa_fwd_kernel, block_q=block_q, block_kv=block_kv, causal=causal,
-        scale=scale, q_len=sq, kv_len=sk, has_mask=has_mask, has_seg=has_seg)
+        scale=scale, q_len=sq, kv_len=sk, has_mask=has_mask, has_seg=has_seg,
+        drop_p=drop_p)
     in_specs, qmap = _specs_common(has_mask, has_seg, mask_heads, group,
-                                   blocks, sq, sk, d, causal)
+                                   blocks, sq, sk, d, causal,
+                                   with_seed=bool(drop_p))
     return _fwd_call(kernel, b, hq, sq, sk, d, blocks, in_specs, qmap,
                      q, k, v, extra, mode)
 
@@ -433,7 +527,7 @@ def _fwd_call(kernel, b, hq, sq, sk, d, blocks, in_specs, qmap, q, k, v,
 
 
 def _fa_pallas_backward(q, k, v, out, lse, g, causal, mask, seg_q, seg_k,
-                        blocks, mode):
+                        blocks, mode, drop_p=0.0, seed=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -442,7 +536,8 @@ def _fa_pallas_backward(q, k, v, out, lse, g, causal, mask, seg_q, seg_k,
     group = hq // hkv
     block_q, block_kv = blocks
     scale = 1.0 / math.sqrt(d)
-    has_mask, has_seg, mask_heads, extra = _prep_mask_segs(mask, seg_q, seg_k)
+    has_mask, has_seg, mask_heads, extra = _prep_mask_segs(
+        mask, seg_q, seg_k, drop_p, seed)
 
     qf, kf, vf = _heads_first(q), _heads_first(k), _heads_first(v)
     of, gf = _heads_first(out), _heads_first(g)
@@ -451,11 +546,12 @@ def _fa_pallas_backward(q, k, v, out, lse, g, causal, mask, seg_q, seg_k,
 
     common = dict(block_q=block_q, block_kv=block_kv, causal=causal,
                   scale=scale, q_len=sq, kv_len=sk, has_mask=has_mask,
-                  has_seg=has_seg)
+                  has_seg=has_seg, drop_p=drop_p)
 
     # ---- dQ: grid (b, hq, q_blocks, kv_blocks) ----
     in_specs, qmap = _specs_common(has_mask, has_seg, mask_heads, group,
-                                   blocks, sq, sk, d, causal)
+                                   blocks, sq, sk, d, causal,
+                                   with_seed=bool(drop_p))
     # q,k,v + do,lse,delta share q-block/row indexing
     rowmap = qmap
     dq_specs = in_specs[:3] + [
@@ -476,7 +572,7 @@ def _fa_pallas_backward(q, k, v, out, lse, g, causal, mask, seg_q, seg_k,
     # ---- dK/dV: grid (b, hq, kv_blocks, q_blocks), per-q-head partials ----
     in_specs2, qmap2 = _specs_common(has_mask, has_seg, mask_heads, group,
                                      blocks, sq, sk, d, causal,
-                                     dkv_layout=True)
+                                     dkv_layout=True, with_seed=bool(drop_p))
     dkv_specs = in_specs2[:3] + [
         pl.BlockSpec((None, None, block_q, d), qmap2),
         pl.BlockSpec((None, None, block_q, 1), qmap2),
@@ -570,58 +666,87 @@ def _tuned_blocks(q, k, causal, mask, seg_q, default):
     return autotune.lookup_or_tune(key, cands, bench, default)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _fa_core(q, k, v, causal, mask, seg_q, seg_k):
-    out, _ = _fa_core_fwd(q, k, v, causal, mask, seg_q, seg_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fa_core(q, k, v, causal, drop_p, mask, seg_q, seg_k, seed):
+    out, _ = _fa_core_fwd(q, k, v, causal, drop_p, mask, seg_q, seg_k, seed)
     return out
 
 
-def _fa_core_fwd(q, k, v, causal, mask, seg_q, seg_k):
+def _fa_core_fwd(q, k, v, causal, drop_p, mask, seg_q, seg_k, seed):
     mode, blocks = _fa_supported(q, k, causal, mask, seg_q)
     if mode is None:
         out, lse = _reference_attention_lse(q, k, v, causal, mask, seg_q,
-                                            seg_k)
-        return out, (q, k, v, mask, seg_q, seg_k, None, None)
+                                            seg_k, drop_p, seed)
+        return out, (q, k, v, mask, seg_q, seg_k, seed, None, None)
     out, lse = _fa_pallas_forward(q, k, v, causal, mask, seg_q, seg_k,
-                                  blocks, mode)
-    return jnp.swapaxes(out, 1, 2), (q, k, v, mask, seg_q, seg_k,
+                                  blocks, mode, drop_p, seed)
+    return jnp.swapaxes(out, 1, 2), (q, k, v, mask, seg_q, seg_k, seed,
                                      jnp.swapaxes(out, 1, 2), lse)
 
 
-def _fa_core_bwd(causal, res, g):
-    q, k, v, mask, seg_q, seg_k, out, lse = res
+def _fa_core_bwd(causal, drop_p, res, g):
+    q, k, v, mask, seg_q, seg_k, seed, out, lse = res
     zeros = lambda t: None if t is None else jnp.zeros_like(t)
     if out is None:
         _, vjp = jax.vjp(
             lambda q_, k_, v_: _reference_attention(q_, k_, v_, causal, mask,
-                                                    seg_q, seg_k), q, k, v)
+                                                    seg_q, seg_k, drop_p,
+                                                    seed), q, k, v)
         dq, dk, dv = vjp(g)
-        return dq, dk, dv, zeros(mask), zeros(seg_q), zeros(seg_k)
+        return dq, dk, dv, zeros(mask), zeros(seg_q), zeros(seg_k), \
+            zeros(seed)
     mode, blocks = _fa_supported(q, k, causal, mask, seg_q)
     dq, dk, dv = _fa_pallas_backward(q, k, v, out, lse, g, causal, mask,
-                                     seg_q, seg_k, blocks, mode)
-    return dq, dk, dv, zeros(mask), zeros(seg_q), zeros(seg_k)
+                                     seg_q, seg_k, blocks, mode, drop_p,
+                                     seed)
+    return dq, dk, dv, zeros(mask), zeros(seg_q), zeros(seg_k), zeros(seed)
 
 
 _fa_core.defvjp(_fa_core_fwd, _fa_core_bwd)
 
 
 def _flash_attention_arrays(q, k, v, causal, mask=None, seg_q=None,
-                            seg_k=None):
-    return _fa_core(q, k, v, causal, mask, seg_q, seg_k)
+                            seg_k=None, drop_p=0.0, seed=None):
+    if drop_p and seed is None:
+        raise ValueError("flash attention dropout requires a seed")
+    return _fa_core(q, k, v, causal, float(drop_p), mask, seg_q, seg_k,
+                    seed if drop_p else jnp.zeros((1, 1), jnp.float32))
 
 
-def flash_attention(query, key, value, causal=False, attn_mask=None):
+def flash_attention(query, key, value, causal=False, attn_mask=None,
+                    dropout=0.0, training=True, rng_name=None):
     """Tensor-level flash attention, layout [b, s, h, d].
 
     GQA-native: key/value may have fewer heads (a divisor of the query
     heads).  ``attn_mask``: additive fp32 mask [b, 1|h, sq, sk] (reference
     flash_attn attn_mask surface), streamed blockwise by the kernel.
+    ``dropout``: attention-probability dropout rate applied in-kernel
+    (reference flash_attn_kernel.cu:53); active when ``training``.  The
+    keep-mask is a counter-based hash of (seed, batch, head, position) —
+    deterministic given the paddle RNG state, invariant to tiling, and
+    identical between the fused and reference paths.
     """
     args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
     args = tuple(a if isinstance(a, Tensor) else Tensor(a) for a in args)
+    drop_p = float(dropout) if training else 0.0
 
-    if attn_mask is not None:
+    if drop_p:
+        from ..core.random import next_key
+
+        # one seed per call from the paddle RNG stream (< 2^24: rides as
+        # float32 through the custom_vjp like the segment ids)
+        seed = jax.random.randint(next_key(), (1, 1), 0, 1 << 23
+                                  ).astype(jnp.float32)
+        args = args + (Tensor(seed),)
+        if attn_mask is not None:
+            def prim(q, k, v, m, sd):
+                return _flash_attention_arrays(q, k, v, causal, mask=m,
+                                               drop_p=drop_p, seed=sd)
+        else:
+            def prim(q, k, v, sd):
+                return _flash_attention_arrays(q, k, v, causal,
+                                               drop_p=drop_p, seed=sd)
+    elif attn_mask is not None:
         def prim(q, k, v, m):
             return _flash_attention_arrays(q, k, v, causal, mask=m)
     else:

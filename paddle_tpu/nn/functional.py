@@ -785,14 +785,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     if attn_mask is not None:
         args.append(_t(attn_mask))
 
-    def prim(q, k, v, *mask):
+    has_mask = attn_mask is not None
+
+    def prim(q, k, v, *rest):
         qh = jnp.swapaxes(q, 1, 2)  # b h s d
         kh = jnp.swapaxes(k, 1, 2)
         vh = jnp.swapaxes(v, 1, 2)
         scale = 1.0 / _math.sqrt(q.shape[-1])
         scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
-        if mask:
-            m = mask[0]
+        if has_mask:
+            m = rest[0]
             if np.dtype(m.dtype) == np.bool_:
                 scores = jnp.where(m, scores, -1e9)
             else:
@@ -802,18 +804,31 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
             causal = jnp.tril(jnp.ones((sq, sk), bool))
             scores = jnp.where(causal, scores, -1e9)
         probs = jax.nn.softmax(scores, axis=-1)
+        if drop_active:
+            # dropout on the ATTENTION PROBABILITIES (reference semantics,
+            # flash_attn_kernel.cu dropout), with the same portable
+            # counter-hash mask as the fused kernel
+            from ..kernels.flash_attention import _drop_keep_dense
+            seed_u32 = rest[-1].reshape(()).astype(jnp.uint32)
+            keep = _drop_keep_dense(probs.shape, seed_u32, float(dropout_p))
+            probs = jnp.where(keep, probs, 0.0) * (1.0 / (1.0 - dropout_p))
         out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
         return jnp.swapaxes(out, 1, 2)
-    out = apply_op("sdpa", prim, tuple(args))
-    if dropout_p > 0.0 and training:
-        out = dropout(out, p=dropout_p, training=training)
-    return out
+
+    drop_active = dropout_p > 0.0 and training
+    if drop_active:
+        from ..core.random import next_key
+        seed = jax.random.randint(next_key(), (1, 1), 0, 1 << 23
+                                  ).astype(jnp.float32)
+        args.append(Tensor(seed))
+    return apply_op("sdpa", prim, tuple(args))
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
                     fixed_seed_offset=None, rng_name="", training=True, name=None):
     from ..kernels.flash_attention import flash_attention as _fa
-    out = _fa(query, key, value, causal=causal)
+    out = _fa(query, key, value, causal=causal, dropout=dropout,
+              training=training)
     return (out, None) if return_softmax is not None else out
 
 

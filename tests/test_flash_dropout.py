@@ -1,0 +1,191 @@
+"""Flash-attention dropout tests (interpreter mode on CPU).
+
+Reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu:53 (dropout in the
+fused kernel signature) + the mpu RNG determinism contract.  The keep-mask
+is a counter-based hash of (seed, batch, head, global position), computed
+identically by the fused kernels (fwd, dQ, dK/dV) and the dense reference
+path — so the Pallas path can be tested bit-for-bit against dense math with
+the SAME mask, and the mask is invariant to the autotuner's tiling choice.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.kernels.flash_attention as fa
+from paddle_tpu import flags
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    old = flags.get_flags(["flash_attention_interpret",
+                           "flash_attention_block_q",
+                           "flash_attention_block_kv"])
+    flags.set_flags({"flash_attention_interpret": True,
+                     "flash_attention_block_q": 64,
+                     "flash_attention_block_kv": 64})
+    yield
+    flags.set_flags(old)
+
+
+def _rand(rng, shape, dtype=jnp.float32):
+    return jnp.asarray(rng.standard_normal(shape), dtype)
+
+
+def _seed(v=7.0):
+    return jnp.full((1, 1), v, jnp.float32)
+
+
+def test_p0_matches_no_dropout(rng):
+    q, k, v = (_rand(rng, (1, 128, 2, 64)) for _ in range(3))
+    a = fa._flash_attention_arrays(q, k, v, True)
+    b = fa._flash_attention_arrays(q, k, v, True, drop_p=0.0, seed=_seed())
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_kernel_matches_dense_reference_with_same_mask(rng, causal):
+    q, k, v = (_rand(rng, (2, 128, 2, 64)) for _ in range(3))
+    kern = fa._flash_attention_arrays(q, k, v, causal, drop_p=0.3,
+                                      seed=_seed())
+    ref = fa._reference_attention(q, k, v, causal, drop_p=0.3, seed=_seed())
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_backward_matches_dense_reference(rng):
+    q, k, v = (_rand(rng, (1, 128, 2, 64)) for _ in range(3))
+    g = _rand(rng, (1, 128, 2, 64))
+
+    def kern(q_, k_, v_):
+        return fa._flash_attention_arrays(q_, k_, v_, True, drop_p=0.25,
+                                          seed=_seed())
+
+    def dense(q_, k_, v_):
+        return fa._reference_attention(q_, k_, v_, True, drop_p=0.25,
+                                       seed=_seed())
+
+    _, vjp_k = jax.vjp(kern, q, k, v)
+    _, vjp_d = jax.vjp(dense, q, k, v)
+    for gk, gd, name in zip(vjp_k(g), vjp_d(g), "qkv"):
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gd),
+                                   rtol=5e-3, atol=5e-3, err_msg=name)
+
+
+def test_gqa_dropout_backward(rng):
+    q = _rand(rng, (1, 128, 4, 64))
+    k = _rand(rng, (1, 128, 2, 64))
+    v = _rand(rng, (1, 128, 2, 64))
+    g = _rand(rng, (1, 128, 4, 64))
+
+    def kern(q_, k_, v_):
+        return fa._flash_attention_arrays(q_, k_, v_, False, drop_p=0.2,
+                                          seed=_seed(3.0))
+
+    def dense(q_, k_, v_):
+        return fa._reference_attention(q_, k_, v_, False, drop_p=0.2,
+                                       seed=_seed(3.0))
+
+    np.testing.assert_allclose(np.asarray(kern(q, k, v)),
+                               np.asarray(dense(q, k, v)),
+                               rtol=2e-3, atol=2e-3)
+    _, vjp_k = jax.vjp(kern, q, k, v)
+    _, vjp_d = jax.vjp(dense, q, k, v)
+    for gk, gd in zip(vjp_k(g), vjp_d(g)):
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gd),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_keep_rate_and_mean_preservation():
+    shape = (2, 4, 256, 256)
+    keep = fa._drop_keep_dense(shape, jnp.uint32(123), 0.3)
+    rate = float(jnp.mean(keep.astype(jnp.float32)))
+    assert abs(rate - 0.7) < 0.01
+    # heads draw different masks
+    k0, k1 = np.asarray(keep[0, 0]), np.asarray(keep[0, 1])
+    assert (k0 != k1).mean() > 0.1
+    # batches too
+    assert (np.asarray(keep[0, 0]) != np.asarray(keep[1, 0])).mean() > 0.1
+
+
+def test_mask_is_tiling_invariant(rng):
+    """Same seed, different block sizes -> identical dropped output (the
+    autotuner may change tilings between runs)."""
+    q, k, v = (_rand(rng, (1, 128, 2, 64)) for _ in range(3))
+    out_64 = fa._flash_attention_arrays(q, k, v, False, drop_p=0.4,
+                                        seed=_seed(11.0))
+    flags.set_flags({"flash_attention_block_q": 128,
+                     "flash_attention_block_kv": 32})
+    out_mix = fa._flash_attention_arrays(q, k, v, False, drop_p=0.4,
+                                         seed=_seed(11.0))
+    np.testing.assert_allclose(np.asarray(out_64), np.asarray(out_mix),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_seed_determinism_and_variation(rng):
+    q, k, v = (_rand(rng, (1, 64, 2, 64)) for _ in range(3))
+    a1 = fa._flash_attention_arrays(q, k, v, False, drop_p=0.3, seed=_seed(5.0))
+    a2 = fa._flash_attention_arrays(q, k, v, False, drop_p=0.3, seed=_seed(5.0))
+    b = fa._flash_attention_arrays(q, k, v, False, drop_p=0.3, seed=_seed(6.0))
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    assert not np.allclose(np.asarray(a1), np.asarray(b))
+
+
+def test_tensor_api_training_eval_and_paddle_seed(rng):
+    import paddle_tpu as P
+    from paddle_tpu.kernels.flash_attention import flash_attention
+
+    q, k, v = (P.to_tensor(np.asarray(_rand(rng, (1, 64, 2, 64))))
+               for _ in range(3))
+    ev = flash_attention(q, k, v, dropout=0.3, training=False)
+    base = flash_attention(q, k, v)
+    np.testing.assert_allclose(ev.numpy(), base.numpy(), rtol=1e-6)
+
+    P.seed(42)
+    t1 = flash_attention(q, k, v, dropout=0.3)
+    P.seed(42)
+    t2 = flash_attention(q, k, v, dropout=0.3)
+    t3 = flash_attention(q, k, v, dropout=0.3)  # stream advanced
+    np.testing.assert_array_equal(t1.numpy(), t2.numpy())
+    assert not np.allclose(t1.numpy(), t3.numpy())
+    # dropout keeps the output mean roughly unbiased
+    assert abs(float(t1.mean()) - float(base.mean())) < 0.05
+
+
+def test_sdpa_prob_dropout(rng):
+    """scaled_dot_product_attention drops ATTENTION PROBABILITIES (not the
+    output): zero rate and eval mode match the plain path; train mode is
+    seed-deterministic under paddle.seed and roughly mean-preserving."""
+    import paddle_tpu as P
+    import paddle_tpu.nn.functional as F
+
+    q, k, v = (P.to_tensor(np.asarray(_rand(rng, (1, 32, 2, 16))))
+               for _ in range(3))
+    base = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    ev = F.scaled_dot_product_attention(q, k, v, dropout_p=0.5,
+                                        is_causal=True, training=False)
+    np.testing.assert_allclose(ev.numpy(), base.numpy(), rtol=1e-6)
+
+    P.seed(7)
+    t1 = F.scaled_dot_product_attention(q, k, v, dropout_p=0.3,
+                                        is_causal=True)
+    P.seed(7)
+    t2 = F.scaled_dot_product_attention(q, k, v, dropout_p=0.3,
+                                        is_causal=True)
+    np.testing.assert_array_equal(t1.numpy(), t2.numpy())
+    assert not np.allclose(t1.numpy(), base.numpy())
+    assert abs(float(t1.mean()) - float(base.mean())) < 0.1
+    # backward works through the dropped probs
+    t1.sum().backward()
+
+
+def test_mp_ranks_draw_identical_masks():
+    """The mask depends only on (seed, batch, head index, position) — two
+    ranks evaluating the same logical shard state (same seed, same local
+    head indices) produce identical masks, the determinism contract of the
+    reference's RNG tracker (mpu/random.py)."""
+    shape = (1, 2, 64, 64)
+    m_rank0 = fa._drop_keep_dense(shape, jnp.uint32(99), 0.2)
+    m_rank1 = fa._drop_keep_dense(shape, jnp.uint32(99), 0.2)
+    np.testing.assert_array_equal(np.asarray(m_rank0), np.asarray(m_rank1))
