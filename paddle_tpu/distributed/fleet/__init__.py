@@ -58,13 +58,73 @@ class DistributedStrategy:
             self.__dict__[k] = v
 
 
+class Role:
+    """reference role_maker.py Role enum."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    """Role resolution for fleet.init (reference
+    python/paddle/distributed/fleet/base/role_maker.py).
+
+    On TPU only COLLECTIVE mode executes; a parameter-server role is
+    accepted so PS-mode scripts import and introspect cleanly, but the
+    PS runtime entry points raise with guidance (SURVEY §7.5: the PS stack
+    is substituted by collective training + selected-rows sparse grads +
+    sharding)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = bool(is_collective)
+        self._kwargs = kwargs
+
+    def _role(self) -> int:
+        import os
+        if os.environ.get("PADDLE_TRAINING_ROLE", "").upper() == "PSERVER":
+            return Role.SERVER
+        return Role.WORKER
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    pass
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective, **kwargs)
+        self._current_id = kwargs.get("current_id", 0)
+        self._user_role = kwargs.get("role")
+
+    def _role(self) -> int:
+        if self._user_role is not None:
+            return self._user_role
+        return super()._role()
+
+
+_PS_GUIDANCE = (
+    "the parameter-server runtime is not implemented in paddle_tpu "
+    "(SURVEY §7.5: excluded by design on TPU). Use collective mode — "
+    "fleet.init(is_collective=True) — where the PS use-cases map to: "
+    "sparse embedding gradients (nn.Embedding(sparse=True) + selected-rows "
+    "optimizers), optimizer-state sharding (ParallelConfig zero1/zero3), "
+    "and VocabParallelEmbedding for huge vocabularies.")
+
+
 class _Fleet:
     def __init__(self):
         self._strategy: Optional[DistributedStrategy] = None
         self._is_initialized = False
+        self._role_maker: Optional[RoleMakerBase] = None
 
     def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
-        """reference fleet.py:218 — builds the hybrid topology/mesh."""
+        """reference fleet.py:218 — builds the hybrid topology/mesh.
+
+        A non-collective role_maker is recorded so is_server()/is_worker()
+        answer, but server-side entry points raise (see _PS_GUIDANCE)."""
+        self._role_maker = role_maker
         self._strategy = strategy or DistributedStrategy()
         hc = self._strategy.hybrid_configs
         env.init_parallel_env()
@@ -74,6 +134,45 @@ class _Fleet:
             sep=hc.get("sep_degree", 1))
         self._is_initialized = True
         return self
+
+    # ---- parameter-server surface (reference fleet.py:812-1160) ----
+    def is_worker(self) -> bool:
+        rm = self._role_maker
+        return rm is None or rm._role() == Role.WORKER
+
+    def is_server(self) -> bool:
+        rm = self._role_maker
+        return rm is not None and rm._role() == Role.SERVER
+
+    def is_coordinator(self) -> bool:
+        return False
+
+    def init_server(self, *args, **kwargs):
+        raise NotImplementedError(f"fleet.init_server: {_PS_GUIDANCE}")
+
+    def run_server(self):
+        raise NotImplementedError(f"fleet.run_server: {_PS_GUIDANCE}")
+
+    def stop_worker(self):
+        raise NotImplementedError(f"fleet.stop_worker: {_PS_GUIDANCE}")
+
+    def init_worker(self, scopes=None):
+        raise NotImplementedError(f"fleet.init_worker: {_PS_GUIDANCE}")
+
+    def save_persistables(self, *args, **kwargs):
+        raise NotImplementedError(f"fleet.save_persistables: {_PS_GUIDANCE}")
+
+    def barrier_worker(self):
+        """reference fleet.py:931 — worker barrier (collective path)."""
+        from ..communication import barrier
+        if env.get_world_size() > 1:
+            barrier()
+
+    def server_num(self) -> int:
+        return 0
+
+    def server_index(self) -> int:
+        raise NotImplementedError(f"fleet.server_index: {_PS_GUIDANCE}")
 
     def is_first_worker(self) -> bool:
         return env.get_rank() == 0
@@ -128,6 +227,13 @@ distributed_optimizer = fleet.distributed_optimizer
 get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
 worker_index = fleet.worker_index
 worker_num = fleet.worker_num
+is_worker = fleet.is_worker
+is_server = fleet.is_server
+init_server = fleet.init_server
+run_server = fleet.run_server
+init_worker = fleet.init_worker
+stop_worker = fleet.stop_worker
+barrier_worker = fleet.barrier_worker
 
 from . import elastic  # noqa: E402,F401
 from .elastic import ElasticManager, ElasticProgram, ElasticStatus  # noqa: E402,F401

@@ -1,7 +1,9 @@
 """paddle.text (reference: python/paddle/text/ — dataset loaders).
 
 Zero-egress environment: dataset classes require local files; `viterbi_decode`
-(the one algorithmic API) is implemented.
+(the one algorithmic API) is implemented.  The vocab/strings surface (the
+tokenizer-adjacent host side of phi/core/vocab + phi/kernels/strings) lives
+in :mod:`paddle_tpu.text.vocab`.
 """
 
 from __future__ import annotations
@@ -10,6 +12,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..ops._prim import apply_op
+from .vocab import Vocab, lower, upper, whitespace_tokenize  # noqa: F401
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

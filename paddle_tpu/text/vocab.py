@@ -1,0 +1,148 @@
+"""Host-side vocabulary + string ops — the tokenizer-adjacent surface.
+
+Reference: paddle/phi/core/vocab/string_array.h (the vocab core consumed by
+the faster-tokenizer ops) and paddle/phi/kernels/strings/ (string-tensor
+lower/upper with unicode handling, case_utils.h).  TPU-native shape: strings
+never reach the device — the vocab maps text to int32 id arrays on host
+(what the device actually consumes) and the case kernels are host functions
+over python/numpy strings, mirroring the reference CPU string kernels.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+class Vocab:
+    """Token <-> id mapping (reference string_array.h Vocab + the
+    paddlenlp-style construction surface).
+
+    Build with :meth:`build_from_corpus`/:meth:`from_dict`/:meth:`load`;
+    call with token lists to get padded int32 arrays ready for embedding
+    lookup on device.
+    """
+
+    def __init__(self, token_to_idx: Dict[str, int],
+                 unk_token: Optional[str] = "[UNK]",
+                 pad_token: Optional[str] = "[PAD]"):
+        self._token_to_idx = dict(token_to_idx)
+        self._idx_to_token = {i: t for t, i in self._token_to_idx.items()}
+        if len(self._idx_to_token) != len(self._token_to_idx):
+            raise ValueError("duplicate indices in token_to_idx")
+        self.unk_token = unk_token
+        self.pad_token = pad_token
+        for special in (unk_token, pad_token):
+            if special is not None and special not in self._token_to_idx:
+                raise ValueError(f"special token {special!r} not in vocab")
+
+    # ---- construction ---------------------------------------------------
+    @classmethod
+    def build_from_corpus(cls, corpus: Iterable[Sequence[str]],
+                          min_freq: int = 1, max_size: Optional[int] = None,
+                          unk_token: str = "[UNK]", pad_token: str = "[PAD]",
+                          specials: Sequence[str] = ()):
+        counter: Counter = Counter()
+        for sent in corpus:
+            counter.update(sent)
+        toks = [pad_token, unk_token] + [s for s in specials
+                                         if s not in (pad_token, unk_token)]
+        for tok, freq in counter.most_common():
+            if freq < min_freq or tok in toks:
+                continue
+            if max_size is not None and len(toks) >= max_size:
+                break
+            toks.append(tok)
+        return cls({t: i for i, t in enumerate(toks)},
+                   unk_token=unk_token, pad_token=pad_token)
+
+    @classmethod
+    def from_dict(cls, token_to_idx, **kw):
+        return cls(token_to_idx, **kw)
+
+    @classmethod
+    def load(cls, path: str, **kw):
+        with open(path, encoding="utf-8") as f:
+            first = f.read(1)
+            f.seek(0)
+            if first == "{":           # json dump from save()
+                data = json.load(f)
+                return cls(data["token_to_idx"],
+                           unk_token=data.get("unk_token"),
+                           pad_token=data.get("pad_token"))
+            # plain token-per-line file (the common vocab.txt format)
+            toks = [line.rstrip("\n") for line in f if line.rstrip("\n")]
+        return cls({t: i for i, t in enumerate(toks)}, **kw)
+
+    def save(self, path: str):
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"token_to_idx": self._token_to_idx,
+                       "unk_token": self.unk_token,
+                       "pad_token": self.pad_token}, f, ensure_ascii=False)
+
+    # ---- lookup ---------------------------------------------------------
+    def __len__(self):
+        return len(self._token_to_idx)
+
+    def __contains__(self, token):
+        return token in self._token_to_idx
+
+    def to_indices(self, tokens):
+        unk = self._token_to_idx.get(self.unk_token) \
+            if self.unk_token is not None else None
+        if isinstance(tokens, str):
+            idx = self._token_to_idx.get(tokens, unk)
+            if idx is None:
+                raise KeyError(tokens)
+            return idx
+        return [self.to_indices(t) for t in tokens]
+
+    def to_tokens(self, indices):
+        if isinstance(indices, (int, np.integer)):
+            return self._idx_to_token[int(indices)]
+        return [self.to_tokens(i) for i in np.asarray(indices).tolist()]
+
+    @property
+    def token_to_idx(self):
+        return dict(self._token_to_idx)
+
+    @property
+    def idx_to_token(self):
+        return dict(self._idx_to_token)
+
+    def __call__(self, batch, max_len: Optional[int] = None):
+        """Token lists -> padded int32 [batch, T] numpy array (+ lengths)."""
+        ids = [self.to_indices(list(sent)) for sent in batch]
+        lens = np.asarray([len(s) for s in ids], np.int32)
+        T = max_len or (int(lens.max()) if len(ids) else 0)
+        pad = self._token_to_idx.get(self.pad_token, 0) \
+            if self.pad_token is not None else 0
+        out = np.full((len(ids), T), pad, np.int32)
+        for r, s in enumerate(ids):
+            out[r, :T][:len(s)] = s[:T]
+        return out, lens
+
+
+# ---- string case kernels (reference phi/kernels/strings/ lower/upper) ----
+
+def lower(x, use_utf8_encoding: bool = True):
+    """strings_lower_upper_kernel: elementwise unicode-aware lowercase."""
+    if isinstance(x, str):
+        return x.lower() if use_utf8_encoding else \
+            x.encode("ascii", "ignore").decode().lower()
+    return [lower(s, use_utf8_encoding) for s in x]
+
+
+def upper(x, use_utf8_encoding: bool = True):
+    if isinstance(x, str):
+        return x.upper() if use_utf8_encoding else \
+            x.encode("ascii", "ignore").decode().upper()
+    return [upper(s, use_utf8_encoding) for s in x]
+
+
+def whitespace_tokenize(text: str) -> List[str]:
+    """The faster-tokenizer pre-tokenization primitive."""
+    return text.split()

@@ -1,0 +1,95 @@
+"""PS-mode API stubs + text vocab/strings surface.
+
+Reference: fleet PS entry points (fleet.py:812 is_worker, :912 is_server,
+:1016 init_server, :1117 run_server, :1142 stop_worker) and the strings/
+vocab kernels (phi/kernels/strings/, phi/core/vocab/string_array.h).
+SURVEY §7.5 excludes the PS runtime on TPU but promises the API surface
+with actionable errors.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed.fleet as fleet_mod
+from paddle_tpu.distributed.fleet import (PaddleCloudRoleMaker, Role,
+                                          UserDefinedRoleMaker, fleet)
+from paddle_tpu.text import Vocab, lower, upper, whitespace_tokenize
+
+
+class TestPSStubs:
+    def test_collective_defaults(self):
+        assert fleet.is_worker() is True
+        assert fleet.is_server() is False
+        assert fleet.server_num() == 0
+        fleet.barrier_worker()  # no-op single process
+
+    def test_ps_entry_points_raise_with_guidance(self):
+        for fn in (fleet.init_server, fleet.run_server, fleet.stop_worker,
+                   fleet.init_worker, fleet.save_persistables):
+            with pytest.raises(NotImplementedError, match="collective"):
+                fn()
+        assert hasattr(fleet_mod, "init_server")
+        assert hasattr(fleet_mod, "run_server")
+
+    def test_role_maker_roles(self, monkeypatch):
+        rm = PaddleCloudRoleMaker(is_collective=False)
+        fleet._role_maker = rm
+        try:
+            assert fleet.is_worker() and not fleet.is_server()
+            monkeypatch.setenv("PADDLE_TRAINING_ROLE", "PSERVER")
+            assert fleet.is_server() and not fleet.is_worker()
+            rm2 = UserDefinedRoleMaker(role=Role.SERVER, current_id=0)
+            fleet._role_maker = rm2
+            monkeypatch.delenv("PADDLE_TRAINING_ROLE")
+            assert fleet.is_server()
+        finally:
+            fleet._role_maker = None
+
+
+class TestVocab:
+    CORPUS = [["the", "cat", "sat"], ["the", "dog", "sat", "sat"]]
+
+    def test_build_lookup_roundtrip(self):
+        v = Vocab.build_from_corpus(self.CORPUS, min_freq=1)
+        assert len(v) == 6  # pad, unk, sat(3), the(2), cat, dog
+        assert v.to_indices("the") == v.token_to_idx["the"]
+        assert v.to_tokens(v.to_indices("cat")) == "cat"
+        assert v.to_indices("MISSING") == v.token_to_idx["[UNK]"]
+        assert "cat" in v and "MISSING" not in v
+
+    def test_frequency_order_and_limits(self):
+        v = Vocab.build_from_corpus(self.CORPUS, max_size=4)
+        assert len(v) == 4
+        # most frequent non-special first after the specials
+        assert v.to_tokens(2) == "sat"
+
+    def test_batch_call_pads_int32(self):
+        v = Vocab.build_from_corpus(self.CORPUS)
+        ids, lens = v([["the", "cat"], ["dog", "sat", "the"]])
+        assert ids.dtype == np.int32 and ids.shape == (2, 3)
+        np.testing.assert_array_equal(lens, [2, 3])
+        pad_id = v.token_to_idx["[PAD]"]
+        assert ids[0, 2] == pad_id
+        # feeds an embedding directly
+        import paddle_tpu as P
+        import paddle_tpu.nn as nn
+        emb = nn.Embedding(len(v), 8)
+        out = emb(P.to_tensor(ids))
+        assert out.shape == [2, 3, 8]
+
+    def test_save_load_json_and_txt(self, tmp_path):
+        v = Vocab.build_from_corpus(self.CORPUS)
+        p = str(tmp_path / "vocab.json")
+        v.save(p)
+        v2 = Vocab.load(p)
+        assert v2.token_to_idx == v.token_to_idx
+        txt = tmp_path / "vocab.txt"
+        txt.write_text("[PAD]\n[UNK]\nhello\nworld\n", encoding="utf-8")
+        v3 = Vocab.load(str(txt))
+        assert v3.to_indices("world") == 3
+
+    def test_strings_kernels(self):
+        assert lower("HeLLo") == "hello"
+        assert upper(["ab", "Cd"]) == ["AB", "CD"]
+        assert lower("ÄÖÜ") == "äöü"   # unicode-aware (case_utils.h)
+        assert whitespace_tokenize("a b  c") == ["a", "b", "c"]
