@@ -20,7 +20,7 @@ from ..core.tensor import Tensor
 
 __all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
            "disable_tensor_checker", "check_numerics", "collect_operator_stats",
-           "compare_accuracy"]
+           "compare_accuracy", "LayerNumericsWatcher", "check_layer_numerics"]
 
 
 class DebugMode(Enum):
@@ -146,3 +146,75 @@ def compare_accuracy(dump_path, another_dump_path, output_filename=None,
     raise NotImplementedError(
         "compare_accuracy requires tensor dump files; use "
         "paddle_tpu.amp.debugging.check_numerics for live checking")
+
+
+class LayerNumericsWatcher:
+    """Per-layer forward numerics instrumentation (reference
+    python/paddle/amp/debugging.py:173 check_layer_numerics — per-layer
+    stats instead of the per-op flag check).
+
+    Attaches forward-post hooks to every sublayer; each forward records
+    output mean / absmax / nan / inf counts into a host-side table.  The
+    stats sync the output to host, so watch in debugging sessions, not in
+    the hot training loop.
+    """
+
+    def __init__(self, model):
+        self._model = model
+        self._handles = []
+        self.stats: dict = {}
+
+    def _record(self, name):
+        import numpy as np
+
+        def hook(layer, inputs, outputs):
+            outs = outputs if isinstance(outputs, (tuple, list)) else \
+                (outputs,)
+            for o in outs:
+                arr = getattr(o, "_data", None)
+                if arr is None or not hasattr(arr, "dtype") or \
+                        not jnp.issubdtype(arr.dtype, jnp.floating):
+                    continue
+                a = np.asarray(arr, np.float32)
+                s = self.stats.setdefault(name, {
+                    "calls": 0, "mean": 0.0, "absmax": 0.0,
+                    "nan": 0, "inf": 0})
+                s["calls"] += 1
+                s["mean"] = float(a.mean())
+                s["absmax"] = max(s["absmax"], float(np.abs(a).max()))
+                s["nan"] += int(np.isnan(a).sum())
+                s["inf"] += int(np.isinf(a).sum())
+            return None
+        return hook
+
+    def watch(self):
+        for name, sub in self._model.named_sublayers():
+            self._handles.append(
+                sub.register_forward_post_hook(self._record(name)))
+        return self
+
+    def unwatch(self):
+        for h in self._handles:
+            h.remove()
+        self._handles.clear()
+
+    def first_bad_layer(self):
+        """Name of the first layer whose output went nan/inf, else None."""
+        for name, s in self.stats.items():
+            if s["nan"] or s["inf"]:
+                return name
+        return None
+
+    def summary(self) -> str:
+        lines = [f"{'layer':<40} {'calls':>5} {'mean':>12} {'absmax':>12} "
+                 f"{'nan':>6} {'inf':>6}"]
+        for name, s in self.stats.items():
+            lines.append(f"{name:<40} {s['calls']:>5} {s['mean']:>12.4g} "
+                         f"{s['absmax']:>12.4g} {s['nan']:>6} {s['inf']:>6}")
+        return "\n".join(lines)
+
+
+def check_layer_numerics(model):
+    """Attach a LayerNumericsWatcher to every sublayer of ``model`` and
+    return it (call ``.unwatch()`` to detach, ``.summary()`` to render)."""
+    return LayerNumericsWatcher(model).watch()

@@ -281,22 +281,93 @@ class PipelineParallelWithInterleave(PipelineParallel):
     `pipeline_spmd.pipeline_apply(..., virtual=v)`."""
 
 
+class HybridGlobalNormClip:
+    """Group-aware global-norm clip (reference
+    hybrid_parallel_optimizer.py:52 HybridParallelClipGrad).
+
+    The reference splits the squared-norm sum by parallel group
+    (mp-duplicated vs mp-sharded vs pp) and allreduces the partial sums so
+    duplicated parameters are not double-counted.  Under single-controller
+    SPMD the arrays are GLOBAL (GSPMD inserts any cross-shard psum), so the
+    plain sum is already the correct global norm — what remains of the
+    reference surface is the grouped accounting, kept here as observable
+    state: ``last_norm_groups`` records the squared norm per group
+    (distributed / replicated / excluded) and ``last_global_norm`` the
+    total, letting hybrid configs audit exactly what the reference logs.
+    """
+
+    def __init__(self, inner_clip, hcg=None):
+        import jax.numpy as jnp
+
+        self._inner = inner_clip
+        self._hcg = hcg
+        self._jnp = jnp
+        self._group_sq = None   # lazy jnp scalars; host sync only on access
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def __call__(self, params_grads):
+        jnp = self._jnp
+        # keep the accounting LAZY (jnp scalars): a float() here would
+        # serialize async dispatch every step and break under trace
+        groups = {"distributed": None, "replicated": None, "excluded": None}
+        for p, g in params_grads:
+            if g is None:
+                continue
+            arr = getattr(g, "_values", None)
+            arr = arr if arr is not None else g._data
+            sq = jnp.sum(jnp.square(arr.astype(jnp.float32)))
+            if not getattr(p, "need_clip", True):
+                key = "excluded"
+            elif getattr(p, "is_distributed", False) or p.is_dist:
+                key = "distributed"
+            else:
+                key = "replicated"
+            groups[key] = sq if groups[key] is None else groups[key] + sq
+        self._group_sq = groups
+        return self._inner(params_grads)
+
+    @property
+    def last_norm_groups(self):
+        """Squared norm per parallel group from the latest step (syncs)."""
+        if self._group_sq is None:
+            return {}
+        return {k: (0.0 if v is None else float(v))
+                for k, v in self._group_sq.items()}
+
+    @property
+    def last_global_norm(self):
+        g = self.last_norm_groups
+        if not g:
+            return None
+        return (g["distributed"] + g["replicated"]) ** 0.5
+
+
 class HybridParallelOptimizer:
     """reference hybrid_parallel_optimizer.py:266 — wraps the user optimizer.
 
     Under single-controller SPMD, grad allreduce across dp/sharding groups is
-    performed by XLA (grads of replicated params are psummed automatically),
-    so the wrapper's remaining jobs are grad clipping across the hybrid groups
-    (global norm is already global here) and API parity.
+    performed by XLA (grads of replicated params are psummed automatically).
+    The wrapper re-wraps a ClipGradByGlobalNorm with the group-aware
+    HybridGlobalNormClip (as the reference swaps in HybridParallelClipGrad)
+    and keeps API parity.
     """
 
     def __init__(self, optimizer, hcg, strategy=None):
         self._inner_opt = optimizer
         self._hcg = hcg
         self._strategy = strategy
+        clip = getattr(optimizer, "_grad_clip", None)
+        if clip is not None and not isinstance(clip, HybridGlobalNormClip):
+            optimizer._grad_clip = HybridGlobalNormClip(clip, hcg)
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
+
+    @property
+    def grad_clip(self):
+        return self._inner_opt._grad_clip
 
     def step(self):
         self._inner_opt.step()

@@ -1,0 +1,70 @@
+"""paddle.tensor.array — TensorArray surface.
+
+Reference: python/paddle/tensor/array.py (array_write:189 / array_read:103 /
+array_length:36 / create_array) over the phi TensorArray type
+(paddle/phi/core/tensor_array.h).  In dygraph the reference's TensorArray IS
+a python list of tensors; that is exactly the right TPU-native shape too —
+under jit, a list of same-shaped tensors becomes a scanned/stacked axis, so
+no dynamic container type is needed on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..core.tensor import Tensor
+
+__all__ = ["create_array", "array_length", "array_read", "array_write"]
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """reference array.py create_array — a (typed) python list."""
+    arr: List[Any] = []
+    if initialized_list is not None:
+        if not isinstance(initialized_list, (list, tuple)):
+            raise TypeError(
+                "initialized_list must be a list/tuple of Tensors, got "
+                f"{type(initialized_list).__name__}")
+        for t in initialized_list:
+            arr.append(t if isinstance(t, Tensor) else Tensor(t))
+    return arr
+
+
+def _idx(i) -> int:
+    if isinstance(i, Tensor):
+        return int(i)
+    return int(i)
+
+
+def array_length(array):
+    if not isinstance(array, list):
+        raise TypeError("array_length expects a TensorArray (python list)")
+    return Tensor(len(array), dtype="int64")
+
+
+def array_read(array, i):
+    if not isinstance(array, list):
+        raise TypeError("array_read expects a TensorArray (python list)")
+    idx = _idx(i)
+    if not 0 <= idx < len(array):
+        raise IndexError(f"array_read index {idx} out of range "
+                         f"[0, {len(array)})")
+    return array[idx]
+
+
+def array_write(x, i, array: Optional[list] = None):
+    """Write ``x`` at position ``i``, growing the array when i == len."""
+    if array is None:
+        array = create_array()
+    if not isinstance(array, list):
+        raise TypeError("array_write expects a TensorArray (python list)")
+    idx = _idx(i)
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    if idx < len(array):
+        array[idx] = x
+    elif idx == len(array):
+        array.append(x)
+    else:
+        raise IndexError(
+            f"array_write index {idx} beyond append position {len(array)}")
+    return array

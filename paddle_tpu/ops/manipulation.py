@@ -391,7 +391,39 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: 
 
 
 def top_p_sampling(x, ps, threshold=None, seed=None):
-    raise NotImplementedError
+    """Nucleus sampling (reference ops.yaml top_p_sampling,
+    phi/kernels/gpu/top_p_sampling_kernel.cu): per row of ``x`` (probability
+    dist over vocab), sample from the smallest prefix of descending probs
+    whose mass reaches ``ps``; ``threshold`` additionally drops tokens whose
+    probability is below the per-row floor.  Returns (probs, ids)."""
+    from .. import dtypes
+    from ..core.random import next_key
+
+    x, ps_t = _t(x), _t(ps)
+    key = jax.random.key_data(next_key() if seed in (None, -1)
+                              else jax.random.key(int(seed)))
+    args = [x, ps_t, Tensor(key)]
+    has_thresh = threshold is not None
+    if has_thresh:
+        args.append(_t(threshold))
+    i64 = dtypes.convert_dtype("int64")
+
+    def prim(probs, p, key_data, *thresh):
+        k = jax.random.wrap_key_data(key_data)
+        vocab = probs.shape[-1]
+        sorted_p, sorted_idx = jax.lax.top_k(probs, vocab)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        keep = (cum - sorted_p) < p.reshape(-1, 1)  # prefix mass before me
+        if has_thresh:
+            keep = jnp.logical_and(keep,
+                                   sorted_p >= thresh[0].reshape(-1, 1))
+        filt = jnp.where(keep, sorted_p, 0.0)
+        choice = jax.random.categorical(k, jnp.log(filt + 1e-30), axis=-1)
+        ids = jnp.take_along_axis(sorted_idx, choice[:, None], -1)[:, 0]
+        scores = jnp.take_along_axis(sorted_p, choice[:, None], -1)
+        return scores, ids[:, None].astype(i64)
+
+    return apply_op("top_p_sampling", prim, tuple(args))
 
 
 def one_hot(x, num_classes, name=None):

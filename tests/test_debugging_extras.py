@@ -1,0 +1,96 @@
+"""TensorArray ops, per-layer numerics watcher, hybrid group-aware clip.
+
+References: python/paddle/tensor/array.py (array_write:189/array_read:103/
+array_length:36), python/paddle/amp/debugging.py:173 (check_layer_numerics),
+distributed/fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:52 (HybridParallelClipGrad).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+class TestTensorArray:
+    def test_write_read_length_stack(self):
+        arr = P.create_array("float32")
+        P.array_write(P.to_tensor([1.0, 2.0]), 0, arr)
+        P.array_write(P.to_tensor([3.0, 4.0]), P.to_tensor(1), arr)
+        assert int(P.array_length(arr)) == 2
+        np.testing.assert_allclose(P.array_read(arr, 1).numpy(), [3.0, 4.0])
+        out = P.stack(arr, axis=0)
+        assert out.shape == [2, 2]
+
+    def test_overwrite_and_bounds(self):
+        arr = P.create_array(initialized_list=[P.to_tensor([1.0])])
+        P.array_write(P.to_tensor([9.0]), 0, arr)
+        np.testing.assert_allclose(P.array_read(arr, 0).numpy(), [9.0])
+        with pytest.raises(IndexError):
+            P.array_read(arr, 3)
+        with pytest.raises(IndexError):
+            P.array_write(P.to_tensor([0.0]), 5, arr)
+
+    def test_loop_accumulation_idiom(self):
+        arr = P.create_array()
+        x = P.to_tensor(np.ones((2,), np.float32))
+        for i in range(4):
+            P.array_write(x * float(i), i, arr)
+        total = P.stack(arr).sum()
+        assert float(total) == 2 * (0 + 1 + 2 + 3)
+
+
+class TestLayerNumerics:
+    def test_watcher_records_and_finds_bad_layer(self):
+        from paddle_tpu.amp.debugging import check_layer_numerics
+
+        P.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        w = check_layer_numerics(m)
+        m(P.randn([3, 4]))
+        assert w.first_bad_layer() is None
+        assert len(w.stats) >= 3
+        for s in w.stats.values():
+            assert s["calls"] == 1 and np.isfinite(s["absmax"])
+
+        m[0].weight.set_value(np.full((4, 8), np.nan, np.float32))
+        m(P.randn([3, 4]))
+        assert w.first_bad_layer() == "0"   # the poisoned Linear
+        assert "layer" in w.summary()
+        w.unwatch()
+        m(P.randn([3, 4]))
+        assert w.stats["0"]["calls"] == 2   # no recording after unwatch
+
+
+class TestHybridClip:
+    def test_wraps_clip_and_matches_plain(self):
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.meta_parallel import HybridGlobalNormClip
+
+        fleet.init()
+        P.seed(0)
+        a = nn.Linear(4, 4)
+        b = nn.Linear(4, 4)
+        for (_, p), (_, q) in zip(a.named_parameters(), b.named_parameters()):
+            q.set_value(p)
+        oa = opt.SGD(0.1, parameters=a.parameters(),
+                     grad_clip=nn.ClipGradByGlobalNorm(0.5))
+        ob = opt.SGD(0.1, parameters=b.parameters(),
+                     grad_clip=nn.ClipGradByGlobalNorm(0.5))
+        hob = fleet.fleet.distributed_optimizer(ob)
+        assert isinstance(hob.grad_clip, HybridGlobalNormClip)
+
+        x = P.randn([2, 4])
+        (a(x) * 3).sum().backward()
+        oa.step()
+        (b(x) * 3).sum().backward()
+        hob.step()
+        # group-aware wrapper must not change the (already global) math
+        np.testing.assert_allclose(a.weight.numpy(), b.weight.numpy(),
+                                   rtol=1e-6)
+        groups = hob.grad_clip.last_norm_groups
+        assert set(groups) == {"distributed", "replicated", "excluded"}
+        assert hob.grad_clip.last_global_norm > 0
+        assert groups["replicated"] > 0 and groups["distributed"] == 0
