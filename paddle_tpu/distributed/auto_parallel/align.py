@@ -1,0 +1,144 @@
+"""Serial-vs-distributed numeric alignment tool.
+
+Reference: python/paddle/distributed/auto_parallel/static/auto_align_tool.py
+(AutoAlignTool:46 — dump loss/params/grads/activations per step from a
+serial and a distributed run, convert layouts, and ``find_diff_vars``:382
+to locate the first diverging tensor).
+
+TPU-native redesign: under single-controller SPMD every array is GLOBAL, so
+the reference's dist->serial layout conversion disappears — alignment is a
+straight capture-and-diff between two runs of the same step function under
+different ``ParallelConfig``s (or different flags/dtypes).  What remains,
+and is kept, is the workflow: leveled capture, on-disk dumps a colleague can
+diff offline, and a report that names the first diverging variable and step.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# capture levels, mirroring the reference's get_*_var ladder
+LEVEL_LOSS = 0       # loss (+ lr if provided)
+LEVEL_PARAM = 1      # + parameters
+LEVEL_GRAD = 2       # + gradients / optimizer deltas
+LEVEL_ALL = 5
+
+
+class AutoAlignTool:
+    """Capture tensors per step and diff two captures."""
+
+    def __init__(self, level: int = LEVEL_ALL):
+        self.level = level
+        self._steps: Dict[int, Dict[str, np.ndarray]] = {}
+
+    # ---- capture ---------------------------------------------------------
+    def capture(self, step: int, *, loss=None, params=None, grads=None,
+                extras: Optional[Dict[str, Any]] = None):
+        """Record one step's tensors (pytrees are flattened to dotted names)."""
+        rec = self._steps.setdefault(int(step), {})
+        if loss is not None:
+            rec["loss"] = np.asarray(getattr(loss, "_data", loss),
+                                     np.float32)
+        if params is not None and self.level >= LEVEL_PARAM:
+            rec.update(_flatten("param", params))
+        if grads is not None and self.level >= LEVEL_GRAD:
+            rec.update(_flatten("grad", grads))
+        if extras:
+            for k, v in extras.items():
+                rec[k] = np.asarray(getattr(v, "_data", v))
+        return self
+
+    # ---- persistence (offline diffing, reference save:255/load:311) -----
+    def save(self, save_dir: str):
+        os.makedirs(save_dir, exist_ok=True)
+        for step, rec in self._steps.items():
+            np.savez(os.path.join(save_dir, f"step_{step}.npz"), **rec)
+
+    @staticmethod
+    def load(save_dir: str) -> "AutoAlignTool":
+        tool = AutoAlignTool()
+        for fn in sorted(os.listdir(save_dir)):
+            if fn.startswith("step_") and fn.endswith(".npz"):
+                step = int(fn[len("step_"):-len(".npz")])
+                with np.load(os.path.join(save_dir, fn)) as z:
+                    tool._steps[step] = {k: z[k] for k in z.files}
+        return tool
+
+    # ---- diff (reference find_diff_vars:382) -----------------------------
+    @staticmethod
+    def find_diff_vars(left: "AutoAlignTool", right: "AutoAlignTool",
+                       rtol: float = 1e-4, atol: float = 1e-5
+                       ) -> List[Tuple[int, str, float]]:
+        """All (step, name, max_abs_diff) that exceed tolerance, in step
+        order; disjoint names count as divergent with diff=inf."""
+        out = []
+        for step in sorted(set(left._steps) | set(right._steps)):
+            a = left._steps.get(step, {})
+            b = right._steps.get(step, {})
+            for name in sorted(set(a) | set(b)):
+                if name not in a or name not in b:
+                    out.append((step, name, float("inf")))
+                    continue
+                x, y = a[name], b[name]
+                if x.shape != y.shape:
+                    out.append((step, name, float("inf")))
+                    continue
+                close = np.isclose(x, y, rtol=rtol, atol=atol,
+                                   equal_nan=True)
+                if not close.all():
+                    out.append((step, name,
+                                float(np.abs(x - y).max())))
+        return out
+
+    @staticmethod
+    def diff_report(left, right, rtol=1e-4, atol=1e-5) -> str:
+        diffs = AutoAlignTool.find_diff_vars(left, right, rtol, atol)
+        if not diffs:
+            return "aligned: no diverging variables"
+        step, name, diff = diffs[0]
+        lines = [f"FIRST DIVERGENCE at step {step}: {name} "
+                 f"(max |delta| = {diff:.3e})",
+                 f"{len(diffs)} diverging entries total:"]
+        for s, n, d in diffs[:20]:
+            lines.append(f"  step {s:<4} {n:<50} {d:.3e}")
+        return "\n".join(lines)
+
+
+def _flatten(prefix: str, tree) -> Dict[str, np.ndarray]:
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        name = prefix + jax.tree_util.keystr(path)
+        out[name] = np.asarray(getattr(leaf, "_data", leaf))
+    return out
+
+
+def align_pretrain_configs(config, pc_a, pc_b, ids, labels, steps: int = 2,
+                           seed: int = 0, level: int = LEVEL_ALL,
+                           rtol: float = 1e-4, atol: float = 1e-5):
+    """Run PretrainStep under two ParallelConfigs on identical data and
+    report alignment — the serial-vs-distributed workflow of the reference
+    tool as one call.  Returns (diffs, report)."""
+    from ...models.pretrain import PretrainStep
+
+    captures = []
+    for pc in (pc_a, pc_b):
+        ps = PretrainStep(config, pc)
+        state = ps.init_state(seed=seed)
+        si, sl = ps.shard_batch(np.asarray(ids), np.asarray(labels))
+        tool = AutoAlignTool(level)
+        for step in range(steps):
+            state, loss = ps.train_step(state, si, sl)
+            # canonical layout: the pipeline's [stages, L/stages] grouping
+            # and interleave permutation undone, so topologies are
+            # name-for-name comparable (the reference's layout conversion)
+            params = ps.canonical_state(state)["params"]
+            tool.capture(step, loss=loss, params=params)
+        captures.append(tool)
+    diffs = AutoAlignTool.find_diff_vars(*captures, rtol=rtol, atol=atol)
+    return diffs, AutoAlignTool.diff_report(*captures, rtol=rtol, atol=atol)
