@@ -151,6 +151,15 @@ def main(argv):
             traceback.print_exc()
             result = {"config": name, "error": f"{type(e).__name__}: {e}"}
             failed += 1
+        # provenance stamp: CPU smoke runs must never read as TPU numbers
+        try:
+            import jax
+            dev = jax.devices()[0]
+            result.setdefault("platform", dev.platform)
+            result.setdefault("device_kind",
+                              getattr(dev, "device_kind", "?"))
+        except Exception:
+            pass
         path = RESULTS / f"{name}.json"
         path.write_text(json.dumps(result, indent=2) + "\n")
         print(f"{name}: {json.dumps(result)}")

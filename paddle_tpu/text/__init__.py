@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..ops._prim import apply_op
 from .vocab import Vocab, lower, upper, whitespace_tokenize  # noqa: F401
+from .datasets import Imdb, Imikolov, UCIHousing  # noqa: F401
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

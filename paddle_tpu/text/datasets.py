@@ -1,0 +1,187 @@
+"""paddle.text.datasets (reference: python/paddle/text/datasets/ — imdb.py,
+imikolov.py, uci_housing.py, conll05.py, movielens.py, wmt14/16.py).
+
+Zero-egress environment: every dataset loads from LOCAL files (the
+reference downloads then parses; the parsing side is what lives here).
+The three most used are implemented; the corpus-download-only wrappers
+raise with guidance.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+from typing import List, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov"]
+
+
+def _check_mode(mode, allowed):
+    if mode not in allowed:
+        raise ValueError(f"mode must be one of {sorted(allowed)}, "
+                         f"got {mode!r}")
+
+
+class UCIHousing(Dataset):
+    """reference uci_housing.py — 13 features + price, whitespace-separated
+    ``housing.data`` layout; features normalized to the train split's
+    min/max/avg like the reference."""
+
+    FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        _check_mode(mode, {"train", "test"})
+        if data_file is None:
+            raise RuntimeError(
+                "zero-egress environment: pass data_file=housing.data")
+        raw = np.loadtxt(data_file).astype("float32")
+        if raw.shape[1] != self.FEATURES + 1:
+            raise ValueError(f"expected {self.FEATURES + 1} columns, got "
+                             f"{raw.shape[1]}")
+        split = int(raw.shape[0] * 0.8)
+        feat = raw[:, :-1]
+        mx, mn, avg = (feat[:split].max(0), feat[:split].min(0),
+                       feat[:split].mean(0))
+        denom = np.where(mx - mn == 0, 1.0, mx - mn)
+        feat = (feat - avg) / denom
+        data = np.concatenate([feat, raw[:, -1:]], axis=1)
+        self.data = data[:split] if mode == "train" else data[split:]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1].astype("float32"), row[-1:].astype("float32")
+
+
+_TOKEN_RE = re.compile(r"\w+|[<>/]|[^\s\w]")
+
+
+class Imdb(Dataset):
+    """reference imdb.py — sentiment corpus from the aclImdb tarball (or an
+    extracted directory): <root>/<mode>/{pos,neg}/*.txt -> (ids, label)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False):
+        _check_mode(mode, {"train", "test"})
+        if data_file is None:
+            raise RuntimeError(
+                "zero-egress environment: pass data_file=aclImdb_v1.tar.gz "
+                "or an extracted aclImdb directory")
+        texts, labels = self._read(data_file, mode)
+        tokens = [self._tokenize(t) for t in texts]
+        self.word_idx = self._build_vocab(tokens, cutoff)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.asarray([self.word_idx.get(w, unk) for w in doc],
+                                np.int64) for doc in tokens]
+        self.labels = np.asarray(labels, np.int64)
+
+    @staticmethod
+    def _tokenize(text):
+        return [t.lower() for t in _TOKEN_RE.findall(text)]
+
+    @staticmethod
+    def _read(path, mode):
+        texts, labels = [], []
+        if os.path.isdir(path):
+            for label, sub in ((0, "pos"), (1, "neg")):
+                d = os.path.join(path, mode, sub)
+                for fn in sorted(os.listdir(d)):
+                    with open(os.path.join(d, fn), encoding="utf-8") as f:
+                        texts.append(f.read())
+                    labels.append(label)
+            return texts, labels
+        pats = {0: re.compile(rf"aclImdb/{mode}/pos/.*\.txt$"),
+                1: re.compile(rf"aclImdb/{mode}/neg/.*\.txt$")}
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                for label, pat in pats.items():
+                    if pat.match(m.name):
+                        texts.append(
+                            tf.extractfile(m).read().decode("utf-8"))
+                        labels.append(label)
+        return texts, labels
+
+    @staticmethod
+    def _build_vocab(token_docs, cutoff):
+        from collections import Counter
+
+        c = Counter()
+        for doc in token_docs:
+            c.update(doc)
+        words = [w for w, f in c.most_common() if f > cutoff]
+        idx = {w: i for i, w in enumerate(words)}
+        idx["<unk>"] = len(idx)
+        return idx
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+
+class Imikolov(Dataset):
+    """reference imikolov.py — PTB n-gram dataset: a text file (or the
+    simple-examples tarball) becomes (n-1 context, next word) pairs."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=False):
+        _check_mode(mode, {"train", "test", "valid"})
+        if data_file is None:
+            raise RuntimeError(
+                "zero-egress environment: pass data_file=ptb.<mode>.txt "
+                "or the simple-examples tarball")
+        lines = self._read(data_file, mode)
+        from collections import Counter
+
+        c = Counter()
+        for ln in lines:
+            c.update(ln)
+        words = [w for w, f in c.most_common() if f >= min_word_freq]
+        # boundary tokens are real vocabulary (reference imikolov.py
+        # build_dict adds them), never <unk>
+        for special in ("<s>", "<e>", "<unk>"):
+            if special not in words:
+                words.append(special)
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        unk = self.word_idx["<unk>"]
+        self.data: List[np.ndarray] = []
+        self.data_type = data_type.upper()
+        for ln in lines:
+            ids = [self.word_idx.get(w, unk)
+                   for w in ["<s>"] * (window_size - 1) + ln + ["<e>"]]
+            if self.data_type == "NGRAM":
+                for i in range(window_size, len(ids) + 1):
+                    self.data.append(
+                        np.asarray(ids[i - window_size:i], np.int64))
+            else:  # SEQ
+                self.data.append(np.asarray(ids, np.int64))
+
+    @staticmethod
+    def _read(path, mode):
+        name = {"train": "ptb.train.txt", "test": "ptb.test.txt",
+                "valid": "ptb.valid.txt"}.get(mode, mode)
+        if os.path.isfile(path) and not path.endswith((".tgz", ".tar.gz")):
+            with open(path, encoding="utf-8") as f:
+                return [ln.split() for ln in f if ln.strip()]
+        with tarfile.open(path) as tf:
+            member = next(m for m in tf.getmembers()
+                          if m.name.endswith(name))
+            raw = tf.extractfile(member).read().decode("utf-8")
+        return [ln.split() for ln in raw.splitlines() if ln.strip()]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        if self.data_type == "NGRAM":
+            return row[:-1], row[-1:]
+        return (row,)

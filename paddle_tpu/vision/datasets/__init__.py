@@ -80,19 +80,22 @@ class Cifar10(Dataset):
     """reference datasets/cifar.py — requires the local python-version tarball
     extracted; pass ``data_path`` to the directory of data_batch_* files."""
 
+    _LABEL_KEY = b"labels"
+    _TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
+    _TEST_FILES = ["test_batch"]
+
     def __init__(self, data_path=None, mode="train", transform=None,
                  download=False, backend=None):
         import pickle
         if data_path is None:
             raise RuntimeError("zero-egress environment: pass data_path")
-        files = ([f"data_batch_{i}" for i in range(1, 6)]
-                 if mode == "train" else ["test_batch"])
+        files = self._TRAIN_FILES if mode == "train" else self._TEST_FILES
         xs, ys = [], []
         for fn in files:
             with open(os.path.join(data_path, fn), "rb") as f:
                 d = pickle.load(f, encoding="bytes")
             xs.append(d[b"data"])
-            ys.extend(d[b"labels"])
+            ys.extend(d[self._LABEL_KEY])
         self.data = np.concatenate(xs).reshape(-1, 3, 32, 32)
         self.labels = np.asarray(ys, dtype=np.int64)
         self.transform = transform
@@ -105,3 +108,104 @@ class Cifar10(Dataset):
         if self.transform is not None:
             img = self.transform(img.transpose(1, 2, 0))
         return img, self.labels[idx]
+
+
+class Cifar100(Cifar10):
+    """reference datasets/cifar.py Cifar100 — python-version layout with
+    train/test files and fine labels."""
+
+    _LABEL_KEY = b"fine_labels"
+    _TRAIN_FILES = ["train"]
+    _TEST_FILES = ["test"]
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def _scan_files(root, extensions, is_valid_file):
+    """Sorted valid file paths under root (shared by the folder datasets)."""
+    if extensions is None and is_valid_file is None:
+        extensions = IMG_EXTENSIONS
+    if extensions is not None:
+        extensions = tuple(extensions)
+    out = []
+    for dirpath, _, files in sorted(os.walk(root)):
+        for fn in sorted(files):
+            path = os.path.join(dirpath, fn)
+            ok = is_valid_file(path) if is_valid_file is not None \
+                else fn.lower().endswith(extensions)
+            if ok:
+                out.append(path)
+    return out
+
+
+class DatasetFolder(Dataset):
+    """reference datasets/folder.py:72 — class-per-subdirectory layout.
+
+    root/class_a/xxx.png ... -> samples (path, class_index); classes sorted
+    alphabetically.  ``loader`` defaults to a PIL RGB loader.
+    """
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or pil_loader
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            for path in _scan_files(os.path.join(root, c), extensions,
+                                    is_valid_file):
+                self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, np.int64(target)
+
+
+def pil_loader(path):
+    from PIL import Image
+
+    with open(path, "rb") as f:
+        return Image.open(f).convert("RGB")
+
+
+class ImageFolder(Dataset):
+    """reference datasets/folder.py ImageFolder — flat list of images (no
+    labels), for inference sweeps."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.loader = loader or pil_loader
+        self.transform = transform
+        self.samples = _scan_files(root, extensions, is_valid_file)
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+
+class Flowers(DatasetFolder):
+    """reference datasets/flowers.py — local extracted layout: pass the
+    directory that holds one subdirectory per flower class."""

@@ -1,0 +1,134 @@
+"""Dataset zoo over local files (reference python/paddle/vision/datasets/ +
+python/paddle/text/datasets/ — zero-egress, so each test synthesizes the
+on-disk format the reference parser consumes)."""
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import Imdb, Imikolov, UCIHousing
+from paddle_tpu.vision.datasets import (Cifar10, Cifar100, DatasetFolder,
+                                        ImageFolder, MNIST)
+
+
+def test_mnist_idx_format(tmp_path):
+    imgs = np.random.default_rng(0).integers(0, 255, (5, 28, 28),
+                                             dtype=np.uint8)
+    labels = np.arange(5, dtype=np.uint8)
+    ip = tmp_path / "images.idx3.gz"
+    lp = tmp_path / "labels.idx1"
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 5, 28, 28) + imgs.tobytes())
+    with open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 5) + labels.tobytes())
+    ds = MNIST(image_path=str(ip), label_path=str(lp))
+    assert len(ds) == 5
+    img, y = ds[3]
+    np.testing.assert_array_equal(img, imgs[3])
+    assert y == 3
+
+
+def _write_cifar(path, fname, n, label_key):
+    data = np.random.default_rng(1).integers(0, 255, (n, 3072),
+                                             dtype=np.uint8)
+    with open(os.path.join(path, fname), "wb") as f:
+        pickle.dump({b"data": data,
+                     label_key: list(range(n))}, f)
+    return data
+
+
+def test_cifar10_and_100(tmp_path):
+    d10 = tmp_path / "c10"
+    d10.mkdir()
+    for i in range(1, 6):
+        _write_cifar(str(d10), f"data_batch_{i}", 4, b"labels")
+    ds = Cifar10(data_path=str(d10))
+    assert len(ds) == 20 and ds[0][0].shape == (3, 32, 32)
+
+    d100 = tmp_path / "c100"
+    d100.mkdir()
+    _write_cifar(str(d100), "train", 6, b"fine_labels")
+    ds100 = Cifar100(data_path=str(d100))
+    assert len(ds100) == 6 and int(ds100[2][1]) == 2
+
+
+def _make_image_tree(root, classes=("cat", "dog"), per=3):
+    from PIL import Image
+
+    for c in classes:
+        os.makedirs(os.path.join(root, c), exist_ok=True)
+        for i in range(per):
+            Image.new("RGB", (8, 8), color=(i * 20, 0, 0)).save(
+                os.path.join(root, c, f"{i}.png"))
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    _make_image_tree(str(tmp_path))
+    ds = DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, y = ds[0]
+    assert img.size == (8, 8) and y == 0
+    # transform applies
+    ds2 = DatasetFolder(str(tmp_path),
+                        transform=lambda im: np.asarray(im, np.float32))
+    x, _ = ds2[5]
+    assert x.shape == (8, 8, 3) and x.dtype == np.float32
+
+    flat = ImageFolder(str(tmp_path))
+    assert len(flat) == 6
+    assert flat[0][0].size == (8, 8)
+
+
+def test_uci_housing(tmp_path):
+    rng = np.random.default_rng(0)
+    raw = rng.standard_normal((50, 14)).astype("float32")
+    p = tmp_path / "housing.data"
+    np.savetxt(p, raw)
+    tr = UCIHousing(data_file=str(p), mode="train")
+    te = UCIHousing(data_file=str(p), mode="test")
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert np.isfinite(x).all()
+
+
+def test_imdb_dir_layout(tmp_path):
+    for label, sub, word in ((0, "pos", "good"), (1, "neg", "bad")):
+        d = tmp_path / "train" / sub
+        d.mkdir(parents=True)
+        for i in range(3):
+            (d / f"{i}.txt").write_text(f"a {word} movie " * 60)
+    ds = Imdb(data_file=str(tmp_path), mode="train", cutoff=1)
+    assert len(ds) == 6
+    doc, label = ds[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    assert "movie" in ds.word_idx and "<unk>" in ds.word_idx
+
+
+def test_imikolov_ngram_and_seq(tmp_path):
+    p = tmp_path / "ptb.train.txt"
+    p.write_text("the cat sat\nthe dog sat on the mat\n" * 30)
+    ds = Imikolov(data_file=str(p), window_size=3, min_word_freq=1)
+    ctx, nxt = ds[0]
+    assert ctx.shape == (2,) and nxt.shape == (1,)
+    seq = Imikolov(data_file=str(p), data_type="SEQ", window_size=3,
+                   min_word_freq=1)
+    (row,) = seq[0]
+    assert row.ndim == 1 and row.dtype == np.int64
+
+
+def test_datasets_feed_dataloader(tmp_path):
+    import paddle_tpu.io as io
+
+    _make_image_tree(str(tmp_path), per=4)
+    ds = DatasetFolder(str(tmp_path),
+                       transform=lambda im: np.asarray(im, np.float32))
+    batches = list(io.DataLoader(ds, batch_size=4, shuffle=False,
+                                 num_workers=2))
+    assert len(batches) == 2
+    assert batches[0][0].shape == [4, 8, 8, 3]
