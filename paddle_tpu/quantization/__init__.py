@@ -86,22 +86,46 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        name=None):
     """reference ops.yaml: weight_only_linear — y = x @ dequant(qw) + b.
 
-    The dequant happens in the matmul's input precision; XLA fuses the scale
-    multiplication, so the int8 weight is the only HBM-resident copy."""
+    On TPU (and under the interpret-mode test flag) the matmul runs in the
+    Pallas weight-only kernel (kernels/weight_only.py): the quantized blocks
+    stream into VMEM and dequantize there, so HBM never holds a dequantized
+    copy (2x/4x weight-bandwidth saving — the decode-path lever).  Elsewhere
+    the dequant happens in the matmul's input precision via XLA."""
+    import jax as _jax
+
+    from .. import flags as _flags
+    from ..kernels.weight_only import weight_only_matmul
+
     if weight_scale is None:
         raise ValueError(
             "weight_only_linear requires weight_scale (from weight_quantize)")
     int4 = weight_dtype == "int4"
+    # routing is decided HERE (per call) so the dispatch cache keys the two
+    # paths separately — a flag flip after the first trace must not be
+    # frozen into a cached prim
+    use_kernel = _jax.default_backend() == "tpu" or \
+        _flags.flag("flash_attention_interpret")
+    interp = _jax.default_backend() != "tpu"
 
-    def prim(a, qw, *rest):
+    def prim_kernel(a, qw, *rest):
         s = rest[0]
-        if int4:
-            qw = _unpack_int4(qw, a.shape[-1])
-        w = qw.astype(a.dtype) * s.astype(a.dtype)
+        y = weight_only_matmul(a, qw, s.astype(jnp.float32),
+                               int4_rows=a.shape[-1] if int4 else None,
+                               interpret=interp)
+        if len(rest) > 1:
+            y = y + rest[1]
+        return y
+
+    def prim_xla(a, qw, *rest):
+        s = rest[0]
+        w = (_unpack_int4(qw, a.shape[-1]) if int4 else qw
+             ).astype(a.dtype) * s.astype(a.dtype)
         y = a @ w
         if len(rest) > 1:
             y = y + rest[1]
         return y
+
+    prim = prim_kernel if use_kernel else prim_xla
 
     args = [_t(x), _t(weight), _t(weight_scale)]
     if bias is not None:

@@ -1,0 +1,149 @@
+"""Weight-only quantized matmul Pallas kernel (interpreter mode on CPU).
+
+Reference: paddle/phi/kernels/fusion/gpu/weight_only_linear_kernel.cu —
+W8A16/W4A16 GEMM with in-kernel dequant.  These tests run the EXACT kernel
+through the Pallas interpreter against the XLA dequant-then-matmul oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import flags
+from paddle_tpu.kernels.weight_only import weight_only_matmul
+from paddle_tpu.quantization import (_unpack_int4, weight_only_linear,
+                                     weight_quantize)
+
+
+def _quant(rng, k, n, algo):
+    w = rng.standard_normal((k, n)).astype(np.float32)
+    qw, scale = weight_quantize(P.to_tensor(w), algo=algo)
+    return w, qw._data, scale._data
+
+
+@pytest.mark.parametrize("algo", ["weight_only_int8", "weight_only_int4"])
+def test_kernel_matches_dequant_oracle(rng, algo):
+    m, k, n = 8, 256, 512
+    x = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    _, qw, scale = _quant(rng, k, n, algo)
+    int4 = algo.endswith("int4")
+    got = weight_only_matmul(x, qw, scale,
+                             int4_rows=k if int4 else None,
+                             block_m=8, block_n=128, block_k=128,
+                             interpret=True)
+    wd = (_unpack_int4(qw, k) if int4 else qw).astype(jnp.float32) * scale
+    ref = x @ wd
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_batched_leading_dims(rng):
+    x = jnp.asarray(rng.standard_normal((2, 4, 128)), jnp.float32)
+    _, qw, scale = _quant(rng, 128, 256, "weight_only_int8")
+    got = weight_only_matmul(x, qw, scale, block_m=8, block_n=128,
+                             block_k=128, interpret=True)
+    assert got.shape == (2, 4, 256)
+    ref = x.reshape(8, 128) @ (qw.astype(jnp.float32) * scale)
+    np.testing.assert_allclose(np.asarray(got).reshape(8, 256),
+                               np.asarray(ref), rtol=1e-4, atol=1e-3)
+
+
+def test_untileable_shapes_fall_back(rng):
+    x = jnp.asarray(rng.standard_normal((3, 100)), jnp.float32)  # odd shapes
+    _, qw, scale = _quant(rng, 100, 130, "weight_only_int8")
+    got = weight_only_matmul(x, qw, scale, interpret=True)
+    ref = x @ (qw.astype(jnp.float32) * scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_weight_only_linear_routes_through_kernel(rng, monkeypatch):
+    """The public op uses the kernel under the interpret flag and matches
+    the XLA path bit-for-bit enough for serving."""
+    flags.set_flags({"flash_attention_interpret": True})
+    try:
+        x = P.to_tensor(rng.standard_normal((4, 128)).astype(np.float32))
+        w = P.to_tensor(rng.standard_normal((128, 256)).astype(np.float32))
+        for algo, dt in (("weight_only_int8", "int8"),
+                         ("weight_only_int4", "int4")):
+            qw, scale = weight_quantize(w, algo=algo)
+            bias = P.to_tensor(rng.standard_normal(256).astype(np.float32))
+            y = weight_only_linear(x, qw, bias=bias, weight_scale=scale,
+                                   weight_dtype=dt)
+            flags.set_flags({"flash_attention_interpret": False})
+            y_ref = weight_only_linear(x, qw, bias=bias, weight_scale=scale,
+                                       weight_dtype=dt)
+            flags.set_flags({"flash_attention_interpret": True})
+            np.testing.assert_allclose(y.numpy(), y_ref.numpy(),
+                                       rtol=1e-4, atol=1e-3)
+    finally:
+        flags.set_flags({"flash_attention_interpret": False})
+
+
+def test_backward_through_kernel(rng):
+    """Activation grads flow through the kernel path (custom vjp); the
+    quantized weight/scale are frozen state with zero cotangents."""
+    x = jnp.asarray(rng.standard_normal((8, 128)), jnp.float32)
+    _, qw, scale = _quant(rng, 128, 256, "weight_only_int8")
+    g = jnp.asarray(rng.standard_normal((8, 256)), jnp.float32)
+
+    def f(x_):
+        return weight_only_matmul(x_, qw, scale, block_m=8, block_n=128,
+                                  block_k=128, interpret=True)
+
+    _, vjp = jax.vjp(f, x)
+    (dx,) = vjp(g)
+    wd = qw.astype(jnp.float32) * scale
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(g @ wd.T),
+                               rtol=1e-4, atol=1e-3)
+    # Tensor-level: backward through the public op on the kernel route
+    flags.set_flags({"flash_attention_interpret": True})
+    try:
+        xt = P.to_tensor(np.asarray(x))
+        xt.stop_gradient = False
+        qwt, st = weight_quantize(
+            P.to_tensor(rng.standard_normal((128, 256)).astype(np.float32)))
+        y = weight_only_linear(xt, qwt, weight_scale=st)
+        y.sum().backward()
+        assert xt.grad is not None and np.isfinite(xt.grad.numpy()).all()
+    finally:
+        flags.set_flags({"flash_attention_interpret": False})
+
+
+def test_flag_flip_reroutes_after_first_trace(rng):
+    """Routing must not be frozen into the first cached trace."""
+    import paddle_tpu.kernels.weight_only as wo
+
+    x = P.to_tensor(rng.standard_normal((4, 128)).astype(np.float32))
+    w = P.to_tensor(rng.standard_normal((128, 256)).astype(np.float32))
+    qw, scale = weight_quantize(w)
+    calls = []
+    real = wo.weight_only_matmul
+    wo_spy = lambda *a, **k: (calls.append(1), real(*a, **k))[1]
+    try:
+        wo.weight_only_matmul = wo_spy
+        flags.set_flags({"flash_attention_interpret": False})
+        weight_only_linear(x, qw, weight_scale=scale)
+        n0 = len(calls)
+        flags.set_flags({"flash_attention_interpret": True})
+        weight_only_linear(x, qw, weight_scale=scale)
+        assert len(calls) > n0   # flag flip reached the kernel path
+    finally:
+        wo.weight_only_matmul = real
+        flags.set_flags({"flash_attention_interpret": False})
+
+
+def test_empty_batch(rng):
+    x = jnp.zeros((0, 128), jnp.float32)
+    _, qw, scale = _quant(rng, 128, 256, "weight_only_int8")
+    out = weight_only_matmul(x, qw, scale, interpret=True)
+    assert out.shape == (0, 256)
+
+
+def test_contraction_mismatch_raises(rng):
+    x = jnp.asarray(rng.standard_normal((4, 64)), jnp.float32)
+    _, qw, scale = _quant(rng, 128, 256, "weight_only_int8")
+    with pytest.raises(ValueError, match="contraction mismatch"):
+        weight_only_matmul(x, qw, scale, interpret=True)
