@@ -95,7 +95,7 @@ _SUBMODULES = [
     "linalg", "fft", "signal", "incubate", "metric", "sparse", "profiler",
     "hapi", "hub", "device", "distributed", "distribution", "static", "audio",
     "text", "quantization", "utils", "inference", "regularizer",
-    "geometric", "sysconfig", "onnx",
+    "geometric", "sysconfig", "onnx", "ir",
 ]
 
 

@@ -1,0 +1,176 @@
+"""User-visible graph IR surface — the PIR analog.
+
+Reference: paddle/pir/ (Program/Operation/pass infrastructure,
+paddle/fluid/pir/transforms passes).  TPU-native substitution per SURVEY
+§7.1: the IR IS the jaxpr (trace-time) and StableHLO (serialized); XLA owns
+the heavy rewrites.  What the reference additionally offers — and this
+module supplies — is a USER-FACING program object you can inspect and run
+passes over: list operations, dead-code-eliminate, constant-fold, swap an
+op's implementation, and lower to StableHLO text for inspection or export.
+
+Passes operate functionally on the captured jaxpr: ``dce``/``fold`` return
+NEW IrProgram objects; ``replace_op`` re-traces with an interpreter that
+substitutes the given primitive — the minimal, honest analog of a PIR
+rewrite pattern (big fusions belong to XLA, not hand passes).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.extend.core  # noqa: F401  (attribute access needs the import)
+import jax.numpy as jnp
+
+__all__ = ["IrProgram", "trace"]
+
+
+class IrProgram:
+    """A captured, inspectable, transformable program (PIR Program analog)."""
+
+    def __init__(self, closed_jaxpr, example_args):
+        self._closed = closed_jaxpr
+        self._example_args = example_args
+
+    # ---- construction ----------------------------------------------------
+    @classmethod
+    def from_function(cls, fn: Callable, *example_args):
+        closed = jax.make_jaxpr(fn)(*example_args)
+        return cls(closed, example_args)
+
+    # ---- inspection (pir::Program::block walk) ---------------------------
+    @property
+    def jaxpr(self):
+        return self._closed.jaxpr
+
+    def ops(self) -> List[str]:
+        return [eqn.primitive.name for eqn in self.jaxpr.eqns]
+
+    def op_histogram(self) -> Dict[str, int]:
+        return dict(Counter(self.ops()))
+
+    def num_ops(self) -> int:
+        return len(self.jaxpr.eqns)
+
+    def __str__(self):
+        return str(self._closed)
+
+    # ---- execution -------------------------------------------------------
+    def __call__(self, *args):
+        out = jax.core.eval_jaxpr(self.jaxpr, self._closed.consts,
+                                  *[getattr(a, "_data", a) for a in args])
+        return out[0] if len(out) == 1 else tuple(out)
+
+    # ---- passes ----------------------------------------------------------
+    def dce(self) -> "IrProgram":
+        """Dead-code elimination (reference dead_code_elimination_pass):
+        backward liveness walk from the outputs; equations producing only
+        dead values are dropped, unused consts pruned."""
+        jaxpr = self.jaxpr
+        Literal = jax.extend.core.Literal
+        live = {v for v in jaxpr.outvars if not isinstance(v, Literal)}
+        keep = []
+        for eqn in reversed(jaxpr.eqns):
+            # effectful equations (debug_print, io_callback) are observable
+            # behavior: always live, like the reference pass's side-effect
+            # whitelist
+            if eqn.effects or any(ov in live for ov in eqn.outvars):
+                keep.append(eqn)
+                for iv in eqn.invars:
+                    if not isinstance(iv, Literal):
+                        live.add(iv)
+        keep.reverse()
+        kept_pairs = [(v, c) for v, c in zip(jaxpr.constvars,
+                                             self._closed.consts)
+                      if v in live]
+        new_jaxpr = jaxpr.replace(
+            eqns=keep, constvars=[v for v, _ in kept_pairs])
+        closed = jax.extend.core.ClosedJaxpr(new_jaxpr,
+                                             [c for _, c in kept_pairs])
+        return IrProgram(closed, self._example_args)
+
+    def fold_constants(self) -> "IrProgram":
+        """Constant folding (reference constant_folding_pass): a partial
+        evaluation — equations whose inputs are all known constants execute
+        eagerly at pass time and re-enter the program as constvars."""
+        jaxpr = self.jaxpr
+        Literal = jax.extend.core.Literal
+        known: Dict[Any, Any] = dict(zip(jaxpr.constvars,
+                                         self._closed.consts))
+        new_eqns = []
+        for eqn in jaxpr.eqns:
+            vals, all_known = [], True
+            for v in eqn.invars:
+                if isinstance(v, Literal):
+                    vals.append(v.val)
+                elif v in known:
+                    vals.append(known[v])
+                else:
+                    all_known = False
+                    break
+            if all_known and not eqn.effects:
+                out = eqn.primitive.bind(*vals, **eqn.params)  # eager
+                outs = out if eqn.primitive.multiple_results else [out]
+                for v, o in zip(eqn.outvars, outs):
+                    known[v] = o
+            else:
+                new_eqns.append(eqn)
+        # folded values still referenced become constvars of the new jaxpr
+        used = {v for eqn in new_eqns for v in eqn.invars
+                if not isinstance(v, Literal)}
+        used |= {v for v in jaxpr.outvars if not isinstance(v, Literal)}
+        new_constvars = [v for v in known if v in used]
+        new_jaxpr = jaxpr.replace(eqns=new_eqns, constvars=new_constvars)
+        closed = jax.extend.core.ClosedJaxpr(
+            new_jaxpr, [known[v] for v in new_constvars])
+        return IrProgram(closed, self._example_args)
+
+    def replace_op(self, prim_name: str,
+                   impl: Callable[..., Any]) -> "IrProgram":
+        """Rewrite pattern (pir RewritePattern analog): every equation whose
+        primitive is ``prim_name`` is re-emitted through ``impl(*inputs)``
+        (the replacement supplies its own semantics — original eqn params
+        are not forwarded); everything else re-binds unchanged."""
+        jaxpr, consts = self.jaxpr, self._closed.consts
+
+        def rewritten(*args):
+            env: Dict[Any, Any] = {}
+
+            def read(v):
+                if isinstance(v, jax.extend.core.Literal):
+                    return v.val
+                return env[v]
+
+            for var, c in zip(jaxpr.constvars, consts):
+                env[var] = c
+            for var, a in zip(jaxpr.invars,
+                              [getattr(x, "_data", x) for x in args]):
+                env[var] = a
+            for eqn in jaxpr.eqns:
+                vals = [read(v) for v in eqn.invars]
+                if eqn.primitive.name == prim_name:
+                    out = impl(*vals)
+                    outs = out if isinstance(out, (tuple, list)) else [out]
+                else:
+                    out = eqn.primitive.bind(*vals, **eqn.params)
+                    outs = out if eqn.primitive.multiple_results else [out]
+                for v, o in zip(eqn.outvars, outs):
+                    env[v] = o
+            return [read(v) for v in jaxpr.outvars]
+
+        return IrProgram.from_function(lambda *a: rewritten(*a),
+                                       *self._example_args)
+
+    # ---- lowering (the deployment artifact) ------------------------------
+    def to_stablehlo(self) -> str:
+        """StableHLO text of the program (what jit.save serializes)."""
+        return jax.jit(self.__call__).lower(
+            *self._example_args).as_text(dialect="stablehlo")
+
+
+def trace(fn: Callable, *example_args) -> IrProgram:
+    """Capture ``fn`` into an IrProgram (paddle.static-style program
+    capture, jaxpr-backed)."""
+    return IrProgram.from_function(
+        fn, *[getattr(a, "_data", a) for a in example_args])
