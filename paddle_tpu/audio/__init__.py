@@ -205,3 +205,4 @@ from . import backends  # noqa: E402,F401
 load = backends.load
 save = backends.save
 info = backends.info
+from . import datasets  # noqa: E402,F401

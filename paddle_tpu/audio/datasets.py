@@ -1,0 +1,134 @@
+"""paddle.audio.datasets (reference: python/paddle/audio/datasets/ —
+dataset.py AudioClassificationDataset + esc50.py + tess.py).
+
+Zero-egress environment: datasets load from LOCAL extracted archives; the
+feature pipeline (raw / spectrogram / mfcc etc.) reuses paddle_tpu.audio
+features exactly as the reference's AudioClassificationDataset does.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+_FEAT_FNS = ("raw", "spectrogram", "melspectrogram", "logmelspectrogram",
+             "mfcc")
+
+
+class AudioClassificationDataset(Dataset):
+    """reference datasets/dataset.py:30 — (file, label) list + on-access
+    feature extraction."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw", sample_rate: Optional[int] = None,
+                 **feat_kwargs):
+        if feat_type not in _FEAT_FNS:
+            raise ValueError(f"feat_type must be one of {_FEAT_FNS}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        self.sample_rate = sample_rate
+
+    def _convert(self, wav: np.ndarray, sr: int):
+        if self.feat_type == "raw":
+            return wav.astype("float32")
+        from . import features as F  # class namespace on the audio package
+
+        from ..core.tensor import Tensor
+        name = {"spectrogram": "Spectrogram",
+                "melspectrogram": "MelSpectrogram",
+                "logmelspectrogram": "LogMelSpectrogram",
+                "mfcc": "MFCC"}[self.feat_type]
+        kwargs = dict(self.feat_kwargs)
+        if name != "Spectrogram":
+            kwargs.setdefault("sr", sr)
+        extractor = getattr(F, name)(**kwargs)
+        x = Tensor(wav.astype("float32")[None, :])
+        return np.asarray(extractor(x).numpy())[0]
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        from .backends import load as _load
+
+        wav, sr = _load(self.files[idx], normalize=True)
+        if self.sample_rate is not None and sr != self.sample_rate:
+            # no resampler in-tree: refuse loudly rather than silently mix
+            # feature parameters across rates
+            raise ValueError(
+                f"{self.files[idx]}: file sample rate {sr} != requested "
+                f"{self.sample_rate} (resampling is not supported; omit "
+                "sample_rate to use each file's native rate)")
+        wav = np.asarray(wav.numpy() if hasattr(wav, "numpy") else wav)
+        if wav.ndim > 1:
+            wav = wav.mean(axis=0)
+        return self._convert(wav, sr), np.int64(self.labels[idx])
+
+
+class ESC50(AudioClassificationDataset):
+    """reference esc50.py:43 — environmental sounds, labels from
+    meta/esc50.csv, 5-fold split; pass ``data_dir`` = extracted
+    ESC-50-master directory."""
+
+    META = os.path.join("meta", "esc50.csv")
+    AUDIO = "audio"
+
+    def __init__(self, data_dir=None, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", **kwargs):
+        if data_dir is None:
+            raise RuntimeError(
+                "zero-egress environment: pass data_dir=<ESC-50-master>")
+        if mode not in ("train", "dev"):
+            raise ValueError(f"mode must be 'train' or 'dev', got {mode!r}")
+        files, labels = [], []
+        with open(os.path.join(data_dir, self.META), newline="",
+                  encoding="utf-8") as f:
+            for row in csv.DictReader(f):
+                in_fold = int(row["fold"]) == int(split)
+                if (mode == "dev") == in_fold:
+                    files.append(os.path.join(data_dir, self.AUDIO,
+                                              row["filename"]))
+                    labels.append(int(row["target"]))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """reference tess.py:30 — Toronto emotional speech set; emotion is the
+    last underscore-separated token of each stem:
+    <word>_<speaker>_<emotion>.wav under ``data_dir`` (recursive)."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, data_dir=None, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw", **kwargs):
+        if data_dir is None:
+            raise RuntimeError(
+                "zero-egress environment: pass data_dir=<extracted TESS>")
+        if mode not in ("train", "dev"):
+            raise ValueError(f"mode must be 'train' or 'dev', got {mode!r}")
+        label_of = {e: i for i, e in enumerate(self.EMOTIONS)}
+        all_files: List[Tuple[str, int]] = []
+        for dirpath, _, fns in sorted(os.walk(data_dir)):
+            for fn in sorted(fns):
+                if not fn.lower().endswith(".wav"):
+                    continue
+                emotion = fn.rsplit(".", 1)[0].split("_")[-1].lower()
+                if emotion in label_of:
+                    all_files.append((os.path.join(dirpath, fn),
+                                      label_of[emotion]))
+        files, labels = [], []
+        for i, (path, lab) in enumerate(all_files):
+            in_fold = (i % n_folds) + 1 == int(split)
+            if (mode == "dev") == in_fold:
+                files.append(path)
+                labels.append(lab)
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)
