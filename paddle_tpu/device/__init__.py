@@ -207,3 +207,7 @@ def _dev_id(device) -> int:
 
 cuda = _MemNamespace()
 tpu = _MemNamespace()
+
+from . import memory_debug  # noqa: E402,F401
+from .memory_debug import (donation_audit, live_arrays_report,  # noqa: E402,F401
+                           memory_analysis)
