@@ -36,23 +36,29 @@ class AudioClassificationDataset(Dataset):
         self.feat_type = feat_type
         self.feat_kwargs = feat_kwargs
         self.sample_rate = sample_rate
+        self._extractors: dict = {}  # sr -> extractor (fbank/DCT are costly)
+
+    def _extractor_for(self, sr: int):
+        ex = self._extractors.get(sr)
+        if ex is None:
+            from . import features as F  # class namespace on audio package
+
+            name = {"spectrogram": "Spectrogram",
+                    "melspectrogram": "MelSpectrogram",
+                    "logmelspectrogram": "LogMelSpectrogram",
+                    "mfcc": "MFCC"}[self.feat_type]
+            kwargs = dict(self.feat_kwargs)
+            if name != "Spectrogram":
+                kwargs.setdefault("sr", sr)
+            ex = self._extractors[sr] = getattr(F, name)(**kwargs)
+        return ex
 
     def _convert(self, wav: np.ndarray, sr: int):
         if self.feat_type == "raw":
             return wav.astype("float32")
-        from . import features as F  # class namespace on the audio package
-
         from ..core.tensor import Tensor
-        name = {"spectrogram": "Spectrogram",
-                "melspectrogram": "MelSpectrogram",
-                "logmelspectrogram": "LogMelSpectrogram",
-                "mfcc": "MFCC"}[self.feat_type]
-        kwargs = dict(self.feat_kwargs)
-        if name != "Spectrogram":
-            kwargs.setdefault("sr", sr)
-        extractor = getattr(F, name)(**kwargs)
         x = Tensor(wav.astype("float32")[None, :])
-        return np.asarray(extractor(x).numpy())[0]
+        return np.asarray(self._extractor_for(sr)(x).numpy())[0]
 
     def __len__(self):
         return len(self.files)
