@@ -166,6 +166,41 @@ def _run_decode(on_tpu):
         else:
             out["decode_ms_per_token_b1"] = round(per_step * 1e3, 3)
         del gen
+
+    if on_tpu:
+        # page-size sweep: the page IS the decode kernel's KV tile; record
+        # the measured winner so LlamaGenerator(page_size="auto") finds it
+        from paddle_tpu.kernels import autotune
+        sweep = {}
+        for psz in (16, 32, 64, 128):
+            try:
+                gen = LlamaGenerator(model, max_batch=8, max_seq_len=max_seq,
+                                     page_size=psz,
+                                     prefill_bucket=prompt_len)
+                prompts = [list(rng.integers(1, cfg.vocab_size, prompt_len))
+                           for _ in range(8)]
+                gen.generate(prompts, GenerationConfig(max_new_tokens=64))
+                # same short/full diff as above: the (page-size-independent)
+                # prefill cost cancels out of the per-token rate
+                t0 = time.perf_counter()
+                gen.generate(prompts, GenerationConfig(max_new_tokens=8))
+                t_short = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                gen.generate(prompts, GenerationConfig(max_new_tokens=64))
+                t_full = time.perf_counter() - t0
+                sweep[psz] = round((t_full - t_short) / (64 - 8) * 1e3, 3)
+                del gen
+            except Exception:
+                continue
+        if sweep:
+            best = min(sweep, key=sweep.get)
+            autotune.record(
+                autotune.make_key("paged_decode",
+                                  heads=cfg.num_key_value_heads,
+                                  d=cfg.head_dim, dt=str(cfg.dtype)),
+                [best], measurements=sweep)
+            out["decode_page_sweep_ms"] = sweep
+            out["decode_best_page"] = best
     return out
 
 

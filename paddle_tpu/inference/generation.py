@@ -108,13 +108,24 @@ class LlamaGenerator:
     """Batch text generation for ``LlamaForCausalLM`` with paged KV."""
 
     def __init__(self, model: LlamaForCausalLM, *, max_batch: int = 8,
-                 max_seq_len: Optional[int] = None, page_size: int = 32,
+                 max_seq_len: Optional[int] = None, page_size=32,
                  cache_dtype: Optional[str] = None,
                  prefill_bucket: int = 64, sync_every: int = 8):
         c = model.config
         self.config = c
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len or c.max_position_embeddings
+        if page_size in (None, "auto"):
+            # the page IS the decode kernel's KV tile: consult the measured
+            # autotune cache (populated by the bench's decode sweep), fall
+            # back to 32 on a cold cache (phi autotune-cache idiom)
+            from ..kernels import autotune
+            page_size = autotune.lookup(autotune.make_key(
+                "paged_decode", heads=c.num_key_value_heads,
+                d=c.head_dim, dt=str(cache_dtype or c.dtype))) or 32
+            if isinstance(page_size, (tuple, list)):
+                page_size = page_size[0]
+        page_size = int(page_size)
         self.page_size = page_size
         self.prefill_bucket = prefill_bucket
         self.sync_every = sync_every

@@ -123,6 +123,19 @@ def lookup(key: str):
         return tuple(v) if isinstance(v, list) else v
 
 
+def record(key: str, config, measurements: Optional[dict] = None):
+    """Explicitly store a measured winner (used by external sweeps, e.g.
+    the bench's decode page-size search)."""
+    with _LOCK:
+        _load()
+        _MEM[key] = list(config) if isinstance(config, (tuple, list)) \
+            else config
+        if measurements:
+            _MEASURED[key] = {str(k): round(float(v), 4)
+                              for k, v in measurements.items()}
+        _save()
+
+
 def lookup_or_tune(key: str, candidates: Sequence,
                    bench: Callable[[object], Optional[Callable[[], None]]],
                    default):
