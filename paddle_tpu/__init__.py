@@ -19,8 +19,9 @@ if "JAX_ENABLE_X64" not in _os.environ:
 
 from . import dtypes, errors, flags
 from .dtypes import (  # noqa: F401
-    bfloat16, bool_, complex64, complex128, float16, float32, float64,
-    get_default_dtype, int8, int16, int32, int64, set_default_dtype, uint8,
+    bfloat16, bool_, complex64, complex128, dtype, float8_e4m3fn,
+    float8_e5m2, float16, float32, float64, get_default_dtype, int8, int16,
+    int32, int64, pstring, raw, set_default_dtype, uint8,
 )
 from .flags import get_flags, set_flags  # noqa: F401
 from .core import (  # noqa: F401
@@ -128,4 +129,17 @@ def __getattr__(name):
         from .distributed.parallel import DataParallel as _DP
         globals()["DataParallel"] = _DP
         return _DP
+    if name in ("CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "TPUPlace",
+                "XPUPlace", "CustomPlace"):
+        from . import device as _dev
+        globals()[name] = getattr(_dev, name)
+        return globals()[name]
+    if name == "ParamAttr":
+        from .nn.layer import ParamAttr as _PA
+        globals()["ParamAttr"] = _PA
+        return _PA
+    if name == "bool":
+        # paddle.bool is a dtype; exposed lazily so the builtin is never
+        # shadowed inside this module (annotations, future bool() calls)
+        return dtypes.bool_
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

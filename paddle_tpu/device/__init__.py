@@ -50,6 +50,26 @@ class TPUPlace(Place):
         super().__init__("tpu", device_id)
 
 
+class CUDAPlace(Place):
+    """Accepted for reference-script portability: the accelerator here is
+    the TPU, so CUDAPlace(i) denotes accelerator device i."""
+
+    def __init__(self, device_id: int = 0):
+        super().__init__("tpu", device_id)
+
+
+class CUDAPinnedPlace(Place):
+    """Pinned-host memory place (host staging buffers on TPU)."""
+
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class XPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("tpu", device_id)
+
+
 class CustomPlace(Place):
     pass
 

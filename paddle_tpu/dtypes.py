@@ -15,6 +15,14 @@ from . import flags
 
 # Canonical dtype handles (numpy dtype objects; jax accepts them everywhere).
 bool_ = np.dtype("bool")
+import ml_dtypes as _ml
+float8_e4m3fn = np.dtype(_ml.float8_e4m3fn)
+float8_e5m2 = np.dtype(_ml.float8_e5m2)
+# non-numeric placeholder dtypes of the reference type zoo (pstring lives in
+# phi's string tensors; raw is the opaque byte dtype) — host-side markers
+pstring = "pstring"
+raw = "raw"
+dtype = np.dtype        # paddle.dtype constructor surface
 uint8 = np.dtype("uint8")
 int8 = np.dtype("int8")
 int16 = np.dtype("int16")

@@ -11,13 +11,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
-from . import (array, creation, extras, indexing, linalg, manipulation, math,
-               random_ops, search)
+from . import (array, compat, creation, extras, indexing, linalg,
+               manipulation, math, random_ops, search)
 from ._prim import OP_REGISTRY, apply_op  # noqa: F401
 
 # ---- re-export everything public ----
 _MODULES = (creation, math, manipulation, linalg, search, random_ops, extras,
-            array)
+            array, compat)
 __all__ = []
 for _m in _MODULES:
     for _name in dir(_m):
