@@ -150,3 +150,34 @@ setattr(Tensor, "unfold", extras.unfold)
 for _name in list(__all__):
     if _name.endswith("_") and not hasattr(Tensor, _name):
         setattr(Tensor, _name, globals()[_name])
+
+
+# ---- full reference tensor_method_func coverage ----
+# Every remaining method of the reference's python/paddle/tensor/__init__.py
+# table (snapshot ops/ref_tensor_methods.txt: method -> providing module) is
+# attached LATE-BOUND: the provider resolves at first call, so modules like
+# linalg/signal/fft (which import back into the package) stay cycle-free.
+def _late_method(name, modpath):
+    resolved = []  # first call resolves + caches; later calls are direct
+
+    def method(self, *args, **kwargs):
+        if not resolved:
+            import importlib
+            resolved.append(getattr(importlib.import_module(modpath), name))
+        return resolved[0](self, *args, **kwargs)
+    method.__name__ = name
+    method.__qualname__ = f"Tensor.{name}"
+    return method
+
+
+import os as _os  # noqa: E402
+
+with open(_os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                        "ref_tensor_methods.txt")) as _f:
+    for _line in _f:
+        _line = _line.strip()
+        if not _line or _line.startswith("#"):
+            continue
+        _name, _mod = _line.split()
+        if not hasattr(Tensor, _name):
+            setattr(Tensor, _name, _late_method(_name, _mod))

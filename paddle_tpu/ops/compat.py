@@ -16,7 +16,7 @@ from ._prim import apply_op
 
 __all__ = [
     "iinfo", "finfo", "shape", "rank", "tolist", "reverse", "pdist",
-    "reduce_as", "create_parameter", "check_shape",
+    "reduce_as", "create_parameter", "create_tensor", "check_shape",
     "disable_signal_handler", "LazyGuard",
     "addmm_", "where_", "mod_", "floor_mod_", "renorm_", "polygamma_",
     "gammainc_", "gammaincc_", "multigammaln_", "bitwise_left_shift_",
@@ -142,6 +142,13 @@ def check_shape(shape):  # noqa: A002
             raise ValueError(
                 f"invalid dimension {d}: negative dims are not accepted")
     return True
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """paddle.create_tensor — an empty typed tensor (static-graph helper)."""
+    t = Tensor(jnp.zeros((0,), np.dtype(dtype)), name=name)
+    t.persistable = persistable
+    return t
 
 
 def disable_signal_handler():

@@ -389,6 +389,7 @@ def install_inplace_variants(ns: dict):
              "reciprocal", "round", "rsqrt", "sigmoid", "sin", "sinh",
              "sqrt", "square", "tan", "tanh", "trunc", "frac", "erf",
              "erfinv", "digamma", "lgamma", "logit", "i0", "gammaln",
+             "asinh", "acosh", "atanh",
              "add", "subtract", "multiply", "divide", "floor_divide",
              "remainder", "pow", "clip", "lerp", "copysign", "hypot",
              "ldexp", "gcd", "lcm", "nan_to_num", "sinc",

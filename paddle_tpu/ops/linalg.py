@@ -262,6 +262,65 @@ def multi_dot(x, name=None):
     return apply_op("multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), tuple(_t(i) for i in x))
 
 
+def cond(x, p=None, name=None):
+    """paddle.linalg.cond — condition number (reference linalg.py cond).
+    jnp.linalg.cond covers every p except the nuclear norm."""
+    xt = _t(x)
+
+    def prim(a):
+        if p == "nuc":
+            nuc = lambda m: jnp.sum(  # noqa: E731
+                jnp.linalg.svd(m, compute_uv=False), axis=-1)
+            return nuc(a) * nuc(jnp.linalg.inv(a))
+        return jnp.linalg.cond(a, p)
+
+    return apply_op("cond", prim, (xt,))
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """paddle.linalg.ormqr — multiply ``other`` by the FULL implicit Q of a
+    QR held in householder form (reference linalg.py ormqr).
+
+    Q is never materialized: each Householder reflector applies directly to
+    ``other`` (O(n*m*cols) instead of O(n*m^3)).  Q = H_0 H_1 ... H_{n-1},
+    so Q @ o applies reflectors in REVERSE order, Q^T @ o in forward order.
+    """
+    def prim(a, t_, o):
+        n = a.shape[-1]
+
+        def reflect_left(o_, k):
+            v = jnp.concatenate(
+                [jnp.zeros(a.shape[:-2] + (k,), a.dtype),
+                 jnp.ones(a.shape[:-2] + (1,), a.dtype),
+                 a[..., k + 1:, k]], axis=-1)
+            vto = jnp.einsum("...m,...mc->...c", v, o_)
+            return o_ - t_[..., k, None, None] * v[..., :, None] \
+                * vto[..., None, :]
+
+        def reflect_right(o_, k):
+            v = jnp.concatenate(
+                [jnp.zeros(a.shape[:-2] + (k,), a.dtype),
+                 jnp.ones(a.shape[:-2] + (1,), a.dtype),
+                 a[..., k + 1:, k]], axis=-1)
+            ov = jnp.einsum("...cm,...m->...c", o_, v)
+            return o_ - t_[..., k, None, None] * ov[..., :, None] \
+                * v[..., None, :]
+
+        # (left, transpose) -> which side reflectors hit and in what order
+        if left:
+            order = range(n) if transpose else range(n - 1, -1, -1)
+            for k in order:
+                o = reflect_left(o, k)
+        else:
+            # o @ Q applies in forward order; o @ Q^T in reverse
+            order = range(n - 1, -1, -1) if transpose else range(n)
+            for k in order:
+                o = reflect_right(o, k)
+        return o
+
+    return apply_op("ormqr", prim, (_t(x), _t(tau), _t(other)))
+
+
 def householder_product(x, tau, name=None):
     def prim(a, t_):
         m, n = a.shape[-2], a.shape[-1]

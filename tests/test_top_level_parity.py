@@ -27,6 +27,63 @@ def test_every_reference_top_level_name_exists():
     assert missing == [], f"missing top-level names: {missing}"
 
 
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/python/paddle/tensor/__init__.py"),
+    reason="reference tree not present")
+def test_every_reference_tensor_method_exists():
+    src = open("/root/reference/python/paddle/tensor/__init__.py").read()
+    tree = ast.parse(src)
+    names = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", "") == "tensor_method_func":
+                    names = [ast.literal_eval(e) for e in node.value.elts]
+    assert names and len(names) > 300
+    missing = [n for n in names if not hasattr(P.Tensor, n)]
+    assert missing == [], f"missing Tensor methods: {missing}"
+
+
+def test_late_bound_methods_behave():
+    """Spot-check the snapshot-attached methods actually dispatch."""
+    x = P.to_tensor(np.asarray([[4.0, 0.0], [0.0, 2.0]], np.float32))
+    np.testing.assert_allclose(float(x.cond()), 2.0, rtol=1e-5)
+    np.testing.assert_allclose(x.matrix_power(2).numpy(),
+                               np.linalg.matrix_power(x.numpy(), 2),
+                               rtol=1e-5)
+    v = P.to_tensor(np.asarray([0.1, -0.5], np.float32))
+    np.testing.assert_allclose(v.acos().numpy(), np.arccos(v.numpy()),
+                               rtol=1e-5)
+    y = P.to_tensor(np.asarray([0.3], np.float32))
+    assert y.atanh_() is y
+    np.testing.assert_allclose(y.numpy(), np.arctanh([0.3]), rtol=1e-5)
+
+
+def test_cond_and_ormqr():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((4, 4)).astype(np.float32)
+    at = P.to_tensor(a)
+    np.testing.assert_allclose(float(P.linalg.cond(at)),
+                               np.linalg.cond(a), rtol=1e-4)
+    np.testing.assert_allclose(float(P.linalg.cond(at, p=1)),
+                               np.linalg.cond(a, p=1), rtol=1e-4)
+    np.testing.assert_allclose(float(P.linalg.cond(at, p="fro")),
+                               np.linalg.cond(a, p="fro"), rtol=1e-4)
+    # ormqr: Q (from householder form) @ other, vs the explicit product
+    import scipy.linalg as sl
+    m, n = 4, 3
+    x = rng.standard_normal((m, n)).astype(np.float32)
+    (hraw, tau), _r = sl.qr(x, mode="raw")
+    h = P.to_tensor(np.asarray(hraw, np.float32))
+    taut = P.to_tensor(tau.astype(np.float32))
+    other = rng.standard_normal((m, 2)).astype(np.float32)
+    qfull = sl.qr(x)[0]  # the full m x m Q the raw form encodes
+    got = P.linalg.ormqr(h, taut, P.to_tensor(other)).numpy()
+    np.testing.assert_allclose(got, qfull @ other, rtol=1e-4, atol=1e-4)
+    gt = P.linalg.ormqr(h, taut, P.to_tensor(other), transpose=True).numpy()
+    np.testing.assert_allclose(gt, qfull.T @ other, rtol=1e-4, atol=1e-4)
+
+
 def test_dtype_objects_and_info():
     assert P.finfo(P.float32).max > 1e38
     assert P.finfo(P.bfloat16).bits == 16
