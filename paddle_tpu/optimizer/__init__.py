@@ -479,6 +479,142 @@ class Adadelta(Optimizer):
         self._set_acc("avg_squared_update", p, su_new)
 
 
+class NAdam(Optimizer):
+    """reference optimizer/nadam.py — Adam with Nesterov momentum
+    (mu-product schedule)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._momentum_decay = momentum_decay
+
+    def _acc_names(self):
+        return ["moment1", "moment2", "mu_product"]
+
+    def _update_param(self, p, g, lr_val, wd):
+        if wd:
+            g = g + wd * p._data
+        t = float(self._step_count)
+        b1, b2, psi = self._beta1, self._beta2, self._momentum_decay
+        mu_t = b1 * (1.0 - 0.5 * 0.96 ** (t * psi))
+        mu_t1 = b1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * psi))
+        mu_prod = self._acc("mu_product", p,
+                            init=jnp.ones((), jnp.float32))
+        mu_prod_new = mu_prod * mu_t
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m_new = b1 * m + (1 - b1) * g
+        v_new = b2 * v + (1 - b2) * jnp.square(g)
+        mhat = mu_t1 * m_new / (1 - mu_prod_new * mu_t1) + \
+            (1 - mu_t) * g / (1 - mu_prod_new)
+        vhat = v_new / (1 - b2 ** t)
+        p._data = p._data - lr_val * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        self._set_acc("moment1", p, m_new)
+        self._set_acc("moment2", p, v_new)
+        self._set_acc("mu_product", p, mu_prod_new)
+
+
+class RAdam(Optimizer):
+    """reference optimizer/radam.py — rectified Adam (variance-rectification
+    term with SGDM fallback while the rectification is undefined)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _acc_names(self):
+        return ["moment1", "moment2"]
+
+    def _update_param(self, p, g, lr_val, wd):
+        if wd:
+            g = g + wd * p._data
+        b1, b2 = self._beta1, self._beta2
+        t = float(self._step_count)
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        m_new = b1 * m + (1 - b1) * g
+        v_new = b2 * v + (1 - b2) * jnp.square(g)
+        mhat = m_new / (1 - b1 ** t)
+        rho_inf = 2.0 / (1.0 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * (b2 ** t) / (1.0 - b2 ** t)
+        if rho_t > 5.0:
+            r = ((rho_t - 4) * (rho_t - 2) * rho_inf
+                 / ((rho_inf - 4) * (rho_inf - 2) * rho_t)) ** 0.5
+            vhat = jnp.sqrt(v_new / (1 - b2 ** t))
+            p._data = p._data - lr_val * r * mhat / (vhat + self._epsilon)
+        else:
+            p._data = p._data - lr_val * mhat
+        self._set_acc("moment1", p, m_new)
+        self._set_acc("moment2", p, v_new)
+
+
+class ASGD(Optimizer):
+    """reference optimizer/asgd.py — averaged SGD over a gradient window."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._batch_num = max(1, int(batch_num))
+
+    def _acc_names(self):
+        return ["d", "ys"]
+
+    def _update_param(self, p, g, lr_val, wd):
+        if wd:
+            g = g + wd * p._data
+        n = self._batch_num
+        d = self._acc("d", p)
+        ys = self._acc("ys", p, init=jnp.zeros((n,) + tuple(p.shape),
+                                               jnp.float32))
+        slot = (self._step_count - 1) % n
+        old = ys[slot]
+        d_new = d - old + g.astype(jnp.float32)
+        ys_new = ys.at[slot].set(g.astype(jnp.float32))
+        p._data = p._data - lr_val * (d_new / min(self._step_count, n)
+                                      ).astype(p._data.dtype)
+        self._set_acc("d", p, d_new)
+        self._set_acc("ys", p, ys_new)
+
+
+class Rprop(Optimizer):
+    """reference optimizer/rprop.py — resilient backprop (sign-based
+    per-weight step sizes)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _acc_names(self):
+        return ["prev_grad", "step_size"]
+
+    def _update_param(self, p, g, lr_val, wd):
+        lo, hi = self._lr_range
+        eta_minus, eta_plus = self._etas
+        prev = self._acc("prev_grad", p)
+        step = self._acc("step_size", p,
+                         init=jnp.full(tuple(p.shape), float(lr_val),
+                                       jnp.float32))
+        sign = jnp.sign(g.astype(jnp.float32) * prev)
+        factor = jnp.where(sign > 0, eta_plus,
+                           jnp.where(sign < 0, eta_minus, 1.0))
+        step_new = jnp.clip(step * factor, lo, hi)
+        g_eff = jnp.where(sign < 0, 0.0, g.astype(jnp.float32))
+        p._data = p._data - (jnp.sign(g_eff) * step_new).astype(p._data.dtype)
+        self._set_acc("prev_grad", p, g_eff)
+        self._set_acc("step_size", p, step_new)
+
+
 class Lamb(Optimizer):
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, parameters=None, grad_clip=None,

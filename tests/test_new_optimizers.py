@@ -1,0 +1,84 @@
+"""ASGD / RAdam / Rprop / NAdam (reference python/paddle/optimizer/
+asgd.py, radam.py, rprop.py, nadam.py).  torch is the numerics oracle
+where it implements the same rule (SURVEY §4 oracle idiom)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.optimizer as opt
+from paddle_tpu.core.tensor import Parameter
+
+
+def _train(o, p, steps=100):
+    losses = []
+    for _ in range(steps):
+        loss = ((p - 3.0) ** 2).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("name,kw,tname,tkw", [
+    ("NAdam", dict(learning_rate=0.05), "NAdam", dict(lr=0.05)),
+    ("RAdam", dict(learning_rate=0.05), "RAdam", dict(lr=0.05)),
+    ("Rprop", dict(learning_rate=0.01), "Rprop", dict(lr=0.01)),
+])
+def test_matches_torch_trajectory(name, kw, tname, tkw):
+    torch = pytest.importorskip("torch")
+    w0 = np.random.default_rng(0).standard_normal((4,)).astype("float32")
+    p = Parameter(w0.copy())
+    o = getattr(opt, name)(parameters=[p], **kw)
+    tp = torch.tensor(w0.copy(), requires_grad=True)
+    to = getattr(torch.optim, tname)([tp], **tkw)
+    for _ in range(60):
+        loss = ((p - 3.0) ** 2).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        tl = ((tp - 3.0) ** 2).sum()
+        to.zero_grad()
+        tl.backward()
+        to.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,kw,factor", [
+    ("NAdam", dict(learning_rate=0.05), 10),
+    ("RAdam", dict(learning_rate=0.05), 4),   # slow rectified tail
+    ("Rprop", dict(learning_rate=0.01), 10),
+    ("ASGD", dict(learning_rate=0.05, batch_num=4), 10),
+])
+def test_converges_on_quadratic(name, kw, factor):
+    w0 = np.random.default_rng(1).standard_normal((4,)).astype("float32")
+    p = Parameter(w0.copy())
+    o = getattr(opt, name)(parameters=[p], **kw)
+    losses = _train(o, p)
+    assert losses[-1] < losses[0] / factor, (losses[0], losses[-1])
+
+
+def test_asgd_average_window():
+    """ASGD's update uses the mean of the last batch_num gradients."""
+    p = Parameter(np.zeros((1,), np.float32))
+    o = opt.ASGD(learning_rate=1.0, batch_num=2, parameters=[p])
+    # constant gradient 1.0 (loss = x): every step moves by ~lr * 1
+    for i in range(3):
+        loss = p.sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    np.testing.assert_allclose(p.numpy(), [-3.0], rtol=1e-5)
+
+
+def test_state_dict_roundtrip():
+    p = Parameter(np.ones((2,), np.float32))
+    o = opt.NAdam(learning_rate=0.05, parameters=[p])
+    (p.sum()).backward()
+    o.step()
+    o.clear_grad()
+    sd = o.state_dict()
+    o2 = opt.NAdam(learning_rate=0.05, parameters=[p])
+    o2.set_state_dict(sd)
+    assert set(o2._accumulators) == set(o._accumulators)
