@@ -35,9 +35,18 @@ class PyLayerContext:
         self.not_inplace_tensors = ()
 
     def save_for_backward(self, *tensors):
-        self._saved = list(tensors)
+        hooks = saved_tensors_hooks._active[-1] \
+            if saved_tensors_hooks._active else None
+        if hooks is not None:
+            self._saved = [hooks.pack_hook(t) for t in tensors]
+            self._pack_hooks = hooks
+        else:
+            self._saved = list(tensors)
+            self._pack_hooks = None
 
     def saved_tensor(self):
+        if getattr(self, "_pack_hooks", None) is not None:
+            return [self._pack_hooks.unpack_hook(h) for h in self._saved]
         return self._saved
 
 
@@ -204,3 +213,26 @@ __all__ = [
     "set_grad_enabled", "PyLayer", "PyLayerContext", "LegacyPyLayer",
     "jacobian", "hessian", "jvp", "vjp",
 ]
+
+
+class saved_tensors_hooks:
+    """reference autograd/saved_tensors_hooks (py_layer.py) — intercept what
+    ``ctx.save_for_backward`` stores: pack_hook runs at save time, and
+    unpack_hook reconstructs the tensor when ``ctx.saved_tensor()`` is read
+    in backward.  The classic offload-to-host / compress recipes work
+    unchanged; per-op tape residuals are XLA-managed and not hookable.
+    """
+
+    _active: list = []
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._active.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active.pop()
+        return False

@@ -58,3 +58,48 @@ def fftshift(x, axes=None, name=None):
 def ifftshift(x, axes=None, name=None):
     return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes),
                     (x if isinstance(x, Tensor) else Tensor(x),))
+
+
+def _hfft_nd(fn_1d, x, s, axes, norm, inverse):
+    """Compose the 1-d Hermitian transform over the LAST axis with complex
+    FFTs over the rest: hfftn = hfft_last(fftn_front(.)) and its inverse
+    ihfftn = ifftn_front(ihfft_last(.)) (reversed order)."""
+    import jax.numpy as jnp
+    from .core.tensor import Tensor
+    from .ops._prim import apply_op
+
+    def prim(a):
+        ax = list(axes if axes is not None else range(a.ndim))
+        sz = list(s) if s is not None else [None] * len(ax)
+        *front, last = ax
+        n_last = sz[-1] if s is not None else None
+        s_front = ([sz[i] for i in range(len(front))]
+                   if s is not None else None)
+        if inverse:
+            out = fn_1d(a, n=n_last, axis=last, norm=norm)
+            if front:
+                out = jnp.fft.ifftn(out, s=s_front, axes=front, norm=norm)
+            return out
+        out = a
+        if front:
+            out = jnp.fft.fftn(out, s=s_front, axes=front, norm=norm)
+        return fn_1d(out, n=n_last, axis=last, norm=norm)
+
+    return apply_op(fn_1d.__name__ + "n", prim,
+                    (x if isinstance(x, Tensor) else Tensor(x),))
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _hfft_nd(jnp.fft.hfft, x, s, axes, norm, inverse=False)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _hfft_nd(jnp.fft.ihfft, x, s, axes, norm, inverse=True)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _hfft_nd(jnp.fft.hfft, x, s, axes, norm, inverse=False)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _hfft_nd(jnp.fft.ihfft, x, s, axes, norm, inverse=True)

@@ -16,3 +16,48 @@ del _inspect, _l, _n
 from .ops.linalg import lu_unpack  # noqa: E402,F401
 
 __all__.append("lu_unpack")
+
+# surfaces living in ops.extras (also Tensor methods) that the reference
+# exposes under paddle.linalg too
+from .ops.extras import cholesky_inverse, matrix_exp  # noqa: E402,F401
+
+__all__ += ["cholesky_inverse", "matrix_exp", "svd_lowrank",
+            "fp8_fp8_half_gemm_fused"]
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    from .ops import extras as _e
+    return _e.svd_lowrank(x, q=q, niter=niter, M=M)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="float16", name=None):
+    """reference linalg.py fp8_fp8_half_gemm_fused — fp8 x fp8 -> half GEMM.
+    On TPU the MXU consumes fp8 natively; XLA fuses the casts/scale."""
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    from . import dtypes as _d
+    from .core.tensor import Tensor
+    from .ops._prim import apply_op
+
+    out_dt = _d.convert_dtype(output_dtype)
+
+    def prim(a, b, *rest):
+        a8 = a.astype(ml_dtypes.float8_e4m3fn)
+        b8 = b.astype(ml_dtypes.float8_e4m3fn)
+        if transpose_x:
+            a8 = jnp.swapaxes(a8, -2, -1)
+        if transpose_y:
+            b8 = jnp.swapaxes(b8, -2, -1)
+        out = jnp.matmul(a8, b8, preferred_element_type=jnp.float32) * scale
+        if rest:
+            out = out + rest[0].astype(jnp.float32)
+        return out.astype(out_dt)
+
+    args = [x if isinstance(x, Tensor) else Tensor(x),
+            y if isinstance(y, Tensor) else Tensor(y)]
+    if bias is not None:
+        args.append(bias if isinstance(bias, Tensor) else Tensor(bias))
+    return apply_op("fp8_fp8_half_gemm_fused", prim, tuple(args))

@@ -1221,3 +1221,206 @@ class Transformer(Layer):
             _jnp.arange(length)[None, :] <= _jnp.arange(length)[:, None],
             0.0, float("-inf")).astype(_jnp.float32)
         return Tensor(mask)
+
+
+class FeatureAlphaDropout(Layer):
+    """reference nn/layer/common.py FeatureAlphaDropout."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, p=self.p, training=self.training)
+
+
+class LPPool1D(Layer):
+    """reference nn/layer/pooling.py LPPool1D."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self._args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                      data_format)
+
+    def forward(self, x):
+        n, k, s, p, c, df = self._args
+        return F.lp_pool1d(x, n, k, stride=s, padding=p, ceil_mode=c,
+                           data_format=df)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                      data_format)
+
+    def forward(self, x):
+        n, k, s, p, c, df = self._args
+        return F.lp_pool2d(x, n, k, stride=s, padding=p, ceil_mode=c,
+                           data_format=df)
+
+
+class HSigmoidLoss(Layer):
+    """reference nn/layer/loss.py HSigmoidLoss (complete-binary-tree
+    hierarchical sigmoid; see F.hsigmoid_loss)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr,
+            default_initializer=initializer.Normal(0.0, 0.1))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], attr=None if bias_attr in (None, True)
+            else bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias=self.bias, path_table=path_table,
+                               path_code=path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """reference nn/layer/loss.py AdaptiveLogSoftmaxWithLoss (Grave et al.
+    adaptive softmax; see F.adaptive_log_softmax_with_loss)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if not cutoffs or cutoffs != sorted(set(cutoffs)) or \
+                cutoffs[-1] > n_classes:
+            raise ValueError(f"invalid cutoffs {cutoffs}")
+        if cutoffs[-1] != n_classes:
+            cutoffs = cutoffs + [n_classes]
+        self.cutoffs = cutoffs
+        self.n_clusters = len(cutoffs) - 1
+        shortlist = cutoffs[0]
+        self.head_weight = self.create_parameter(
+            [shortlist + self.n_clusters, in_features], attr=weight_attr,
+            default_initializer=initializer.XavierNormal())
+        self.head_bias = self.create_parameter(
+            [shortlist + self.n_clusters], is_bias=True) if head_bias \
+            else None
+        self.tail_weights = ParameterList()
+        for c in range(self.n_clusters):
+            hid = max(1, int(in_features / (div_value ** (c + 1))))
+            osz = cutoffs[c + 1] - cutoffs[c]
+            self.tail_weights.append(self.create_parameter(
+                [in_features, hid],
+                default_initializer=initializer.XavierNormal()))
+            self.tail_weights.append(self.create_parameter(
+                [hid, osz], default_initializer=initializer.XavierNormal()))
+
+    def forward(self, input, label):  # noqa: A002
+        tails = [(self.tail_weights[2 * c], self.tail_weights[2 * c + 1])
+                 for c in range(self.n_clusters)]
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.head_bias, self.cutoffs,
+            tails)
+
+    def log_prob(self, input):  # noqa: A002
+        raise NotImplementedError(
+            "log_prob over the full vocabulary is not implemented; use "
+            "forward(input, label) for target log-probs")
+
+    def predict(self, input):  # noqa: A002
+        raise NotImplementedError(
+            "predict is not implemented; take argmax over forward log-probs")
+
+
+class ParameterDict(Layer):
+    """reference nn/layer/container.py ParameterDict."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            self.update(parameters)
+
+    def __setitem__(self, key, param):
+        self.add_parameter(str(key), param)
+
+    def __getitem__(self, key):
+        return self._parameters[str(key)]
+
+    def __contains__(self, key):
+        return str(key) in self._parameters
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def values(self):
+        return self._parameters.values()
+
+    def items(self):
+        return self._parameters.items()
+
+    def update(self, parameters):
+        items = parameters.items() if isinstance(parameters, dict) \
+            else parameters
+        for k, v in items:
+            self[k] = v
+
+
+class LayerDict(Layer):
+    """reference nn/layer/container.py LayerDict."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            self.update(sublayers)
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(str(key), layer)
+
+    def __getitem__(self, key):
+        return self._sub_layers[str(key)]
+
+    def __delitem__(self, key):
+        del self._sub_layers[str(key)]
+
+    def __contains__(self, key):
+        return str(key) in self._sub_layers
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        layer = self[key]
+        del self[key]
+        return layer
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        items = sublayers.items() if isinstance(sublayers, dict) \
+            else sublayers
+        for k, v in items:
+            self[k] = v
+
+
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: E402,F401

@@ -1,0 +1,141 @@
+"""Seq2seq decoding (reference: python/paddle/nn/decode.py —
+BeamSearchDecoder + dynamic_decode).
+
+Host-driven decode loop (the reference's dynamic_decode is a while_op on
+static graphs and a host loop in dygraph; serving-grade decode lives in
+paddle_tpu.inference.generation with the paged-KV device loop — this module
+is the training/eval-time seq2seq surface)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class BeamSearchDecoder:
+    """reference decode.py:64 — beam search over an RNN cell.
+
+    ``cell`` is any callable cell (nn.LSTMCell / GRUCell / SimpleRNNCell
+    style: cell(inputs, states) -> (outputs, new_states)); the output layer
+    maps cell outputs to vocab logits."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- beam plumbing (reference tile_beam_merge_with_batch et al.) ------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[b, ...] -> [b * beam, ...] by repeating each batch row."""
+        a = _arr(x)
+        tiled = jnp.repeat(a, beam_size, axis=0)
+        return Tensor(tiled)
+
+    def _merge(self, x):
+        a = _arr(x)
+        return a.reshape((-1,) + a.shape[2:])
+
+    def _split(self, a, batch):
+        return a.reshape((batch, self.beam_size) + a.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda s: jnp.repeat(_arr(s), self.beam_size, axis=0),
+            initial_cell_states)
+        batch = jax.tree_util.tree_leaves(states)[0].shape[0] \
+            // self.beam_size
+        ids = jnp.full((batch * self.beam_size,), self.start_token,
+                       jnp.int32)
+        # beam 0 active, others -inf so the first step seeds distinct paths
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1), jnp.float32),
+            (batch,))
+        finished = jnp.zeros((batch * self.beam_size,), bool)
+        return ids, states, log_probs, finished
+
+    def step(self, time, ids, states, log_probs, finished):
+        inputs = Tensor(ids)
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        cell_out, new_states = self.cell(inputs, states)
+        logits = self.output_fn(cell_out) if self.output_fn is not None \
+            else cell_out
+        logp = jax.nn.log_softmax(_arr(logits).astype(jnp.float32), -1)
+        vocab = logp.shape[-1]
+        # finished beams only extend with end_token at zero cost
+        fin_mask = jnp.full((vocab,), -1e9).at[self.end_token].set(0.0)
+        logp = jnp.where(finished[:, None], fin_mask[None, :], logp)
+
+        batch = ids.shape[0] // self.beam_size
+        total = log_probs[:, None] + logp                # [b*beam, vocab]
+        total_b = self._split(total, batch).reshape(batch, -1)
+        top_val, top_idx = jax.lax.top_k(total_b, self.beam_size)
+        beam_idx = top_idx // vocab                      # [b, beam]
+        token_idx = (top_idx % vocab).astype(jnp.int32)
+        flat_src = (jnp.arange(batch)[:, None] * self.beam_size
+                    + beam_idx).reshape(-1)
+        new_states = jax.tree_util.tree_map(
+            lambda s: _arr(s)[flat_src], new_states)
+        new_ids = token_idx.reshape(-1)
+        new_log_probs = top_val.reshape(-1)
+        new_finished = jnp.logical_or(finished[flat_src],
+                                      new_ids == self.end_token)
+        return new_ids, new_states, new_log_probs, new_finished, flat_src
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """reference decode.py dynamic_decode — run ``decoder`` to completion.
+
+    Returns (ids [b, beam, T] best-first, final log-probs) and optionally
+    per-beam lengths."""
+    max_steps = int(max_step_num or 32)
+    ids, states, log_probs, finished = decoder.initialize(inits)
+    batch = ids.shape[0] // decoder.beam_size
+    steps = []
+    parents = []
+    t = 0
+    while t < max_steps and not bool(jnp.all(finished)):
+        ids, states, log_probs, finished, src = decoder.step(
+            t, ids, states, log_probs, finished)
+        steps.append(ids)
+        parents.append(src)
+        t += 1
+
+    # backtrace through the beam parents to recover full sequences
+    T = len(steps)
+    seqs = np.zeros((batch * decoder.beam_size, T), np.int32)
+    ptr = np.arange(batch * decoder.beam_size)
+    for k in range(T - 1, -1, -1):
+        seqs[:, k] = np.asarray(steps[k])[ptr]
+        ptr = np.asarray(parents[k])[ptr]
+    seqs = seqs.reshape(batch, decoder.beam_size, T)
+
+    lengths = np.full((batch, decoder.beam_size), T, np.int32)
+    for b in range(batch):
+        for w in range(decoder.beam_size):
+            hits = np.where(seqs[b, w] == decoder.end_token)[0]
+            if hits.size:
+                lengths[b, w] = hits[0] + 1
+    out = (Tensor(jnp.asarray(seqs)),
+           Tensor(log_probs.reshape(batch, decoder.beam_size)))
+    if return_length:
+        return out + (Tensor(jnp.asarray(lengths)),)
+    return out

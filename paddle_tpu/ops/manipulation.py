@@ -467,19 +467,24 @@ def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
 from builtins import abs as builtins_abs  # noqa: E402
 
 
+# the paddle op `slice` (def below) shadows the builtin at module scope;
+# capture the builtin first for the functions that genuinely slice
+_pyslice = slice
+
+
 def crop(x, shape=None, offsets=None, name=None):
     x = _t(x)
     shape = [int(s) for s in (shape or x.shape)]
     offsets = [int(o) for o in (offsets or [0] * x.ndim)]
-    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    slices = tuple(_pyslice(o, o + s) for o, s in zip(offsets, shape))
     return apply_op("crop", lambda a: a[slices], (x,))
 
 
 def strided_slice(x, axes, starts, ends, strides, name=None):
     x = _t(x)
-    sl = [slice(None)] * x.ndim
+    sl = [_pyslice(None)] * x.ndim
     for ax, s, e, st in zip(axes, starts, ends, strides):
-        sl[int(ax)] = slice(int(s), int(e), int(st))
+        sl[int(ax)] = _pyslice(int(s), int(e), int(st))
     sl = tuple(sl)
     return apply_op("strided_slice", lambda a: a[sl], (x,))
 

@@ -353,3 +353,24 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
 
 
 from . import nn  # noqa: E402,F401
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """paddle.sparse.slice (reference sparse/unary.py slice) — slice a
+    sparse tensor; dense-roundtrip lowering (same policy as conv3d etc.)."""
+    from ..ops.manipulation import slice as _dense_slice
+
+    dense = to_dense(x)
+    out = _dense_slice(dense, axes, starts, ends)
+    if isinstance(x, SparseCsrTensor):
+        return _dense_to_csr(out)
+    return _dense_to_coo(out)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """paddle.sparse.pca_lowrank — PCA of a sparse matrix via the dense
+    low-rank routine (XLA arrays are dense on TPU; the sparse input is the
+    API contract, the compute densifies)."""
+    from ..ops.linalg import pca_lowrank as _dense
+
+    return _dense(to_dense(x), q=q, center=center, niter=niter)

@@ -1,0 +1,293 @@
+"""Round-4 surface completion tests: nn.functional + nn layers + linalg +
+fft + sparse + autograd additions (torch as the oracle where it implements
+the same math — SURVEY §4 oracle idiom)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Parameter
+
+
+@pytest.fixture
+def nprng():
+    return np.random.default_rng(0)
+
+
+class TestFunctional:
+    def test_pairwise_distance_torch(self, nprng):
+        torch = pytest.importorskip("torch")
+        a = nprng.standard_normal((4, 8)).astype("float32")
+        b = nprng.standard_normal((4, 8)).astype("float32")
+        for p in (2.0, 1.0, float("inf")):
+            ours = F.pairwise_distance(P.to_tensor(a), P.to_tensor(b),
+                                       p=p).numpy()
+            ref = torch.nn.functional.pairwise_distance(
+                torch.tensor(a), torch.tensor(b), p=p).numpy()
+            np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_inplace_activations(self, nprng):
+        x = P.to_tensor(nprng.standard_normal((3, 4)).astype("float32"))
+        ref = F.tanh(x).numpy()
+        assert F.tanh_(x) is x
+        np.testing.assert_allclose(x.numpy(), ref)
+        for fn in (F.elu_, F.hardtanh_, F.leaky_relu_, F.softmax_,
+                   F.thresholded_relu_):
+            t = P.to_tensor(nprng.standard_normal((3, 4)).astype("float32"))
+            assert fn(t) is t
+
+    def test_lp_pool_torch(self, nprng):
+        torch = pytest.importorskip("torch")
+        x = nprng.standard_normal((2, 3, 8)).astype("float32")
+        np.testing.assert_allclose(
+            F.lp_pool1d(P.to_tensor(x), 2.0, 2, stride=2).numpy(),
+            torch.nn.functional.lp_pool1d(torch.tensor(x), 2.0, 2,
+                                          stride=2).numpy(),
+            rtol=1e-4, atol=1e-5)
+        x4 = np.abs(nprng.standard_normal((2, 3, 8, 8))).astype("float32")
+        np.testing.assert_allclose(
+            F.lp_pool2d(P.to_tensor(x4), 3.0, 2).numpy(),
+            torch.nn.functional.lp_pool2d(torch.tensor(x4), 3.0, 2).numpy(),
+            rtol=1e-4, atol=1e-5)
+
+    def test_adaptive_log_softmax_torch(self, nprng):
+        torch = pytest.importorskip("torch")
+        B, D, N = 6, 16, 20
+        tm = torch.nn.AdaptiveLogSoftmaxWithLoss(D, N, cutoffs=[8, 14],
+                                                 div_value=2.0)
+        x = nprng.standard_normal((B, D)).astype("float32")
+        y = nprng.integers(0, N, B).astype("int64")
+        tout = tm(torch.tensor(x), torch.tensor(y))
+        tails = [(c[0].weight.detach().numpy().T,
+                  c[1].weight.detach().numpy().T) for c in tm.tail]
+        hb = (P.to_tensor(tm.head.bias.detach().numpy())
+              if tm.head.bias is not None else None)
+        out, loss = F.adaptive_log_softmax_with_loss(
+            P.to_tensor(x), P.to_tensor(y),
+            P.to_tensor(tm.head.weight.detach().numpy()),
+            hb, [8, 14, 20],
+            [(P.to_tensor(a), P.to_tensor(b)) for a, b in tails])
+        np.testing.assert_allclose(out.numpy(), tout.output.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(loss), float(tout.loss), rtol=1e-4)
+
+    def test_sparse_attention_full_mask_equals_dense(self, nprng):
+        torch = pytest.importorskip("torch")
+        b, h, s, d = 1, 2, 8, 16
+        q, k, v = (nprng.standard_normal((b, h, s, d)).astype("float32")
+                   for _ in range(3))
+        off = np.tile(np.arange(0, s * s + 1, s, dtype=np.int32), (b, h, 1))
+        cols = np.tile(np.tile(np.arange(s, dtype=np.int32), s), (b, h, 1))
+        ours = F.sparse_attention(P.to_tensor(q), P.to_tensor(k),
+                                  P.to_tensor(v), P.to_tensor(off),
+                                  P.to_tensor(cols)).numpy()
+        ref = torch.nn.functional.scaled_dot_product_attention(
+            torch.tensor(q), torch.tensor(k), torch.tensor(v)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_sparse_attention_band_mask(self, nprng):
+        """A diagonal-band CSR keeps only in-band attention."""
+        b, h, s, d = 1, 1, 6, 8
+        q = nprng.standard_normal((b, h, s, d)).astype("float32")
+        off = np.asarray([[list(range(0, s + 1))]], np.int32)  # 1 nnz/row
+        cols = np.asarray([[list(range(s))]], np.int32)        # diagonal
+        out = F.sparse_attention(P.to_tensor(q), P.to_tensor(q),
+                                 P.to_tensor(q), P.to_tensor(off),
+                                 P.to_tensor(cols)).numpy()
+        np.testing.assert_allclose(out, q, rtol=1e-5)  # self-only attention
+
+    def test_hsigmoid_trains(self, nprng):
+        import paddle_tpu.optimizer as opt
+
+        x = P.to_tensor(nprng.standard_normal((8, 16)).astype("float32"))
+        w = Parameter(nprng.standard_normal((9, 16)).astype("float32") * 0.1)
+        lbl = P.to_tensor(nprng.integers(0, 10, 8).astype("int64"))
+        o = opt.SGD(0.5, parameters=[w])
+        losses = []
+        for _ in range(30):
+            loss = F.hsigmoid_loss(x, lbl, 10, w).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7
+
+    def test_flashmask_and_qkvpacked(self, nprng):
+        q = nprng.standard_normal((1, 6, 2, 8)).astype("float32")
+        o1 = F.flashmask_attention(P.to_tensor(q), P.to_tensor(q),
+                                   P.to_tensor(q), causal=True).numpy()
+        o2 = F.scaled_dot_product_attention(
+            P.to_tensor(q), P.to_tensor(q), P.to_tensor(q),
+            is_causal=True).numpy()
+        np.testing.assert_allclose(o1, o2, rtol=1e-4, atol=1e-5)
+        si = np.full((1, 1, 6, 1), 3, np.int32)
+        om = F.flashmask_attention(P.to_tensor(q), P.to_tensor(q),
+                                   P.to_tensor(q), P.to_tensor(si)).numpy()
+        assert not np.allclose(om, o2)
+
+        qkv = nprng.standard_normal((2, 6, 3, 2, 8)).astype("float32")
+        op, _ = F.flash_attn_qkvpacked(P.to_tensor(qkv), causal=True)
+        ou, _ = F.flash_attention(P.to_tensor(qkv[:, :, 0]),
+                                  P.to_tensor(qkv[:, :, 1]),
+                                  P.to_tensor(qkv[:, :, 2]), causal=True)
+        np.testing.assert_allclose(op.numpy(), ou.numpy(), rtol=1e-5)
+        tot = 12
+        qkvv = nprng.standard_normal((tot, 3, 2, 8)).astype("float32")
+        cu = np.asarray([0, 5, 12], np.int32)
+        ov, _ = F.flash_attn_varlen_qkvpacked(
+            P.to_tensor(qkvv), P.to_tensor(cu), P.to_tensor(cu), causal=True)
+        assert ov.shape == [tot, 2, 8]
+
+    def test_feature_alpha_dropout_channelwise(self):
+        P.seed(0)
+        x = P.ones([4, 8, 5, 5])
+        y = F.feature_alpha_dropout(x, p=0.5).numpy()
+        per_chan = y.reshape(4, 8, -1)
+        for i in range(4):
+            for c in range(8):
+                assert len(np.unique(per_chan[i, c])) == 1
+        np.testing.assert_array_equal(
+            F.feature_alpha_dropout(x, p=0.5, training=False).numpy(),
+            x.numpy())
+
+
+class TestLayers:
+    def test_layer_classes(self, nprng):
+        x = P.to_tensor(nprng.standard_normal((6, 16)).astype("float32"))
+        m = nn.AdaptiveLogSoftmaxWithLoss(16, 20, [8, 14])
+        out, loss = m(x, P.to_tensor(nprng.integers(0, 20, 6).astype("int64")))
+        assert out.shape == [6] and np.isfinite(float(loss))
+        h = nn.HSigmoidLoss(16, 10)
+        hl = h(x, P.to_tensor(nprng.integers(0, 10, 6).astype("int64")))
+        assert hl.shape == [6, 1] and float(hl.mean()) > 0
+        assert nn.LPPool1D(2.0, 2, stride=2)(
+            P.to_tensor(nprng.standard_normal((2, 3, 8)).astype("float32"))
+        ).shape == [2, 3, 4]
+        assert nn.LPPool2D(2.0, 2)(
+            P.to_tensor(nprng.standard_normal((2, 3, 8, 8)).astype("float32"))
+        ).shape == [2, 3, 4, 4]
+        fa = nn.FeatureAlphaDropout(0.5)
+        fa.eval()
+        np.testing.assert_array_equal(fa(x).numpy(), x.numpy())
+
+    def test_containers(self):
+        pd = nn.ParameterDict({"a": P.create_parameter([2, 2], "float32")})
+        pd["b"] = P.create_parameter([3], "float32", is_bias=True)
+        assert len(pd) == 2 and "a" in pd
+        assert len([p for p in pd.values()]) == 2
+        # registered: visible to optimizers
+        assert len(list(pd.parameters())) == 2
+
+        ld = nn.LayerDict({"fc": nn.Linear(4, 4)})
+        ld["act"] = nn.ReLU()
+        assert len(ld) == 2
+        assert isinstance(ld.pop("act"), nn.ReLU) and len(ld) == 1
+        assert len(list(ld["fc"].parameters())) == 2
+
+    def test_beam_search_decode(self):
+        class Cell(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(12, 8)
+                self.fc = nn.Linear(8, 12)
+
+            def __call__(self, inputs, states):
+                h = self.emb(inputs) + states
+                return self.fc(h), h
+
+        P.seed(3)
+        dec = nn.BeamSearchDecoder(Cell(), start_token=0, end_token=11,
+                                   beam_size=3)
+        ids, scores, lens = nn.dynamic_decode(dec, P.zeros([2, 8]),
+                                              max_step_num=6,
+                                              return_length=True)
+        assert ids.shape[0] == 2 and ids.shape[1] == 3
+        s = scores.numpy()
+        assert (np.diff(s, axis=1) <= 1e-5).all()   # best-first ordering
+        assert lens.numpy().max() <= 6
+
+
+class TestNamespaceExtras:
+    def test_hermitian_ffts_torch(self, nprng):
+        torch = pytest.importorskip("torch")
+        x = (nprng.standard_normal((4, 6))
+             + 1j * nprng.standard_normal((4, 6)))
+        xr = nprng.standard_normal((4, 6))
+        np.testing.assert_allclose(
+            P.fft.hfft2(P.to_tensor(x)).numpy(),
+            torch.fft.hfft2(torch.tensor(x)).numpy(), rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(
+            P.fft.ihfft2(P.to_tensor(xr)).numpy(),
+            torch.fft.ihfft2(torch.tensor(xr)).numpy(), rtol=1e-6,
+            atol=1e-8)
+        np.testing.assert_allclose(
+            P.fft.hfftn(P.to_tensor(x)).numpy(),
+            torch.fft.hfftn(torch.tensor(x)).numpy(), rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(
+            P.fft.ihfftn(P.to_tensor(xr)).numpy(),
+            torch.fft.ihfftn(torch.tensor(xr)).numpy(), rtol=1e-6,
+            atol=1e-8)
+
+    def test_fp8_gemm(self, nprng):
+        a = nprng.standard_normal((8, 16)).astype("float32")
+        b = nprng.standard_normal((16, 8)).astype("float32")
+        out = P.linalg.fp8_fp8_half_gemm_fused(P.to_tensor(a),
+                                               P.to_tensor(b))
+        assert out.numpy().dtype == np.float16
+        rel = np.abs(out.numpy().astype("float32") - a @ b).max() \
+            / np.abs(a @ b).max()
+        assert rel < 0.2
+
+    def test_sparse_slice_and_pca(self, nprng):
+        import paddle_tpu.sparse as S
+
+        x = np.zeros((4, 6), np.float32)
+        x[0, 1], x[2, 3] = 2.0, 5.0
+        st = S._dense_to_coo(P.to_tensor(x))
+        np.testing.assert_allclose(
+            S.slice(st, [0, 1], [0, 1], [3, 5]).to_dense().numpy(),
+            x[0:3, 1:5])
+        _, sv, _ = S.pca_lowrank(st, q=2)
+        assert sv.shape == [2]
+
+    def test_slice_family_builtin_shadow_fixed(self, nprng):
+        """Regression: ops.manipulation.slice shadowed the builtin inside
+        strided_slice/crop."""
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        np.testing.assert_allclose(
+            P.slice(P.to_tensor(x), [0, 1], [0, 1], [3, 5]).numpy(),
+            x[0:3, 1:5])
+        np.testing.assert_allclose(
+            P.strided_slice(P.to_tensor(x), [1], [0], [6], [2]).numpy(),
+            x[:, ::2])
+        np.testing.assert_allclose(
+            P.crop(P.to_tensor(x), shape=[2, 3], offsets=[1, 2]).numpy(),
+            x[1:3, 2:5])
+
+    def test_saved_tensors_hooks(self):
+        from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+
+        packed, unpacked = [], []
+
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, g):
+                (x,) = ctx.saved_tensor()
+                return g * 2.0 * x
+
+        x = P.to_tensor(np.asarray([3.0], np.float32))
+        x.stop_gradient = False
+        with saved_tensors_hooks(
+                lambda t: (packed.append(1), np.asarray(t.numpy()))[1],
+                lambda h: (unpacked.append(1), P.to_tensor(h))[1]):
+            y = Square.apply(x)
+        y.backward()
+        assert packed and unpacked
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
