@@ -35,6 +35,15 @@ from . import checkpoint  # noqa: F401
 from . import sharding  # noqa: F401
 from . import launch  # noqa: F401
 from . import rpc  # noqa: F401
+from . import io  # noqa: F401
+from .checkpoint.api import load_state_dict, save_state_dict  # noqa: F401
+from .compat import (  # noqa: F401
+    CountFilterEntry, DistAttr, InMemoryDataset, ParallelMode,
+    ProbabilityEntry, QueueDataset, ReduceType, ShowClickEntry,
+    alltoall_single, gather, gloo_barrier, gloo_init_parallel_env,
+    gloo_release, is_available, scatter_object_list, shard_scaler, split,
+    wait,
+)
 from . import auto_tuner  # noqa: F401
 from . import watchdog  # noqa: F401
 from .pipeline_spmd import pipeline_apply  # noqa: F401

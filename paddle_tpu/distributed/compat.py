@@ -1,0 +1,199 @@
+"""Remaining paddle.distributed surface (reference distributed/__init__.py
+__all__): small collectives/utilities, PS-adjacent dataset stubs, and
+re-exports.  Wired into distributed/__init__.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import env as _env
+from .communication import all_gather, all_gather_object  # noqa: F401
+
+__all__ = [
+    "gather", "scatter_object_list", "alltoall_single", "wait", "split",
+    "is_available",
+    "ParallelMode", "ReduceType", "DistAttr", "shard_scaler",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+    "QueueDataset", "InMemoryDataset", "CountFilterEntry",
+    "ShowClickEntry", "ProbabilityEntry",
+]
+
+
+class ParallelMode:
+    """reference parallel.py ParallelMode constants."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType:
+    """reference auto_parallel ReduceType (partial placements)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """reference DistAttr — carried mesh + placements of a DistTensor.
+    Under GSPMD the truth lives on the array's sharding; this records the
+    user-declared view."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")
+
+
+def is_available() -> bool:
+    """reference distributed.is_available."""
+    return True
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """reference communication/gather.py — all ranks send to dst.
+
+    Single-controller SPMD: arrays are global, so gather == all_gather with
+    the result meaningful on the dst rank (every rank holds it)."""
+    out: List = []
+    all_gather(out, tensor, group=group, sync_op=sync_op)
+    if gather_list is not None and _env.get_rank() == dst:
+        gather_list.extend(out)
+    return out if _env.get_rank() == dst else None
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """reference scatter_object_list — rank ``src``'s list is distributed
+    one object per rank.  Single-controller: every rank sees the source
+    list, so each takes its own slot."""
+    rank = _env.get_rank()
+    objs = in_object_list or []
+    if objs:
+        out_object_list.append(objs[rank % len(objs)])
+    return out_object_list
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """reference alltoall_single — one fused tensor, row-block j going to
+    rank j.  Single-controller: the tensor is GLOBAL, so the world-wide
+    exchange is the block transpose of the [ranks, rows/ranks] view (an
+    identity when each rank contributes one block)."""
+    t = in_tensor if isinstance(in_tensor, Tensor) else Tensor(in_tensor)
+    n = group.nranks if group is not None else _env.get_world_size()
+    rows = t.shape[0]
+    if rows % n:
+        raise ValueError(
+            f"alltoall_single: leading dim {rows} must divide world {n}")
+    blocks = t._data.reshape((n, rows // n) + tuple(t.shape[1:]))
+    result = Tensor(jnp.swapaxes(blocks, 0, 1).reshape(t._data.shape)
+                    if rows // n > 1 else t._data)
+    if out_tensor is not None:
+        out_tensor._data = result._data
+        return out_tensor
+    return result
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference communication/wait — block until the tensor is ready
+    (PJRT: block_until_ready; streams are XLA-managed)."""
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    jax.block_until_ready(arr)
+    return tensor
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference collective.split — megatron-style parallel linear/embedding
+    split over the model-parallel group.  The mpu layers own this here."""
+    from .fleet.mpu import (ColumnParallelLinear, RowParallelLinear,
+                            VocabParallelEmbedding)
+
+    if operation == "linear":
+        cls = ColumnParallelLinear if axis == 1 else RowParallelLinear
+        layer = cls(size[0], size[1], weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    gather_output=gather_out) \
+            if axis == 1 else cls(size[0], size[1],
+                                  weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=False)
+        return layer(x)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unknown split operation {operation!r}")
+
+
+def shard_scaler(scaler):
+    """reference auto_parallel shard_scaler — the GradScaler's found-inf
+    reduction is already global under SPMD; returns the scaler unchanged."""
+    return scaler
+
+
+# ---- gloo CPU-barrier trio (reference gloo_init_parallel_env etc.) -------
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """The CPU rendezvous role of gloo is played by the jax.distributed
+    coordination service here."""
+    _env.init_parallel_env()
+
+
+def gloo_barrier():
+    from .communication import barrier
+
+    if _env.get_world_size() > 1:
+        barrier()
+
+
+def gloo_release():
+    """No persistent gloo store to release (PJRT owns coordination)."""
+
+
+# ---- PS-adjacent dataset surfaces (SURVEY §7.5 stubs-with-guidance) ------
+
+_PS_DATA_GUIDANCE = (
+    "the parameter-server data pipeline is not implemented in paddle_tpu "
+    "(SURVEY §7.5); use paddle_tpu.io.DataLoader with fork workers, or "
+    "text/vision datasets, for the equivalent ingestion path")
+
+
+class QueueDataset:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(f"QueueDataset: {_PS_DATA_GUIDANCE}")
+
+
+class InMemoryDataset:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(f"InMemoryDataset: {_PS_DATA_GUIDANCE}")
+
+
+class CountFilterEntry:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(f"CountFilterEntry: {_PS_DATA_GUIDANCE}")
+
+
+class ShowClickEntry:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(f"ShowClickEntry: {_PS_DATA_GUIDANCE}")
+
+
+class ProbabilityEntry:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(f"ProbabilityEntry: {_PS_DATA_GUIDANCE}")

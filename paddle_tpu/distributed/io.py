@@ -1,0 +1,27 @@
+"""paddle.distributed.io (reference distributed/io.py — save/load for
+distributed programs).  Delegates to framework save/load: parameters are
+GLOBAL jax arrays under single-controller SPMD, so there is no per-rank
+shard assembly to do here; sharded checkpointing with topology change lives
+in distributed.checkpoint."""
+
+from __future__ import annotations
+
+from ..framework import io as _fio
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    raise NotImplementedError(
+        "static-graph persistable saving: use paddle.save on state_dict, "
+        "or distributed.checkpoint.save_state_dict for sharded checkpoints")
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    raise NotImplementedError(
+        "static-graph persistable loading: use paddle.load, or "
+        "distributed.checkpoint.load_state_dict for sharded checkpoints")
+
+
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", False))

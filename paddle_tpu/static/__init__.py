@@ -316,6 +316,10 @@ class amp:
 
 
 # keep the legacy names importable
+from .compat import *  # noqa: E402,F401,F403
+from .compat import __all__ as _compat_all
+
 __all__ = ["Program", "program_guard", "Executor", "data", "enable_static",
            "disable_static", "default_main_program",
-           "default_startup_program", "append_backward", "InputSpec"]
+           "default_startup_program", "append_backward", "InputSpec",
+           ] + list(_compat_all)

@@ -1,0 +1,383 @@
+"""Remaining paddle.static surface (reference static/__init__.py __all__):
+scopes, places, program serialization, small graph utilities, EMA.  The
+capture-replay Program/Executor core lives in static/__init__.py; these are
+the satellites around it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = [
+    "gradients", "global_scope", "scope_guard", "BuildStrategy",
+    "CompiledProgram", "Print", "py_func", "name_scope",
+    "WeightNormParamAttr", "ExponentialMovingAverage", "save", "load",
+    "serialize_program", "serialize_persistables", "save_to_file",
+    "deserialize_program", "deserialize_persistables", "load_from_file",
+    "normalize_program", "load_program_state", "set_program_state",
+    "cpu_places", "cuda_places", "xpu_places", "Variable",
+    "create_global_var", "create_parameter", "accuracy", "auc",
+    "device_guard", "ipu_shard_guard", "IpuCompiledProgram", "IpuStrategy",
+    "set_ipu_shard", "ctr_metric_bundle",
+]
+
+
+# ---- scopes / places -----------------------------------------------------
+
+class _Scope:
+    """reference core.Scope — named variable store."""
+
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, Tensor(jnp.zeros((0,))))
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_GLOBAL_SCOPE = _Scope()
+_SCOPE_STACK = [_GLOBAL_SCOPE]
+
+
+def global_scope():
+    return _SCOPE_STACK[0]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _SCOPE_STACK.append(scope)
+    try:
+        yield scope
+    finally:
+        _SCOPE_STACK.pop()
+
+
+def cpu_places(device_count=None):
+    from ..device import CPUPlace
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..device import CUDAPlace
+    ids = device_ids if device_ids is not None else \
+        range(jax.device_count())
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..device import XPUPlace
+    ids = device_ids if device_ids is not None else \
+        range(jax.device_count())
+    return [XPUPlace(i) for i in ids]
+
+
+# ---- build/compile compat ------------------------------------------------
+
+class BuildStrategy:
+    """reference BuildStrategy — the pass-toggle knob set.  XLA owns the
+    passes; the attributes are recorded for API parity."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.build_cinn_pass = False
+
+    def __setattr__(self, k, v):
+        self.__dict__[k] = v
+
+
+class CompiledProgram:
+    """reference CompiledProgram — on TPU every executed program is XLA-
+    compiled already; wraps the Program for API parity."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self.program, item)
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU backends do not exist on TPU")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU backends do not exist on TPU")
+
+
+def ipu_shard_guard(*a, **k):
+    raise NotImplementedError("IPU backends do not exist on TPU")
+
+
+def set_ipu_shard(*a, **k):
+    raise NotImplementedError("IPU backends do not exist on TPU")
+
+
+# ---- graph utilities -----------------------------------------------------
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference static/gradients — autograd.grad over captured tensors."""
+    from ..core.autograd import grad as _grad
+
+    outs = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return _grad(outs, ins, grad_outputs=target_gradients,
+                 allow_unused=True)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002,N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference static.Print — debug print that passes the value through
+    (jax.debug.print under trace, plain print in eager)."""
+    arr = input._data if isinstance(input, Tensor) else jnp.asarray(input)
+    prefix = (message or "") + (f" {input.name}" if print_tensor_name and
+                                isinstance(input, Tensor) else "")
+    if isinstance(arr, jax.core.Tracer):
+        jax.debug.print(prefix + " {x}", x=arr)
+        return input
+    print(prefix, np.asarray(arr)[:summarize])
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference static.py_func — host python inside the graph
+    (jax.pure_callback under trace; direct call in eager)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    arrs = [t._data if isinstance(t, Tensor) else jnp.asarray(t) for t in xs]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), np.dtype(o.dtype))
+              for o in outs]
+
+    def host(*np_args):
+        res = func(*[Tensor(a) for a in np_args])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return [np.asarray(getattr(r, "_data", r)) for r in res]
+
+    if any(isinstance(a, jax.core.Tracer) for a in arrs):
+        res = jax.pure_callback(host, shapes, *arrs)
+    else:
+        res = host(*arrs)
+    res = res if isinstance(res, (list, tuple)) else [res]
+    wrapped = [Tensor(r) for r in res]
+    return wrapped[0] if len(wrapped) == 1 else wrapped
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """reference name_scope — names are cosmetic here (XLA keeps its own
+    HLO metadata); kept as a scoping no-op."""
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference device_guard — placement is GSPMD/PJRT-owned; accepted
+    and ignored (the reference uses it to pin ops to cpu/gpu)."""
+    yield
+
+
+class WeightNormParamAttr:
+    """reference WeightNormParamAttr — use nn.utils.weight_norm on the
+    layer instead (real reparameterization); kept for signature parity."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class ExponentialMovingAverage:
+    """reference static.ExponentialMovingAverage — shadow EMA weights with
+    apply/restore context."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow: Dict[int, Any] = {}
+        self._backup: Dict[int, Any] = {}
+        self._params: List[Parameter] = []
+        self._step = 0
+
+    def update(self, parameters=None):
+        params = parameters or self._params
+        if parameters is not None:
+            self._params = list(parameters)
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            prev = self._shadow.get(id(p), p._data)
+            self._shadow[id(p)] = d * prev + (1 - d) * p._data
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p._data
+            if id(p) in self._shadow:
+                p._data = self._shadow[id(p)].astype(p._data.dtype)
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+
+
+# ---- program serialization (StableHLO-backed) ----------------------------
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """Program -> bytes.  The capture-replay Program's op records hold live
+    closures (not picklable by design); the DEPLOYABLE artifact on TPU is
+    StableHLO via jit.save.  What serializes here is the program
+    descriptor: variable specs + parameter names — enough to rebuild state
+    with deserialize_persistables/set_program_state."""
+    import pickle
+
+    from . import default_main_program
+
+    prog = program or default_main_program()
+    desc = {
+        "format": "paddle_tpu.program_descriptor.v1",
+        "params": [getattr(t, "name", f"param_{i}")
+                   for i, t in enumerate(prog.parameters())],
+        "note": "executable export = jit.save (StableHLO)",
+    }
+    return pickle.dumps(desc)
+
+
+def deserialize_program(data):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    import pickle
+
+    from . import default_main_program
+
+    prog = program or default_main_program()
+    state = {getattr(t, "name", None) or f"param_{i}": np.asarray(t._data)
+             for i, t in enumerate(prog.parameters())}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+
+    state = pickle.loads(data)
+    set_program_state(program, state)
+    return state
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+def save(program, model_path, protocol=4, **configs):
+    """reference static.save — program + persistables to files."""
+    save_to_file(model_path + ".pdmodel",
+                 serialize_program([], [], program))
+    save_to_file(model_path + ".pdparams",
+                 serialize_persistables([], [], program))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    data = load_from_file(model_path + ".pdparams")
+    deserialize_persistables(program, data)
+
+
+def load_program_state(model_path, var_list=None):
+    import pickle
+
+    return pickle.loads(load_from_file(model_path + ".pdparams"))
+
+
+def set_program_state(program, state_dict):
+    own = {getattr(t, "name", None) or f"param_{i}": t
+           for i, t in enumerate(program.parameters())}
+    for name, value in state_dict.items():
+        if name in own:
+            t = own[name]
+            t._data = jnp.asarray(value, t._data.dtype).reshape(t.shape)
+
+
+# ---- variables / metrics -------------------------------------------------
+
+Variable = Tensor  # reference static.Variable — the captured tensor handle
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    t = Tensor(jnp.full(tuple(shape), value, np.dtype(dtype)), name=name)
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..ops.compat import create_parameter as _cp
+
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,  # noqa: A002
+        slide_steps=1, ins_tag_weight=None):
+    from ..metric import Auc
+
+    m = Auc(curve=curve, num_thresholds=min(num_thresholds, 4095))
+    inp = input.numpy() if isinstance(input, Tensor) else np.asarray(input)
+    lab = label.numpy() if isinstance(label, Tensor) else np.asarray(label)
+    m.update(inp, lab)
+    val = m.accumulate()
+    z = Tensor(jnp.zeros((1,), jnp.int64))
+    return Tensor(jnp.asarray([val], jnp.float32)), z, [z] * 4
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
+    raise NotImplementedError(
+        "ctr_metric_bundle is part of the PS stack (SURVEY §7.5); use "
+        "paddle_tpu.metric.Auc for CTR evaluation")

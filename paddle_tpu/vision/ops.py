@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 from ..ops._prim import apply_op
@@ -185,3 +186,611 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
         return jax.vmap(one)(feat[img_of], bx)
 
     return apply_op("roi_pool", prim, (_t(x), _t(boxes), _t(boxes_num)))
+
+
+# ---- round-4 detection surface completion --------------------------------
+
+class RoIAlign:
+    """reference vision/ops.py RoIAlign layer over roi_align."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         spatial_scale=self._spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    """reference vision/ops.py RoIPool layer over roi_pool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        spatial_scale=self._spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """reference ops.yaml psroi_pool (R-FCN position-sensitive ROI
+    pooling): input channels C = out_c * ph * pw; output bin (i, j) average-
+    pools its own channel group over the bin's spatial window."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def prim(feat, bx, bn):
+        n, c, h, w = feat.shape
+        out_c = c // (ph * pw)
+        img_of = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                            total_repeat_length=bx.shape[0])
+        roi_feats = feat[img_of]                       # [R, C, H, W]
+
+        def one(f, box):
+            x1 = box[0] * spatial_scale
+            y1 = box[1] * spatial_scale
+            x2 = box[2] * spatial_scale
+            y2 = box[3] * spatial_scale
+            bh = jnp.maximum(y2 - y1, 0.1) / ph
+            bw = jnp.maximum(x2 - x1, 0.1) / pw
+            ys = jnp.arange(h, dtype=jnp.float32)
+            xs = jnp.arange(w, dtype=jnp.float32)
+            # bin membership masks per output position
+            by = jnp.floor((ys - y1) / bh)             # [h]
+            bxs = jnp.floor((xs - x1) / bw)            # [w]
+            out = jnp.zeros((out_c, ph, pw), jnp.float32)
+            fr = f.reshape(out_c, ph, pw, h, w).astype(jnp.float32)
+            for i in range(ph):
+                for j in range(pw):
+                    my = jnp.logical_and(by == i,
+                                         jnp.logical_and(ys >= y1, ys < y2))
+                    mx = jnp.logical_and(bxs == j,
+                                         jnp.logical_and(xs >= x1, xs < x2))
+                    m = my[:, None] * mx[None, :]
+                    denom = jnp.maximum(m.sum(), 1.0)
+                    val = (fr[:, i, j] * m[None]).sum((-2, -1)) / denom
+                    out = out.at[:, i, j].set(val)
+            return out
+
+        return jax.vmap(one)(roi_feats, bx).astype(feat.dtype)
+
+    return apply_op("psroi_pool", prim, (_t(x), _t(boxes), _t(boxes_num)))
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          spatial_scale=self._spatial_scale)
+
+
+def read_file(filename, name=None):
+    """reference ops.yaml read_file — file bytes as a uint8 tensor."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference ops.yaml decode_jpeg — host-side PIL decode to CHW uint8."""
+    import io as _io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(_t(x)._data, np.uint8).tobytes())
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "unchanged"):
+        img = img.convert("RGB")
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """reference ops.yaml box_coder — SSD box encode/decode."""
+    pb, tb = _t(prior_box), _t(target_box)
+    pbv = _t(prior_box_var) if prior_box_var is not None else None
+    norm = 0.0 if box_normalized else 1.0
+
+    def prim(p, t, *var):
+        v = var[0] if var else jnp.ones_like(p)
+        pw = p[:, 2] - p[:, 0] + norm
+        ph_ = p[:, 3] - p[:, 1] + norm
+        pcx = p[:, 0] + pw * 0.5
+        pcy = p[:, 1] + ph_ * 0.5
+        if code_type == "encode_center_size":
+            tw = t[:, 2] - t[:, 0] + norm
+            th = t[:, 3] - t[:, 1] + norm
+            tcx = t[:, 0] + tw * 0.5
+            tcy = t[:, 1] + th * 0.5
+            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph_,
+                             jnp.log(tw / pw), jnp.log(th / ph_)], -1)
+            return out / v
+        # decode: t [R, 4] deltas (axis=0: priors broadcast over rows)
+        d = t * v
+        ocx = d[..., 0] * pw + pcx
+        ocy = d[..., 1] * ph_ + pcy
+        ow = jnp.exp(d[..., 2]) * pw
+        oh = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([ocx - ow * 0.5, ocy - oh * 0.5,
+                          ocx + ow * 0.5 - norm,
+                          ocy + oh * 0.5 - norm], -1)
+
+    args = (pb, tb) + ((pbv,) if pbv is not None else ())
+    return apply_op("box_coder", prim, args)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,  # noqa: A002
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """reference ops.yaml prior_box — SSD anchor generation."""
+    feat, img = _t(input), _t(image)
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+
+    ratios = list(aspect_ratios)
+    if flip:
+        ratios += [1.0 / r for r in aspect_ratios if r != 1.0]
+    if max_sizes and len(max_sizes) != len(min_sizes):
+        raise ValueError("max_sizes must pair 1:1 with min_sizes")
+    boxes = []
+    for i, ms in enumerate(min_sizes):
+        per = [(ms, ms)]
+        ratio_boxes = [(ms * np.sqrt(r), ms / np.sqrt(r))
+                       for r in ratios if abs(r - 1.0) > 1e-6]
+        if max_sizes:
+            mxb = (np.sqrt(ms * max_sizes[i]),) * 2
+            # reference ordering flag: True -> [min, max, ratios...],
+            # False (default) -> [min, ratios..., max]
+            per += ([mxb] + ratio_boxes) if min_max_aspect_ratios_order \
+                else (ratio_boxes + [mxb])
+        else:
+            per += ratio_boxes
+        boxes.extend(per)
+    nb = len(boxes)
+    cx = (np.arange(fw) + offset) * step_w
+    cy = (np.arange(fh) + offset) * step_h
+    grid_cx, grid_cy = np.meshgrid(cx, cy)
+    out = np.zeros((fh, fw, nb, 4), np.float32)
+    for k, (bw, bh) in enumerate(boxes):
+        out[..., k, 0] = (grid_cx - bw / 2) / iw
+        out[..., k, 1] = (grid_cy - bh / 2) / ih
+        out[..., k, 2] = (grid_cx + bw / 2) / iw
+        out[..., k, 3] = (grid_cy + bh / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """reference ops.yaml yolo_box — decode a YOLOv3 head to boxes/scores."""
+    xt, ims = _t(x), _t(img_size)
+    na = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(na, 2)
+
+    def prim(a, im):
+        n, c, h, w = a.shape
+        ioup = None
+        if iou_aware:
+            # PP-YOLO iou-aware layout: na IoU-logit channels first
+            ioup = jax.nn.sigmoid(a[:, :na].reshape(n, na, h, w))
+            a = a[:, na:]
+        a = a.reshape(n, na, -1, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)
+        gy = jnp.arange(h, dtype=jnp.float32)
+        mx, my = jnp.meshgrid(gx, gy)
+        sig = jax.nn.sigmoid
+        bx = (sig(a[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1) + mx) / w
+        by = (sig(a[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1) + my) / h
+        bw = jnp.exp(a[:, :, 2]) * anc[None, :, 0, None, None] \
+            / (w * downsample_ratio)
+        bh = jnp.exp(a[:, :, 3]) * anc[None, :, 1, None, None] \
+            / (h * downsample_ratio)
+        obj = sig(a[:, :, 4])
+        if ioup is not None:
+            obj = obj ** (1.0 - iou_aware_factor) * \
+                ioup ** iou_aware_factor
+        cls = sig(a[:, :, 5:5 + class_num])
+        imh = im[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = im[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        scores = (obj[:, :, None] * cls).transpose(0, 1, 3, 4, 2) \
+            .reshape(n, -1, class_num)
+        keep = (obj.reshape(n, -1) >= conf_thresh)[..., None]
+        return boxes * keep, scores * keep
+    return apply_op("yolo_box", prim, (xt, ims))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """reference ops.yaml yolo_loss (YOLOv3 loss).
+
+    Faithful core: responsible-anchor assignment by best IoU against the
+    masked anchors at each gt's grid cell; xy/wh MSE-style + obj/cls BCE,
+    with no-object loss suppressed where best IoU > ignore_thresh."""
+    xt, gb, gl = _t(x), _t(gt_box), _t(gt_label)
+    mask = list(anchor_mask)
+    na = len(mask)
+    anc = np.asarray(anchors, np.float32).reshape(-1, 2)[mask]
+
+    def prim(a, boxes, labels, *gs):
+        n, c, h, w = a.shape
+        a = a.reshape(n, na, -1, h, w)
+        sig = jax.nn.sigmoid
+        # decode predicted boxes (normalized)
+        gxm, gym = jnp.meshgrid(jnp.arange(w, dtype=jnp.float32),
+                                jnp.arange(h, dtype=jnp.float32))
+        px = (sig(a[:, :, 0]) + gxm) / w
+        py = (sig(a[:, :, 1]) + gym) / h
+        pw = jnp.exp(jnp.clip(a[:, :, 2], -10, 10)) \
+            * anc[None, :, 0, None, None] / (w * downsample_ratio)
+        phh = jnp.exp(jnp.clip(a[:, :, 3], -10, 10)) \
+            * anc[None, :, 1, None, None] / (h * downsample_ratio)
+
+        # per-gt assignment (gt boxes are [n, B, 4] cx/cy/w/h normalized)
+        B = boxes.shape[1]
+        gcx, gcy = boxes[..., 0], boxes[..., 1]
+        gw, gh = boxes[..., 2], boxes[..., 3]
+        gi = jnp.clip((gcx * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gcy * h).astype(jnp.int32), 0, h - 1)
+        # best anchor by wh IoU
+        aw = anc[:, 0] / (w * downsample_ratio)
+        ah = anc[:, 1] / (h * downsample_ratio)
+        inter = jnp.minimum(gw[..., None], aw) * jnp.minimum(gh[..., None], ah)
+        union = gw[..., None] * gh[..., None] + aw * ah - inter
+        best_a = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)  # [n, B]
+        valid = gw > 0
+
+        tx = gcx * w - gi
+        ty = gcy * h - gj
+        tw = jnp.log(jnp.maximum(
+            gw * w * downsample_ratio / jnp.maximum(aw[best_a] * w
+                                                    * downsample_ratio,
+                                                    1e-9), 1e-9))
+        th = jnp.log(jnp.maximum(
+            gh * h * downsample_ratio / jnp.maximum(ah[best_a] * h
+                                                    * downsample_ratio,
+                                                    1e-9), 1e-9))
+
+        bidx = jnp.arange(n)[:, None].repeat(B, 1)
+        sel = lambda t: t[bidx, best_a, gj, gi]  # noqa: E731
+        bce = lambda z, t: jnp.maximum(z, 0) - z * t + \
+            jnp.log1p(jnp.exp(-jnp.abs(z)))  # noqa: E731
+
+        loss_xy = (bce(sel(a[:, :, 0]), tx) + bce(sel(a[:, :, 1]), ty))
+        loss_wh = ((sel(a[:, :, 2]) - tw) ** 2 + (sel(a[:, :, 3]) - th) ** 2) * 0.5
+        scale = 2.0 - gw * gh
+        pos = (loss_xy + loss_wh) * scale * valid
+
+        # objectness: positives at assigned cells; negatives elsewhere
+        # unless best pred-gt IoU > ignore_thresh
+        obj_logit = a[:, :, 4]
+        obj_t = jnp.zeros((n, na, h, w))
+        obj_t = obj_t.at[bidx, best_a, gj, gi].max(valid.astype(jnp.float32))
+        # pred-gt IoU per cell (vs ANY gt)
+        px1, py1 = px - pw / 2, py - phh / 2
+        px2, py2 = px + pw / 2, py + phh / 2
+        gx1 = (gcx - gw / 2)[:, None, None, None, :]
+        gy1 = (gcy - gh / 2)[:, None, None, None, :]
+        gx2 = (gcx + gw / 2)[:, None, None, None, :]
+        gy2 = (gcy + gh / 2)[:, None, None, None, :]
+        iw_ = jnp.maximum(jnp.minimum(px2[..., None], gx2)
+                          - jnp.maximum(px1[..., None], gx1), 0)
+        ih_ = jnp.maximum(jnp.minimum(py2[..., None], gy2)
+                          - jnp.maximum(py1[..., None], gy1), 0)
+        inter2 = iw_ * ih_
+        union2 = (pw * phh)[..., None] + (gw * gh)[:, None, None, None, :] \
+            - inter2
+        best_iou = jnp.max(jnp.where(
+            valid[:, None, None, None, :], inter2 /
+            jnp.maximum(union2, 1e-9), 0.0), -1)
+        noobj_mask = (best_iou < ignore_thresh).astype(jnp.float32)
+        loss_obj = bce(obj_logit, obj_t)
+        obj_term = jnp.where(obj_t > 0, loss_obj,
+                             loss_obj * noobj_mask).sum((1, 2, 3))
+
+        # classification at positives
+        smooth = 1.0 / max(class_num, 1) if use_label_smooth else 0.0
+        cls_logit = sel(a[:, :, 5:5 + class_num].transpose(0, 1, 3, 4, 2))
+        cls_t = jax.nn.one_hot(labels, class_num) * (1 - smooth) + \
+            smooth / class_num
+        loss_cls = (bce(cls_logit, cls_t).sum(-1) * valid)
+
+        return (pos.sum(-1) + obj_term + loss_cls.sum(-1))
+
+    args = (xt, gb, gl) + ((_t(gt_score),) if gt_score is not None else ())
+    return apply_op("yolo_loss", prim, args)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """reference ops.yaml matrix_nms (SOLOv2) — parallel soft-NMS via the
+    pairwise IoU decay matrix."""
+    bx, sc = _t(bboxes), _t(scores)
+
+    def prim(b, s):
+        n, cnum, _ = s.shape[0], s.shape[1], 0
+        outs, idxs = [], []
+        for img in range(b.shape[0]):
+            cls_scores = s[img]                       # [C, M]
+            boxes = b[img]                            # [M, 4]
+            all_scores, all_boxes, all_cls, all_idx = [], [], [], []
+            for c in range(cls_scores.shape[0]):
+                if c == background_label:
+                    continue
+                cs = cls_scores[c]
+                keep = cs > score_threshold
+                order = jnp.argsort(-jnp.where(keep, cs, -1.0))[:nms_top_k]
+                cs_k = jnp.where(keep[order], cs[order], 0.0)
+                bx_k = boxes[order]
+                m = cs_k.shape[0]
+                x1, y1, x2, y2 = bx_k.T
+                area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+                iw_ = jnp.maximum(
+                    jnp.minimum(x2[:, None], x2[None]) -
+                    jnp.maximum(x1[:, None], x1[None]), 0)
+                ih_ = jnp.maximum(
+                    jnp.minimum(y2[:, None], y2[None]) -
+                    jnp.maximum(y1[:, None], y1[None]), 0)
+                inter = iw_ * ih_
+                iou = inter / jnp.maximum(area[:, None] + area[None] - inter,
+                                          1e-9)
+                iou = jnp.tril(iou, -1)               # higher-scored rivals
+                ious_cmax = jnp.max(iou, axis=0)
+                if use_gaussian:
+                    decay = jnp.exp(-(iou ** 2 - ious_cmax[None] ** 2)
+                                    / gaussian_sigma)
+                    decay = jnp.min(jnp.where(iou > 0, decay, 1.0), 0)
+                else:
+                    decay = jnp.min(jnp.where(
+                        iou > 0, (1 - iou) / jnp.maximum(1 - ious_cmax[None],
+                                                         1e-9), 1.0), 0)
+                final = cs_k * decay
+                ok = final > post_threshold
+                all_scores.append(jnp.where(ok, final, 0.0))
+                all_boxes.append(bx_k)
+                all_cls.append(jnp.full((m,), c, jnp.float32))
+                all_idx.append(order)
+            fs = jnp.concatenate(all_scores)
+            fb = jnp.concatenate(all_boxes)
+            fc = jnp.concatenate(all_cls)
+            fi = jnp.concatenate(all_idx)
+            top = jnp.argsort(-fs)[:keep_top_k]
+            outs.append(jnp.concatenate(
+                [fc[top][:, None], fs[top][:, None], fb[top]], -1))
+            idxs.append(fi[top])
+        return jnp.stack(outs), jnp.stack(idxs)
+
+    out, idx = apply_op("matrix_nms", prim, (bx, sc))
+    rois_num = Tensor(jnp.full((bx.shape[0],), out.shape[1], jnp.int32))
+    res = (out,)
+    if return_index:
+        res = res + (idx,)
+    if return_rois_num:
+        res = res + (rois_num,)
+    return res if len(res) > 1 else res[0]
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """reference ops.yaml deformable_conv (v1; v2 with mask) — bilinear
+    sampling at offset locations, then a grouped contraction."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    args = [_t(x), _t(offset), _t(weight)]
+    if mask is not None:
+        args.append(_t(mask))
+    has_mask = mask is not None
+    has_bias = bias is not None
+    if has_bias:
+        args.append(_t(bias))
+
+    def prim(a, off, w_, *rest):
+        m_ = rest[0] if has_mask else None
+        b_ = rest[-1] if has_bias else None
+        n, cin, h, w = a.shape
+        cout, cin_g, kh, kw = w_.shape
+        sh, sw = stride
+        ph_, pw_ = padding
+        dh, dw = dilation
+        oh = (h + 2 * ph_ - dh * (kh - 1) - 1) // sh + 1
+        ow = (w + 2 * pw_ - dw * (kw - 1) - 1) // sw + 1
+        ap = jnp.pad(a, ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)))
+
+        oy = jnp.arange(oh) * sh
+        ox = jnp.arange(ow) * sw
+        # offsets: [n, 2*dg*kh*kw, oh, ow] (y then x per tap)
+        off = off.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+        # absolute sampling grids [n, dg, kh*kw, oh, ow]
+        ky = jnp.arange(kh).repeat(kw)
+        kx = jnp.tile(jnp.arange(kw), kh)
+        gy = (oy[None, None, None, :, None] +
+              ky[None, None, :, None, None] * dh +
+              off[:, :, :, 0])                        # [n, dg, khkw, oh, ow]
+        gx = (ox[None, None, None, None, :] +
+              kx[None, None, :, None, None] * dw +
+              off[:, :, :, 1])
+        hp, wp = h + 2 * ph_, w + 2 * pw_
+        y0 = jnp.floor(gy)
+        x0 = jnp.floor(gx)
+        wy = gy - y0
+        wx = gx - x0
+
+        def gather(yi, xi):
+            yi = jnp.clip(yi.astype(jnp.int32), 0, hp - 1)
+            xi = jnp.clip(xi.astype(jnp.int32), 0, wp - 1)
+            # [n, dg, khkw, oh, ow] indices into [n, C, hp, wp]
+            cg = cin // deformable_groups
+
+            def per_n(feat, yy, xx):
+                # feat [C, hp, wp]; yy/xx [dg, khkw, oh, ow]
+                fg = feat.reshape(deformable_groups, cg, hp, wp)
+                return jax.vmap(lambda f, y_, x_: f[:, y_, x_]
+                                )(fg, yy, xx)          # [dg, cg, khkw, oh, ow]
+
+            return jax.vmap(per_n)(ap, yi, xi)
+
+        inb = ((gy >= 0) & (gy <= hp - 1) & (gx >= 0) & (gx <= wp - 1)
+               ).astype(jnp.float32)[:, :, None]
+        val = ((1 - wy)[:, :, None] * (1 - wx)[:, :, None] * gather(y0, x0)
+               + (1 - wy)[:, :, None] * wx[:, :, None] * gather(y0, x0 + 1)
+               + wy[:, :, None] * (1 - wx)[:, :, None] * gather(y0 + 1, x0)
+               + wy[:, :, None] * wx[:, :, None] * gather(y0 + 1, x0 + 1))
+        val = val * inb
+        if m_ is not None:
+            mk = m_.reshape(n, deformable_groups, kh * kw, oh, ow)
+            val = val * mk[:, :, None]
+        # val: [n, dg, cg, khkw, oh, ow] -> [n, cin, kh*kw, oh, ow]
+        val = val.reshape(n, cin, kh * kw, oh, ow)
+        cgrp = cin // groups
+        val = val.reshape(n, groups, cgrp, kh * kw, oh, ow)
+        wg = w_.reshape(groups, cout // groups, cin_g, kh * kw)
+        out = jnp.einsum("ngckhw,gock->ngohw", val, wg)
+        out = out.reshape(n, cout, oh, ow)
+        if b_ is not None:
+            out = out + b_[None, :, None, None]
+        return out.astype(a.dtype)
+
+    return apply_op("deform_conv2d", prim, tuple(args))
+
+
+class DeformConv2D:
+    """reference vision/ops.py DeformConv2D layer."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        import math as _m
+
+        from ..nn.initializer import Uniform
+        from ..core.tensor import Parameter
+
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self._stride, self._padding, self._dilation = stride, padding, dilation
+        self._dg, self._groups = deformable_groups, groups
+        fan_in = in_channels * kernel_size[0] * kernel_size[1] // groups
+        bound = 1.0 / _m.sqrt(fan_in)
+        init = Uniform(-bound, bound)
+        self.weight = Parameter(init(
+            (out_channels, in_channels // groups) + tuple(kernel_size),
+            np.float32))
+        self.bias = None if bias_attr is False else Parameter(
+            init((out_channels,), np.float32))
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, bias=self.bias,
+                             stride=self._stride, padding=self._padding,
+                             dilation=self._dilation,
+                             deformable_groups=self._dg,
+                             groups=self._groups, mask=mask)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """reference ops.yaml distribute_fpn_proposals — assign each RoI to an
+    FPN level by its scale (host-side routing, like the reference CPU op)."""
+    rois = np.asarray(_t(fpn_rois)._data)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(ws * hs, 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs, nums = [], [], []
+    order = []
+    for L in range(min_level, max_level + 1):
+        sel = np.where(lvl == L)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        nums.append(Tensor(jnp.asarray([len(sel)], jnp.int32)))
+        order.extend(sel.tolist())
+    restore = np.argsort(np.asarray(order, np.int64)) \
+        if order else np.zeros((0,), np.int64)
+    return outs, Tensor(jnp.asarray(restore.astype(np.int32))), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """reference ops.yaml generate_proposals (RPN): decode deltas against
+    anchors, clip, filter tiny boxes, top-k + NMS."""
+    sc = np.asarray(_t(scores)._data)          # [N, A, H, W]
+    bd = np.asarray(_t(bbox_deltas)._data)     # [N, A*4, H, W]
+    ims = np.asarray(_t(img_size)._data)       # [N, 2] (h, w)
+    anc = np.asarray(_t(anchors)._data).reshape(-1, 4)
+    var = np.asarray(_t(variances)._data).reshape(-1, 4)
+    n = sc.shape[0]
+    outs, out_scores, nums = [], [], []
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)
+        d = bd[i].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        cx = d[:, 0] * var[:, 0] * aw + acx
+        cy = d[:, 1] * var[:, 1] * ah + acy
+        w_ = np.exp(np.clip(d[:, 2] * var[:, 2], -10, 10)) * aw
+        h_ = np.exp(np.clip(d[:, 3] * var[:, 3], -10, 10)) * ah
+        boxes = np.stack([cx - w_ / 2, cy - h_ / 2,
+                          cx + w_ / 2, cy + h_ / 2], -1)
+        ih, iw = ims[i]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - 1)
+        keep = ((boxes[:, 2] - boxes[:, 0] >= min_size) &
+                (boxes[:, 3] - boxes[:, 1] >= min_size))
+        s, boxes = s[keep], boxes[keep]
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, boxes = s[order], boxes[order]
+        kept = np.asarray(nms(Tensor(jnp.asarray(boxes)),
+                              iou_threshold=nms_thresh)._data)
+        kept = kept[:post_nms_top_n]
+        outs.append(boxes[kept])
+        out_scores.append(s[kept])
+        nums.append(len(kept))
+    rois = Tensor(jnp.asarray(np.concatenate(outs, 0)))
+    rscores = Tensor(jnp.asarray(np.concatenate(out_scores, 0)))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, rscores

@@ -438,3 +438,81 @@ def erase(img, i, j, h, w, v, inplace=False):
         arr = arr.copy()
     arr[i:i + h, j:j + w] = v
     return arr
+
+
+class Transpose(BaseTransform):
+    """reference transforms.Transpose — HWC -> CHW (or given order)."""
+
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        arr = np.asarray(_to_hwc(img))
+        return arr.transpose(self.order)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """reference transforms.functional.affine."""
+    arr = _to_hwc(img)
+    return _affine(arr, angle, tuple(translate), scale, fill=fill)
+
+
+def _perspective_warp(arr, startpoints, endpoints, fill=0):
+    """Inverse-mapped nearest-neighbor perspective: solve the 8-dof
+    homography sending endpoints -> startpoints, then sample."""
+    h, w = arr.shape[:2]
+    src = np.asarray(startpoints, np.float64)
+    dst = np.asarray(endpoints, np.float64)
+    # solve for H with H @ [dst, 1] ~ [src, 1] (inverse map)
+    A, b = [], []
+    for (sx, sy), (dx, dy) in zip(src, dst):
+        A.append([dx, dy, 1, 0, 0, 0, -sx * dx, -sx * dy])
+        b.append(sx)
+        A.append([0, 0, 0, dx, dy, 1, -sy * dx, -sy * dy])
+        b.append(sy)
+    coef = np.linalg.solve(np.asarray(A), np.asarray(b))
+    H = np.append(coef, 1.0).reshape(3, 3)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ones = np.ones_like(xx, np.float64)
+    pts = np.stack([xx, yy, ones], axis=-1) @ H.T
+    xs = pts[..., 0] / pts[..., 2]
+    ys = pts[..., 1] / pts[..., 2]
+    xi = np.round(xs).astype("int64")
+    yi = np.round(ys).astype("int64")
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    out = np.full_like(arr, fill)
+    out[valid] = arr[yi[valid], xi[valid]]
+    return out
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """reference transforms.functional.perspective."""
+    return _perspective_warp(_to_hwc(img), startpoints, endpoints, fill)
+
+
+class RandomPerspective(BaseTransform):
+    """reference transforms.RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        if np.random.uniform() > self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        half_h, half_w = int(d * h / 2), int(d * w / 2)
+
+        def jig(x, y, dx, dy):
+            return (x + int(np.random.uniform(0, dx + 1)) * (1 if x == 0 else -1),
+                    y + int(np.random.uniform(0, dy + 1)) * (1 if y == 0 else -1))
+
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [jig(x, y, half_w, half_h) for x, y in start]
+        return _perspective_warp(arr, start, end, self.fill)
