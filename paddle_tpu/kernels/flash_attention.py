@@ -111,8 +111,21 @@ def _apply_masks(s, i, j, *, block_q, block_kv, causal, diag_off,
 
 
 def _needed(i, block_q, block_kv, diag_off):
-    """Last KV block index a causal q-block i touches."""
-    return (i * block_q + block_q - 1 + diag_off) // block_kv
+    """Last KV block index a causal q-block i touches.
+
+    The divisor must be an explicit int32: inside a Pallas kernel trace a
+    bare Python int reaching ``jnp.floor_divide``'s nested jit becomes an
+    int64 literal, and Mosaic's convert_element_type lowering recurses
+    forever on 64->32-bit signed casts (jax 0.9 lowering.py:_convert_helper).
+    """
+    return jnp.floor_divide(i * block_q + block_q - 1 + diag_off,
+                            jnp.int32(block_kv))
+
+
+def _seed_u32(seed_ref):
+    """f32 seed scalar -> u32 for the hash. Mosaic has no f32->u32 cast;
+    go through int32 (fptosi) then reinterpret 32->32 (exact: seed < 2^23)."""
+    return seed_ref[0, 0].astype(jnp.int32).astype(jnp.uint32)
 
 
 def _drop_keep(shape, seed_u32, b, h, row0, col0, drop_p):
@@ -220,9 +233,9 @@ def _fa_fwd_kernel(*refs, block_q, block_kv, causal, scale, q_len, kv_len,
         l_sc[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         if drop_p:
             keep = _drop_keep(p.shape,
-                              seed_ref[0, 0].astype(jnp.uint32),
+                              _seed_u32(seed_ref),
                               bb, hh, i * block_q, j * block_kv, drop_p)
-            p = jnp.where(keep, p, 0.0) * jnp.float32(1.0 / (1.0 - drop_p))
+            p = jnp.where(keep, p, jnp.float32(0.0)) * jnp.float32(1.0 / (1.0 - drop_p))
         acc_sc[...] = alpha * acc_sc[...] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -288,9 +301,9 @@ def _fa_bwd_dq_kernel(*refs, block_q, block_kv, causal, scale, q_len, kv_len,
             # dP = mask/(1-p) o (dO V^T); delta = rowsum(dO o O) is already
             # the dropped-P inner product, so the softmax-bwd form is intact
             keep = _drop_keep(p.shape,
-                              seed_ref[0, 0].astype(jnp.uint32),
+                              _seed_u32(seed_ref),
                               bb, hh, i * block_q, j * block_kv, drop_p)
-            dp = jnp.where(keep, dp, 0.0) * jnp.float32(1.0 / (1.0 - drop_p))
+            dp = jnp.where(keep, dp, jnp.float32(0.0)) * jnp.float32(1.0 / (1.0 - drop_p))
         ds = p * (dp - delta)
         acc_sc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -352,11 +365,11 @@ def _fa_bwd_dkv_kernel(*refs, block_q, block_kv, causal, scale, q_len,
         p = jnp.exp(s - lse)
         if drop_p:
             keep = _drop_keep(p.shape,
-                              seed_ref[0, 0].astype(jnp.uint32),
+                              _seed_u32(seed_ref),
                               bb, hh, jq * block_q, kv_idx * block_kv,
                               drop_p)
             inv = jnp.float32(1.0 / (1.0 - drop_p))
-            pd = jnp.where(keep, p, 0.0) * inv
+            pd = jnp.where(keep, p, jnp.float32(0.0)) * inv
         else:
             pd = p
         dv_sc[...] += jax.lax.dot_general(
@@ -365,7 +378,7 @@ def _fa_bwd_dkv_kernel(*refs, block_q, block_kv, causal, scale, q_len,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if drop_p:
-            dp = jnp.where(keep, dp, 0.0) * inv
+            dp = jnp.where(keep, dp, jnp.float32(0.0)) * inv
         ds = p * (dp - delta)
         # q is pre-scaled, so this carries the `scale` factor already
         dk_sc[...] += jax.lax.dot_general(
@@ -432,8 +445,9 @@ def _specs_common(has_mask, has_seg, mask_heads, group, blocks, sq, sk, d,
         def qc(kv, jq):         # clamp to the first q block that reaches kv
             if not causal:
                 return jq
-            first = jnp.maximum(
-                (kv * block_kv - diag_off - block_q + 1), 0) // block_q
+            first = jnp.floor_divide(
+                jnp.maximum((kv * block_kv - diag_off - block_q + 1), 0),
+                jnp.int32(block_q))  # int32 divisor: see _needed
             return jnp.maximum(jq, first)
         qmap = lambda b, h, kv, jq: (b, h, qc(kv, jq), _I0)
         kvmap = lambda b, h, kv, jq: (b, h // g, kv, _I0)
