@@ -48,47 +48,65 @@ def run_resnet():
 
     on_tpu = _on_tpu()
     # CPU smoke: resnet18 at 32px keeps the eager per-op path tractable
-    batch, size, steps = (32, 224, 5) if on_tpu else (2, 32, 2)
+    batch, size, steps = (32, 224, 3) if on_tpu else (2, 32, 2)
     paddle.seed(0)
     model = (resnet50 if on_tpu else resnet18)(num_classes=1000)
-    optimizer = opt.Momentum(0.1, parameters=model.parameters())
+    # lr sized for a from-scratch bench run: 0.1 diverges at batch 32 in the
+    # first steps (round-4 review finding); the criterion is a DECREASING loss
+    optimizer = opt.Momentum(0.02, parameters=model.parameters())
     rng = np.random.default_rng(0)
     x = paddle.to_tensor(
         rng.standard_normal((batch, 3, size, size)).astype("float32"))
     y = paddle.to_tensor(rng.integers(0, 1000, batch).astype("int64"))
     loss_fn = nn.CrossEntropyLoss()
 
-    def train_step(xb, yb):
-        loss = loss_fn(model(xb), yb)
+    def train_step(xb, yb, fwd=None):
+        loss = loss_fn((fwd or model)(xb), yb)
         loss.backward()
         optimizer.step()
         optimizer.clear_grad()
         return loss
 
-    losses = [float(train_step(x, y)._data)]        # warmup + correctness
+    loss0 = float(train_step(x, y)._data)           # warmup + first loss
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = train_step(x, y)
     jax.block_until_ready(loss._data)
     eager_ips = batch * steps / (time.perf_counter() - t0)
-    losses.append(float(loss._data))
+
+    # compiled train: to_static forward = ONE tape node (compiled fwd+bwd);
+    # also the convergence check — loss must drop on the overfit batch
+    fwd = to_static(model, input_spec=[
+        InputSpec([batch, 3, size, size], "float32")])
+    train_step(x, y, fwd)
+    t0 = time.perf_counter()
+    for _ in range(steps * 3):
+        loss = train_step(x, y, fwd)
+    jax.block_until_ready(loss._data)
+    compiled_train_ips = batch * steps * 3 / (time.perf_counter() - t0)
+    for _ in range(20):
+        loss = train_step(x, y, fwd)
+    loss_last = float(loss._data)
 
     model.eval()
-    fwd = to_static(lambda xb: model(xb),
-                    input_spec=[InputSpec([batch, 3, size, size], "float32")])
-    out = fwd(x)
+    infer = to_static(lambda xb: model(xb),
+                      input_spec=[InputSpec([batch, 3, size, size],
+                                            "float32")])
+    out = infer(x)
     jax.block_until_ready(out._data)
     t0 = time.perf_counter()
-    for _ in range(steps * 4):
-        out = fwd(x)
+    for _ in range(steps * 6):
+        out = infer(x)
     jax.block_until_ready(out._data)
-    compiled_ips = batch * steps * 4 / (time.perf_counter() - t0)
+    compiled_ips = batch * steps * 6 / (time.perf_counter() - t0)
     return {
         "config": "resnet50_dygraph" if on_tpu else "resnet18_dygraph_smoke",
         "eager_train_imgs_per_sec": round(eager_ips, 2),
+        "compiled_train_imgs_per_sec": round(compiled_train_ips, 2),
         "compiled_infer_imgs_per_sec": round(compiled_ips, 2),
-        "loss_first": round(losses[0], 4), "loss_last": round(losses[-1], 4),
-        "finite": bool(np.isfinite(losses).all()),
+        "loss_first": round(loss0, 4), "loss_last": round(loss_last, 4),
+        "loss_decreased": bool(loss_last < loss0),
+        "finite": bool(np.isfinite([loss0, loss_last]).all()),
         "batch": batch, "image_size": size,
     }
 

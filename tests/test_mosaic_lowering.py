@@ -141,6 +141,52 @@ class TestWeightOnlyMosaic:
             x, wq, scale)
 
 
+class TestEndToEndMosaic:
+    """Cross-lower the bench ladder's compiled steps at flagship geometry
+    (2 layers — per-layer kernel shapes identical to bench.py's configs),
+    so a chip-only lowering failure can't silently kill the round's perf
+    number again."""
+
+    def _llama_step(self, **extra):
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models.pretrain import ParallelConfig, PretrainStep
+
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=extra.pop("hidden_size", 2048),
+            intermediate_size=extra.pop("intermediate_size", 5504),
+            num_hidden_layers=2, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16", **extra)
+        ps = PretrainStep(
+            cfg, ParallelConfig(remat=True, loss_chunks=16,
+                                m_dtype="bfloat16"))
+        state = ps.init_state(seed=0)
+        ids = np.zeros((4, 2048), np.int32)
+
+        def step(state, ids, labels):
+            loss, grads = jax.value_and_grad(
+                lambda p: ps._forward_loss(p, ids, labels))(state["params"])
+            return ps._update(state, grads), loss
+
+        return step, (state, ids, ids)
+
+    def test_flagship_train_step(self, monkeypatch):
+        from paddle_tpu.kernels import flash_attention as fa
+
+        monkeypatch.setattr(fa, "_pallas_mode", lambda: "tpu")
+        step, args = self._llama_step()
+        _export_tpu(step, *args)
+
+    def test_moe_train_step(self, monkeypatch):
+        from paddle_tpu.kernels import flash_attention as fa
+
+        monkeypatch.setattr(fa, "_pallas_mode", lambda: "tpu")
+        step, args = self._llama_step(hidden_size=1024,
+                                      intermediate_size=2816,
+                                      moe_num_experts=8, moe_top_k=2)
+        _export_tpu(step, *args)
+
+
 class TestPrimitivesMosaic:
     def test_matmul(self):
         from paddle_tpu.kernels.primitives import matmul_kernel
