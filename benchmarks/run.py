@@ -113,19 +113,17 @@ def run_resnet():
 
 def run_llama():
     import bench
+    mk, b, s_, st, pce = _llama_args()
     return {"config": "llama_hybrid",
-            **bench._run_config(*_llama_args(), on_tpu=_on_tpu())}
+            **bench._run_config(mk, b, s_, st, on_tpu=_on_tpu(),
+                                pc_extra=pce)}
 
 
 def _llama_args():
-    import dataclasses
-
     import bench
-    from paddle_tpu.models.llama import LlamaConfig
     if _on_tpu():
-        mk, b, s, st = bench._tpu_configs()[0]
-        return (mk, b, s, st)
-    return (dataclasses.asdict(LlamaConfig.tiny()), 4, 64, 2)
+        return bench._tpu_configs()[0]
+    return bench._cpu_smoke_config()
 
 
 def run_gpt2():

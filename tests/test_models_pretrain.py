@@ -387,3 +387,39 @@ def test_zero3_composes_with_mp(rng):
     s = z.init_state(seed=0)
     s, l1 = z.train_step(s, *z.shard_batch(ids, labels))
     np.testing.assert_allclose(float(l0), float(l1), rtol=2e-4)
+
+
+def test_remat_policy_dots_parity(rng):
+    """remat_policy='dots' changes what backward recomputes, not the math:
+    losses must match full-recompute remat bit-for-bit-ish."""
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models.pretrain import ParallelConfig, PretrainStep
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    ids = rng.integers(0, 256, (4, 16))
+    labels = rng.integers(0, 256, (4, 16))
+
+    losses = {}
+    for policy in ("full", "dots"):
+        ps = PretrainStep(cfg, ParallelConfig(remat=True,
+                                              remat_policy=policy))
+        s = ps.init_state(seed=3)
+        si, sl = ps.shard_batch(ids, labels)
+        out = []
+        for _ in range(3):
+            s, loss = ps.train_step(s, si, sl)
+            out.append(float(loss))
+        losses[policy] = out
+    assert losses["full"][-1] < losses["full"][0]
+    np.testing.assert_allclose(losses["full"], losses["dots"], rtol=2e-5)
+
+
+def test_remat_policy_validation():
+    import pytest
+
+    from paddle_tpu.models.pretrain import ParallelConfig
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        ParallelConfig(remat=True, remat_policy="nope")
+    with pytest.raises(ValueError, match="remat=False"):
+        ParallelConfig(remat_policy="dots")  # policy without remat=True
