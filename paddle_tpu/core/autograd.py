@@ -174,6 +174,27 @@ class _Unkeyable(Exception):
 _VALUE_TYPES = (int, float, bool, str, bytes, type(None), type(Ellipsis))
 
 
+def _glob_key(v):
+    """("glob", v) if v is a hashable module-level singleton, else None.
+
+    A module-level callable (jnp.sum, a jnp ufunc object, a custom_jvp
+    wrapper, a helper def) is a stable singleton: identity-keying it
+    cannot grow the cache per call.  ufunc objects carry __name__ but no
+    __qualname__ and no __code__.
+    """
+    import sys
+    mod = sys.modules.get(getattr(v, "__module__", None))
+    qn = getattr(v, "__qualname__", None) or \
+        getattr(v, "__name__", None) or "."
+    if mod is not None and "." not in qn and getattr(mod, qn, None) is v:
+        try:
+            hash(v)
+        except TypeError:
+            return None
+        return ("glob", v)
+    return None
+
+
 def _cell_key(v, depth):
     """Hashable *value* identity for a closure cell / default.
 
@@ -191,14 +212,9 @@ def _cell_key(v, depth):
     if isinstance(v, (tuple, frozenset)):
         return ("tup", tuple(_cell_key(x, depth) for x in v))
     if callable(v):
-        # a module-level callable (jnp.sum, a helper def) is a stable
-        # singleton: identity-keying it cannot grow the cache per call
-        import sys
-        mod = sys.modules.get(getattr(v, "__module__", None))
-        qn = getattr(v, "__qualname__", ".")
-        if mod is not None and "." not in qn and \
-                getattr(mod, qn, None) is v:
-            return ("glob", v)
+        gk = _glob_key(v)
+        if gk is not None:
+            return gk
         if depth < 3:
             return _fn_key(v, depth + 1)
     raise _Unkeyable
@@ -238,7 +254,12 @@ def _prim_key(prim):
     try:
         return _fn_key(prim)
     except (_Unkeyable, ValueError):  # ValueError: empty cell
-        return prim
+        # No __code__ (jnp ufunc objects — jnp.add etc. in jax>=0.5 —, C
+        # callables) or unkeyable innards: if it is a module-level
+        # singleton, its IDENTITY is stable across calls, so it still
+        # makes a valid cache key.  Without this, every schema table op
+        # whose impl is a ufunc takes the re-traced vjp slow path.
+        return _glob_key(prim) or prim
 
 
 def _hashable(kw: dict):

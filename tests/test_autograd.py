@@ -170,3 +170,27 @@ def test_retained_graph_no_stale_cotangents(rng):
     (g2,) = paddle.grad(y, x, retain_graph=True)
     np.testing.assert_allclose(g1.numpy(), g2.numpy(), rtol=1e-7)
     np.testing.assert_allclose(g1.numpy(), [4.0], rtol=1e-7)
+
+
+def test_ufunc_prims_hit_vjp_cache():
+    """jnp table-op impls are ufunc objects (no __code__) in jax>=0.5; the
+    dispatch cache must key them by module-singleton identity, or every
+    schema op re-traces jax.vjp per call (~18x slower eager tape)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import autograd as ag
+
+    assert isinstance(ag._prim_key(jnp.add), tuple)
+    assert isinstance(ag._prim_key(jax.nn.relu), tuple)
+
+    x = paddle.ones([4])
+    x.stop_gradient = False
+    z = paddle.add(x, x)
+    n = len(ag._vjp_cache)
+    for _ in range(3):
+        z = paddle.add(x, x)
+    assert len(ag._vjp_cache) == n  # steady state: no new entries per call
+    z.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data), 2.0 * np.ones(4))
