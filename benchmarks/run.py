@@ -19,7 +19,8 @@ import time
 # `--cpu` (or PADDLE_TPU_BENCH_CPU=1) pins the CPU backend BEFORE jax
 # initializes — the ambient environment may force a TPU platform whose
 # tunnel hangs jax.devices() forever when down
-if "--cpu" in sys.argv or os.environ.get("PADDLE_TPU_BENCH_CPU"):
+CPU_PINNED = "--cpu" in sys.argv or bool(os.environ.get("PADDLE_TPU_BENCH_CPU"))
+if CPU_PINNED:
     sys.argv = [a for a in sys.argv if a != "--cpu"]
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -151,11 +152,114 @@ CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
            "dit": run_dit, "moe": run_moe, "decode": run_decode}
 
 
+def _supervise(names, timeout):
+    """Run each config in its own subprocess with a hard timeout.
+
+    A mid-run TPU-tunnel hang blocks the PJRT client forever (observed: a
+    ladder process parked in ``wait_woken`` with zero CPU advance after two
+    configs completed) — a fresh process per config both bounds the damage
+    to one config and gets a fresh PJRT connection for the next one.
+    """
+    import subprocess
+    failed = 0
+    for name in names:
+        t0, path = time.time(), RESULTS / f"{name}.json"
+        prev = _parse(path)  # snapshot BEFORE the child can clobber it
+        cmd = [sys.executable, os.path.abspath(__file__), "--inproc", name]
+        if CPU_PINNED:
+            cmd.append("--cpu")
+        try:
+            child = subprocess.Popen(cmd)
+        except Exception as e:
+            failed += 1
+            _write_error(path, name, f"{type(e).__name__}: {e}", t0, prev)
+            continue
+        # Poll instead of a blocking wait: a child may write a fresh valid
+        # result and THEN hang in PJRT client teardown at exit (observed
+        # mode) — kill it as soon as its result lands rather than burning
+        # the full timeout on a run that already succeeded.
+        err = None
+        while True:
+            rc = child.poll()
+            if rc is not None:
+                err = None if rc == 0 else f"subprocess exited rc={rc}"
+                break
+            if time.time() - t0 > timeout:
+                child.kill()
+                child.wait()
+                err = f"timeout after {timeout}s (hung backend?)"
+                break
+            if _fresh_ok(path, t0):
+                time.sleep(5)       # grace for trailing stdout, then reap
+                if child.poll() is None:
+                    child.kill()
+                    child.wait()
+                break
+            time.sleep(5)
+        if err is not None and _fresh_ok(path, t0):
+            err = None              # result landed; only the exit failed
+        if err is not None:
+            failed += 1
+            _write_error(path, name, err, t0, prev)
+    return 1 if failed else 0
+
+
+def _write_error(path, name, err, t0, prev):
+    """Record a failure, keeping the newest NON-error numbers visible.
+
+    ``prev`` is the pre-run snapshot: if it is itself an error record, hoist
+    its ``previous`` so consecutive failures never nest unboundedly.
+    """
+    fresh = _parse(path)  # the child may have written its own error record
+    try:  # prefer the child's specific exception over a generic rc string
+        if fresh["error"] and path.stat().st_mtime >= t0:
+            err = fresh["error"]
+    except (TypeError, KeyError, OSError):
+        pass
+    record = {"config": name, "error": err,
+              "wall_s": round(time.time() - t0, 2)}
+    for cand in (fresh, prev):
+        if isinstance(cand, dict) and "error" not in cand:
+            record["previous"] = cand
+            break
+        if isinstance(cand, dict) and "previous" in cand:
+            record["previous"] = cand["previous"]
+            break
+    path.write_text(json.dumps(record, indent=2) + "\n")
+    print(f"{name}: ERROR {err}")
+
+
+def _parse(path):
+    try:
+        return json.loads(path.read_text())
+    except Exception:
+        return None
+
+
+def _fresh_ok(path, t0):
+    """True if path holds an error-free result written after t0."""
+    try:
+        if path.stat().st_mtime < t0:
+            return False
+    except OSError:
+        return False
+    obj = _parse(path)
+    return isinstance(obj, dict) and "error" not in obj
+
+
 def main(argv):
-    names = argv or ["all"]
+    inproc = "--inproc" in argv
+    timeout = int(os.environ.get("LADDER_TIMEOUT_S", "2400"))
+    names = [a for a in argv if a != "--inproc"] or ["all"]
     if "all" in names:
         names = list(CONFIGS)
+    unknown = [n for n in names if n not in CONFIGS]
+    if unknown:  # fail fast, not after a 2400s child timeout
+        print(f"unknown config(s): {unknown}; have {sorted(CONFIGS)}")
+        return 2
     RESULTS.mkdir(exist_ok=True)
+    if not inproc:
+        return _supervise(names, timeout)
     failed = 0
     for name in names:
         t0 = time.perf_counter()
@@ -165,7 +269,8 @@ def main(argv):
         except Exception as e:  # record the failure, keep the ladder going
             import traceback
             traceback.print_exc()
-            result = {"config": name, "error": f"{type(e).__name__}: {e}"}
+            result = {"config": name, "error": f"{type(e).__name__}: {e}",
+                      "wall_s": round(time.perf_counter() - t0, 2)}
             failed += 1
         # provenance stamp: CPU smoke runs must never read as TPU numbers
         try:
