@@ -179,23 +179,24 @@ def _supervise(names, timeout):
         # mode) — kill it as soon as its result lands rather than burning
         # the full timeout on a run that already succeeded.
         err = None
-        while True:
-            rc = child.poll()
-            if rc is not None:
-                err = None if rc == 0 else f"subprocess exited rc={rc}"
-                break
-            if time.time() - t0 > timeout:
+        try:
+            while True:
+                rc = child.poll()
+                if rc is not None:
+                    err = None if rc == 0 else f"subprocess exited rc={rc}"
+                    break
+                if time.time() - t0 > timeout:
+                    err = f"timeout after {timeout}s (hung backend?)"
+                    break
+                if _fresh_ok(path, t0):
+                    time.sleep(5)   # grace for trailing stdout, then reap
+                    break
+                time.sleep(5)
+        finally:
+            # never leave a child holding the TPU — incl. on KeyboardInterrupt
+            if child.poll() is None:
                 child.kill()
                 child.wait()
-                err = f"timeout after {timeout}s (hung backend?)"
-                break
-            if _fresh_ok(path, t0):
-                time.sleep(5)       # grace for trailing stdout, then reap
-                if child.poll() is None:
-                    child.kill()
-                    child.wait()
-                break
-            time.sleep(5)
         if err is not None and _fresh_ok(path, t0):
             err = None              # result landed; only the exit failed
         if err is not None:

@@ -319,10 +319,17 @@ def moe_mlp_forward(x, gate_w, w_gate, w_up, w_down, *, top_k,
     keep = pos < cap
     slot = jnp.where(keep, idx_flat * cap + pos, E * cap)  # OOB -> dropped
 
-    x_rep = jnp.tile(xf, (k, 1))                          # [kN, H]
-    buf = jnp.zeros((E * cap, H), x.dtype)
-    buf = buf.at[slot].add(x_rep, mode="drop")
-    expert_in = buf.reshape(E, cap, H)
+    # Dispatch = scatter the scalar TOKEN id per slot, then gather rows from
+    # xf: slots are unique by construction (cumsum position within expert),
+    # so a row scatter-add is equivalent — but TPU lowers row scatters to
+    # serialized per-row updates, while an int32 scatter + row gather stays
+    # vectorized (1 word/slot scattered, [N+1, H] touched instead of
+    # 2*[kN, H]).  Flat entry r routes token r % N; unfilled slots hit the
+    # appended zero row.
+    xf_z = jnp.concatenate([xf, jnp.zeros((1, H), x.dtype)], axis=0)
+    tok_ids = jnp.tile(jnp.arange(N, dtype=jnp.int32), k)  # [kN]
+    inv = jnp.full((E * cap + 1,), N, jnp.int32).at[slot].set(tok_ids)
+    expert_in = xf_z[inv[:-1]].reshape(E, cap, H)
 
     h1 = jax.nn.silu(jnp.einsum("ech,ehi->eci", expert_in, w_gate)) * \
         jnp.einsum("ech,ehi->eci", expert_in, w_up)
