@@ -266,12 +266,17 @@ def _run_moe(on_tpu):
     dt = time.perf_counter() - t0
     tok_per_sec = batch * seq * steps / dt
     peak = _peak_flops(jax.devices()[0])
+    stats = ps.router_stats(state, ids)
     return {
         "moe_tok_per_sec": round(tok_per_sec, 1),
         "moe_mfu": round(tok_per_sec * ps.flops_per_token(False) / peak, 4),
         "moe_params": cfg.num_params(),
         "moe_active_params": cfg.num_active_params(),
         "moe_loss": round(float(loss), 4),
+        # expert load balance (BASELINE config 5): fraction of routed
+        # tokens that fit capacity + busiest-expert share vs uniform
+        "moe_kept_frac": round(stats["kept_frac"], 4),
+        "moe_imbalance": round(stats["imbalance"], 4),
     }
 
 

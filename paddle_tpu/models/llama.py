@@ -291,7 +291,10 @@ def moe_mlp_forward(x, gate_w, w_gate, w_up, w_down, *, top_k,
     XLA lowers the scatter/gather into the EP collectives.
 
     x: [B, S, H]; gate_w: [H, E]; w_gate/w_up: [E, H, I]; w_down: [E, I, H].
-    Returns (y [B, S, H], aux_loss scalar fp32).
+    Returns (y [B, S, H], aux_loss scalar fp32, stats fp32 [2]) where
+    stats = [kept_frac (routed tokens that fit capacity), imbalance
+    (busiest expert's first-choice token share x E; 1.0 = uniform)] —
+    the expert-load-balance evidence BASELINE config 5 asks to report.
     """
     B, S, H = x.shape
     E = gate_w.shape[-1]
@@ -339,7 +342,9 @@ def moe_mlp_forward(x, gate_w, w_gate, w_up, w_down, *, top_k,
     yf = gathered * (gate_flat * keep.astype(jnp.float32))[:, None] \
         .astype(x.dtype)
     y = yf.reshape(k, N, H).sum(axis=0).reshape(B, S, H)
-    return y, aux
+    stats = jnp.stack([keep.mean().astype(jnp.float32),
+                       ce.max() * jnp.float32(E)])
+    return y, aux, stats
 
 
 class LlamaMoEMLP(Layer):
@@ -362,20 +367,21 @@ class LlamaMoEMLP(Layer):
         self.experts_down = self.create_parameter(
             [E, I, H], default_initializer=init_i)
         self._last_aux = None
+        self._last_stats = None
 
     def forward(self, x):
         c = self.config
 
         def prim(xa, gw, wg, wu, wd):
-            y, aux = moe_mlp_forward(
+            return moe_mlp_forward(
                 xa, gw, wg, wu, wd, top_k=c.moe_top_k,
                 capacity_factor=c.moe_capacity_factor)
-            return y, aux
 
-        y, aux = apply_op("moe_mlp", prim,
-                          (x, self.gate.weight, self.experts_gate,
-                           self.experts_up, self.experts_down))
+        y, aux, stats = apply_op("moe_mlp", prim,
+                                 (x, self.gate.weight, self.experts_gate,
+                                  self.experts_up, self.experts_down))
         self._last_aux = aux
+        self._last_stats = stats
         return y
 
 

@@ -334,8 +334,11 @@ class PretrainStep:
         h, _ = self._hidden(params, ids)
         return (h @ params["head"]).astype(jnp.float32)   # [B, T, V]
 
-    def _hidden(self, params, ids):
-        """Returns (final-norm hidden states, weighted MoE aux loss)."""
+    def _hidden(self, params, ids, with_stats=False):
+        """Returns (final-norm hidden states, weighted MoE aux loss), plus a
+        layer-mean router-stats fp32 [kept_frac, imbalance] vector when
+        ``with_stats`` (MoE only — the load-balance evidence of BASELINE
+        config 5)."""
         c, pc = self.config, self.pc
         mesh = self.mesh
         B, T = ids.shape
@@ -380,6 +383,8 @@ class PretrainStep:
                     return block(lp, carry), None
                 h, _ = jax.lax.scan(body, h, blocks)
             h = rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
+            if with_stats:   # dense: nothing routes, nothing drops
+                return h, jnp.float32(0.0), jnp.array([1.0, 1.0], jnp.float32)
             return h, jnp.float32(0.0)
 
         if self._moe:
@@ -390,7 +395,9 @@ class PretrainStep:
             def block_aux(lp, x):
                 y = block(lp, x)
                 aux = template.mlp._last_aux
-                return y, aux._data if isinstance(aux, Tensor) else aux
+                stats = template.mlp._last_stats
+                return (y, aux._data if isinstance(aux, Tensor) else aux,
+                        stats._data if isinstance(stats, Tensor) else stats)
 
             if pc.remat:
                 block_aux = _remat(block_aux, pc.remat_policy)
@@ -399,12 +406,16 @@ class PretrainStep:
                       for k, v in params["blocks"].items()}
 
             def body(carry, lp):
-                x, aux = carry
-                y, a = block_aux(lp, x)
-                return (y, aux + a), None
+                x, aux, st = carry
+                y, a, s = block_aux(lp, x)
+                return (y, aux + a, st + s), None
 
-            (h, aux), _ = jax.lax.scan(body, (h, jnp.float32(0.0)), blocks)
+            (h, aux, st), _ = jax.lax.scan(
+                body, (h, jnp.float32(0.0), jnp.zeros((2,), jnp.float32)),
+                blocks)
             h = rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
+            if with_stats:
+                return h, c.moe_aux_loss_weight * aux, st / c.num_hidden_layers
             return h, c.moe_aux_loss_weight * aux
 
         if pc.remat:
@@ -426,8 +437,10 @@ class PretrainStep:
         h = out.reshape(B, T, c.hidden_size)
 
         # final rms norm (fp32 accumulation); head applied by caller
-        return rms_norm_fp32(h, params["norm"], c.rms_norm_eps), \
-            jnp.float32(0.0)
+        hn = rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
+        if with_stats:   # dense model: nothing routes, nothing drops
+            return hn, jnp.float32(0.0), jnp.array([1.0, 1.0], jnp.float32)
+        return hn, jnp.float32(0.0)
 
     # ---- 1F1B: manual grad plumbing (loss computed per-microbatch at the
     # last stage; embed grads recovered from the pipeline's input cotangent) --
@@ -555,6 +568,17 @@ class PretrainStep:
 
     def eval_loss(self, state, ids, labels):
         return self._forward_loss(state["params"], ids, labels)
+
+    def router_stats(self, state, ids):
+        """Layer-mean MoE routing health on one batch: dict with
+        ``kept_frac`` (routed tokens that fit expert capacity) and
+        ``imbalance`` (busiest expert's first-choice share x E; 1.0 =
+        perfectly balanced) — BASELINE config 5's load-balance metric."""
+        if getattr(self, "_jit_stats", None) is None:
+            self._jit_stats = jax.jit(
+                lambda p, i: self._hidden(p, i, with_stats=True)[2])
+        st = self._jit_stats(state["params"], ids)
+        return {"kept_frac": float(st[0]), "imbalance": float(st[1])}
 
     # ---- accounting (BASELINE.md MFU formula) ----
     def flops_per_token(self, include_remat: bool = False) -> float:

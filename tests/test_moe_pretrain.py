@@ -45,11 +45,13 @@ def test_moe_mlp_matches_dense_oracle(rng):
     wd = jnp.asarray(rng.standard_normal((E, I, H)) * 0.2, jnp.float32)
 
     # capacity large enough that nothing drops -> exact parity
-    y, aux = moe_mlp_forward(x, gate_w, wg, wu, wd, top_k=k,
-                             capacity_factor=float(E))
+    y, aux, stats = moe_mlp_forward(x, gate_w, wg, wu, wd, top_k=k,
+                                    capacity_factor=float(E))
     expect = _moe_oracle(x, gate_w, wg, wu, wd, k)
     np.testing.assert_allclose(np.asarray(y), expect, rtol=2e-4, atol=2e-4)
     assert float(aux) > 0.9      # E * sum(f*p) ~ 1 for near-uniform routing
+    assert float(stats[0]) == 1.0         # capacity E -> nothing drops
+    assert float(stats[1]) >= 1.0         # busiest-share x E is >= uniform
 
 
 def test_moe_capacity_drops_tokens(rng):
@@ -62,10 +64,11 @@ def test_moe_capacity_drops_tokens(rng):
     wu = jnp.asarray(rng.standard_normal((E, H, I)) * 0.2, jnp.float32)
     wd = jnp.asarray(rng.standard_normal((E, I, H)) * 0.2, jnp.float32)
     # N*k*cf/E = 8*1*0.25/2 = 1 slot per expert
-    y, _ = moe_mlp_forward(x, gate_w, wg, wu, wd, top_k=1,
-                           capacity_factor=0.25)
+    y, _, stats = moe_mlp_forward(x, gate_w, wg, wu, wd, top_k=1,
+                              capacity_factor=0.25)
     nonzero_rows = np.abs(np.asarray(y).reshape(-1, H)).sum(-1) > 1e-6
     assert nonzero_rows.sum() <= 2   # at most one token per expert survives
+    assert float(stats[0]) <= 2 / 8 + 1e-6   # kept_frac reflects the drops
 
 
 def test_moe_eager_model_forward():
