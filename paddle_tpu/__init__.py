@@ -18,6 +18,27 @@ if "JAX_ENABLE_X64" not in _os.environ:
     _jax.config.update("jax_enable_x64", True)
 
 from . import dtypes, errors, flags
+
+# Persistent XLA compilation cache — the CompilationCache slot of the
+# reference's CINN stack (paddle/cinn/hlir/framework/pir/compilation_cache.h):
+# compiled executables are reused across processes, so a framework restart or
+# a bench subprocess pays ~0s instead of the 20-40s TPU compile.
+# FLAGS_jit_cache_dir="" disables (env-only: consumed once at import); an
+# explicit JAX_COMPILATION_CACHE_DIR wins, like JAX_ENABLE_X64 above.
+flags.define_flag(
+    "jit_cache_dir",
+    _os.path.join(_os.environ.get("XDG_CACHE_HOME")
+                  or _os.path.expanduser("~/.cache"),
+                  "paddle_tpu", "xla_cache"),
+    "persistent XLA compilation cache directory ('' disables; env-only)")
+if flags.flag("jit_cache_dir") and \
+        "JAX_COMPILATION_CACHE_DIR" not in _os.environ:
+    try:
+        _jax.config.update("jax_compilation_cache_dir",
+                           flags.flag("jit_cache_dir"))
+    except Exception:  # older jaxlib without the knob: cache is best-effort
+        pass
+
 from .dtypes import (  # noqa: F401
     bfloat16, bool_, complex64, complex128, dtype, float8_e4m3fn,
     float8_e5m2, float16, float32, float64, get_default_dtype, int8, int16,
