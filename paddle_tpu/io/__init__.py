@@ -33,8 +33,55 @@ __all__ = [
     "ChainDataset", "ConcatDataset", "Subset", "random_split",
     "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "BatchSampler", "DistributedBatchSampler", "DataLoader",
-    "get_worker_info", "default_collate_fn",
+    "get_worker_info", "default_collate_fn", "prefetch_to_device",
 ]
+
+
+def prefetch_to_device(iterable, size=2, sharding=None):
+    """Overlap host->device transfer with compute: yield batches whose
+    ``jax.device_put`` was issued ``size`` iterations ahead (async under
+    PJRT, so the copy rides alongside the previous step's execution).
+
+    TPU-native analog of the reference DataLoader's pinned-memory + places
+    async H2D path (python/paddle/io/reader.py:262 ``places``/
+    ``use_buffer_reader``).  Works on any iterable of numpy/Tensor pytrees;
+    pass a ``jax.sharding.Sharding`` to place sharded global batches.
+    """
+    import collections
+
+    import jax
+
+    def _leaf_sharding(x):
+        """The requested sharding, or full replication for leaves of lower
+        rank than its PartitionSpec (e.g. scalar labels in a batch dict).
+        Real placement errors (batch not divisible by the mesh axis, ...)
+        still raise at the put site."""
+        spec = getattr(sharding, "spec", None)
+        if spec is not None and getattr(x, "ndim", 0) < len(spec):
+            from jax.sharding import NamedSharding, PartitionSpec
+            return NamedSharding(sharding.mesh, PartitionSpec())
+        return sharding
+
+    def _put(batch):
+        def one(x):
+            if isinstance(x, Tensor):
+                x = x._data
+            if sharding is not None:
+                return jax.device_put(x, _leaf_sharding(x))
+            return jax.device_put(x)
+        return jax.tree_util.tree_map(one, batch)
+
+    def gen():
+        queue = collections.deque()
+        it = iter(iterable)
+        for batch in it:
+            queue.append(_put(batch))
+            if len(queue) >= size:
+                yield queue.popleft()
+        while queue:
+            yield queue.popleft()
+
+    return gen()
 
 
 class Dataset:
