@@ -267,7 +267,7 @@ def _run_moe(on_tpu):
     tok_per_sec = batch * seq * steps / dt
     peak = _peak_flops(jax.devices()[0])
     stats = ps.router_stats(state, ids)
-    return {
+    out = {
         "moe_tok_per_sec": round(tok_per_sec, 1),
         "moe_mfu": round(tok_per_sec * ps.flops_per_token(False) / peak, 4),
         "moe_params": cfg.num_params(),
@@ -277,7 +277,28 @@ def _run_moe(on_tpu):
         # tokens that fit capacity + busiest-expert share vs uniform
         "moe_kept_frac": round(stats["kept_frac"], 4),
         "moe_imbalance": round(stats["imbalance"], 4),
+        "moe_dispatch": cfg.moe_dispatch,
     }
+    if on_tpu:
+        # measure the alternate dispatch formulation (einsum: one-hot
+        # matmul dispatch, no scatters in either direction) so the better
+        # of the two is an evidence-backed default choice
+        del ps, state
+        import dataclasses
+        cfg2 = dataclasses.replace(cfg, moe_dispatch="einsum")
+        ps2 = PretrainStep(cfg2, pc)
+        st2 = ps2.init_state(seed=0)
+        st2, l2 = ps2.train_step(st2, ids, labels)
+        jax.block_until_ready(l2)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            st2, l2 = ps2.train_step(st2, ids, labels)
+        jax.block_until_ready(l2)
+        tps2 = batch * seq * steps / (time.perf_counter() - t0)
+        out["moe_einsum_tok_per_sec"] = round(tps2, 1)
+        out["moe_einsum_mfu"] = round(
+            tps2 * ps2.flops_per_token(False) / peak, 4)
+    return out
 
 
 def _run_gpt2_compiled_vs_eager(on_tpu):

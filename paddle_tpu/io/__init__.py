@@ -52,14 +52,15 @@ def prefetch_to_device(iterable, size=2, sharding=None):
     import jax
 
     def _leaf_sharding(x):
-        """The requested sharding, or full replication for leaves of lower
-        rank than its PartitionSpec (e.g. scalar labels in a batch dict).
-        Real placement errors (batch not divisible by the mesh axis, ...)
-        still raise at the put site."""
+        """The requested sharding, with its PartitionSpec truncated to the
+        leaf's rank — so a P('dp', None) batch spec still dp-shards 1-D
+        labels and replicates scalars.  Real placement errors (batch not
+        divisible by the mesh axis, ...) still raise at the put site."""
         spec = getattr(sharding, "spec", None)
-        if spec is not None and getattr(x, "ndim", 0) < len(spec):
+        nd = getattr(x, "ndim", 0)
+        if spec is not None and nd < len(spec):
             from jax.sharding import NamedSharding, PartitionSpec
-            return NamedSharding(sharding.mesh, PartitionSpec())
+            return NamedSharding(sharding.mesh, PartitionSpec(*spec[:nd]))
         return sharding
 
     def _put(batch):

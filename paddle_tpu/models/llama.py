@@ -56,6 +56,12 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_weight: float = 0.01
+    # "gather": int32 scatter + row gather (global capacity); "einsum":
+    # GShard/t5x one-hot matmul dispatch (per-group capacity) — both
+    # directions ride the MXU, at ~25% extra FFN flops for the dispatch
+    # contraction.  The bench measures both; see BENCH notes.
+    moe_dispatch: str = "gather"
+    moe_groups: int = 0          # einsum only: token groups (0 -> batch dim)
     # parallel knobs (consumed by llama_shard_plan / trainer)
     tensor_parallel: bool = False
     sequence_parallel: bool = False
@@ -64,6 +70,10 @@ class LlamaConfig:
     def __post_init__(self):
         if self.num_key_value_heads is None:
             self.num_key_value_heads = self.num_attention_heads
+        if self.moe_dispatch not in ("gather", "einsum"):
+            raise ValueError(
+                f"moe_dispatch must be 'gather' or 'einsum', "
+                f"got {self.moe_dispatch!r}")
 
     @property
     def head_dim(self) -> int:
@@ -347,6 +357,74 @@ def moe_mlp_forward(x, gate_w, w_gate, w_up, w_down, *, top_k,
     return y, aux, stats
 
 
+def moe_mlp_forward_einsum(x, gate_w, w_gate, w_up, w_down, *, top_k,
+                           capacity_factor, groups=0):
+    """GShard/t5x-style one-hot einsum MoE dispatch (reference mechanism
+    surface as moe_mlp_forward; public TPU pattern: gshard/t5x MoE layers).
+
+    Dispatch AND combine are einsum contractions against a [G, n, E, cap]
+    one-hot combine tensor, so both directions (and both AD transposes) are
+    MXU matmuls — no scatter anywhere, at the cost of the dispatch
+    contraction's extra FLOPs (~2*n*E*cap*H per group vs 3 FFN matmuls).
+    Capacity is per token-group of n = N/G (GShard semantics; G=1
+    reproduces the global-capacity routing of moe_mlp_forward exactly).
+
+    Shapes as moe_mlp_forward; returns (y, aux_loss, stats[2]).
+    """
+    B, S, H = x.shape
+    E = gate_w.shape[-1]
+    N = B * S
+    k = top_k
+    G = groups or B
+    if N % G:
+        raise ValueError(f"moe_groups ({G}) must divide tokens ({N})")
+    n = N // G
+    xg = x.reshape(G, n, H)
+
+    logits = (xg.astype(jnp.float32) @ gate_w.astype(jnp.float32))  # [G,n,E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)                  # [G, n, k]
+    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+
+    # GShard aux on the flat batch (same formula as moe_mlp_forward)
+    pf = probs.reshape(N, E)
+    me = pf.mean(axis=0)
+    ce = jnp.zeros((E,), jnp.float32).at[topi[..., 0].reshape(N)].add(1.0) / N
+    aux = E * jnp.sum(me * ce)
+
+    cap = max(1, int(n * k * capacity_factor / E))
+    # k-major priority within each group: first choices claim slots first
+    idx = jnp.swapaxes(topi, 1, 2).reshape(G, k * n)      # [G, kn]
+    gate_v = jnp.swapaxes(topv, 1, 2).reshape(G, k * n).astype(jnp.float32)
+    oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)        # [G, kn, E]
+    pos = jnp.sum(jnp.cumsum(oh, axis=1) * oh - oh, axis=-1).astype(jnp.int32)
+    keep = pos < cap
+
+    # combine[g, n, e, c]: gate weight where token n routes to (e, c);
+    # built per choice (k outer products of [G,n,E] x [G,n,cap]) to keep
+    # the transient at [G, n, E, cap] rather than k times that
+    combine = jnp.zeros((G, n, E, cap), jnp.float32)
+    for kk in range(k):
+        sl = slice(kk * n, (kk + 1) * n)
+        w = (gate_v[:, sl] * keep[:, sl])[..., None, None]    # [G, n, 1, 1]
+        combine = combine + w * (oh[:, sl, :, None] *
+                                 jax.nn.one_hot(pos[:, sl], cap,
+                                                dtype=jnp.float32)[:, :, None])
+    dispatch = (combine > 0).astype(x.dtype)              # [G, n, E, cap]
+
+    expert_in = jnp.einsum("gnec,gnh->egch", dispatch, xg)    # [E,G,cap,H]
+    ei = expert_in.reshape(E, G * cap, H)
+    h1 = jax.nn.silu(jnp.einsum("exh,ehi->exi", ei, w_gate)) * \
+        jnp.einsum("exh,ehi->exi", ei, w_up)
+    out_e = jnp.einsum("exi,eih->exh", h1, w_down)            # [E,G*cap,H]
+    out_e = out_e.reshape(E, G, cap, H)
+    y = jnp.einsum("gnec,egch->gnh", combine.astype(x.dtype), out_e)
+
+    kept_frac = (keep.sum() / jnp.float32(k * N)).astype(jnp.float32)
+    stats = jnp.stack([kept_frac, ce.max() * jnp.float32(E)])
+    return y.reshape(B, S, H), aux, stats
+
+
 class LlamaMoEMLP(Layer):
     """Mixtral-style MoE FFN block (drop-in for LlamaMLP when
     config.moe_num_experts > 0).  Expert banks are single stacked
@@ -373,6 +451,11 @@ class LlamaMoEMLP(Layer):
         c = self.config
 
         def prim(xa, gw, wg, wu, wd):
+            if c.moe_dispatch == "einsum":
+                return moe_mlp_forward_einsum(
+                    xa, gw, wg, wu, wd, top_k=c.moe_top_k,
+                    capacity_factor=c.moe_capacity_factor,
+                    groups=c.moe_groups)
             return moe_mlp_forward(
                 xa, gw, wg, wu, wd, top_k=c.moe_top_k,
                 capacity_factor=c.moe_capacity_factor)

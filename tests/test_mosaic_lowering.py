@@ -186,6 +186,16 @@ class TestEndToEndMosaic:
                                       moe_num_experts=8, moe_top_k=2)
         _export_tpu(step, *args)
 
+    def test_moe_train_step_einsum_dispatch(self, monkeypatch):
+        from paddle_tpu.kernels import flash_attention as fa
+
+        monkeypatch.setattr(fa, "_pallas_mode", lambda: "tpu")
+        step, args = self._llama_step(hidden_size=1024,
+                                      intermediate_size=2816,
+                                      moe_num_experts=8, moe_top_k=2,
+                                      moe_dispatch="einsum")
+        _export_tpu(step, *args)
+
 
 class TestPrimitivesMosaic:
     def test_matmul(self):
