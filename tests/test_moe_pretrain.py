@@ -189,3 +189,23 @@ def test_einsum_dispatch_trains_in_pretrain_step(rng):
     assert losses[-1] < losses[0] and np.isfinite(losses).all(), losses
     s = ps.router_stats(state, ids)
     assert 0.0 < s["kept_frac"] <= 1.0 and s["imbalance"] >= 1.0
+
+
+def test_einsum_dispatch_dp_ep_mp_mesh(rng):
+    """einsum dispatch trains on the dp2 x ep2 x mp2 mesh with expert banks
+    ep-sharded (GSPMD propagates through the one-hot einsums)."""
+    import dataclasses
+    cfg = dataclasses.replace(LlamaConfig.mixtral_tiny(),
+                              moe_dispatch="einsum")
+    ps = PretrainStep(cfg, ParallelConfig(dp=2, ep=2, mp=2))
+    state = ps.init_state(seed=0)
+    spec = state["params"]["blocks"]["mlp.experts_gate"].sharding.spec
+    assert "ep" in [s for s in spec if s is not None]
+    ids, labels = ps.shard_batch(
+        rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int32),
+        rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int32))
+    losses = []
+    for _ in range(4):
+        state, loss = ps.train_step(state, ids, labels)
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses) and losses[-1] < losses[0]
