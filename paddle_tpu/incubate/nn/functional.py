@@ -207,3 +207,63 @@ def number_count(numbers, upper_range):
     return apply_op("number_count", prim,
                     (numbers if isinstance(numbers, Tensor)
                      else Tensor(numbers),))
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn1_scale=None, ffn2_bias=None, ffn2_scale=None,
+              quant_method="None", moe_topk=2, norm_topk_prob=True):
+    """Inference MoE FFN mixture (reference:
+    incubate/nn/functional/fused_moe.py — the fused_moe CUDA kernel).
+
+    x [B, S, H]; gate_weight [H, E]; ffn1_weight [E, H, 2*I] packing
+    [gate | up] halves of a SwiGLU FFN; ffn2_weight [E, I, H]; optional
+    per-expert biases [E, 1, 2*I] / [E, 1, H].
+
+    TPU formulation: dense mixture — every expert runs as one batched
+    einsum over all tokens and outputs are combined with the top-k gate
+    weights (zero for unselected experts).  No capacity, no drops, exactly
+    the per-token routed result; E/top_k-fold extra FFN flops traded for
+    pure-matmul execution.  For the capacity-dispatch TRAINING path use
+    ``models.llama.moe_mlp_forward`` / ``LlamaMoEMLP``.
+    """
+    if quant_method != "None" or ffn1_scale is not None \
+            or ffn2_scale is not None:
+        raise NotImplementedError(
+            "fused_moe quantization (quant_method/ffn*_scale) is not "
+            "supported; use quantization.weight_quantize + the weight-only "
+            "matmul kernel instead")
+
+    extras = [("b1", ffn1_bias), ("b2", ffn2_bias)]
+    present = tuple(tag for tag, v in extras if v is not None)
+
+    def prim(xv, gw, w1, w2, *rest):
+        by_tag = dict(zip(present, rest))
+        b1, b2 = by_tag.get("b1"), by_tag.get("b2")
+        B, S, H = xv.shape
+        half = w1.shape[-1] // 2
+        xf = xv.reshape(-1, H)                             # [N, H]
+
+        logits = xf.astype(jnp.float32) @ gw.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)            # [N, E]
+        topv, topi = jax.lax.top_k(probs, moe_topk)
+        if norm_topk_prob:
+            topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+        # [N, E] combine weights, zero for unselected experts
+        comb = jnp.zeros_like(probs).at[
+            jnp.arange(xf.shape[0])[:, None], topi].set(topv)
+
+        h1 = jnp.einsum("nh,ehi->eni", xf, w1)             # [E, N, 2I]
+        if b1 is not None:
+            h1 = h1 + b1
+        act = jax.nn.silu(h1[..., :half]) * h1[..., half:]
+        out_e = jnp.einsum("eni,eih->enh", act, w2)        # [E, N, H]
+        if b2 is not None:
+            out_e = out_e + b2
+        y = jnp.einsum("ne,enh->nh", comb.astype(xv.dtype), out_e)
+        return y.reshape(B, S, H)
+
+    args = [x, gate_weight, ffn1_weight, ffn2_weight]
+    args += [v for _, v in extras if v is not None]
+    args = tuple(a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
+                 for a in args)
+    return apply_op("fused_moe", prim, args)

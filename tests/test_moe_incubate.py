@@ -233,3 +233,56 @@ def test_fused_ffn_act_dropout_applied(rng):
     out = np.asarray(ffn(x)._data)
     want = np.asarray(x._data) + np.asarray(ffn.b2._data)
     np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_moe_matches_routed_oracle():
+    """incubate fused_moe (dense-mixture inference formulation) matches
+    per-token top-k routing with renormalized gates; biases applied."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn.functional import fused_moe
+
+    rng = np.random.default_rng(0)
+    B, S, H, I, E, k = 2, 8, 16, 32, 4, 2
+    x = rng.standard_normal((B, S, H)).astype(np.float32)
+    gw = (rng.standard_normal((H, E)) * 0.5).astype(np.float32)
+    w1 = (rng.standard_normal((E, H, 2 * I)) * 0.2).astype(np.float32)
+    w2 = (rng.standard_normal((E, I, H)) * 0.2).astype(np.float32)
+    b1 = (rng.standard_normal((E, 1, 2 * I)) * 0.1).astype(np.float32)
+    b2 = (rng.standard_normal((E, 1, H)) * 0.1).astype(np.float32)
+
+    y = fused_moe(paddle.to_tensor(x), paddle.to_tensor(gw),
+                  paddle.to_tensor(w1), paddle.to_tensor(w2),
+                  ffn1_bias=paddle.to_tensor(b1),
+                  ffn2_bias=paddle.to_tensor(b2), moe_topk=k)
+
+    # per-token oracle
+    xf = x.reshape(-1, H)
+    logits = xf @ gw
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    out = np.zeros_like(xf)
+    for n in range(xf.shape[0]):
+        top = np.argsort(-probs[n])[:k]
+        w = probs[n, top] / probs[n, top].sum()
+        for e, wt in zip(top, w):
+            h1 = xf[n] @ w1[e] + b1[e, 0]
+            act = h1[:I] / (1 + np.exp(-h1[:I])) * h1[I:]
+            out[n] += wt * (act @ w2[e] + b2[e, 0])
+    np.testing.assert_allclose(np.asarray(y.numpy()),
+                               out.reshape(B, S, H), rtol=2e-4, atol=2e-4)
+
+
+def test_fused_moe_quant_method_raises():
+    import numpy as np
+    import pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn.functional import fused_moe
+    z = paddle.to_tensor(np.zeros((1, 2, 4), np.float32))
+    g = paddle.to_tensor(np.zeros((4, 2), np.float32))
+    w1 = paddle.to_tensor(np.zeros((2, 4, 8), np.float32))
+    w2 = paddle.to_tensor(np.zeros((2, 4, 4), np.float32))
+    with pytest.raises(NotImplementedError):
+        fused_moe(z, g, w1, w2, quant_method="weight_only_int8")
