@@ -533,6 +533,10 @@ def _child_main():
             result = _run_config(mk, batch, seq, steps, on_tpu, pce)
             if i > 1:
                 result["degraded"] = i  # ran a fallback rung, not the flagship
+            # print incrementally: the parent takes the LAST parseable line,
+            # so if the child is killed mid-extras (timeout, tunnel drop)
+            # the flagship number + extras measured so far still land
+            print(json.dumps(result), flush=True)
             for name, fn in (("large", _run_large), ("decode", _run_decode),
                              ("moe", _run_moe),
                              ("gpt2", _run_gpt2_compiled_vs_eager),
@@ -544,7 +548,7 @@ def _child_main():
                     result[f"{name}_error"] = (
                         f"{type(e).__name__}: {str(e)[:150]}")
                     traceback.print_exc(file=sys.stderr)
-            print(json.dumps(result))
+                print(json.dumps(result), flush=True)
             return 0
         except Exception as e:  # OOM or anything else: degrade, never die
             errors.append(f"rung {i}: {type(e).__name__}: {str(e)[:200]}")
@@ -581,7 +585,12 @@ def _spawn(argv, env, timeout):
         err = (e.stderr or b"")
         if isinstance(err, bytes):
             err = err.decode(errors="replace")
-        return -9, "", f"timeout after {timeout}s; stderr tail: {err[-1500:]}"
+        out = (e.stdout or b"")
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        # keep partial stdout: the child prints its result incrementally,
+        # so a timeout mid-extras still yields the last complete JSON line
+        return -9, out, f"timeout after {timeout}s; stderr tail: {err[-1500:]}"
     except Exception as e:  # spawn itself failed
         return -1, "", f"{type(e).__name__}: {e}"
 
@@ -623,16 +632,28 @@ def _parent_main():
     # 2) measured run on the probed backend (2 attempts), with its own timeout
     if platform is not None:
         tmo = 2700 if platform == "tpu" else 1500
+        partial = None
         for i in range(2):
             rc, out, err = _spawn(["--child"], probe_env, tmo)
             result = _extract_json(out)
-            if result is not None:
+            if result is not None and rc == 0:
                 if diag:
                     result["bench_diag"] = "; ".join(diag)[:1000]
                 print(json.dumps(result))
                 return 0
+            if result is not None:
+                # salvaged from a killed child — keep it, but let the
+                # remaining attempt try for a complete run first
+                result["bench_partial"] = (
+                    f"child rc={rc}; last complete measurement kept")
+                partial = result
             diag.append(f"child[{i}] rc={rc}: {err[-400:]}")
             time.sleep(15)
+        if partial is not None:
+            if diag:
+                partial["bench_diag"] = "; ".join(diag)[:1000]
+            print(json.dumps(partial))
+            return 0
 
     # 3) TPU unusable: CPU smoke fallback so the round still has a number
     env = dict(os.environ)
@@ -642,6 +663,9 @@ def _parent_main():
         rc, out, err = _spawn(["--child"], env, 1500)
         result = _extract_json(out)
         if result is not None:
+            if rc != 0:   # salvaged from a killed child: mark it
+                result["bench_partial"] = (
+                    f"child rc={rc}; last complete measurement kept")
             result["bench_diag"] = ("tpu-unavailable, cpu fallback; " +
                                     "; ".join(diag))[:1000]
             print(json.dumps(result))
