@@ -7,6 +7,14 @@ Single chip:   python examples/pretrain_llama.py
 
 import argparse
 import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the ambient TPU plugin overrides JAX_PLATFORMS at interpreter start; honor
+# an explicit cpu request before any jax initialization (hung-tunnel safety)
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
 import time
 
 import numpy as np
