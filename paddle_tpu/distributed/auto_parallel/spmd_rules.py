@@ -193,6 +193,306 @@ def flash_attention_rule(q_dm: Sequence[int], k_dm: Sequence[int],
     return SpmdInfo([out])
 
 
+def flash_attention_grad_rule(q_dm: Sequence[int], k_dm: Sequence[int],
+                              v_dm: Sequence[int]) -> SpmdInfo:
+    """Backward of flash attention (ref flash_attention.cc grad variant):
+    dq/dk/dv inherit the batch/head placement of their primal operand;
+    seq-of-KV and head_dim stay local (the kernel streams KV)."""
+    dq = [q_dm[0], q_dm[1], q_dm[2], -1]
+    dk = [k_dm[0], k_dm[1], k_dm[2], -1]
+    dv = [v_dm[0], v_dm[1], v_dm[2], -1]
+    return SpmdInfo([dq, dk, dv])
+
+
+def unary_rule(x_dm: Sequence[int], *_, **__) -> SpmdInfo:
+    """Shape-preserving unary ops (ref cast.cc / scale.cc / pow.cc /
+    full_like.cc / triu.cc): placement passes straight through.  triu/tril
+    included: the mask is a shardable iota compare, so GSPMD keeps row/col
+    shardings."""
+    return SpmdInfo([list(x_dm)])
+
+
+def slice_rule(x_dm: Sequence[int], axes: Sequence[int]) -> SpmdInfo:
+    """ref slice.cc: sliced axes lose their sharding (a sub-range of a
+    sharded dim straddles shards; GSPMD reshards), others pass through."""
+    out = list(x_dm)
+    for a in axes:
+        out[a % len(out)] = -1
+    return SpmdInfo([out])
+
+
+def squeeze_rule(x_dm: Sequence[int], axes: Sequence[int]) -> SpmdInfo:
+    """ref squeeze.cc: size-1 dims are replicated by construction; drop
+    their entries, everything else passes through."""
+    drop = {a % len(x_dm) for a in axes}
+    return SpmdInfo([[d for i, d in enumerate(x_dm) if i not in drop]])
+
+
+def unsqueeze_rule(x_dm: Sequence[int], axes: Sequence[int]) -> SpmdInfo:
+    """ref unsqueeze.cc: new size-1 dims are replicated (-1)."""
+    ndim = len(x_dm) + len(axes)
+    ins = {a % ndim for a in axes}
+    out, src = [], iter(x_dm)
+    for i in range(ndim):
+        out.append(-1 if i in ins else next(src))
+    return SpmdInfo([out])
+
+
+def flatten_rule(x_dm: Sequence[int], start_axis: int = 0,
+                 stop_axis: int = -1) -> SpmdInfo:
+    """ref flatten.cc: the merged group keeps its LEADING sharding (same
+    dim-factorization logic as reshape); trailing group shardings drop."""
+    n = len(x_dm)
+    s, e = start_axis % n, stop_axis % n
+    out = list(x_dm[:s]) + [x_dm[s]] + list(x_dm[e + 1:])
+    return SpmdInfo([out])
+
+
+def stack_rule(dims_mappings: Sequence[Sequence[int]], axis: int) -> SpmdInfo:
+    """ref stack.cc: first-sharded-wins across inputs; the new axis is
+    replicated."""
+    base = elementwise_rule(*dims_mappings).single
+    axis = axis % (len(base) + 1)
+    return SpmdInfo([base[:axis] + [-1] + base[axis:]])
+
+
+def unbind_rule(x_dm: Sequence[int], num: int, axis: int) -> SpmdInfo:
+    """ref unbind.cc: the unbound axis disappears; each output keeps the
+    remaining placements."""
+    axis = axis % len(x_dm)
+    out = [d for i, d in enumerate(x_dm) if i != axis]
+    return SpmdInfo([out] * num)
+
+
+def tile_rule(x_dm: Sequence[int], reps: Sequence[int]) -> SpmdInfo:
+    """ref tile.cc: a tiled dim interleaves copies across the original
+    index space, so its sharding drops; rep==1 dims pass through.  reps
+    may be longer than x (leading broadcast dims, replicated)."""
+    ndim = max(len(x_dm), len(reps))
+    pad_x = [-1] * (ndim - len(x_dm)) + list(x_dm)
+    pad_r = [1] * (ndim - len(reps)) + list(reps)
+    return SpmdInfo([[d if r == 1 else -1 for d, r in zip(pad_x, pad_r)]])
+
+
+def expand_rule(x_dm: Sequence[int], src_shape: Sequence[int],
+                dst_shape: Sequence[int]) -> SpmdInfo:
+    """ref expand_as.cc: broadcast (size-1 -> n) dims are replicated;
+    passthrough dims keep their sharding; new leading dims replicate."""
+    ndim = len(dst_shape)
+    pad_x = [-1] * (ndim - len(x_dm)) + list(x_dm)
+    pad_s = [1] * (ndim - len(src_shape)) + list(src_shape)
+    out = [d if pad_s[i] == dst_shape[i] else -1
+           for i, d in enumerate(pad_x)]
+    return SpmdInfo([out])
+
+
+def gather_rule(x_dm: Sequence[int], index_dm: Sequence[int],
+                axis: int = 0) -> SpmdInfo:
+    """ref gather.cc: out = x with the gathered axis replaced by the (1-D)
+    index placement; x must be local along the gathered axis (a sharded
+    gather axis would need an all-gather first, which propagation does)."""
+    axis = axis % len(x_dm)
+    out = list(x_dm)
+    out[axis] = index_dm[0]
+    return SpmdInfo([out])
+
+
+def gather_nd_rule(x_dm: Sequence[int], index_dm: Sequence[int],
+                   k: int = 1) -> SpmdInfo:
+    """ref gather_nd.cc: index [..., k] picks k leading x dims; out =
+    index batch dims + x's trailing (unindexed) dims.  The k indexed dims
+    of x must be local (propagation all-gathers them)."""
+    out = list(index_dm[:-1]) + list(x_dm[k:])
+    return SpmdInfo([out])
+
+
+def scatter_rule(x_dm: Sequence[int], index_dm: Sequence[int],
+                 updates_dm: Sequence[int]) -> SpmdInfo:
+    """ref scatter.cc: scattered leading dim must be local (cleared);
+    trailing dims keep x's placement."""
+    return SpmdInfo([[-1] + list(x_dm[1:])])
+
+
+def where_rule(cond_dm: Sequence[int], x_dm: Sequence[int],
+               y_dm: Sequence[int]) -> SpmdInfo:
+    """ref where.cc: ternary elementwise select."""
+    return elementwise_rule(cond_dm, x_dm, y_dm)
+
+
+def cumsum_rule(x_dm: Sequence[int], axis: int) -> SpmdInfo:
+    """ref cumsum.cc: the scan axis must be local (prefix dependence);
+    other dims pass through."""
+    out = list(x_dm)
+    out[axis % len(out)] = -1
+    return SpmdInfo([out])
+
+
+def argmax_rule(x_dm: Sequence[int], axis: int,
+                keepdim: bool = False) -> SpmdInfo:
+    """ref argmax.cc: like reduction but the arg needs the reduced axis
+    local (index comparison is not a psum-able partial)."""
+    axis = axis % len(x_dm)
+    out = [(-1 if i == axis else d) for i, d in enumerate(x_dm)
+           if keepdim or i != axis]
+    return SpmdInfo([out])
+
+
+def one_hot_rule(x_dm: Sequence[int], depth: int = 0) -> SpmdInfo:
+    """ref one_hot.cc: appends a replicated class dim."""
+    return SpmdInfo([list(x_dm) + [-1]])
+
+
+def pad_rule(x_dm: Sequence[int], padded_axes: Sequence[int]) -> SpmdInfo:
+    """ref pad.cc: padded dims lose their sharding (shard boundaries move),
+    untouched dims pass through."""
+    out = list(x_dm)
+    for a in padded_axes:
+        out[a % len(out)] = -1
+    return SpmdInfo([out])
+
+
+def logsumexp_rule(x_dm: Sequence[int], axis,
+                   keepdim: bool = False) -> SpmdInfo:
+    """ref logsumexp.cc: reduction-shaped; a sharded reduced axis is a
+    max/sum partial pair, surfaced as partial like reduction."""
+    return reduction_rule(x_dm, axis, keepdim)
+
+
+def p_norm_rule(x_dm: Sequence[int], axis=None,
+                keepdim: bool = False) -> SpmdInfo:
+    """ref p_norm.cc / squared_l2_norm.cc: full or axis reduction to a
+    (near-)scalar; sharded reduced dims are partial."""
+    if axis is None:
+        return SpmdInfo([[]], partial_dims=[d for d in x_dm if d >= 0])
+    return reduction_rule(x_dm, axis, keepdim)
+
+
+def add_n_rule(dims_mappings: Sequence[Sequence[int]]) -> SpmdInfo:
+    """ref add_n.cc: n-ary elementwise sum."""
+    return elementwise_rule(*dims_mappings)
+
+
+def numel_rule(x_dm: Sequence[int]) -> SpmdInfo:
+    """ref numel.cc: metadata scalar, replicated (no partial — the count
+    is computed from shape, not data)."""
+    return SpmdInfo([[]])
+
+
+def nonzero_rule(x_dm: Sequence[int]) -> SpmdInfo:
+    """ref nonzero.cc: data-dependent output shape forces replication."""
+    return SpmdInfo([[-1, -1]])
+
+
+def swiglu_rule(x_dm: Sequence[int],
+                y_dm: Optional[Sequence[int]] = None) -> SpmdInfo:
+    """ref swiglu.cc: silu(x) * y — elementwise over both operands."""
+    return elementwise_rule(x_dm, y_dm) if y_dm else SpmdInfo([list(x_dm)])
+
+
+def fused_rope_rule(q_dm: Sequence[int],
+                    k_dm: Optional[Sequence[int]] = None) -> SpmdInfo:
+    """ref fused_rope.cc: RoPE is positionwise-elementwise ([b, s, h, d]);
+    batch/seq/head shardings pass through (cos/sin tables are a shardable
+    iota), head_dim must be local (the rotate-half pairs within it)."""
+    outs = [list(q_dm[:-1]) + [-1]]
+    if k_dm is not None:
+        outs.append(list(k_dm[:-1]) + [-1])
+    return SpmdInfo(outs)
+
+
+def rms_norm_rule(x_dm: Sequence[int],
+                  w_dm: Optional[Sequence[int]] = None) -> SpmdInfo:
+    """ref rms_norm.cc: normalizes the last dim, which must be local;
+    leading dims pass through."""
+    return SpmdInfo([list(x_dm[:-1]) + [-1]])
+
+
+def fused_dropout_add_rule(x_dm: Sequence[int],
+                           y_dm: Sequence[int]) -> SpmdInfo:
+    """ref fused_dropout_add.cc: elementwise over both operands (the mask
+    is generated shard-local from a split RNG)."""
+    return elementwise_rule(x_dm, y_dm)
+
+
+def c_embedding_rule(table_dm: Sequence[int],
+                     ids_dm: Sequence[int]) -> SpmdInfo:
+    """ref c_embedding.cc: the TP vocab-sharded lookup — same contract as
+    embedding with (table, ids) argument order."""
+    return embedding_rule(ids_dm, table_dm)
+
+
+def c_softmax_cross_entropy_rule(logits_dm: Sequence[int],
+                                 labels_dm: Sequence[int]) -> SpmdInfo:
+    """ref c_softmax_with_cross_entropy.cc: class-parallel CE, identical
+    partial structure to cross_entropy_with_softmax."""
+    return cross_entropy_rule(logits_dm, labels_dm)
+
+
+def moe_gate_dispatch_rule(x_dm: Sequence[int],
+                           gates_dm: Sequence[int]) -> SpmdInfo:
+    """ref moe_gate_dispatch.cc: x [S, H] + gates [S, E] -> dispatched
+    [E, C, H].  The expert dim takes the gates' expert placement (ep axis);
+    capacity is local; hidden keeps x's placement."""
+    return SpmdInfo([[gates_dm[-1], -1, x_dm[-1]]])
+
+
+def moe_combine_rule(y_dm: Sequence[int],
+                     gates_dm: Sequence[int]) -> SpmdInfo:
+    """ref moe_combine.cc: dispatched [E, C, H] + gates [S, E] -> [S, H].
+    A sharded expert dim is a psum partial (each expert shard contributes
+    its tokens' outputs)."""
+    partial = [y_dm[0]] if y_dm[0] >= 0 else []
+    return SpmdInfo([[gates_dm[0], y_dm[-1]]], partial_dims=partial)
+
+
+def conv2d_rule(x_dm: Sequence[int], w_dm: Sequence[int]) -> SpmdInfo:
+    """ref conv2d.cc: x [N, C, H, W] @ w [O, I, kh, kw] -> [N, O, H, W].
+    Batch from x, out-channels from w; spatial dims replicate (halo
+    exchange not modeled); matching sharded C/I contracts to a partial."""
+    partial = [x_dm[1]] if (x_dm[1] >= 0 and x_dm[1] == w_dm[1]) else []
+    return SpmdInfo([[x_dm[0], w_dm[0], -1, -1]], partial_dims=partial)
+
+
+def fused_linear_param_grad_add_rule(x_dm: Sequence[int],
+                                     dy_dm: Sequence[int]) -> SpmdInfo:
+    """ref fused_linear_param_grad_add.cc: dW = x^T @ dy over the flattened
+    batch/seq dims; sharded batch dims become a psum partial on dW."""
+    partial = sorted({d for d in list(x_dm[:-1]) + list(dy_dm[:-1])
+                      if d >= 0})
+    return SpmdInfo([[x_dm[-1], dy_dm[-1]]], partial_dims=partial)
+
+
+def default_data_parallel_rule(
+        out_ndims: Sequence[int], batch_axis: int = 0) -> SpmdInfo:
+    """ref default_data_parallel.cc: fallback that shards every output's
+    leading dim on the batch mesh axis, rest replicated."""
+    return SpmdInfo([[batch_axis] + [-1] * (n - 1) for n in out_ndims])
+
+
+def replicated_rule(out_ndims: Sequence[int]) -> SpmdInfo:
+    """ref replicated.cc: the bottom fallback — everything replicated."""
+    return SpmdInfo([[-1] * n for n in out_ndims])
+
+
+def optimizer_rule(param_dm: Sequence[int],
+                   grad_dm: Sequence[int]) -> SpmdInfo:
+    """ref optimizer.cc (sgd/adam family): the update is elementwise over
+    param/grad/moments — all carried states take the PARAM's placement
+    (the grad is resharded to match, never the other way: the param's
+    layout is the persistent one)."""
+    return SpmdInfo([list(param_dm)])
+
+
+def amp_check_finite_rule(
+        dims_mappings: Sequence[Sequence[int]]) -> SpmdInfo:
+    """ref amp_ops.cc (check_finite_and_unscale): scaled params pass
+    through unchanged; found_inf is a replicated scalar reduced across
+    all shards (an OR-partial over every sharded axis)."""
+    partial = sorted({d for dm in dims_mappings for d in dm if d >= 0})
+    return SpmdInfo([list(dm) for dm in dims_mappings] + [[]],
+                    partial_dims=partial)
+
+
 RULES: Dict[str, object] = {
     "elementwise": elementwise_rule,
     "matmul": matmul_rule,
@@ -206,6 +506,50 @@ RULES: Dict[str, object] = {
     "split": split_rule,
     "cross_entropy_with_softmax": cross_entropy_rule,
     "flash_attention": flash_attention_rule,
+    "flash_attention_grad": flash_attention_grad_rule,
+    # shape-preserving unaries (ref cast.cc/scale.cc/pow.cc/full_like.cc/
+    # triu.cc) — one mechanism, registered per reference family name
+    "cast": unary_rule,
+    "scale": unary_rule,
+    "pow": unary_rule,
+    "full_like": unary_rule,
+    "triu": unary_rule,
+    "slice": slice_rule,
+    "squeeze": squeeze_rule,
+    "unsqueeze": unsqueeze_rule,
+    "flatten": flatten_rule,
+    "stack": stack_rule,
+    "unbind": unbind_rule,
+    "tile": tile_rule,
+    "expand_as": expand_rule,
+    "gather": gather_rule,
+    "gather_nd": gather_nd_rule,
+    "scatter": scatter_rule,
+    "where": where_rule,
+    "cumsum": cumsum_rule,
+    "argmax": argmax_rule,
+    "one_hot": one_hot_rule,
+    "pad": pad_rule,
+    "logsumexp": logsumexp_rule,
+    "p_norm": p_norm_rule,
+    "squared_l2_norm": p_norm_rule,
+    "add_n": add_n_rule,
+    "numel": numel_rule,
+    "nonzero": nonzero_rule,
+    "swiglu": swiglu_rule,
+    "fused_rope": fused_rope_rule,
+    "rms_norm": rms_norm_rule,
+    "fused_dropout_add": fused_dropout_add_rule,
+    "c_embedding": c_embedding_rule,
+    "c_softmax_with_cross_entropy": c_softmax_cross_entropy_rule,
+    "moe_gate_dispatch": moe_gate_dispatch_rule,
+    "moe_combine": moe_combine_rule,
+    "conv2d": conv2d_rule,
+    "fused_linear_param_grad_add": fused_linear_param_grad_add_rule,
+    "default_data_parallel": default_data_parallel_rule,
+    "replicated": replicated_rule,
+    "optimizer": optimizer_rule,
+    "amp_check_finite": amp_check_finite_rule,
 }
 
 
@@ -245,7 +589,8 @@ def sharding_to_dims_mapping(sharding, ndim: int,
 
 
 def validate_rule(op: str, fn, input_shapes, input_dms, mesh,
-                  rule_args=(), rule_kwargs=None):
+                  rule_args=(), rule_kwargs=None, input_dtypes=None,
+                  rule_dms=None):
     """Run ``fn`` under jit with inputs sharded per ``input_dms`` and
     compare XLA's actual output sharding against the rule's prediction.
     Returns (predicted, actual) dims_mappings; raises on mismatch of the
@@ -259,12 +604,19 @@ def validate_rule(op: str, fn, input_shapes, input_dms, mesh,
     from jax.sharding import NamedSharding
 
     names = list(mesh.axis_names)
-    info = infer_spmd(op, *list(input_dms) + list(rule_args),
+    # rule_dms lets ops whose rule signature differs from the executed
+    # argument order (e.g. multi-arg fused ops) state the rule's view
+    info = infer_spmd(op, *list(rule_dms or input_dms) + list(rule_args),
                       **(rule_kwargs or {}))
     args = []
-    for shape, dm in zip(input_shapes, input_dms):
-        arr = jnp.asarray(
-            np.random.default_rng(0).standard_normal(shape), jnp.float32)
+    dtypes = input_dtypes or [jnp.float32] * len(input_shapes)
+    for shape, dm, dt in zip(input_shapes, input_dms, dtypes):
+        rng = np.random.default_rng(0)
+        if jnp.issubdtype(jnp.dtype(dt), jnp.integer):
+            lim = max(2, min(s for s in shape) if shape else 2)
+            arr = jnp.asarray(rng.integers(0, lim, shape), dt)
+        else:
+            arr = jnp.asarray(rng.standard_normal(shape), dt)
         args.append(jax.device_put(
             arr, NamedSharding(mesh, dims_mapping_to_spec(dm, names))))
     out = jax.jit(fn)(*args)
