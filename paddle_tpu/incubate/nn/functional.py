@@ -219,12 +219,16 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
     [gate | up] halves of a SwiGLU FFN; ffn2_weight [E, I, H]; optional
     per-expert biases [E, 1, 2*I] / [E, 1, H].
 
-    TPU formulation: dense mixture — every expert runs as one batched
-    einsum over all tokens and outputs are combined with the top-k gate
-    weights (zero for unselected experts).  No capacity, no drops, exactly
-    the per-token routed result; E/top_k-fold extra FFN flops traded for
-    pure-matmul execution.  For the capacity-dispatch TRAINING path use
-    ``models.llama.moe_mlp_forward`` / ``LlamaMoEMLP``.
+    TPU formulation: dense mixture — every expert runs over all tokens and
+    outputs are combined with the top-k gate weights (zero for unselected
+    experts).  No capacity, no drops, exactly the per-token routed result;
+    E/top_k-fold extra FFN flops traded for pure-matmul execution.  The
+    experts run under a ``lax.scan`` so the transients are bounded at
+    [N, 2I] + [N, H] for ONE expert at a time — a single [E, N, 2I]
+    einsum would materialize E-fold that (e.g. E=8, N=4096, I=11008 bf16
+    ≈ 1.4 GB per transient) and OOM long before the routed path.  For the
+    capacity-dispatch TRAINING path use ``models.llama.moe_mlp_forward``
+    / ``LlamaMoEMLP``.
     """
     if quant_method != "None" or ffn1_scale is not None \
             or ffn2_scale is not None:
@@ -252,14 +256,24 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
         comb = jnp.zeros_like(probs).at[
             jnp.arange(xf.shape[0])[:, None], topi].set(topv)
 
-        h1 = jnp.einsum("nh,ehi->eni", xf, w1)             # [E, N, 2I]
+        # scan over experts: one [N, 2I] / [N, H] transient at a time
+        xs = {"w1": w1, "w2": w2, "c": comb.T.astype(xv.dtype)}  # c [E, N]
         if b1 is not None:
-            h1 = h1 + b1
-        act = jax.nn.silu(h1[..., :half]) * h1[..., half:]
-        out_e = jnp.einsum("eni,eih->enh", act, w2)        # [E, N, H]
+            xs["b1"] = b1
         if b2 is not None:
-            out_e = out_e + b2
-        y = jnp.einsum("ne,enh->nh", comb.astype(xv.dtype), out_e)
+            xs["b2"] = b2
+
+        def step(acc, ex):
+            h1 = xf @ ex["w1"]                             # [N, 2I]
+            if "b1" in ex:
+                h1 = h1 + ex["b1"][0]
+            act = jax.nn.silu(h1[..., :half]) * h1[..., half:]
+            o = act @ ex["w2"]                             # [N, H]
+            if "b2" in ex:
+                o = o + ex["b2"][0]
+            return acc + ex["c"][:, None] * o, None
+
+        y, _ = jax.lax.scan(step, jnp.zeros_like(xf), xs)
         return y.reshape(B, S, H)
 
     args = [x, gate_weight, ffn1_weight, ffn2_weight]

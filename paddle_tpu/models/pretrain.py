@@ -392,12 +392,17 @@ class PretrainStep:
             # accumulating each block's load-balancing aux loss.  The aux
             # tracer is read off the template's MoE submodule right after
             # the functional call — same trace, so it composes with scan.
+            # stats (keep.mean/ce.max + a carried [2] vector) only when
+            # asked: the hot training scan keeps the 2-tuple carry and no
+            # extra reductions inside the remat'd block (ADVICE r4)
             def block_aux(lp, x):
                 y = block(lp, x)
                 aux = template.mlp._last_aux
-                stats = template.mlp._last_stats
-                return (y, aux._data if isinstance(aux, Tensor) else aux,
-                        stats._data if isinstance(stats, Tensor) else stats)
+                out = (y, aux._data if isinstance(aux, Tensor) else aux)
+                if with_stats:
+                    s = template.mlp._last_stats
+                    out += (s._data if isinstance(s, Tensor) else s,)
+                return out
 
             if pc.remat:
                 block_aux = _remat(block_aux, pc.remat_policy)
@@ -406,16 +411,19 @@ class PretrainStep:
                       for k, v in params["blocks"].items()}
 
             def body(carry, lp):
-                x, aux, st = carry
-                y, a, s = block_aux(lp, x)
-                return (y, aux + a, st + s), None
+                outs = block_aux(lp, carry[0])
+                return (outs[0],) + tuple(
+                    c_ + o for c_, o in zip(carry[1:], outs[1:])), None
 
-            (h, aux, st), _ = jax.lax.scan(
-                body, (h, jnp.float32(0.0), jnp.zeros((2,), jnp.float32)),
-                blocks)
-            h = rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
+            init = (h, jnp.float32(0.0))
             if with_stats:
-                return h, c.moe_aux_loss_weight * aux, st / c.num_hidden_layers
+                init += (jnp.zeros((2,), jnp.float32),)
+            carry, _ = jax.lax.scan(body, init, blocks)
+            h = rms_norm_fp32(carry[0], params["norm"], c.rms_norm_eps)
+            aux = carry[1]
+            if with_stats:
+                return (h, c.moe_aux_loss_weight * aux,
+                        carry[2] / c.num_hidden_layers)
             return h, c.moe_aux_loss_weight * aux
 
         if pc.remat:

@@ -34,37 +34,11 @@ _DOMAIN = {
     "atanh": lambda r: np.clip(r, -0.9, 0.9),
 }
 
-# our name -> torch name when they differ
-_TORCH_NAMES = {"neg": "neg", "mod": "remainder", "fix": "trunc",
-                "gammaln": "lgamma", "logaddexp": "logaddexp"}
-
-_SKIP = {
-    # numerics checked elsewhere / oracle semantics differ
-    "clip_by_norm", "isclose", "allclose", "frac",
-}
-
-
-_FORCE_NUMPY = {"conj",   # torch sets the conj bit; .numpy() refuses
-                "equal"}  # torch.equal is whole-tensor, ours is elementwise
-
-
-def _oracle(name):
-    tname = _TORCH_NAMES.get(name, name)
-    try:
-        import torch
-    except ImportError:  # numpy still covers most of the table
-        torch = None
-    fn = None if (name in _FORCE_NUMPY or torch is None) else (
-        getattr(torch, tname, None) or getattr(torch.special, tname, None))
-    if fn is not None:
-        def run(*arrays):
-            out = fn(*[torch.tensor(a) for a in arrays])
-            return out.numpy()
-        return run
-    nfn = getattr(np, tname, None)
-    if nfn is not None:
-        return lambda *arrays: nfn(*arrays)
-    return None
+# skip set + oracle resolution live in ops.coverage so the
+# OPS_COVERAGE.md "oracle-verified" count is derived from the exact same
+# logic this sweep runs (ADVICE r4)
+from paddle_tpu.ops.coverage import ORACLE_SKIP as _SKIP
+from paddle_tpu.ops.coverage import resolve_oracle as _oracle
 
 
 def _rows(kind):
