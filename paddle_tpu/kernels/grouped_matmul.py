@@ -1,0 +1,314 @@
+"""Grouped (ragged) expert matmul — the MoE compute kernel.
+
+Reference surface: the fused/cutlass grouped-GEMM MoE kernels under
+paddle/phi/kernels/fusion/ (moe_gemm/, fused_moe_op.h) and their API
+python/paddle/incubate/nn/functional/fused_moe.py — experts run one GEMM
+over just their own tokens instead of a capacity-padded dense batch.
+
+TPU-native design (megablocks-style, built for the MXU):
+
+- Tokens are pre-sorted by expert id OUTSIDE the kernel (an XLA sort);
+  each expert's rows live in a contiguous, tile-aligned span of the
+  ``[M, K]`` operand, so every ``bm`` row-tile belongs to exactly ONE
+  expert.  ``tile_groups[i]`` names that expert; it rides the scalar-
+  prefetch channel (`pltpu.PrefetchScalarGridSpec`) so the index map can
+  DMA the right expert's weight block — data-dependent weight selection
+  with zero data-dependent control flow inside the kernel.
+- ``gmm``: out[m] = lhs[m] @ rhs[group(m)] with an fp32 VMEM accumulator
+  over k-steps.  ``tgmm`` (the weight-grad transpose) accumulates
+  lhs^T @ rhs into out[group]: the m grid dim is innermost, so each
+  expert's output block is visited in consecutive steps and flushed at
+  the group boundary — the revisit pattern Mosaic requires.
+- Expert FLOPs scale with the actual tokens-per-expert (plus <=1 tile of
+  per-expert alignment padding), not with a capacity bound: the
+  capacity-dispatch formulations pay ~capacity_factor extra FLOPs and
+  drop overflow tokens; this path pays <=E*bm pad rows and drops nothing.
+
+``grouped_matmul`` wraps both in a ``custom_vjp`` (dlhs via gmm against
+the transposed weights, drhs via tgmm), so the kernel trains.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import flags
+
+flags.define_flag("grouped_matmul_interpret", False,
+                  "Run the Pallas grouped-matmul kernels in interpreter "
+                  "mode on CPU (tests).")
+
+
+def _mode():
+    if jax.default_backend() == "tpu":
+        return "tpu"
+    if flags.flag("grouped_matmul_interpret"):
+        return "interpret"
+    return None
+
+
+def _pick_block(dim: int, want: int) -> int:
+    """Largest power-of-two tile <= want that divides dim (>=128 for the
+    lane dim by construction: callers pad K/N to 128 multiples)."""
+    b = want
+    while b > 128 and dim % b:
+        b //= 2
+    if dim % b:
+        raise ValueError(f"dim {dim} not divisible by a tile <= {want}")
+    return b
+
+
+# ------------------------------------------------------------------ gmm ---
+
+def _gmm_kernel(group_ref, lhs_ref, rhs_ref, out_ref, acc_ref, *, nk,
+                trans_rhs):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    dims = (((1,), (1,)), ((), ())) if trans_rhs else (((1,), (0,)), ((), ()))
+    acc_ref[...] += jax.lax.dot_general(
+        lhs_ref[...], rhs_ref[...], dims,
+        preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(2) == nk - 1)
+    def _flush():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def gmm(lhs, rhs, tile_groups, *, bm=512, bn=512, bk=512, trans_rhs=False,
+        interpret=None):
+    """Grouped matmul: ``out[m, :] = lhs[m, :] @ rhs[tile_groups[m//bm]]``.
+
+    lhs: [M, C] with rows grouped by expert, group spans bm-aligned.
+    rhs: [E, C, O] ([E, O, C] when ``trans_rhs``).
+    tile_groups: [M//bm] int32, nondecreasing, expert id per row-tile.
+    Returns [M, O] in lhs.dtype.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, C = lhs.shape
+    E = rhs.shape[0]
+    O = rhs.shape[1] if trans_rhs else rhs.shape[2]
+    mode = _mode() if interpret is None else ("interpret" if interpret
+                                              else "tpu")
+    if mode is None:
+        return _gmm_reference(lhs, rhs, tile_groups, bm=bm,
+                              trans_rhs=trans_rhs)
+    if M % bm:
+        raise ValueError(f"M ({M}) must be a multiple of bm ({bm})")
+    bn = _pick_block(O, bn)
+    bk = _pick_block(C, bk)
+    nk = C // bk
+
+    rhs_spec = (
+        pl.BlockSpec((None, bn, bk), lambda i, j, k, g: (g[i], j, k))
+        if trans_rhs else
+        pl.BlockSpec((None, bk, bn), lambda i, j, k, g: (g[i], k, j)))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(M // bm, O // bn, nk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k, g: (i, k)),
+            rhs_spec,
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k, g: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+    )
+    kernel = functools.partial(_gmm_kernel, nk=nk, trans_rhs=trans_rhs)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((M, O), lhs.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=(mode == "interpret"),
+    )(tile_groups.astype(jnp.int32), lhs, rhs)
+
+
+# ----------------------------------------------------------------- tgmm ---
+
+def _tgmm_kernel(group_ref, lhs_ref, rhs_ref, out_ref, acc_ref, *, nm):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(2)
+    g_here = group_ref[i]
+    first = jnp.logical_or(i == 0,
+                           group_ref[jnp.maximum(i - 1, 0)] != g_here)
+    last = jnp.logical_or(
+        i == nm - 1, group_ref[jnp.minimum(i + 1, nm - 1)] != g_here)
+
+    @pl.when(first)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        lhs_ref[...], rhs_ref[...], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(last)
+    def _flush():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def tgmm(lhs, rhs, tile_groups, num_groups, *, bm=512, bn=512, bk=512,
+         interpret=None):
+    """Transposed grouped matmul (the weight gradient):
+    ``out[e] = sum over e's rows of lhs[m, :]^T @ rhs[m, :]``.
+
+    lhs: [M, K]; rhs: [M, N]; both row-grouped as in gmm.
+    Every group id in [0, num_groups) MUST own at least one tile (the MoE
+    dispatch pads each expert to >=1 tile), otherwise its output block is
+    left unwritten.  Returns [E, K, N] in lhs.dtype.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, K = lhs.shape
+    N = rhs.shape[1]
+    mode = _mode() if interpret is None else ("interpret" if interpret
+                                              else "tpu")
+    if mode is None:
+        return _tgmm_reference(lhs, rhs, tile_groups, num_groups, bm=bm)
+    if M % bm:
+        raise ValueError(f"M ({M}) must be a multiple of bm ({bm})")
+    bk = _pick_block(K, bk)
+    bn = _pick_block(N, bn)
+    nm = M // bm
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(K // bk, N // bn, nm),          # m innermost: consecutive
+        in_specs=[                            # visits per expert block
+            pl.BlockSpec((bm, bk), lambda k, j, i, g: (i, k)),
+            pl.BlockSpec((bm, bn), lambda k, j, i, g: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((None, bk, bn),
+                               lambda k, j, i, g: (g[i], k, j)),
+        scratch_shapes=[pltpu.VMEM((bk, bn), jnp.float32)],
+    )
+    kernel = functools.partial(_tgmm_kernel, nm=nm)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_groups, K, N), lhs.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=(mode == "interpret"),
+    )(tile_groups.astype(jnp.int32), lhs, rhs)
+
+
+# ------------------------------------------------- XLA reference (CPU) ---
+
+def _row_groups(tile_groups, bm, M):
+    return jnp.repeat(tile_groups.astype(jnp.int32), bm,
+                      total_repeat_length=M)
+
+
+def _gmm_reference(lhs, rhs, tile_groups, *, bm, trans_rhs=False):
+    """Oracle: scan over experts, masked dense matmul each (E-fold flops —
+    tests and CPU fallback only)."""
+    M = lhs.shape[0]
+    rg = _row_groups(tile_groups, bm, M)
+
+    def step(acc, e):
+        w = rhs[e].T if trans_rhs else rhs[e]
+        part = (jnp.where((rg == e)[:, None], lhs, 0) @ w)
+        return acc + part.astype(acc.dtype), None
+
+    O = rhs.shape[1] if trans_rhs else rhs.shape[2]
+    acc = jnp.zeros((M, O), jnp.float32)
+    acc, _ = jax.lax.scan(step, acc, jnp.arange(rhs.shape[0]))
+    return acc.astype(lhs.dtype)
+
+
+def _tgmm_reference(lhs, rhs, tile_groups, num_groups, *, bm):
+    M = lhs.shape[0]
+    rg = _row_groups(tile_groups, bm, M)
+
+    def per_expert(e):
+        return (jnp.where((rg == e)[:, None], lhs, 0).T @ rhs)
+
+    out = jax.lax.map(per_expert, jnp.arange(num_groups))
+    return out.astype(lhs.dtype)
+
+
+# ------------------------------------------------------- dispatch plan ---
+
+def sorted_dispatch_plan(expert_ids, num_groups, bm):
+    """Build the gather maps for a grouped-GEMM dispatch.
+
+    expert_ids: [F] int32 — the expert choice per (token, k) flat entry.
+    Returns (inv_flat [M], pos [F], tile_groups [M // bm]) where
+    M = ceil(F/bm)*bm + num_groups*bm (static):
+
+    - ``inv_flat[p]`` = flat entry id occupying padded-buffer row p, or F
+      for alignment-padding rows (callers gather against a zero row).
+    - ``pos[f]`` = padded-buffer row of flat entry f.
+    - ``tile_groups[i]`` = expert owning row-tile i (nondecreasing; every
+      expert owns >= 1 tile, which ``tgmm`` requires).
+
+    Rows are grouped by expert in stable order, each expert padded to a
+    bm multiple (>= bm), so both dispatch and un-dispatch are pure
+    GATHERS — the backward of each is the other, so no serialized
+    scatter-adds appear anywhere in the MoE step (the scatters here are
+    1 int32 word per row, vectorized).
+    """
+    F = expert_ids.shape[0]
+    M = -(-F // bm) * bm + num_groups * bm
+    i32 = jnp.int32
+    expert_ids = expert_ids.astype(i32)
+    order = jnp.argsort(expert_ids, stable=True)
+    e_sorted = jnp.take(expert_ids, order)
+    counts = jnp.bincount(expert_ids, length=num_groups)
+    padded = jnp.maximum(-(-counts // bm), 1) * bm
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]])
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), padded.dtype), jnp.cumsum(padded)[:-1]])
+    r = jnp.arange(F, dtype=i32)
+    dest = (offsets[e_sorted] + (r - starts[e_sorted])).astype(i32)
+    inv_flat = jnp.full((M,), F, i32).at[dest].set(order.astype(i32))
+    pos = jnp.zeros((F,), i32).at[order].set(dest)
+    ends = jnp.cumsum(padded)
+    tile_groups = jnp.minimum(
+        jnp.searchsorted(ends, jnp.arange(M // bm) * bm, side="right"),
+        num_groups - 1).astype(i32)
+    return inv_flat, pos, tile_groups
+
+
+# ------------------------------------------------------ differentiable ---
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def grouped_matmul(lhs, rhs, tile_groups, num_groups, bm=512, bn=512,
+                   bk=512):
+    """Differentiable grouped matmul: ``gmm`` forward; backward runs
+    ``gmm`` against the transposed expert weights (dlhs) and ``tgmm``
+    (drhs).  All three are ragged — the gradient FLOPs also scale with
+    actual tokens-per-expert."""
+    return gmm(lhs, rhs, tile_groups, bm=bm, bn=bn, bk=bk)
+
+
+def _grouped_matmul_fwd(lhs, rhs, tile_groups, num_groups, bm, bn, bk):
+    out = gmm(lhs, rhs, tile_groups, bm=bm, bn=bn, bk=bk)
+    return out, (lhs, rhs, tile_groups)
+
+
+def _grouped_matmul_bwd(num_groups, bm, bn, bk, res, dy):
+    lhs, rhs, tile_groups = res
+    # dlhs[m] = dy[m] @ rhs[g]^T — rhs's [E, C, O] is exactly the
+    # trans_rhs=[E, out, contract] layout for this product
+    dlhs = gmm(dy, rhs, tile_groups, bm=bm, bn=bn, bk=bk, trans_rhs=True)
+    drhs = tgmm(lhs, dy, tile_groups, num_groups, bm=bm, bn=bn, bk=bk)
+    return (dlhs.astype(lhs.dtype), drhs.astype(rhs.dtype),
+            np.zeros(tile_groups.shape, jax.dtypes.float0))
+
+
+grouped_matmul.defvjp(_grouped_matmul_fwd, _grouped_matmul_bwd)

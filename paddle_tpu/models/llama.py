@@ -20,6 +20,7 @@ TPU-first design decisions:
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
@@ -57,11 +58,13 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_loss_weight: float = 0.01
     # "gather": int32 scatter + row gather (global capacity); "einsum":
-    # GShard/t5x one-hot matmul dispatch (per-group capacity) — both
-    # directions ride the MXU, at ~25% extra FFN flops for the dispatch
-    # contraction.  The bench measures both; see BENCH notes.
+    # GShard/t5x one-hot matmul dispatch (per-group capacity); "grouped":
+    # expert-sorted ragged GEMM Pallas kernel (no capacity, no drops —
+    # the fast single-chip path; requires ep=1: the kernel runs inside
+    # one shard).  The bench measures them; see BENCH notes.
     moe_dispatch: str = "gather"
     moe_groups: int = 0          # einsum only: token groups (0 -> batch dim)
+    moe_block_m: int = 512       # grouped only: row-tile (group alignment)
     # parallel knobs (consumed by llama_shard_plan / trainer)
     tensor_parallel: bool = False
     sequence_parallel: bool = False
@@ -70,9 +73,9 @@ class LlamaConfig:
     def __post_init__(self):
         if self.num_key_value_heads is None:
             self.num_key_value_heads = self.num_attention_heads
-        if self.moe_dispatch not in ("gather", "einsum"):
+        if self.moe_dispatch not in ("gather", "einsum", "grouped"):
             raise ValueError(
-                f"moe_dispatch must be 'gather' or 'einsum', "
+                f"moe_dispatch must be 'gather', 'einsum' or 'grouped', "
                 f"got {self.moe_dispatch!r}")
 
     @property
@@ -425,6 +428,131 @@ def moe_mlp_forward_einsum(x, gate_w, w_gate, w_up, w_down, *, top_k,
     return y.reshape(B, S, H), aux, stats
 
 
+def _silu(x):
+    return x * jax.nn.sigmoid(x)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10))
+def _grouped_ffn(xf, w_gate, w_up, w_down, gates, inv_flat, pos,
+                 tile_groups, E, k, bm):
+    """Grouped-GEMM SwiGLU expert mixture over pre-sorted tokens.
+
+    xf [N, H]; w_gate/w_up [E, H, I]; w_down [E, I, H]; gates [N, k] fp32
+    combine weights; inv_flat/pos/tile_groups from
+    ``sorted_dispatch_plan``.  Dispatch and combine are GATHERS and the
+    hand-written VJP keeps them gathers in reverse (the AD transpose of a
+    gather is a scatter-add, which TPU serializes row-by-row — the
+    whole point of carrying both maps is never to emit one).
+    """
+    y, _ = _grouped_ffn_fwd(xf, w_gate, w_up, w_down, gates, inv_flat,
+                            pos, tile_groups, E, k, bm)
+    return y
+
+
+def _grouped_ffn_fwd(xf, w_gate, w_up, w_down, gates, inv_flat, pos,
+                     tile_groups, E, k, bm):
+    from ..kernels.grouped_matmul import gmm
+
+    N, H = xf.shape
+    xz = jnp.concatenate([xf, jnp.zeros((1, H), xf.dtype)], axis=0)
+    tok_of = jnp.where(inv_flat < N * k, inv_flat // k, N)
+    x_pad = jnp.take(xz, tok_of, axis=0)                  # [M, H] gather
+    h_g = gmm(x_pad, w_gate, tile_groups, bm=bm)
+    h_u = gmm(x_pad, w_up, tile_groups, bm=bm)
+    a = _silu(h_g) * h_u
+    o = gmm(a, w_down, tile_groups, bm=bm)                # [M, H]
+    o_pos = jnp.take(o, pos, axis=0).reshape(N, k, H)     # combine gather
+    y = (o_pos * gates[..., None].astype(o.dtype)).sum(axis=1)
+    return y, (xf, w_gate, w_up, w_down, gates, inv_flat, pos, tile_groups)
+
+
+def _grouped_ffn_bwd(E, k, bm, res, dy):
+    from ..kernels.grouped_matmul import gmm, tgmm
+
+    xf, w_gate, w_up, w_down, gates, inv_flat, pos, tile_groups = res
+    N, H = xf.shape
+    # recompute the forward intermediates (full-remat semantics — the
+    # training configs run the block under remat anyway)
+    xz = jnp.concatenate([xf, jnp.zeros((1, H), xf.dtype)], axis=0)
+    tok_of = jnp.where(inv_flat < N * k, inv_flat // k, N)
+    x_pad = jnp.take(xz, tok_of, axis=0)
+    h_g = gmm(x_pad, w_gate, tile_groups, bm=bm)
+    h_u = gmm(x_pad, w_up, tile_groups, bm=bm)
+    sg = _silu(h_g)
+    a = sg * h_u
+    o = gmm(a, w_down, tile_groups, bm=bm)
+
+    o_pos = jnp.take(o, pos, axis=0).reshape(N, k, H)
+    d_gates = (o_pos.astype(jnp.float32)
+               * dy[:, None, :].astype(jnp.float32)).sum(-1)  # [N, k]
+
+    # d(combine): do[p] = gate(p) * dy[token(p)] — both gathers
+    gate_z = jnp.concatenate(
+        [gates.reshape(N * k).astype(dy.dtype), jnp.zeros((1,), dy.dtype)])
+    gate_pad = jnp.take(gate_z, jnp.minimum(inv_flat, N * k))
+    dy_z = jnp.concatenate([dy, jnp.zeros((1, H), dy.dtype)], axis=0)
+    do = gate_pad[:, None] * jnp.take(dy_z, tok_of, axis=0)   # [M, H]
+
+    da = gmm(do, w_down, tile_groups, bm=bm, trans_rhs=True)  # [M, I]
+    sig = jax.nn.sigmoid(h_g.astype(jnp.float32)).astype(h_g.dtype)
+    dsilu = sig + h_g * sig * (1 - sig)
+    dh_g = da * h_u * dsilu
+    dh_u = da * sg
+    dw_d = tgmm(a, do, tile_groups, E, bm=bm)
+    dw_g = tgmm(x_pad, dh_g, tile_groups, E, bm=bm)
+    dw_u = tgmm(x_pad, dh_u, tile_groups, E, bm=bm)
+    dx_pad = gmm(dh_g, w_gate, tile_groups, bm=bm, trans_rhs=True) + \
+        gmm(dh_u, w_up, tile_groups, bm=bm, trans_rhs=True)   # [M, H]
+    # d(dispatch): token t accumulates its k buffer rows — a gather
+    dxf = jnp.take(dx_pad, pos, axis=0).reshape(N, k, H).sum(axis=1)
+
+    f0 = lambda t: np.zeros(t.shape, jax.dtypes.float0)
+    return (dxf.astype(xf.dtype), dw_g.astype(w_gate.dtype),
+            dw_u.astype(w_up.dtype), dw_d.astype(w_down.dtype),
+            d_gates.astype(gates.dtype), f0(inv_flat), f0(pos),
+            f0(tile_groups))
+
+
+_grouped_ffn.defvjp(_grouped_ffn_fwd, _grouped_ffn_bwd)
+
+
+def moe_mlp_forward_grouped(x, gate_w, w_gate, w_up, w_down, *, top_k,
+                            block_m=512):
+    """Grouped-GEMM (megablocks-style) MoE — the fast single-chip path
+    (reference: the fused/cutlass grouped MoE GEMMs under
+    paddle/phi/kernels/fusion/ + incubate fused_moe).
+
+    Tokens are sorted by expert and each expert runs ONE ragged GEMM over
+    exactly its own tokens (``kernels.grouped_matmul``): no capacity
+    bound, no dropped tokens, <= E*block_m rows of tile-alignment padding
+    instead of the ~capacity_factor x N*k padded rows the capacity
+    formulations compute.  Shapes/returns as ``moe_mlp_forward``
+    (kept_frac is 1.0 by construction — nothing drops).
+    """
+    B, S, H = x.shape
+    E = gate_w.shape[-1]
+    N = B * S
+    k = top_k
+    xf = x.reshape(N, H)
+
+    logits = (xf.astype(jnp.float32) @ gate_w.astype(jnp.float32))  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)                  # [N, k]
+    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+
+    me = probs.mean(axis=0)
+    ce = jnp.zeros((E,), jnp.float32).at[topi[:, 0]].add(1.0) / N
+    aux = E * jnp.sum(me * ce)
+
+    from ..kernels.grouped_matmul import sorted_dispatch_plan
+    inv_flat, pos, tile_groups = sorted_dispatch_plan(
+        topi.reshape(N * k), E, block_m)
+    y = _grouped_ffn(xf, w_gate, w_up, w_down, topv, inv_flat, pos,
+                     tile_groups, E, k, block_m)
+    stats = jnp.stack([jnp.float32(1.0), ce.max() * jnp.float32(E)])
+    return y.reshape(B, S, H), aux, stats
+
+
 class LlamaMoEMLP(Layer):
     """Mixtral-style MoE FFN block (drop-in for LlamaMLP when
     config.moe_num_experts > 0).  Expert banks are single stacked
@@ -456,6 +584,10 @@ class LlamaMoEMLP(Layer):
                     xa, gw, wg, wu, wd, top_k=c.moe_top_k,
                     capacity_factor=c.moe_capacity_factor,
                     groups=c.moe_groups)
+            if c.moe_dispatch == "grouped":
+                return moe_mlp_forward_grouped(
+                    xa, gw, wg, wu, wd, top_k=c.moe_top_k,
+                    block_m=c.moe_block_m)
             return moe_mlp_forward(
                 xa, gw, wg, wu, wd, top_k=c.moe_top_k,
                 capacity_factor=c.moe_capacity_factor)
