@@ -42,7 +42,17 @@ def next_key():
         key = jax.random.fold_in(slot["key"], slot["counter"])
         slot["counter"] += 1
         return key
-    g.key, sub = jax.random.split(g.key)
+    new_key, sub = jax.random.split(g.key)
+    if isinstance(new_key, jax.core.Tracer):
+        # being traced WITHOUT a key scope (e.g. an op primitive using
+        # randomness under the eager op-jit cache): the split result is a
+        # tracer and must never be stored as the global root key — a
+        # leaked tracer poisons every later eager random op.  The root
+        # key stays put; the compiled program bakes this call's key, so
+        # per-call freshness requires a trace_key_scope (what to_static
+        # installs).
+        return sub
+    g.key = new_key
     return sub
 
 

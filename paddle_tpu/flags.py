@@ -74,6 +74,13 @@ define_flag("autotune_enable", True,
 define_flag("autotune_cache_path", "",
             "Override the on-disk autotune cache location "
             "(default ~/.cache/paddle_tpu/autotune.json).")
+define_flag("to_static_cache_size", 64,
+            "Max guard-cache entries per to_static function (LRU eviction;"
+            " <=0 = unbounded). Reference: the SOT guard-tree cache cap.")
+define_flag("eager_jit_cache_size", 4096,
+            "Max cached per-op jitted executables in the eager dispatch "
+            "seam (core/autograd _jit_cache/_vjp_cache; LRU; <=0 = "
+            "unbounded).")
 define_flag("use_native_dataloader", False,
             "Route DataLoader prefetch through the C++ ring-buffer engine "
             "(native/ringbuf.cc). Off by default: with in-process thread "
