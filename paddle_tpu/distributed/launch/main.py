@@ -1,6 +1,8 @@
 """paddle.distributed.launch analog (reference:
 python/paddle/distributed/launch/main.py:23; CollectiveController builds a
-pod of per-GPU processes with PADDLE_TRAINER_ID env — SURVEY.md §3.4 step 1).
+pod of per-GPU processes with PADDLE_TRAINER_ID env — SURVEY.md §3.4 step 1,
+plus the controllers' pod watcher: watch -> peer failure -> teardown ->
+relaunch, python/paddle/distributed/launch/controllers/collective.py).
 
 TPU-native process model: ONE controller process per *host* drives all local
 chips (jax SPMD), so on a single host the launcher simply runs the script.
@@ -8,6 +10,15 @@ Multi-host: one process per node, rendezvous via jax.distributed
 (coordinator = --master).  ``--nproc_per_node`` still spawns N processes for
 multi-process simulation/testing (each pinned to the CPU platform with
 virtual devices).
+
+Elastic failover (``--max_restarts``): the launcher WATCHES the pod; when a
+rank dies it tears the pod down (peers block on a dead peer forever — the
+watchdog's ``barrier_timeout`` lets trainers notice first and exit clean),
+then relaunches at the surviving world size (bounded below by
+``--min_procs``) with a fresh rendezvous port and
+``PADDLE_RESTART_ATTEMPT`` exported, resuming trainers from their own
+checkpoints — the loopback analog of the reference ElasticManager's
+etcd-membership relaunch (fleet/elastic/manager.py:125).
 """
 
 from __future__ import annotations
@@ -17,6 +28,7 @@ import os
 import runpy
 import subprocess
 import sys
+import time
 
 
 def _parse(argv):
@@ -31,9 +43,73 @@ def _parse(argv):
                         "managed by the TPU runtime")
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="pod relaunches after a rank failure (elastic "
+                        "failover; reference launch/controllers watcher)")
+    p.add_argument("--min_procs", type=int, default=1,
+                   help="lower bound on the relaunched world size")
+    p.add_argument("--grace_s", type=float, default=15.0,
+                   help="after a rank failure, how long surviving ranks "
+                        "get to notice (watchdog barrier_timeout), flush "
+                        "and exit before the pod is killed")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
+
+
+def _spawn_pod(nproc, master, args, attempt):
+    """Start one pod of ``nproc`` rank processes."""
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_MASTER": master,
+            "PADDLE_TRAINERS_NUM": str(nproc),
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_RESTART_ATTEMPT": str(attempt),
+            "JAX_PLATFORMS": "cpu",
+        })
+        log = open(os.path.join(args.log_dir,
+                                f"workerlog.{rank}.{attempt}"
+                                if attempt else f"workerlog.{rank}"), "w")
+        procs.append((rank, subprocess.Popen(
+            [sys.executable, args.script] + list(args.script_args),
+            env=env, stdout=log, stderr=subprocess.STDOUT), log))
+    return procs
+
+
+def _watch_pod(procs, grace_s=15.0, poll_s=0.2):
+    """Reference controllers' watch loop: block until the pod finishes or
+    any rank fails.  On failure, survivors get ``grace_s`` to detect the
+    dead peer themselves (watchdog ``barrier_timeout``), checkpoint and
+    exit, then stragglers are killed.  Returns the ranks that failed
+    FIRST (spontaneously) — they size the relaunched world."""
+    failed = []
+    try:
+        while True:
+            running = 0
+            for rank, p, _ in procs:
+                rc = p.poll()
+                if rc is None:
+                    running += 1
+                elif rc != 0 and rank not in failed:
+                    failed.append(rank)
+            if failed or running == 0:
+                break
+            time.sleep(poll_s)
+        if failed:
+            deadline = time.time() + grace_s
+            while time.time() < deadline and any(
+                    p.poll() is None for _, p, _ in procs):
+                time.sleep(poll_s)
+    finally:
+        for _, p, _ in procs:
+            if p.poll() is None:
+                p.kill()
+        for _, p, log in procs:
+            p.wait()
+            log.close()
+    return failed
 
 
 def launch(argv=None):
@@ -51,28 +127,27 @@ def launch(argv=None):
         return 0
 
     # multi-process simulation (the reference's process-per-device pod),
-    # used by collective tests without real multi-host
+    # used by collective/elastic tests without real multi-host
     os.makedirs(args.log_dir, exist_ok=True)
     master = args.master or "127.0.0.1:36718"
-    procs = []
-    for rank in range(args.nproc_per_node):
-        env = dict(os.environ)
-        env.update({
-            "PADDLE_MASTER": master,
-            "PADDLE_TRAINERS_NUM": str(args.nproc_per_node),
-            "PADDLE_TRAINER_ID": str(rank),
-            "JAX_PLATFORMS": "cpu",
-        })
-        log = open(os.path.join(args.log_dir,
-                                f"workerlog.{rank}"), "w")
-        procs.append((subprocess.Popen(
-            [sys.executable, args.script] + list(args.script_args),
-            env=env, stdout=log, stderr=subprocess.STDOUT), log))
-    code = 0
-    for p, log in procs:
-        code |= p.wait()
-        log.close()
-    return code
+    host, port = master.rsplit(":", 1)
+    nproc = args.nproc_per_node
+    for attempt in range(args.max_restarts + 1):
+        # fresh coordinator port per attempt: the dead pod's coordinator
+        # socket may linger in TIME_WAIT
+        procs = _spawn_pod(nproc, f"{host}:{int(port) + attempt}",
+                           args, attempt)
+        failed = _watch_pod(procs, grace_s=args.grace_s)
+        if not failed:
+            return 0
+        survivors = max(args.min_procs, nproc - len(failed))
+        print(f"[launch] rank(s) {failed} failed (attempt {attempt}, "
+              f"world {nproc}); "
+              + (f"relaunching with world {survivors}"
+                 if attempt < args.max_restarts else "giving up"),
+              file=sys.stderr, flush=True)
+        nproc = survivors
+    return 1
 
 
 if __name__ == "__main__":

@@ -122,8 +122,12 @@ class watch:
 
 
 def barrier_timeout(group=None, timeout_s: Optional[float] = None) -> bool:
-    """Barrier with deadline: True on success, False on timeout (the
-    peer-failure detection primitive; reference: store barrier + watchdog)."""
+    """Barrier with deadline: True on success, False on timeout OR on a
+    transport error (a dead peer surfaces either as silence or as a
+    connection-reset from the collective backend — both ARE the failure
+    being detected; reference: store barrier + watchdog async-error
+    channel).  The last transport error is kept on
+    ``barrier_timeout.last_error`` for diagnostics."""
     from .communication import barrier
 
     timeout_s = timeout_s or flags.flag("comm_timeout_s")
@@ -140,7 +144,13 @@ def barrier_timeout(group=None, timeout_s: Optional[float] = None) -> bool:
     t.start()
     t.join(timeout_s)
     if t.is_alive():
+        barrier_timeout.last_error = TimeoutError(
+            f"barrier exceeded {timeout_s}s")
         return False
     if "err" in result:
-        raise result["err"]
+        barrier_timeout.last_error = result["err"]
+        return False
     return True
+
+
+barrier_timeout.last_error = None

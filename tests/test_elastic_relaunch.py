@@ -1,0 +1,129 @@
+"""Failure-path e2e: rank death -> watchdog detection -> pod teardown ->
+relaunch at the surviving world size -> resume from the distributed
+checkpoint (VERDICT r4 item 7).
+
+Modules under test, together: ``distributed.watchdog.barrier_timeout``
+(peers detect the dead rank and exit clean within the launcher's grace
+window), ``distributed.launch`` (pod watcher + elastic failover relaunch
+— the loopback analog of the reference ElasticManager's etcd-membership
+relaunch, fleet/elastic/manager.py:125; the single-controller resize path
+of ``fleet.elastic.ElasticManager`` is covered by test_elastic.py), and
+``distributed.checkpoint`` (cross-topology resume: saved at world 3,
+restored at world 2)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+WORKER = textwrap.dedent("""
+    import json, os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.distributed import env as denv
+    denv.init_parallel_env()
+    import numpy as np
+    from paddle_tpu import flags
+    from paddle_tpu.distributed.watchdog import barrier_timeout
+    from paddle_tpu.distributed.checkpoint import (
+        load_state_dict, save_state_dict)
+
+    rank = jax.process_index()
+    world = jax.process_count()
+    attempt = int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0"))
+    out_dir = os.environ["TEST_OUT_DIR"]
+    latest = out_dir + "/LATEST"
+
+    state = {"step": np.zeros((), np.int32),
+             "w": np.zeros(4, np.float32)}
+    start_step = 0
+    if os.path.exists(latest):
+        with open(latest) as f:
+            ck = f.read().strip()
+        load_state_dict(state, ck)
+        start_step = int(state["step"])
+
+    TOTAL = 6
+    step = start_step
+    while step < TOTAL:
+        # the injected failure: rank dies BEFORE joining this step's
+        # barrier, so peers see it as a barrier timeout/reset
+        if attempt == 0 and rank == world - 1 and step == 3:
+            print(f"CRASH rank={rank} step={step}", flush=True)
+            os._exit(1)
+        # detection: a dead peer turns this barrier into a timeout (or a
+        # transport reset — both return False)
+        if not barrier_timeout(timeout_s=5):
+            print(f"PEER-LOST rank={rank} step={step}", flush=True)
+            os._exit(13)
+        try:
+            state["w"] = state["w"] + 1.0      # the "training"
+            state["step"] = np.asarray(step + 1, np.int32)
+            ck = out_dir + f"/ckpt_{step + 1}"
+            save_state_dict(state, ck)
+        except Exception as e:                 # peer died mid-collective
+            print(f"PEER-LOST rank={rank} step={step} "
+                  f"({type(e).__name__})", flush=True)
+            os._exit(13)
+        if rank == 0:
+            with open(latest + ".tmp", "w") as f:
+                f.write(ck)
+            os.replace(latest + ".tmp", latest)
+        step += 1
+
+    with open(out_dir + f"/result_rank{rank}.json", "w") as f:
+        json.dump({"rank": rank, "world": world, "attempt": attempt,
+                   "start_step": start_step, "end_step": step,
+                   "w": state["w"].tolist()}, f)
+    print(f"DONE rank={rank}", flush=True)
+""")
+
+
+@pytest.mark.timeout(600)
+def test_rank_death_relaunch_resume(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    port = 29200 + os.getpid() % 500
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["TEST_OUT_DIR"] = str(tmp_path)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)   # one device per process
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "3", "--master", f"127.0.0.1:{port}",
+         "--max_restarts", "1", "--min_procs", "2", "--grace_s", "30",
+         "--log_dir", str(tmp_path / "log"), str(script)],
+        env=env, capture_output=True, text=True, timeout=540, cwd=repo)
+
+    logs = ""
+    logdir = tmp_path / "log"
+    if logdir.exists():
+        for lp in sorted(logdir.iterdir()):
+            logs += f"--- {lp.name} ---\n{lp.read_text()[-1500:]}\n"
+    ctx = f"launcher rc={r.returncode}\nstderr:{r.stderr[-1500:]}\n{logs}"
+
+    # the launcher detected the death and relaunched at world 2
+    assert "relaunching with world 2" in r.stderr, ctx
+    assert r.returncode == 0, ctx
+    # the dead rank crashed spontaneously; survivors detected it through
+    # the watchdog barrier (not by being killed)
+    assert "CRASH rank=2 step=3" in logs, ctx
+    assert "PEER-LOST" in logs, ctx
+
+    import json
+    results = []
+    for i in (0, 1):
+        p = tmp_path / f"result_rank{i}.json"
+        assert p.exists(), ctx
+        results.append(json.loads(p.read_text()))
+    for res in results:
+        assert res["world"] == 2, ctx           # membership changed
+        assert res["attempt"] == 1, ctx         # ran in the relaunched pod
+        assert res["start_step"] == 3, ctx      # resumed from the ckpt,
+        assert res["end_step"] == 6, ctx        # not from scratch
+        # 6 increments total across both incarnations, none lost/repeated
+        assert res["w"] == [6.0, 6.0, 6.0, 6.0], ctx
+    assert not (tmp_path / "result_rank2.json").exists(), ctx
