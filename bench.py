@@ -159,6 +159,11 @@ def _run_decode(on_tpu):
     out = {}
     if on_tpu:
         _decode_page_sweep(model, cfg, rng, max_seq, prompt_len, out)
+        try:
+            _serving_mixed_ab(model, cfg, rng, out)
+        except Exception as e:
+            out["serving_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+            traceback.print_exc(file=sys.stderr)
     # headline runs on the product default path: page_size="auto" reads the
     # sweep's measured winner from the autotune cache (32 on a cold cache)
     for b, tag in ((batch, "decode_tok_per_sec"), (1, "decode_b1")):
@@ -190,13 +195,20 @@ def _run_decode(on_tpu):
     return out
 
 
-def _decode_page_sweep(model, cfg, rng, max_seq, prompt_len, out):
+def _decode_page_sweep(model, cfg, rng, max_seq, prompt_len, out,
+                       samples=3):
     """Measure ms/token per page size and record the winner in the autotune
     cache BEFORE the headline runs, so page_size="auto" benchmarks the
-    tuned configuration (the page IS the decode kernel's KV tile)."""
+    tuned configuration (the page IS the decode kernel's KV tile).
+
+    Median of ``samples`` repeats after a discarded compile+warmup run:
+    the r04 sweep took ONE sample per page size and produced a
+    non-monotonic curve whose "winner" could be timer noise (VERDICT r4
+    weak #4); the per-sample spread is recorded alongside the medians so
+    the choice is auditable."""
     from paddle_tpu.inference import GenerationConfig, LlamaGenerator
     from paddle_tpu.kernels import autotune
-    sweep = {}
+    sweep, spread = {}, {}
     for psz in (16, 32, 64, 128):
         try:
             # sweep at the throughput headline's batch so the recorded
@@ -206,15 +218,20 @@ def _decode_page_sweep(model, cfg, rng, max_seq, prompt_len, out):
             prompts = [list(rng.integers(1, cfg.vocab_size, prompt_len))
                        for _ in range(16)]
             gen.generate(prompts, GenerationConfig(max_new_tokens=64))
-            # same short/full diff as the headline: the (page-size-
-            # independent) prefill cost cancels out of the per-token rate
-            t0 = time.perf_counter()
-            gen.generate(prompts, GenerationConfig(max_new_tokens=8))
-            t_short = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            gen.generate(prompts, GenerationConfig(max_new_tokens=64))
-            t_full = time.perf_counter() - t0
-            sweep[psz] = round((t_full - t_short) / (64 - 8) * 1e3, 3)
+            vals = []
+            for _ in range(samples):
+                # short/full diff: the (page-size-independent) prefill
+                # cost cancels out of the per-token rate
+                t0 = time.perf_counter()
+                gen.generate(prompts, GenerationConfig(max_new_tokens=8))
+                t_short = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                gen.generate(prompts, GenerationConfig(max_new_tokens=64))
+                t_full = time.perf_counter() - t0
+                vals.append((t_full - t_short) / (64 - 8) * 1e3)
+            vals.sort()
+            sweep[psz] = round(vals[len(vals) // 2], 3)
+            spread[psz] = [round(v, 3) for v in vals]
             del gen
         except Exception:
             continue
@@ -226,7 +243,66 @@ def _decode_page_sweep(model, cfg, rng, max_seq, prompt_len, out):
                               d=cfg.head_dim, dt=str(cfg.dtype)),
             [best], measurements=sweep)
         out["decode_page_sweep_ms"] = sweep
+        out["decode_page_sweep_samples"] = spread
         out["decode_best_page"] = best
+
+
+def _serving_mixed_ab(model, cfg, rng, out, n_requests=32, slots=16):
+    """Mixed-length serving A/B (VERDICT r4 item 8): the continuous-
+    batching engine admits/evicts per step over the paged KV, the static
+    baseline decodes fixed batches until each batch's longest request
+    finishes.  Same requests, same weights; tokens/s = generated tokens
+    over wall time."""
+    from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                      GenerationConfig, LlamaGenerator)
+
+    max_seq = 768
+    prompts = [list(rng.integers(1, cfg.vocab_size,
+                                 int(rng.integers(32, 257))))
+               for _ in range(n_requests)]
+    budgets = [int(rng.integers(16, 129)) for _ in range(n_requests)]
+
+    # continuous batching.  Warmup = throwaway requests driven to
+    # completion (compiles prefill+decode); the timed region then holds
+    # the real requests END TO END — admissions/prefills inside the
+    # clock, exactly like the static arm's timed region.
+    eng = ContinuousBatchingEngine(
+        model, max_batch=slots, gen=GenerationConfig(max_new_tokens=128),
+        max_seq_len=max_seq, page_size="auto")
+    for p in prompts[:2]:
+        eng.add_request(p, max_new_tokens=4)
+    eng.run()
+    rids = [eng.add_request(p, max_new_tokens=b)
+            for p, b in zip(prompts, budgets)]
+    t0 = time.perf_counter()
+    results = eng.run()
+    dt_cb = time.perf_counter() - t0
+    cb_tokens = sum(len(results[r]) for r in rids)
+    del eng
+
+    # static batches: everyone in a batch decodes until its longest budget
+    gen = LlamaGenerator(model, max_batch=slots, max_seq_len=max_seq,
+                         page_size="auto")
+    batches = [list(range(i, min(i + slots, n_requests)))
+               for i in range(0, n_requests, slots)]
+    gen.generate([prompts[i] for i in batches[0]],
+                 GenerationConfig(max_new_tokens=8))   # compile
+    t0 = time.perf_counter()
+    static_tokens = 0
+    for idx in batches:
+        longest = max(budgets[i] for i in idx)
+        outs = gen.generate([prompts[i] for i in idx],
+                            GenerationConfig(max_new_tokens=longest))
+        static_tokens += sum(min(len(o), budgets[i])
+                             for i, o in zip(idx, outs))
+    dt_static = time.perf_counter() - t0
+    del gen
+
+    out["serving_cb_tok_per_sec"] = round(cb_tokens / dt_cb, 1)
+    out["serving_static_tok_per_sec"] = round(static_tokens / dt_static, 1)
+    out["serving_cb_speedup"] = round(
+        (cb_tokens / dt_cb) / max(static_tokens / dt_static, 1e-9), 3)
+    out["serving_requests"] = n_requests
 
 
 def _run_moe(on_tpu):
@@ -239,11 +315,14 @@ def _run_moe(on_tpu):
     from paddle_tpu.models.pretrain import ParallelConfig, PretrainStep
 
     if on_tpu:
+        # headline = grouped dispatch (ragged expert GEMM, no capacity
+        # padding — VERDICT r4 item 2); gather/einsum measured as A/Bs
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
                           intermediate_size=2816, num_hidden_layers=12,
                           num_attention_heads=16, num_key_value_heads=16,
                           max_position_embeddings=2048, dtype="bfloat16",
-                          moe_num_experts=8, moe_top_k=2)
+                          moe_num_experts=8, moe_top_k=2,
+                          moe_dispatch="grouped")
         batch, seq, steps = 8, 2048, 8
     else:
         cfg = LlamaConfig.mixtral_tiny()
@@ -251,21 +330,36 @@ def _run_moe(on_tpu):
 
     pc = ParallelConfig(remat=on_tpu, loss_chunks=16 if on_tpu else 1,
                         m_dtype="bfloat16" if on_tpu else "float32")
-    ps = PretrainStep(cfg, pc)
-    state = ps.init_state(seed=0)
     rng = np.random.default_rng(0)
-    ids, labels = ps.shard_batch(
-        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
-        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
-    state, loss = ps.train_step(state, ids, labels)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, loss = ps.train_step(state, ids, labels)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    tok_per_sec = batch * seq * steps / dt
     peak = _peak_flops(jax.devices()[0])
+
+    def measure(c):
+        ps = PretrainStep(c, pc)
+        state = ps.init_state(seed=0)
+        ids, labels = ps.shard_batch(
+            rng.integers(0, c.vocab_size, (batch, seq)).astype(np.int32),
+            rng.integers(0, c.vocab_size, (batch, seq)).astype(np.int32))
+        state, loss = ps.train_step(state, ids, labels)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = ps.train_step(state, ids, labels)
+        jax.block_until_ready(loss)
+        tps = batch * seq * steps / (time.perf_counter() - t0)
+        return ps, state, ids, loss, tps
+
+    import dataclasses
+    headline_note = None
+    try:
+        ps, state, ids, loss, tok_per_sec = measure(cfg)
+    except Exception as e:  # grouped kernel unavailable: degrade, record
+        if cfg.moe_dispatch == "gather":
+            raise
+        headline_note = (f"{cfg.moe_dispatch} failed "
+                         f"({type(e).__name__}: {str(e)[:120]}); "
+                         "gather fallback")
+        cfg = dataclasses.replace(cfg, moe_dispatch="gather")
+        ps, state, ids, loss, tok_per_sec = measure(cfg)
     stats = ps.router_stats(state, ids)
     out = {
         "moe_tok_per_sec": round(tok_per_sec, 1),
@@ -274,30 +368,31 @@ def _run_moe(on_tpu):
         "moe_active_params": cfg.num_active_params(),
         "moe_loss": round(float(loss), 4),
         # expert load balance (BASELINE config 5): fraction of routed
-        # tokens that fit capacity + busiest-expert share vs uniform
+        # tokens that fit capacity (grouped dispatch drops nothing -> 1.0)
+        # + busiest-expert share vs uniform
         "moe_kept_frac": round(stats["kept_frac"], 4),
         "moe_imbalance": round(stats["imbalance"], 4),
         "moe_dispatch": cfg.moe_dispatch,
     }
+    if headline_note:
+        out["moe_headline_note"] = headline_note
     if on_tpu:
-        # measure the alternate dispatch formulation (einsum: one-hot
-        # matmul dispatch, no scatters in either direction) so the better
-        # of the two is an evidence-backed default choice
+        # A/B the capacity-dispatch formulations so the grouped default
+        # stays an evidence-backed choice (skip whatever the headline
+        # already measured, e.g. gather after a grouped fallback)
         del ps, state
-        import dataclasses
-        cfg2 = dataclasses.replace(cfg, moe_dispatch="einsum")
-        ps2 = PretrainStep(cfg2, pc)
-        st2 = ps2.init_state(seed=0)
-        st2, l2 = ps2.train_step(st2, ids, labels)
-        jax.block_until_ready(l2)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            st2, l2 = ps2.train_step(st2, ids, labels)
-        jax.block_until_ready(l2)
-        tps2 = batch * seq * steps / (time.perf_counter() - t0)
-        out["moe_einsum_tok_per_sec"] = round(tps2, 1)
-        out["moe_einsum_mfu"] = round(
-            tps2 * ps2.flops_per_token(False) / peak, 4)
+        for alt in ("gather", "einsum"):
+            if alt == cfg.moe_dispatch:
+                continue
+            try:
+                cfg2 = dataclasses.replace(cfg, moe_dispatch=alt)
+                ps2, st2, _, _, tps2 = measure(cfg2)
+                out[f"moe_{alt}_tok_per_sec"] = round(tps2, 1)
+                out[f"moe_{alt}_mfu"] = round(
+                    tps2 * ps2.flops_per_token(False) / peak, 4)
+                del ps2, st2
+            except Exception as e:
+                out[f"moe_{alt}_error"] = f"{type(e).__name__}: {str(e)[:120]}"
     return out
 
 
@@ -549,6 +644,12 @@ def _child_main():
                         f"{type(e).__name__}: {str(e)[:150]}")
                     traceback.print_exc(file=sys.stderr)
                 print(json.dumps(result), flush=True)
+            # explicit completion marker: the parent accepts on this, not
+            # on rc — a child that prints everything and then hangs in
+            # PJRT teardown until the timeout kill (observed mode) still
+            # counts as a COMPLETE run
+            result["complete"] = True
+            print(json.dumps(result), flush=True)
             return 0
         except Exception as e:  # OOM or anything else: degrade, never die
             errors.append(f"rung {i}: {type(e).__name__}: {str(e)[:200]}")
@@ -636,7 +737,10 @@ def _parent_main():
         for i in range(2):
             rc, out, err = _spawn(["--child"], probe_env, tmo)
             result = _extract_json(out)
-            if result is not None and rc == 0:
+            # accept on the child's completion marker; rc is diagnostic
+            # only (a complete child may be timeout-killed in teardown)
+            if result is not None and (result.pop("complete", False)
+                                       or rc == 0):
                 if diag:
                     result["bench_diag"] = "; ".join(diag)[:1000]
                 print(json.dumps(result))
@@ -663,8 +767,8 @@ def _parent_main():
         rc, out, err = _spawn(["--child"], env, 1500)
         result = _extract_json(out)
         if result is not None:
-            if rc != 0:   # salvaged from a killed child: mark it
-                result["bench_partial"] = (
+            if not result.pop("complete", False) and rc != 0:
+                result["bench_partial"] = (   # salvaged from a killed child
                     f"child rc={rc}; last complete measurement kept")
             result["bench_diag"] = ("tpu-unavailable, cpu fallback; " +
                                     "; ".join(diag))[:1000]

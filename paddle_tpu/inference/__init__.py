@@ -19,12 +19,14 @@ from typing import Dict, List, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from .generation import GenerationConfig, LlamaGenerator, generate
+from .generation import (ContinuousBatchingEngine, GenerationConfig,
+                         LlamaGenerator, Request, generate)
 from .kv_cache import PagedKVCache, PageAllocator
 
 __all__ = [
     "Config", "Predictor", "create_predictor", "PredictorTensor",
     "GenerationConfig", "LlamaGenerator", "generate",
+    "ContinuousBatchingEngine", "Request",
     "PagedKVCache", "PageAllocator",
 ]
 
