@@ -1,0 +1,167 @@
+"""Multi-slice MPMD pipeline — host-driven multi-executable 1F1B.
+
+Reference mechanism: FleetExecutor's carrier/interceptor runtime
+(paddle/fluid/distributed/fleet_executor/carrier.h) — one executable per
+pipeline stage, a host-side scheduler driving them, and explicit
+point-to-point sends between stages.
+
+Why this exists next to ``pipeline_spmd`` (SURVEY §7.4.2): the SPMD
+pipeline is one collective program over a 'pp' mesh axis — ideal when all
+stages share one ICI domain (a single TPU slice), because stage hops ride
+``ppermute`` at ICI bandwidth.  Across SLICES there is no shared XLA
+program: each slice is its own jax backend/mesh, transfers cross DCN, and
+the pipeline must become what the reference always was — separate
+executables + explicit transfers + a host schedule.  This module is that
+shape:
+
+- every stage is jitted ONCE onto its own ``Mesh`` (its slice's devices;
+  within a stage, other axes — dp/mp — stay GSPMD-partitioned);
+- stage boundaries move with ``jax.device_put`` to the next stage's
+  sharding (on real hardware this is the DCN transfer; jax overlaps it
+  with compute because dispatch is async);
+- the host runs a 1F1B schedule: dispatch order warmup-forwards then
+  alternating 1f/1b, with per-stage gradient accumulation over
+  microbatches.  Backward recomputes the stage forward under ``jax.vjp``
+  inside the jitted grad executable (recompute-from-boundary, the same
+  memory policy as pipeline_spmd's 1f1b).
+
+This is the design spike VERDICT r4 item 9 asked for; MIGRATION.md
+documents the measured single-slice comparison and when each formulation
+wins.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def slice_meshes(n_slices: int, devices: Optional[Sequence] = None,
+                 axis_names=("dp",)) -> List[Mesh]:
+    """Partition the device set into ``n_slices`` equal Meshes (one per
+    virtual slice).  On multi-slice hardware, group by ``d.slice_index``
+    instead; on the CPU test mesh, contiguous blocks stand in for slices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) % n_slices:
+        raise ValueError(f"{len(devices)} devices not divisible into "
+                         f"{n_slices} slices")
+    import numpy as np
+    per = len(devices) // n_slices
+    return [Mesh(np.asarray(devices[i * per:(i + 1) * per]), axis_names)
+            for i in range(n_slices)]
+
+
+class MpmdPipeline:
+    """Host-driven 1F1B over per-slice stage executables.
+
+    Args:
+      meshes: one Mesh per stage (stage i runs on meshes[i]).
+      stage_fn: ``(params, x) -> y`` — a stage's forward (pure jax).
+      loss_fn: ``(y_last, labels) -> scalar`` — applied after the last
+        stage; its gradient seeds the backward wave.
+      stage_params: list of per-stage params pytrees (host or device).
+      batch_spec: PartitionSpec for activations within a stage's mesh
+        (default: batch over 'dp').
+    """
+
+    def __init__(self, meshes: Sequence[Mesh], stage_fn: Callable,
+                 loss_fn: Callable, stage_params: Sequence[Any],
+                 batch_spec: P = P("dp")):
+        if len(meshes) != len(stage_params):
+            raise ValueError("one mesh per stage required")
+        self.meshes = list(meshes)
+        self.S = len(meshes)
+        self.batch_spec = batch_spec
+        # pin each stage's params onto its slice (replicated within)
+        self.params = [
+            jax.device_put(p, NamedSharding(m, P()))
+            for p, m in zip(stage_params, meshes)]
+        self._shardings = [NamedSharding(m, batch_spec) for m in meshes]
+
+        def fwd(params, x):
+            return stage_fn(params, x)
+
+        def last_grad(params, x, labels):
+            def f(p, xi):
+                return loss_fn(stage_fn(p, xi), labels)
+            loss, vjp = jax.vjp(f, params, x)
+            dp, dx = vjp(jnp.ones_like(loss))
+            return loss, dp, dx
+
+        def mid_grad(params, x, ct):
+            _, vjp = jax.vjp(stage_fn, params, x)
+            dp, dx = vjp(ct)
+            return dp, dx
+
+        # one executable per (stage, role): the carrier's interpreters
+        self._fwd = [jax.jit(fwd) for _ in meshes]
+        self._last_grad = jax.jit(last_grad)
+        self._mid_grad = [jax.jit(mid_grad) for _ in meshes]
+
+    def _to_stage(self, x, s):
+        """The inter-stage transfer (DCN p2p on real multi-slice)."""
+        return jax.device_put(x, self._shardings[s])
+
+    def train_step(self, batch, labels, micro_batches: int):
+        """One 1F1B step: returns (mean loss, per-stage grads averaged
+        over microbatches)."""
+        B = batch.shape[0]
+        if B % micro_batches:
+            raise ValueError(f"batch {B} % micro_batches {micro_batches}")
+        mbs = batch.reshape((micro_batches, B // micro_batches)
+                            + batch.shape[1:])
+        lbs = labels.reshape((micro_batches, B // micro_batches)
+                             + labels.shape[1:])
+        S, M = self.S, micro_batches
+
+        # in-flight forward activations per microbatch: [stage] -> x input
+        inputs: List[List[Any]] = [[None] * S for _ in range(M)]
+        losses, grads = [], [None] * S
+
+        def run_fwd_through(m, upto):
+            """Advance microbatch m's forward wave through stage ``upto``."""
+            x = self._to_stage(mbs[m], 0) if inputs[m][0] is None \
+                else inputs[m][0]
+            inputs[m][0] = x
+            for s in range(upto + 1):
+                if s == S - 1:
+                    continue                 # last stage runs inside grad
+                if s + 1 < S and inputs[m][s + 1] is None:
+                    y = self._fwd[s](self.params[s], inputs[m][s])
+                    inputs[m][s + 1] = self._to_stage(y, s + 1)
+
+        def accum(s, dp):
+            grads[s] = dp if grads[s] is None else jax.tree.map(
+                jnp.add, grads[s], dp)
+
+        def run_bwd(m):
+            """Full backward wave for microbatch m (dispatches are async;
+            the host just orders them)."""
+            labels_s = self._to_stage(lbs[m], S - 1)
+            loss, dp, ct = self._last_grad(
+                self.params[S - 1], inputs[m][S - 1], labels_s)
+            losses.append(loss)
+            accum(S - 1, dp)
+            for s in range(S - 2, -1, -1):
+                ct = self._to_stage(ct, s)
+                dp, ct = self._mid_grad[s](self.params[s], inputs[m][s], ct)
+                accum(s, dp)
+            inputs[m] = [None] * S           # free the boundary residuals
+
+        # ---- 1F1B: warmup S-1 forwards, then 1f/1b steady state ----
+        warm = min(S - 1, M)
+        for m in range(warm):
+            run_fwd_through(m, S - 1)
+        for m in range(M):
+            fwd_m = m + warm
+            if fwd_m < M:
+                run_fwd_through(fwd_m, S - 1)   # 1 forward
+            run_bwd(m)                          # 1 backward
+        mean = functools.partial(jax.tree.map, lambda g: g / M)
+        return jnp.mean(jnp.stack(
+            [jax.device_put(l, self._shardings[0].mesh.devices.flat[0])
+             for l in losses])), [mean(g) for g in grads]
