@@ -164,9 +164,10 @@ def tgmm(lhs, rhs, tile_groups, num_groups, *, bm=512, bn=512, bk=512,
     ``out[e] = sum over e's rows of lhs[m, :]^T @ rhs[m, :]``.
 
     lhs: [M, K]; rhs: [M, N]; both row-grouped as in gmm.
-    Every group id in [0, num_groups) MUST own at least one tile (the MoE
-    dispatch pads each expert to >=1 tile), otherwise its output block is
-    left unwritten.  Returns [E, K, N] in lhs.dtype.
+    A group owning zero tiles gets an explicitly zeroed output block (the
+    kernel only writes blocks it visits; the mask below covers truncated
+    dispatch plans where a tail expert's span was cut).  Returns
+    [E, K, N] in lhs.dtype.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -195,7 +196,7 @@ def tgmm(lhs, rhs, tile_groups, num_groups, *, bm=512, bn=512, bk=512,
         scratch_shapes=[pltpu.VMEM((bk, bn), jnp.float32)],
     )
     kernel = functools.partial(_tgmm_kernel, nm=nm)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_groups, K, N), lhs.dtype),
@@ -203,6 +204,8 @@ def tgmm(lhs, rhs, tile_groups, num_groups, *, bm=512, bn=512, bk=512,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=(mode == "interpret"),
     )(tile_groups.astype(jnp.int32), lhs, rhs)
+    visited = jnp.zeros((num_groups,), bool).at[tile_groups].set(True)
+    return jnp.where(visited[:, None, None], out, 0)
 
 
 # ------------------------------------------------- XLA reference (CPU) ---
@@ -224,7 +227,11 @@ def _gmm_reference(lhs, rhs, tile_groups, *, bm, trans_rhs=False):
         return acc + part.astype(acc.dtype), None
 
     O = rhs.shape[1] if trans_rhs else rhs.shape[2]
-    acc = jnp.zeros((M, O), jnp.float32)
+    # seed the carry from the operands so it inherits their varying manual
+    # axes under shard_map (a plain zeros carry trips the scan vma check)
+    seed = (lhs.ravel()[0] * 0).astype(jnp.float32) + \
+        (rhs.ravel()[0] * 0).astype(jnp.float32)
+    acc = jnp.zeros((M, O), jnp.float32) + seed
     acc, _ = jax.lax.scan(step, acc, jnp.arange(rhs.shape[0]))
     return acc.astype(lhs.dtype)
 

@@ -553,6 +553,103 @@ def moe_mlp_forward_grouped(x, gate_w, w_gate, w_up, w_down, *, top_k,
     return y.reshape(B, S, H), aux, stats
 
 
+def moe_mlp_forward_grouped_sharded(x, gate_w, w_gate, w_up, w_down, *,
+                                    mesh, top_k, block_m=512,
+                                    capacity_factor=1.5,
+                                    axes=("dp", "ep", "mp")):
+    """Grouped-GEMM MoE under an explicit dp x ep x mp mesh (shard_map).
+
+    Key structural fact: activations are REPLICATED over 'ep' (they shard
+    over dp only), so expert parallelism needs no all-to-all transport —
+    every ep shard recomputes the (cheap) router identically, packs only
+    the (token, choice) pairs owned by ITS expert bank through the ragged
+    grouped GEMM, and one ``psum`` over (ep, mp) combines the partial
+    outputs (mp is partial from the down-projection's sharded
+    contraction).  The reference reaches the same routing with
+    global_scatter/global_gather alltoalls (moe_layer.py:263); on a TPU
+    mesh the replicated-activation form trades those two collectives for
+    one psum.
+
+    Per-shard compute is bounded by ``capacity_factor``: the packed
+    buffer holds ~ k*N*cf/ep rows, overflow drops exactly like the
+    capacity formulations (kept_frac in stats reports it).  Weight specs:
+    w_gate/w_up P(ep, None, mp), w_down P(ep, mp, None), gate P().
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..kernels.grouped_matmul import sorted_dispatch_plan
+
+    dp_axis, ep_axis, mp_axis = axes
+    B, S, H = x.shape
+    E = gate_w.shape[-1]
+    ep = mesh.shape[ep_axis]
+    E_loc = E // ep
+    k = top_k
+    N_loc = (B // mesh.shape[dp_axis]) * S
+    bm = block_m
+    # static per-shard row budget (+ per-expert alignment slack)
+    m_cap = -(-int(N_loc * k * capacity_factor / ep) // bm) * bm \
+        + E_loc * bm
+
+    def local(xb, gw, wg, wu, wd):
+        b, s, h = xb.shape
+        n = b * s
+        xf = xb.reshape(n, h)
+        # the router runs on the PRISTINE values (vma tracked by jax's own
+        # primitives, so gw's dp-psum transpose is automatic); the custom-
+        # vjp FFN gets explicitly pvary'd operands instead — shard_map AD
+        # cannot see inside a custom vjp, and the pvary transpose is what
+        # emits the replicated axes' psums on dx / dw
+        logits = xf.astype(jnp.float32) @ gw.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, k)
+        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+        me = probs.mean(axis=0)
+        ce = jnp.zeros((E,), jnp.float32).at[topi[:, 0]].add(1.0) / n
+        aux = jax.lax.pmean(E * jnp.sum(me * ce), dp_axis)
+
+        my = jax.lax.axis_index(ep_axis)
+        own = (topi // E_loc) == my                      # [n, k]
+        # foreign choices route to a trailing discard group so they sort
+        # LAST; owned groups pack first and survive the truncation
+        local_e = jnp.where(own, topi % E_loc, E_loc).reshape(n * k)
+        inv, pos, tg = sorted_dispatch_plan(local_e, E_loc + 1, bm)
+        M_loc = min(m_cap, inv.shape[0])
+        # discard rows (and owned overflow beyond M_loc) become zero rows
+        # with zero gates: they contribute nothing in either direction
+        own_flat = own.reshape(n * k)
+        inv_t = jnp.where(
+            (inv < n * k)
+            & jnp.take(own_flat, jnp.minimum(inv, n * k - 1)),
+            inv, n * k)[:M_loc]
+        keep = (pos < M_loc) & own_flat
+        gates = topv * keep.reshape(n, k)
+        pos_t = jnp.minimum(pos, M_loc - 1)
+        tg_t = jnp.minimum(tg[:M_loc // bm], E_loc - 1)
+        xf_v = jax.lax.pvary(xf, (ep_axis, mp_axis))  # x replicated there
+        wg_v, wu_v, wd_v = (jax.lax.pvary(t, (dp_axis,))
+                            for t in (wg, wu, wd))    # weights: over dp
+        gates_v = jax.lax.pvary(gates, (mp_axis,))  # ep-varying already
+        y = _grouped_ffn(xf_v, wg_v, wu_v, wd_v, gates_v, inv_t, pos_t,
+                         tg_t, E_loc, k, bm)
+        y = jax.lax.psum(y, (ep_axis, mp_axis))
+        kept = jax.lax.pmean(
+            jax.lax.psum(keep.sum(), ep_axis) / jnp.float32(k * n),
+            dp_axis)
+        stats = jnp.stack([kept.astype(jnp.float32),
+                           jax.lax.pmean(ce.max(), dp_axis)
+                           * jnp.float32(E)])
+        return y.reshape(b, s, h), aux, stats
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(dp_axis, None, None), P(),
+                  P(ep_axis, None, mp_axis), P(ep_axis, None, mp_axis),
+                  P(ep_axis, mp_axis, None)),
+        out_specs=(P(dp_axis, None, None), P(), P()),
+    )(x, gate_w, w_gate, w_up, w_down)
+
+
 class LlamaMoEMLP(Layer):
     """Mixtral-style MoE FFN block (drop-in for LlamaMLP when
     config.moe_num_experts > 0).  Expert banks are single stacked
@@ -574,11 +671,19 @@ class LlamaMoEMLP(Layer):
             [E, I, H], default_initializer=init_i)
         self._last_aux = None
         self._last_stats = None
+        # set by PretrainStep when dispatch='grouped' runs on a >1-device
+        # dp x ep x mp mesh: routes through the shard_map formulation
+        self._grouped_mesh = None
 
     def forward(self, x):
         c = self.config
 
         def prim(xa, gw, wg, wu, wd):
+            if c.moe_dispatch == "grouped" and self._grouped_mesh is not None:
+                return moe_mlp_forward_grouped_sharded(
+                    xa, gw, wg, wu, wd, mesh=self._grouped_mesh,
+                    top_k=c.moe_top_k, block_m=c.moe_block_m,
+                    capacity_factor=c.moe_capacity_factor)
             if c.moe_dispatch == "einsum":
                 return moe_mlp_forward_einsum(
                     xa, gw, wg, wu, wd, top_k=c.moe_top_k,

@@ -196,6 +196,11 @@ class PretrainStep:
                 f"({config.num_hidden_layers})")
         # one template layer provides the block math for every (stage, layer)
         self._template = LlamaDecoderLayer(config)
+        if self._moe and config.moe_dispatch == "grouped" and \
+                (self.pc.dp > 1 or self.pc.ep > 1 or self.pc.mp > 1):
+            # multi-device grouped MoE runs the shard_map formulation
+            # (replicated-router + ragged local GEMM + one psum)
+            self._template.mlp._grouped_mesh = self.mesh
         self._jit_step = None
 
     # ---- parameter init & sharding ----
