@@ -53,6 +53,29 @@ class IrProgram:
     def num_ops(self) -> int:
         return len(self.jaxpr.eqns)
 
+    def typed_ops(self) -> List[Dict[str, Any]]:
+        """Per-operation record with output shapes/dtypes (the
+        pir::Operation result-type walk): [{name, outputs: [(shape,
+        dtype), ...], params}]."""
+        out = []
+        for eqn in self.jaxpr.eqns:
+            outs = [(tuple(v.aval.shape), str(v.aval.dtype))
+                    for v in eqn.outvars]
+            out.append({"name": eqn.primitive.name, "outputs": outs,
+                        "params": dict(eqn.params)})
+        return out
+
+    def cost_analysis(self) -> Dict[str, float]:
+        """XLA's compiled cost model for the program (flops, bytes
+        accessed, ...) — the analysis the reference exposes through its
+        cost-model passes, answered by the real compiler."""
+        compiled = jax.jit(self.__call__).lower(
+            *self._example_args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):       # one entry per device program
+            ca = ca[0] if ca else {}
+        return dict(ca or {})
+
     def __str__(self):
         return str(self._closed)
 
@@ -124,6 +147,51 @@ class IrProgram:
         new_jaxpr = jaxpr.replace(eqns=new_eqns, constvars=new_constvars)
         closed = jax.extend.core.ClosedJaxpr(
             new_jaxpr, [known[v] for v in new_constvars])
+        return IrProgram(closed, self._example_args)
+
+    def cse(self) -> "IrProgram":
+        """Common-subexpression elimination (reference
+        common_subexpression_elimination_pass): equations with identical
+        (primitive, params, inputs) collapse to the first occurrence.
+        Effectful equations never merge (order/observability), matching
+        the reference pass's side-effect bail-out."""
+        jaxpr = self.jaxpr
+        Literal = jax.extend.core.Literal
+
+        def key_of(eqn, rep):
+            ins = []
+            for v in eqn.invars:
+                if isinstance(v, Literal):
+                    val = v.val
+                    ins.append(("lit", str(val.dtype) if hasattr(val, "dtype")
+                                else type(val).__name__, repr(val)))
+                else:
+                    ins.append(("var", id(rep.get(v, v))))
+            try:
+                params = repr(sorted(eqn.params.items()))
+            except Exception:
+                return None                   # unhashable params: skip
+            return (eqn.primitive.name, params, tuple(ins))
+
+        rep: Dict[Any, Any] = {}              # var -> canonical var
+        seen: Dict[Any, Any] = {}             # key -> canonical eqn
+        new_eqns = []
+        for eqn in jaxpr.eqns:
+            key = None if eqn.effects else key_of(eqn, rep)
+            if key is not None and key in seen:
+                for mine, canon in zip(eqn.outvars, seen[key].outvars):
+                    rep[mine] = rep.get(canon, canon)
+                continue
+            if key is not None:
+                seen[key] = eqn
+            new_invars = [rep.get(v, v) if not isinstance(v, Literal)
+                          else v for v in eqn.invars]
+            eqn = eqn.replace(invars=new_invars)
+            new_eqns.append(eqn)
+        new_outvars = [rep.get(v, v) if not isinstance(v, Literal) else v
+                       for v in jaxpr.outvars]
+        new_jaxpr = jaxpr.replace(eqns=new_eqns, outvars=new_outvars)
+        closed = jax.extend.core.ClosedJaxpr(new_jaxpr, self._closed.consts)
         return IrProgram(closed, self._example_args)
 
     def replace_op(self, prim_name: str,

@@ -2,6 +2,7 @@
 paddle/fluid/pir/transforms dead_code_elimination_pass /
 constant_folding_pass; substituted by jaxpr+StableHLO per SURVEY §7.1)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -101,3 +102,42 @@ def test_tensor_inputs_accepted():
     prog = ir.trace(lambda a: a * 2.0, xt)
     out = prog(xt)
     np.testing.assert_allclose(np.asarray(out), 2 * np.ones((2, 2)))
+
+
+def test_cse_merges_duplicate_subexpressions():
+    def fn(a):
+        x = jnp.sin(a) * 2.0
+        y = jnp.sin(a) * 2.0      # identical subexpression
+        return x + y
+
+    prog = ir.trace(fn, np.ones(4, np.float32))
+    optimized = prog.cse()
+    assert optimized.op_histogram().get("sin", 0) == 1
+    assert optimized.num_ops() < prog.num_ops()
+    x = np.random.default_rng(0).standard_normal(4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(optimized(x)),
+                               np.asarray(prog(x)), rtol=1e-6)
+
+
+def test_cse_never_merges_effects():
+    def fn(a):
+        jax.debug.print("a={x}", x=a.sum())
+        jax.debug.print("a={x}", x=a.sum())
+        return a + 1
+
+    prog = ir.trace(fn, np.ones(2, np.float32))
+    n_prints = prog.op_histogram().get("debug_callback", 0)
+    assert prog.cse().op_histogram().get("debug_callback", 0) == n_prints
+
+
+def test_typed_ops_and_cost_analysis():
+    prog = ir.trace(lambda a, b: jnp.tanh(a @ b),
+                    np.ones((8, 16), np.float32),
+                    np.ones((16, 4), np.float32))
+    rec = prog.typed_ops()
+    names = [r["name"] for r in rec]
+    assert "dot_general" in names and "tanh" in names
+    dot = rec[names.index("dot_general")]
+    assert dot["outputs"][0] == ((8, 4), "float32")
+    cost = prog.cost_analysis()
+    assert cost.get("flops", 0) > 0
