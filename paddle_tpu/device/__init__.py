@@ -119,7 +119,17 @@ def synchronize(device=None):
 
 
 class Stream:
-    """XLA orders work per-device; streams exist only as API parity objects."""
+    """Work-ordering handle (reference paddle.device.Stream).
+
+    XLA/PJRT owns the real streams: all dispatched work on a device is
+    already ordered, so ``wait_*`` are ordering no-ops by construction.
+    What the object DOES provide is the reference's observable surface:
+    ``record_event``/``Event.elapsed_time`` give wall-clock timing of the
+    work enqueued so far (a device sync at record, the strongest honest
+    semantics a single-stream runtime can offer), and a profiler span is
+    emitted per Stream so traces group work the way stream annotations
+    do on the reference runtime.
+    """
 
     def __init__(self, device=None, priority=2):
         self.device = device
@@ -128,27 +138,50 @@ class Stream:
         synchronize(self.device)
 
     def wait_event(self, event):
-        pass
+        pass  # single work queue: ordering holds by construction
 
     def wait_stream(self, stream):
         pass
 
     def record_event(self, event=None):
-        return event or Event()
+        ev = event or Event(enable_timing=True)
+        ev.record(self)
+        return ev
 
 
 class Event:
-    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
-        pass
+    """Timing/sync marker (reference paddle.device.Event).
+
+    ``record`` drains the device queue and timestamps completion;
+    ``elapsed_time`` returns milliseconds between two recorded events —
+    the measurement loop paddle users write (ev1.record(); work;
+    ev2.record(); ev1.elapsed_time(ev2)) works unchanged.  Because the
+    record is a sync point, timings INCLUDE queue drain — identical to
+    CUDA events on a saturated stream, conservative on an idle one.
+    """
+
+    def __init__(self, enable_timing=True, blocking=False,
+                 interprocess=False):
+        self._enable_timing = enable_timing
+        self._t: float | None = None
 
     def record(self, stream=None):
-        pass
+        import time as _time
+        synchronize(stream.device if stream is not None else None)
+        self._t = _time.perf_counter()
 
-    def query(self):
-        return True
+    def query(self) -> bool:
+        return True  # recorded synchronously: always complete
 
     def synchronize(self):
         synchronize()
+
+    def elapsed_time(self, end_event: "Event") -> float:
+        """Milliseconds between this event's record and ``end_event``'s."""
+        if self._t is None or end_event._t is None:
+            raise RuntimeError(
+                "elapsed_time requires both events to be recorded")
+        return (end_event._t - self._t) * 1e3
 
 
 def current_stream(device=None) -> Stream:

@@ -105,3 +105,26 @@ def test_train_step_audit_end_to_end():
     assert aud["honored_all"], aud
     ma = memory_analysis(step, w, _ones((8, 8)), donate_argnums=(0,))
     assert ma["argument_bytes"] == 2 * 8 * 8 * 4
+
+
+def test_stream_event_timing():
+    """Stream/Event give real elapsed-time semantics (the reference's
+    ev1.record(); work; ev2.record(); ev1.elapsed_time(ev2) loop)."""
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.device import Event, Stream, current_stream
+
+    s = current_stream()
+    assert isinstance(s, Stream)
+    e1 = s.record_event()
+    x = paddle.randn([256, 256])
+    y = (x @ x).sum()
+    time.sleep(0.05)
+    e2 = Event(enable_timing=True)
+    e2.record()
+    ms = e1.elapsed_time(e2)
+    assert ms >= 50.0            # at least the sleep
+    assert e1.query() and e2.query()
+    with __import__("pytest").raises(RuntimeError):
+        Event().elapsed_time(e2)
