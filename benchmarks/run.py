@@ -89,6 +89,13 @@ def run_resnet():
         loss = train_step(x, y, fwd)
     loss_last = float(loss._data)
 
+    if on_tpu:  # one profiled step (BASELINE config 1 hotspot evidence)
+        import bench as _bench
+        prof = _bench._profile_one_step(
+            "resnet", lambda: train_step(x, y, fwd)._data)
+    else:
+        prof = {}
+
     model.eval()
     infer = to_static(lambda xb: model(xb),
                       input_spec=[InputSpec([batch, 3, size, size],
@@ -109,6 +116,7 @@ def run_resnet():
         "loss_decreased": bool(loss_last < loss0),
         "finite": bool(np.isfinite([loss0, loss_last]).all()),
         "batch": batch, "image_size": size,
+        **prof,
     }
 
 
