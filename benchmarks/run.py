@@ -156,8 +156,63 @@ def run_decode():
     return {"config": "serving_decode", **bench._run_decode(_on_tpu())}
 
 
+def run_longctx():
+    """Long-context single-chip: 16k-token train step through the flash
+    kernel's KV-streaming path (SURVEY §5.7; the multi-chip story is the
+    sep axis + ring attention, proven on the virtual mesh)."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models.pretrain import ParallelConfig, PretrainStep
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=16384, dtype="bfloat16")
+        batch, seq, steps = 1, 16384, 6
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps = 1, 64, 2
+    pc = ParallelConfig(remat=on_tpu, loss_chunks=16 if on_tpu else 1,
+                        m_dtype="bfloat16" if on_tpu else "float32")
+    ps = PretrainStep(cfg, pc)
+    state = ps.init_state(seed=0)
+    rng = np.random.default_rng(0)
+    ids, labels = ps.shard_batch(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    state, loss = ps.train_step(state, ids, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = ps.train_step(state, ids, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    tps = batch * seq * steps / dt
+    import bench
+    peak = bench._peak_flops(jax.devices()[0])
+    # flops_per_token is 6N (dense-decoder convention); at 16k the
+    # attention matmuls are no longer negligible — add the PaLM-appendix
+    # 6*L*s*H term (causal average s/2 keys, x2 for QK+AV, x3 fwd+bwd)
+    attn = 6.0 * cfg.num_hidden_layers * seq * cfg.hidden_size / 2
+    fpt = ps.flops_per_token(False) + attn
+    return {
+        "config": "longctx_16k",
+        "longctx_seq": seq,
+        "longctx_tok_per_sec": round(tps, 1),
+        "longctx_mfu": round(tps * fpt / peak, 4),
+        "longctx_mfu_excl_attn": round(
+            tps * ps.flops_per_token(False) / peak, 4),
+        "longctx_loss": round(float(loss), 4),
+    }
+
+
 CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
-           "dit": run_dit, "moe": run_moe, "decode": run_decode}
+           "dit": run_dit, "moe": run_moe, "decode": run_decode,
+           "longctx": run_longctx}
 
 
 def _supervise(names, timeout):
