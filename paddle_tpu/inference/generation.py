@@ -85,6 +85,36 @@ def _rope_seq(x, cos, sin):
     return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
+def _moe_ffn(y, lp, top_k):
+    """Routed SwiGLU expert mixture for the serving path (reference:
+    incubate fused_moe inference semantics).  Dense-mixture form — every
+    expert runs under a lax.scan over all rows, combined with top-k gate
+    weights: exact routing, no capacity, transients bounded to one
+    expert.  Decode batches are tiny so the E/top_k extra FLOPs are
+    noise; prefill pays them for simplicity (the training-side grouped
+    kernel is the fast path at scale)."""
+    gw = lp["mlp.gate.weight"]              # [H, E]
+    shape = y.shape
+    xf = y.reshape(-1, shape[-1])
+    probs = jax.nn.softmax(
+        xf.astype(jnp.float32) @ gw.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, top_k)
+    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    comb = jnp.zeros_like(probs).at[
+        jnp.arange(xf.shape[0])[:, None], topi].set(topv)
+
+    def step(acc, ex):
+        h = jax.nn.silu(xf @ ex["wg"]) * (xf @ ex["wu"])
+        return acc + ex["c"][:, None].astype(acc.dtype) * (h @ ex["wd"]), None
+
+    acc0 = jnp.zeros(xf.shape, xf.dtype)
+    out, _ = jax.lax.scan(step, acc0, {
+        "wg": lp["mlp.experts_gate"], "wu": lp["mlp.experts_up"],
+        "wd": lp["mlp.experts_down"],
+        "c": comb.T.astype(xf.dtype)})
+    return out.reshape(shape)
+
+
 def _sample(logits, key, gc: GenerationConfig):
     """logits: [B, V] fp32 → [B] int32 (traced; gc fields are static)."""
     if not gc.do_sample:
@@ -197,9 +227,12 @@ class LlamaGenerator:
             x = x + (attn.reshape(B, T, -1) @ lp["self_attn.o_proj.weight"])
             y = rms_norm_fp32(x, lp["post_attention_layernorm.weight"],
                               c.rms_norm_eps)
-            act = jax.nn.silu(y @ lp["mlp.gate_proj.weight"]) * \
-                (y @ lp["mlp.up_proj.weight"])
-            x = x + act @ lp["mlp.down_proj.weight"]
+            if "mlp.experts_gate" in lp:          # MoE model serving
+                x = x + _moe_ffn(y, lp, c.moe_top_k)
+            else:
+                act = jax.nn.silu(y @ lp["mlp.gate_proj.weight"]) * \
+                    (y @ lp["mlp.up_proj.weight"])
+                x = x + act @ lp["mlp.down_proj.weight"]
             return (x,), (kcl, vcl)
 
         (h,), (kc, vc) = jax.lax.scan(layer, (h,), (params["blocks"], kc, vc))
@@ -268,9 +301,12 @@ class LlamaGenerator:
             x = x + (attn.reshape(B, -1) @ lp["self_attn.o_proj.weight"])
             y = rms_norm_fp32(x, lp["post_attention_layernorm.weight"],
                               c.rms_norm_eps)
-            act = jax.nn.silu(y @ lp["mlp.gate_proj.weight"]) * \
-                (y @ lp["mlp.up_proj.weight"])
-            x = x + act @ lp["mlp.down_proj.weight"]
+            if "mlp.experts_gate" in lp:          # MoE model serving
+                x = x + _moe_ffn(y, lp, c.moe_top_k)
+            else:
+                act = jax.nn.silu(y @ lp["mlp.gate_proj.weight"]) * \
+                    (y @ lp["mlp.up_proj.weight"])
+                x = x + act @ lp["mlp.down_proj.weight"]
             return (x,), (k, v)
 
         (h,), (k_all, v_all) = jax.lax.scan(layer, (h,),
