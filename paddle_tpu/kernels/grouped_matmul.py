@@ -41,6 +41,13 @@ from .. import flags
 flags.define_flag("grouped_matmul_interpret", False,
                   "Run the Pallas grouped-matmul kernels in interpreter "
                   "mode on CPU (tests).")
+flags.define_flag("grouped_matmul_bn", 0,
+                  "Override the grouped-matmul output-column tile "
+                  "(0 = the 512-with-divisibility default). On-chip "
+                  "sweeps set this without code edits.")
+flags.define_flag("grouped_matmul_bk", 0,
+                  "Override the grouped-matmul contraction tile "
+                  "(0 = default).")
 
 
 def _mode():
@@ -104,8 +111,8 @@ def gmm(lhs, rhs, tile_groups, *, bm=512, bn=512, bk=512, trans_rhs=False,
                               trans_rhs=trans_rhs)
     if M % bm:
         raise ValueError(f"M ({M}) must be a multiple of bm ({bm})")
-    bn = _pick_block(O, bn)
-    bk = _pick_block(C, bk)
+    bn = _pick_block(O, flags.flag("grouped_matmul_bn") or bn)
+    bk = _pick_block(C, flags.flag("grouped_matmul_bk") or bk)
     nk = C // bk
 
     rhs_spec = (
@@ -180,8 +187,8 @@ def tgmm(lhs, rhs, tile_groups, num_groups, *, bm=512, bn=512, bk=512,
         return _tgmm_reference(lhs, rhs, tile_groups, num_groups, bm=bm)
     if M % bm:
         raise ValueError(f"M ({M}) must be a multiple of bm ({bm})")
-    bk = _pick_block(K, bk)
-    bn = _pick_block(N, bn)
+    bk = _pick_block(K, flags.flag("grouped_matmul_bk") or bk)
+    bn = _pick_block(N, flags.flag("grouped_matmul_bn") or bn)
     nm = M // bm
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
