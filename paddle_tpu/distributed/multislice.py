@@ -122,15 +122,13 @@ class MpmdPipeline:
         inputs: List[List[Any]] = [[None] * S for _ in range(M)]
         losses, grads = [], [None] * S
 
-        def run_fwd_through(m, upto):
-            """Advance microbatch m's forward wave through stage ``upto``."""
-            x = self._to_stage(mbs[m], 0) if inputs[m][0] is None \
-                else inputs[m][0]
-            inputs[m][0] = x
-            for s in range(upto + 1):
-                if s == S - 1:
-                    continue                 # last stage runs inside grad
-                if s + 1 < S and inputs[m][s + 1] is None:
+        def run_fwd(m):
+            """Advance microbatch m's forward wave up to the last stage's
+            input (the last stage itself runs inside its grad executable)."""
+            if inputs[m][0] is None:
+                inputs[m][0] = self._to_stage(mbs[m], 0)
+            for s in range(S - 1):
+                if inputs[m][s + 1] is None:
                     y = self._fwd[s](self.params[s], inputs[m][s])
                     inputs[m][s + 1] = self._to_stage(y, s + 1)
 
@@ -155,11 +153,10 @@ class MpmdPipeline:
         # ---- 1F1B: warmup S-1 forwards, then 1f/1b steady state ----
         warm = min(S - 1, M)
         for m in range(warm):
-            run_fwd_through(m, S - 1)
+            run_fwd(m)
         for m in range(M):
-            fwd_m = m + warm
-            if fwd_m < M:
-                run_fwd_through(fwd_m, S - 1)   # 1 forward
+            if m + warm < M:
+                run_fwd(m + warm)               # 1 forward
             run_bwd(m)                          # 1 backward
         mean = functools.partial(jax.tree.map, lambda g: g / M)
         return jnp.mean(jnp.stack(

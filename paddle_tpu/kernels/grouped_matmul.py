@@ -50,7 +50,9 @@ flags.define_flag("grouped_matmul_bk", 0,
                   "(0 = default).")
 
 
-def _mode():
+def _mode(interpret=None):
+    if interpret is not None:
+        return "interpret" if interpret else "tpu"
     if jax.default_backend() == "tpu":
         return "tpu"
     if flags.flag("grouped_matmul_interpret"):
@@ -104,8 +106,7 @@ def gmm(lhs, rhs, tile_groups, *, bm=512, bn=512, bk=512, trans_rhs=False,
     M, C = lhs.shape
     E = rhs.shape[0]
     O = rhs.shape[1] if trans_rhs else rhs.shape[2]
-    mode = _mode() if interpret is None else ("interpret" if interpret
-                                              else "tpu")
+    mode = _mode(interpret)
     if mode is None:
         return _gmm_reference(lhs, rhs, tile_groups, bm=bm,
                               trans_rhs=trans_rhs)
@@ -181,8 +182,7 @@ def tgmm(lhs, rhs, tile_groups, num_groups, *, bm=512, bn=512, bk=512,
 
     M, K = lhs.shape
     N = rhs.shape[1]
-    mode = _mode() if interpret is None else ("interpret" if interpret
-                                              else "tpu")
+    mode = _mode(interpret)
     if mode is None:
         return _tgmm_reference(lhs, rhs, tile_groups, num_groups, bm=bm)
     if M % bm:

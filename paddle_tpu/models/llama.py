@@ -315,15 +315,8 @@ def moe_mlp_forward(x, gate_w, w_gate, w_up, w_down, *, top_k,
     k = top_k
     xf = x.reshape(N, H)
 
-    logits = (xf.astype(jnp.float32) @ gate_w.astype(jnp.float32))  # [N, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-    topv, topi = jax.lax.top_k(probs, k)                  # [N, k]
-    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
-
-    # GShard load-balancing aux: E * sum_e mean_prob_e * frac_tokens_e
-    me = probs.mean(axis=0)
-    ce = jnp.zeros((E,), jnp.float32).at[topi[:, 0]].add(1.0) / N
-    aux = E * jnp.sum(me * ce)
+    # GShard top-k routing + load-balancing aux (shared router)
+    topv, topi, aux, ce = _route_topk(xf, gate_w, k)
 
     cap = max(1, int(N * k * capacity_factor / E))
     # k-major priority: every token's first choice beats any second choice
@@ -428,8 +421,19 @@ def moe_mlp_forward_einsum(x, gate_w, w_gate, w_up, w_down, *, top_k,
     return y.reshape(B, S, H), aux, stats
 
 
-def _silu(x):
-    return x * jax.nn.sigmoid(x)
+def _route_topk(xf, gate_w, k):
+    """Shared top-k router: returns (normalized gate weights [N, k],
+    expert ids [N, k], GShard aux loss, first-choice load ce [E])."""
+    N = xf.shape[0]
+    E = gate_w.shape[-1]
+    logits = xf.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)
+    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    me = probs.mean(axis=0)
+    ce = jnp.zeros((E,), jnp.float32).at[topi[:, 0]].add(1.0) / N
+    aux = E * jnp.sum(me * ce)
+    return topv, topi, aux, ce
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10))
@@ -459,7 +463,7 @@ def _grouped_ffn_fwd(xf, w_gate, w_up, w_down, gates, inv_flat, pos,
     x_pad = jnp.take(xz, tok_of, axis=0)                  # [M, H] gather
     h_g = gmm(x_pad, w_gate, tile_groups, bm=bm)
     h_u = gmm(x_pad, w_up, tile_groups, bm=bm)
-    a = _silu(h_g) * h_u
+    a = jax.nn.silu(h_g) * h_u
     o = gmm(a, w_down, tile_groups, bm=bm)                # [M, H]
     o_pos = jnp.take(o, pos, axis=0).reshape(N, k, H)     # combine gather
     y = (o_pos * gates[..., None].astype(o.dtype)).sum(axis=1)
@@ -478,7 +482,7 @@ def _grouped_ffn_bwd(E, k, bm, res, dy):
     x_pad = jnp.take(xz, tok_of, axis=0)
     h_g = gmm(x_pad, w_gate, tile_groups, bm=bm)
     h_u = gmm(x_pad, w_up, tile_groups, bm=bm)
-    sg = _silu(h_g)
+    sg = jax.nn.silu(h_g)
     a = sg * h_u
     o = gmm(a, w_down, tile_groups, bm=bm)
 
@@ -535,14 +539,7 @@ def moe_mlp_forward_grouped(x, gate_w, w_gate, w_up, w_down, *, top_k,
     k = top_k
     xf = x.reshape(N, H)
 
-    logits = (xf.astype(jnp.float32) @ gate_w.astype(jnp.float32))  # [N, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-    topv, topi = jax.lax.top_k(probs, k)                  # [N, k]
-    topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
-
-    me = probs.mean(axis=0)
-    ce = jnp.zeros((E,), jnp.float32).at[topi[:, 0]].add(1.0) / N
-    aux = E * jnp.sum(me * ce)
+    topv, topi, aux, ce = _route_topk(xf, gate_w, k)
 
     from ..kernels.grouped_matmul import sorted_dispatch_plan
     inv_flat, pos, tile_groups = sorted_dispatch_plan(
@@ -600,13 +597,8 @@ def moe_mlp_forward_grouped_sharded(x, gate_w, w_gate, w_up, w_down, *,
         # vjp FFN gets explicitly pvary'd operands instead — shard_map AD
         # cannot see inside a custom vjp, and the pvary transpose is what
         # emits the replicated axes' psums on dx / dw
-        logits = xf.astype(jnp.float32) @ gw.astype(jnp.float32)
-        probs = jax.nn.softmax(logits, axis=-1)
-        topv, topi = jax.lax.top_k(probs, k)
-        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
-        me = probs.mean(axis=0)
-        ce = jnp.zeros((E,), jnp.float32).at[topi[:, 0]].add(1.0) / n
-        aux = jax.lax.pmean(E * jnp.sum(me * ce), dp_axis)
+        topv, topi, aux_local, ce = _route_topk(xf, gw, k)
+        aux = jax.lax.pmean(aux_local, dp_axis)
 
         my = jax.lax.axis_index(ep_axis)
         own = (topi // E_loc) == my                      # [n, k]
