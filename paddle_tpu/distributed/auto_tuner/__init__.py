@@ -137,6 +137,67 @@ def _device_hbm_bytes() -> Optional[int]:
         return None
 
 
+def tune_pretrain(model_config, n_devices: int, *, global_batch: int,
+                  seq: int, steps: int = 2, max_trials: int = 3,
+                  hbm_bytes: Optional[int] = None):
+    """End-to-end tuner over real compiled train steps (the reference
+    auto_tuner's launch-measure-record loop, with a jitted
+    ``models.pretrain.PretrainStep`` as the trial instead of a pod
+    launch).  Candidates are pruned by the analytic memory model, the
+    survivors' compiled HBM peaks are probed via
+    ``device.memory_debug.memory_analysis``, and the remainder are timed
+    for ``steps`` steps.  Returns the winning TuningRecord (its
+    ``.config`` holds dp/mp/pp/micro_batches/recompute).
+    """
+    import time
+
+    import jax
+    import numpy as np
+
+    from ...device.memory_debug import memory_analysis
+    from ...models.pretrain import ParallelConfig, PretrainStep
+
+    c = model_config
+    tuner = AutoTuner(n_devices, hidden=c.hidden_size,
+                      num_layers=c.num_hidden_layers,
+                      heads=c.num_attention_heads, seq=seq,
+                      global_batch=global_batch, vocab=c.vocab_size,
+                      hbm_bytes=hbm_bytes)
+
+    def build(cfg):
+        pc = ParallelConfig(dp=cfg["dp"], mp=cfg["mp"], pp=cfg["pp"],
+                            micro_batches=max(cfg["micro_batches"], 1),
+                            remat=cfg["recompute"])
+        ps = PretrainStep(c, pc)
+        state = ps.init_state(seed=0)
+        rng = np.random.default_rng(0)
+        ids, labels = ps.shard_batch(
+            rng.integers(0, c.vocab_size,
+                         (global_batch, seq)).astype(np.int32),
+            rng.integers(0, c.vocab_size,
+                         (global_batch, seq)).astype(np.int32))
+        return ps, state, ids, labels
+
+    def memory_fn(cfg):
+        ps, state, ids, labels = build(cfg)
+        rep = memory_analysis(
+            lambda s, i, l: ps.train_step(s, i, l), state, ids, labels)
+        return rep["peak_estimate_bytes"] // max(n_devices, 1)
+
+    def trial_fn(cfg):
+        ps, state, ids, labels = build(cfg)
+        state, loss = ps.train_step(state, ids, labels)   # compile
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = ps.train_step(state, ids, labels)
+        jax.block_until_ready(loss)
+        return (time.perf_counter() - t0) / steps
+
+    return tuner.tune(trial_fn=trial_fn, max_trials=max_trials,
+                      memory_fn=memory_fn if tuner.hbm_bytes else None)
+
+
 class AutoTuner:
     """reference auto_tuner Search+Recorder driver.
 

@@ -100,3 +100,22 @@ def test_real_memory_analysis_probe():
     assert best is not None and probed
     for r in probed:
         assert r.memory_bytes <= budget
+
+
+def test_tune_pretrain_end_to_end():
+    """The full loop: search -> analytic prune -> compiled memory probe ->
+    timed PretrainStep trials on the virtual mesh -> a measured winner."""
+    from paddle_tpu.distributed.auto_tuner import tune_pretrain
+    from paddle_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=512, hidden_size=64,
+                      intermediate_size=176, num_hidden_layers=4,
+                      num_attention_heads=8, num_key_value_heads=4,
+                      max_position_embeddings=64, dtype="float32")
+    best = tune_pretrain(cfg, 8, global_batch=8, seq=32, steps=1,
+                         max_trials=2, hbm_bytes=int(4e9))
+    assert best is not None and best.pruned is None
+    assert best.measured is not None and best.measured > 0
+    c = best.config
+    assert c["dp"] * c["mp"] * c["pp"] == 8
+    assert best.memory_bytes is not None and best.memory_bytes <= int(4e9)
