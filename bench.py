@@ -373,6 +373,7 @@ def _run_moe(on_tpu):
         "moe_kept_frac": round(stats["kept_frac"], 4),
         "moe_imbalance": round(stats["imbalance"], 4),
         "moe_dispatch": cfg.moe_dispatch,
+        "moe_block_m": cfg.moe_block_m,
     }
     if headline_note:
         out["moe_headline_note"] = headline_note

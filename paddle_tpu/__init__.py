@@ -8,6 +8,39 @@ docstring cites the reference component (file:line) it re-implements.
 import os as _os
 
 import jax as _jax
+import jax.export as _jax_export  # noqa: F401  (on the pinned jax the
+#   lazy `jax.export` attribute 404s until the submodule is imported once;
+#   jit.save/load and the Mosaic cross-lowering tests rely on it)
+
+# `jax.shard_map` graduated from jax.experimental after the pinned
+# version; the sharded kernels (pipeline_spmd, ring_attention, the
+# grouped MoE) all target the graduated spelling, so install it when
+# missing.  check_rep=False matches the graduated default closely enough
+# here: these callers all psum/ppermute explicitly and several wrap
+# custom_vjp functions the replication checker cannot see into.
+if not hasattr(_jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map_compat(f, mesh, in_specs, out_specs, **kw):
+        kw.setdefault("check_rep", False)
+        names = kw.pop("axis_names", None)
+        if names is not None:   # graduated API: manual axes by name; the
+            #                     experimental one takes the AUTO complement
+            kw.setdefault("auto",
+                          frozenset(mesh.axis_names) - frozenset(names))
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+    _jax.shard_map = _shard_map_compat
+
+# The varying-manual-axes cast ops (`jax.lax.pcast` / `jax.lax.pvary`)
+# belong to the newer replication checker; under this jax's shard_map
+# with check_rep=False they are semantically identity casts, so the
+# pipeline/ring kernels that annotate with them keep working.
+if not hasattr(_jax.lax, "pcast"):
+    _jax.lax.pcast = lambda x, axes=None, *, to=None: x
+if not hasattr(_jax.lax, "pvary"):
+    _jax.lax.pvary = lambda x, axes=None: x
 
 # Paddle's dtype surface includes real int64/float64 tensors
 # (phi DataType::INT64/FLOAT64); without x64 JAX silently narrows to 32-bit.

@@ -49,6 +49,20 @@ def _dispatch_combine(gate_val, gate_idx, num_experts, capacity):
     return dispatch, combine
 
 
+def _dispatch_indices(gate_val, gate_idx, num_experts, capacity):
+    """Index form of :func:`_dispatch_combine` — same slots, same k-major
+    priority, same drops, but carried as int32 maps instead of [N, E, C]
+    one-hots.  Delegates to the single-sourced
+    ``kernels.grouped_matmul.capacity_dispatch_plan`` (the "gather"
+    dispatch idiom of models.llama — see the dispatch-mode matrix in
+    benchmarks/README.md); returns (inv, slot, gate_keep)."""
+    from .....kernels.grouped_matmul import capacity_dispatch_plan
+
+    inv, slot, gate_keep, _ = capacity_dispatch_plan(
+        gate_idx, gate_val, num_experts, capacity)
+    return inv, slot, gate_keep
+
+
 class MoELayer(Layer):
     """reference moe_layer.py:263.
 
@@ -60,8 +74,17 @@ class MoELayer(Layer):
 
     def __init__(self, d_model: int, experts: List, gate=None, moe_group=None,
                  mp_group=None, recompute_interval: int = 0,
-                 capacity_factor: float = 1.2):
+                 capacity_factor: float = 1.2, dispatch: str = "gather"):
         super().__init__()
+        if dispatch not in ("gather", "einsum"):
+            raise ValueError(
+                f"dispatch must be 'gather' or 'einsum', got {dispatch!r}")
+        # "gather" (default): int32 slot maps + row gathers — no [N, E, C]
+        # one-hot dispatch tensor, no O(N*E*C*d) dispatch einsum (the
+        # grouped-dispatch idiom of models.llama threaded through the
+        # compat layer).  "einsum": the original GShard one-hot
+        # contraction, kept as the reference oracle.
+        self.dispatch = dispatch
         self.d_model = d_model
         if isinstance(experts, (list, tuple)):
             experts = LayerList(list(experts))
@@ -134,9 +157,22 @@ class MoELayer(Layer):
             for k in keys]
         template = self._template
 
+        use_gather = self.dispatch == "gather"
+
         def prim(x_arr, val_arr, idx_arr, *leaves):
-            dispatch, combine = _dispatch_combine(val_arr, idx_arr, E, cap)
-            xin = jnp.einsum("nec,nd->ecd", dispatch.astype(x_arr.dtype), x_arr)
+            from .....kernels.grouped_matmul import take_sentinel_rows
+
+            d_ = x_arr.shape[-1]
+            if use_gather:
+                inv, slot, gate_keep = _dispatch_indices(
+                    val_arr, idx_arr, E, cap)
+                xin = take_sentinel_rows(x_arr, inv[:-1]) \
+                    .reshape(E, cap, d_)
+            else:
+                dispatch, combine = _dispatch_combine(val_arr, idx_arr, E,
+                                                      cap)
+                xin = jnp.einsum("nec,nd->ecd",
+                                 dispatch.astype(x_arr.dtype), x_arr)
             if ep is not None:
                 mesh, ax = ep
                 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -150,6 +186,13 @@ class MoELayer(Layer):
                 return functional_call(template, params, Tensor(ein))
 
             eout = jax.vmap(one)(stacked, xin)                     # [E, C, d]
+            if use_gather:
+                N_, K_ = idx_arr.shape
+                eo = eout.reshape(E * cap, d_)
+                picked = take_sentinel_rows(eo, slot)              # [K*N, d]
+                y = (gate_keep[:, None].astype(eo.dtype) * picked) \
+                    .reshape(K_, N_, d_).sum(axis=0)
+                return y
             return jnp.einsum("nec,ecd->nd", combine.astype(eout.dtype), eout)
 
         y = apply_op("moe_gshard_einsum", prim,

@@ -85,17 +85,40 @@ def _rope_seq(x, cos, sin):
     return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
-def _moe_ffn(y, lp, top_k):
+def _moe_ffn(y, lp, top_k, dispatch="dense", block_m=128):
     """Routed SwiGLU expert mixture for the serving path (reference:
-    incubate fused_moe inference semantics).  Dense-mixture form — every
-    expert runs under a lax.scan over all rows, combined with top-k gate
-    weights: exact routing, no capacity, transients bounded to one
-    expert.  Decode batches are tiny so the E/top_k extra FLOPs are
-    noise; prefill pays them for simplicity (the training-side grouped
-    kernel is the fast path at scale)."""
+    incubate fused_moe inference semantics).
+
+    Two forms, picked by routed-entry count (the dispatch-mode matrix of
+    benchmarks/README.md):
+
+    - grouped (``dispatch="grouped"`` and >= one ``block_m`` tile of
+      (token, choice) entries — prefill): the expert-sorted ragged-GEMM
+      path shared with training (``models.llama._grouped_ffn``) — each
+      expert runs over exactly its own rows, E/top_k-fold fewer FFN
+      FLOPs than the dense mixture.
+    - dense (decode, or non-grouped configs): every expert runs under a
+      lax.scan over all rows, combined with top-k gate weights — exact
+      routing, no capacity, transients bounded to one expert.  Decode
+      batches are tiny (a handful of rows), so the E/top_k extra FLOPs
+      are noise there and the scan avoids the tile-padding overhead.
+    """
     gw = lp["mlp.gate.weight"]              # [H, E]
     shape = y.shape
     xf = y.reshape(-1, shape[-1])
+    E = gw.shape[-1]
+    if dispatch == "grouped" and xf.shape[0] * top_k >= block_m:
+        from ..kernels.grouped_matmul import sorted_dispatch_plan
+        from ..models import llama as _llama
+
+        N = xf.shape[0]
+        topv, topi, _, _ = _llama._route_topk(xf, gw, top_k)
+        inv, pos, tg = sorted_dispatch_plan(
+            topi.reshape(N * top_k), E, block_m)
+        out = _llama._grouped_ffn(
+            xf, lp["mlp.experts_gate"], lp["mlp.experts_up"],
+            lp["mlp.experts_down"], topv, inv, pos, tg, E, top_k, block_m)
+        return out.reshape(shape)
     probs = jax.nn.softmax(
         xf.astype(jnp.float32) @ gw.astype(jnp.float32), axis=-1)
     topv, topi = jax.lax.top_k(probs, top_k)
@@ -228,7 +251,9 @@ class LlamaGenerator:
             y = rms_norm_fp32(x, lp["post_attention_layernorm.weight"],
                               c.rms_norm_eps)
             if "mlp.experts_gate" in lp:          # MoE model serving
-                x = x + _moe_ffn(y, lp, c.moe_top_k)
+                x = x + _moe_ffn(y, lp, c.moe_top_k,
+                                 dispatch=c.moe_dispatch,
+                                 block_m=c.moe_block_m)
             else:
                 act = jax.nn.silu(y @ lp["mlp.gate_proj.weight"]) * \
                     (y @ lp["mlp.up_proj.weight"])
@@ -302,7 +327,9 @@ class LlamaGenerator:
             y = rms_norm_fp32(x, lp["post_attention_layernorm.weight"],
                               c.rms_norm_eps)
             if "mlp.experts_gate" in lp:          # MoE model serving
-                x = x + _moe_ffn(y, lp, c.moe_top_k)
+                x = x + _moe_ffn(y, lp, c.moe_top_k,
+                                 dispatch=c.moe_dispatch,
+                                 block_m=c.moe_block_m)
             else:
                 act = jax.nn.silu(y @ lp["mlp.gate_proj.weight"]) * \
                     (y @ lp["mlp.up_proj.weight"])

@@ -24,6 +24,8 @@ import threading
 from typing import Any, List, Optional, Sequence
 
 import jax
+import jax.export  # noqa: F401  (registers the lazy `jax.export` submodule
+#                     on the pinned jax, where plain attribute access fails)
 import jax.numpy as jnp
 import numpy as np
 
@@ -228,9 +230,13 @@ class StaticFunction:
             return self._fn(*args, **kwargs)
         tensors: List[Tensor] = []
         spec = _flatten((tuple(args), dict(kwargs)), tensors)
-        slice_map = ()
+        padded = False
+        true_key = None
+        orig_tensors = tensors
         if self._bucket is not None and self._input_spec:
-            tensors, slice_map = self._pad_to_buckets(tensors)
+            aligned = self._align_specs(args, kwargs)
+            true_key = tuple(tuple(t.shape) for t in tensors)
+            tensors, padded = self._pad_to_buckets(tensors, aligned)
         params, buffers = self._state()
         training = self._layer.training if self._layer is not None else False
 
@@ -243,11 +249,51 @@ class StaticFunction:
         entry = self._cache.get(guard)
         if entry is None:
             entry = {"mode": "whole", "jit": None, "out_spec": None,
-                     "specs": {}, "mru": None}
+                     "specs": {}, "mru": None, "out_shapes": {}}
             self._cache[guard] = entry
 
         if entry["mode"] == "eager":
             return self._fn(*args, **kwargs)
+
+        out_shapes = None
+        if padded:
+            # inputs were padded this call: jitted outputs carry bucket-
+            # sized axes that must be cut back.  The slice recipe is the
+            # TRUE output shapes, recorded once per distinct true-input-
+            # shape signature — not the old positional (axis, size)==
+            # bucket coincidence heuristic, which silently truncated
+            # legitimate bucket-sized axes.  Recording is abstract
+            # evaluation of the pure program on the UNPADDED avals (no
+            # FLOPs, no buffer side effects); a function that graph-
+            # breaks under trace records from one eager run instead.
+            recs = entry["out_shapes"]
+            out_shapes = recs.get(true_key)
+            if out_shapes is None:
+                if len(recs) >= 4096:     # true lengths are bucket-bounded;
+                    recs.clear()          # this is only a leak backstop
+                try:
+                    prim = self._make_pure(spec, len(params), len(buffers),
+                                           len(tensors), params, buffers)
+                    # next_key() HERE (eagerly) also guarantees the global
+                    # RNG root exists before the abstract trace — lazy
+                    # init inside eval_shape would store a tracer as the
+                    # root key and poison every later eager random op
+                    flat_avals = jax.eval_shape(
+                        prim, *(p._data for p in params),
+                        *(b._data for b in buffers),
+                        jax.random.key_data(next_key()),
+                        *(t._data for t in orig_tensors))
+                    outs = flat_avals[:len(flat_avals) - len(buffers)]
+                    out_shapes = tuple(tuple(o.shape) for o in outs)
+                except _sot.BREAK_ERRORS:
+                    out = self._fn(*args, **kwargs)
+                    # _flatten_out is the SAME traversal _slice_back's
+                    # iterator pairs against — one walker, no desync
+                    arrays: List = []
+                    _flatten_out(out, arrays)
+                    recs[true_key] = tuple(tuple(a.shape) for a in arrays)
+                    return out
+                recs[true_key] = out_shapes
 
         key = jax.random.key_data(next_key())
         all_inputs = list(params) + list(buffers) + [Tensor(key)] + tensors
@@ -272,7 +318,7 @@ class StaticFunction:
                     entry["out_spec"] = self._out_spec
                 return self._slice_back(
                     self._commit(entry["out_spec"], flat, buffers, 0),
-                    slice_map)
+                    out_shapes)
 
         # ---- SOT mode: try the hot specialization, verify its guards ----
         if entry["mru"] is not None:
@@ -292,7 +338,7 @@ class StaticFunction:
             if _sot.aux_guard_ok(aux, srec["probes"]):
                 return self._slice_back(
                     self._commit(srec["out_spec"], flat, buffers, n_aux),
-                    slice_map)
+                    out_shapes)
             # guard miss: discard the speculative run, take the eager path
 
         # ---- eager journal run (always correct), then specialize --------
@@ -319,56 +365,119 @@ class StaticFunction:
         return out
 
     # -- pad-to-bucket policy (SURVEY §7.4.3 / VERDICT r4 item 4) --------
-    def _pad_to_buckets(self, tensors):
+    def _align_specs(self, args, kwargs):
+        """Pair ``input_spec`` entries with the call's tensors by the SAME
+        structure ``_flatten`` walks (positional args in order, then
+        kwargs by sorted key, recursing into containers), so tensors
+        passed via kwargs or nested containers cannot shift the pairing
+        and silently pad the wrong tensor's axes.  Returns one
+        InputSpec-or-None per flattened tensor; raises on structure
+        mismatch instead of guessing."""
+        specs = list(self._input_spec)
+        entries = list(args) + [kwargs[k] for k in sorted(kwargs)]
+        if len(specs) > len(entries):
+            raise ValueError(
+                f"to_static({self.__name__}): input_spec has {len(specs)} "
+                f"entries but the call supplies {len(entries)} arguments")
+        aligned: List[Optional[InputSpec]] = []
+
+        def pair(sp, obj, path):
+            if isinstance(obj, Tensor):
+                if sp is None or isinstance(sp, InputSpec):
+                    aligned.append(sp)
+                    return
+                raise ValueError(
+                    f"to_static({self.__name__}): input_spec entry at "
+                    f"{path} is {sp!r}, not an InputSpec, but the call "
+                    "passes a tensor there")
+            if isinstance(obj, (list, tuple)):
+                if sp is None:
+                    for j, v in enumerate(obj):
+                        pair(None, v, f"{path}[{j}]")
+                elif isinstance(sp, (list, tuple)) and len(sp) == len(obj):
+                    for j, (s, v) in enumerate(zip(sp, obj)):
+                        pair(s, v, f"{path}[{j}]")
+                else:
+                    raise ValueError(
+                        f"to_static({self.__name__}): input_spec at {path} "
+                        f"({sp!r}) does not match the call's container of "
+                        f"{len(obj)} elements")
+                return
+            if isinstance(obj, dict):
+                if sp is None:
+                    for k2 in sorted(obj):
+                        pair(None, obj[k2], f"{path}[{k2!r}]")
+                elif isinstance(sp, dict) and set(sp) == set(obj):
+                    for k2 in sorted(obj):
+                        pair(sp[k2], obj[k2], f"{path}[{k2!r}]")
+                else:
+                    raise ValueError(
+                        f"to_static({self.__name__}): input_spec at {path} "
+                        f"({sp!r}) does not match the call's dict keys "
+                        f"{sorted(obj)}")
+                return
+            if isinstance(sp, InputSpec):
+                raise ValueError(
+                    f"to_static({self.__name__}): input_spec declares a "
+                    f"tensor at {path} but the call passes {type(obj).__name__}")
+
+        for i, obj in enumerate(entries):
+            pair(specs[i] if i < len(specs) else None, obj, f"arg{i}")
+        return aligned
+
+    def _pad_to_buckets(self, tensors, specs):
         """Pad each ``InputSpec(None)`` axis up to its bucket so 50
         distinct lengths compile #buckets programs, not 50.
 
         Requires the function to be pad-invariant over the padded region
         (mask-aware attention, elementwise math, ...): zero-padding rides
-        into the trace, and each output is sliced back on any axis whose
-        POSITION and padded size match a padded input axis (the standard
-        TPU serving recipe; the reference instead compiles symbolic
-        DimExpr shapes, which XLA does not offer).
+        into the trace; outputs are sliced back to the TRUE output shapes
+        recorded per true-shape signature (see ``__call__``; the
+        reference instead compiles symbolic DimExpr shapes, which XLA
+        does not offer).  ``specs`` is the per-tensor alignment from
+        ``_align_specs``.  Returns (tensors, padded_anything).
         """
         new_tensors = list(tensors)
-        slice_map: dict = {}    # (axis, bucket) -> true length
-        for i, sp in enumerate(self._input_spec):
-            if i >= len(tensors) or not isinstance(sp, InputSpec):
+        padded = False
+        for i, sp in enumerate(specs):
+            if not isinstance(sp, InputSpec):
                 continue
             t = tensors[i]
             if len(sp.shape) != len(t.shape):
-                continue
+                raise ValueError(
+                    f"to_static({self.__name__}): input_spec {sp!r} has "
+                    f"rank {len(sp.shape)} but the matching tensor has "
+                    f"shape {tuple(t.shape)}")
             pads, changed = [], False
             for ax, d in enumerate(sp.shape):
                 n = t.shape[ax]
                 if d is None:
                     b = _bucket_size(n, self._bucket)
                     pads.append((0, b - n))
-                    if b != n:
-                        changed = True
-                    # record EVERY dynamic axis (padded or exactly at the
-                    # bucket): the slice length is the max true length
-                    # across inputs sharing (axis, bucket), so an input
-                    # sitting exactly at the bucket keeps outputs unsliced
-                    slice_map[(ax, b)] = max(n, slice_map.get((ax, b), 0))
+                    changed = changed or b != n
                 else:
                     pads.append((0, 0))
             if changed:
                 _STATS["bucket_pads"] += 1
+                padded = True
                 new_tensors[i] = Tensor(jnp.pad(t._data, pads))
-        return new_tensors, tuple(
-            (k, n) for k, n in sorted(slice_map.items()) if n < k[1])
+        return new_tensors, padded
 
-    def _slice_back(self, result, slice_map):
-        if not slice_map:
+    def _slice_back(self, result, out_shapes):
+        """Cut each output tensor back to its recorded true shape (the
+        shapes an unpadded run of this true-shape signature produced).
+        ``out_shapes=None`` => nothing was padded this call."""
+        if not out_shapes:
             return result
-        sm = dict(slice_map)
+        it = iter(out_shapes)
 
         def fix(obj):
             if isinstance(obj, Tensor):
-                idx = tuple(
-                    slice(0, sm[(ax, s)]) if (ax, s) in sm else slice(None)
-                    for ax, s in enumerate(obj.shape))
+                want = next(it, None)
+                if want is None or len(want) != len(obj.shape):
+                    return obj
+                idx = tuple(slice(0, w) if w < s else slice(None)
+                            for w, s in zip(want, obj.shape))
                 if any(i != slice(None) for i in idx):
                     return obj[idx]
                 return obj

@@ -172,6 +172,36 @@ def lookup_or_tune(key: str, candidates: Sequence,
     return tuple(best) if isinstance(best, (tuple, list)) else best
 
 
+def grouped_matmul_candidates(M: int, K: int, N: int, itemsize: int = 2,
+                              bm: int = 512, kind: str = "gmm",
+                              vmem_budget: int = 10 << 20
+                              ) -> List[Tuple[int, int]]:
+    """Feasible (bn, bk) tilings for the grouped-matmul kernels
+    (kernels/grouped_matmul.py).
+
+    Feasibility: the tile must divide its operand dim (K for bk, N for
+    bn), be an MXU-friendly multiple of 128, and keep the resident VMEM
+    under ``vmem_budget``.  The block shapes differ per kernel: gmm holds
+    lhs [bm, bk] + rhs [bk, bn] + a [bm, bn] fp32 accumulator and output,
+    while tgmm holds lhs [bm, bk] + rhs [bm, bn] + a [bk, bn] fp32
+    accumulator and output."""
+    def opts(d):
+        return [b for b in (128, 256, 512, 1024) if b <= d and d % b == 0]
+
+    cands = []
+    for bn in opts(N):
+        for bk in opts(K):
+            if kind == "tgmm":
+                vmem = (bm * bk + bm * bn) * itemsize + \
+                    bk * bn * (4 + itemsize)
+            else:
+                vmem = (bm * bk + bk * bn) * itemsize + \
+                    bm * bn * (4 + itemsize)
+            if vmem <= vmem_budget:
+                cands.append((bn, bk))
+    return cands
+
+
 def flash_attention_candidates(sq: int, sk: int, d: int,
                                vmem_budget: int = 10 << 20
                                ) -> List[Tuple[int, int]]:

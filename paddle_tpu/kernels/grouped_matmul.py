@@ -14,6 +14,13 @@ TPU-native design (megablocks-style, built for the MXU):
   prefetch channel (`pltpu.PrefetchScalarGridSpec`) so the index map can
   DMA the right expert's weight block — data-dependent weight selection
   with zero data-dependent control flow inside the kernel.
+- The dispatch permutation itself also rides the scalar-prefetch channel:
+  ``rows`` (gmm) / ``lhs_rows``/``rhs_rows`` (tgmm) carry the
+  padded-buffer-row -> token-row map and the kernel gathers operand rows
+  straight out of HBM with per-row async copies into a VMEM staging
+  block, so the ``[M, H]`` permuted operand copies of an unfused
+  dispatch never materialize (an optional per-row ``row_scale`` fuses
+  the combine-weight scaling of the MoE backward the same way).
 - ``gmm``: out[m] = lhs[m] @ rhs[group(m)] with an fp32 VMEM accumulator
   over k-steps.  ``tgmm`` (the weight-grad transpose) accumulates
   lhs^T @ rhs into out[group]: the m grid dim is innermost, so each
@@ -23,6 +30,9 @@ TPU-native design (megablocks-style, built for the MXU):
   per-expert alignment padding), not with a capacity bound: the
   capacity-dispatch formulations pay ~capacity_factor extra FLOPs and
   drop overflow tokens; this path pays <=E*bm pad rows and drops nothing.
+- Tile selection: explicit ``bn``/``bk`` arguments win, then a measured
+  ``kernels.autotune`` cache entry for the exact (kind, shape, dtype),
+  then the sweep flags (defaults only), then 512-with-divisibility.
 
 ``grouped_matmul`` wraps both in a ``custom_vjp`` (dlhs via gmm against
 the transposed weights, drhs via tgmm), so the kernel trains.
@@ -42,12 +52,19 @@ flags.define_flag("grouped_matmul_interpret", False,
                   "Run the Pallas grouped-matmul kernels in interpreter "
                   "mode on CPU (tests).")
 flags.define_flag("grouped_matmul_bn", 0,
-                  "Override the grouped-matmul output-column tile "
-                  "(0 = the 512-with-divisibility default). On-chip "
-                  "sweeps set this without code edits.")
+                  "Default grouped-matmul output-column tile when the "
+                  "caller does not pass one and no autotune cache entry "
+                  "exists (0 = the 512-with-divisibility default). "
+                  "Explicit bn arguments always take precedence.")
 flags.define_flag("grouped_matmul_bk", 0,
-                  "Override the grouped-matmul contraction tile "
-                  "(0 = default).")
+                  "Default grouped-matmul contraction tile (0 = default); "
+                  "explicit bk arguments always take precedence.")
+flags.define_flag("grouped_matmul_fused_gather", True,
+                  "Fuse the MoE dispatch row-gather (and optional per-row "
+                  "combine scale) into the grouped-matmul kernels via "
+                  "scalar-prefetched row indices + per-row DMA. Off: "
+                  "materialize the permuted operand and run the plain "
+                  "block kernels.")
 
 
 def _mode(interpret=None):
@@ -71,19 +88,148 @@ def _pick_block(dim: int, want: int) -> int:
     return b
 
 
+def validate_tile_flags(*dims):
+    """Fail fast when a FLAGS_grouped_matmul_bn/_bk sweep value cannot
+    tile every operand dim the forward AND backward kernels will see (the
+    backward swaps the output/contraction roles of H and I, so a flag
+    that only fits the forward would error mid-backward, on TPU only).
+    Called from ``grouped_matmul`` / the MoE FFN entry points; explicit
+    bn/bk arguments bypass the flags entirely."""
+    for name in ("grouped_matmul_bn", "grouped_matmul_bk"):
+        want = flags.flag(name)
+        if not want:
+            continue
+        for d in dims:
+            try:
+                _pick_block(d, want)
+            except ValueError:
+                raise ValueError(
+                    f"FLAGS_{name}={want} cannot tile operand dim {d} "
+                    f"(forward+backward dims {tuple(dims)}); pass explicit "
+                    "bn/bk to override the flag, or unset it") from None
+
+
+# ------------------------------------------------------ tile selection ---
+
+def _resolve_tiles(kind, M, K, N, E, bm, dtype, bn, bk, mode):
+    """(bn, bk) for a kernel call: explicit args > autotune cache (and
+    on-chip measurement when tuning is enabled) > sweep flags > 512."""
+    if bn is None or bk is None:
+        from . import autotune
+        key = autotune.make_key(f"grouped_matmul_{kind}", M=M, K=K, N=N,
+                                E=E, bm=bm, dtype=jnp.dtype(dtype).name)
+        tuned = autotune.lookup(key)
+        if tuned is None and mode == "tpu" and autotune.enabled():
+            tuned = _tune(kind, key, M, K, N, E, bm, dtype)
+        dbn = flags.flag("grouped_matmul_bn") or 512
+        dbk = flags.flag("grouped_matmul_bk") or 512
+        if tuned is not None:
+            dbn, dbk = int(tuned[0]), int(tuned[1])
+        if bn is None:
+            bn = dbn
+        if bk is None:
+            bk = dbk
+    return _pick_block(N, bn), _pick_block(K, bk)
+
+
+def _tune(kind, key, M, K, N, E, bm, dtype):
+    """Measure candidate (bn, bk) tiles on the attached chip (outside the
+    ongoing trace — each probe is its own jitted call on dummy operands,
+    the autotune module's re-entrant dispatch contract)."""
+    from . import autotune
+
+    cands = autotune.grouped_matmul_candidates(
+        M, K, N, itemsize=jnp.dtype(dtype).itemsize, bm=bm,
+        kind="tgmm" if kind == "tgmm" else "gmm")
+    if not cands:
+        return None
+    tg = ((jnp.arange(M // bm) * E) // (M // bm)).astype(jnp.int32)
+    lhs = jnp.ones((M, K), dtype)
+
+    if kind == "tgmm":
+        rhs = jnp.ones((M, N), dtype)
+
+        def bench(cand):
+            bn_, bk_ = cand
+            f = jax.jit(lambda a, b: tgmm(a, b, tg, E, bm=bm, bn=bn_,
+                                          bk=bk_))
+            f(lhs, rhs).block_until_ready()      # compile outside the timer
+            return lambda: f(lhs, rhs).block_until_ready()
+    else:
+        trans = kind == "gmm_t"
+        rhs = jnp.ones((E, N, K) if trans else (E, K, N), dtype)
+
+        def bench(cand):
+            bn_, bk_ = cand
+            f = jax.jit(lambda a, b: gmm(a, b, tg, bm=bm, bn=bn_, bk=bk_,
+                                         trans_rhs=trans))
+            f(lhs, rhs).block_until_ready()
+            return lambda: f(lhs, rhs).block_until_ready()
+
+    return autotune.lookup_or_tune(key, cands, bench, None)
+
+
+# ----------------------------------------------------- fused row gather ---
+
+def _gather_rows(src_ref, rows_ref, base, col0, ncols, dst_ref, sem, bm):
+    """Gather ``bm`` arbitrary rows of ``src_ref`` (HBM) into the VMEM
+    staging block ``dst_ref``: start all per-row copies back-to-back so
+    they overlap, then drain the semaphore.  This is the in-kernel form
+    of the dispatch permutation — same HBM bytes as the block fetch of a
+    pre-permuted operand, without ever writing the permuted copy."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def copy(r):
+        return pltpu.make_async_copy(
+            src_ref.at[rows_ref[base + r], pl.ds(col0, ncols)],
+            dst_ref.at[r], sem)
+
+    def start(r, c):
+        copy(r).start()
+        return c
+
+    def wait(r, c):
+        copy(r).wait()
+        return c
+
+    jax.lax.fori_loop(0, bm, start, 0)
+    jax.lax.fori_loop(0, bm, wait, 0)
+
+
 # ------------------------------------------------------------------ gmm ---
 
-def _gmm_kernel(group_ref, lhs_ref, rhs_ref, out_ref, acc_ref, *, nk,
-                trans_rhs):
+def _gmm_kernel(*refs, nk, trans_rhs, bm, bk, fused, scaled):
     from jax.experimental import pallas as pl
+
+    it = iter(refs)
+    group_ref = next(it)
+    rows_ref = next(it) if fused else None
+    lhs_ref = next(it)
+    rhs_ref = next(it)
+    scale_ref = next(it) if scaled else None
+    out_ref = next(it)
+    lx_ref = next(it) if fused else None
+    acc_ref = next(it)
+    sem = next(it) if fused else None
+    del group_ref
 
     @pl.when(pl.program_id(2) == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
+    if fused:
+        _gather_rows(lhs_ref, rows_ref, pl.program_id(0) * bm,
+                     pl.program_id(2) * bk, bk, lx_ref, sem, bm)
+        lblk = lx_ref[...]
+    else:
+        lblk = lhs_ref[...]
+    if scaled:
+        lblk = lblk * scale_ref[...]
+
     dims = (((1,), (1,)), ((), ())) if trans_rhs else (((1,), (0,)), ((), ()))
     acc_ref[...] += jax.lax.dot_general(
-        lhs_ref[...], rhs_ref[...], dims,
+        lblk, rhs_ref[...], dims,
         preferred_element_type=jnp.float32)
 
     @pl.when(pl.program_id(2) == nk - 1)
@@ -91,74 +237,142 @@ def _gmm_kernel(group_ref, lhs_ref, rhs_ref, out_ref, acc_ref, *, nk,
         out_ref[...] = acc_ref[...].astype(out_ref.dtype)
 
 
-def gmm(lhs, rhs, tile_groups, *, bm=512, bn=512, bk=512, trans_rhs=False,
-        interpret=None):
+def gmm(lhs, rhs, tile_groups, *, bm=512, bn=None, bk=None, trans_rhs=False,
+        interpret=None, rows=None, row_scale=None):
     """Grouped matmul: ``out[m, :] = lhs[m, :] @ rhs[tile_groups[m//bm]]``.
 
     lhs: [M, C] with rows grouped by expert, group spans bm-aligned.
     rhs: [E, C, O] ([E, O, C] when ``trans_rhs``).
     tile_groups: [M//bm] int32, nondecreasing, expert id per row-tile.
-    Returns [M, O] in lhs.dtype.
+    bn/bk: explicit tiles win over the autotune cache and the sweep
+    flags (see ``_resolve_tiles``).
+
+    rows: optional int32 [M] fused dispatch gather — lhs is then the
+    UN-permuted token buffer [L, C] and the kernel computes
+    ``out[m] = lhs[rows[m]] @ rhs[group(m)]``, reading lhs rows straight
+    from HBM via scalar-prefetched indices (no [M, C] permuted copy in
+    HBM).  row_scale: optional fp [M] per-row multiplier fused the same
+    way (diag(s) @ lhs[rows] @ rhs — the combine-weight scaling of the
+    MoE backward).  Returns [M, O] in lhs.dtype.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    M, C = lhs.shape
+    M = rows.shape[0] if rows is not None else lhs.shape[0]
+    C = lhs.shape[1]
     E = rhs.shape[0]
     O = rhs.shape[1] if trans_rhs else rhs.shape[2]
+    if M % bm:
+        raise ValueError(f"M ({M}) must be a multiple of bm ({bm})")
     mode = _mode(interpret)
     if mode is None:
         return _gmm_reference(lhs, rhs, tile_groups, bm=bm,
-                              trans_rhs=trans_rhs)
-    if M % bm:
-        raise ValueError(f"M ({M}) must be a multiple of bm ({bm})")
-    bn = _pick_block(O, flags.flag("grouped_matmul_bn") or bn)
-    bk = _pick_block(C, flags.flag("grouped_matmul_bk") or bk)
+                              trans_rhs=trans_rhs, rows=rows,
+                              row_scale=row_scale)
+    bn, bk = _resolve_tiles("gmm_t" if trans_rhs else "gmm", M, C, O, E,
+                            bm, lhs.dtype, bn, bk, mode)
     nk = C // bk
 
-    rhs_spec = (
-        pl.BlockSpec((None, bn, bk), lambda i, j, k, g: (g[i], j, k))
+    fused = rows is not None and flags.flag("grouped_matmul_fused_gather")
+    if rows is not None and not fused:
+        lhs = jnp.take(lhs, rows, axis=0)
+    scaled = fused and row_scale is not None
+    if row_scale is not None and not scaled:   # scale without fused gather
+        lhs = lhs * row_scale[:, None].astype(lhs.dtype)
+
+    scalars = [tile_groups.astype(jnp.int32)]
+    in_specs = []
+    operands = []
+    if fused:
+        scalars.append(rows.astype(jnp.int32))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+    else:
+        in_specs.append(
+            pl.BlockSpec((bm, bk), lambda i, j, k, g, *_: (i, k)))
+    operands.append(lhs)
+    in_specs.append(
+        pl.BlockSpec((None, bn, bk), lambda i, j, k, g, *_: (g[i], j, k))
         if trans_rhs else
-        pl.BlockSpec((None, bk, bn), lambda i, j, k, g: (g[i], k, j)))
+        pl.BlockSpec((None, bk, bn), lambda i, j, k, g, *_: (g[i], k, j)))
+    operands.append(rhs)
+    if scaled:
+        in_specs.append(
+            pl.BlockSpec((bm, 1), lambda i, j, k, g, *_: (i, 0)))
+        operands.append(row_scale.reshape(M, 1).astype(lhs.dtype))
+
+    scratch = []
+    if fused:
+        scratch.append(pltpu.VMEM((bm, bk), lhs.dtype))
+    scratch.append(pltpu.VMEM((bm, bn), jnp.float32))
+    if fused:
+        scratch.append(pltpu.SemaphoreType.DMA(()))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=len(scalars),
         grid=(M // bm, O // bn, nk),
-        in_specs=[
-            pl.BlockSpec((bm, bk), lambda i, j, k, g: (i, k)),
-            rhs_spec,
-        ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k, g: (i, j)),
-        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k, g, *_: (i, j)),
+        scratch_shapes=scratch,
     )
-    kernel = functools.partial(_gmm_kernel, nk=nk, trans_rhs=trans_rhs)
+    kernel = functools.partial(_gmm_kernel, nk=nk, trans_rhs=trans_rhs,
+                               bm=bm, bk=bk, fused=fused, scaled=scaled)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((M, O), lhs.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pltpu.TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=(mode == "interpret"),
-    )(tile_groups.astype(jnp.int32), lhs, rhs)
+    )(*scalars, *operands)
 
 
 # ----------------------------------------------------------------- tgmm ---
 
-def _tgmm_kernel(group_ref, lhs_ref, rhs_ref, out_ref, acc_ref, *, nm):
+def _tgmm_kernel(*refs, nm, bm, bk, bn, lfused, rfused, rscaled):
     from jax.experimental import pallas as pl
 
-    i = pl.program_id(2)
-    g_here = group_ref[i]
-    first = jnp.logical_or(i == 0,
-                           group_ref[jnp.maximum(i - 1, 0)] != g_here)
+    it = iter(refs)
+    group_ref = next(it)
+    lrows_ref = next(it) if lfused else None
+    rrows_ref = next(it) if rfused else None
+    lhs_ref = next(it)
+    rhs_ref = next(it)
+    scale_ref = next(it) if rscaled else None
+    out_ref = next(it)
+    lx_ref = next(it) if lfused else None
+    rx_ref = next(it) if rfused else None
+    acc_ref = next(it)
+    sem = next(it) if (lfused or rfused) else None
+
+    m = pl.program_id(2)
+    g_here = group_ref[m]
+    first = jnp.logical_or(m == 0,
+                           group_ref[jnp.maximum(m - 1, 0)] != g_here)
     last = jnp.logical_or(
-        i == nm - 1, group_ref[jnp.minimum(i + 1, nm - 1)] != g_here)
+        m == nm - 1, group_ref[jnp.minimum(m + 1, nm - 1)] != g_here)
 
     @pl.when(first)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
+    base = m * bm
+    if lfused:
+        _gather_rows(lhs_ref, lrows_ref, base, pl.program_id(0) * bk, bk,
+                     lx_ref, sem, bm)
+        lblk = lx_ref[...]
+    else:
+        lblk = lhs_ref[...]
+    if rfused:
+        _gather_rows(rhs_ref, rrows_ref, base, pl.program_id(1) * bn, bn,
+                     rx_ref, sem, bm)
+        rblk = rx_ref[...]
+    else:
+        rblk = rhs_ref[...]
+    if rscaled:
+        rblk = rblk * scale_ref[...]
+
     acc_ref[...] += jax.lax.dot_general(
-        lhs_ref[...], rhs_ref[...], (((0,), (0,)), ((), ())),
+        lblk, rblk, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
     @pl.when(last)
@@ -166,12 +380,16 @@ def _tgmm_kernel(group_ref, lhs_ref, rhs_ref, out_ref, acc_ref, *, nm):
         out_ref[...] = acc_ref[...].astype(out_ref.dtype)
 
 
-def tgmm(lhs, rhs, tile_groups, num_groups, *, bm=512, bn=512, bk=512,
-         interpret=None):
+def tgmm(lhs, rhs, tile_groups, num_groups, *, bm=512, bn=None, bk=None,
+         interpret=None, lhs_rows=None, rhs_rows=None, rhs_scale=None):
     """Transposed grouped matmul (the weight gradient):
     ``out[e] = sum over e's rows of lhs[m, :]^T @ rhs[m, :]``.
 
     lhs: [M, K]; rhs: [M, N]; both row-grouped as in gmm.
+    ``lhs_rows`` / ``rhs_rows``: optional fused row gathers (as ``rows``
+    in :func:`gmm`) — the named operand is then an un-permuted [L, dim]
+    buffer indexed per padded row; ``rhs_scale`` fuses a per-row
+    multiplier onto the gathered rhs rows (lhs^T @ diag(s) @ rhs[rows]).
     A group owning zero tiles gets an explicitly zeroed output block (the
     kernel only writes blocks it visits; the mask below covers truncated
     dispatch plans where a tail expert's span was cut).  Returns
@@ -180,81 +398,164 @@ def tgmm(lhs, rhs, tile_groups, num_groups, *, bm=512, bn=512, bk=512,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    M, K = lhs.shape
+    M = lhs_rows.shape[0] if lhs_rows is not None else lhs.shape[0]
+    K = lhs.shape[1]
     N = rhs.shape[1]
-    mode = _mode(interpret)
-    if mode is None:
-        return _tgmm_reference(lhs, rhs, tile_groups, num_groups, bm=bm)
     if M % bm:
         raise ValueError(f"M ({M}) must be a multiple of bm ({bm})")
-    bk = _pick_block(K, flags.flag("grouped_matmul_bk") or bk)
-    bn = _pick_block(N, flags.flag("grouped_matmul_bn") or bn)
+    mode = _mode(interpret)
+    if mode is None:
+        return _tgmm_reference(lhs, rhs, tile_groups, num_groups, bm=bm,
+                               lhs_rows=lhs_rows, rhs_rows=rhs_rows,
+                               rhs_scale=rhs_scale)
+    bn, bk = _resolve_tiles("tgmm", M, K, N, num_groups, bm, lhs.dtype,
+                            bn, bk, mode)
     nm = M // bm
 
+    fuse = flags.flag("grouped_matmul_fused_gather")
+    if lhs_rows is not None and not fuse:
+        lhs, lhs_rows = jnp.take(lhs, lhs_rows, axis=0), None
+    if rhs_rows is not None and not fuse:
+        rhs = jnp.take(rhs, rhs_rows, axis=0)
+        if rhs_scale is not None:
+            rhs = rhs * rhs_scale[:, None].astype(rhs.dtype)
+        rhs_rows, rhs_scale = None, None
+    lfused = lhs_rows is not None
+    rfused = rhs_rows is not None
+    rscaled = rfused and rhs_scale is not None
+    if rhs_scale is not None and not rfused:
+        rhs = rhs * rhs_scale[:, None].astype(rhs.dtype)
+
+    scalars = [tile_groups.astype(jnp.int32)]
+    in_specs = []
+    operands = []
+    if lfused:
+        scalars.append(lhs_rows.astype(jnp.int32))
+    if rfused:
+        scalars.append(rhs_rows.astype(jnp.int32))
+    in_specs.append(
+        pl.BlockSpec(memory_space=pltpu.ANY) if lfused else
+        pl.BlockSpec((bm, bk), lambda k, j, i, g, *_: (i, k)))
+    operands.append(lhs)
+    in_specs.append(
+        pl.BlockSpec(memory_space=pltpu.ANY) if rfused else
+        pl.BlockSpec((bm, bn), lambda k, j, i, g, *_: (i, j)))
+    operands.append(rhs)
+    if rscaled:
+        in_specs.append(
+            pl.BlockSpec((bm, 1), lambda k, j, i, g, *_: (i, 0)))
+        operands.append(rhs_scale.reshape(M, 1).astype(rhs.dtype))
+
+    scratch = []
+    if lfused:
+        scratch.append(pltpu.VMEM((bm, bk), lhs.dtype))
+    if rfused:
+        scratch.append(pltpu.VMEM((bm, bn), rhs.dtype))
+    scratch.append(pltpu.VMEM((bk, bn), jnp.float32))
+    if lfused or rfused:
+        scratch.append(pltpu.SemaphoreType.DMA(()))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=len(scalars),
         grid=(K // bk, N // bn, nm),          # m innermost: consecutive
-        in_specs=[                            # visits per expert block
-            pl.BlockSpec((bm, bk), lambda k, j, i, g: (i, k)),
-            pl.BlockSpec((bm, bn), lambda k, j, i, g: (i, j)),
-        ],
+        in_specs=in_specs,                    # visits per expert block
         out_specs=pl.BlockSpec((None, bk, bn),
-                               lambda k, j, i, g: (g[i], k, j)),
-        scratch_shapes=[pltpu.VMEM((bk, bn), jnp.float32)],
+                               lambda k, j, i, g, *_: (g[i], k, j)),
+        scratch_shapes=scratch,
     )
-    kernel = functools.partial(_tgmm_kernel, nm=nm)
+    kernel = functools.partial(_tgmm_kernel, nm=nm, bm=bm, bk=bk, bn=bn,
+                               lfused=lfused, rfused=rfused,
+                               rscaled=rscaled)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_groups, K, N), lhs.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pltpu.TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=(mode == "interpret"),
-    )(tile_groups.astype(jnp.int32), lhs, rhs)
+    )(*scalars, *operands)
     visited = jnp.zeros((num_groups,), bool).at[tile_groups].set(True)
     return jnp.where(visited[:, None, None], out, 0)
 
 
 # ------------------------------------------------- XLA reference (CPU) ---
 
-def _row_groups(tile_groups, bm, M):
-    return jnp.repeat(tile_groups.astype(jnp.int32), bm,
-                      total_repeat_length=M)
+def _gmm_reference(lhs, rhs, tile_groups, *, bm, trans_rhs=False, rows=None,
+                   row_scale=None):
+    """Oracle/CPU fallback: gather each row-tile's expert weights and run
+    one batched matmul — M*K*N flops (no E-fold masking), fp32 accum."""
+    if rows is not None:
+        lhs = jnp.take(lhs, rows, axis=0)
+    if row_scale is not None:
+        lhs = lhs * row_scale[:, None].astype(lhs.dtype)
+    M, C = lhs.shape
+    T = M // bm
+    w = jnp.take(rhs, tile_groups.astype(jnp.int32), axis=0)
+    eq = "tbc,toc->tbo" if trans_rhs else "tbc,tco->tbo"
+    out = jnp.einsum(eq, lhs.reshape(T, bm, C), w,
+                     preferred_element_type=jnp.promote_types(
+                         lhs.dtype, jnp.float32))
+    return out.reshape(M, -1).astype(lhs.dtype)
 
 
-def _gmm_reference(lhs, rhs, tile_groups, *, bm, trans_rhs=False):
-    """Oracle: scan over experts, masked dense matmul each (E-fold flops —
-    tests and CPU fallback only)."""
+def _tgmm_reference(lhs, rhs, tile_groups, num_groups, *, bm, lhs_rows=None,
+                    rhs_rows=None, rhs_scale=None):
+    if lhs_rows is not None:
+        lhs = jnp.take(lhs, lhs_rows, axis=0)
+    if rhs_rows is not None:
+        rhs = jnp.take(rhs, rhs_rows, axis=0)
+    if rhs_scale is not None:
+        rhs = rhs * rhs_scale[:, None].astype(rhs.dtype)
     M = lhs.shape[0]
-    rg = _row_groups(tile_groups, bm, M)
-
-    def step(acc, e):
-        w = rhs[e].T if trans_rhs else rhs[e]
-        part = (jnp.where((rg == e)[:, None], lhs, 0) @ w)
-        return acc + part.astype(acc.dtype), None
-
-    O = rhs.shape[1] if trans_rhs else rhs.shape[2]
-    # seed the carry from the operands so it inherits their varying manual
-    # axes under shard_map (a plain zeros carry trips the scan vma check)
-    seed = (lhs.ravel()[0] * 0).astype(jnp.float32) + \
-        (rhs.ravel()[0] * 0).astype(jnp.float32)
-    acc = jnp.zeros((M, O), jnp.float32) + seed
-    acc, _ = jax.lax.scan(step, acc, jnp.arange(rhs.shape[0]))
-    return acc.astype(lhs.dtype)
-
-
-def _tgmm_reference(lhs, rhs, tile_groups, num_groups, *, bm):
-    M = lhs.shape[0]
-    rg = _row_groups(tile_groups, bm, M)
-
-    def per_expert(e):
-        return (jnp.where((rg == e)[:, None], lhs, 0).T @ rhs)
-
-    out = jax.lax.map(per_expert, jnp.arange(num_groups))
+    T = M // bm
+    per_tile = jnp.einsum("tbk,tbn->tkn", lhs.reshape(T, bm, -1),
+                          rhs.reshape(T, bm, -1),
+                          preferred_element_type=jnp.promote_types(
+                              lhs.dtype, jnp.float32))
+    out = jax.ops.segment_sum(per_tile, tile_groups.astype(jnp.int32),
+                              num_segments=num_groups)
     return out.astype(lhs.dtype)
 
 
 # ------------------------------------------------------- dispatch plan ---
+
+def take_sentinel_rows(buf, idx):
+    """Gather rows of ``buf`` treating any index >= ``buf.shape[0]`` as
+    the dispatch maps' dropped/pad SENTINEL: those positions read an
+    exact zero row (and their AD transpose writes nowhere real).  Every
+    dispatch/combine gather of the MoE paths goes through this one
+    helper so the drop-to-zero semantics stay single-sourced."""
+    pad = jnp.zeros((1,) + buf.shape[1:], buf.dtype)
+    z = jnp.concatenate([buf, pad], axis=0)
+    return jnp.take(z, jnp.minimum(idx, buf.shape[0]), axis=0)
+
+
+def capacity_dispatch_plan(expert_ids, gate_vals, num_groups, capacity):
+    """k-major capacity dispatch maps — the "gather" formulation shared by
+    ``models.llama.moe_mlp_forward`` and the incubate ``MoELayer``.
+
+    expert_ids/gate_vals: [N, K] top-k routing.  Slot priority is k-major
+    (every token's first choice beats any second choice); position within
+    an expert's buffer is the cumsum rank among entries routed to it;
+    entries ranked past ``capacity`` drop.  Returns
+    (inv [E*capacity + 1], slot [K*N], gate_keep [K*N], keep [K*N]):
+    ``inv[b]`` = token id in buffer slot b (N = empty sentinel);
+    ``slot[f]`` = buffer slot of k-major flat entry f (E*capacity = drop
+    sentinel — gather combines through :func:`take_sentinel_rows`);
+    ``gate_keep`` = combine weight, zeroed for drops."""
+    N, K = expert_ids.shape
+    i32 = jnp.int32
+    idx_flat = expert_ids.T.reshape(K * N).astype(i32)
+    val_flat = gate_vals.T.reshape(K * N).astype(jnp.float32)
+    oh = jax.nn.one_hot(idx_flat, num_groups, dtype=jnp.float32)
+    pos = jnp.sum(jnp.cumsum(oh, axis=0) * oh - oh, axis=-1).astype(i32)
+    keep = pos < capacity
+    slot = jnp.where(keep, idx_flat * capacity + pos,
+                     num_groups * capacity)
+    inv = jnp.full((num_groups * capacity + 1,), N, i32) \
+        .at[slot].set(jnp.tile(jnp.arange(N, dtype=i32), K))
+    return inv, slot, val_flat * keep.astype(jnp.float32), keep
+
 
 def sorted_dispatch_plan(expert_ids, num_groups, bm):
     """Build the gather maps for a grouped-GEMM dispatch.
@@ -301,16 +602,22 @@ def sorted_dispatch_plan(expert_ids, num_groups, bm):
 # ------------------------------------------------------ differentiable ---
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def grouped_matmul(lhs, rhs, tile_groups, num_groups, bm=512, bn=512,
-                   bk=512):
+def grouped_matmul(lhs, rhs, tile_groups, num_groups, bm=512, bn=None,
+                   bk=None):
     """Differentiable grouped matmul: ``gmm`` forward; backward runs
     ``gmm`` against the transposed expert weights (dlhs) and ``tgmm``
     (drhs).  All three are ragged — the gradient FLOPs also scale with
     actual tokens-per-expert."""
+    if bn is None or bk is None:
+        validate_tile_flags(lhs.shape[1], rhs.shape[2])
     return gmm(lhs, rhs, tile_groups, bm=bm, bn=bn, bk=bk)
 
 
 def _grouped_matmul_fwd(lhs, rhs, tile_groups, num_groups, bm, bn, bk):
+    if bn is None or bk is None:
+        # flag-overridden tiles must fit BOTH the forward (bn|O, bk|C) and
+        # backward (bn|C, bk|O via trans_rhs + tgmm) operand shapes
+        validate_tile_flags(lhs.shape[1], rhs.shape[2])
     out = gmm(lhs, rhs, tile_groups, bm=bm, bn=bn, bk=bk)
     return out, (lhs, rhs, tile_groups)
 

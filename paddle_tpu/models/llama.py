@@ -57,12 +57,15 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_weight: float = 0.01
-    # "gather": int32 scatter + row gather (global capacity); "einsum":
-    # GShard/t5x one-hot matmul dispatch (per-group capacity); "grouped":
-    # expert-sorted ragged GEMM Pallas kernel (no capacity, no drops —
-    # the fast single-chip path; requires ep=1: the kernel runs inside
-    # one shard).  The bench measures them; see BENCH notes.
-    moe_dispatch: str = "gather"
+    # "grouped" (the default): expert-sorted ragged GEMM Pallas kernels —
+    # no capacity padding, no drops on one chip; on a dp x ep x mp mesh it
+    # runs the shard_map formulation (replicated router + ragged local
+    # GEMM + one psum, capacity-bounded per shard).  "gather": int32
+    # scatter + row gather (global capacity) and "einsum": GShard/t5x
+    # one-hot matmul dispatch (per-group capacity) are kept as reference
+    # oracles for parity tests and A/B baselines.  The bench measures all
+    # three; see benchmarks/README.md for the dispatch-mode matrix.
+    moe_dispatch: str = "grouped"
     moe_groups: int = 0          # einsum only: token groups (0 -> batch dim)
     moe_block_m: int = 512       # grouped only: row-tile (group alignment)
     # parallel knobs (consumed by llama_shard_plan / trainer)
@@ -107,7 +110,11 @@ class LlamaConfig:
         base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
                     num_hidden_layers=2, num_attention_heads=4,
                     num_key_value_heads=2, max_position_embeddings=128,
-                    moe_num_experts=4, moe_top_k=2, dtype="float32")
+                    moe_num_experts=4, moe_top_k=2, dtype="float32",
+                    # tiny token counts: a 512-row tile would pad the
+                    # grouped dispatch ~10x; 16 keeps M within ~1.3x of
+                    # the routed entries (TPU bench configs keep 512)
+                    moe_block_m=16)
         base.update(kw)
         return LlamaConfig(**base)
 
@@ -319,34 +326,24 @@ def moe_mlp_forward(x, gate_w, w_gate, w_up, w_down, *, top_k,
     topv, topi, aux, ce = _route_topk(xf, gate_w, k)
 
     cap = max(1, int(N * k * capacity_factor / E))
-    # k-major priority: every token's first choice beats any second choice
-    idx_flat = topi.T.reshape(k * N)                      # [kN]
-    gate_flat = topv.T.reshape(k * N).astype(jnp.float32)
-    oh = jax.nn.one_hot(idx_flat, E, dtype=jnp.float32)
-    pos = jnp.sum(jnp.cumsum(oh, axis=0) * oh - oh, axis=-1)  # 0-based slot
-    pos = pos.astype(jnp.int32)
-    keep = pos < cap
-    slot = jnp.where(keep, idx_flat * cap + pos, E * cap)  # OOB -> dropped
-
     # Dispatch = scatter the scalar TOKEN id per slot, then gather rows from
     # xf: slots are unique by construction (cumsum position within expert),
     # so a row scatter-add is equivalent — but TPU lowers row scatters to
     # serialized per-row updates, while an int32 scatter + row gather stays
     # vectorized (1 word/slot scattered, [N+1, H] touched instead of
-    # 2*[kN, H]).  Flat entry r routes token r % N; unfilled slots hit the
-    # appended zero row.
-    xf_z = jnp.concatenate([xf, jnp.zeros((1, H), x.dtype)], axis=0)
-    tok_ids = jnp.tile(jnp.arange(N, dtype=jnp.int32), k)  # [kN]
-    inv = jnp.full((E * cap + 1,), N, jnp.int32).at[slot].set(tok_ids)
-    expert_in = xf_z[inv[:-1]].reshape(E, cap, H)
+    # 2*[kN, H]).  The k-major slot/inv maps (and their drop sentinels)
+    # are single-sourced in kernels.grouped_matmul.capacity_dispatch_plan.
+    from ..kernels.grouped_matmul import (capacity_dispatch_plan,
+                                          take_sentinel_rows)
+    inv, slot, gate_keep, keep = capacity_dispatch_plan(topi, topv, E, cap)
+    expert_in = take_sentinel_rows(xf, inv[:-1]).reshape(E, cap, H)
 
     h1 = jax.nn.silu(jnp.einsum("ech,ehi->eci", expert_in, w_gate)) * \
         jnp.einsum("ech,ehi->eci", expert_in, w_up)
     out_e = jnp.einsum("eci,eih->ech", h1, w_down).reshape(E * cap, H)
 
-    gathered = jnp.take(out_e, jnp.minimum(slot, E * cap - 1), axis=0)
-    yf = gathered * (gate_flat * keep.astype(jnp.float32))[:, None] \
-        .astype(x.dtype)
+    gathered = take_sentinel_rows(out_e, slot)
+    yf = gathered * gate_keep[:, None].astype(x.dtype)
     y = yf.reshape(k, N, H).sum(axis=0).reshape(B, S, H)
     stats = jnp.stack([keep.mean().astype(jnp.float32),
                        ce.max() * jnp.float32(E)])
@@ -446,7 +443,16 @@ def _grouped_ffn(xf, w_gate, w_up, w_down, gates, inv_flat, pos,
     ``sorted_dispatch_plan``.  Dispatch and combine are GATHERS and the
     hand-written VJP keeps them gathers in reverse (the AD transpose of a
     gather is a scatter-add, which TPU serializes row-by-row — the
-    whole point of carrying both maps is never to emit one).
+    whole point of carrying both maps is never to emit one).  The
+    dispatch gathers ride INSIDE the grouped-matmul kernels (scalar-
+    prefetched row indices, kernels/grouped_matmul.py) so no ``[M, H]``
+    permuted activation copy ever lands in HBM, forward or backward.
+
+    ``pos`` entries >= M (the padded-buffer row count) are a DROPPED-
+    entry sentinel: combine and the dx gather go through a zero-extended
+    buffer, so dropped (token, choice) entries contribute exactly zero in
+    both directions (the capacity-overflow semantics of the sharded
+    path; single-device plans never emit the sentinel).
     """
     y, _ = _grouped_ffn_fwd(xf, w_gate, w_up, w_down, gates, inv_flat,
                             pos, tile_groups, E, k, bm)
@@ -455,60 +461,64 @@ def _grouped_ffn(xf, w_gate, w_up, w_down, gates, inv_flat, pos,
 
 def _grouped_ffn_fwd(xf, w_gate, w_up, w_down, gates, inv_flat, pos,
                      tile_groups, E, k, bm):
-    from ..kernels.grouped_matmul import gmm
+    from ..kernels.grouped_matmul import (gmm, take_sentinel_rows,
+                                          validate_tile_flags)
 
     N, H = xf.shape
+    # sweep flags must tile H AND I: the backward swaps their roles
+    validate_tile_flags(H, w_gate.shape[2])
     xz = jnp.concatenate([xf, jnp.zeros((1, H), xf.dtype)], axis=0)
     tok_of = jnp.where(inv_flat < N * k, inv_flat // k, N)
-    x_pad = jnp.take(xz, tok_of, axis=0)                  # [M, H] gather
-    h_g = gmm(x_pad, w_gate, tile_groups, bm=bm)
-    h_u = gmm(x_pad, w_up, tile_groups, bm=bm)
+    h_g = gmm(xz, w_gate, tile_groups, bm=bm, rows=tok_of)  # fused gather
+    h_u = gmm(xz, w_up, tile_groups, bm=bm, rows=tok_of)
     a = jax.nn.silu(h_g) * h_u
     o = gmm(a, w_down, tile_groups, bm=bm)                # [M, H]
-    o_pos = jnp.take(o, pos, axis=0).reshape(N, k, H)     # combine gather
+    # combine gather: sentinel pos >= M (dropped entries) reads zero
+    o_pos = take_sentinel_rows(o, pos).reshape(N, k, H)
     y = (o_pos * gates[..., None].astype(o.dtype)).sum(axis=1)
-    return y, (xf, w_gate, w_up, w_down, gates, inv_flat, pos, tile_groups)
+    # h_g/h_u/o ride as residuals: under the training configs' remat the
+    # whole block is recomputed anyway (storing is free there), and
+    # without remat this saves re-running 3 of the 9 grouped GEMMs
+    return y, (xf, w_gate, w_up, w_down, gates, inv_flat, pos, tile_groups,
+               h_g, h_u, o)
 
 
 def _grouped_ffn_bwd(E, k, bm, res, dy):
-    from ..kernels.grouped_matmul import gmm, tgmm
+    from ..kernels.grouped_matmul import gmm, take_sentinel_rows, tgmm
 
-    xf, w_gate, w_up, w_down, gates, inv_flat, pos, tile_groups = res
+    (xf, w_gate, w_up, w_down, gates, inv_flat, pos, tile_groups,
+     h_g, h_u, o) = res
     N, H = xf.shape
-    # recompute the forward intermediates (full-remat semantics — the
-    # training configs run the block under remat anyway)
     xz = jnp.concatenate([xf, jnp.zeros((1, H), xf.dtype)], axis=0)
     tok_of = jnp.where(inv_flat < N * k, inv_flat // k, N)
-    x_pad = jnp.take(xz, tok_of, axis=0)
-    h_g = gmm(x_pad, w_gate, tile_groups, bm=bm)
-    h_u = gmm(x_pad, w_up, tile_groups, bm=bm)
     sg = jax.nn.silu(h_g)
     a = sg * h_u
-    o = gmm(a, w_down, tile_groups, bm=bm)
 
-    o_pos = jnp.take(o, pos, axis=0).reshape(N, k, H)
+    o_pos = take_sentinel_rows(o, pos).reshape(N, k, H)
     d_gates = (o_pos.astype(jnp.float32)
                * dy[:, None, :].astype(jnp.float32)).sum(-1)  # [N, k]
 
-    # d(combine): do[p] = gate(p) * dy[token(p)] — both gathers
-    gate_z = jnp.concatenate(
-        [gates.reshape(N * k).astype(dy.dtype), jnp.zeros((1,), dy.dtype)])
-    gate_pad = jnp.take(gate_z, jnp.minimum(inv_flat, N * k))
+    # d(combine): do[p] = gate(p) * dy[token(p)] — both gathers, fused
+    # into the kernels below as (rows, row_scale) so do never materializes
+    gate_pad = take_sentinel_rows(
+        gates.reshape(N * k).astype(dy.dtype), inv_flat)        # [M]
     dy_z = jnp.concatenate([dy, jnp.zeros((1, H), dy.dtype)], axis=0)
-    do = gate_pad[:, None] * jnp.take(dy_z, tok_of, axis=0)   # [M, H]
 
-    da = gmm(do, w_down, tile_groups, bm=bm, trans_rhs=True)  # [M, I]
+    da = gmm(dy_z, w_down, tile_groups, bm=bm, trans_rhs=True,
+             rows=tok_of, row_scale=gate_pad)                 # [M, I]
     sig = jax.nn.sigmoid(h_g.astype(jnp.float32)).astype(h_g.dtype)
     dsilu = sig + h_g * sig * (1 - sig)
     dh_g = da * h_u * dsilu
     dh_u = da * sg
-    dw_d = tgmm(a, do, tile_groups, E, bm=bm)
-    dw_g = tgmm(x_pad, dh_g, tile_groups, E, bm=bm)
-    dw_u = tgmm(x_pad, dh_u, tile_groups, E, bm=bm)
+    dw_d = tgmm(a, dy_z, tile_groups, E, bm=bm, rhs_rows=tok_of,
+                rhs_scale=gate_pad)
+    dw_g = tgmm(xz, dh_g, tile_groups, E, bm=bm, lhs_rows=tok_of)
+    dw_u = tgmm(xz, dh_u, tile_groups, E, bm=bm, lhs_rows=tok_of)
     dx_pad = gmm(dh_g, w_gate, tile_groups, bm=bm, trans_rhs=True) + \
         gmm(dh_u, w_up, tile_groups, bm=bm, trans_rhs=True)   # [M, H]
-    # d(dispatch): token t accumulates its k buffer rows — a gather
-    dxf = jnp.take(dx_pad, pos, axis=0).reshape(N, k, H).sum(axis=1)
+    # d(dispatch): token t accumulates its k buffer rows — a gather;
+    # dropped entries read the sentinel zero row (exactly-zero gradient)
+    dxf = take_sentinel_rows(dx_pad, pos).reshape(N, k, H).sum(axis=1)
 
     f0 = lambda t: np.zeros(t.shape, jax.dtypes.float0)
     return (dxf.astype(xf.dtype), dw_g.astype(w_gate.dtype),
@@ -616,8 +626,16 @@ def moe_mlp_forward_grouped_sharded(x, gate_w, w_gate, w_up, w_down, *,
             inv, n * k)[:M_loc]
         keep = (pos < M_loc) & own_flat
         gates = topv * keep.reshape(n, k)
-        pos_t = jnp.minimum(pos, M_loc - 1)
+        # dropped (token, choice) entries go to the M_loc SENTINEL row:
+        # _grouped_ffn combines/backpropagates them through a zero-
+        # extended buffer, so they get exactly-zero output AND gradient.
+        # (Clamping to M_loc-1 instead — the pre-fix behavior — silently
+        # accumulated a real kept row's dx into unrelated tokens under
+        # capacity overflow.)
+        pos_t = jnp.where(keep.reshape(n * k), pos, M_loc)
         tg_t = jnp.minimum(tg[:M_loc // bm], E_loc - 1)
+        # (jax.lax.pvary is the package-init no-op shim on the pinned
+        # jax — shard_map there runs check_rep=False)
         xf_v = jax.lax.pvary(xf, (ep_axis, mp_axis))  # x replicated there
         wg_v, wu_v, wd_v = (jax.lax.pvary(t, (dp_axis,))
                             for t in (wg, wu, wd))    # weights: over dp

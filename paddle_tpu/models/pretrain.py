@@ -291,7 +291,11 @@ class PretrainStep:
                 lambda p: moment_like(p, self.pc.m_dtype), params),
             "v": jax.tree_util.tree_map(
                 lambda p: moment_like(p, self.pc.v_dtype), params),
-            "step": jnp.zeros((), jnp.int32),
+            # committed to the mesh (replicated) so the whole state tree
+            # shares one device set — train_step pins state shardings on
+            # both sides of the jit to keep the step single-compile
+            "step": jax.device_put(jnp.zeros((), jnp.int32),
+                                   NamedSharding(self.mesh, P())),
         }
         return state
 
@@ -564,6 +568,10 @@ class PretrainStep:
 
     # ---- the jitted step ----
     def train_step(self, state, ids, labels):
+        if not (hasattr(ids, "sharding") and hasattr(labels, "sharding")):
+            # raw host arrays (either of them): place both on the mesh
+            ids, labels = self.shard_batch(np.asarray(ids),
+                                           np.asarray(labels))
         if self._jit_step is None:
             if self.pc.schedule in ("1f1b", "zbh1", "zbvpp"):
                 def step(state, ids, labels):
@@ -576,7 +584,17 @@ class PretrainStep:
                         lambda p: self._forward_loss(p, ids, labels))(state["params"])
                     return self._update(state, grads), loss
 
-            self._jit_step = jax.jit(step, donate_argnums=(0,))
+            # pin the state's shardings on BOTH sides of the program:
+            # without out_shardings XLA is free to hand the updated state
+            # back replicated/unspecified, and the next call — now seeing
+            # different input shardings — silently recompiles the whole
+            # step (one wasted multi-second compile per process, and the
+            # short-window bench reads it as throughput)
+            sh = jax.tree_util.tree_map(lambda a: a.sharding, state)
+            self._jit_step = jax.jit(
+                step, donate_argnums=(0,),
+                in_shardings=(sh, ids.sharding, labels.sharding),
+                out_shardings=(sh, None))
         return self._jit_step(state, ids, labels)
 
     def eval_loss(self, state, ids, labels):

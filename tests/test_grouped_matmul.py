@@ -83,6 +83,132 @@ class TestKernels:
         np.testing.assert_array_equal(np.asarray(out[1]), 0.0)
 
 
+class TestFusedGather:
+    """The in-kernel dispatch permutation: gmm/tgmm with scalar-prefetched
+    row indices (+ optional per-row scale) must match materialize-then-
+    multiply, in interpret mode (same code path Mosaic compiles)."""
+
+    M, K, N, E, bm, L = 32, 128, 256, 3, 8, 21
+    tg = jnp.asarray([0, 0, 1, 2], jnp.int32)
+
+    def _rows(self):
+        rng = np.random.default_rng(5)
+        return jnp.asarray(rng.integers(0, self.L, self.M), jnp.int32)
+
+    def test_gmm_rows_matches_materialized(self, interp):
+        lhs = _rand((self.L, self.K))
+        rhs = _rand((self.E, self.K, self.N), seed=1)
+        rows = self._rows()
+        out = G.gmm(lhs, rhs, self.tg, bm=self.bm, rows=rows)
+        ref = G.gmm(jnp.take(lhs, rows, axis=0), rhs, self.tg, bm=self.bm)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_gmm_rows_scale_trans(self, interp):
+        lhs = _rand((self.L, self.N))          # trans: contract over N
+        rhs = _rand((self.E, self.K, self.N), seed=1)
+        rows = self._rows()
+        scale = _rand((self.M,), seed=6)
+        out = G.gmm(lhs, rhs, self.tg, bm=self.bm, trans_rhs=True,
+                    rows=rows, row_scale=scale)
+        ref = G.gmm(jnp.take(lhs, rows, axis=0) * scale[:, None], rhs,
+                    self.tg, bm=self.bm, trans_rhs=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_tgmm_fused_rows_and_scale(self, interp):
+        lhs = _rand((self.L, self.K))
+        rhs = _rand((self.L, self.N), seed=1)
+        lrows, rrows = self._rows(), self._rows()
+        scale = _rand((self.M,), seed=7)
+        out = G.tgmm(lhs, rhs, self.tg, self.E, bm=self.bm,
+                     lhs_rows=lrows, rhs_rows=rrows, rhs_scale=scale)
+        ref = G.tgmm(jnp.take(lhs, lrows, axis=0),
+                     jnp.take(rhs, rrows, axis=0) * scale[:, None],
+                     self.tg, self.E, bm=self.bm)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_fused_gather_flag_off_parity(self, interp):
+        lhs = _rand((self.L, self.K))
+        rhs = _rand((self.E, self.K, self.N), seed=1)
+        rows = self._rows()
+        fused = G.gmm(lhs, rhs, self.tg, bm=self.bm, rows=rows)
+        flags.set_flags({"FLAGS_grouped_matmul_fused_gather": False})
+        try:
+            unfused = G.gmm(lhs, rhs, self.tg, bm=self.bm, rows=rows)
+        finally:
+            flags.set_flags({"FLAGS_grouped_matmul_fused_gather": True})
+        np.testing.assert_allclose(fused, unfused, rtol=1e-5, atol=1e-5)
+
+
+class TestTileSelection:
+    """Explicit bn/bk > autotune cache > sweep flags > 512 default; flag
+    values that cannot tile the backward shapes fail fast at forward
+    time with the flag named (ADVICE r5 low)."""
+
+    @pytest.fixture(autouse=True)
+    def _isolated_autotune(self, tmp_path):
+        from paddle_tpu.kernels import autotune
+        flags.set_flags({"autotune_cache_path": str(tmp_path / "at.json")})
+        autotune.clear()
+        yield
+        autotune.clear()
+        flags.set_flags({"autotune_cache_path": ""})
+
+    def test_explicit_args_beat_flags(self, interp):
+        lhs = _rand((32, 128))
+        rhs = _rand((3, 128, 256), seed=1)
+        tg = jnp.asarray([0, 0, 1, 2], jnp.int32)
+        # 192 tiles neither 128 nor 256 -> the flag default would raise,
+        # but an explicit bn/bk must win and succeed
+        flags.set_flags({"FLAGS_grouped_matmul_bn": 192,
+                         "FLAGS_grouped_matmul_bk": 192})
+        try:
+            out = G.gmm(lhs, rhs, tg, bm=8, bn=128, bk=128)
+            ref = G._gmm_reference(lhs, rhs, tg, bm=8)
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+            with pytest.raises(ValueError):
+                G.gmm(lhs, rhs, tg, bm=8)      # flag default path raises
+        finally:
+            flags.set_flags({"FLAGS_grouped_matmul_bn": 0,
+                             "FLAGS_grouped_matmul_bk": 0})
+
+    def test_bad_flag_fails_fast_with_flag_named(self, interp):
+        lhs = _rand((32, 128))
+        rhs = _rand((3, 128, 256), seed=1)
+        tg = jnp.asarray([0, 0, 1, 2], jnp.int32)
+        flags.set_flags({"FLAGS_grouped_matmul_bk": 192})
+        try:
+            with pytest.raises(ValueError, match="grouped_matmul_bk"):
+                G.grouped_matmul(lhs, rhs, tg, 3, 8)
+        finally:
+            flags.set_flags({"FLAGS_grouped_matmul_bk": 0})
+
+    def test_autotune_cache_beats_flag_default(self):
+        from paddle_tpu.kernels import autotune
+
+        key = autotune.make_key("grouped_matmul_gmm", M=32, K=128, N=256,
+                                E=3, bm=8, dtype="float32")
+        autotune.record(key, (128, 128))
+        try:
+            flags.set_flags({"FLAGS_grouped_matmul_bn": 256})
+            bn, bk = G._resolve_tiles("gmm", 32, 128, 256, 3, 8,
+                                      jnp.float32, None, None, "interpret")
+            assert (bn, bk) == (128, 128)      # measured entry wins
+            bn, bk = G._resolve_tiles("gmm", 32, 128, 256, 3, 8,
+                                      jnp.float32, 256, None, "interpret")
+            assert bn == 256                   # explicit beats everything
+        finally:
+            flags.set_flags({"FLAGS_grouped_matmul_bn": 0})
+            autotune.clear()
+
+    def test_candidates_respect_divisibility(self):
+        from paddle_tpu.kernels import autotune
+
+        cands = autotune.grouped_matmul_candidates(512, 384, 256)
+        assert cands and all(256 % bn == 0 and 384 % bk == 0
+                             for bn, bk in cands)
+        assert (256, 128) in cands
+
+
 class TestDispatchPlan:
     def test_plan_invariants(self):
         rng = np.random.default_rng(0)

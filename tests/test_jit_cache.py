@@ -81,22 +81,69 @@ class TestBucketing:
         assert len(fn._cache) == 3      # the unbucketed baseline behavior
 
     def test_input_exactly_at_bucket_not_truncated(self):
-        # regression (r5 review): input a sits exactly at the bucket (no
-        # padding), input b below it; outputs sized at the bucket must NOT
-        # be sliced down to b's length
+        # regression (r5 review, reworked): input a sits exactly at the
+        # bucket (no padding), input b below it.  Outputs are sliced to
+        # the TRUE shapes recorded from an unpadded run — so a's output
+        # keeps its full 128 rows and b's comes back at b's own length
+        # (the old (axis, size)==bucket heuristic could only give both
+        # outputs one shared length)
         fn = to_static(lambda a, b: (a * 2, b * 2),
                        input_spec=[InputSpec([None, 4], "float32"),
                                    InputSpec([None, 4], "float32")],
                        bucket=[128])
         a = paddle.to_tensor(np.ones((128, 4), "float32"))
         b = paddle.to_tensor(np.ones((100, 4), "float32"))
-        oa, ob = fn(a, b)
-        assert tuple(oa.shape) == (128, 4)
-        assert tuple(ob.shape) == (128, 4)  # b's output keeps the padded
-        # rows too (max true length at this (axis, bucket) is 128); the
-        # pad region is zeros * 2 = zeros
-        np.testing.assert_allclose(ob.numpy()[:100], 2.0)
-        np.testing.assert_allclose(ob.numpy()[100:], 0.0)
+        for _ in range(2):          # eager recording call, then the jit run
+            oa, ob = fn(a, b)
+            assert tuple(oa.shape) == (128, 4)
+            assert tuple(ob.shape) == (100, 4)
+            np.testing.assert_allclose(oa.numpy(), 2.0)
+            np.testing.assert_allclose(ob.numpy(), 2.0)
+
+    def test_bucket_sized_output_axis_not_truncated(self):
+        # ADVICE r5 medium: an output axis that LEGITIMATELY has the
+        # bucket's size at a padded axis position (here: a fixed [128, 8]
+        # projection output while the input's axis 0 pads 100 -> 128) must
+        # not be cut down to the batch's true length
+        fn = to_static(
+            lambda x: (x * 3, paddle.ones([128, 8]) * x.sum(axis=0)),
+            input_spec=[InputSpec([None, 8], "float32")],
+            bucket=[128])
+        x = paddle.to_tensor(np.ones((100, 8), "float32"))
+        for _ in range(2):          # recording call, then the jit run
+            ox, proj = fn(x)
+            assert tuple(ox.shape) == (100, 8)
+            assert tuple(proj.shape) == (128, 8)   # NOT truncated to 100
+            np.testing.assert_allclose(ox.numpy(), 3.0)
+            np.testing.assert_allclose(proj.numpy(), 100.0)
+
+    def test_bucket_kwarg_tensor_pads_right_axis(self):
+        # input_spec is aligned with the call STRUCTURE (args then sorted
+        # kwargs), so a tensor passed by keyword still pads its own axes
+        fn = to_static(lambda a, b=None: (a + 1, b.sum(axis=0)),
+                       input_spec=[InputSpec([None, 4], "float32"),
+                                   InputSpec([None, 2], "float32")],
+                       bucket=[8])
+        a = paddle.to_tensor(np.ones((5, 4), "float32"))
+        b = paddle.to_tensor(np.ones((7, 2), "float32"))
+        for _ in range(2):
+            oa, ob = fn(a, b=b)
+            assert tuple(oa.shape) == (5, 4)
+            np.testing.assert_allclose(ob.numpy(), 7.0)  # pad rows are 0
+
+    def test_bucket_spec_structure_mismatch_raises(self):
+        fn = to_static(lambda a: a * 2,
+                       input_spec=[InputSpec([None], "float32"),
+                                   InputSpec([None], "float32")],
+                       bucket=[8])
+        with pytest.raises(ValueError):
+            fn(paddle.to_tensor(np.ones(3, "float32")))  # 2 specs, 1 arg
+        fn2 = to_static(lambda a: a[0] * 2,
+                        input_spec=[InputSpec([None], "float32")],
+                        bucket=[8])
+        with pytest.raises(ValueError):  # spec says tensor, call passes list
+            fn2([paddle.to_tensor(np.ones(3, "float32")),
+                 paddle.to_tensor(np.ones(3, "float32"))])
 
     def test_grad_flows_through_padded_program(self):
         model = paddle.nn.Linear(8, 4)
