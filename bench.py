@@ -159,11 +159,19 @@ def _run_decode(on_tpu):
     out = {}
     if on_tpu:
         _decode_page_sweep(model, cfg, rng, max_seq, prompt_len, out)
-        try:
+    try:
+        if on_tpu:
             _serving_mixed_ab(model, cfg, rng, out)
-        except Exception as e:
-            out["serving_error"] = f"{type(e).__name__}: {str(e)[:150]}"
-            traceback.print_exc(file=sys.stderr)
+        else:
+            # CPU-scaled mixed prefill+decode A/B: the serving perf series
+            # needs a CPU-mesh point per PR (ISSUE 2 satellite) — small
+            # shapes, same admission/eviction dynamics
+            _serving_mixed_ab(model, cfg, rng, out, n_requests=12, slots=4,
+                              max_seq=256, prompt_range=(16, 97),
+                              budget_range=(8, 49), page_size=16)
+    except Exception as e:
+        out["serving_error"] = f"{type(e).__name__}: {str(e)[:150]}"
+        traceback.print_exc(file=sys.stderr)
     # headline runs on the product default path: page_size="auto" reads the
     # sweep's measured winner from the autotune cache (32 on a cold cache)
     for b, tag in ((batch, "decode_tok_per_sec"), (1, "decode_b1")):
@@ -247,7 +255,9 @@ def _decode_page_sweep(model, cfg, rng, max_seq, prompt_len, out,
         out["decode_best_page"] = best
 
 
-def _serving_mixed_ab(model, cfg, rng, out, n_requests=32, slots=16):
+def _serving_mixed_ab(model, cfg, rng, out, n_requests=32, slots=16,
+                      max_seq=768, prompt_range=(32, 257),
+                      budget_range=(16, 129), page_size="auto"):
     """Mixed-length serving A/B (VERDICT r4 item 8): the continuous-
     batching engine admits/evicts per step over the paged KV, the static
     baseline decodes fixed batches until each batch's longest request
@@ -256,11 +266,10 @@ def _serving_mixed_ab(model, cfg, rng, out, n_requests=32, slots=16):
     from paddle_tpu.inference import (ContinuousBatchingEngine,
                                       GenerationConfig, LlamaGenerator)
 
-    max_seq = 768
     prompts = [list(rng.integers(1, cfg.vocab_size,
-                                 int(rng.integers(32, 257))))
+                                 int(rng.integers(*prompt_range))))
                for _ in range(n_requests)]
-    budgets = [int(rng.integers(16, 129)) for _ in range(n_requests)]
+    budgets = [int(rng.integers(*budget_range)) for _ in range(n_requests)]
 
     # continuous batching.  Warmup = throwaway requests driven to
     # completion (compiles prefill+decode); the timed region then holds
@@ -268,7 +277,7 @@ def _serving_mixed_ab(model, cfg, rng, out, n_requests=32, slots=16):
     # clock, exactly like the static arm's timed region.
     eng = ContinuousBatchingEngine(
         model, max_batch=slots, gen=GenerationConfig(max_new_tokens=128),
-        max_seq_len=max_seq, page_size="auto")
+        max_seq_len=max_seq, page_size=page_size)
     for p in prompts[:2]:
         eng.add_request(p, max_new_tokens=4)
     eng.run()
@@ -282,7 +291,7 @@ def _serving_mixed_ab(model, cfg, rng, out, n_requests=32, slots=16):
 
     # static batches: everyone in a batch decodes until its longest budget
     gen = LlamaGenerator(model, max_batch=slots, max_seq_len=max_seq,
-                         page_size="auto")
+                         page_size=page_size)
     batches = [list(range(i, min(i + slots, n_requests)))
                for i in range(0, n_requests, slots)]
     gen.generate([prompts[i] for i in batches[0]],

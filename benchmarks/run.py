@@ -2,8 +2,11 @@
 in benchmarks/ of this repo with per-config JSON results").
 
 Usage:
-    python benchmarks/run.py [config ...]
+    python benchmarks/run.py [config ...] [--cpu] [--fused-gather=0|1]
 configs: resnet gpt2 llama dit moe decode all   (default: all)
+
+--fused-gather pins FLAGS_grouped_matmul_fused_gather for the run (A/B of
+the in-kernel MoE dispatch gather; the =0 arm writes <config>_nofuse.json).
 
 Each config writes benchmarks/results/<config>.json.  The driver-facing
 single-line bench stays `bench.py` at the repo root; this harness is the
@@ -24,6 +27,23 @@ if CPU_PINNED:
     sys.argv = [a for a in sys.argv if a != "--cpu"]
     import jax
     jax.config.update("jax_platforms", "cpu")
+
+# `--fused-gather=0|1` A/B toggle (the ROADMAP chip-capture queue item):
+# pins FLAGS_grouped_matmul_fused_gather for the whole run, so
+#     python benchmarks/run.py moe --fused-gather=1
+#     python benchmarks/run.py moe --fused-gather=0
+# is the one-command A/B of the in-kernel dispatch gather vs the
+# materialized-permutation path when the TPU tunnel returns.  Set via env
+# so the per-config subprocesses inherit it before paddle_tpu imports; the
+# B arm writes <config>_nofuse.json so the arms never clobber each other.
+FUSED_GATHER = None
+for _a in [a for a in sys.argv if a.startswith("--fused-gather")]:
+    sys.argv.remove(_a)
+    _v = _a.split("=", 1)[1] if "=" in _a else "1"
+    FUSED_GATHER = _v.lower() not in ("0", "false", "no", "off")
+    os.environ["FLAGS_grouped_matmul_fused_gather"] = \
+        "1" if FUSED_GATHER else "0"
+RESULT_SUFFIX = "_nofuse" if FUSED_GATHER is False else ""
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
@@ -226,11 +246,17 @@ def _supervise(names, timeout):
     import subprocess
     failed = 0
     for name in names:
-        t0, path = time.time(), RESULTS / f"{name}.json"
+        t0 = time.time()
+        path = RESULTS / f"{name}{RESULT_SUFFIX}.json"
         prev = _parse(path)  # snapshot BEFORE the child can clobber it
         cmd = [sys.executable, os.path.abspath(__file__), "--inproc", name]
         if CPU_PINNED:
             cmd.append("--cpu")
+        if FUSED_GATHER is not None:
+            # the child derives its flag AND its result-file suffix from
+            # argv — without this the B arm would write <name>.json and
+            # clobber the fused arm's record
+            cmd.append(f"--fused-gather={1 if FUSED_GATHER else 0}")
         try:
             child = subprocess.Popen(cmd)
         except Exception as e:
@@ -336,7 +362,8 @@ def main(argv):
             result = {"config": name, "error": f"{type(e).__name__}: {e}",
                       "wall_s": round(time.perf_counter() - t0, 2)}
             failed += 1
-        # provenance stamp: CPU smoke runs must never read as TPU numbers
+        # provenance stamp: CPU smoke runs must never read as TPU numbers,
+        # and A/B arms must record which dispatch-gather mode they ran
         try:
             import jax
             dev = jax.devices()[0]
@@ -345,7 +372,14 @@ def main(argv):
                               getattr(dev, "device_kind", "?"))
         except Exception:
             pass
-        path = RESULTS / f"{name}.json"
+        try:
+            import paddle_tpu.kernels.grouped_matmul  # registers the flag
+            from paddle_tpu import flags as _flags
+            result.setdefault("grouped_matmul_fused_gather",
+                              bool(_flags.flag("grouped_matmul_fused_gather")))
+        except Exception:
+            pass
+        path = RESULTS / f"{name}{RESULT_SUFFIX}.json"
         path.write_text(json.dumps(result, indent=2) + "\n")
         print(f"{name}: {json.dumps(result)}")
     return 1 if failed else 0

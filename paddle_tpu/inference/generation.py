@@ -5,31 +5,32 @@ path (paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu +
 masked_multihead_attention + AnalysisPredictor,
 paddle/fluid/inference/api/analysis_predictor.h:105).
 
-Structure:
-- **prefill**: one jitted whole-prompt forward (the training Pallas flash
-  attention, causal) that also scatters every token's K/V into the paged
-  cache via ``write_kv_pages``, then samples each sequence's first token.
-- **decode**: one jitted single-token step.  The layer loop is a
-  ``lax.scan`` over stacked per-layer weights and cache slices in which the
-  cache is strictly READ-ONLY: attention runs over the previous context via
-  the Pallas ``paged_attention`` kernel (returning logsumexp) and the
-  current token's key/value are folded in analytically by online-softmax
-  merge.  Each layer's new K/V row is emitted as a scan output, and ONE
-  batched scatter commits all layers at the end of the step.  This shape is
-  what lets XLA alias the donated cache in place — a scan that *carries*
-  the cache re-materializes all of it every step (measured: step time
-  scaling with total cache size, not context), and an unrolled layer loop
-  compiles pathologically slowly.
-- **host loop**: page-allocator bookkeeping only.  The loop is
-  **sync-free**: token ids, positions, write slots (derived in-jit from the
-  block table), the EOS/finished mask and the PRNG key all live on device
-  and chain from step to step; the host uploads a new block table only when
-  a sequence crosses a page boundary and polls the all-finished flag every
-  ``sync_every`` steps.  Per step the host does exactly one async jit
-  dispatch — essential when the device sits behind a high-latency link.
+Structure — ONE jitted step function serves every serving phase:
 
-Static shapes throughout: prompt-length buckets and a fixed block-table
-width keep recompiles bounded.
+- ``_step_fn`` is the single fused engine step: derive write slots in-jit
+  from the block table, run every layer through the mixed-mode
+  ``ragged_paged_attention`` kernel (the step's own K/V rows fold in with a
+  causal mask — no separate prefill kernel, no analytic current-token
+  merge), commit all layers' fresh KV in ONE batched scatter at the end
+  (the cache stays strictly read-only until then, which is what lets XLA
+  alias the donated pool in place), then sample.  The layer loop is a
+  ``lax.scan`` over stacked per-layer weights and cache slices; each
+  layer's new K/V row is emitted as a scan output.
+- The step is compiled per (sampling config, T) where T is the query-token
+  bucket: T=1 is pure decode, T=prefill_bucket is a chunked-prefill /
+  mixed step.  Both compile once; **warm steps never recompile** (asserted
+  by ``paddle_tpu.jit.assert_no_recompiles`` in the serving tests) and all
+  state arrays are fixed ``[max_batch]`` buckets.
+- Prefill IS the step: prompts stream through T-sized chunks with
+  per-sequence ``q_lens`` raggedness, so a prefill chunk and concurrent
+  decode rows ride one ``pallas_call`` (the ragged-paged-attention shape).
+- EOS / budget / capacity tracking lives ON DEVICE (``finished``,
+  ``gen_counts``, ``budgets``): the host loop is sync-free — one async jit
+  dispatch per step — and drains results every ``sync_every`` steps.
+  Essential when the device sits behind a high-latency link.
+
+Static shapes throughout: fixed [max_batch] rows, fixed chunk buckets and a
+fixed block-table width keep the compile count at two per sampling config.
 """
 
 from __future__ import annotations
@@ -43,8 +44,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..kernels.flash_attention import _flash_attention_arrays
-from ..kernels.paged_attention import (paged_attention, write_kv_pages,
+from ..kernels.paged_attention import (paged_attention,
+                                       ragged_paged_attention,
+                                       write_kv_pages,
                                        write_kv_pages_all_layers)
 from ..kernels.rms_norm import rms_norm_fp32
 from ..models.llama import LlamaConfig, LlamaForCausalLM, _rope_cos_sin
@@ -67,19 +69,12 @@ class GenerationConfig:
                 self.eos_token_id)
 
 
-def _rope_rows(x, cos, sin):
-    """Rotary embedding for per-row tables. x: [B, h, d]; cos/sin: [B, d/2]."""
-    x1, x2 = x[..., 0::2], x[..., 1::2]
-    c, s = cos[:, None, :], sin[:, None, :]
-    o1 = x1 * c - x2 * s
-    o2 = x2 * c + x1 * s
-    return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
+def _rope_bt(x, cos, sin):
+    """Rotary embedding with per-(row, token) tables.
 
-
-def _rope_seq(x, cos, sin):
-    """Rotary for full sequences. x: [B, T, h, d]; cos/sin: [T, d/2]."""
+    x: [B, T, h, d]; cos/sin: [B, T, d/2]."""
     x1, x2 = x[..., 0::2], x[..., 1::2]
-    c, s = cos[None, :, None, :], sin[None, :, None, :]
+    c, s = cos[:, :, None, :], sin[:, :, None, :]
     o1 = x1 * c - x2 * s
     o2 = x2 * c + x1 * s
     return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
@@ -89,35 +84,34 @@ def _moe_ffn(y, lp, top_k, dispatch="dense", block_m=128):
     """Routed SwiGLU expert mixture for the serving path (reference:
     incubate fused_moe inference semantics).
 
-    Two forms, picked by routed-entry count (the dispatch-mode matrix of
-    benchmarks/README.md):
-
-    - grouped (``dispatch="grouped"`` and >= one ``block_m`` tile of
-      (token, choice) entries — prefill): the expert-sorted ragged-GEMM
-      path shared with training (``models.llama._grouped_ffn``) — each
-      expert runs over exactly its own rows, E/top_k-fold fewer FFN
-      FLOPs than the dense mixture.
-    - dense (decode, or non-grouped configs): every expert runs under a
-      lax.scan over all rows, combined with top-k gate weights — exact
-      routing, no capacity, transients bounded to one expert.  Decode
-      batches are tiny (a handful of rows), so the E/top_k extra FLOPs
-      are noise there and the scan avoids the tile-padding overhead.
+    - grouped (``dispatch="grouped"``): the expert-sorted ragged-GEMM path
+      shared with training (``models.llama._grouped_ffn``) — each expert
+      runs over exactly its own rows, E/top_k-fold fewer FFN FLOPs than
+      the dense mixture.  Serves prefill chunks AND decode steps: the
+      row tile shrinks to fit the actual (token, choice) entry count so a
+      decode batch doesn't pay a full ``block_m`` of padding per expert.
+    - dense (non-grouped configs): every expert runs under a lax.scan over
+      all rows, combined with top-k gate weights — exact routing, no
+      capacity, transients bounded to one expert.
     """
     gw = lp["mlp.gate.weight"]              # [H, E]
     shape = y.shape
     xf = y.reshape(-1, shape[-1])
     E = gw.shape[-1]
-    if dispatch == "grouped" and xf.shape[0] * top_k >= block_m:
+    if dispatch == "grouped":
         from ..kernels.grouped_matmul import sorted_dispatch_plan
         from ..models import llama as _llama
 
         N = xf.shape[0]
+        # decode batches carry a handful of rows: shrink the row tile to
+        # the 8-row sublane multiple that covers them (same math, less pad)
+        bm = max(8, min(block_m, -(-N * top_k // 8) * 8))
         topv, topi, _, _ = _llama._route_topk(xf, gw, top_k)
         inv, pos, tg = sorted_dispatch_plan(
-            topi.reshape(N * top_k), E, block_m)
+            topi.reshape(N * top_k), E, bm)
         out = _llama._grouped_ffn(
             xf, lp["mlp.experts_gate"], lp["mlp.experts_up"],
-            lp["mlp.experts_down"], topv, inv, pos, tg, E, top_k, block_m)
+            lp["mlp.experts_down"], topv, inv, pos, tg, E, top_k, bm)
         return out.reshape(shape)
     probs = jax.nn.softmax(
         xf.astype(jnp.float32) @ gw.astype(jnp.float32), axis=-1)
@@ -163,7 +157,8 @@ class LlamaGenerator:
     def __init__(self, model: LlamaForCausalLM, *, max_batch: int = 8,
                  max_seq_len: Optional[int] = None, page_size=32,
                  cache_dtype: Optional[str] = None,
-                 prefill_bucket: int = 64, sync_every: int = 8):
+                 prefill_bucket: int = 64, sync_every: int = 8,
+                 num_pages: Optional[int] = None):
         c = model.config
         self.config = c
         self.max_batch = max_batch
@@ -180,14 +175,20 @@ class LlamaGenerator:
                 page_size = page_size[0]
         page_size = int(page_size)
         self.page_size = page_size
-        self.prefill_bucket = prefill_bucket
+        self.prefill_bucket = min(prefill_bucket, self.max_seq_len)
         self.sync_every = sync_every
         self.pages_per_seq = -(-self.max_seq_len // page_size)
 
         self.params = self._extract(model)
+        # the KV pool: ``num_pages`` may be smaller than the dense
+        # max_batch x pages_per_seq worst case — sequences share the pool
+        # through the free-list allocator; admission blocks on pressure
+        # and a sequence whose mid-decode growth finds the pool dry is
+        # finalized early (engine._drain caps its output) — never a crash
+        self.num_pages = num_pages or max_batch * self.pages_per_seq
         self.cache = PagedKVCache(
             num_layers=c.num_hidden_layers,
-            num_pages=max_batch * self.pages_per_seq,
+            num_pages=self.num_pages,
             page_size=page_size, num_kv_heads=c.num_key_value_heads,
             head_dim=c.head_dim, dtype=cache_dtype or c.dtype)
         cos, sin = _rope_cos_sin(self.max_seq_len, c.head_dim, c.rope_theta,
@@ -207,32 +208,67 @@ class LlamaGenerator:
             "blocks": blocks,
         }
 
-    def _jit_for(self, gc: GenerationConfig):
-        """(prefill, decode) jitted for this sampling configuration."""
-        key = gc._key()
+    def _step_jit(self, gc: GenerationConfig, t: int):
+        """The fused serving step, jitted for (sampling config, q bucket)."""
+        key = (gc._key(), t)
         if key not in self._jit_cache:
             import functools
-            self._jit_cache[key] = (
-                jax.jit(functools.partial(self._prefill_fn, gc),
-                        donate_argnums=(1, 2)),
-                jax.jit(functools.partial(self._decode_fn, gc),
-                        donate_argnums=(1, 2)),
-            )
+            self._jit_cache[key] = jax.jit(
+                functools.partial(self._step_fn, gc, t),
+                donate_argnums=(1, 2))
         return self._jit_cache[key]
 
-    # ---- prefill ----
-    def _prefill_fn(self, gc, params, kc, vc, ids, slot_mapping, last_pos, key):
-        """ids: [B, T] right-padded; slot_mapping: [B, T] (-1 on pads);
-        last_pos: [B] index of each prompt's final token.  Returns the first
-        sampled token per sequence."""
+    # ---- the ONE engine step ----
+    def _step_fn(self, gc, T, params, kc, vc, tokens, q_lens, positions,
+                 finished, decode_mask, commit_mask, counts, budgets,
+                 block_tables, key):
+        """One fused serving step: admit (slots derived in-jit) →
+        ragged attention over every layer → ONE batched KV commit → sample.
+
+        tokens:      [B, T] — this step's query tokens (decode rows use
+                     column 0; prefill rows their prompt chunk).
+        q_lens:      [B] — valid tokens per row (0 = idle row).
+        positions:   [B] — cache tokens BEFORE this step (write cursor).
+        decode_mask: [B] — rows whose column-0 token is generated output
+                     (EOS is only checked on generated tokens, never on
+                     prompt tokens).
+        commit_mask: [B] — rows whose sample this step is a real generated
+                     token (decode rows + the final prompt chunk).
+        counts/budgets: [B] — generated-so-far / max_new_tokens per row;
+                     the budget freeze happens on device.
+        All of it device-resident and chained between calls — the host
+        loop is sync-free.
+        """
         c = self.config
-        B, T = ids.shape
-        cos, sin = self._cos[:T], self._sin[:T]
-        h = jnp.take(params["embed"], ids, axis=0)
+        B = tokens.shape[0]
+        page = self.page_size
+
+        if gc.eos_token_id is not None:
+            finished = jnp.logical_or(
+                finished,
+                jnp.logical_and(decode_mask, tokens[:, 0] == gc.eos_token_id))
+        # a sequence that filled the cache freezes (no slot rewrite)
+        finished = jnp.logical_or(finished, positions >= self.max_seq_len)
+        ql = jnp.where(finished, 0, q_lens).astype(jnp.int32)
+
+        # token positions & write slots, derived in-jit from the block table
+        offs = jnp.arange(T, dtype=jnp.int32)
+        pos = positions[:, None].astype(jnp.int32) + offs[None, :]   # [B, T]
+        pos_c = jnp.minimum(pos, self.max_seq_len - 1)
+        page_ids = jnp.take_along_axis(block_tables, pos_c // page, axis=1)
+        valid = jnp.logical_and(offs[None, :] < ql[:, None],
+                                pos < self.max_seq_len)
+        slots = jnp.where(valid, page_ids * page + pos_c % page,
+                          -1).reshape(B * T)
+
+        cos = jnp.take(self._cos, pos_c, axis=0)          # [B, T, d/2]
+        sin = jnp.take(self._sin, pos_c, axis=0)
+        ctx_prev = jnp.minimum(positions, self.max_seq_len).astype(jnp.int32)
+        h = jnp.take(params["embed"], tokens, axis=0)     # [B, T, H]
 
         def layer(carry, xs):
             x, = carry
-            lp, kcl, vcl = xs
+            lp, kcl, vcl = xs                 # cache slices: READ-ONLY
             y = rms_norm_fp32(x, lp["input_layernorm.weight"], c.rms_norm_eps)
             q = (y @ lp["self_attn.q_proj.weight"]).reshape(
                 B, T, c.num_attention_heads, c.head_dim)
@@ -240,90 +276,15 @@ class LlamaGenerator:
                 B, T, c.num_key_value_heads, c.head_dim)
             v = (y @ lp["self_attn.v_proj.weight"]).reshape(
                 B, T, c.num_key_value_heads, c.head_dim)
-            q = _rope_seq(q, cos, sin)
-            k = _rope_seq(k, cos, sin)
-            kcl, vcl = write_kv_pages(
-                kcl, vcl, k.reshape(B * T, c.num_key_value_heads, c.head_dim),
-                v.reshape(B * T, c.num_key_value_heads, c.head_dim),
-                slot_mapping.reshape(B * T))
-            attn = _flash_attention_arrays(q, k, v, True)  # GQA in-kernel
-            x = x + (attn.reshape(B, T, -1) @ lp["self_attn.o_proj.weight"])
-            y = rms_norm_fp32(x, lp["post_attention_layernorm.weight"],
-                              c.rms_norm_eps)
-            if "mlp.experts_gate" in lp:          # MoE model serving
-                x = x + _moe_ffn(y, lp, c.moe_top_k,
-                                 dispatch=c.moe_dispatch,
-                                 block_m=c.moe_block_m)
-            else:
-                act = jax.nn.silu(y @ lp["mlp.gate_proj.weight"]) * \
-                    (y @ lp["mlp.up_proj.weight"])
-                x = x + act @ lp["mlp.down_proj.weight"]
-            return (x,), (kcl, vcl)
-
-        (h,), (kc, vc) = jax.lax.scan(layer, (h,), (params["blocks"], kc, vc))
-        h = rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
-        last = jnp.take_along_axis(
-            h, last_pos[:, None, None].astype(jnp.int32), axis=1)[:, 0]
-        logits = (last @ params["head"]).astype(jnp.float32)
-        key, sub = jax.random.split(key)
-        tokens = _sample(logits, sub, gc)
-        return tokens, kc, vc, key
-
-    # ---- decode ----
-    def _decode_fn(self, gc, params, kc, vc, tokens, positions, finished,
-                   block_tables, key):
-        """One sync-free decode step.  tokens/positions/finished: [B] device
-        state chained between calls; positions[b] = index the input token
-        will be written at.  The cache is read-only until the final batched
-        commit (see module docstring)."""
-        c = self.config
-        B = tokens.shape[0]
-        page = self.page_size
-        rep = c.num_attention_heads // c.num_key_value_heads
-        scale = 1.0 / math.sqrt(c.head_dim)
-
-        if gc.eos_token_id is not None:
-            finished = jnp.logical_or(finished, tokens == gc.eos_token_id)
-        # a sequence that filled the cache freezes (no slot rewrite)
-        finished = jnp.logical_or(finished, positions >= self.max_seq_len)
-        pos_c = jnp.minimum(positions, self.max_seq_len - 1)
-        page_ids = jnp.take_along_axis(
-            block_tables, (pos_c // page)[:, None], axis=1)[:, 0]
-        slots = jnp.where(finished, -1, page_ids * page + pos_c % page)
-        ctx_prev = pos_c                      # tokens already in the cache
-
-        cos = jnp.take(self._cos, pos_c, axis=0)   # [B, d/2]
-        sin = jnp.take(self._sin, pos_c, axis=0)
-        h = jnp.take(params["embed"], tokens, axis=0)     # [B, H]
-
-        def layer(carry, xs):
-            x, = carry
-            lp, kcl, vcl = xs                 # cache slices: READ-ONLY
-            y = rms_norm_fp32(x, lp["input_layernorm.weight"], c.rms_norm_eps)
-            q = (y @ lp["self_attn.q_proj.weight"]).reshape(
-                B, c.num_attention_heads, c.head_dim)
-            k = (y @ lp["self_attn.k_proj.weight"]).reshape(
-                B, c.num_key_value_heads, c.head_dim)
-            v = (y @ lp["self_attn.v_proj.weight"]).reshape(
-                B, c.num_key_value_heads, c.head_dim)
-            q = _rope_rows(q, cos, sin)
-            k = _rope_rows(k, cos, sin)
-            out_c, lse = paged_attention(q, kcl, vcl, block_tables, ctx_prev,
-                                         with_lse=True)
-            # fold the current token in by online-softmax merge — its KV is
+            q = _rope_bt(q, cos, sin)
+            k = _rope_bt(k, cos, sin)
+            # prior context from the paged cache + this step's own rows
+            # (causal), one mixed-mode kernel call; the fresh rows are
             # committed to the cache only at the end of the step
-            k_exp = jnp.repeat(k, rep, axis=1) if rep > 1 else k
-            v_exp = jnp.repeat(v, rep, axis=1) if rep > 1 else v
-            s_cur = jnp.sum(q.astype(jnp.float32) * k_exp.astype(jnp.float32),
-                            axis=-1) * scale                    # [B, qh]
-            m = jnp.maximum(lse, s_cur)
-            wc = jnp.exp(lse - m)
-            wn = jnp.exp(s_cur - m)
-            denom = wc + wn
-            attn = (out_c.astype(jnp.float32) * (wc / denom)[..., None]
-                    + v_exp.astype(jnp.float32) * (wn / denom)[..., None]
-                    ).astype(x.dtype)
-            x = x + (attn.reshape(B, -1) @ lp["self_attn.o_proj.weight"])
+            attn = ragged_paged_attention(q, kcl, vcl, block_tables,
+                                          ctx_prev, q_lens=ql,
+                                          k_new=k, v_new=v)
+            x = x + (attn.reshape(B, T, -1) @ lp["self_attn.o_proj.weight"])
             y = rms_norm_fp32(x, lp["post_attention_layernorm.weight"],
                               c.rms_norm_eps)
             if "mlp.experts_gate" in lp:          # MoE model serving
@@ -338,64 +299,99 @@ class LlamaGenerator:
 
         (h,), (k_all, v_all) = jax.lax.scan(layer, (h,),
                                             (params["blocks"], kc, vc))
-        kc, vc = write_kv_pages_all_layers(kc, vc, k_all, v_all, slots)
+        L = k_all.shape[0]
+        kvh, dh = c.num_key_value_heads, c.head_dim
+        kc, vc = write_kv_pages_all_layers(
+            kc, vc, k_all.reshape(L, B * T, kvh, dh),
+            v_all.reshape(L, B * T, kvh, dh), slots)
+
         h = rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
-        logits = (h @ params["head"]).astype(jnp.float32)
+        last_ix = jnp.maximum(ql - 1, 0)
+        last = jnp.take_along_axis(h, last_ix[:, None, None], axis=1)[:, 0]
+        logits = (last @ params["head"]).astype(jnp.float32)
         key, sub = jax.random.split(key)
         sampled = _sample(logits, sub, gc)
-        out_tokens = jnp.where(finished, tokens, sampled)
-        new_positions = jnp.where(finished, positions, positions + 1)
+        last_in = jnp.take_along_axis(tokens, last_ix[:, None], axis=1)[:, 0]
+        out_tokens = jnp.where(finished, last_in, sampled)
+        new_positions = jnp.where(
+            finished, positions,
+            jnp.minimum(positions + ql, self.max_seq_len))
+        counts = counts + jnp.where(
+            jnp.logical_and(commit_mask, jnp.logical_not(finished)), 1, 0)
+        finished = jnp.logical_or(finished, counts >= budgets)
         return (out_tokens, new_positions, finished, jnp.all(finished),
-                kc, vc, key)
+                counts, kc, vc, key)
 
     # ---- host loop ----
-    def _bucket(self, n: int) -> int:
-        b = self.prefill_bucket
-        return min(-(-n // b) * b, self.max_seq_len)
-
     def generate(self, prompts: Sequence[Sequence[int]],
                  gen: Optional[GenerationConfig] = None) -> List[List[int]]:
         """prompts: per-sequence token-id lists → generated ids (no prompt)."""
         gen = gen or GenerationConfig()
         B = len(prompts)
-        if B > self.max_batch:
-            raise ValueError(f"batch {B} > max_batch {self.max_batch}")
-        prefill_jit, decode_jit = self._jit_for(gen)
+        MB = self.max_batch
+        if B > MB:
+            raise ValueError(f"batch {B} > max_batch {MB}")
         alloc = self.cache.allocator
         lens = np.asarray([len(p) for p in prompts], np.int32)
-        T = self._bucket(int(lens.max()))
-
-        ids = np.zeros((B, T), np.int32)
-        slot_map = np.full((B, T), -1, np.int32)
         seq_ids = list(range(B))
         for i, p in enumerate(prompts):
-            ids[i, :len(p)] = np.asarray(p, np.int32)
-            slot_map[i, :len(p)] = alloc.allocate(seq_ids[i], len(p))
+            alloc.allocate(seq_ids[i], len(p))
+        bt_width = self.pages_per_seq
+        bt = np.zeros((MB, bt_width), np.int32)
+        bt[:B] = alloc.block_table(seq_ids, max_pages=bt_width)
+        bt_dev = jnp.asarray(bt)
 
         key = jax.random.key(gen.seed)
-        tokens, kc, vc, key = prefill_jit(
-            self.params, *self.cache.arrays, jnp.asarray(ids),
-            jnp.asarray(slot_map), jnp.asarray(lens - 1), key)
-        self.cache.update(kc, vc)
+        i32 = jnp.int32
+        positions = jnp.zeros((MB,), i32)
+        finished = jnp.asarray(np.arange(MB) >= B)        # pad rows inert
+        counts = jnp.zeros((MB,), i32)
+        budgets_np = np.zeros((MB,), np.int32)
+        budgets_np[:B] = gen.max_new_tokens
+        budgets = jnp.asarray(budgets_np)
+        no_mask = jnp.zeros((MB,), bool)
+        all_mask = jnp.ones((MB,), bool)
+        first = jnp.zeros((MB,), i32)
 
-        # device-resident loop state
-        positions = jnp.asarray(lens)        # next write index per sequence
-        finished = jnp.zeros((B,), bool)
-        collected = [tokens]                 # device arrays, synced at the end
+        # chunked prefill: prompts stream through the step in fixed
+        # T-sized chunks (one compile, any prompt length)
+        T = self.prefill_bucket
+        step_p = self._step_jit(gen, T)
+        n_chunks = max(1, -(-int(lens.max()) // T))
+        for ci in range(n_chunks):
+            s0 = ci * T
+            chunk = np.zeros((MB, T), np.int32)
+            ql = np.zeros((MB,), np.int32)
+            for i, p in enumerate(prompts):
+                n = min(max(len(p) - s0, 0), T)
+                ql[i] = n
+                if n:
+                    chunk[i, :n] = np.asarray(p[s0:s0 + n], np.int32)
+            commit = np.zeros((MB,), bool)
+            commit[:B] = (lens > s0) & (lens <= s0 + T)   # prompt ends here
+            out, positions, finished, _ad, counts, kc, vc, key = step_p(
+                self.params, *self.cache.arrays, jnp.asarray(chunk),
+                jnp.asarray(ql), positions, finished, no_mask,
+                jnp.asarray(commit), counts, budgets, bt_dev, key)
+            self.cache.update(kc, vc)
+            first = jnp.where(jnp.asarray(commit), out, first)
 
-        # host-side upper bound of each sequence's written length: grows every
-        # step regardless of finished (finished state lives on device) — page
-        # allocation is safe-by-overestimate, at most one spare page per seq
+        # device-resident decode loop (sync-free; one dispatch per step)
+        step_d = self._step_jit(gen, 1)
+        ql1 = jnp.ones((MB,), i32)
+        tokens = first
+        collected = [first]                  # device arrays, synced at end
+
+        # host-side upper bound of each sequence's written length: grows
+        # every step regardless of finished (finished lives on device) —
+        # page allocation is safe-by-overestimate, <= 1 spare page per seq
         host_lens = lens.copy()
-        bt_width = self.pages_per_seq
-        bt_dev = jnp.asarray(alloc.block_table(seq_ids, max_pages=bt_width))
-
         steps_until_sync = self.sync_every
         for _ in range(gen.max_new_tokens - 1):
             if int(np.min(host_lens)) >= self.max_seq_len:
                 break                        # every sequence is at capacity
-            # grow pages ahead of any boundary crossing; re-upload the table
-            # only when it changed
+            # grow pages ahead of any boundary crossing; re-upload the
+            # table only when it changed
             grew = False
             for i in range(B):
                 if host_lens[i] < self.max_seq_len and \
@@ -406,12 +402,13 @@ class LlamaGenerator:
                                      self.max_seq_len - host_lens[i]))
                     grew = True
             if grew:
-                bt_dev = jnp.asarray(
-                    alloc.block_table(seq_ids, max_pages=bt_width))
+                bt[:B] = alloc.block_table(seq_ids, max_pages=bt_width)
+                bt_dev = jnp.asarray(bt)
 
-            tokens, positions, finished, all_done, kc, vc, key = decode_jit(
-                self.params, *self.cache.arrays, tokens, positions, finished,
-                bt_dev, key)
+            tokens, positions, finished, all_done, counts, kc, vc, key = \
+                step_d(self.params, *self.cache.arrays, tokens[:, None],
+                       ql1, positions, finished, all_mask, all_mask,
+                       counts, budgets, bt_dev, key)
             self.cache.update(kc, vc)
             collected.append(tokens)
             host_lens = np.minimum(host_lens + 1, self.max_seq_len)
@@ -426,7 +423,7 @@ class LlamaGenerator:
             alloc.free(s)
 
         # one bulk transfer, then trim to the first EOS per sequence
-        mat = np.asarray(jnp.stack(collected, axis=1))     # [B, steps]
+        mat = np.asarray(jnp.stack(collected, axis=1))     # [MB, steps]
         out: List[List[int]] = []
         for i in range(B):
             row = mat[i].tolist()
@@ -463,17 +460,23 @@ class Request:
 
 
 class ContinuousBatchingEngine:
-    """vLLM-style continuous batching over the paged-KV decode path
+    """vLLM-style continuous batching over the fused serving step
     (reference product surface: the fused multi-transformer serving stack,
     analysis_predictor + block_multihead_attention).
 
-    Requests are admitted into free batch slots BETWEEN decode steps:
-    admission runs one full-width prefill (inactive rows carry -1 slot
-    mappings, so they write nothing), then every step decodes all active
-    slots together.  Finished sequences (EOS / budget / cache-full) free
-    their pages and their slot immediately, so short requests leave and new
-    ones join without draining the batch — decode utilization stays high
-    under mixed-length traffic."""
+    Single-step design: admission does NOT run a separate prefill program —
+    newly admitted prompts stream through the SAME jitted step as decode,
+    in ``prefill_bucket``-sized chunks, while already-running rows keep
+    decoding in the same call (their single token rides column 0 of the
+    chunk bucket).  Two compiles total per sampling config (T=1 decode-only
+    steps and T=bucket mixed steps); every warm step reuses them —
+    telemetry-asserted zero recompiles.
+
+    EOS / budget / capacity freezing happens on device; the host drains
+    sampled tokens, retires finished requests (freeing their pages back to
+    the pool) and admits waiting ones every ``sync_every`` steps, so steady
+    state runs one async dispatch per step with no per-step host sync.
+    """
 
     def __init__(self, model: LlamaForCausalLM, *, max_batch: int = 8,
                  gen: Optional[GenerationConfig] = None, **kw):
@@ -481,20 +484,29 @@ class ContinuousBatchingEngine:
         self.g = LlamaGenerator(model, max_batch=max_batch, **kw)
         B = max_batch
         self.B = B
-        self._prefill, self._decode = self.g._jit_for(self.gen_cfg)
+        i32 = jnp.int32
         self.key = jax.random.key(self.gen_cfg.seed)
-        self.tokens = jnp.zeros((B,), jnp.int32)
-        self.positions = jnp.zeros((B,), jnp.int32)
+        self.tokens = jnp.zeros((B,), i32)          # last sampled per slot
+        self.positions = jnp.zeros((B,), i32)
         self.finished = jnp.ones((B,), bool)        # inactive == finished
+        self.counts = jnp.zeros((B,), i32)
+        self._budgets_np = np.zeros((B,), np.int32)   # host mirror
+        self.budgets = jnp.asarray(self._budgets_np)
         self.slot_req: List[Optional[Request]] = [None] * B
+        self.prompt_pos = np.zeros((B,), np.int64)  # prompt tokens consumed
         self.host_lens = np.zeros((B,), np.int64)
-        self.new_counts = np.zeros((B,), np.int64)  # generated so far
         self.waiting: "deque[Request]" = deque()
-        self._done_at_admit: List[Request] = []
         self.completed: dict = {}            # req_id -> generated tokens
         self._next_id = 0
-        self._bt = np.full((B, self.g.pages_per_seq), 0, np.int32)
+        self._bt = np.zeros((B, self.g.pages_per_seq), np.int32)
         self._bt_dev = jnp.asarray(self._bt)
+        self._ql1 = jnp.ones((B,), i32)
+        self._pending: List[tuple] = []      # (out_dev [B], commit np [B])
+        self._steps_since_drain = 0
+        # per-slot hard cap on VALID generated tokens, set when a sequence
+        # freezes early (KV pool ran dry mid-decode): the device keeps
+        # emitting frozen repeats until the next drain, which trims here
+        self._gen_cap: List[Optional[int]] = [None] * B
 
     # ---- public api ----
     def add_request(self, prompt: Sequence[int],
@@ -514,120 +526,186 @@ class ContinuousBatchingEngine:
         request completed so far (incl. during earlier manual step() calls)."""
         while self.has_work():
             self.step()
+        self._drain()
         return dict(self.completed)
 
     # ---- engine step ----
     def step(self) -> List[Request]:
+        """Admit what fits, run ONE fused device step, drain every
+        ``sync_every`` steps.  Returns requests retired by this call."""
         self._admit()
-        done: List[Request] = list(self._done_at_admit)
-        self._done_at_admit.clear()
-        for r in done:
-            self.completed[r.req_id] = r.output
         if all(r is None for r in self.slot_req):
-            return done
-        # grow pages BEFORE decoding: the write position (== host_lens) must
-        # already be inside the allocated table, else the block-table pad
-        # entry (page 0) silently receives another sequence's KV — exact
-        # page-multiple prompts hit this on their very first decode
-        alloc = self.g.cache.allocator
-        grew_pre = False
-        for b in range(self.B):
+            return self._drain() if self._pending else []
+        g = self.g
+        B = self.B
+        prompt_rows = [b for b in range(B)
+                       if self.slot_req[b] is not None
+                       and self.prompt_pos[b] < len(self.slot_req[b].prompt)]
+        T = g.prefill_bucket if prompt_rows else 1
+
+        # grow pages BEFORE the step: every position this step writes must
+        # already be inside the allocated table (prompts are allocated in
+        # full at admission; decode rows may cross a page boundary here)
+        alloc = g.cache.allocator
+        grew = False
+        for b in range(B):
             req = self.slot_req[b]
-            if req is None:
+            if req is None or self.prompt_pos[b] < len(req.prompt):
                 continue
             while alloc.context_len(req.req_id) <= int(self.host_lens[b]) \
-                    and alloc.context_len(req.req_id) < self.g.max_seq_len:
+                    and alloc.context_len(req.req_id) < g.max_seq_len:
+                if alloc.free_pages == 0:
+                    # pool ran dry mid-decode (undersized num_pages):
+                    # finalize THIS sequence early instead of raising —
+                    # freeze it on device (no further writes) and cap its
+                    # valid output at what was generated before this step
+                    if self._gen_cap[b] is None:
+                        self._gen_cap[b] = len(req.output) + sum(
+                            int(c[b]) for _, c in self._pending)
+                        self.finished = self.finished.at[b].set(True)
+                    break
                 alloc.extend(req.req_id,
-                             min(self.g.page_size,
-                                 self.g.max_seq_len
+                             min(g.page_size,
+                                 g.max_seq_len
                                  - alloc.context_len(req.req_id)))
                 self._bt[b] = alloc.block_table(
-                    [req.req_id], max_pages=self.g.pages_per_seq)[0]
-                grew_pre = True
-        if grew_pre:
+                    [req.req_id], max_pages=g.pages_per_seq)[0]
+                grew = True
+        if grew:
             self._bt_dev = jnp.asarray(self._bt)
-        self.tokens, self.positions, self.finished, _all_done, kc, vc, \
-            self.key = self._decode(
-                self.g.params, *self.g.cache.arrays, self.tokens,
-                self.positions, self.finished, self._bt_dev, self.key)
-        self.g.cache.update(kc, vc)
-        toks = np.asarray(self.tokens)
+
+        ql = np.zeros((B,), np.int32)
+        decode = np.zeros((B,), bool)
+        commit = np.zeros((B,), bool)
+        chunk = np.zeros((B, T), np.int32)
+        for b in range(B):
+            req = self.slot_req[b]
+            if req is None:
+                continue
+            rem = len(req.prompt) - int(self.prompt_pos[b])
+            if rem > 0:                      # prefill chunk
+                n = min(rem, T)
+                ql[b] = n
+                chunk[b, :n] = np.asarray(
+                    req.prompt[self.prompt_pos[b]:self.prompt_pos[b] + n],
+                    np.int32)
+                commit[b] = n == rem         # consumes the final token
+                self.prompt_pos[b] += n
+                self.host_lens[b] += n
+            else:                            # decode row
+                ql[b] = 1
+                decode[b] = True
+                commit[b] = True
+                self.host_lens[b] += 1
+
+        tokens_in = jnp.asarray(chunk)
+        dm = jnp.asarray(decode)
+        if T == 1:
+            tokens_in = jnp.where(dm[:, None], self.tokens[:, None],
+                                  tokens_in)
+        else:
+            tokens_in = tokens_in.at[:, 0].set(
+                jnp.where(dm, self.tokens, tokens_in[:, 0]))
+
+        step = g._step_jit(self.gen_cfg, T)
+        (self.tokens, self.positions, self.finished, _all_done, self.counts,
+         kc, vc, self.key) = step(
+            g.params, *g.cache.arrays, tokens_in, jnp.asarray(ql),
+            self.positions, self.finished, dm, jnp.asarray(commit),
+            self.counts, self.budgets, self._bt_dev, self.key)
+        g.cache.update(kc, vc)
+        self._pending.append((self.tokens, commit))
+        self._steps_since_drain += 1
+        if self._steps_since_drain >= self.g.sync_every:
+            return self._drain()
+        return []
+
+    # ---- drain: the ONLY host<->device sync of the steady state ----
+    def _drain(self) -> List[Request]:
+        done: List[Request] = []
+        if not self._pending:
+            self._steps_since_drain = 0
+            return done
+        # per-array host transfers, NOT a device-side stack: the pending
+        # window length varies (partial windows at tail/run end) and a
+        # jnp.stack would compile one executable per distinct length —
+        # breaking the warm loop's zero-recompile contract
+        mat = np.stack([np.asarray(o) for o, _ in self._pending], axis=1)
+        commits = np.stack([c for _, c in self._pending], axis=1)  # [B, n]
+        self._pending.clear()
+        self._steps_since_drain = 0
         fin = np.asarray(self.finished)
+        alloc = self.g.cache.allocator
+        eos = self.gen_cfg.eos_token_id
         for b in range(self.B):
             req = self.slot_req[b]
             if req is None:
                 continue
-            req.output.append(int(toks[b]))
-            self.new_counts[b] += 1
-            self.host_lens[b] += 1
-            eos = (self.gen_cfg.eos_token_id is not None
-                   and int(toks[b]) == self.gen_cfg.eos_token_id)
-            if eos or fin[b] or self.new_counts[b] >= req.max_new_tokens \
-                    or self.host_lens[b] >= self.g.max_seq_len:
-                req.done = True
-                alloc.free(req.req_id)
-                self.slot_req[b] = None
-                self.finished = self.finished.at[b].set(True)
-                self.completed[req.req_id] = req.output
-                done.append(req)
-                continue
+            req.output.extend(int(t) for t in mat[b][commits[b]])
+            # device freeze repeats the last token once finished — trim to
+            # the true capacity/EOS/budget boundary host-side.  cap =
+            # what physically fits in the cache (max_seq minus the
+            # prompt), further lowered if the KV pool ran dry mid-decode
+            cap = max(1, self.g.max_seq_len - len(req.prompt))
+            if self._gen_cap[b] is not None:
+                cap = min(cap, max(1, self._gen_cap[b]))
+            if len(req.output) > cap:
+                req.output = req.output[:cap]
+            if eos is not None and eos in req.output:
+                req.output = req.output[:req.output.index(eos) + 1]
+            elif len(req.output) >= req.max_new_tokens:
+                req.output = req.output[:req.max_new_tokens]
+            elif len(req.output) < cap and not fin[b]:
+                continue                     # still running
+            req.done = True
+            alloc.free(req.req_id)
+            self.slot_req[b] = None
+            self._gen_cap[b] = None
+            self.finished = self.finished.at[b].set(True)
+            self.completed[req.req_id] = req.output
+            done.append(req)
         return done
 
-    # ---- admission (prefill newly scheduled requests) ----
+    # ---- admission (host-known free slots only; frees appear at drains) ----
     def _admit(self):
         free = [b for b in range(self.B) if self.slot_req[b] is None]
         if not free or not self.waiting:
             return
-        alloc = self.g.cache.allocator
+        g = self.g
+        alloc = g.cache.allocator
         admitted = []
         while free and self.waiting:
             req = self.waiting[0]
             # truncate ONCE here; every later length (pages, host_lens,
             # positions) derives from the truncated prompt
-            req.prompt = req.prompt[: self.g.max_seq_len - 1]
-            need = -(-len(req.prompt) // self.g.page_size)
+            req.prompt = req.prompt[: g.max_seq_len - 1]
+            need = -(-len(req.prompt) // g.page_size)
             if alloc.free_pages < need:
+                if len(free) == self.B and not admitted \
+                        and need > alloc.num_pages:
+                    raise MemoryError(
+                        f"prompt needs {need} pages but the pool only has "
+                        f"{alloc.num_pages}; raise num_pages or page_size")
                 break                         # wait for pages to free up
             self.waiting.popleft()
             admitted.append((free.pop(0), req))
         if not admitted:
             return
-        T = self.g._bucket(max(len(r.prompt) for _, r in admitted))
-        ids = np.zeros((self.B, T), np.int32)
-        slot_map = np.full((self.B, T), -1, np.int32)
-        last_pos = np.zeros((self.B,), np.int32)
-        for b, req in admitted:
-            p = req.prompt
-            ids[b, :len(p)] = np.asarray(p, np.int32)
-            slot_map[b, :len(p)] = alloc.allocate(req.req_id, len(p))
-            last_pos[b] = len(p) - 1
-        first, kc, vc, self.key = self._prefill(
-            self.g.params, *self.g.cache.arrays, jnp.asarray(ids),
-            jnp.asarray(slot_map), jnp.asarray(last_pos), self.key)
-        self.g.cache.update(kc, vc)
-        first_host = np.asarray(first)
         mask = np.zeros((self.B,), bool)
+        budgets = self._budgets_np
         for b, req in admitted:
-            tok = int(first_host[b])
-            req.output.append(tok)
-            # the prefill-sampled token itself may already finish the
-            # request (budget of 1, or EOS right away)
-            eos = (self.gen_cfg.eos_token_id is not None
-                   and tok == self.gen_cfg.eos_token_id)
-            if eos or req.max_new_tokens <= 1:
-                req.done = True
-                alloc.free(req.req_id)
-                self._done_at_admit.append(req)
-                continue
-            mask[b] = True
+            alloc.allocate(req.req_id, len(req.prompt))
             self.slot_req[b] = req
-            self.host_lens[b] = len(req.prompt)
-            self.new_counts[b] = 1
+            self.prompt_pos[b] = 0
+            self.host_lens[b] = 0
+            mask[b] = True
+            budgets[b] = req.max_new_tokens
             self._bt[b] = alloc.block_table(
-                [req.req_id], max_pages=self.g.pages_per_seq)[0]
+                [req.req_id], max_pages=g.pages_per_seq)[0]
         m = jnp.asarray(mask)
-        self.tokens = jnp.where(m, first, self.tokens)
-        self.positions = jnp.where(
-            m, jnp.asarray(self.host_lens.astype(np.int32)), self.positions)
+        zero = jnp.zeros((), jnp.int32)
+        self.positions = jnp.where(m, zero, self.positions)
+        self.counts = jnp.where(m, zero, self.counts)
+        self.budgets = jnp.asarray(budgets.astype(np.int32))
         self.finished = jnp.where(m, jnp.zeros((), bool), self.finished)
         self._bt_dev = jnp.asarray(self._bt)

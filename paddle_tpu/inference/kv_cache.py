@@ -29,7 +29,16 @@ import numpy as np
 
 
 class PageAllocator:
-    """Free-list allocator mapping sequence ids to page lists."""
+    """Free-list allocator mapping sequence ids to page lists.
+
+    The pool may be sized BELOW the dense ``max_batch * pages_per_seq``
+    worst case: freed pages recycle through the free list, admission
+    backpressure handles exhaustion at admission time, a sequence whose
+    mid-decode growth finds the pool dry is finalized early by the engine
+    (``_grow`` itself raises MemoryError only on the raw allocator API),
+    and ``stats()`` reports the high-water mark so operators can size the
+    pool to observed traffic instead of the worst case.
+    """
 
     def __init__(self, num_pages: int, page_size: int):
         self.num_pages = num_pages
@@ -37,10 +46,22 @@ class PageAllocator:
         self._free: List[int] = list(range(num_pages - 1, -1, -1))
         self._pages: Dict[int, List[int]] = {}     # seq id -> page ids
         self._lens: Dict[int, int] = {}            # seq id -> token count
+        self.peak_in_use = 0
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def stats(self) -> Dict[str, int]:
+        """Pool telemetry: live/peak page usage and active sequences."""
+        return {"num_pages": self.num_pages,
+                "pages_in_use": self.pages_in_use,
+                "peak_in_use": self.peak_in_use,
+                "active_seqs": len(self._pages)}
 
     def context_len(self, seq_id: int) -> int:
         return self._lens[seq_id]
@@ -53,6 +74,7 @@ class PageAllocator:
                 raise MemoryError(
                     f"KV cache exhausted: {self.num_pages} pages in use")
             pages.append(self._free.pop())
+        self.peak_in_use = max(self.peak_in_use, self.pages_in_use)
         self._lens[seq_id] = new_len
 
     def allocate(self, seq_id: int, num_tokens: int) -> np.ndarray:

@@ -38,7 +38,7 @@ from . import _sot
 
 __all__ = ["to_static", "not_to_static", "enable_to_static", "InputSpec",
            "StaticFunction", "TranslatedLayer", "save", "load",
-           "cache_stats"]
+           "cache_stats", "assert_no_recompiles"]
 
 _enabled = [True]
 
@@ -47,13 +47,68 @@ _enabled = [True]
 # LRU guard-cache drops across all StaticFunctions
 _STATS = {"compiles": 0, "evictions": 0, "bucket_pads": 0}
 
+# process-wide XLA-compile telemetry: every backend compile fires a
+# jax.monitoring duration event, StaticFunction or raw jax.jit alike.
+# This is what lets the serving tests/benches assert that a warm engine
+# loop triggers ZERO recompiles (the PR-1 telemetry, extended below the
+# guard-cache layer to the compiles XLA actually performs).
+_JIT_STATS = {"backend_compiles": 0}
+
+
+def _count_backend_compiles(name, *args, **kw):
+    if name == "/jax/core/compile/backend_compile_duration":
+        _JIT_STATS["backend_compiles"] += 1
+
+
+jax.monitoring.register_event_duration_secs_listener(_count_backend_compiles)
+
 
 def cache_stats() -> dict:
     """Compilation-cache telemetry: ``to_static`` guard caches (compiles /
-    LRU evictions / bucket paddings) + the eager dispatch seam's capped
-    caches (reference surface: SOT guard-tree statistics)."""
+    LRU evictions / bucket paddings), the eager dispatch seam's capped
+    caches (reference surface: SOT guard-tree statistics), and the
+    process-wide XLA backend-compile count."""
     from ..core.autograd import dispatch_cache_stats
-    return {"to_static": dict(_STATS), "dispatch": dispatch_cache_stats()}
+    return {"to_static": dict(_STATS), "dispatch": dispatch_cache_stats(),
+            "jit": dict(_JIT_STATS)}
+
+
+class assert_no_recompiles:
+    """Context manager failing if XLA compiles anything inside the block.
+
+    The serving engine's warm-step contract (and any steady-state loop's):
+    after warmup, every step must reuse an already-compiled executable.
+
+    ::
+
+        with paddle.jit.assert_no_recompiles():
+            for _ in range(32):
+                engine.step()
+
+    ``allow`` > 0 tolerates that many backend compiles (e.g. one final
+    host-transfer program).  The counter is process-wide, so keep the
+    block tight around the loop being asserted.  Exposed for benches: the
+    instance records ``.compiles`` on exit either way when ``record=True``
+    is used instead of raising.
+    """
+
+    def __init__(self, allow: int = 0, record: bool = False):
+        self.allow = allow
+        self.record = record
+        self.compiles = 0
+
+    def __enter__(self):
+        self._before = _JIT_STATS["backend_compiles"]
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.compiles = _JIT_STATS["backend_compiles"] - self._before
+        if exc_type is None and not self.record and self.compiles > self.allow:
+            raise AssertionError(
+                f"{self.compiles} XLA backend compile(s) inside an "
+                f"assert_no_recompiles(allow={self.allow}) block — the warm "
+                "path recompiled")
+        return False
 
 
 def enable_to_static(flag: bool):

@@ -1,25 +1,42 @@
-"""Paged-KV decode attention for TPU (Pallas) — the serving hot op.
+"""Ragged paged-KV attention for TPU (Pallas) — the serving hot op.
 
 Replaces the reference's fused decode kernels
 (paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu and
-masked_multihead_attention): one query token per sequence attends its whole
+masked_multihead_attention): each sequence's query tokens attend its whole
 KV history, which lives in fixed-size *pages* scattered through a global
 cache and addressed by a per-sequence block table (vLLM-style paged KV).
 
-TPU-first design:
+TPU-first design (the "Ragged Paged Attention" shape of arxiv 2604.15464):
+
+- **One mixed-mode kernel** serves prefill chunks AND decode tokens: the
+  query operand is ``[batch, T, q_heads, head_dim]`` where T is the step's
+  query-token tile (1 for pure decode, the chunk length for chunked
+  prefill), with per-sequence ``q_lens`` raggedness.  The step's OWN fresh
+  K/V rows (``k_new``/``v_new``, not yet committed to the cache) are folded
+  in-kernel with a causal mask, so a serving step never needs a separate
+  flash-attention call or an analytic current-token merge — chunked
+  prefill rides the decode schedule in one ``pallas_call``.
 - The KV cache is laid out **head-major**, ``[kv_heads, num_pages,
-  page_size, head_dim]``, so one (head, page) tile is a ``[page_size,
-  head_dim]`` VMEM block — native (sublane, lane) shape for the MXU, with
-  no squeezed dimension inside the tile.
-- The block table and context lengths ride in as **scalar-prefetch**
-  operands (`pltpu.PrefetchScalarGridSpec`): the index map reads
-  ``block_table[b, i]`` to DMA exactly the pages the sequence owns, so HBM
-  traffic is O(context), never O(max_context).
-- GQA is native: the grid is (batch, kv_heads, pages) and each program
-  holds the ``group = q_heads // kv_heads`` query rows for one KV head —
+  page_size, head_dim]``, and stays in **HBM** (``pltpu.ANY``): the kernel
+  itself DMAs exactly the pages a sequence owns into a two-slot VMEM ring,
+  **double-buffered** — page ``p+1``'s copy is started while page ``p`` is
+  being computed (the same overlap pattern as the grouped_matmul fused
+  gather).  The buffer slot is ``p % 2`` with p the *absolute* page index,
+  so the prefetch chain continues across page-chunk grid steps with no
+  warm-up bubble after the first page.
+- The grid is **(sequence, kv_head, page_chunk)** with per-sequence
+  ``context_lens`` raggedness: a chunk wholly beyond a sequence's context
+  issues NO DMA and no compute — HBM traffic and FLOPs are O(context),
+  never O(max_context).
+- The block table, context lengths and query lengths ride in as
+  **scalar-prefetch** operands (``pltpu.PrefetchScalarGridSpec``), so page
+  ids resolve before the body runs — data-dependent addressing with zero
+  data-dependent control flow outside ``fori_loop`` trip counts.
+- GQA is native: each program holds the ``group = q_heads // kv_heads``
+  query rows of all T tokens for one KV head (``T * group`` MXU rows), so
   K/V pages are fetched ONCE per group, not per query head.
-- Online softmax (m, l, acc) carries across the page axis in VMEM scratch,
-  which persists along the innermost grid dimension.
+- Online softmax (m, l, acc) carries across the page-chunk axis in VMEM
+  scratch, which persists along the innermost grid dimension.
 
 Falls back to an XLA gather+masked-softmax reference off-TPU (tests use it
 as the numerics oracle; ``FLAGS_paged_attention_interpret=1`` runs the real
@@ -43,9 +60,16 @@ _I0 = np.int32(0)  # index-map literal: bare 0 would be int64 under x64 mode
 flags.define_flag("paged_attention_interpret", False,
                   "Run the Pallas paged-attention kernel in interpreter mode "
                   "on CPU (tests only; TPU always uses the compiled path).")
+flags.define_flag("paged_attention_pages_per_chunk", 8,
+                  "KV pages per page-chunk grid step of the ragged "
+                  "paged-attention kernel. Chunks wholly beyond a "
+                  "sequence's context are skipped (no DMA, no compute); "
+                  "within a chunk pages are double-buffered.")
 
-_MIN_GROUP = 8  # pad query-group rows to the f32 sublane count
+_SUBLANE = 8  # f32 sublane count — query-row tiles pad to a multiple
 
+
+# --------------------------------------------------------------- oracles ---
 
 def _reference_paged_attention(q, k_cache, v_cache, block_tables,
                                context_lens, with_lse=False):
@@ -76,39 +100,117 @@ def _reference_paged_attention(q, k_cache, v_cache, block_tables,
     return out, lse.reshape(b, qh)
 
 
-def _paged_attn_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                       m_ref, l_ref, acc_ref, *, page_size, scale):
-    """One (batch, kv_head, page) program: online-softmax over one KV page.
+def _reference_ragged_paged_attention(q, k_cache, v_cache, block_tables,
+                                      context_lens, q_lens=None, k_new=None,
+                                      v_new=None):
+    """XLA oracle for the mixed prefill+decode form.
 
-    bt_ref/cl_ref are scalar-prefetched (block table, context lens); the
-    page to visit was already selected by the k/v index maps.
+    q: [B, T, qh, d]; k_new/v_new: [B, T, kvh, d] — the step's fresh rows,
+    attended with an intra-step causal mask on top of the cached context.
+    Rows with token index >= q_lens[b] are don't-care (garbage-but-finite,
+    exactly like the kernel).  Returns (out [B, T, qh, d], lse [B, T, qh]).
+    """
+    b, t, qh, d = q.shape
+    kvh, n_pages, page_size, _ = k_cache.shape
+    group = qh // kvh
+    max_pages = block_tables.shape[1]
+    S = max_pages * page_size
+    scale = 1.0 / math.sqrt(d)
+
+    flat = block_tables.reshape(-1)
+    k = jnp.take(k_cache, flat, axis=1).reshape(kvh, b, S, d)
+    v = jnp.take(v_cache, flat, axis=1).reshape(kvh, b, S, d)
+
+    qg = q.reshape(b, t, kvh, group, d).astype(jnp.float32)
+    s = jnp.einsum("btkgd,kbsd->btkgs", qg, k.astype(jnp.float32)) * scale
+    pos = jnp.arange(S)
+    mask = pos[None, :] < context_lens[:, None]                    # [B, S]
+    s = jnp.where(mask[:, None, None, None, :], s, NEG_INF)
+    parts_s, parts_v = [s], [v]
+    if k_new is not None:
+        kn = jnp.moveaxis(k_new, 2, 0).astype(jnp.float32)   # [kvh, B, T, d]
+        vn = jnp.moveaxis(v_new, 2, 0).astype(jnp.float32)
+        s2 = jnp.einsum("btkgd,kbjd->btkgj", qg, kn) * scale
+        jq = jnp.arange(t)
+        ql = (q_lens if q_lens is not None
+              else jnp.full((b,), t)).astype(jnp.int32)
+        causal = jq[None, :, None] >= jq[None, None, :]          # [1, T, T]
+        valid = jnp.logical_and(causal, jq[None, None, :] < ql[:, None, None])
+        s2 = jnp.where(valid[:, :, None, None, :], s2, NEG_INF)
+        parts_s.append(s2)
+        parts_v.append(vn)
+    s_all = jnp.concatenate(parts_s, axis=-1)
+    p = jax.nn.softmax(s_all, axis=-1)
+    v_all = jnp.concatenate(parts_v, axis=2)                  # [kvh, B, *, d]
+    out = jnp.einsum("btkgs,kbsd->btkgd", p, v_all)
+    out = out.reshape(b, t, qh, d).astype(q.dtype)
+    lse = jax.scipy.special.logsumexp(s_all, axis=-1).reshape(b, t, qh)
+    return out, lse
+
+
+# ---------------------------------------------------------------- kernel ---
+
+def _ragged_paged_attn_kernel(*refs, page_size, ppc, scale, t, group,
+                              has_new):
+    """One (sequence, kv_head, page_chunk) program.
+
+    Double-buffered page loop over this chunk's live pages (slot = absolute
+    page index % 2, so the prefetch chain crosses chunk boundaries); the
+    final chunk folds the step's fresh K/V rows with a causal mask and
+    normalizes.
     """
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    it = iter(refs)
+    bt_ref, cl_ref, ql_ref = next(it), next(it), next(it)
+    q_ref = next(it)
+    knew_ref = next(it) if has_new else None
+    vnew_ref = next(it) if has_new else None
+    k_hbm, v_hbm = next(it), next(it)
+    o_ref, lse_ref = next(it), next(it)
+    kbuf, vbuf, sem = next(it), next(it), next(it)
+    m_ref, l_ref, acc_ref = next(it), next(it), next(it)
 
     b = pl.program_id(0)
-    i = pl.program_id(2)
-    n_i = pl.num_programs(2)
+    h = pl.program_id(1)
+    c = pl.program_id(2)
+    n_c = pl.num_programs(2)
     ctx = cl_ref[b]
+    # all int scalars must stay strongly-typed int32: python-int divisors /
+    # clip bounds embed i64 literals under x64 mode, and the i64->i32
+    # convert_element_type they force breaks Mosaic lowering (the round-4
+    # recursion bug) — hence lax.div/lax.rem against np.int32 constants
+    ps_c = np.int32(page_size)
+    pages_total = jax.lax.div(ctx + ps_c - np.int32(1), ps_c)
+    start = c * np.int32(ppc)
+    n_here = jnp.minimum(jnp.maximum(pages_total - start, _I0),
+                         np.int32(ppc))
 
-    @pl.when(i == 0)
+    def k_copy(p, slot):
+        return pltpu.make_async_copy(
+            k_hbm.at[h, bt_ref[b, p]], kbuf.at[slot], sem.at[slot, _I0])
+
+    def v_copy(p, slot):
+        return pltpu.make_async_copy(
+            v_hbm.at[h, bt_ref[b, p]], vbuf.at[slot],
+            sem.at[slot, np.int32(1)])
+
+    @pl.when(c == 0)
     def _init():
         m_ref[...] = jnp.full(m_ref.shape, NEG_INF, m_ref.dtype)
         l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
         acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
 
-    # pages wholly beyond the context contribute nothing — skip the math
-    # (their DMA was clamped to page 0 host-side)
-    used = i * page_size < ctx
+    # chain warm-up: only the very first live page of a (seq, head) visit
+    # has no chunk before it to have prefetched it
+    @pl.when(jnp.logical_and(c == 0, pages_total > 0))
+    def _warmup():
+        k_copy(_I0, _I0).start()
+        v_copy(_I0, _I0).start()
 
-    @pl.when(used)
-    def _compute():
-        q = q_ref[...].astype(jnp.float32) * jnp.float32(scale)  # [g, d]
-        k = k_ref[...].astype(jnp.float32)                       # [page, d]
-        v = v_ref[...].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [g, page]
-        pos = i * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos < ctx, s, jnp.float32(NEG_INF))
+    def _accumulate(s, v):
+        """Online-softmax update of the (m, l, acc) scratch carry."""
         m_prev, l_prev = m_ref[...], l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -116,73 +218,206 @@ def _paged_attn_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[...] = m_new
         l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = alpha * acc_ref[...] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    @pl.when(i == n_i - 1)
+    @pl.when(n_here > 0)
+    def _pages():
+        q = q_ref[...].astype(jnp.float32) * jnp.float32(scale)  # [R, d]
+
+        def body(i, carry):
+            p = start + i
+            slot = jax.lax.rem(p, np.int32(2))
+            nxt = p + np.int32(1)
+
+            # prefetch page p+1 (possibly the NEXT chunk's first page)
+            # while p's arrival is awaited and computed on
+            @pl.when(nxt < pages_total)
+            def _prefetch():
+                nslot = jax.lax.rem(nxt, np.int32(2))
+                k_copy(nxt, nslot).start()
+                v_copy(nxt, nslot).start()
+
+            k_copy(p, slot).wait()
+            v_copy(p, slot).wait()
+            k = kbuf[slot].astype(jnp.float32)                 # [page, d]
+            v = vbuf[slot].astype(jnp.float32)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            pos = p * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(pos < ctx, s, jnp.float32(NEG_INF))
+            _accumulate(s, v)
+            return carry
+
+        # int32 literals: a bare python 0 is an i64 under x64 mode, and an
+        # i64->i32 convert inside the kernel breaks Mosaic lowering
+        jax.lax.fori_loop(_I0, n_here.astype(jnp.int32), body, _I0)
+
+    @pl.when(c == n_c - 1)
     def _finalize():
+        if has_new:   # static: compiled in only for the mixed-mode form
+            q = q_ref[...].astype(jnp.float32) * jnp.float32(scale)
+            kn = knew_ref[...].astype(jnp.float32)             # [Tp, d]
+            vn = vnew_ref[...].astype(jnp.float32)
+            s = jax.lax.dot_general(q, kn, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            jq = jax.lax.div(
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 0),
+                jnp.full(s.shape, group, jnp.int32))
+            jk = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            valid = jnp.logical_and(jk <= jq, jk < ql_ref[b])
+            s = jnp.where(valid, s, jnp.float32(NEG_INF))
+            _accumulate(s, vn)
         l = jnp.maximum(l_ref[...], jnp.float32(1e-30))
         o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
         lse_ref[...] = m_ref[...] + jnp.log(l)
 
 
-def _pallas_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
-                            interpret):
+def _pallas_ragged_paged_attention(q, k_cache, v_cache, block_tables,
+                                   context_lens, q_lens, k_new, v_new,
+                                   interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, qh, d = q.shape
+    b, t, qh, d = q.shape
     kvh, n_pages, page_size, _ = k_cache.shape
     group = qh // kvh
     max_pages = block_tables.shape[1]
-    gp = max(group, _MIN_GROUP)
+    rows = t * group
+    R = -(-max(rows, _SUBLANE) // _SUBLANE) * _SUBLANE
 
-    qg = q.reshape(b, kvh, group, d)
-    if gp != group:
-        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+    # [B, T, qh, d] -> [B, kvh, T*group, d]: row r = token*(group) + g, so
+    # one MXU tile holds every query row sharing this program's KV head
+    qg = q.reshape(b, t, kvh, group, d).transpose(0, 2, 1, 3, 4)
+    qg = qg.reshape(b, kvh, rows, d)
+    if R != rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, R - rows), (0, 0)))
+
+    ppc = max(1, min(int(flags.flag("paged_attention_pages_per_chunk")),
+                     max_pages))
+    n_chunks = -(-max_pages // ppc)
 
     # unused table entries must still be valid page ids for the DMA
     bt = jnp.clip(block_tables, 0, n_pages - 1).astype(jnp.int32)
     cl = context_lens.astype(jnp.int32)
+    ql = (q_lens if q_lens is not None
+          else jnp.full((b,), t)).astype(jnp.int32)
 
-    kernel = functools.partial(_paged_attn_kernel, page_size=page_size,
-                               scale=1.0 / math.sqrt(d))
+    has_new = k_new is not None
+    operands = [qg]
+    in_specs = [pl.BlockSpec((None, None, R, d),
+                             lambda b_, h, c, *_: (b_, h, _I0, _I0))]
+    if has_new:
+        Tp = -(-t // _SUBLANE) * _SUBLANE
+        kn = k_new.transpose(0, 2, 1, 3)        # [B, kvh, T, d]
+        vn = v_new.transpose(0, 2, 1, 3)
+        if Tp != t:
+            pad = ((0, 0), (0, 0), (0, Tp - t), (0, 0))
+            kn, vn = jnp.pad(kn, pad), jnp.pad(vn, pad)
+        spec = pl.BlockSpec((None, None, Tp, d),
+                            lambda b_, h, c, *_: (b_, h, _I0, _I0))
+        operands += [kn, vn]
+        in_specs += [spec, spec]
+    operands += [k_cache, v_cache]
+    in_specs += [pl.BlockSpec(memory_space=pltpu.ANY),
+                 pl.BlockSpec(memory_space=pltpu.ANY)]
+
+    kernel = functools.partial(
+        _ragged_paged_attn_kernel, page_size=page_size, ppc=ppc,
+        scale=1.0 / math.sqrt(d), t=t, group=group, has_new=has_new)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, kvh, max_pages),
-        in_specs=[
-            pl.BlockSpec((None, None, gp, d),
-                         lambda b_, h, i, bt_, cl_: (b_, h, _I0, _I0)),
-            pl.BlockSpec((None, None, page_size, d),
-                         lambda b_, h, i, bt_, cl_: (h, bt_[b_, i], _I0, _I0)),
-            pl.BlockSpec((None, None, page_size, d),
-                         lambda b_, h, i, bt_, cl_: (h, bt_[b_, i], _I0, _I0)),
-        ],
+        num_scalar_prefetch=3,
+        grid=(b, kvh, n_chunks),
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((None, None, gp, d),
-                         lambda b_, h, i, bt_, cl_: (b_, h, _I0, _I0)),
-            pl.BlockSpec((None, None, gp, 1),
-                         lambda b_, h, i, bt_, cl_: (b_, h, _I0, _I0)),
+            pl.BlockSpec((None, None, R, d),
+                         lambda b_, h, c, *_: (b_, h, _I0, _I0)),
+            pl.BlockSpec((None, None, R, 1),
+                         lambda b_, h, c, *_: (b_, h, _I0, _I0)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((gp, 1), jnp.float32),
-            pltpu.VMEM((gp, 1), jnp.float32),
-            pltpu.VMEM((gp, d), jnp.float32),
+            pltpu.VMEM((2, page_size, d), k_cache.dtype),
+            pltpu.VMEM((2, page_size, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, d), jnp.float32),
         ],
     )
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((b, kvh, gp, d), q.dtype),
-                   jax.ShapeDtypeStruct((b, kvh, gp, 1), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((b, kvh, R, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, kvh, R, 1), jnp.float32)],
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(bt, cl, qg, k_cache, v_cache)
-    return (out[:, :, :group, :].reshape(b, qh, d),
-            lse[:, :, :group, 0].reshape(b, qh))
+    )(bt, cl, ql, *operands)
+    out = out[:, :, :rows].reshape(b, kvh, t, group, d)
+    out = out.transpose(0, 2, 1, 3, 4).reshape(b, t, qh, d)
+    lse = lse[:, :, :rows, 0].reshape(b, kvh, t, group)
+    lse = lse.transpose(0, 2, 1, 3).reshape(b, t, qh)
+    return out, lse
+
+
+# ----------------------------------------------------------- entry points ---
+
+def ragged_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
+                           *, q_lens=None, k_new=None, v_new=None,
+                           with_lse=False):
+    """Mixed-mode serving attention: prefill chunks and decode tokens in one
+    call over a paged KV cache.
+
+    Args:
+      q:            [batch, T, num_q_heads, head_dim] — this step's query
+                    tokens (T = 1 for pure decode, the chunk length for
+                    chunked prefill; sequences ragged via ``q_lens``).
+      k_cache:      [num_kv_heads, num_pages, page_size, head_dim].
+      v_cache:      same shape as k_cache.
+      block_tables: [batch, max_pages_per_seq] int32 page ids (pad with 0).
+      context_lens: [batch] int32 — tokens ALREADY in the cache (the prior
+                    context; this step's own tokens are NOT included).
+      q_lens:       [batch] int32 — valid query tokens per sequence
+                    (None = all T).  Output rows past q_lens[b] are
+                    don't-care.
+      k_new/v_new:  [batch, T, num_kv_heads, head_dim] — the step's fresh
+                    KV rows, folded in with a causal mask (token j attends
+                    new tokens <= j).  They need not be written to the
+                    cache before the call; commit them after the step.
+      with_lse:     also return the per-query logsumexp [batch, T, q_heads]
+                    (fp32) for online-softmax merging of extra keys.
+
+    Returns [batch, T, num_q_heads, head_dim] (and lse when requested).
+    """
+    b, t, qh, d = q.shape
+    kvh, _, page_size, _ = k_cache.shape
+    if qh % kvh:
+        raise ValueError(f"q heads ({qh}) must be a multiple of kv heads ({kvh})")
+    if (k_new is None) != (v_new is None):
+        raise ValueError("k_new and v_new must be given together")
+    on_tpu = jax.default_backend() == "tpu"
+    interpret = flags.flag("paged_attention_interpret")
+    # f32 sublane is 8; bf16 packs 16 — page_size must tile the sublane dim
+    ok = page_size % 8 == 0 and d % 128 in (0, 64)
+    if (on_tpu or interpret) and ok:
+        out, lse = _pallas_ragged_paged_attention(
+            q, k_cache, v_cache, block_tables, context_lens, q_lens,
+            k_new, v_new, interpret=not on_tpu)
+    else:
+        out, lse = _reference_ragged_paged_attention(
+            q, k_cache, v_cache, block_tables, context_lens, q_lens,
+            k_new, v_new)
+    return (out, lse) if with_lse else out
 
 
 def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
                     with_lse=False):
     """Single-token decode attention over a paged KV cache.
+
+    The T=1, no-fresh-rows form of :func:`ragged_paged_attention` (kept as
+    the stable decode API; the reference oracle for it is
+    ``_reference_paged_attention``).
 
     Args:
       q:            [batch, num_q_heads, head_dim] — this step's query.
@@ -197,22 +432,15 @@ def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
 
     Returns [batch, num_q_heads, head_dim] (and lse when requested).
     """
-    b, qh, d = q.shape
-    kvh, _, page_size, _ = k_cache.shape
-    if qh % kvh:
-        raise ValueError(f"q heads ({qh}) must be a multiple of kv heads ({kvh})")
-    on_tpu = jax.default_backend() == "tpu"
-    interpret = flags.flag("paged_attention_interpret")
-    # f32 sublane is 8; bf16 packs 16 — page_size must tile the sublane dim
-    ok = page_size % 8 == 0 and d % 128 in (0, 64)
-    if (on_tpu or interpret) and ok:
-        out, lse = _pallas_paged_attention(
-            q, k_cache, v_cache, block_tables, context_lens,
-            interpret=not on_tpu)
-        return (out, lse) if with_lse else out
-    return _reference_paged_attention(q, k_cache, v_cache, block_tables,
-                                      context_lens, with_lse=with_lse)
+    res = ragged_paged_attention(q[:, None], k_cache, v_cache, block_tables,
+                                 context_lens, with_lse=with_lse)
+    if with_lse:
+        out, lse = res
+        return out[:, 0], lse[:, 0]
+    return res[:, 0]
 
+
+# ----------------------------------------------------------- cache writes ---
 
 def write_kv_pages(k_cache, v_cache, k_new, v_new, slot_mapping):
     """Scatter new KV rows into the paged cache.

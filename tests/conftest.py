@@ -25,6 +25,29 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# ---- pinned-jax version gates ---------------------------------------------
+# The container pins jax 0.4.37, which ships two SPMD bugs this repo cannot
+# work around in-tree (tracked in ROADMAP "Pinned jax gaps"; both pre-date
+# PR 1 — seed-failing — and reproduce on stock jax without this repo's
+# shims; re-check whenever the pin moves):
+#   1. XLA verifier failure "s64 vs s32 compare" in the scan-transpose
+#      dynamic_update_slice lowering under SPMD partitioning with x64 on
+#      (the zero1/zero3 multi-device optimizer-state configs).
+#   2. Partial-auto shard_map lowers a PartitionId instruction that SPMD
+#      partitioning rejects (UNIMPLEMENTED: "PartitionId ... ambiguous") in
+#      the pipeline-parallel schedules (1f1b/interleave/zbh1/zbvpp paths).
+JAX_VERSION = tuple(int(p) for p in jax.__version__.split(".")[:3])
+PINNED_JAX_SPMD_BUGS = JAX_VERSION <= (0, 4, 38)
+
+xfail_pinned_scan_transpose = pytest.mark.xfail(
+    PINNED_JAX_SPMD_BUGS, strict=False,
+    reason="pinned jax <= 0.4.38: XLA s64/s32 scan-transpose "
+           "dynamic_update_slice verifier bug under SPMD + x64")
+xfail_pinned_partial_auto = pytest.mark.xfail(
+    PINNED_JAX_SPMD_BUGS, strict=False,
+    reason="pinned jax <= 0.4.38: partial-auto shard_map emits PartitionId, "
+           "unsupported under SPMD partitioning")
+
 
 @pytest.fixture
 def rng():

@@ -113,19 +113,45 @@ class TestFlashAttentionMosaic:
 
 
 class TestPagedAttentionMosaic:
+    b, qh, kvh, d = 2, 8, 4, 128
+    n_pages, page_size, max_pages = 16, 32, 8
+
+    def _cache(self):
+        k_cache = _rand((self.kvh, self.n_pages, self.page_size, self.d),
+                        seed=1)
+        v_cache = _rand((self.kvh, self.n_pages, self.page_size, self.d),
+                        seed=2)
+        bt = jnp.zeros((self.b, self.max_pages), jnp.int32)
+        cl = jnp.full((self.b,), 40, jnp.int32)
+        return k_cache, v_cache, bt, cl
+
     def test_decode_kernel(self):
         from paddle_tpu.kernels.paged_attention import \
-            _pallas_paged_attention
+            _pallas_ragged_paged_attention
 
-        b, qh, kvh, d = 2, 8, 4, 128
-        n_pages, page_size, max_pages = 16, 32, 8
-        q = _rand((b, qh, d))
-        k_cache = _rand((kvh, n_pages, page_size, d), seed=1)
-        v_cache = _rand((kvh, n_pages, page_size, d), seed=2)
-        bt = jnp.zeros((b, max_pages), jnp.int32)
-        cl = jnp.full((b,), 40, jnp.int32)
-        _export_tpu(lambda *a: _pallas_paged_attention(*a, False)[0],
-                    q, k_cache, v_cache, bt, cl)
+        q = _rand((self.b, 1, self.qh, self.d))
+        k_cache, v_cache, bt, cl = self._cache()
+        _export_tpu(
+            lambda *a: _pallas_ragged_paged_attention(
+                *a, None, None, None, False)[0],
+            q, k_cache, v_cache, bt, cl)
+
+    def test_mixed_mode_kernel(self):
+        """Prefill chunk + fresh-KV causal fold, the ragged mixed form."""
+        from paddle_tpu.kernels.paged_attention import \
+            _pallas_ragged_paged_attention
+
+        T = 16
+        q = _rand((self.b, T, self.qh, self.d))
+        k_cache, v_cache, bt, cl = self._cache()
+        ql = jnp.asarray([T, 3], jnp.int32)
+        kn = _rand((self.b, T, self.kvh, self.d), seed=3)
+        vn = _rand((self.b, T, self.kvh, self.d), seed=4)
+        _export_tpu(
+            lambda q_, kc, vc, bt_, cl_, ql_, kn_, vn_:
+                _pallas_ragged_paged_attention(
+                    q_, kc, vc, bt_, cl_, ql_, kn_, vn_, False)[0],
+            q, k_cache, v_cache, bt, cl, ql, kn, vn)
 
 
 class TestWeightOnlyMosaic:
