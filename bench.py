@@ -642,8 +642,108 @@ def _run_flash_autotune(on_tpu):
     }
 
 
+def _run_grad_comm(on_tpu):
+    """ISSUE 3: grad_comm A/B over the dp mesh — "auto" (the XLA-emitted
+    collective, parity oracle) vs the explicit bucketed fp32 ring vs the
+    EQuARX-style int8 ring.  Reports step time, tokens/s, the analytic
+    bytes-moved per gradient sync, and the loss delta vs the oracle."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models.pretrain import ParallelConfig, PretrainStep
+
+    ndev = len(jax.devices())
+    dp = 1
+    while dp * 2 <= min(ndev, 8):
+        dp *= 2
+    if dp < 2:
+        return {"grad_comm_note": f"needs >= 2 devices, have {ndev}"}
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        batch, seq, steps = 2 * dp, 1024, 8
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps = 8, 32, 4
+    rng = np.random.default_rng(0)
+    ids_np = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    lbl_np = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+
+    out = {"grad_comm_dp": dp}
+    ref_loss = None
+    for arm in ("auto", "ring", "ring_int8"):
+        pc = ParallelConfig(dp=dp, grad_comm=arm, remat=on_tpu,
+                            loss_chunks=16 if on_tpu else 1,
+                            m_dtype="bfloat16" if on_tpu else "float32")
+        ps = PretrainStep(cfg, pc)
+        state = ps.init_state(seed=0)
+        ids, labels = ps.shard_batch(ids_np, lbl_np)
+        state, loss = ps.train_step(state, ids, labels)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = ps.train_step(state, ids, labels)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        out[f"grad_comm_{arm}_tok_per_sec"] = round(
+            batch * seq * steps / dt, 1)
+        out[f"grad_comm_{arm}_step_ms"] = round(dt / steps * 1e3, 2)
+        out[f"grad_comm_{arm}_bytes_per_step"] = ps.grad_sync_bytes()
+        out[f"grad_comm_{arm}_loss"] = round(float(loss), 4)
+        if arm == "auto":
+            ref_loss = float(loss)
+        else:
+            out[f"grad_comm_{arm}_loss_delta"] = round(
+                abs(float(loss) - ref_loss), 5)
+        del ps, state
+    out["grad_comm_int8_bytes_ratio"] = round(
+        out["grad_comm_ring_bytes_per_step"]
+        / max(out["grad_comm_ring_int8_bytes_per_step"], 1), 2)
+    return out
+
+
+# extras measured after the flagship ladder, each in its own subprocess
+_EXTRAS = (("large", _run_large), ("decode", _run_decode),
+           ("moe", _run_moe), ("gpt2", _run_gpt2_compiled_vs_eager),
+           ("dit", _run_dit), ("flash", _run_flash_autotune),
+           ("grad_comm", _run_grad_comm))
+
+
+def _force_host_devices(n=8):
+    """Force an n-device host (CPU) platform before the backend
+    initializes — the dp axis for the grad_comm A/B off-chip.  Affects
+    only the CPU platform, so it is harmless when the TPU plugin is
+    active.  Shared with benchmarks/run.py's grad_comm config."""
+    xf = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xf:
+        os.environ["XLA_FLAGS"] = (
+            xf + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def _extra_main(name):
+    """--extra NAME entry point: one extra config, fresh process."""
+    if name == "grad_comm":
+        _force_host_devices()
+    _force_cpu_if_asked()
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    try:
+        out = dict(_EXTRAS)[name](on_tpu)
+    except Exception as e:
+        traceback.print_exc(file=sys.stderr)
+        out = {f"{name}_error": f"{type(e).__name__}: {str(e)[:150]}"}
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def _child_main():
-    """Measured ladder. Runs inside a parent-supervised subprocess."""
+    """Measured flagship ladder ONLY — extras run as sibling subprocesses
+    of the parent AFTER this process (and its PJRT client) is gone, so a
+    TPU extra never races the child for the per-process libtpu lock."""
     _force_cpu_if_asked()
     import jax
 
@@ -659,22 +759,7 @@ def _child_main():
             result = _run_config(mk, batch, seq, steps, on_tpu, pce)
             if i > 1:
                 result["degraded"] = i  # ran a fallback rung, not the flagship
-            # print incrementally: the parent takes the LAST parseable line,
-            # so if the child is killed mid-extras (timeout, tunnel drop)
-            # the flagship number + extras measured so far still land
             print(json.dumps(result), flush=True)
-            for name, fn in (("large", _run_large), ("decode", _run_decode),
-                             ("moe", _run_moe),
-                             ("gpt2", _run_gpt2_compiled_vs_eager),
-                             ("dit", _run_dit),
-                             ("flash", _run_flash_autotune)):
-                try:
-                    result.update(fn(on_tpu))
-                except Exception as e:
-                    result[f"{name}_error"] = (
-                        f"{type(e).__name__}: {str(e)[:150]}")
-                    traceback.print_exc(file=sys.stderr)
-                print(json.dumps(result), flush=True)
             # explicit completion marker: the parent accepts on this, not
             # on rc — a child that prints everything and then hangs in
             # PJRT teardown until the timeout kill (observed mode) still
@@ -727,7 +812,7 @@ def _spawn(argv, env, timeout):
         return -1, "", f"{type(e).__name__}: {e}"
 
 
-def _extract_json(stdout):
+def _extract_json(stdout, require_metric=True):
     """Last stdout line that parses as the bench JSON dict, else None."""
     for line in reversed(stdout.strip().splitlines()):
         line = line.strip()
@@ -737,9 +822,33 @@ def _extract_json(stdout):
             obj = json.loads(line)
         except ValueError:
             continue
-        if isinstance(obj, dict) and "metric" in obj:
+        if isinstance(obj, dict) and ("metric" in obj or not require_metric):
             return obj
     return None
+
+
+def _run_extras(result, env, platform):
+    """Merge every extra config into ``result``, each measured in a FRESH
+    subprocess (the BENCH_NOTES cross-contamination fix: the old
+    in-process ladder ran the decode config after the train benches and
+    reported ~401 tok/s where the standalone harness measured ~724 —
+    compilation/device state leaked between configs).  Runs from the
+    jax-free parent AFTER the ladder child exited, so on TPU each extra
+    gets the per-process libtpu lock to itself, with its own timeout
+    outside the child's budget.  Prints incrementally — the driver takes
+    the LAST parseable line, so a kill mid-extras still lands everything
+    measured so far."""
+    print(json.dumps(result), flush=True)
+    tmo = 900 if platform == "tpu" else 420
+    for name, _fn in _EXTRAS:
+        rc, out, err = _spawn(["--extra", name], env, tmo)
+        extra = _extract_json(out, require_metric=False)
+        if extra is None:
+            extra = {f"{name}_error":
+                     f"extra subprocess rc={rc}: {err[-200:]}"}
+        result.update(extra)
+        print(json.dumps(result), flush=True)
+    return result
 
 
 def _parent_main():
@@ -761,9 +870,11 @@ def _parent_main():
         diag.append(f"probe[{i}] rc={rc}: {err[-300:]}")
         time.sleep(10 + 10 * i)
 
-    # 2) measured run on the probed backend (2 attempts), with its own timeout
+    # 2) measured run on the probed backend (2 attempts), with its own
+    #    timeout — the child is the flagship ladder only; extras follow
+    #    as parent-level subprocesses once the child's PJRT client is gone
     if platform is not None:
-        tmo = 2700 if platform == "tpu" else 1500
+        tmo = 1800 if platform == "tpu" else 900
         partial = None
         for i in range(2):
             rc, out, err = _spawn(["--child"], probe_env, tmo)
@@ -772,6 +883,7 @@ def _parent_main():
             # only (a complete child may be timeout-killed in teardown)
             if result is not None and (result.pop("complete", False)
                                        or rc == 0):
+                result = _run_extras(result, probe_env, platform)
                 if diag:
                     result["bench_diag"] = "; ".join(diag)[:1000]
                 print(json.dumps(result))
@@ -785,6 +897,7 @@ def _parent_main():
             diag.append(f"child[{i}] rc={rc}: {err[-400:]}")
             time.sleep(15)
         if partial is not None:
+            partial = _run_extras(partial, probe_env, platform)
             if diag:
                 partial["bench_diag"] = "; ".join(diag)[:1000]
             print(json.dumps(partial))
@@ -795,12 +908,13 @@ def _parent_main():
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_FORCE_CPU"] = "1"
     for i in range(2):
-        rc, out, err = _spawn(["--child"], env, 1500)
+        rc, out, err = _spawn(["--child"], env, 900)
         result = _extract_json(out)
         if result is not None:
             if not result.pop("complete", False) and rc != 0:
                 result["bench_partial"] = (   # salvaged from a killed child
                     f"child rc={rc}; last complete measurement kept")
+            result = _run_extras(result, env, "cpu")
             result["bench_diag"] = ("tpu-unavailable, cpu fallback; " +
                                     "; ".join(diag))[:1000]
             print(json.dumps(result))
@@ -821,6 +935,8 @@ def main():
         return _probe_main()
     if "--child" in sys.argv:
         return _child_main()
+    if "--extra" in sys.argv:
+        return _extra_main(sys.argv[sys.argv.index("--extra") + 1])
     return _parent_main()
 
 

@@ -230,9 +230,22 @@ def run_longctx():
     }
 
 
+def run_grad_comm():
+    """ISSUE 3: one-command grad_comm A/B (`python benchmarks/run.py
+    grad_comm --cpu`) — auto (XLA psum oracle) vs bucketed fp32 ring vs
+    EQuARX-style int8 ring gradient sync; step time + bytes moved per
+    collective.  Needs a dp axis: forces an 8-device host platform before
+    the backend initializes (a no-op for the TPU plugin, and too late only
+    in `--inproc all` single-process runs, where the A/B then records a
+    needs-devices note instead)."""
+    import bench
+    bench._force_host_devices()
+    return {"config": "grad_comm_ab", **bench._run_grad_comm(_on_tpu())}
+
+
 CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
            "dit": run_dit, "moe": run_moe, "decode": run_decode,
-           "longctx": run_longctx}
+           "longctx": run_longctx, "grad_comm": run_grad_comm}
 
 
 def _supervise(names, timeout):

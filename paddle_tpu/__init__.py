@@ -22,6 +22,8 @@ if not hasattr(_jax, "shard_map"):
     from jax.experimental.shard_map import shard_map as _exp_shard_map
 
     def _shard_map_compat(f, mesh, in_specs, out_specs, **kw):
+        if "check_vma" in kw:   # the graduated rename of check_rep
+            kw.setdefault("check_rep", kw.pop("check_vma"))
         kw.setdefault("check_rep", False)
         names = kw.pop("axis_names", None)
         if names is not None:   # graduated API: manual axes by name; the

@@ -15,9 +15,11 @@ from .group import (  # noqa: F401
 )
 from .communication import (  # noqa: F401
     all_gather, all_gather_object, all_reduce, all_to_all, alltoall, barrier,
-    broadcast, broadcast_object_list, irecv, isend, ppermute, recv, reduce,
+    broadcast, broadcast_object_list, irecv, isend, ppermute,
+    quantized_all_reduce, quantized_reduce_scatter, recv, reduce,
     reduce_scatter, scatter, send,
 )
+from . import quantized_collectives  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,

@@ -246,6 +246,75 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 all_to_all = alltoall
 
 
+# ---- quantized collectives (EQuARX-style int8 ring; ISSUE 3) ----
+# Same ProcessGroup calling conventions as all_reduce/reduce_scatter above,
+# but the wire payload is blockwise-int8 (fp32 scales per `block` values)
+# over an explicit ppermute ring — ~4x less gradient traffic.  `key=None`
+# rounds to nearest; pass a PRNG key (fold in the step counter) for
+# unbiased, per-step-deterministic stochastic rounding.  The building
+# blocks live in `quantized_collectives` (shard_map-composable); these
+# wrappers add the eager stacked-tensor path.
+
+def quantized_all_reduce(tensor, group=None, block: int = 256, key=None,
+                         sync_op=True):
+    """SUM all-reduce with int8 ring payloads (blockwise fp32 scales).
+
+    Result dtype follows the input; internal accumulation is fp32 and the
+    dequantized result is bitwise identical on every rank.
+    """
+    from . import quantized_collectives as qc
+    g = _resolve_group(group)
+    x = _as_array(tensor)
+    if g.nranks == 1:
+        return _finish(tensor, x)
+
+    def ring(v):
+        flat = v.reshape(-1)
+        pad = (-flat.shape[0]) % g.nranks
+        if pad:
+            flat = jnp.pad(flat.astype(jnp.float32), (0, pad))
+        out, _ = qc.ring_all_reduce(flat, g.axis_name, axis_size=g.nranks,
+                                    int8=True, block=block, key=key)
+        return out[:v.size].reshape(v.shape).astype(v.dtype)
+
+    if _is_traced(x):
+        out = ring(x)
+    else:
+        _check_stack(x, g, "quantized_all_reduce")
+        out = _stacked(lambda v: ring(v[0])[None], g, x)
+    return _finish(tensor, out)
+
+
+def quantized_reduce_scatter(tensor, tensor_list=None, group=None,
+                             block: int = 256, key=None, sync_op=True):
+    """Reduce-scatter (SUM) with per-hop int8 requantization and fp32
+    accumulation (the EQuARX reduce-scatter half).  Per-rank input: list
+    of N chunks (or ``[N, *S]`` tensor); output: the rank's chunk.
+    Stacked eager input: ``[N_ranks, N_chunks, *S]``.
+    """
+    from . import quantized_collectives as qc
+    g = _resolve_group(group)
+    if tensor_list is not None:
+        x = jnp.stack([_as_array(t) for t in tensor_list])
+    else:
+        x = _as_array(tensor)
+    if g.nranks == 1:
+        return _finish(tensor, x[0] if tensor_list is not None else x)
+
+    def ring(v):   # v: [N, *S] per rank
+        out = qc.ring_reduce_scatter(
+            v.astype(jnp.float32).reshape(-1), g.axis_name,
+            axis_size=g.nranks, int8=True, block=block, key=key)
+        return out.reshape(v.shape[1:]).astype(v.dtype)
+
+    if _is_traced(x):
+        out = ring(x)
+    else:
+        _check_stack(x, g, "quantized_reduce_scatter")
+        out = _stacked(lambda v: ring(v[0])[None], g, x)
+    return _finish(tensor, out)
+
+
 # ---- p2p ----
 # Single-controller p2p: the controller plays both endpoints, so messages
 # queue FIFO per (group, dst) channel.  recv with a single live channel pops

@@ -1,0 +1,327 @@
+"""Quantized, bucketed gradient collectives — EQuARX-style int8 ring
+reduce-scatter / all-gather over a mesh axis (PAPERS.md: "EQuARX: Efficient
+Quantized AllReduce in XLA").
+
+At scale the data-parallel gradient all-reduce is the dominant step-time tax
+of the hybrid-parallel train loop (SURVEY.md §3.4, the reference's
+DataParallel grad sync).  EQuARX's observation: a ring all-reduce moves
+2*(n-1)/n bytes per element per device, and blockwise int8 quantization of
+the ring payloads recovers ~4x of that bandwidth with negligible quality
+loss — IF partial sums accumulate in full precision and rounding is
+unbiased.  This module is that design as `shard_map`-composable jax:
+
+  * **blockwise quantization** — per-`block` (default 256 values) fp32
+    absmax scales; int8 payload + scales travel together.
+  * **stochastic rounding** — counter-keyed (threefry) and deterministic
+    per (step, bucket, hop, rank): the same step quantizes the same way on
+    every run, so the gradient sync is bit-exactly reproducible while
+    staying unbiased across steps.
+  * **fp32 local accumulation, requantize per hop** — each ring hop
+    dequantizes the incoming partial, adds the local chunk in fp32, and
+    requantizes for the next hop (the EQuARX reduce-scatter); the
+    all-gather phase quantizes each fully-reduced chunk ONCE at its owner
+    and circulates the identical payload, so every device dequantizes the
+    same bits and replicated parameters cannot drift apart.
+  * **error feedback (optional)** — the all-gather-phase quantization
+    error of the chunk a device owns is returned so callers can carry it
+    in optimizer state and add it back next step (`ring_all_reduce`'s
+    ``error_feedback=``).
+
+All collectives here are the **traced per-rank path**: call them inside a
+``shard_map`` whose mesh binds ``axis_name`` (the eager stacked-tensor
+wrappers live in `communication.py` as ``quantized_all_reduce`` /
+``quantized_reduce_scatter``).  The ring is built from ``lax.ppermute``
+neighbor exchanges only — exactly the ICI-friendly schedule the TPU
+distributed linear-algebra work (PAPERS.md, arXiv 2112.09017) engineers
+against — so XLA can overlap hops with whatever compute surrounds them.
+
+Bucketing: `bucket_plan` / `pack_bucket` / `unpack_bucket` fuse a gradient
+pytree into per-dtype flat fp32 buckets (DDP-style; a leaf never spans two
+buckets) padded to the ring size, so one collective launch covers many
+small tensors.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "quantize_blockwise", "dequantize_blockwise",
+    "ring_reduce_scatter", "ring_all_gather", "ring_all_reduce",
+    "bucket_plan", "pack_bucket", "unpack_bucket", "bytes_moved",
+    "GRAD_COMM_SEED",
+]
+
+# base seed for the counter-keyed stochastic rounding; callers fold in the
+# step counter (and bucket index) so rounding is deterministic per step
+GRAD_COMM_SEED = 0x5EED
+
+
+# --------------------------------------------------------------- quantize --
+
+def _pad_to(x: jnp.ndarray, multiple: int) -> jnp.ndarray:
+    rem = x.shape[0] % multiple
+    if rem:
+        x = jnp.pad(x, (0, multiple - rem))
+    return x
+
+
+def quantize_blockwise(x, block: int = 256, key=None):
+    """Blockwise-int8 quantize a 1-D array.
+
+    Returns ``(q, scales)`` where ``q`` is int8 of the same (block-padded)
+    length and ``scales`` is fp32 ``[ceil(len/block)]`` (absmax/127 per
+    block).  Ragged tails are zero-padded internally — zeros quantize to
+    exactly 0, so padding never perturbs real values.
+
+    ``key=None`` rounds to nearest; with a PRNG key, rounding is stochastic
+    (floor + Bernoulli(frac)) — unbiased, and fully determined by the key.
+    """
+    xf = _pad_to(x.astype(jnp.float32), block).reshape(-1, block)
+    amax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
+    scales = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    y = xf / scales
+    if key is None:
+        q = jnp.round(y)
+    else:
+        lo = jnp.floor(y)
+        frac = y - lo
+        u = jax.random.uniform(key, y.shape, jnp.float32)
+        q = lo + (u < frac).astype(jnp.float32)
+    q = jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+    return q.reshape(-1), scales[:, 0]
+
+
+def dequantize_blockwise(q, scales, length: Optional[int] = None):
+    """Inverse of `quantize_blockwise`; ``length`` trims block padding."""
+    block = q.shape[0] // scales.shape[0]
+    x = q.astype(jnp.float32).reshape(-1, block) * scales[:, None]
+    x = x.reshape(-1)
+    return x[:length] if length is not None else x
+
+
+def _sr_key(key, hop: int, rank):
+    """Per-(hop, rank) stochastic-rounding key.  Each (chunk, hop)
+    quantization happens on exactly one rank, so this uniquely and
+    deterministically keys every rounding decision in the ring."""
+    return jax.random.fold_in(jax.random.fold_in(key, hop), rank)
+
+
+# ------------------------------------------------------------------- ring --
+
+def _axis_size(axis_name, axis_size):
+    return int(axis_size) if axis_size is not None else lax.psum(1, axis_name)
+
+
+def ring_reduce_scatter(x, axis_name: str, *, axis_size: Optional[int] = None,
+                        int8: bool = False, block: int = 256, key=None):
+    """Ring reduce-scatter over ``axis_name`` (traced path; call inside
+    shard_map).  ``x`` is the per-device flat buffer ``[n*c]``; returns the
+    device's fully-reduced chunk ``[c]`` (device p owns chunk p, matching
+    ``lax.psum_scatter`` with ``scatter_dimension=0``).
+
+    With ``int8=True`` each hop's outgoing partial is blockwise-quantized
+    (stochastic rounding under ``key``); accumulation stays fp32 per hop
+    (the EQuARX reduce-scatter).  ``n-1`` ``ppermute`` hops either way.
+    """
+    n = _axis_size(axis_name, axis_size)
+    if x.ndim != 1 or x.shape[0] % n:
+        raise ValueError(
+            f"ring_reduce_scatter: need a flat buffer divisible by the axis "
+            f"size {n}, got shape {list(x.shape)}")
+    chunks = x.astype(jnp.float32).reshape(n, -1)
+    if n == 1:
+        return chunks[0]
+    p = lax.axis_index(axis_name)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def chunk_at(j):
+        return lax.dynamic_index_in_dim(chunks, jnp.mod(j, n), 0,
+                                        keepdims=False)
+
+    # hop h sends the partial of chunk (p-h-1); the receiver folds in its
+    # own contribution in fp32.  After n-1 hops device p holds chunk p.
+    t = chunk_at(p - 1)
+    for h in range(n - 1):
+        if int8:
+            q, s = quantize_blockwise(
+                t, block, None if key is None else _sr_key(key, h, p))
+            q = lax.ppermute(q, axis_name, fwd)
+            s = lax.ppermute(s, axis_name, fwd)
+            r = dequantize_blockwise(q, s, t.shape[0])
+        else:
+            r = lax.ppermute(t, axis_name, fwd)
+        t = r + chunk_at(p - h - 2)
+    return t
+
+
+def ring_all_gather(t, axis_name: str, *, axis_size: Optional[int] = None,
+                    int8: bool = False, block: int = 256, key=None):
+    """Ring all-gather of per-device chunks ``[c]`` into ``[n*c]``.
+
+    With ``int8=True`` each chunk is quantized ONCE at its owner and the
+    identical (payload, scales) pair circulates — every device dequantizes
+    the same bits, so the gathered array is bitwise identical on all
+    devices (required: replicated parameters must not drift).  Returns
+    ``(gathered, own_dequantized)``; ``own_dequantized`` is the device's
+    own chunk after its quantize/dequantize round trip (``== t`` when
+    ``int8=False``) so callers can form an error-feedback residual.
+    """
+    n = _axis_size(axis_name, axis_size)
+    t = t.astype(jnp.float32)
+    c = t.shape[0]
+    if n == 1:
+        if not int8:
+            return t, t
+        q, s = quantize_blockwise(t, block, None if key is None
+                                  else _sr_key(key, 0, jnp.int32(0)))
+        own = dequantize_blockwise(q, s, c)
+        return own, own
+    p = lax.axis_index(axis_name)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    if int8:
+        # n-1 is one past the reduce-scatter hop indices: the all-gather
+        # rounding never reuses a reduce-scatter key
+        q, s = quantize_blockwise(
+            t, block, None if key is None else _sr_key(key, n - 1, p))
+        own = dequantize_blockwise(q, s, c)
+        payload = (q, s)
+        out_q = jnp.zeros((n,) + q.shape, jnp.int8)
+        out_s = jnp.zeros((n,) + s.shape, jnp.float32)
+        out_q = lax.dynamic_update_index_in_dim(out_q, q, p, 0)
+        out_s = lax.dynamic_update_index_in_dim(out_s, s, p, 0)
+        cur = payload
+        for h in range(n - 1):
+            cur = (lax.ppermute(cur[0], axis_name, fwd),
+                   lax.ppermute(cur[1], axis_name, fwd))
+            j = jnp.mod(p - h - 1, n)
+            out_q = lax.dynamic_update_index_in_dim(out_q, cur[0], j, 0)
+            out_s = lax.dynamic_update_index_in_dim(out_s, cur[1], j, 0)
+        # dequantize row-wise: [n, blocks, block] * [n, blocks, 1]
+        blocks = out_s.shape[1]
+        deq = (out_q.astype(jnp.float32).reshape(n, blocks, -1)
+               * out_s[:, :, None]).reshape(n, -1)[:, :c]
+        return deq.reshape(-1), own
+
+    out = jnp.zeros((n, c), jnp.float32)
+    out = lax.dynamic_update_index_in_dim(out, t, p, 0)
+    cur = t
+    for h in range(n - 1):
+        cur = lax.ppermute(cur, axis_name, fwd)
+        out = lax.dynamic_update_index_in_dim(out, cur,
+                                              jnp.mod(p - h - 1, n), 0)
+    return out.reshape(-1), t
+
+
+def ring_all_reduce(x, axis_name: str, *, axis_size: Optional[int] = None,
+                    int8: bool = False, block: int = 256, key=None,
+                    error_feedback=None):
+    """Ring all-reduce = reduce-scatter + all-gather (both optionally
+    int8).  ``x``: per-device flat ``[n*c]``; returns ``(summed [n*c],
+    new_error_feedback)``.
+
+    ``error_feedback`` (per-device ``[c]``, the chunk this device owns) is
+    added to the fully-reduced chunk *before* the all-gather quantization;
+    the returned residual is exactly the quantization error introduced
+    there — carry it in optimizer state and pass it back next step.  With
+    ``int8=False`` the residual is identically zero.
+    """
+    t = ring_reduce_scatter(x, axis_name, axis_size=axis_size, int8=int8,
+                            block=block, key=key)
+    if error_feedback is not None:
+        t = t + error_feedback.astype(jnp.float32)
+    out, own = ring_all_gather(t, axis_name, axis_size=axis_size, int8=int8,
+                               block=block, key=key)
+    new_ef = t - own if error_feedback is not None else None
+    return out, new_ef
+
+
+# --------------------------------------------------------------- buckets --
+
+def bucket_plan(leaves: Sequence[Any], bucket_elems: int,
+                ring_size: int) -> List[Dict[str, Any]]:
+    """DDP-style fusion plan over a flat leaf list (e.g.
+    ``jax.tree_util.tree_leaves(grads)``).
+
+    Leaves are grouped **per dtype** in tree order and greedily packed into
+    buckets of at most ``bucket_elems`` elements (a leaf larger than the
+    budget gets its own bucket; leaves never split across buckets).  Each
+    bucket records ``items`` = [(leaf_index, size)], its ``dtype``, and a
+    ``padded`` length rounded up to a multiple of ``ring_size`` so the
+    ring chunks evenly.  Works on concrete arrays and tracers alike (only
+    ``.shape``/``.dtype`` are read), so the plan is identical at init time
+    and at trace time.
+    """
+    if bucket_elems <= 0:
+        raise ValueError(f"bucket_elems must be positive, got {bucket_elems}")
+    by_dtype: Dict[Any, List[Tuple[int, int]]] = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.dtype(leaf.dtype), []).append(
+            (i, int(math.prod(leaf.shape)) if leaf.shape else 1))
+    plan = []
+    for dt in by_dtype:
+        cur: List[Tuple[int, int]] = []
+        cur_sz = 0
+        for idx, size in by_dtype[dt]:
+            if cur and cur_sz + size > bucket_elems:
+                plan.append({"dtype": dt, "items": cur, "size": cur_sz})
+                cur, cur_sz = [], 0
+            cur.append((idx, size))
+            cur_sz += size
+        if cur:
+            plan.append({"dtype": dt, "items": cur, "size": cur_sz})
+    for b in plan:
+        b["padded"] = -(-b["size"] // ring_size) * ring_size
+    return plan
+
+
+def pack_bucket(leaves: Sequence[Any], bucket: Dict[str, Any]) -> jnp.ndarray:
+    """Concatenate a bucket's leaves into one flat fp32 buffer of length
+    ``bucket['padded']`` (zero pad at the tail)."""
+    parts = [jnp.ravel(leaves[i]).astype(jnp.float32)
+             for i, _ in bucket["items"]]
+    buf = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    pad = bucket["padded"] - bucket["size"]
+    if pad:
+        buf = jnp.pad(buf, (0, pad))
+    return buf
+
+
+def unpack_bucket(buf, bucket: Dict[str, Any], like: Sequence[Any],
+                  into: List[Any]) -> None:
+    """Split a (reduced) bucket buffer back into leaf shapes/dtypes taken
+    from ``like``, writing results into the ``into`` list."""
+    off = 0
+    for idx, size in bucket["items"]:
+        into[idx] = buf[off:off + size].reshape(like[idx].shape).astype(
+            like[idx].dtype)
+        off += size
+
+
+# ------------------------------------------------------------ accounting --
+
+def bytes_moved(num_elems: int, axis_size: int, mode: str,
+                block: int = 256, dtype_bytes: int = 4) -> int:
+    """Per-device bytes sent over the ring for one all-reduce of
+    ``num_elems`` values: 2*(n-1) hops of one chunk each.
+
+    ``mode``: ``"ring_int8"`` counts 1 byte/value + fp32 scales per
+    ``block``; anything else (``"ring"``, ``"auto"`` — XLA's own bf16/fp32
+    ring is bandwidth-equivalent) counts ``dtype_bytes``/value.  This is
+    the analytic figure the grad_comm bench reports alongside step time.
+    """
+    n = max(int(axis_size), 1)
+    if n == 1:
+        return 0
+    c = -(-num_elems // n)
+    if mode == "ring_int8":
+        hop = c + 4 * (-(-c // block))
+    else:
+        hop = dtype_bytes * c
+    return 2 * (n - 1) * hop

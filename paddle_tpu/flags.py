@@ -81,6 +81,15 @@ define_flag("eager_jit_cache_size", 4096,
             "Max cached per-op jitted executables in the eager dispatch "
             "seam (core/autograd _jit_cache/_vjp_cache; LRU; <=0 = "
             "unbounded).")
+define_flag("grad_comm_bucket_mb", 4,
+            "Fused gradient-bucket size in MB (fp32 elements) for the "
+            "ring grad collectives (ParallelConfig.grad_comm='ring'/"
+            "'ring_int8'; DDP-style per-dtype fusion, a leaf never spans "
+            "two buckets).")
+define_flag("grad_comm_block_size", 256,
+            "Values per fp32 scale block in the int8 ring grad collective "
+            "(distributed/quantized_collectives.py; the EQuARX blockwise-"
+            "quantization granularity).")
 define_flag("use_native_dataloader", False,
             "Route DataLoader prefetch through the C++ ring-buffer engine "
             "(native/ringbuf.cc). Off by default: with in-process thread "

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import math
+import warnings
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
@@ -88,6 +89,16 @@ class ParallelConfig:
     #                              the per-step relative increment can round
     #                              away in bf16 and v silently stops tracking
     #                              gradient variance.
+    grad_comm: str = "auto"      # dp gradient sync: "auto" keeps the XLA-
+    #                              emitted collective (the parity oracle);
+    #                              "ring" is an explicit bucketed fp32 ring
+    #                              all-reduce (shard_map + ppermute);
+    #                              "ring_int8" adds EQuARX-style blockwise
+    #                              int8 payloads with stochastic rounding —
+    #                              ~4x less gradient traffic over ICI/DCN.
+    grad_comm_error_feedback: bool = False  # ring_int8 only: carry the
+    #                              broadcast-quantization residual in
+    #                              optimizer state and add it back next step
 
     def __post_init__(self):
         if self.remat_policy not in ("full", "dots"):
@@ -98,6 +109,15 @@ class ParallelConfig:
             raise ValueError(
                 "remat_policy is set but remat=False — no checkpointing "
                 "would be applied; set remat=True")
+        if self.grad_comm not in ("auto", "ring", "ring_int8"):
+            raise ValueError(
+                f"unknown grad_comm {self.grad_comm!r} "
+                "(expected 'auto', 'ring' or 'ring_int8')")
+        if self.grad_comm_error_feedback and self.grad_comm != "ring_int8":
+            raise ValueError(
+                "grad_comm_error_feedback requires grad_comm='ring_int8' "
+                "(the fp32 paths introduce no quantization error to feed "
+                "back)")
 
     @property
     def n_devices(self):
@@ -194,6 +214,35 @@ class PretrainStep:
             raise ValueError(
                 f"pp*virtual ({groups}) must divide num_hidden_layers "
                 f"({config.num_hidden_layers})")
+        if self.pc.grad_comm != "auto":
+            # the explicit ring grad sync runs the fwd/bwd inside a fully
+            # manual shard_map over the mesh (no partial-auto axes — the
+            # pinned-jax PartitionId bug never enters); that formulation
+            # covers the dp-sync of the flagship data-parallel loop, not
+            # the GSPMD-internal collectives of the other axes
+            if self.pc.pp > 1 or self.pc.mp > 1 or self.pc.sep > 1 \
+                    or self.pc.ep > 1:
+                raise NotImplementedError(
+                    "grad_comm='ring'/'ring_int8' takes over the dp "
+                    "gradient all-reduce only; pp/mp/sep/ep collectives "
+                    "stay XLA-emitted — use grad_comm='auto' for hybrid "
+                    "meshes")
+            if self._moe:
+                raise NotImplementedError(
+                    "grad_comm ring modes are wired for the dense decoder "
+                    "path (the MoE step already owns its shard_map)")
+            if self.pc.micro_batches > 1:
+                raise NotImplementedError(
+                    "grad_comm ring modes run the plain layer scan; set "
+                    "micro_batches=1 (pp=1 makes microbatching a no-op)")
+            if self.pc.zero3:
+                raise NotImplementedError(
+                    "grad_comm ring modes + zero3 (params over dp) need "
+                    "the quantized parameter all-gather — not wired yet")
+        from .. import flags as _flags
+        self._grad_comm_block = int(_flags.flag("grad_comm_block_size"))
+        self._grad_comm_bucket_elems = max(
+            1, int(_flags.flag("grad_comm_bucket_mb")) * (1 << 20) // 4)
         # one template layer provides the block math for every (stage, layer)
         self._template = LlamaDecoderLayer(config)
         if self._moe and config.moe_dispatch == "grouped" and \
@@ -202,6 +251,7 @@ class PretrainStep:
             # (replicated-router + ragged local GEMM + one psum)
             self._template.mlp._grouped_mesh = self.mesh
         self._jit_step = None
+        self._zero1_warned: set = set()
 
     # ---- parameter init & sharding ----
     def _shardings(self, sample_params) -> Dict[str, Any]:
@@ -267,7 +317,7 @@ class PretrainStep:
                        for k, v in params["blocks"].items()},
         }
 
-        def moment_like(p, dtype):
+        def moment_like(path, p, dtype):
             m = jnp.zeros(p.shape, jnp.dtype(dtype))
             sh_ = p.sharding
             if self.pc.zero1 and self.pc.dp > 1 and \
@@ -283,20 +333,41 @@ class PretrainStep:
                         spec[d] = "dp"
                         sh_ = NamedSharding(self.mesh, P(*spec))
                         break
+                else:
+                    # no dim divides dp: the moment silently replicates —
+                    # say so ONCE per parameter, or the memory budget the
+                    # user sized for zero1 quietly doesn't materialize
+                    name = jax.tree_util.keystr(path)
+                    if name not in self._zero1_warned:
+                        self._zero1_warned.add(name)
+                        warnings.warn(
+                            f"zero1: parameter {name} (shape "
+                            f"{list(p.shape)}) has no unsharded dim "
+                            f"divisible by dp={self.pc.dp}; its optimizer "
+                            "moments stay replicated", stacklevel=2)
             return jax.device_put(m, sh_)
 
         state = {
             "params": params,
-            "m": jax.tree_util.tree_map(
-                lambda p: moment_like(p, self.pc.m_dtype), params),
-            "v": jax.tree_util.tree_map(
-                lambda p: moment_like(p, self.pc.v_dtype), params),
+            "m": jax.tree_util.tree_map_with_path(
+                lambda path, p: moment_like(path, p, self.pc.m_dtype), params),
+            "v": jax.tree_util.tree_map_with_path(
+                lambda path, p: moment_like(path, p, self.pc.v_dtype), params),
             # committed to the mesh (replicated) so the whole state tree
             # shares one device set — train_step pins state shardings on
             # both sides of the jit to keep the step single-compile
             "step": jax.device_put(jnp.zeros((), jnp.int32),
                                    NamedSharding(self.mesh, P())),
         }
+        if self.pc.grad_comm_error_feedback:
+            # per-bucket residual of the all-gather-phase quantization,
+            # naturally dp-sharded: chunk p of each bucket lives (and is
+            # produced) on dp rank p
+            state["ef"] = {
+                f"b{i}": jax.device_put(
+                    jnp.zeros((b["padded"],), jnp.float32),
+                    NamedSharding(self.mesh, P("dp")))
+                for i, b in enumerate(self._bucket_plan(params))}
         return state
 
     # ---- forward/loss as a pure function ----
@@ -538,6 +609,139 @@ class PretrainStep:
         }
         return loss_sum / n_tok, grads
 
+    # ---- explicit (quantized) ring gradient sync ----------------------
+    # grad_comm="ring"/"ring_int8": the step computes LOCAL sum-gradients
+    # per dp shard inside a fully-manual shard_map and syncs them with the
+    # bucketed ring collectives (distributed/quantized_collectives.py) —
+    # the dp all-reduce XLA would emit is replaced by our own schedule,
+    # optionally with EQuARX-style blockwise-int8 payloads.
+    def _bucket_plan(self, params):
+        from ..distributed import quantized_collectives as qc
+        return qc.bucket_plan(jax.tree_util.tree_leaves(params),
+                              self._grad_comm_bucket_elems,
+                              max(self.pc.dp, 1))
+
+    def grad_sync_bytes(self) -> int:
+        """Analytic per-device bytes sent over the dp axis for ONE step's
+        gradient sync under the configured ``grad_comm`` ("auto" is
+        modeled as the bandwidth-equivalent fp32/bf16 ring XLA emits).
+        The grad_comm bench reports this alongside step time."""
+        from ..distributed import quantized_collectives as qc
+        c = self.config
+        dt = jnp.dtype(c.dtype) if isinstance(c.dtype, str) else c.dtype
+        sample = {
+            "embed": jax.ShapeDtypeStruct((c.vocab_size, c.hidden_size), dt),
+            "head": jax.ShapeDtypeStruct((c.hidden_size, c.vocab_size), dt),
+            "norm": jax.ShapeDtypeStruct((c.hidden_size,), dt),
+            "blocks": {k: jax.ShapeDtypeStruct(v, dt) for k, v in
+                       self._block_shapes().items()},
+        }
+        dt_bytes = dt.itemsize
+        mode = self.pc.grad_comm
+        total = 0
+        for b in self._bucket_plan(sample):
+            total += qc.bytes_moved(
+                b["padded"], self.pc.dp,
+                mode if mode != "auto" else "ring",
+                block=self._grad_comm_block,
+                dtype_bytes=4 if mode != "auto" else dt_bytes)
+        return total
+
+    def _block_shapes(self):
+        """Stacked [G, L/G, ...] block-param shapes without materializing."""
+        c = self.config
+        G = self.pc.pp * self._virtual
+        sample = extract_params(self._template)
+        return {k: (G, c.num_hidden_layers // G) + tuple(v.shape)
+                for k, v in sample.items()}
+
+    def _loss_and_grads_ring(self, params, ids, labels, step, ef):
+        from ..distributed import quantized_collectives as qc
+        from ..kernels.rms_norm import rms_norm_fp32
+        c, pc = self.config, self.pc
+        mesh = self.mesh
+        B, T = ids.shape
+        n = pc.dp
+        if B % max(n, 1):
+            raise ValueError(f"dp ({n}) must divide the batch size ({B})")
+        int8 = pc.grad_comm == "ring_int8"
+        block = self._grad_comm_block
+        cos, sin = _rope_cos_sin(T, c.head_dim, c.rope_theta, jnp.float32)
+        template = self._template
+
+        def local_loss_sum(p, ids_l, labels_l):
+            """SUM-convention CE over this dp shard's batch — plain dense
+            layer scan, NO sharding constraints (we are inside a manual
+            shard_map; the math matches _forward_loss exactly)."""
+            h = jnp.take(p["embed"], ids_l, axis=0)
+
+            def blockf(lp, x):
+                return functional_call(template, lp, Tensor(x), cos, sin)
+
+            if pc.remat:
+                blockf = _remat(blockf, pc.remat_policy)
+            blocks = {k: v.reshape((c.num_hidden_layers,) + v.shape[2:])
+                      for k, v in p["blocks"].items()}
+
+            def body(carry, lp):
+                return blockf(lp, carry), None
+
+            h, _ = jax.lax.scan(body, h, blocks)
+            h = rms_norm_fp32(h, p["norm"], c.rms_norm_eps)
+            H = h.shape[-1]
+            hf = h.reshape(-1, H)
+            lf = labels_l.reshape(-1)
+            C = pc.loss_chunks if hf.shape[0] % pc.loss_chunks == 0 else 1
+            hc = hf.reshape(C, -1, H)
+            lc = lf.reshape(C, -1)
+
+            @jax.checkpoint
+            def chunk_loss(args):
+                hunk, gold_ids = args
+                logits = (hunk @ p["head"]).astype(jnp.float32)
+                lse = jax.scipy.special.logsumexp(logits, axis=-1)
+                gold = jnp.take_along_axis(logits, gold_ids[..., None],
+                                           axis=-1)[..., 0]
+                return (lse - gold).sum()
+
+            return jax.lax.map(chunk_loss, (hc, lc)).sum()
+
+        plan = self._bucket_plan(params)
+
+        def per_shard(p, ids_l, labels_l, step_, ef_bufs):
+            loss_sum, grads = jax.value_and_grad(local_loss_sum)(
+                p, ids_l, labels_l)
+            flat, treedef = jax.tree_util.tree_flatten(grads)
+            synced = list(flat)
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(qc.GRAD_COMM_SEED), step_) if int8 \
+                else None
+            new_ef = {}
+            ntok = jnp.float32(B * T)
+            for bi, bucket in enumerate(plan):
+                buf = qc.pack_bucket(flat, bucket)
+                e = ef_bufs.get(f"b{bi}")
+                red, e_new = qc.ring_all_reduce(
+                    buf, "dp", axis_size=n, int8=int8, block=block,
+                    key=None if key is None else jax.random.fold_in(key, bi),
+                    error_feedback=e)
+                if e is not None:
+                    new_ef[f"b{bi}"] = e_new
+                # sum -> mean convention in fp32, THEN cast to grad dtype
+                qc.unpack_bucket(red / ntok, bucket, flat, synced)
+            loss = jax.lax.psum(loss_sum, "dp") / ntok
+            return loss, jax.tree_util.tree_unflatten(treedef, synced), new_ef
+
+        # check_vma=False: the gathered grads are built from ppermute'd
+        # payloads — varying by construction, bitwise replicated by design
+        # (every rank dequantizes identical bits), which the replication
+        # checker cannot see
+        return jax.shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P(), P("dp"), P("dp"), P(), P("dp")),
+            out_specs=(P(), P(), P("dp")), check_vma=False,
+        )(params, ids, labels, step, ef)
+
     # ---- adamw ----
     def _update(self, state, grads):
         b1, b2, eps, lr, wd = self.b1, self.b2, self.eps, self.lr, self.wd
@@ -573,7 +777,17 @@ class PretrainStep:
             ids, labels = self.shard_batch(np.asarray(ids),
                                            np.asarray(labels))
         if self._jit_step is None:
-            if self.pc.schedule in ("1f1b", "zbh1", "zbvpp"):
+            if self.pc.grad_comm != "auto":
+                def step(state, ids, labels):
+                    loss, grads, new_ef = self._loss_and_grads_ring(
+                        state["params"], ids, labels, state["step"],
+                        state.get("ef", {}))
+                    new_state = self._update(
+                        {k: v for k, v in state.items() if k != "ef"}, grads)
+                    if "ef" in state:
+                        new_state["ef"] = new_ef
+                    return new_state, loss
+            elif self.pc.schedule in ("1f1b", "zbh1", "zbvpp"):
                 def step(state, ids, labels):
                     loss, grads = self._loss_and_grads_1f1b(
                         state["params"], ids, labels)

@@ -1,0 +1,192 @@
+"""ParallelConfig.grad_comm — the explicit (quantized) ring gradient sync
+in the hybrid-parallel train step (ISSUE 3): psum parity across dp widths
+(zero1 included), per-step bit determinism, the 30-step convergence smoke
+with and without error feedback, the warm-step zero-recompile contract,
+and the zero1 moment-sharding warning."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.models.pretrain import ParallelConfig, PretrainStep
+
+
+def _data(rng, cfg, batch=8, seq=16):
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    return ids, labels
+
+
+def _run(cfg, pcfg, ids, labels, steps=2, seed=7):
+    ps = PretrainStep(cfg, pcfg)
+    state = ps.init_state(seed=seed)
+    si, sl = ps.shard_batch(ids, labels)
+    losses = []
+    for _ in range(steps):
+        state, loss = ps.train_step(state, si, sl)
+        losses.append(float(loss))
+    return losses, state, ps
+
+
+@pytest.mark.parametrize("dp", [2, 4, 8])
+def test_ring_fp32_matches_auto(rng, dp):
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    ids, labels = _data(rng, cfg)
+    ref, _, _ = _run(cfg, ParallelConfig(dp=dp), ids, labels)
+    out, _, _ = _run(cfg, ParallelConfig(dp=dp, grad_comm="ring"),
+                     ids, labels)
+    assert ref[1] < ref[0]
+    np.testing.assert_allclose(ref, out, rtol=1e-4)
+
+
+@pytest.mark.parametrize("dp", [2, 4, 8])
+def test_ring_int8_tracks_auto_within_quant_error(rng, dp):
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    ids, labels = _data(rng, cfg)
+    ref, _, _ = _run(cfg, ParallelConfig(dp=dp), ids, labels, steps=3)
+    out, _, _ = _run(cfg, ParallelConfig(dp=dp, grad_comm="ring_int8"),
+                     ids, labels, steps=3)
+    assert out[-1] < out[0]              # still training
+    np.testing.assert_allclose(ref, out, rtol=5e-3)
+
+
+def test_ring_int8_zero1_parity_and_sharding(rng):
+    """zero1 + ring runs the fwd/bwd inside a fully-manual shard_map, so
+    (unlike the GSPMD zero1 paths, xfail-gated on the pinned jax) it works
+    on this pin: moments shard over dp AND the loss matches the dense
+    baseline."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    ids, labels = _data(rng, cfg)
+    ref, _, _ = _run(cfg, ParallelConfig(dp=2), ids, labels)
+    out, state, _ = _run(
+        cfg, ParallelConfig(dp=2, zero1=True, grad_comm="ring_int8"),
+        ids, labels)
+    np.testing.assert_allclose(ref, out, rtol=5e-3)
+    specs = [str(v.sharding.spec)
+             for v in jax.tree_util.tree_leaves(
+                 jax.tree_util.tree_map(lambda x: x, state["m"]))]
+    assert any("dp" in s for s in specs)
+
+
+def test_ring_int8_bit_deterministic_per_step(rng):
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    ids, labels = _data(rng, cfg)
+    _, s1, ps1 = _run(cfg, ParallelConfig(dp=4, grad_comm="ring_int8"),
+                      ids, labels, steps=1)
+    _, s2, _ = _run(cfg, ParallelConfig(dp=4, grad_comm="ring_int8"),
+                    ids, labels, steps=1)
+    for k in ("embed", "head", "norm"):
+        np.testing.assert_array_equal(np.asarray(s1["params"][k]),
+                                      np.asarray(s2["params"][k]))
+    for k, v in s1["params"]["blocks"].items():
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.asarray(s2["params"]["blocks"][k]))
+
+
+def test_convergence_smoke_ring_int8_tracks_baseline(rng):
+    """~30-step tiny-llama loss curve: ring_int8 (with and without error
+    feedback) tracks the fp32 auto baseline within tolerance."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    ids, labels = _data(rng, cfg)
+    steps = 30
+    ref, _, _ = _run(cfg, ParallelConfig(dp=2), ids, labels, steps=steps)
+    q, _, _ = _run(cfg, ParallelConfig(dp=2, grad_comm="ring_int8"),
+                   ids, labels, steps=steps)
+    qef, _, _ = _run(
+        cfg, ParallelConfig(dp=2, grad_comm="ring_int8",
+                            grad_comm_error_feedback=True),
+        ids, labels, steps=steps)
+    assert ref[-1] < ref[0] and q[-1] < q[0] and qef[-1] < qef[0]
+    for curve in (q, qef):
+        err = np.abs(np.asarray(curve) - np.asarray(ref))
+        rel = err / np.abs(np.asarray(ref))
+        assert rel.max() < 2e-2, (curve, ref)
+    # the overfit batch drives loss far down; both arms keep pace
+    assert q[-1] < ref[0] * 0.7 and qef[-1] < ref[0] * 0.7
+
+
+def test_error_feedback_state_roundtrips(rng):
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    ids, labels = _data(rng, cfg)
+    ps = PretrainStep(cfg, ParallelConfig(dp=4, grad_comm="ring_int8",
+                                          grad_comm_error_feedback=True))
+    state = ps.init_state(seed=0)
+    assert "ef" in state and state["ef"]
+    for buf in state["ef"].values():
+        assert buf.dtype == jnp.float32
+        assert "dp" in str(buf.sharding.spec)
+    si, sl = ps.shard_batch(ids, labels)
+    state, _ = ps.train_step(state, si, sl)
+    state, _ = ps.train_step(state, si, sl)
+    # after a step the residual is live (quantization error is nonzero)
+    assert any(float(jnp.abs(b).max()) > 0 for b in state["ef"].values())
+
+
+def test_warm_ring_steps_compile_nothing(rng):
+    """Backend-compile telemetry (the PR-2 contract, extended to the new
+    train-step variants): warm ring/ring_int8 steps compile ZERO fresh
+    XLA programs."""
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    ids, labels = _data(rng, cfg)
+    for mode in ("ring", "ring_int8"):
+        ps = PretrainStep(cfg, ParallelConfig(dp=4, grad_comm=mode))
+        state = ps.init_state(seed=0)
+        si, sl = ps.shard_batch(ids, labels)
+        state, _ = ps.train_step(state, si, sl)      # compile once
+        with paddle.jit.assert_no_recompiles():
+            for _ in range(3):
+                state, loss = ps.train_step(state, si, sl)
+        assert np.isfinite(float(loss))
+
+
+def test_grad_comm_validation():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    with pytest.raises(ValueError, match="grad_comm"):
+        ParallelConfig(grad_comm="nope")
+    with pytest.raises(ValueError, match="error_feedback"):
+        ParallelConfig(grad_comm="ring", grad_comm_error_feedback=True)
+    with pytest.raises(NotImplementedError, match="dp"):
+        PretrainStep(cfg, ParallelConfig(dp=2, mp=2, grad_comm="ring"))
+    with pytest.raises(NotImplementedError, match="zero3"):
+        PretrainStep(cfg, ParallelConfig(dp=2, zero3=True,
+                                         grad_comm="ring_int8"))
+
+
+def test_grad_sync_bytes_ratio():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    fp32 = PretrainStep(cfg, ParallelConfig(dp=4, grad_comm="ring"))
+    i8 = PretrainStep(cfg, ParallelConfig(dp=4, grad_comm="ring_int8"))
+    b_fp32, b_i8 = fp32.grad_sync_bytes(), i8.grad_sync_bytes()
+    assert b_fp32 > b_i8 > 0
+    assert 3.5 < b_fp32 / b_i8 <= 4.0
+
+
+def test_zero1_no_divisible_dim_warns_once(rng):
+    """zero1 moment sharding silently replicates when no dim divides dp —
+    now it says so, once, naming the parameter (ISSUE 3 satellite)."""
+    cfg = LlamaConfig.tiny(hidden_size=70, intermediate_size=140,
+                           num_attention_heads=2, num_key_value_heads=2,
+                           num_hidden_layers=2)
+    ps = PretrainStep(cfg, ParallelConfig(dp=4, zero1=True))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        state = ps.init_state(seed=0)
+        msgs = [str(x.message) for x in w if "zero1" in str(x.message)]
+    # hidden=70 % dp=4 != 0: norms (and the attention mats) cannot shard
+    assert msgs, "expected a zero1 replication warning"
+    assert any("norm" in m or "70" in m for m in msgs)
+    # one warning per parameter, not one per moment tensor (m AND v)
+    assert len(msgs) == len(set(msgs))
+    # the warned moments really are replicated
+    assert "dp" not in str(state["m"]["norm"].sharding.spec)
+    # ...and a second init_state does not re-warn
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        ps.init_state(seed=0)
+        again = [str(x.message) for x in w2 if "zero1" in str(x.message)]
+    assert not again
