@@ -705,11 +705,104 @@ def _run_grad_comm(on_tpu):
     return out
 
 
+def _run_serve_prefix(on_tpu):
+    """ISSUE 4: prefix-cache A/B — the continuous-batching engine over a
+    50% shared-prefix traffic mix (system-prompt-style requests), cache
+    ON vs cache OFF.  Same requests, same weights, fresh engine per arm;
+    tokens/s = generated tokens over wall time, plus the hit-rate /
+    tokens-saved / pages-saved telemetry from the engine's drain-time
+    stats (the cache-off arm must report all-zero prefix counters)."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                      GenerationConfig)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        n_req, slots, max_seq, page, bucket = 48, 16, 1024, 32, 128
+        shared_len, tail_range, budget_range = 512, (16, 65), (16, 49)
+    else:
+        cfg = LlamaConfig.tiny()
+        n_req, slots, max_seq, page, bucket = 24, 4, 384, 16, 64
+        shared_len, tail_range, budget_range = 240, (8, 25), (8, 17)
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    shared = list(rng.integers(1, cfg.vocab_size, shared_len))
+    prompts, budgets = [], []
+    for i in range(n_req):
+        tail = int(rng.integers(*tail_range))
+        if i % 2 == 0:                      # the 50% shared-prefix mix
+            prompts.append(shared +
+                           list(rng.integers(1, cfg.vocab_size, tail)))
+        else:                               # unique, same length profile
+            prompts.append(
+                list(rng.integers(1, cfg.vocab_size, shared_len + tail)))
+        budgets.append(int(rng.integers(*budget_range)))
+    total_prompt_tokens = sum(len(p) for p in prompts)
+
+    def arm(cache_on):
+        eng = ContinuousBatchingEngine(
+            model, max_batch=slots,
+            gen=GenerationConfig(max_new_tokens=int(budget_range[1])),
+            max_seq_len=max_seq, page_size=page, prefill_bucket=bucket,
+            prefix_cache=cache_on)
+        # warmup compiles the step pair (+ the COW copy program) on junk
+        # traffic that shares nothing with the measured requests
+        eng.add_request(list(rng.integers(1, cfg.vocab_size, bucket + 3)),
+                        max_new_tokens=4)
+        eng.run()
+        rids = [eng.add_request(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        t0 = time.perf_counter()
+        res = eng.run()
+        dt = time.perf_counter() - t0
+        toks = sum(len(res[r]) for r in rids)
+        stats = eng.stats()
+        del eng
+        return toks / dt, stats
+
+    off_tps, off_stats = arm(False)
+    on_tps, on_stats = arm(True)
+    saved = on_stats["prefix_tokens_saved"]
+    return {
+        "serve_prefix_requests": n_req,
+        "serve_prefix_shared_frac": 0.5,
+        "serve_prefix_shared_len": shared_len,
+        "serve_prefix_off_tok_per_sec": round(off_tps, 1),
+        "serve_prefix_on_tok_per_sec": round(on_tps, 1),
+        "serve_prefix_speedup": round(on_tps / max(off_tps, 1e-9), 3),
+        "serve_prefix_hit_rate": round(
+            on_stats["prefix_hits"] / n_req, 3),
+        "serve_prefix_tokens_saved": saved,
+        "serve_prefix_prefill_savings_frac": round(
+            saved / total_prompt_tokens, 3),
+        "serve_prefix_pages_saved": saved // page,
+        "serve_prefix_cow_copies": on_stats["cow_copies"],
+        "serve_prefix_evicted_pages": on_stats["evicted_pages"],
+        "serve_prefix_peak_pages_on": on_stats["peak_in_use"],
+        "serve_prefix_peak_pages_off": off_stats["peak_in_use"],
+        "serve_prefix_off_stats_zero": bool(
+            off_stats["prefix_hits"] == 0
+            and off_stats["prefix_tokens_saved"] == 0
+            and off_stats["cow_copies"] == 0
+            and off_stats["evicted_pages"] == 0),
+    }
+
+
 # extras measured after the flagship ladder, each in its own subprocess
 _EXTRAS = (("large", _run_large), ("decode", _run_decode),
            ("moe", _run_moe), ("gpt2", _run_gpt2_compiled_vs_eager),
            ("dit", _run_dit), ("flash", _run_flash_autotune),
-           ("grad_comm", _run_grad_comm))
+           ("grad_comm", _run_grad_comm),
+           ("serve_prefix", _run_serve_prefix))
 
 
 def _force_host_devices(n=8):

@@ -243,9 +243,24 @@ def run_grad_comm():
     return {"config": "grad_comm_ab", **bench._run_grad_comm(_on_tpu())}
 
 
+def run_serve_prefix():
+    """ISSUE 4: one-command prefix-cache A/B (`python benchmarks/run.py
+    serve_prefix --cpu`) — continuous-batching engine on a 50%
+    shared-prefix traffic mix, cache on vs off.  Besides the usual
+    results/serve_prefix.json, stamps results/prefix_cache.json as the
+    canonical A/B record (tok/s both arms, hit rate, pages saved)."""
+    import bench
+    out = {"config": "serve_prefix", **bench._run_serve_prefix(_on_tpu())}
+    RESULTS.mkdir(exist_ok=True)
+    (RESULTS / "prefix_cache.json").write_text(
+        json.dumps(out, indent=2) + "\n")
+    return out
+
+
 CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
            "dit": run_dit, "moe": run_moe, "decode": run_decode,
-           "longctx": run_longctx, "grad_comm": run_grad_comm}
+           "longctx": run_longctx, "grad_comm": run_grad_comm,
+           "serve_prefix": run_serve_prefix}
 
 
 def _supervise(names, timeout):

@@ -90,6 +90,16 @@ define_flag("grad_comm_block_size", 256,
             "Values per fp32 scale block in the int8 ring grad collective "
             "(distributed/quantized_collectives.py; the EQuARX blockwise-"
             "quantization granularity).")
+define_flag("prefix_cache", False,
+            "Serving engine: share KV pages across requests with a common "
+            "page-aligned token prefix (radix index + ref-counted pages + "
+            "copy-on-write + LRU eviction; inference/prefix_cache.py). "
+            "Off is bit-identical to the uncached engine; on, greedy "
+            "outputs still bit-match the cache-off oracle.")
+define_flag("prefix_cache_min_pages", 1,
+            "Minimum cached-prefix length IN PAGES for an admission to "
+            "take a prefix-cache hit; shorter matches prefill from "
+            "scratch (guards against sharing overhead on tiny matches).")
 define_flag("use_native_dataloader", False,
             "Route DataLoader prefetch through the C++ ring-buffer engine "
             "(native/ringbuf.cc). Off by default: with in-process thread "

@@ -22,12 +22,13 @@ import numpy as np
 from .generation import (ContinuousBatchingEngine, GenerationConfig,
                          LlamaGenerator, Request, generate)
 from .kv_cache import PagedKVCache, PageAllocator
+from .prefix_cache import PrefixCache, serving_stats
 
 __all__ = [
     "Config", "Predictor", "create_predictor", "PredictorTensor",
     "GenerationConfig", "LlamaGenerator", "generate",
     "ContinuousBatchingEngine", "Request",
-    "PagedKVCache", "PageAllocator",
+    "PagedKVCache", "PageAllocator", "PrefixCache", "serving_stats",
 ]
 
 

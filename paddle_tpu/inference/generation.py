@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import flags
 from ..kernels.paged_attention import (paged_attention,
                                        ragged_paged_attention,
                                        write_kv_pages,
@@ -52,6 +53,20 @@ from ..kernels.rms_norm import rms_norm_fp32
 from ..models.llama import LlamaConfig, LlamaForCausalLM, _rope_cos_sin
 from ..utils import extract_params, stack_params
 from .kv_cache import PagedKVCache
+
+
+def _cow_copy_pages(kc, vc, src, dst):
+    """Whole-page KV copies src[i] -> dst[i] across every layer/head (the
+    prefix cache's copy-on-write privatization).  Entries with src < 0
+    are no-ops: their dst is routed out of bounds, which scatter drops.
+    Jitted once per engine over the fixed [max_batch] pair bucket and
+    donated like the step, so warm hit admissions never recompile."""
+    valid = src >= 0
+    s = jnp.maximum(src, 0)
+    d = jnp.where(valid, dst, kc.shape[2])
+    kc = kc.at[:, :, d].set(jnp.take(kc, s, axis=2), mode="drop")
+    vc = vc.at[:, :, d].set(jnp.take(vc, s, axis=2), mode="drop")
+    return kc, vc
 
 
 @dataclass
@@ -476,10 +491,23 @@ class ContinuousBatchingEngine:
     sampled tokens, retires finished requests (freeing their pages back to
     the pool) and admits waiting ones every ``sync_every`` steps, so steady
     state runs one async dispatch per step with no per-step host sync.
+
+    With ``prefix_cache=True`` (or ``FLAGS_prefix_cache``) admission
+    consults the radix prefix cache (``inference/prefix_cache.py``): a
+    prompt's longest cached page-aligned prefix is attached to its block
+    table by reference (zero prefill compute and zero KV writes for those
+    tokens — chunked prefill starts at the first uncached token), a
+    fully-cached prompt privatizes its final page copy-on-write, retired
+    sequences park their prompt pages in an LRU pool evicted only under
+    memory pressure, and rows that matched pages a concurrent producer is
+    still writing idle until the producer's prefill passes them.  Cache
+    off is bit-identical to the uncached engine; greedy outputs with the
+    cache on bit-match the cache-off oracle.
     """
 
     def __init__(self, model: LlamaForCausalLM, *, max_batch: int = 8,
-                 gen: Optional[GenerationConfig] = None, **kw):
+                 gen: Optional[GenerationConfig] = None,
+                 prefix_cache: Optional[bool] = None, **kw):
         self.gen_cfg = gen or GenerationConfig()
         self.g = LlamaGenerator(model, max_batch=max_batch, **kw)
         B = max_batch
@@ -507,6 +535,27 @@ class ContinuousBatchingEngine:
         # freezes early (KV pool ran dry mid-decode): the device keeps
         # emitting frozen repeats until the next drain, which trims here
         self._gen_cap: List[Optional[int]] = [None] * B
+        # ---- prefix cache (ISSUE 4): radix-shared KV pages ----
+        if prefix_cache is None:
+            prefix_cache = flags.flag("prefix_cache")
+        self.prefix_cache = None
+        # per-slot admission leftovers: nodes a row must wait on before its
+        # first prefill chunk (the producer row is still writing them) and
+        # the COW page copies to dispatch once the row is cleared to start
+        self._gate: List[tuple] = [()] * B
+        self._cow_pairs: List[List[tuple]] = [[] for _ in range(B)]
+        self.last_stats: dict = self.stats()
+        if prefix_cache:
+            from .prefix_cache import PrefixCache
+            self.prefix_cache = PrefixCache(
+                self.g.cache.allocator, self.g.page_size,
+                min_pages=flags.flag("prefix_cache_min_pages"))
+            self._cow_jit = jax.jit(_cow_copy_pages, donate_argnums=(0, 1))
+            # warm the copy program with an all-no-op call so the first
+            # cache hit (and every later one) stays zero-recompile
+            none = jnp.full((B,), -1, jnp.int32)
+            self.g.cache.update(*self._cow_jit(*self.g.cache.arrays,
+                                               none, none))
 
     # ---- public api ----
     def add_request(self, prompt: Sequence[int],
@@ -538,8 +587,10 @@ class ContinuousBatchingEngine:
             return self._drain() if self._pending else []
         g = self.g
         B = self.B
+        if self.prefix_cache is not None:
+            self._open_gates()
         prompt_rows = [b for b in range(B)
-                       if self.slot_req[b] is not None
+                       if self.slot_req[b] is not None and not self._gate[b]
                        and self.prompt_pos[b] < len(self.slot_req[b].prompt)]
         T = g.prefill_bucket if prompt_rows else 1
 
@@ -554,7 +605,7 @@ class ContinuousBatchingEngine:
                 continue
             while alloc.context_len(req.req_id) <= int(self.host_lens[b]) \
                     and alloc.context_len(req.req_id) < g.max_seq_len:
-                if alloc.free_pages == 0:
+                if alloc.available_pages == 0:
                     # pool ran dry mid-decode (undersized num_pages):
                     # finalize THIS sequence early instead of raising —
                     # freeze it on device (no further writes) and cap its
@@ -580,7 +631,9 @@ class ContinuousBatchingEngine:
         chunk = np.zeros((B, T), np.int32)
         for b in range(B):
             req = self.slot_req[b]
-            if req is None:
+            if req is None or self._gate[b]:
+                # gated: this row's matched prefix pages are still being
+                # written by their producer row — idle until they're ready
                 continue
             rem = len(req.prompt) - int(self.prompt_pos[b])
             if rem > 0:                      # prefill chunk
@@ -615,10 +668,51 @@ class ContinuousBatchingEngine:
             self.counts, self.budgets, self._bt_dev, self.key)
         g.cache.update(kc, vc)
         self._pending.append((self.tokens, commit))
+        if self.prefix_cache is not None:
+            # this step's prefill writes are now dispatched: pages wholly
+            # below each row's prompt cursor are safe for later steps of
+            # other rows to read (device execution is dispatch-ordered)
+            for b in range(B):
+                req = self.slot_req[b]
+                if req is not None and ql[b] > 0 and not decode[b]:
+                    self.prefix_cache.note_progress(
+                        req.req_id, int(self.prompt_pos[b]))
         self._steps_since_drain += 1
         if self._steps_since_drain >= self.g.sync_every:
             return self._drain()
         return []
+
+    # ---- prefix-cache gates: rows waiting on producer prefill ----
+    def _open_gates(self):
+        """Clear gates whose matched pages became ready, and dispatch the
+        newly-cleared rows' pending COW page copies BEFORE this step's
+        pallas call reads them.  Producers advance every step, so every
+        gate opens in bounded time."""
+        starting = []
+        for b in range(self.B):
+            if self._gate[b] and all(x.ready for x in self._gate[b]):
+                self._gate[b] = ()
+            if not self._gate[b] and self._cow_pairs[b]:
+                starting.extend(self._cow_pairs[b])
+                self._cow_pairs[b] = []
+        if starting:
+            src = np.full((self.B,), -1, np.int32)
+            dst = np.full((self.B,), -1, np.int32)
+            for i, (s, d) in enumerate(starting):
+                src[i], dst[i] = s, d
+            self.g.cache.update(*self._cow_jit(
+                *self.g.cache.arrays, jnp.asarray(src), jnp.asarray(dst)))
+
+    # ---- serving telemetry ----
+    def stats(self) -> dict:
+        """Pool + prefix-cache telemetry (refreshed at every drain into
+        ``last_stats``).  With the cache off, every prefix counter is 0."""
+        s = self.g.cache.allocator.stats()
+        s["prefix_cache_enabled"] = self.prefix_cache is not None
+        if self.prefix_cache is not None:
+            s["prefix_cached_pages"] = self.prefix_cache.cached_pages()
+            s["prefix_evictable_pages"] = self.prefix_cache.evictable_pages()
+        return s
 
     # ---- drain: the ONLY host<->device sync of the steady state ----
     def _drain(self) -> List[Request]:
@@ -658,12 +752,18 @@ class ContinuousBatchingEngine:
             elif len(req.output) < cap and not fin[b]:
                 continue                     # still running
             req.done = True
+            if self.prefix_cache is not None:
+                # retiring drops the sequence's node refs: its cached
+                # prefix pages fall to the LRU free-pool (evicted only
+                # when admission actually needs the memory)
+                self.prefix_cache.release(req.req_id)
             alloc.free(req.req_id)
             self.slot_req[b] = None
             self._gen_cap[b] = None
             self.finished = self.finished.at[b].set(True)
             self.completed[req.req_id] = req.output
             done.append(req)
+        self.last_stats = self.stats()
         return done
 
     # ---- admission (host-known free slots only; frees appear at drains) ----
@@ -673,38 +773,81 @@ class ContinuousBatchingEngine:
             return
         g = self.g
         alloc = g.cache.allocator
+        cache = self.prefix_cache
         admitted = []
+        starts = np.zeros((self.B,), np.int32)
         while free and self.waiting:
             req = self.waiting[0]
             # truncate ONCE here; every later length (pages, host_lens,
             # positions) derives from the truncated prompt
             req.prompt = req.prompt[: g.max_seq_len - 1]
-            need = -(-len(req.prompt) // g.page_size)
-            if alloc.free_pages < need:
+            dense_need = -(-len(req.prompt) // g.page_size)
+            # prefix match: the longest cached page-aligned prefix trims
+            # both the fresh-page demand and the prefill chunk schedule
+            plan = cache.plan(req.prompt) if cache is not None else None
+            need = plan.fresh_pages if plan is not None else dense_need
+            # matched-but-idle pages are about to be pinned, not evicted:
+            # they cannot double-count as reclaimable supply
+            avail = alloc.available_pages - (
+                plan.idle_matched if plan is not None else 0)
+            if plan is not None and plan.nodes and avail < need \
+                    and len(free) == self.B and not admitted:
+                # nothing is running: prefer admitting from scratch (and
+                # letting reclaim evict the cache) over waiting forever
+                plan = None
+                need, avail = dense_need, alloc.available_pages
+            if avail < need:
                 if len(free) == self.B and not admitted \
-                        and need > alloc.num_pages:
+                        and dense_need > alloc.num_pages:
                     raise MemoryError(
-                        f"prompt needs {need} pages but the pool only has "
-                        f"{alloc.num_pages}; raise num_pages or page_size")
+                        f"prompt needs {dense_need} pages but the pool only "
+                        f"has {alloc.num_pages}; raise num_pages or "
+                        "page_size")
                 break                         # wait for pages to free up
             self.waiting.popleft()
-            admitted.append((free.pop(0), req))
+            b = free.pop(0)
+            if plan is not None:
+                cache.attach(plan)            # pin before any reclaim runs
+                shared = [x.page for x in plan.nodes]
+            else:
+                shared = ()
+            try:
+                alloc.allocate(req.req_id, len(req.prompt),
+                               shared_pages=shared)
+            except MemoryError:
+                # evictable estimate raced a concurrent structure change —
+                # roll back and retry this request at the next admission
+                if plan is not None:
+                    cache.detach(plan)
+                self.waiting.appendleft(req)
+                free.insert(0, b)
+                break
+            if plan is not None:
+                self._cow_pairs[b] = cache.admit(req.req_id, req.prompt,
+                                                 plan)
+                self._gate[b] = tuple(plan.wait)
+                starts[b] = plan.start
+            else:
+                self._gate[b] = ()
+                self._cow_pairs[b] = []
+            admitted.append((b, req))
         if not admitted:
             return
         mask = np.zeros((self.B,), bool)
         budgets = self._budgets_np
         for b, req in admitted:
-            alloc.allocate(req.req_id, len(req.prompt))
             self.slot_req[b] = req
-            self.prompt_pos[b] = 0
-            self.host_lens[b] = 0
+            self.prompt_pos[b] = int(starts[b])
+            self.host_lens[b] = int(starts[b])
             mask[b] = True
             budgets[b] = req.max_new_tokens
             self._bt[b] = alloc.block_table(
                 [req.req_id], max_pages=g.pages_per_seq)[0]
         m = jnp.asarray(mask)
         zero = jnp.zeros((), jnp.int32)
-        self.positions = jnp.where(m, zero, self.positions)
+        # rows with a prefix hit start mid-prompt: their write cursor and
+        # RoPE positions begin at the first uncached token
+        self.positions = jnp.where(m, jnp.asarray(starts), self.positions)
         self.counts = jnp.where(m, zero, self.counts)
         self.budgets = jnp.asarray(budgets.astype(np.int32))
         self.finished = jnp.where(m, jnp.zeros((), bool), self.finished)

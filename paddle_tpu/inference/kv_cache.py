@@ -17,19 +17,36 @@ TPU-first split of responsibilities:
 - **Host**: a free-list page allocator (pure Python — page bookkeeping is
   control flow, not math) producing the int32 block tables / context-lens /
   slot-mapping operands the Pallas kernel consumes via scalar prefetch.
+
+Pages are REF-COUNTED (ISSUE 4): the same physical page may appear in
+several sequences' block tables (a shared prompt prefix — the prefix
+cache in ``inference/prefix_cache.py`` — or the cache's own retained
+reference after the producing sequence retired).  A page returns to the
+free list only when its last reference drops, which makes a page-level
+double free structurally impossible: the refcount transition guards the
+free-list append, and releasing a page that is already free raises.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
+def _serving_bump(key: str, n: int = 1) -> None:
+    """Mirror a prefix-cache counter into the process-wide serving
+    telemetry (jit.cache_stats()["serving"]).  The allocator is the ONE
+    place every counter increments, so the per-engine and process-wide
+    books cannot diverge."""
+    from .prefix_cache import _SERVING_STATS
+    _SERVING_STATS[key] += n
+
+
 class PageAllocator:
-    """Free-list allocator mapping sequence ids to page lists.
+    """Free-list allocator mapping sequence ids to ref-counted page lists.
 
     The pool may be sized BELOW the dense ``max_batch * pages_per_seq``
     worst case: freed pages recycle through the free list, admission
@@ -38,54 +55,144 @@ class PageAllocator:
     (``_grow`` itself raises MemoryError only on the raw allocator API),
     and ``stats()`` reports the high-water mark so operators can size the
     pool to observed traffic instead of the worst case.
+
+    With a prefix cache attached (``set_reclaimer``) the allocator asks
+    the cache to evict idle cached pages back into the free list before
+    declaring the pool exhausted, so cached history is reclaimed exactly
+    when admission or decode growth needs the memory and never sooner.
     """
 
     def __init__(self, num_pages: int, page_size: int):
         self.num_pages = num_pages
         self.page_size = page_size
         self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._ref: List[int] = [0] * num_pages     # per-page reference count
         self._pages: Dict[int, List[int]] = {}     # seq id -> page ids
         self._lens: Dict[int, int] = {}            # seq id -> token count
         self.peak_in_use = 0
+        # prefix-cache reclaim hooks (inference/prefix_cache.py): evict
+        # idle cached pages on demand / count how many could be evicted
+        self._reclaim: Optional[Callable[[int], int]] = None
+        self._evictable: Optional[Callable[[], int]] = None
+        # prefix-cache telemetry (all stay 0 with the cache off)
+        self.prefix_hits = 0          # admissions that reused cached pages
+        self.prefix_tokens_saved = 0  # prompt tokens whose prefill was skipped
+        self.cow_copies = 0           # shared pages privatized copy-on-write
+        self.evicted_pages = 0        # cached pages reclaimed under pressure
+
+    # ---- reclaim seam (the prefix cache's LRU free-pool) ----
+    def set_reclaimer(self, reclaim: Callable[[int], int],
+                      evictable: Callable[[], int]) -> None:
+        """Attach an eviction source: ``reclaim(n)`` moves up to ``n`` idle
+        cached pages back to the free list (returns how many it moved);
+        ``evictable()`` counts pages reclaim could free right now."""
+        self._reclaim = reclaim
+        self._evictable = evictable
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
 
     @property
+    def available_pages(self) -> int:
+        """Pages obtainable right now: free list + evictable cached pages."""
+        extra = self._evictable() if self._evictable is not None else 0
+        return len(self._free) + extra
+
+    @property
     def pages_in_use(self) -> int:
         return self.num_pages - len(self._free)
 
     def stats(self) -> Dict[str, int]:
-        """Pool telemetry: live/peak page usage and active sequences."""
+        """Pool telemetry: live/peak page usage, active sequences, and the
+        prefix-cache counters (all zero when the cache is off)."""
         return {"num_pages": self.num_pages,
                 "pages_in_use": self.pages_in_use,
                 "peak_in_use": self.peak_in_use,
-                "active_seqs": len(self._pages)}
+                "active_seqs": len(self._pages),
+                "prefix_hits": self.prefix_hits,
+                "prefix_tokens_saved": self.prefix_tokens_saved,
+                "cow_copies": self.cow_copies,
+                "evicted_pages": self.evicted_pages}
 
     def context_len(self, seq_id: int) -> int:
         return self._lens[seq_id]
+
+    def page_list(self, seq_id: int) -> List[int]:
+        """The sequence's page ids, in token order (a copy)."""
+        return list(self._pages[seq_id])
+
+    def ref_count(self, page: int) -> int:
+        return self._ref[page]
+
+    # ---- page-level refcounting ----
+    def retain(self, page: int) -> None:
+        """Add a reference to a live page (prefix sharing / cache pin)."""
+        if self._ref[page] <= 0:
+            raise ValueError(f"page {page} is free; cannot retain it")
+        self._ref[page] += 1
+
+    def release_page(self, page: int) -> None:
+        """Drop one reference; the last drop returns the page to the free
+        list.  Releasing an already-free page raises (the structural
+        double-free guard)."""
+        if self._ref[page] <= 0:
+            raise ValueError(f"page {page} is already free (double free)")
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            self._free.append(page)
+
+    def _alloc_page(self) -> int:
+        if not self._free and self._reclaim is not None:
+            self._reclaim(1)
+        if not self._free:
+            raise MemoryError(
+                f"KV cache exhausted: {self.num_pages} pages in use")
+        p = self._free.pop()
+        if self._ref[p] != 0:
+            raise RuntimeError(f"free-list page {p} has live references")
+        self._ref[p] = 1
+        return p
 
     def _grow(self, seq_id: int, new_len: int) -> None:
         pages = self._pages[seq_id]
         need = -(-new_len // self.page_size)       # ceil
         while len(pages) < need:
-            if not self._free:
-                raise MemoryError(
-                    f"KV cache exhausted: {self.num_pages} pages in use")
-            pages.append(self._free.pop())
+            pages.append(self._alloc_page())
         self.peak_in_use = max(self.peak_in_use, self.pages_in_use)
         self._lens[seq_id] = new_len
 
-    def allocate(self, seq_id: int, num_tokens: int) -> np.ndarray:
+    def allocate(self, seq_id: int, num_tokens: int,
+                 shared_pages: Sequence[int] = ()) -> np.ndarray:
         """Register a new sequence with ``num_tokens`` prompt tokens.
-        Returns the flat slot ids [num_tokens] its KV rows must be
-        written to."""
+
+        ``shared_pages`` (prefix-cache hit) are attached FIRST, in token
+        order, with a refcount bump each — their KV is reused, not
+        rewritten; fresh pages are then allocated for the remaining
+        tokens.  Returns the flat slot ids [num_tokens] the sequence's
+        KV rows map to (callers with a prefix hit only write the
+        uncached tail).  On pool exhaustion the registration is rolled
+        back completely before MemoryError propagates."""
         if seq_id in self._pages:
             raise ValueError(f"sequence {seq_id} already allocated")
-        self._pages[seq_id] = []
+        pages: List[int] = []
+        self._pages[seq_id] = pages
         self._lens[seq_id] = 0
-        self._grow(seq_id, num_tokens)
+        try:
+            for p in shared_pages:
+                self.retain(p)
+                pages.append(p)
+            self._grow(seq_id, num_tokens)
+        except BaseException:
+            # full rollback on ANY failure (pool exhaustion, a bad
+            # shared_pages entry, ...): `pages` holds exactly the
+            # references taken so far, so releasing them restores every
+            # refcount and the seq id stays allocatable
+            for p in pages:
+                self.release_page(p)
+            del self._pages[seq_id]
+            del self._lens[seq_id]
+            raise
         return self.slots(seq_id, 0, num_tokens)
 
     def extend(self, seq_id: int, num_tokens: int = 1) -> np.ndarray:
@@ -94,6 +201,37 @@ class PageAllocator:
         self._grow(seq_id, start + num_tokens)
         return self.slots(seq_id, start, num_tokens)
 
+    def cow(self, seq_id: int,
+            page_index: int) -> Optional[Tuple[int, int]]:
+        """Copy-on-write: make entry ``page_index`` of the sequence's page
+        list private before it is written.  A shared page (refcount > 1)
+        is swapped for a fresh one and ``(src, dst)`` is returned — the
+        caller owns the device-side page copy; an exclusive page returns
+        None (already writable)."""
+        pages = self._pages[seq_id]
+        src = pages[page_index]
+        if self._ref[src] <= 1:
+            return None
+        dst = self._alloc_page()
+        pages[page_index] = dst
+        self.release_page(src)       # cannot hit zero: it was > 1
+        self.peak_in_use = max(self.peak_in_use, self.pages_in_use)
+        self.cow_copies += 1
+        _serving_bump("cow_copies")
+        return src, dst
+
+    def record_prefix_hit(self, tokens_saved: int) -> None:
+        """Count one prefix-cache hit admission (both telemetry books)."""
+        self.prefix_hits += 1
+        self.prefix_tokens_saved += tokens_saved
+        _serving_bump("prefix_hits")
+        _serving_bump("prefix_tokens_saved", tokens_saved)
+
+    def record_evictions(self, n: int = 1) -> None:
+        """Count cached pages reclaimed under pressure (both books)."""
+        self.evicted_pages += n
+        _serving_bump("evicted_pages", n)
+
     def slots(self, seq_id: int, start: int, count: int) -> np.ndarray:
         pages = self._pages[seq_id]
         pos = np.arange(start, start + count)
@@ -101,9 +239,26 @@ class PageAllocator:
         return (page_ids * self.page_size + pos % self.page_size).astype(np.int32)
 
     def free(self, seq_id: int) -> None:
+        """Release the sequence's reference on every page it holds.
+
+        NOT idempotent: freeing an unknown or already-freed ``seq_id``
+        raises ``KeyError("seq id ... not allocated")`` on every path —
+        callers own exactly one free per allocate.  Pages shared with the
+        prefix cache or other sequences survive (their refcount stays
+        positive); only last references land back in the free list, so a
+        page-level double free cannot occur even if two owners retire in
+        either order."""
+        if seq_id not in self._pages:
+            raise KeyError(
+                f"seq id {seq_id} not allocated (double free or never "
+                "allocated)")
         for p in self._pages.pop(seq_id):
-            self._free.append(p)
+            self.release_page(p)
         del self._lens[seq_id]
+
+    def release(self, seq_id: int) -> None:
+        """Alias of :meth:`free` (same contract, same KeyError)."""
+        self.free(seq_id)
 
     def block_table(self, seq_ids: Sequence[int],
                     max_pages: Optional[int] = None) -> np.ndarray:

@@ -1,0 +1,271 @@
+"""Prefix cache: ref-counted shared KV pages with radix lookup, COW, LRU.
+
+Production serving traffic is dominated by shared prefixes — system
+prompts, few-shot templates, multi-turn histories.  The paged pool (PR 2)
+already gives every sequence page indirection, so cross-sequence KV
+sharing is pure bookkeeping: a new prompt that starts with an
+already-cached token run simply points the shared pages from its block
+table (refcount bump in ``PageAllocator``) and starts chunked prefill at
+the first uncached token — zero prefill FLOPs and zero KV writes for the
+matched prefix, with the ragged paged-attention kernel unchanged.
+
+Design (vLLM/SGLang-style radix cache, page-granular, TPU-first):
+
+- **Index**: a trie whose edges are whole token *pages* (``page_size``
+  tokens) — matching is therefore always page-aligned, which is exactly
+  the granularity the block table can share.  A node owns one physical
+  page and the cache's own allocator reference on it.
+- **Pending vs ready**: admission registers a prompt's full pages in the
+  index *before* their KV is written (so identical prompts admitted in
+  the same batch still share); a consumer row that matched pending pages
+  is gated by the engine until the producer's chunked prefill has
+  dispatched past them.  Device execution is dispatch-ordered, so
+  "producer's chunk dispatched" is the full ordering guarantee needed —
+  no host sync.
+- **Copy-on-write**: a fully-cached prompt still needs its last token
+  re-prefilled (only KV is cached, not logits), which writes inside the
+  final shared page — that page is privatized via ``PageAllocator.cow``
+  and one device-side page copy (dispatched by the engine).
+- **LRU free-pool**: when the last sequence using a node retires, the
+  node stays indexed but becomes *idle* — an LRU-ordered pool the
+  allocator reclaims from (leaf-first, oldest-first) only when admission
+  or decode growth actually needs pages.  Because a sequence always
+  holds a root-chain prefix of nodes, an idle node's whole subtree is
+  idle, so ``len(idle)`` is exactly the evictable page count.
+
+Cache-off behavior is bit-identical to the uncached engine: nothing in
+this module runs unless ``FLAGS_prefix_cache`` (or the engine's
+``prefix_cache=`` argument) turns it on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# process-wide serving telemetry, surfaced through paddle_tpu.jit's
+# monitoring seam (jit.cache_stats()["serving"]) next to the XLA
+# backend-compile counters.  Per-engine numbers live in
+# PageAllocator.stats(); every increment happens INSIDE the allocator
+# (kv_cache._serving_bump mirrors both books in one place), so the two
+# can never diverge.
+_SERVING_STATS = {"prefix_hits": 0, "prefix_tokens_saved": 0,
+                  "cow_copies": 0, "evicted_pages": 0}
+
+
+def serving_stats() -> Dict[str, int]:
+    """Process-wide prefix-cache counters (all engines summed)."""
+    return dict(_SERVING_STATS)
+
+
+class _Node:
+    """One cached page: an edge of the radix index.
+
+    ``active`` counts live sequences holding this node (matched at
+    admission, or the producer that inserted it); ``ready`` flips once
+    the producer's prefill has dispatched the page's KV writes.  A node
+    with ``active == 0`` and ``ready`` sits in the LRU idle pool.
+    """
+
+    __slots__ = ("tokens", "page", "end", "parent", "children", "active",
+                 "ready")
+
+    def __init__(self, tokens: Tuple[int, ...], page: int, end: int,
+                 parent: Optional["_Node"]):
+        self.tokens = tokens       # this page's token block (the trie key)
+        self.page = page           # physical page id in the pool
+        self.end = end             # prompt offset one past this page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.active = 0
+        self.ready = False
+
+
+class MatchPlan:
+    """A prompt's admission plan against the index (read-only until
+    ``attach``): the matched node chain, where prefill starts, whether
+    the final shared page needs COW, and the fresh-page demand."""
+
+    __slots__ = ("nodes", "start", "cow", "fresh_pages", "wait",
+                 "idle_matched")
+
+    def __init__(self, nodes, start, cow, fresh_pages, wait, idle_matched):
+        self.nodes: List[_Node] = nodes
+        self.start: int = start            # first token prefill must compute
+        self.cow: bool = cow               # privatize the last matched page
+        self.fresh_pages: int = fresh_pages  # free-list demand at admission
+        self.wait: List[_Node] = wait      # still-pending matched nodes
+        self.idle_matched: int = idle_matched  # matched nodes now idle
+
+
+class PrefixCache:
+    """Radix index over cached KV pages + the LRU eviction pool.
+
+    Owns one allocator reference per indexed page (so retired sequences'
+    pages survive in the pool) and registers itself as the allocator's
+    reclaimer (so those pages are evicted — leaf-first, LRU order — the
+    moment admission or decode growth actually needs them).
+    """
+
+    def __init__(self, allocator, page_size: int, min_pages: int = 1):
+        self.alloc = allocator
+        self.page = int(page_size)
+        self.min_pages = max(1, int(min_pages))
+        self._root = _Node((), -1, 0, None)
+        self._root.ready = True
+        self._seq_nodes: Dict[int, List[_Node]] = {}
+        self._seq_pending: Dict[int, List[_Node]] = {}
+        # idle pool: insertion order IS the LRU order (oldest first)
+        self._idle: Dict[_Node, None] = {}
+        allocator.set_reclaimer(self._reclaim, self.evictable_pages)
+
+    # ------------------------------------------------------------- lookup
+    def plan(self, tokens: Sequence[int]) -> MatchPlan:
+        """Longest page-aligned cached prefix of ``tokens`` → MatchPlan.
+
+        A full-prompt match keeps all pages but re-prefills the final
+        token (only KV is cached; the first sampled token needs logits),
+        so the last page goes copy-on-write.  Matches shorter than
+        ``min_pages`` pages are treated as misses.
+        """
+        page = self.page
+        n = len(tokens)
+        node, nodes = self._root, []
+        i = 0
+        while i + page <= n:
+            child = node.children.get(tuple(tokens[i:i + page]))
+            if child is None:
+                break
+            nodes.append(child)
+            node = child
+            i += page
+        cow = False
+        start = i
+        if nodes and i >= n:          # fully cached: recompute the last token
+            cow = True
+            start = n - 1
+        if len(nodes) < self.min_pages:
+            nodes, start, cow = [], 0, False
+        fresh = -(-n // page) - len(nodes) + (1 if cow else 0)
+        wait = [x for x in nodes if not x.ready]
+        idle_matched = sum(1 for x in nodes if x.active == 0)
+        return MatchPlan(nodes, start, cow, fresh, wait, idle_matched)
+
+    # ------------------------------------------------- admission lifecycle
+    def attach(self, plan: MatchPlan) -> None:
+        """Pin the matched chain BEFORE allocating fresh pages, so the
+        allocator's reclaim pass cannot evict pages this admission is
+        about to share."""
+        for x in plan.nodes:
+            if x.active == 0:
+                self._idle.pop(x, None)
+            x.active += 1
+
+    def detach(self, plan: MatchPlan) -> None:
+        """Undo :meth:`attach` (allocation-failure rollback path)."""
+        for x in plan.nodes:
+            x.active -= 1
+            if x.active == 0 and x.ready:
+                self._idle[x] = None
+
+    def admit(self, seq_id: int, tokens: Sequence[int],
+              plan: MatchPlan) -> List[Tuple[int, int]]:
+        """Finish admission for an ``attach``-ed plan after the allocator
+        registered the sequence (shared pages first, fresh after):
+        privatize the COW page, record the hit telemetry, and index the
+        prompt's remaining full pages as pending nodes.  Returns the
+        device page-copy pairs [(src, dst)] the engine must dispatch
+        before the sequence's first prefill chunk."""
+        alloc, page = self.alloc, self.page
+        cow_pairs: List[Tuple[int, int]] = []
+        if plan.cow:
+            pair = alloc.cow(seq_id, len(plan.nodes) - 1)
+            if pair is not None:
+                cow_pairs.append(pair)
+        if plan.nodes:
+            alloc.record_prefix_hit(plan.start)
+        # commit: index the uncovered full pages (pending until this
+        # sequence's prefill dispatches their writes), chained off the
+        # last matched node
+        held = list(plan.nodes)
+        pending: List[_Node] = []
+        pages = alloc.page_list(seq_id)
+        parent = plan.nodes[-1] if plan.nodes else self._root
+        for pi in range(len(plan.nodes), len(tokens) // page):
+            key = tuple(tokens[pi * page:(pi + 1) * page])
+            if key in parent.children:   # raced in by a concurrent admit
+                break
+            node = _Node(key, pages[pi], (pi + 1) * page, parent)
+            alloc.retain(pages[pi])      # the cache's own reference
+            parent.children[key] = node
+            node.active = 1              # the producer holds it
+            pending.append(node)
+            parent = node
+        self._seq_nodes[seq_id] = held + pending
+        self._seq_pending[seq_id] = list(pending)
+        return cow_pairs
+
+    def note_progress(self, seq_id: int, tokens_done: int) -> None:
+        """Producer's chunked prefill has dispatched writes for tokens
+        [0, tokens_done) — flip its pending nodes up to there to ready."""
+        pend = self._seq_pending.get(seq_id)
+        if not pend:
+            return
+        while pend and pend[0].end <= tokens_done:
+            pend.pop(0).ready = True
+
+    def release(self, seq_id: int) -> None:
+        """Drop a retiring sequence's node references.  Nodes left with no
+        active user enter the LRU idle pool (most-recent end); nodes whose
+        KV never became ready are unindexed immediately."""
+        for x in self._seq_nodes.pop(seq_id, ()):
+            x.active -= 1
+            if x.active == 0:
+                if x.ready:
+                    self._idle[x] = None
+                else:
+                    self._unlink(x)
+        self._seq_pending.pop(seq_id, None)
+
+    # ------------------------------------------------------------ eviction
+    def evictable_pages(self) -> int:
+        """Exact count of pages `_reclaim` could free right now.  A
+        sequence always references a root-chain prefix, so every idle
+        node's subtree is idle: the idle pool is fully reclaimable."""
+        return len(self._idle)
+
+    def cached_pages(self) -> int:
+        """Pages the index currently pins (idle + in active use)."""
+        n = 0
+        stack = [self._root]
+        while stack:
+            x = stack.pop()
+            stack.extend(x.children.values())
+            n += 1
+        return n - 1                     # minus the root sentinel
+
+    def _reclaim(self, n: int) -> int:
+        """Evict up to ``n`` idle pages, leaf-first in LRU order, back to
+        the allocator's free list.  Called by the allocator only when the
+        free list runs dry."""
+        freed = 0
+        progress = True
+        while freed < n and progress:
+            progress = False
+            for x in list(self._idle):   # insertion order = oldest first
+                if x.children:           # interior: wait for its leaves
+                    continue
+                self._evict(x)
+                freed += 1
+                progress = True
+                if freed >= n:
+                    break
+        return freed
+
+    def _evict(self, x: _Node) -> None:
+        del self._idle[x]
+        self._unlink(x)
+        self.alloc.record_evictions(1)
+
+    def _unlink(self, x: _Node) -> None:
+        del x.parent.children[x.tokens]
+        self.alloc.release_page(x.page)

@@ -66,11 +66,14 @@ jax.monitoring.register_event_duration_secs_listener(_count_backend_compiles)
 def cache_stats() -> dict:
     """Compilation-cache telemetry: ``to_static`` guard caches (compiles /
     LRU evictions / bucket paddings), the eager dispatch seam's capped
-    caches (reference surface: SOT guard-tree statistics), and the
-    process-wide XLA backend-compile count."""
+    caches (reference surface: SOT guard-tree statistics), the
+    process-wide XLA backend-compile count, and the serving prefix-cache
+    counters (hits / tokens saved / COW copies / evictions, summed over
+    every engine in the process — all zero with the cache off)."""
     from ..core.autograd import dispatch_cache_stats
+    from ..inference.prefix_cache import serving_stats
     return {"to_static": dict(_STATS), "dispatch": dispatch_cache_stats(),
-            "jit": dict(_JIT_STATS)}
+            "jit": dict(_JIT_STATS), "serving": serving_stats()}
 
 
 class assert_no_recompiles:

@@ -135,6 +135,29 @@ def test_page_allocator_exhaustion():
         a.allocate(1, 1)
 
 
+def test_page_allocator_double_free_keyerror_both_paths():
+    """ISSUE 4 satellite: free()/release() raise a CLEAR KeyError on
+    unknown AND double-freed seq ids on every path (free is explicitly
+    not idempotent), and the refcounts make a page-level double free
+    structurally impossible."""
+    a = PageAllocator(num_pages=4, page_size=4)
+    with pytest.raises(KeyError, match="seq id 3 not allocated"):
+        a.free(3)
+    with pytest.raises(KeyError, match="seq id 3 not allocated"):
+        a.release(3)
+    a.allocate(0, 4)
+    page = a.page_list(0)[0]
+    a.free(0)
+    with pytest.raises(KeyError, match="seq id 0 not allocated"):
+        a.free(0)
+    with pytest.raises(KeyError, match="seq id 0 not allocated"):
+        a.release(0)
+    # the page went back exactly once; another release is refused
+    assert a.free_pages == 4
+    with pytest.raises(ValueError, match="double free"):
+        a.release_page(page)
+
+
 # ---------------------------------------------------------------------------
 # end-to-end generation
 # ---------------------------------------------------------------------------
@@ -515,6 +538,34 @@ def test_engine_warm_steps_zero_recompiles():
                 ([5, 6, 7], [8, 9], [1, 4, 1, 4, 1, 4, 1, 4, 1])]
         out = eng.run()
     assert all(len(out[r]) == 6 for r in rids)
+
+
+def test_engine_prefix_hits_zero_recompiles():
+    """ISSUE 4 satellite: warm engine steps with PREFIX-CACHE HITS —
+    partial-page hits, full-match COW admissions, concurrent same-batch
+    sharing (gated rows) and LRU-parked re-hits — trigger ZERO XLA
+    compiles; the cache can never reintroduce per-shape programs."""
+    from paddle_tpu.inference.generation import ContinuousBatchingEngine
+    from paddle_tpu.jit import assert_no_recompiles
+
+    model = _tiny_model()
+    gc = GenerationConfig(max_new_tokens=6, do_sample=False)
+    eng = ContinuousBatchingEngine(model, max_batch=2, gen=gc,
+                                   max_seq_len=64, page_size=8,
+                                   prefill_bucket=8, prefix_cache=True)
+    S = list(range(1, 17))                 # 2 full pages
+    # warmup: one miss + hit + full-match (COW) lifecycle compiles the
+    # T=bucket/T=1 steps and the page-copy program
+    for p in ([1, 2, 3], S, S + [4, 5], S):
+        eng.add_request(p)
+    eng.run()
+    with assert_no_recompiles():
+        rids = [eng.add_request(p) for p in
+                (S + [9], S, S + [4, 5], S + [9], [7, 8, 9])]
+        out = eng.run()
+    assert all(len(out[r]) == 6 for r in rids)
+    st = eng.stats()
+    assert st["prefix_hits"] >= 4 and st["cow_copies"] >= 1
 
 
 def test_engine_capacity_frozen_output_trimmed():
