@@ -797,12 +797,116 @@ def _run_serve_prefix(on_tpu):
     }
 
 
+def _hist_record(h):
+    """Summary + populated buckets of a registry histogram, JSON-able."""
+    return {**h.summary(), "buckets": h.nonzero_buckets()}
+
+
+def _run_serve_metrics(on_tpu):
+    """ISSUE 5: serving observability A/B (`benchmarks/run.py serve`) —
+    the continuous-batching engine over a mixed traffic profile, metrics
+    ON vs metrics OFF.  The on arm must stay within the <2% tok/s
+    overhead contract AND keep warm steps at ZERO XLA compiles (asserted
+    via the registry's own compile counter); its TTFT/ITL/queue-wait/
+    batch-occupancy histograms are reported from the registry so the
+    stamped JSON is the per-PR latency record the Gemma-comparison
+    methodology asks for (step-time/TTFT/ITL, not just end-of-run
+    tok/s).  Best-of-``samples`` per arm damps host timer noise."""
+    import jax  # noqa: F401  (backend init before timing)
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                      GenerationConfig)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        n_req, slots, max_seq, page, bucket = 48, 16, 1024, 32, 128
+        prompt_range, budget_range, samples = (64, 257), (32, 97), 2
+    else:
+        cfg = LlamaConfig.tiny()
+        n_req, slots, max_seq, page, bucket = 24, 4, 256, 16, 32
+        prompt_range, budget_range, samples = (12, 49), (16, 49), 3
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, cfg.vocab_size,
+                                 int(rng.integers(*prompt_range))))
+               for _ in range(n_req)]
+    budgets = [int(rng.integers(*budget_range)) for _ in range(n_req)]
+
+    def run_once(metrics_on, reset_serving=False):
+        eng = ContinuousBatchingEngine(
+            model, max_batch=slots,
+            gen=GenerationConfig(max_new_tokens=int(budget_range[1])),
+            max_seq_len=max_seq, page_size=page, prefill_bucket=bucket,
+            metrics=metrics_on)
+        eng.add_request(list(rng.integers(1, cfg.vocab_size, bucket + 3)),
+                        max_new_tokens=4)          # warmup compiles T pair
+        eng.run()
+        if reset_serving:
+            # the stamped histograms describe exactly the measured
+            # traffic of the final (reported) metrics-on sample
+            obs.reset("serving.")
+        rids = [eng.add_request(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        with obs.assert_overhead(record=True) as rec:
+            t0 = time.perf_counter()
+            res = eng.run()
+            dt = time.perf_counter() - t0
+        toks = sum(len(res[r]) for r in rids)
+        del eng
+        return toks / dt, toks, rec.compiles
+
+    # arms INTERLEAVED per sample (off, on, off, on, ...): host-load drift
+    # and process warm-up order hit both arms equally instead of biasing
+    # whichever arm runs last; best-of-samples damps the residual noise
+    off_tps = on_tps = 0.0
+    off_tokens = on_tokens = off_compiles = on_compiles = 0
+    for s in range(samples):
+        tps, off_tokens, off_compiles = run_once(False)
+        off_tps = max(off_tps, tps)
+        tps, on_tokens, on_compiles = run_once(
+            True, reset_serving=(s == samples - 1))
+        on_tps = max(on_tps, tps)
+
+    h = {name: obs.metrics.histogram("serving." + name)
+         for name in ("ttft_ms", "itl_ms", "queue_wait_ms",
+                      "batch_occupancy")}
+    out = {
+        "serve_requests": n_req,
+        "serve_tokens": on_tokens,
+        "serve_metrics_off_tok_per_sec": round(off_tps, 1),
+        "serve_metrics_on_tok_per_sec": round(on_tps, 1),
+        # the <2% contract: positive = metrics cost throughput
+        "serve_metrics_overhead_frac": round(1.0 - on_tps
+                                             / max(off_tps, 1e-9), 4),
+        "serve_warm_compiles_on": on_compiles,
+        "serve_warm_compiles_off": off_compiles,
+        "serve_ttft_ms": _hist_record(h["ttft_ms"]),
+        "serve_itl_ms": _hist_record(h["itl_ms"]),
+        "serve_queue_wait_ms": _hist_record(h["queue_wait_ms"]),
+        "serve_batch_occupancy": _hist_record(h["batch_occupancy"]),
+        "serve_tokens_match": bool(off_tokens == on_tokens),
+    }
+    if obs.tracer.enabled:
+        out["serve_trace_events_buffered"] = True
+    return out
+
+
 # extras measured after the flagship ladder, each in its own subprocess
 _EXTRAS = (("large", _run_large), ("decode", _run_decode),
            ("moe", _run_moe), ("gpt2", _run_gpt2_compiled_vs_eager),
            ("dit", _run_dit), ("flash", _run_flash_autotune),
            ("grad_comm", _run_grad_comm),
-           ("serve_prefix", _run_serve_prefix))
+           ("serve_prefix", _run_serve_prefix),
+           ("serve", _run_serve_metrics))
 
 
 def _force_host_devices(n=8):

@@ -3,14 +3,23 @@ in benchmarks/ of this repo with per-config JSON results").
 
 Usage:
     python benchmarks/run.py [config ...] [--cpu] [--fused-gather=0|1]
-configs: resnet gpt2 llama dit moe decode all   (default: all)
+                             [--trace=PATH]
+configs: resnet gpt2 llama dit moe decode serve all   (default: all)
 
 --fused-gather pins FLAGS_grouped_matmul_fused_gather for the run (A/B of
 the in-kernel MoE dispatch gather; the =0 arm writes <config>_nofuse.json).
 
-Each config writes benchmarks/results/<config>.json.  The driver-facing
-single-line bench stays `bench.py` at the repo root; this harness is the
-full BASELINE ladder, config 1 (ResNet-50 dygraph) included.
+--trace=PATH records the run's host spans (engine steps, per-request
+serving lifecycles, train steps, profiler RecordEvents) through the
+observability tracer and dumps a Chrome-trace/perfetto JSON to PATH
+(multi-config runs write PATH's stem + `_<config>` per config).
+
+Each config writes benchmarks/results/<config>.json, stamped with a full
+observability snapshot (`"metrics"`: the registry JSON) and
+`"jit_cache_stats"` (ISSUE 5) so every per-PR record carries its
+compile/serving/train telemetry.  The driver-facing single-line bench
+stays `bench.py` at the repo root; this harness is the full BASELINE
+ladder, config 1 (ResNet-50 dygraph) included.
 """
 
 import json
@@ -44,6 +53,13 @@ for _a in [a for a in sys.argv if a.startswith("--fused-gather")]:
     os.environ["FLAGS_grouped_matmul_fused_gather"] = \
         "1" if FUSED_GATHER else "0"
 RESULT_SUFFIX = "_nofuse" if FUSED_GATHER is False else ""
+
+# `--trace=PATH`: dump a Chrome-trace of the run (ISSUE 5).  Parsed here so
+# the supervised subprocesses inherit it via argv forwarding.
+TRACE_PATH = None
+for _a in [a for a in sys.argv if a.startswith("--trace")]:
+    sys.argv.remove(_a)
+    TRACE_PATH = _a.split("=", 1)[1] if "=" in _a else "trace.json"
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
@@ -257,10 +273,22 @@ def run_serve_prefix():
     return out
 
 
+def run_serve():
+    """ISSUE 5: serving observability A/B (`python benchmarks/run.py serve
+    --cpu`) — continuous-batching engine with metrics ON vs OFF: TTFT/ITL/
+    queue-wait/occupancy histograms from the registry, warm steps asserted
+    at zero compiles, and the on arm within the 2% tok/s overhead
+    contract.  Combine with --trace=PATH for a loadable Chrome-trace of
+    the run's request lifecycles."""
+    import bench
+    return {"config": "serve_observability",
+            **bench._run_serve_metrics(_on_tpu())}
+
+
 CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
            "dit": run_dit, "moe": run_moe, "decode": run_decode,
            "longctx": run_longctx, "grad_comm": run_grad_comm,
-           "serve_prefix": run_serve_prefix}
+           "serve_prefix": run_serve_prefix, "serve": run_serve}
 
 
 def _supervise(names, timeout):
@@ -285,6 +313,14 @@ def _supervise(names, timeout):
             # argv — without this the B arm would write <name>.json and
             # clobber the fused arm's record
             cmd.append(f"--fused-gather={1 if FUSED_GATHER else 0}")
+        if TRACE_PATH is not None:
+            # each child runs ONE config, so the per-config suffix must be
+            # applied HERE — forwarding the bare path would have every
+            # child overwrite the same file
+            tp = pathlib.Path(TRACE_PATH)
+            if len(names) > 1:
+                tp = tp.with_name(tp.stem + f"_{name}" + tp.suffix)
+            cmd.append(f"--trace={tp}")
         try:
             child = subprocess.Popen(cmd)
         except Exception as e:
@@ -380,6 +416,12 @@ def main(argv):
         return _supervise(names, timeout)
     failed = 0
     for name in names:
+        if TRACE_PATH is not None:
+            # (re)start per config, clearing the buffer: each exported
+            # trace holds exactly its own config's spans (engine steps,
+            # request lifecycles, train steps, RecordEvents)
+            from paddle_tpu import observability as _obs
+            _obs.tracer.start()
         t0 = time.perf_counter()
         try:
             result = CONFIGS[name]()
@@ -407,6 +449,21 @@ def main(argv):
                               bool(_flags.flag("grouped_matmul_fused_gather")))
         except Exception:
             pass
+        # observability stamp (ISSUE 5): every result carries the full
+        # registry snapshot + compile-cache telemetry of its process
+        try:
+            import paddle_tpu.jit as _pjit
+            from paddle_tpu import observability as _obs
+            result["metrics"] = _obs.snapshot()
+            result["jit_cache_stats"] = _pjit.cache_stats()
+            if TRACE_PATH is not None:
+                tp = pathlib.Path(TRACE_PATH)
+                if len(names) > 1:   # one file per config, never clobbered
+                    tp = tp.with_name(tp.stem + f"_{name}" + tp.suffix)
+                result["trace_path"] = _obs.export_chrome_trace(str(tp))
+        except Exception as e:
+            result.setdefault("metrics_error",
+                              f"{type(e).__name__}: {str(e)[:120]}")
         path = RESULTS / f"{name}{RESULT_SUFFIX}.json"
         path.write_text(json.dumps(result, indent=2) + "\n")
         print(f"{name}: {json.dumps(result)}")

@@ -152,7 +152,7 @@ _SUBMODULES = [
     "linalg", "fft", "signal", "incubate", "metric", "sparse", "profiler",
     "hapi", "hub", "device", "distributed", "distribution", "static", "audio",
     "text", "quantization", "utils", "inference", "regularizer",
-    "geometric", "sysconfig", "onnx", "ir",
+    "geometric", "sysconfig", "onnx", "ir", "observability",
 ]
 
 

@@ -32,7 +32,16 @@ class _Task:
 
 
 class CommTaskManager:
-    """Singleton watchdog thread over outstanding device/collective work."""
+    """Singleton watchdog thread over outstanding device/collective work.
+
+    Registry telemetry (ISSUE 5 satellite): ``watchdog.last_heartbeat_age_s``
+    (gauge — seconds since the most recent ``begin()`` heartbeat, refreshed
+    every poll tick), ``watchdog.outstanding_tasks`` (gauge) and
+    ``watchdog.timeouts`` (counter, incremented on every fired timeout).
+    ``poll_interval`` is an instance attribute so tests can tighten the
+    tick without touching the timeout flag semantics."""
+
+    poll_interval = 1.0
 
     def __init__(self):
         self._tasks: Dict[int, _Task] = {}
@@ -41,6 +50,11 @@ class CommTaskManager:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.timed_out: list = []
+        self._last_heartbeat: Optional[float] = None
+        from ..observability import metrics as _metrics
+        self._hb_gauge = _metrics.gauge("watchdog.last_heartbeat_age_s")
+        self._out_gauge = _metrics.gauge("watchdog.outstanding_tasks")
+        self._timeout_ctr = _metrics.counter("watchdog.timeouts")
 
     def start(self):
         if self._thread is None:
@@ -62,29 +76,38 @@ class CommTaskManager:
             stack = "".join(traceback.format_stack(limit=8)) \
                 if flags.flag("enable_async_trace") else ""
             self._tasks[tid] = _Task(name, time.time(), stack)
+            self._last_heartbeat = time.time()
+            self._hb_gauge.set(0.0)
+            self._out_gauge.set(len(self._tasks))
             return tid
 
     def end(self, tid: int):
         with self._lock:
             self._tasks.pop(tid, None)
+            self._out_gauge.set(len(self._tasks))
 
     def outstanding(self):
         with self._lock:
             return list(self._tasks.values())
 
     def _loop(self):
-        while not self._stop.wait(1.0):
+        while not self._stop.wait(self.poll_interval):
             timeout = flags.flag("comm_timeout_s")
             now = time.time()
             with self._lock:
                 hung = [t for t in self._tasks.values()
-                        if now - t.started > timeout]
+                        if now - t.started >= timeout]
+                if self._last_heartbeat is not None:
+                    self._hb_gauge.set(now - self._last_heartbeat)
+                self._out_gauge.set(len(self._tasks))
             for t in hung:
                 self.timed_out.append(t)
+                self._timeout_ctr.inc()
                 self._dump(t, now)
                 with self._lock:
                     self._tasks = {k: v for k, v in self._tasks.items()
                                    if v is not t}
+                    self._out_gauge.set(len(self._tasks))
 
     def _dump(self, task: _Task, now: float):
         import sys

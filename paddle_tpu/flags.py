@@ -100,6 +100,18 @@ define_flag("prefix_cache_min_pages", 1,
             "Minimum cached-prefix length IN PAGES for an admission to "
             "take a prefix-cache hit; shorter matches prefill from "
             "scratch (guards against sharing overhead on tiny matches).")
+define_flag("metrics", True,
+            "Process-wide metrics registry collection on the serving/train "
+            "hot paths (paddle_tpu/observability/): per-request TTFT/ITL "
+            "histograms, StepTimer train telemetry, pool gauges.  The "
+            "overhead contract (warm steps: zero recompiles, zero added "
+            "device syncs, <2% tok/s) is telemetry-asserted in tests and "
+            "A/B'd by `benchmarks/run.py serve`; 0 disables every hot-path "
+            "instrumentation site.")
+define_flag("trace_max_events", 200000,
+            "Cap on buffered Chrome-trace events in the observability "
+            "tracer (observability/tracing.py); overflow is counted in the "
+            "exported file's metadata instead of growing without bound.")
 define_flag("use_native_dataloader", False,
             "Route DataLoader prefetch through the C++ ring-buffer engine "
             "(native/ringbuf.cc). Off by default: with in-process thread "

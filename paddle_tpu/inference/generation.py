@@ -36,6 +36,7 @@ fixed block-table width keep the compile count at two per sampling config.
 from __future__ import annotations
 
 import math
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
@@ -45,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import flags
+from .. import observability as _obs
 from ..kernels.paged_attention import (paged_attention,
                                        ragged_paged_attention,
                                        write_kv_pages,
@@ -210,6 +212,7 @@ class LlamaGenerator:
                                  jnp.float32)
         self._cos, self._sin = cos, sin
         self._jit_cache = {}
+        self._metrics_on = _obs.metrics_enabled()
 
     # ---- params ----
     def _extract(self, model: LlamaForCausalLM):
@@ -431,6 +434,8 @@ class LlamaGenerator:
             steps_until_sync -= 1
             if gen.eos_token_id is not None and steps_until_sync <= 0:
                 steps_until_sync = self.sync_every
+                if self._metrics_on:
+                    _obs.count_sync()
                 if bool(all_done):           # single scalar device sync
                     break
 
@@ -438,6 +443,8 @@ class LlamaGenerator:
             alloc.free(s)
 
         # one bulk transfer, then trim to the first EOS per sequence
+        if self._metrics_on:
+            _obs.count_sync()
         mat = np.asarray(jnp.stack(collected, axis=1))     # [MB, steps]
         out: List[List[int]] = []
         for i in range(B):
@@ -462,9 +469,15 @@ def generate(model: LlamaForCausalLM, prompts, gen: Optional[GenerationConfig] =
 
 
 class Request:
-    """One in-flight generation request of the continuous-batching engine."""
+    """One in-flight generation request of the continuous-batching engine.
 
-    __slots__ = ("req_id", "prompt", "max_new_tokens", "output", "done")
+    The ``t_*`` fields are host ``perf_counter`` stamps of the request's
+    lifecycle (enqueue → admission → first token → last token), recorded
+    by the engine's observability instrumentation at dispatch/drain time —
+    never via a device sync."""
+
+    __slots__ = ("req_id", "prompt", "max_new_tokens", "output", "done",
+                 "t_enqueue", "t_admit", "t_first", "t_last", "n_emitted")
 
     def __init__(self, req_id, prompt, max_new_tokens):
         self.req_id = req_id
@@ -472,6 +485,52 @@ class Request:
         self.max_new_tokens = max_new_tokens
         self.output: List[int] = []
         self.done = False
+        self.t_enqueue = None
+        self.t_admit = None
+        self.t_first = None
+        self.t_last = None
+        self.n_emitted = 0
+
+
+class _ServingMetrics:
+    """Resolved registry handles for the serving hot path (one dict lookup
+    per series at engine construction, plain attribute access per step)."""
+
+    __slots__ = ("requests", "completed", "tokens", "prefill_tokens",
+                 "queue_wait", "ttft", "itl", "queue_depth", "queue_now",
+                 "occupancy", "steps", "drains", "pages_in_use",
+                 "peak_pages", "active_seqs", "cached_pages",
+                 "evictable_pages")
+
+    def __init__(self):
+        m = _obs.metrics
+        self.requests = m.counter("serving.requests_total")
+        self.completed = m.counter("serving.requests_completed")
+        self.tokens = m.counter("serving.tokens_generated")
+        self.prefill_tokens = m.counter("serving.prefill_tokens")
+        self.queue_wait = m.histogram("serving.queue_wait_ms")
+        self.ttft = m.histogram("serving.ttft_ms")
+        self.itl = m.histogram("serving.itl_ms")
+        self.queue_depth = m.histogram("serving.queue_depth")
+        self.queue_now = m.gauge("serving.queue_depth_now")
+        self.occupancy = m.histogram("serving.batch_occupancy")
+        self.steps = m.counter("serving.steps")
+        self.drains = m.counter("serving.drains")
+        self.pages_in_use = m.gauge("serving.pages_in_use")
+        self.peak_pages = m.gauge("serving.peak_pages_in_use")
+        self.active_seqs = m.gauge("serving.active_seqs")
+        self.cached_pages = m.gauge("serving.prefix_cached_pages")
+        self.evictable_pages = m.gauge("serving.prefix_evictable_pages")
+
+    def update_pool(self, stats: dict) -> None:
+        """Fold the allocator/prefix-cache gauges in from engine.stats()
+        (called at every drain — the existing host touch point)."""
+        self.pages_in_use.set(stats["pages_in_use"])
+        self.peak_pages.set(stats["peak_in_use"])
+        self.active_seqs.set(stats["active_seqs"])
+        if "prefix_cached_pages" in stats:
+            self.cached_pages.set(stats["prefix_cached_pages"])
+            self.evictable_pages.set(stats["prefix_evictable_pages"])
 
 
 class ContinuousBatchingEngine:
@@ -507,7 +566,8 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model: LlamaForCausalLM, *, max_batch: int = 8,
                  gen: Optional[GenerationConfig] = None,
-                 prefix_cache: Optional[bool] = None, **kw):
+                 prefix_cache: Optional[bool] = None,
+                 metrics: Optional[bool] = None, **kw):
         self.gen_cfg = gen or GenerationConfig()
         self.g = LlamaGenerator(model, max_batch=max_batch, **kw)
         B = max_batch
@@ -529,12 +589,20 @@ class ContinuousBatchingEngine:
         self._bt = np.zeros((B, self.g.pages_per_seq), np.int32)
         self._bt_dev = jnp.asarray(self._bt)
         self._ql1 = jnp.ones((B,), i32)
-        self._pending: List[tuple] = []      # (out_dev [B], commit np [B])
+        self._pending: List[tuple] = []  # (out_dev [B], commit np [B], t_disp)
         self._steps_since_drain = 0
         # per-slot hard cap on VALID generated tokens, set when a sequence
         # freezes early (KV pool ran dry mid-decode): the device keeps
         # emitting frozen repeats until the next drain, which trims here
         self._gen_cap: List[Optional[int]] = [None] * B
+        # ---- observability (ISSUE 5): per-request lifecycle telemetry —
+        # TTFT/ITL/queue/occupancy histograms + pool gauges, all host-
+        # timestamped at dispatch and folded in at the existing drain (no
+        # added device syncs; warm steps tested compile/sync-free)
+        if metrics is None:
+            metrics = _obs.metrics_enabled()
+        self._obs: Optional[_ServingMetrics] = \
+            _ServingMetrics() if metrics else None
         # ---- prefix cache (ISSUE 4): radix-shared KV pages ----
         if prefix_cache is None:
             prefix_cache = flags.flag("prefix_cache")
@@ -565,6 +633,10 @@ class ContinuousBatchingEngine:
         req = Request(rid, prompt,
                       max_new_tokens or self.gen_cfg.max_new_tokens)
         self.waiting.append(req)
+        if self._obs is not None:
+            req.t_enqueue = time.perf_counter()
+            self._obs.requests.inc()
+            self._obs.queue_now.set(len(self.waiting))
         return rid
 
     def has_work(self) -> bool:
@@ -582,6 +654,7 @@ class ContinuousBatchingEngine:
     def step(self) -> List[Request]:
         """Admit what fits, run ONE fused device step, drain every
         ``sync_every`` steps.  Returns requests retired by this call."""
+        t_host0 = time.perf_counter() if _obs.TRACER.enabled else None
         self._admit()
         if all(r is None for r in self.slot_req):
             return self._drain() if self._pending else []
@@ -612,7 +685,7 @@ class ContinuousBatchingEngine:
                     # valid output at what was generated before this step
                     if self._gen_cap[b] is None:
                         self._gen_cap[b] = len(req.output) + sum(
-                            int(c[b]) for _, c in self._pending)
+                            int(c[b]) for _, c, _ in self._pending)
                         self.finished = self.finished.at[b].set(True)
                     break
                 alloc.extend(req.req_id,
@@ -667,7 +740,25 @@ class ContinuousBatchingEngine:
             self.positions, self.finished, dm, jnp.asarray(commit),
             self.counts, self.budgets, self._bt_dev, self.key)
         g.cache.update(kc, vc)
-        self._pending.append((self.tokens, commit))
+        # host dispatch timestamp rides the pending window: the drain
+        # stamps TTFT/ITL per committed token from it — dispatch-side
+        # wall clock, no device sync
+        t_step = time.perf_counter()
+        self._pending.append((self.tokens, commit, t_step))
+        if self._obs is not None:
+            o = self._obs
+            o.steps.inc()
+            o.occupancy.observe(
+                sum(r is not None for r in self.slot_req) / B)
+            o.queue_depth.observe(len(self.waiting))
+            o.queue_now.set(len(self.waiting))
+            n_prefill = int(ql.sum()) - int(decode.sum())
+            if n_prefill:
+                o.prefill_tokens.inc(n_prefill)
+        if t_host0 is not None:
+            _obs.TRACER.event("engine.step", t_host0, t_step - t_host0,
+                              cat="serving", tid="engine",
+                              args={"T": int(T)})
         if self.prefix_cache is not None:
             # this step's prefill writes are now dispatched: pages wholly
             # below each row's prompt cursor are safe for later steps of
@@ -724,8 +815,13 @@ class ContinuousBatchingEngine:
         # window length varies (partial windows at tail/run end) and a
         # jnp.stack would compile one executable per distinct length —
         # breaking the warm loop's zero-recompile contract
-        mat = np.stack([np.asarray(o) for o, _ in self._pending], axis=1)
-        commits = np.stack([c for _, c in self._pending], axis=1)  # [B, n]
+        mat = np.stack([np.asarray(o) for o, _, _ in self._pending], axis=1)
+        commits = np.stack([c for _, c, _ in self._pending], axis=1)  # [B, n]
+        step_ts = [t for _, _, t in self._pending]
+        obs = self._obs
+        if obs is not None:
+            obs.drains.inc()
+            _obs.count_sync()        # the window's host<->device transfer
         self._pending.clear()
         self._steps_since_drain = 0
         fin = np.asarray(self.finished)
@@ -735,7 +831,31 @@ class ContinuousBatchingEngine:
             req = self.slot_req[b]
             if req is None:
                 continue
-            req.output.extend(int(t) for t in mat[b][commits[b]])
+            prev_len = len(req.output)
+            new_tok = [int(t) for t in mat[b][commits[b]]]
+            req.output.extend(new_tok)
+            if obs is not None:
+                # TTFT/ITL from the committing steps' dispatch stamps;
+                # commits the trims below drop — past the budget, past
+                # cache capacity, or frozen repeats after a device-side
+                # EOS — are not real tokens and must not be timed
+                room = max(0, req.max_new_tokens - prev_len)
+                cap_v = max(1, self.g.max_seq_len - len(req.prompt))
+                if self._gen_cap[b] is not None:
+                    cap_v = min(cap_v, max(1, self._gen_cap[b]))
+                room = min(room, max(0, cap_v - prev_len))
+                if eos is not None and eos in new_tok:
+                    room = min(room, new_tok.index(eos) + 1)
+                for j in np.nonzero(commits[b])[0][:room]:
+                    tj = step_ts[j]
+                    if req.t_first is None:
+                        req.t_first = tj
+                        base = req.t_enqueue if req.t_enqueue is not None \
+                            else tj
+                        obs.ttft.observe((tj - base) * 1e3)
+                    else:
+                        obs.itl.observe((tj - req.t_last) * 1e3)
+                    req.t_last = tj
             # device freeze repeats the last token once finished — trim to
             # the true capacity/EOS/budget boundary host-side.  cap =
             # what physically fits in the cache (max_seq minus the
@@ -750,8 +870,33 @@ class ContinuousBatchingEngine:
             elif len(req.output) >= req.max_new_tokens:
                 req.output = req.output[:req.max_new_tokens]
             elif len(req.output) < cap and not fin[b]:
+                if obs is not None and len(req.output) > req.n_emitted:
+                    obs.tokens.inc(len(req.output) - req.n_emitted)
+                    req.n_emitted = len(req.output)
                 continue                     # still running
             req.done = True
+            if obs is not None:
+                if len(req.output) > req.n_emitted:
+                    obs.tokens.inc(len(req.output) - req.n_emitted)
+                    req.n_emitted = len(req.output)
+                obs.completed.inc()
+                if _obs.TRACER.enabled and req.t_enqueue is not None:
+                    # retroactive lifecycle spans: queued -> prefill ->
+                    # decode, on the slot's trace lane
+                    tr = _obs.TRACER
+                    t_adm = req.t_admit or req.t_enqueue
+                    t_f = req.t_first if req.t_first is not None else t_adm
+                    t_l = req.t_last if req.t_last is not None else t_f
+                    lane = f"slot{b}"
+                    rid = req.req_id
+                    tr.event(f"req{rid}.queued", req.t_enqueue,
+                             t_adm - req.t_enqueue, cat="serving", tid=lane)
+                    tr.event(f"req{rid}.prefill", t_adm, t_f - t_adm,
+                             cat="serving", tid=lane,
+                             args={"prompt_tokens": len(req.prompt)})
+                    tr.event(f"req{rid}.decode", t_f, t_l - t_f,
+                             cat="serving", tid=lane,
+                             args={"generated": len(req.output)})
             if self.prefix_cache is not None:
                 # retiring drops the sequence's node refs: its cached
                 # prefix pages fall to the LRU free-pool (evicted only
@@ -764,6 +909,8 @@ class ContinuousBatchingEngine:
             self.completed[req.req_id] = req.output
             done.append(req)
         self.last_stats = self.stats()
+        if obs is not None:
+            obs.update_pool(self.last_stats)
         return done
 
     # ---- admission (host-known free slots only; frees appear at drains) ----
@@ -835,6 +982,14 @@ class ContinuousBatchingEngine:
             return
         mask = np.zeros((self.B,), bool)
         budgets = self._budgets_np
+        if self._obs is not None:
+            now = time.perf_counter()
+            for _, req in admitted:
+                req.t_admit = now
+                if req.t_enqueue is not None:
+                    self._obs.queue_wait.observe(
+                        (now - req.t_enqueue) * 1e3)
+            self._obs.queue_now.set(len(self.waiting))
         for b, req in admitted:
             self.slot_req[b] = req
             self.prompt_pos[b] = int(starts[b])

@@ -38,11 +38,13 @@ import numpy as np
 
 def _serving_bump(key: str, n: int = 1) -> None:
     """Mirror a prefix-cache counter into the process-wide serving
-    telemetry (jit.cache_stats()["serving"]).  The allocator is the ONE
-    place every counter increments, so the per-engine and process-wide
-    books cannot diverge."""
-    from .prefix_cache import _SERVING_STATS
-    _SERVING_STATS[key] += n
+    telemetry — an ``observability`` registry counter (``serving.<key>``),
+    which both ``jit.cache_stats()["serving"]`` and
+    ``observability.snapshot()`` read.  The allocator is the ONE place
+    every counter increments, so the per-engine and process-wide books
+    cannot diverge."""
+    from ..observability import metrics as _metrics
+    _metrics.counter("serving." + key).inc(n)
 
 
 class PageAllocator:

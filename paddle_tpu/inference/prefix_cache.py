@@ -42,19 +42,22 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
-# process-wide serving telemetry, surfaced through paddle_tpu.jit's
-# monitoring seam (jit.cache_stats()["serving"]) next to the XLA
-# backend-compile counters.  Per-engine numbers live in
-# PageAllocator.stats(); every increment happens INSIDE the allocator
-# (kv_cache._serving_bump mirrors both books in one place), so the two
-# can never diverge.
-_SERVING_STATS = {"prefix_hits": 0, "prefix_tokens_saved": 0,
-                  "cow_copies": 0, "evicted_pages": 0}
+# process-wide serving telemetry lives in the observability registry
+# (``serving.*`` counters — ISSUE 5), surfaced through BOTH
+# paddle_tpu.jit's cache_stats()["serving"] and observability.snapshot().
+# Per-engine numbers live in PageAllocator.stats(); every increment
+# happens INSIDE the allocator (kv_cache._serving_bump mirrors both books
+# in one place), so the two can never diverge.
+_SERVING_KEYS = ("prefix_hits", "prefix_tokens_saved", "cow_copies",
+                 "evicted_pages")
 
 
 def serving_stats() -> Dict[str, int]:
-    """Process-wide prefix-cache counters (all engines summed)."""
-    return dict(_SERVING_STATS)
+    """Process-wide prefix-cache counters (all engines summed) — a view
+    of the ``serving.*`` registry series."""
+    from ..observability import metrics as _metrics
+    return {k: int(_metrics.counter("serving." + k).value)
+            for k in _SERVING_KEYS}
 
 
 class _Node:

@@ -33,6 +33,7 @@ from .. import flags
 from ..core import autograd as _engine
 from ..core.random import next_key, trace_key_scope
 from ..core.tensor import Parameter, Tensor
+from ..observability import metrics as _metrics
 from ..utils.cache import LruCache
 from . import _sot
 
@@ -44,23 +45,22 @@ _enabled = [True]
 
 # module-wide recompile telemetry (VERDICT r4 weak #7): every jax.jit
 # wrapper minted by a StaticFunction counts as one compile; evictions are
-# LRU guard-cache drops across all StaticFunctions
-_STATS = {"compiles": 0, "evictions": 0, "bucket_pads": 0}
+# LRU guard-cache drops across all StaticFunctions.  The counts live in
+# the observability registry (ISSUE 5) as jit.to_static_* series; this
+# dict view keeps the original cache_stats() shape.
+_STATS = {"compiles": _metrics.counter("jit.to_static_compiles"),
+          "evictions": _metrics.counter("jit.to_static_evictions"),
+          "bucket_pads": _metrics.counter("jit.to_static_bucket_pads")}
 
 # process-wide XLA-compile telemetry: every backend compile fires a
 # jax.monitoring duration event, StaticFunction or raw jax.jit alike.
-# This is what lets the serving tests/benches assert that a warm engine
-# loop triggers ZERO recompiles (the PR-1 telemetry, extended below the
+# The listener is registered by paddle_tpu.observability (one system for
+# compile telemetry); this module reads the same registry series, which
+# is what lets the serving tests/benches assert that a warm engine loop
+# triggers ZERO recompiles (the PR-1 telemetry, extended below the
 # guard-cache layer to the compiles XLA actually performs).
-_JIT_STATS = {"backend_compiles": 0}
-
-
-def _count_backend_compiles(name, *args, **kw):
-    if name == "/jax/core/compile/backend_compile_duration":
-        _JIT_STATS["backend_compiles"] += 1
-
-
-jax.monitoring.register_event_duration_secs_listener(_count_backend_compiles)
+from .. import observability as _observability  # noqa: E402
+from ..observability import _BACKEND_COMPILES  # noqa: E402
 
 
 def cache_stats() -> dict:
@@ -69,14 +69,19 @@ def cache_stats() -> dict:
     caches (reference surface: SOT guard-tree statistics), the
     process-wide XLA backend-compile count, and the serving prefix-cache
     counters (hits / tokens saved / COW copies / evictions, summed over
-    every engine in the process — all zero with the cache off)."""
+    every engine in the process — all zero with the cache off).  Every
+    number is a view of an ``observability`` registry series (the
+    jit.* / serving.* names), so ``observability.snapshot()`` carries the
+    same figures."""
     from ..core.autograd import dispatch_cache_stats
     from ..inference.prefix_cache import serving_stats
-    return {"to_static": dict(_STATS), "dispatch": dispatch_cache_stats(),
-            "jit": dict(_JIT_STATS), "serving": serving_stats()}
+    return {"to_static": {k: int(c.value) for k, c in _STATS.items()},
+            "dispatch": dispatch_cache_stats(),
+            "jit": {"backend_compiles": int(_BACKEND_COMPILES.value)},
+            "serving": serving_stats()}
 
 
-class assert_no_recompiles:
+class assert_no_recompiles(_observability.assert_overhead):
     """Context manager failing if XLA compiles anything inside the block.
 
     The serving engine's warm-step contract (and any steady-state loop's):
@@ -93,25 +98,16 @@ class assert_no_recompiles:
     block tight around the loop being asserted.  Exposed for benches: the
     instance records ``.compiles`` on exit either way when ``record=True``
     is used instead of raising.
+
+    The compile-only view of ``observability.assert_overhead`` (one
+    delta/raise implementation, one registry series — the two can never
+    disagree); use the general form to ALSO bound marked device syncs.
     """
 
     def __init__(self, allow: int = 0, record: bool = False):
+        super().__init__(max_compiles=allow, max_syncs=(1 << 62),
+                         record=record)
         self.allow = allow
-        self.record = record
-        self.compiles = 0
-
-    def __enter__(self):
-        self._before = _JIT_STATS["backend_compiles"]
-        return self
-
-    def __exit__(self, exc_type, exc, tb):
-        self.compiles = _JIT_STATS["backend_compiles"] - self._before
-        if exc_type is None and not self.record and self.compiles > self.allow:
-            raise AssertionError(
-                f"{self.compiles} XLA backend compile(s) inside an "
-                f"assert_no_recompiles(allow={self.allow}) block — the warm "
-                "path recompiled")
-        return False
 
 
 def enable_to_static(flag: bool):
@@ -211,8 +207,7 @@ class StaticFunction:
         # an entry drops its jit wrapper and every executable it compiled
         self._cache = LruCache(
             lambda: flags.flag("to_static_cache_size"),
-            on_evict=lambda *_: _STATS.__setitem__(
-                "evictions", _STATS["evictions"] + 1))
+            on_evict=lambda *_: _STATS["evictions"].inc())
         self.__name__ = getattr(self._fn, "__name__", "static_fn")
 
     # -- state collection ------------------------------------------------
@@ -361,7 +356,7 @@ class StaticFunction:
                 prim = self._make_pure(spec, len(params), len(buffers),
                                        len(tensors), params, buffers)
                 entry["jit"] = jax.jit(prim)
-                _STATS["compiles"] += 1
+                _STATS["compiles"].inc()
             try:
                 flat = _engine.apply(self.__name__, entry["jit"], all_inputs)
             except _sot.BREAK_ERRORS:
@@ -418,7 +413,7 @@ class StaticFunction:
             entry["specs"][pattern] = {"jit": jax.jit(prim),
                                        "pattern": pattern, "out_spec": None,
                                        "probes": None}
-            _STATS["compiles"] += 1
+            _STATS["compiles"].inc()
             entry["mru"] = pattern
         return out
 
@@ -516,7 +511,7 @@ class StaticFunction:
                 else:
                     pads.append((0, 0))
             if changed:
-                _STATS["bucket_pads"] += 1
+                _STATS["bucket_pads"].inc()
                 padded = True
                 new_tensors[i] = Tensor(jnp.pad(t._data, pads))
         return new_tensors, padded

@@ -252,6 +252,15 @@ class PretrainStep:
             self._template.mlp._grouped_mesh = self.mesh
         self._jit_step = None
         self._zero1_warned: set = set()
+        # per-step train telemetry (ISSUE 5): host-timestamp StepTimer —
+        # step wall time, tokens/s, per-step recompiles and the analytic
+        # grad-comm bytes land in the observability registry (train.*)
+        # with ZERO added device syncs (timing reads ride the caller's
+        # existing host drain); FLAGS_metrics=0 disables entirely
+        from .. import observability as _obs
+        self._telemetry = _obs.StepTimer("train") \
+            if _obs.metrics_enabled() else None
+        self._grad_sync_bytes: Optional[int] = None
 
     # ---- parameter init & sharding ----
     def _shardings(self, sample_params) -> Dict[str, Any]:
@@ -772,6 +781,8 @@ class PretrainStep:
 
     # ---- the jitted step ----
     def train_step(self, state, ids, labels):
+        if self._telemetry is not None:
+            self._telemetry.begin_step()
         if not (hasattr(ids, "sharding") and hasattr(labels, "sharding")):
             # raw host arrays (either of them): place both on the mesh
             ids, labels = self.shard_batch(np.asarray(ids),
@@ -809,7 +820,18 @@ class PretrainStep:
                 step, donate_argnums=(0,),
                 in_shardings=(sh, ids.sharding, labels.sharding),
                 out_shardings=(sh, None))
-        return self._jit_step(state, ids, labels)
+        out = self._jit_step(state, ids, labels)
+        if self._telemetry is not None:
+            if self._grad_sync_bytes is None:
+                try:    # analytic per-step dp gradient-sync traffic
+                    self._grad_sync_bytes = self.grad_sync_bytes() \
+                        if self.pc.dp > 1 else 0
+                except Exception:
+                    self._grad_sync_bytes = 0
+            self._telemetry.tick(
+                tokens=int(ids.shape[0]) * int(ids.shape[1]),
+                comm_bytes=self._grad_sync_bytes)
+        return out
 
     def eval_loss(self, state, ids, labels):
         return self._forward_loss(state["params"], ids, labels)

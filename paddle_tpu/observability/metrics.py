@@ -1,0 +1,278 @@
+"""Process-wide metrics registry: counters / gauges / histograms with
+labeled series, lock-free on the hot path, JSON + Prometheus-text snapshot.
+
+The unifying half of the observability runtime (ISSUE 5): every subsystem's
+telemetry — serving TTFT/ITL/queue depth, the train loop's StepTimer, the
+jit layer's XLA backend-compile counts, the prefix-cache/page-pool books,
+the collective watchdog — increments series in ONE registry, so "what is
+this process doing" is a single ``snapshot()`` instead of N scattered
+``stats()`` dicts (the reference's analog surface is the profiler statistic
+tables + the monitor/stat registry of paddle/fluid/platform/monitor.h).
+
+Concurrency contract: the *hot path* (``Counter.inc``, ``Gauge.set``,
+``Histogram.observe`` on an existing series) is plain Python arithmetic on
+instance attributes — atomic enough under the GIL for monotonic telemetry,
+no locks, no allocation beyond one float.  Only series *creation* takes the
+registry lock.  Metric handles are cached by callers (the serving engine
+resolves its series once at construction), so steady state never touches a
+dict lookup either.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from bisect import bisect_left
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry", "REGISTRY",
+           "counter", "gauge", "histogram", "snapshot", "prometheus_text",
+           "reset", "find"]
+
+# default histogram bucket ladder: 1/2/5 per decade over 1e-3 .. 1e5 —
+# covers sub-microsecond spans (ms units) through multi-minute step times
+# and 0..1 ratios (occupancy) with <=2.5x relative error per bucket
+_DEFAULT_BOUNDS = tuple(m * 10.0 ** e for e in range(-3, 6)
+                        for m in (1.0, 2.0, 5.0))
+
+
+class Counter:
+    """Monotonic counter.  ``inc`` is the hot path: one add, no locks."""
+
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...] = ()):
+        self.name = name
+        self.labels = labels
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...] = ()):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+
+class Histogram:
+    """Fixed-bound histogram with count/sum/min/max and bucket counts.
+
+    ``observe`` is the hot path: one bisect over a ~27-entry tuple plus
+    five scalar updates.  Percentiles are estimated from the cumulative
+    bucket counts (linear within the winning bucket) — good to the bucket
+    ratio (<=2.5x), which is plenty for latency telemetry.
+    """
+
+    __slots__ = ("name", "labels", "bounds", "bucket_counts", "count",
+                 "sum", "min", "max")
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...] = (),
+                 bounds: Optional[Tuple[float, ...]] = None):
+        self.name = name
+        self.labels = labels
+        self.bounds = tuple(bounds) if bounds else _DEFAULT_BOUNDS
+        self.bucket_counts = [0] * (len(self.bounds) + 1)  # +1 = +Inf bucket
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.bucket_counts[bisect_left(self.bounds, v)] += 1
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (q in [0, 1]) from the bucket counts."""
+        if not self.count:
+            return None
+        rank = q * self.count
+        seen = 0
+        for i, c in enumerate(self.bucket_counts):
+            if not c:
+                continue
+            if seen + c >= rank:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) else self.max
+                lo = max(lo, self.min if self.min != math.inf else lo)
+                hi = min(hi, self.max if self.max != -math.inf else hi)
+                if hi < lo:
+                    hi = lo
+                frac = (rank - seen) / c
+                return lo + (hi - lo) * frac
+            seen += c
+        return self.max
+
+    def summary(self) -> Dict[str, Optional[float]]:
+        if not self.count:
+            return {"count": 0, "sum": 0.0, "mean": None, "min": None,
+                    "max": None, "p50": None, "p95": None, "p99": None}
+        return {"count": self.count, "sum": round(self.sum, 6),
+                "mean": round(self.sum / self.count, 6),
+                "min": round(self.min, 6), "max": round(self.max, 6),
+                "p50": round(self.percentile(0.5), 6),
+                "p95": round(self.percentile(0.95), 6),
+                "p99": round(self.percentile(0.99), 6)}
+
+    def nonzero_buckets(self) -> List[List[float]]:
+        """[[le_bound, count], ...] for populated buckets (+Inf = null)."""
+        out = []
+        for i, c in enumerate(self.bucket_counts):
+            if c:
+                le = self.bounds[i] if i < len(self.bounds) else None
+                out.append([le, c])
+        return out
+
+
+def _series_key(name: str, labels: Dict[str, str]):
+    return (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+
+
+def _series_name(name: str, labels) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+class MetricRegistry:
+    """Name → labeled-series map.  Lookup of an existing series is one
+    plain dict get (no lock); creation is double-checked under the lock."""
+
+    def __init__(self):
+        self._counters: Dict[tuple, Counter] = {}
+        self._gauges: Dict[tuple, Gauge] = {}
+        self._histograms: Dict[tuple, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, table, cls, name, labels, **kw):
+        key = _series_key(name, labels)
+        m = table.get(key)
+        if m is None:
+            with self._lock:
+                m = table.get(key)
+                if m is None:
+                    m = cls(name, key[1], **kw)
+                    table[key] = m
+        return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(self._counters, Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(self._gauges, Gauge, name, labels)
+
+    def histogram(self, name: str, bounds=None, **labels) -> Histogram:
+        return self._get(self._histograms, Histogram, name, labels,
+                         bounds=bounds)
+
+    def find(self, name: str, kind: Optional[str] = None) -> list:
+        """Every series whose name matches exactly (all label sets)."""
+        tables = {"counter": [self._counters], "gauge": [self._gauges],
+                  "histogram": [self._histograms]}.get(
+            kind, [self._counters, self._gauges, self._histograms])
+        out = []
+        for t in tables:
+            out.extend(m for (n, _), m in list(t.items()) if n == name)
+        return out
+
+    def reset(self, prefix: str = "") -> None:
+        """Zero every series whose name starts with ``prefix`` ("" = all)
+        — IN PLACE, so metric handles already resolved by hot paths (the
+        serving engine caches its series at construction) keep recording
+        into the same live objects after the reset."""
+        with self._lock:
+            for t in (self._counters, self._gauges):
+                for key, m in t.items():
+                    if key[0].startswith(prefix):
+                        m.value = 0
+            for key, h in self._histograms.items():
+                if key[0].startswith(prefix):
+                    h.bucket_counts = [0] * (len(h.bounds) + 1)
+                    h.count = 0
+                    h.sum = 0.0
+                    h.min = math.inf
+                    h.max = -math.inf
+
+    # ------------------------------------------------------------ export --
+    def snapshot(self) -> Dict[str, dict]:
+        """JSON-able view of every series.  Histograms carry a summary
+        (count/sum/mean/min/max/p50/p95/p99) plus their populated
+        ``[le, count]`` buckets."""
+        out: Dict[str, dict] = {"counters": {}, "gauges": {},
+                                "histograms": {}}
+        for (n, lb), c in list(self._counters.items()):
+            out["counters"][_series_name(n, lb)] = c.value
+        for (n, lb), g in list(self._gauges.items()):
+            out["gauges"][_series_name(n, lb)] = g.value
+        for (n, lb), h in list(self._histograms.items()):
+            out["histograms"][_series_name(n, lb)] = {
+                **h.summary(), "buckets": h.nonzero_buckets()}
+        return out
+
+    def prometheus_text(self, namespace: str = "paddle_tpu") -> str:
+        """Prometheus text exposition of the whole registry."""
+        def sane(name):
+            return (namespace + "_" + name).replace(".", "_").replace(
+                "-", "_")
+
+        def esc(v):
+            # exposition-format label escaping: \ " and newline
+            return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+                .replace("\n", "\\n")
+
+        def lbl(labels, extra=()):
+            items = tuple(labels) + tuple(extra)
+            if not items:
+                return ""
+            return "{" + ",".join(f'{k}="{esc(v)}"' for k, v in items) + "}"
+
+        lines: List[str] = []
+        for (n, lb), c in sorted(self._counters.items()):
+            lines.append(f"# TYPE {sane(n)} counter")
+            lines.append(f"{sane(n)}{lbl(lb)} {c.value}")
+        for (n, lb), g in sorted(self._gauges.items()):
+            lines.append(f"# TYPE {sane(n)} gauge")
+            lines.append(f"{sane(n)}{lbl(lb)} {g.value}")
+        for (n, lb), h in sorted(self._histograms.items()):
+            base = sane(n)
+            lines.append(f"# TYPE {base} histogram")
+            cum = 0
+            for i, cnt in enumerate(h.bucket_counts):
+                cum += cnt
+                le = (f"{h.bounds[i]:g}" if i < len(h.bounds) else "+Inf")
+                lines.append(
+                    f"{base}_bucket{lbl(lb, (('le', le),))} {cum}")
+            lines.append(f"{base}_sum{lbl(lb)} {h.sum}")
+            lines.append(f"{base}_count{lbl(lb)} {h.count}")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = MetricRegistry()
+
+# module-level conveniences bound to the process-wide registry
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+snapshot = REGISTRY.snapshot
+prometheus_text = REGISTRY.prometheus_text
+reset = REGISTRY.reset
+find = REGISTRY.find

@@ -1,0 +1,187 @@
+"""Span-based host tracer with Chrome-trace / perfetto JSON export.
+
+The timeline half of the observability runtime: host spans (engine steps,
+profiler RecordEvents, retroactive per-request serving lifecycles) land in
+one in-memory event buffer exported as the Chrome ``traceEvents`` JSON that
+chrome://tracing and https://ui.perfetto.dev load directly.  Device
+timelines stay jax.profiler's job (XPlane/perfetto); ``device_trace``
+wraps ``jax.profiler.start_trace``/``stop_trace`` so a harness can capture
+both views of the same run side by side.
+
+Disabled (the default) the tracer is one attribute check per
+instrumentation site — nothing allocates.  Enabled, each span is one
+buffer append; the buffer is capped (``FLAGS_trace_max_events``) and the
+overflow count is reported in the exported file's metadata rather than
+silently dropped.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import flags
+
+__all__ = ["Tracer", "TRACER", "device_tracing_available"]
+
+
+def device_tracing_available() -> bool:
+    """True when a jax device trace may start: the backend is not CPU.
+    The env probe short-circuits before any backend initialization, so
+    the CPU tier-1 suite (JAX_PLATFORMS=cpu) never pays for — or
+    pollutes — a device-trace attempt.  The ONE guard shared by
+    ``Tracer.device_trace`` and ``profiler.Profiler``."""
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        return False
+    try:
+        import jax
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+class Tracer:
+    """Chrome-trace event buffer.  All timestamps ride
+    ``time.perf_counter()`` (µs in the export), so retroactive events can
+    be stamped from any saved ``perf_counter`` reading."""
+
+    def __init__(self, max_events: Optional[int] = None):
+        self._events: List[dict] = []
+        self._enabled = False
+        self._max = max_events
+        self.dropped = 0
+        self._tids: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # -------------------------------------------------------- lifecycle --
+    def start(self, clear: bool = True) -> "Tracer":
+        if clear:
+            self._events = []
+            self.dropped = 0
+            self._tids = {}
+        self._enabled = True
+        return self
+
+    def stop(self) -> "Tracer":
+        self._enabled = False
+        return self
+
+    # ------------------------------------------------------------ events --
+    def _tid(self, tid) -> int:
+        """Map a logical lane name ("slot3", "train") to a stable integer
+        tid, emitting the thread_name metadata event on first use."""
+        if tid is None:
+            return threading.get_ident() & 0x7FFFFFFF
+        if isinstance(tid, int):
+            return tid
+        n = self._tids.get(tid)
+        if n is None:
+            with self._lock:
+                n = self._tids.get(tid)
+                if n is None:
+                    n = len(self._tids) + 1
+                    self._tids[tid] = n
+                    self._events.append(
+                        {"ph": "M", "pid": 0, "tid": n,
+                         "name": "thread_name", "args": {"name": tid}})
+        return n
+
+    def _append(self, ev: dict) -> None:
+        cap = self._max
+        if cap is None:
+            cap = int(flags.flag("trace_max_events"))
+        if cap and len(self._events) >= cap:
+            self.dropped += 1
+            return
+        self._events.append(ev)
+
+    def event(self, name: str, t0: float, dur: float, *, cat: str = "host",
+              tid=None, args: Optional[dict] = None) -> None:
+        """Retroactive complete ("X") event: ``t0``/``dur`` in seconds on
+        the perf_counter clock (the serving drain stamps request phases
+        from timestamps it recorded at dispatch time)."""
+        if not self._enabled:
+            return
+        ev = {"ph": "X", "name": name, "cat": cat, "pid": 0,
+              "tid": self._tid(tid), "ts": t0 * 1e6,
+              "dur": max(dur, 0.0) * 1e6}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, cat: str = "host", tid=None,
+             args: Optional[dict] = None):
+        """Context-managed live span around host work."""
+        if not self._enabled:
+            yield self
+            return
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            self.event(name, t0, time.perf_counter() - t0, cat=cat,
+                       tid=tid, args=args)
+
+    def instant(self, name: str, *, cat: str = "host", tid=None,
+                args: Optional[dict] = None) -> None:
+        if not self._enabled:
+            return
+        ev = {"ph": "i", "s": "t", "name": name, "cat": cat, "pid": 0,
+              "tid": self._tid(tid), "ts": time.perf_counter() * 1e6}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def counter(self, name: str, **values) -> None:
+        """Chrome counter ("C") track, e.g. queue depth over time."""
+        if not self._enabled:
+            return
+        self._append({"ph": "C", "name": name, "pid": 0,
+                      "ts": time.perf_counter() * 1e6, "args": dict(values)})
+
+    # ------------------------------------------------------------ export --
+    def export_chrome_trace(self, path: str) -> str:
+        """Write the buffered events as Chrome-trace JSON; returns path."""
+        doc = {"traceEvents": list(self._events),
+               "displayTimeUnit": "ms",
+               "metadata": {"producer": "paddle_tpu.observability",
+                            "dropped_events": self.dropped}}
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+    @contextlib.contextmanager
+    def device_trace(self, logdir: str):
+        """Wrap a jax.profiler device trace (XPlane/perfetto) around a
+        block, guarded off on the CPU backend — the host tracer keeps
+        working either way, so CPU tier-1 never spawns device tracing."""
+        started = False
+        if device_tracing_available():
+            try:
+                import jax
+                jax.profiler.start_trace(logdir)
+                started = True
+            except Exception:
+                started = False
+        try:
+            yield started
+        finally:
+            if started:
+                import jax
+                jax.profiler.stop_trace()
+
+
+# the process-wide tracer every subsystem emits into
+TRACER = Tracer()

@@ -3,17 +3,30 @@ Profiler with states/targets/scheduler windows, RecordEvent spans,
 profiler_statistic summary tables, timer.py throughput benchmark).
 
 TPU-native engine: jax.profiler (XPlane/perfetto traces, the CUPTI+chrome
-slot — SURVEY.md §5.1) for device timelines, plus a host-side RecordEvent
-aggregator that powers ``summary()`` without any device hooks.
+slot — SURVEY.md §5.1) for device timelines, plus host-side RecordEvent
+spans.  Since ISSUE 5 this module is a thin frontend over the unified
+observability runtime: each RecordEvent lands in the process-wide metrics
+registry (``profiler.host_events_ms`` histograms, labeled by span name and
+event type) and — when the observability tracer is recording — as a
+Chrome-trace event on the same timeline as the serving/train spans.
+``summary()`` reads the registry; nothing is aggregated privately here.
+
+Device tracing: ``ProfilerTarget.TPU`` (or auto-detection with no
+``targets``) wires ``jax.profiler.start_trace``/``stop_trace`` around the
+RECORD windows, guarded off whenever the backend is CPU
+(``JAX_PLATFORMS=cpu`` short-circuits without initializing a backend), so
+CPU tier-1 runs never spawn device traces.
 """
 
 from __future__ import annotations
 
 import contextlib
 import time
-from collections import defaultdict
 from enum import Enum
 from typing import Callable, Iterable, Optional
+
+from ..observability import metrics as _metrics
+from ..observability import tracing as _tracing
 
 
 class ProfilerState(Enum):
@@ -43,8 +56,10 @@ class TracerEventType(Enum):
     UserDefined = 8
 
 
-# name -> [count, total_s, max_s, min_s, TracerEventType]
-_HOST_EVENTS = defaultdict(lambda: [0, 0.0, 0.0, float("inf"), None])
+# the registry family every RecordEvent records into (ms); labeled by
+# (name, type) so summary() can rebuild the per-event-type tables
+_EVENT_FAMILY = "profiler.host_events_ms"
+
 _ACTIVE = []
 
 
@@ -59,26 +74,35 @@ class SortedKeys(Enum):
 
 class RecordEvent:
     """Host span recorder (reference: paddle.profiler.RecordEvent; C++
-    platform/profiler RecordEvent)."""
+    platform/profiler RecordEvent).  Thin frontend over the observability
+    runtime: duration goes to the ``profiler.host_events_ms`` registry
+    histogram for this (name, type) series, and to the process tracer as
+    a Chrome-trace event when one is recording.  Nests freely — each span
+    is its own timed region."""
+
+    __slots__ = ("name", "event_type", "_t0", "_hist")
 
     def __init__(self, name: str, event_type=TracerEventType.UserDefined):
         self.name = name
-        self.event_type = event_type
+        self.event_type = event_type or TracerEventType.UserDefined
         self._t0 = None
+        self._hist = None
 
     def begin(self):
         self._t0 = time.perf_counter()
 
     def end(self):
         if self._t0 is not None:
-            dt = time.perf_counter() - self._t0
-            ev = _HOST_EVENTS[self.name]
-            ev[0] += 1
-            ev[1] += dt
-            ev[2] = max(ev[2], dt)
-            ev[3] = min(ev[3], dt)
-            ev[4] = self.event_type
+            t0 = self._t0
             self._t0 = None
+            dt = time.perf_counter() - t0
+            if self._hist is None:
+                self._hist = _metrics.histogram(
+                    _EVENT_FAMILY, event=self.name,
+                    type=self.event_type.name)
+            self._hist.observe(dt * 1e3)
+            if _tracing.TRACER.enabled:
+                _tracing.TRACER.event(self.name, t0, dt, cat="profiler")
 
     def __enter__(self):
         self.begin()
@@ -118,13 +142,26 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
     return handler
 
 
+def _device_tracing_available() -> bool:
+    """The shared CPU guard (observability.tracing owns the logic); a
+    module-level seam so tests can monkeypatch the profiler's view."""
+    return _tracing.device_tracing_available()
+
+
 class Profiler:
-    """reference profiler.py Profiler."""
+    """reference profiler.py Profiler.
+
+    ``targets``: ``ProfilerTarget.TPU`` (or ``GPU``/``CUSTOM_DEVICE``)
+    requests a jax.profiler device trace for the RECORD windows; with no
+    ``targets`` the device trace is auto-enabled exactly when the backend
+    is a real accelerator.  Host RecordEvent aggregation works in every
+    mode; ``timer_only=True`` skips device tracing entirely."""
 
     def __init__(self, targets: Optional[Iterable] = None, scheduler=None,
                  on_trace_ready=None, timer_only: bool = False, record_shapes=False,
                  profile_memory=False, with_flops=False):
         self.timer_only = timer_only
+        self._targets = None if targets is None else set(targets)
         self._scheduler = scheduler if callable(scheduler) else (
             # (start, end) tuple = ONE capture window (reference semantics)
             make_scheduler(closed=0, ready=0, record=scheduler[1] - scheduler[0],
@@ -138,8 +175,20 @@ class Profiler:
         self._last_step_t = None
 
     # -- lifecycle --
+    def _device_trace_requested(self) -> bool:
+        """The ProfilerTarget.TPU wiring (ISSUE 5 satellite): device
+        tracing needs BOTH a device-class target (TPU/GPU/custom, or
+        auto-detection with targets unset) AND a non-CPU backend."""
+        if self.timer_only:
+            return False
+        if self._targets is not None and not (
+                self._targets & {ProfilerTarget.TPU, ProfilerTarget.GPU,
+                                 ProfilerTarget.CUSTOM_DEVICE}):
+            return False
+        return _device_tracing_available()
+
     def _start_trace(self):
-        if self._jax_active or self.timer_only:
+        if self._jax_active or not self._device_trace_requested():
             return
         if self._on_trace_ready is not None:
             self._on_trace_ready(self)
@@ -160,7 +209,7 @@ class Profiler:
             self._jax_active = False
 
     def start(self):
-        _HOST_EVENTS.clear()
+        _metrics.reset(_EVENT_FAMILY)
         _install_op_hook()
         self._last_step_t = time.perf_counter()
         # with a scheduler, tracing starts/stops around RECORD windows in
@@ -217,11 +266,15 @@ class Profiler:
         by event type plus per-type breakdowns (Operator table = the
         framework's per-op dispatch spans, recorded automatically while the
         profiler is active) with Calls/Total/Avg/Max/Min/Ratio columns.
+        Rows are read from the observability registry's
+        ``profiler.host_events_ms`` series (reset at ``start()``).
         Device-side kernel timings live in the exported XPlane trace.
 
         Returns {event_type_name: [(name, calls, total_s, avg_s, max_s,
         min_s), ...]} for programmatic use.
         """
+        from collections import defaultdict
+
         key_idx = {SortedKeys.CPUTotal: lambda r: -r[2],
                    SortedKeys.CPUAvg: lambda r: -r[3],
                    SortedKeys.CPUMax: lambda r: -r[4],
@@ -231,11 +284,14 @@ class Profiler:
 
         by_type = defaultdict(list)
         grand_total = 0.0
-        for name, (cnt, tot, mx, mn, ttype) in _HOST_EVENTS.items():
-            tname = (ttype or TracerEventType.UserDefined).name
-            by_type[tname].append(
-                (name, cnt, tot, tot / max(cnt, 1), mx,
-                 mn if mn != float("inf") else 0.0))
+        for h in _metrics.find(_EVENT_FAMILY, kind="histogram"):
+            labels = dict(h.labels)
+            if not h.count:
+                continue
+            tot = h.sum / 1e3                    # histogram stores ms
+            by_type[labels.get("type", "UserDefined")].append(
+                (labels.get("event", "?"), h.count, tot, tot / h.count,
+                 h.max / 1e3, h.min / 1e3))
             grand_total += tot
 
         unit = 1000.0 if time_unit == "ms" else 1.0

@@ -1,0 +1,367 @@
+"""Unified metrics + tracing runtime (ISSUE 5): registry semantics,
+Chrome-trace export, the assert_overhead contract, serving per-request
+telemetry (TTFT/ITL/queue/occupancy), the PretrainStep StepTimer, and the
+collective watchdog's heartbeat gauge + timeout fire path."""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import flags
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import ContinuousBatchingEngine, GenerationConfig
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_and_labels():
+    c = obs.metrics.counter("t9.hits")
+    c0 = c.value
+    c.inc()
+    c.inc(3)
+    assert obs.metrics.counter("t9.hits").value == c0 + 4  # same series
+    assert obs.metrics.counter("t9.hits", shard="a") is not \
+        obs.metrics.counter("t9.hits", shard="b")          # labeled split
+    g = obs.metrics.gauge("t9.depth")
+    g.set(7)
+    snap = obs.snapshot()
+    assert snap["counters"]["t9.hits"] == c0 + 4
+    assert snap["gauges"]["t9.depth"] == 7.0
+    assert "t9.hits{shard=a}" in snap["counters"]
+
+
+def test_histogram_summary_and_percentiles():
+    h = obs.metrics.histogram("t9.lat_ms")
+    for v in (1.5, 2.5, 3.5, 100.0):
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 4 and s["min"] == 1.5 and s["max"] == 100.0
+    assert s["mean"] == pytest.approx((1.5 + 2.5 + 3.5 + 100.0) / 4)
+    # p50 must land in the bucket holding the 2nd observation (2, 5]
+    assert 1.5 <= s["p50"] <= 5.0
+    assert s["p99"] <= 100.0
+    # buckets are [le, count] pairs summing to the observation count
+    assert sum(c for _, c in h.nonzero_buckets()) == 4
+
+
+def test_prometheus_text_format():
+    obs.metrics.counter("t9.prom_total").inc(2)
+    obs.metrics.histogram("t9.prom_ms").observe(3.0)
+    text = obs.prometheus_text()
+    assert "# TYPE paddle_tpu_t9_prom_total counter" in text
+    assert "paddle_tpu_t9_prom_total 2" in text
+    assert "paddle_tpu_t9_prom_ms_count 1" in text
+    assert 'le="+Inf"' in text
+
+
+def test_reset_zeroes_in_place_keeping_handles_live():
+    c = obs.metrics.counter("t9reset.n")
+    h = obs.metrics.histogram("t9reset.ms")
+    c.inc(5)
+    h.observe(1.0)
+    obs.reset("t9reset.")
+    assert c.value == 0 and h.count == 0
+    # the CRITICAL property: handles resolved before the reset still
+    # record into the registry (the serving engine caches its series)
+    c.inc()
+    h.observe(2.0)
+    assert obs.metrics.counter("t9reset.n").value == 1
+    assert obs.metrics.histogram("t9reset.ms").count == 1
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+def test_tracer_spans_and_chrome_export(tmp_path):
+    tr = obs.Tracer()
+    tr.start()
+    with tr.span("outer", cat="test"):
+        with tr.span("inner", cat="test"):
+            time.sleep(0.002)
+    tr.event("retro", time.perf_counter() - 1.0, 0.5, tid="lane")
+    tr.instant("marker")
+    tr.stop()
+    path = tr.export_chrome_trace(str(tmp_path / "t.json"))
+    doc = json.loads(open(path).read())
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "outer" in names and "inner" in names and "retro" in names
+    xs = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert xs["outer"]["dur"] >= xs["inner"]["dur"] > 0
+    assert xs["retro"]["dur"] == pytest.approx(0.5e6)
+    # named lanes get a thread_name metadata event
+    assert any(e["ph"] == "M" and e["args"]["name"] == "lane"
+               for e in doc["traceEvents"])
+    assert doc["metadata"]["dropped_events"] == 0
+
+
+def test_tracer_disabled_is_inert():
+    tr = obs.Tracer()
+    with tr.span("nope"):
+        pass
+    tr.event("nope2", 0.0, 1.0)
+    assert tr._events == []
+
+
+def test_tracer_event_cap():
+    tr = obs.Tracer(max_events=3)
+    tr.start()
+    for i in range(6):
+        tr.instant(f"e{i}")
+    assert len(tr._events) == 3 and tr.dropped == 3
+
+
+# ---------------------------------------------------------------------------
+# assert_overhead — the generalized warm-path contract
+# ---------------------------------------------------------------------------
+
+def test_assert_overhead_counts_compiles_and_syncs():
+    with obs.assert_overhead(record=True) as rec:
+        jax.jit(lambda x: x * 1.25 + 9)(jnp.ones((5,)))
+        obs.count_sync()
+    assert rec.compiles >= 1 and rec.syncs == 1
+    with pytest.raises(AssertionError, match="compile"):
+        with obs.assert_overhead():
+            jax.jit(lambda x: x * 2.25 - 7)(jnp.ones((6,)))
+    with pytest.raises(AssertionError, match="sync"):
+        with obs.assert_overhead():
+            obs.count_sync()
+    with obs.assert_overhead(max_syncs=2):
+        obs.count_sync(2)
+
+
+def test_assert_overhead_matches_jit_assert_no_recompiles():
+    """Both read the same registry series — one compile system."""
+    from paddle_tpu.jit import assert_no_recompiles
+    with obs.assert_overhead(record=True) as a, \
+            assert_no_recompiles(record=True) as b:
+        jax.jit(lambda x: x - 0.125)(jnp.ones((7,)))
+    assert a.compiles == b.compiles >= 1
+
+
+# ---------------------------------------------------------------------------
+# serving engine telemetry
+# ---------------------------------------------------------------------------
+
+def _tiny_engine(**kw):
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    return ContinuousBatchingEngine(
+        model, max_batch=2, gen=GenerationConfig(max_new_tokens=6),
+        max_seq_len=64, page_size=8, prefill_bucket=8, **kw)
+
+
+def test_engine_request_lifecycle_histograms():
+    obs.reset("serving.")
+    eng = _tiny_engine(metrics=True)
+    rids = [eng.add_request(p) for p in ([1, 2, 3], [4, 5], [6, 7, 8, 9])]
+    out = eng.run()
+    total = sum(len(out[r]) for r in rids)
+    ttft = obs.metrics.histogram("serving.ttft_ms")
+    itl = obs.metrics.histogram("serving.itl_ms")
+    assert ttft.count == len(rids)           # one TTFT per request
+    assert itl.count == total - len(rids)    # one ITL per later token
+    assert ttft.min >= 0 and itl.min >= 0
+    assert obs.metrics.counter("serving.tokens_generated").value == total
+    assert obs.metrics.counter(
+        "serving.requests_completed").value == len(rids)
+    assert obs.metrics.histogram("serving.queue_wait_ms").count == len(rids)
+    occ = obs.metrics.histogram("serving.batch_occupancy")
+    assert occ.count > 0 and 0.0 < occ.max <= 1.0
+    # pool gauges folded in from the allocator at drain time
+    assert obs.metrics.gauge("serving.peak_pages_in_use").value > 0
+
+
+def test_engine_eos_does_not_inflate_itl():
+    """Frozen-repeat commits after a device-side EOS are trimmed from the
+    output — they must not be timed either: the per-token invariant
+    itl.count == tokens - requests holds on EOS-terminating traffic."""
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    prompt = [1, 2, 3, 4, 5]
+    # discover a token greedy decode actually emits mid-stream, then use
+    # it as the EOS id so the sequence terminates before its budget
+    probe = ContinuousBatchingEngine(
+        model, max_batch=2, gen=GenerationConfig(max_new_tokens=8),
+        max_seq_len=64, page_size=8, prefill_bucket=8, metrics=False)
+    r = probe.add_request(prompt)
+    eos = probe.run()[r][2]                  # 3rd generated token
+    obs.reset("serving.")
+    eng = ContinuousBatchingEngine(
+        model, max_batch=2,
+        gen=GenerationConfig(max_new_tokens=8, eos_token_id=int(eos)),
+        max_seq_len=64, page_size=8, prefill_bucket=8, metrics=True,
+        sync_every=8)                        # EOS lands mid drain-window
+    rid = eng.add_request(prompt)
+    out = eng.run()
+    assert out[rid][-1] == eos and len(out[rid]) < 8   # terminated early
+    assert obs.metrics.counter(
+        "serving.tokens_generated").value == len(out[rid])
+    assert obs.metrics.histogram("serving.ttft_ms").count == 1
+    assert obs.metrics.histogram("serving.itl_ms").count == \
+        len(out[rid]) - 1
+
+
+def test_engine_metrics_off_records_nothing():
+    obs.reset("serving.")
+    eng = _tiny_engine(metrics=False)
+    rids = [eng.add_request([1, 2, 3]), eng.add_request([4, 5])]
+    out = eng.run()
+    assert all(len(out[r]) == 6 for r in rids)   # behavior unchanged
+    assert obs.metrics.counter("serving.tokens_generated").value == 0
+    assert obs.metrics.histogram("serving.ttft_ms").count == 0
+    assert obs.metrics.counter("serving.requests_total").value == 0
+
+
+def test_engine_warm_steps_zero_compiles_zero_syncs():
+    """The ISSUE 5 overhead contract, telemetry-asserted: warm engine
+    steps with metrics ON perform ZERO XLA compiles and ZERO marked
+    host<->device syncs between drains."""
+    eng = _tiny_engine(metrics=True, sync_every=64)
+    for p in ([1, 2, 3], [4, 5]):
+        eng.add_request(p)
+    eng.run()                                 # warm the T-pair programs
+    for p in ([9, 8, 7], [2, 3]):
+        eng.add_request(p)
+    with obs.assert_overhead(max_compiles=0, max_syncs=0):
+        for _ in range(6):
+            eng.step()
+    out = eng.run()
+    assert all(len(v) == 6 for v in out.values())
+
+
+def test_engine_request_spans_in_trace(tmp_path):
+    obs.tracer.start()
+    try:
+        eng = _tiny_engine(metrics=True)
+        rid = eng.add_request([1, 2, 3, 4, 5])
+        eng.run()
+    finally:
+        obs.tracer.stop()
+    path = obs.export_chrome_trace(str(tmp_path / "serve.json"))
+    doc = json.loads(open(path).read())
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "engine.step" in names
+    for phase in ("queued", "prefill", "decode"):
+        assert f"req{rid}.{phase}" in names, names
+    # the lifecycle phases tile the request's wall time in order
+    spans = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    q, p, d = (spans[f"req{rid}.{s}"] for s in ("queued", "prefill",
+                                                "decode"))
+    assert q["ts"] <= p["ts"] <= d["ts"]
+    assert d["args"]["generated"] == 6
+
+
+# ---------------------------------------------------------------------------
+# train StepTimer
+# ---------------------------------------------------------------------------
+
+def test_pretrain_steptimer_records_warm_steps_without_syncs():
+    from paddle_tpu.models.pretrain import ParallelConfig, PretrainStep
+
+    obs.reset("train.")
+    ps = PretrainStep(LlamaConfig.tiny(), ParallelConfig())
+    state = ps.init_state(seed=0)
+    rng = np.random.default_rng(0)
+    ids, labels = ps.shard_batch(
+        rng.integers(0, 256, (2, 16)).astype(np.int32),
+        rng.integers(0, 256, (2, 16)).astype(np.int32))
+    state, loss = ps.train_step(state, ids, labels)      # compile step
+    rc_warmup = obs.metrics.counter("train.recompiles").value
+    assert rc_warmup >= 1
+    with obs.assert_overhead(max_compiles=0, max_syncs=0):
+        for _ in range(3):
+            state, loss = ps.train_step(state, ids, labels)
+    jax.block_until_ready(loss)
+    assert obs.metrics.counter("train.steps").value == 4
+    h = obs.metrics.histogram("train.step_ms")
+    assert h.count == 3                     # warm steps only, compile excluded
+    assert obs.metrics.gauge("train.tokens_per_sec").value > 0
+    # recompile count did NOT grow over the warm steps
+    assert obs.metrics.counter("train.recompiles").value == rc_warmup
+
+
+def test_steptimer_attributes_compiles_per_step():
+    obs.reset("t9train.")
+    t = obs.StepTimer("t9train")
+    t.begin_step()
+    jax.jit(lambda x: x + 17.5)(jnp.ones((3,)))          # a "step" compile
+    t.tick(tokens=32)
+    t.begin_step()
+    t.tick(tokens=32)                                    # warm step
+    assert obs.metrics.counter("t9train.recompiles").value >= 1
+    assert obs.metrics.counter("t9train.steps").value == 2
+    assert obs.metrics.histogram("t9train.step_ms").count == 1
+
+
+# ---------------------------------------------------------------------------
+# watchdog (ISSUE 5 satellite: heartbeat gauge + the timeout fire path)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_timeout_fires_and_counts():
+    from paddle_tpu.distributed.watchdog import CommTaskManager
+
+    fired_before = obs.metrics.counter("watchdog.timeouts").value
+    old = flags.get_flags(["comm_timeout_s"])
+    flags.set_flags({"comm_timeout_s": 0})
+    m = CommTaskManager()
+    m.poll_interval = 0.05
+    m.start()
+    try:
+        m.begin("t9-hung-collective")
+        deadline = time.time() + 5.0
+        while not m.timed_out and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        m.shutdown()
+        flags.set_flags(old)
+    assert m.timed_out and m.timed_out[0].name == "t9-hung-collective"
+    assert obs.metrics.counter("watchdog.timeouts").value > fired_before
+    assert not m.outstanding()               # fired task was removed
+
+
+def test_watchdog_heartbeat_gauge_ages():
+    from paddle_tpu.distributed.watchdog import CommTaskManager
+
+    m = CommTaskManager()
+    m.poll_interval = 0.05
+    m.start()
+    try:
+        tid = m.begin("t9-live")
+        assert obs.metrics.gauge("watchdog.last_heartbeat_age_s").value == 0
+        deadline = time.time() + 5.0
+        while obs.metrics.gauge("watchdog.last_heartbeat_age_s").value \
+                <= 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert obs.metrics.gauge("watchdog.last_heartbeat_age_s").value > 0
+        assert obs.metrics.gauge("watchdog.outstanding_tasks").value == 1
+        m.end(tid)
+        assert obs.metrics.gauge("watchdog.outstanding_tasks").value == 0
+    finally:
+        m.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# one-system integration: cache_stats <-> registry
+# ---------------------------------------------------------------------------
+
+def test_cache_stats_reads_registry_series():
+    import paddle_tpu.jit as pjit
+
+    before = pjit.cache_stats()["jit"]["backend_compiles"]
+    jax.jit(lambda x: x * 0.375)(jnp.ones((9,)))
+    stats = pjit.cache_stats()
+    assert stats["jit"]["backend_compiles"] > before
+    assert stats["jit"]["backend_compiles"] == \
+        obs.metrics.counter("jit.backend_compiles").value
+    # serving counters are the same registry series too
+    assert stats["serving"]["prefix_hits"] == \
+        obs.metrics.counter("serving.prefix_hits").value

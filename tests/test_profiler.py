@@ -1,0 +1,184 @@
+"""paddle_tpu.profiler direct coverage (ISSUE 5 satellites): nested
+RecordEvent spans, SortedKeys ordering in summary(), the registry-backed
+aggregation, and the ProfilerTarget.TPU device-trace wiring with its
+CPU guard."""
+
+import time
+
+import pytest
+
+import paddle_tpu.profiler as prof
+from paddle_tpu import observability as obs
+
+
+def _fresh():
+    """Each test starts from an empty host-event family (same reset
+    Profiler.start() performs)."""
+    obs.reset("profiler.host_events_ms")
+
+
+# ---------------------------------------------------------------------------
+# RecordEvent: nesting + aggregation
+# ---------------------------------------------------------------------------
+
+def test_nested_record_events_aggregate_independently():
+    _fresh()
+    p = prof.Profiler(timer_only=True).start()
+    try:
+        for _ in range(3):
+            with prof.RecordEvent("outer"):
+                with prof.RecordEvent("inner"):
+                    time.sleep(0.002)
+                time.sleep(0.001)
+    finally:
+        p.stop()
+    out = p.summary()
+    rows = {r[0]: r for r in out["UserDefined"]}
+    assert set(rows) >= {"outer", "inner"}
+    o, i = rows["outer"], rows["inner"]
+    assert o[1] == 3 and i[1] == 3                    # calls
+    assert o[2] > i[2] > 0                            # outer total > inner
+    assert o[4] >= o[3] >= o[5] >= 0                  # max >= avg >= min
+    # nested spans are independent regions: outer's min exceeds inner's max
+    assert o[5] >= i[5]
+
+
+def test_record_event_reenterable_and_typed():
+    _fresh()
+    ev = prof.RecordEvent("reused", prof.TracerEventType.Forward)
+    for _ in range(2):
+        ev.begin()
+        ev.end()
+    ev.end()                                          # idempotent no-op
+    p = prof.Profiler(timer_only=True)
+    # summary groups by TracerEventType name
+    h = obs.metrics.histogram("profiler.host_events_ms", event="reused",
+                              type="Forward")
+    assert h.count == 2
+    out = p.summary()
+    assert any(r[0] == "reused" for r in out.get("Forward", []))
+
+
+def test_summary_sorted_keys_orderings():
+    _fresh()
+    # craft three series with distinct totals/calls/mins via direct
+    # registry observes (same seam RecordEvent.end uses)
+    for name, durs in (("a", [5.0]), ("b", [1.0, 1.0, 1.0]),
+                       ("c", [0.5, 9.0])):
+        h = obs.metrics.histogram("profiler.host_events_ms", event=name,
+                                  type="UserDefined")
+        for d in durs:
+            h.observe(d)
+    p = prof.Profiler(timer_only=True)
+
+    by_total = [r[0] for r in p.summary(
+        sorted_by=prof.SortedKeys.CPUTotal)["UserDefined"]]
+    assert by_total == ["c", "a", "b"]                # 9.5 > 5.0 > 3.0 ms
+    by_calls = [r[0] for r in p.summary(
+        sorted_by=prof.SortedKeys.Calls)["UserDefined"]]
+    assert by_calls[0] == "b"                         # 3 calls first
+    by_min = [r[0] for r in p.summary(
+        sorted_by=prof.SortedKeys.CPUMin)["UserDefined"]]
+    assert by_min[0] == "c"                           # min 0.5 ms first
+    by_max = [r[0] for r in p.summary(
+        sorted_by=prof.SortedKeys.CPUMax)["UserDefined"]]
+    assert by_max[0] == "c"                           # max 9.0 ms first
+
+
+def test_profiler_start_resets_host_events():
+    _fresh()
+    with prof.RecordEvent("stale"):
+        pass
+    p = prof.Profiler(timer_only=True).start()
+    try:
+        with prof.RecordEvent("fresh"):
+            pass
+    finally:
+        p.stop()
+    names = [r[0] for r in p.summary().get("UserDefined", [])]
+    assert "fresh" in names and "stale" not in names
+
+
+def test_record_event_lands_in_tracer_when_recording(tmp_path):
+    _fresh()
+    obs.tracer.start()
+    try:
+        with prof.RecordEvent("traced-span"):
+            time.sleep(0.001)
+    finally:
+        obs.tracer.stop()
+    import json
+    doc = json.loads(open(obs.export_chrome_trace(
+        str(tmp_path / "prof.json"))).read())
+    assert any(e["name"] == "traced-span" and e["ph"] == "X"
+               for e in doc["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# ProfilerTarget.TPU wiring + CPU guard
+# ---------------------------------------------------------------------------
+
+def test_tpu_target_guarded_off_on_cpu():
+    """tier-1 runs under JAX_PLATFORMS=cpu: even an explicit TPU target
+    must NOT start a device trace (no tempdir, no jax.profiler)."""
+    p = prof.Profiler(targets=[prof.ProfilerTarget.TPU]).start()
+    try:
+        assert p._jax_active is False
+        assert p._trace_dir is None
+    finally:
+        p.stop()
+
+
+def test_auto_targets_guarded_off_on_cpu():
+    p = prof.Profiler().start()
+    try:
+        assert p._jax_active is False
+    finally:
+        p.stop()
+
+
+def test_tpu_target_reaches_jax_profiler_off_cpu(monkeypatch):
+    """With the backend guard lifted, ProfilerTarget.TPU wires straight
+    to jax.profiler.start_trace/stop_trace (the satellite fix: the enum
+    was previously defined but unreachable from Profiler)."""
+    import jax
+
+    calls = []
+    monkeypatch.setattr(prof, "_device_tracing_available", lambda: True)
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop", None)))
+    p = prof.Profiler(targets=[prof.ProfilerTarget.TPU]).start()
+    assert p._jax_active is True
+    p.stop()
+    assert [c[0] for c in calls] == ["start", "stop"]
+    assert calls[0][1] == p._trace_dir is not None
+
+
+def test_cpu_only_target_never_requests_device_trace(monkeypatch):
+    monkeypatch.setattr(prof, "_device_tracing_available", lambda: True)
+    p = prof.Profiler(targets=[prof.ProfilerTarget.CPU]).start()
+    try:
+        assert p._jax_active is False          # host-only target set
+    finally:
+        p.stop()
+
+
+def test_scheduler_windows_drive_device_trace(monkeypatch):
+    """make_scheduler RECORD windows open/close the device trace."""
+    import jax
+
+    calls = []
+    monkeypatch.setattr(prof, "_device_tracing_available", lambda: True)
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append("start"))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append("stop"))
+    sched = prof.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    p = prof.Profiler(targets=[prof.ProfilerTarget.TPU],
+                      scheduler=sched).start()
+    for _ in range(4):
+        p.step()
+    p.stop()
+    assert calls == ["start", "stop"]          # one RECORD window captured
