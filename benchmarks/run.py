@@ -4,7 +4,7 @@ in benchmarks/ of this repo with per-config JSON results").
 Usage:
     python benchmarks/run.py [config ...] [--cpu] [--fused-gather=0|1]
                              [--trace=PATH]
-configs: resnet gpt2 llama dit moe decode serve all   (default: all)
+configs: resnet gpt2 llama dit moe decode serve http_serve all (default: all)
 
 --fused-gather pins FLAGS_grouped_matmul_fused_gather for the run (A/B of
 the in-kernel MoE dispatch gather; the =0 arm writes <config>_nofuse.json).
@@ -285,10 +285,25 @@ def run_serve():
             **bench._run_serve_metrics(_on_tpu())}
 
 
+def run_http_serve():
+    """ISSUE 6: HTTP front door A/B (`python benchmarks/run.py http_serve
+    --cpu`) — concurrent streaming clients against the real-socket
+    asyncio server, full observability plane ON (metrics + SLO admission
+    + flight-recorder ring) vs OFF.  Reports client-measured TTFT and
+    inter-chunk latency (the drain-cadence arrival rhythm a user sees)
+    next to the engine-measured serving.ttft_ms/itl_ms histograms, and
+    stamps the shed / dropped-series / dropped-trace-events guard
+    counters into results/http_serve.json alongside the automatic
+    registry snapshot."""
+    import bench
+    return {"config": "http_serve", **bench._run_http_serve(_on_tpu())}
+
+
 CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
            "dit": run_dit, "moe": run_moe, "decode": run_decode,
            "longctx": run_longctx, "grad_comm": run_grad_comm,
-           "serve_prefix": run_serve_prefix, "serve": run_serve}
+           "serve_prefix": run_serve_prefix, "serve": run_serve,
+           "http_serve": run_http_serve}
 
 
 def _supervise(names, timeout):

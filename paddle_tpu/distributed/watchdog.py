@@ -51,10 +51,26 @@ class CommTaskManager:
         self._stop = threading.Event()
         self.timed_out: list = []
         self._last_heartbeat: Optional[float] = None
+        self._timeout_hooks: list = []
         from ..observability import metrics as _metrics
         self._hb_gauge = _metrics.gauge("watchdog.last_heartbeat_age_s")
         self._out_gauge = _metrics.gauge("watchdog.outstanding_tasks")
         self._timeout_ctr = _metrics.counter("watchdog.timeouts")
+
+    def add_timeout_hook(self, fn):
+        """Register ``fn(task)`` to run (on the poller thread) whenever a
+        watched task exceeds the timeout — the crash-flight-recorder dump
+        seam (ISSUE 6): a hung device step triggers a trace dump of the
+        window that led up to it.  Hook exceptions are swallowed: the
+        watchdog must keep polling."""
+        self._timeout_hooks.append(fn)
+        return fn
+
+    def remove_timeout_hook(self, fn):
+        try:
+            self._timeout_hooks.remove(fn)
+        except ValueError:
+            pass
 
     def start(self):
         if self._thread is None:
@@ -104,6 +120,13 @@ class CommTaskManager:
                 self.timed_out.append(t)
                 self._timeout_ctr.inc()
                 self._dump(t, now)
+                for fn in list(self._timeout_hooks):
+                    try:
+                        fn(t)
+                    except Exception as e:
+                        import sys
+                        print(f"[paddle_tpu watchdog] timeout hook "
+                              f"{fn!r} raised: {e}", file=sys.stderr)
                 with self._lock:
                     self._tasks = {k: v for k, v in self._tasks.items()
                                    if v is not t}

@@ -112,6 +112,45 @@ define_flag("trace_max_events", 200000,
             "Cap on buffered Chrome-trace events in the observability "
             "tracer (observability/tracing.py); overflow is counted in the "
             "exported file's metadata instead of growing without bound.")
+define_flag("metrics_max_series", 512,
+            "Cap on LABELED series per metric family in the registry "
+            "(observability/metrics.py).  A family at the cap folds every "
+            "further label set into one {series=__overflow__} series and "
+            "bumps metrics.dropped_series instead of growing unbounded "
+            "(per-request label explosion guard for long-lived serving).")
+define_flag("serving_slo_ttft_ms", 2000.0,
+            "HTTP front door TTFT SLO target in ms (serving/slo.py): the "
+            "serving.ttft_ms quantile FLAGS_serving_slo_quantile must stay "
+            "under this.  <=0 disables the TTFT term.")
+define_flag("serving_slo_itl_ms", 200.0,
+            "HTTP front door inter-token-latency SLO target in ms "
+            "(serving.itl_ms histogram).  <=0 disables the ITL term.")
+define_flag("serving_slo_quantile", 0.95,
+            "SLO quantile: the fraction of observations that must meet the "
+            "TTFT/ITL targets (0.95 = a 5% violation budget).")
+define_flag("serving_slo_burn", 2.0,
+            "Load-shed threshold as a multiple of the SLO violation "
+            "budget: observed violation rate > burn * (1 - quantile) "
+            "sheds new requests with 503; > 1x budget marks them "
+            "'queue' (admitted, counted as at-risk).")
+define_flag("serving_slo_min_samples", 64,
+            "Minimum fresh histogram observations in the current window "
+            "before SLO burn decisions activate (cold start admits).")
+define_flag("serving_slo_window", 512,
+            "Observations per SLO decision window: burn is computed over "
+            "deltas since the window base, rebased every this-many.")
+define_flag("flight_recorder_events", 4096,
+            "Bounded ring of recent trace spans kept by the crash flight "
+            "recorder (observability/flight_recorder.py); the ring is "
+            "dumped as a Chrome trace on watchdog timeout / SIGTERM / "
+            "unhandled crash.")
+define_flag("flight_recorder_snapshot_s", 10.0,
+            "Seconds between periodic registry snapshots folded into the "
+            "flight-recorder ring (each is one instant event).")
+define_flag("flight_recorder_path", "flight_record.json",
+            "Base path for flight-recorder dumps; the trigger reason is "
+            "suffixed to the stem so a SIGTERM dump never clobbers a "
+            "watchdog-timeout dump.")
 define_flag("use_native_dataloader", False,
             "Route DataLoader prefetch through the C++ ring-buffer engine "
             "(native/ringbuf.cc). Off by default: with in-process thread "

@@ -474,12 +474,18 @@ class Request:
     The ``t_*`` fields are host ``perf_counter`` stamps of the request's
     lifecycle (enqueue → admission → first token → last token), recorded
     by the engine's observability instrumentation at dispatch/drain time —
-    never via a device sync."""
+    never via a device sync.
+
+    ``trace_id`` is the caller's trace-context id (the HTTP front door's
+    response id, ISSUE 6): when set, the request's lifecycle spans ride a
+    trace lane named after it, so one request is ONE correlated track from
+    HTTP accept through engine retire in the exported Chrome trace."""
 
     __slots__ = ("req_id", "prompt", "max_new_tokens", "output", "done",
-                 "t_enqueue", "t_admit", "t_first", "t_last", "n_emitted")
+                 "t_enqueue", "t_admit", "t_first", "t_last", "n_emitted",
+                 "trace_id")
 
-    def __init__(self, req_id, prompt, max_new_tokens):
+    def __init__(self, req_id, prompt, max_new_tokens, trace_id=None):
         self.req_id = req_id
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
@@ -490,6 +496,7 @@ class Request:
         self.t_first = None
         self.t_last = None
         self.n_emitted = 0
+        self.trace_id = trace_id
 
 
 class _ServingMetrics:
@@ -626,18 +633,28 @@ class ContinuousBatchingEngine:
                                                none, none))
 
     # ---- public api ----
-    def add_request(self, prompt: Sequence[int],
-                    max_new_tokens: Optional[int] = None) -> int:
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               trace_id: Optional[str] = None) -> Request:
+        """Enqueue a request and return its live ``Request`` object (the
+        HTTP front door streams tokens by watching ``req.output`` grow at
+        drains).  ``trace_id`` threads the caller's trace context through
+        the request's lifecycle spans."""
         rid = self._next_id
         self._next_id += 1
         req = Request(rid, prompt,
-                      max_new_tokens or self.gen_cfg.max_new_tokens)
+                      max_new_tokens or self.gen_cfg.max_new_tokens,
+                      trace_id=trace_id)
         self.waiting.append(req)
         if self._obs is not None:
             req.t_enqueue = time.perf_counter()
             self._obs.requests.inc()
             self._obs.queue_now.set(len(self.waiting))
-        return rid
+        return req
+
+    def add_request(self, prompt: Sequence[int],
+                    max_new_tokens: Optional[int] = None) -> int:
+        return self.submit(prompt, max_new_tokens).req_id
 
     def has_work(self) -> bool:
         return bool(self.waiting) or any(r is not None for r in self.slot_req)
@@ -882,21 +899,26 @@ class ContinuousBatchingEngine:
                 obs.completed.inc()
                 if _obs.TRACER.enabled and req.t_enqueue is not None:
                     # retroactive lifecycle spans: queued -> prefill ->
-                    # decode, on the slot's trace lane
+                    # decode.  With a trace context (HTTP front door) the
+                    # lane IS the request id — one correlated track from
+                    # accept to retire; otherwise the slot's lane.
                     tr = _obs.TRACER
                     t_adm = req.t_admit or req.t_enqueue
                     t_f = req.t_first if req.t_first is not None else t_adm
                     t_l = req.t_last if req.t_last is not None else t_f
-                    lane = f"slot{b}"
+                    lane = req.trace_id or f"slot{b}"
                     rid = req.req_id
+                    ctx = {"trace_id": req.trace_id, "slot": b} \
+                        if req.trace_id else {"slot": b}
                     tr.event(f"req{rid}.queued", req.t_enqueue,
-                             t_adm - req.t_enqueue, cat="serving", tid=lane)
+                             t_adm - req.t_enqueue, cat="serving",
+                             tid=lane, args=ctx)
                     tr.event(f"req{rid}.prefill", t_adm, t_f - t_adm,
                              cat="serving", tid=lane,
-                             args={"prompt_tokens": len(req.prompt)})
+                             args={**ctx, "prompt_tokens": len(req.prompt)})
                     tr.event(f"req{rid}.decode", t_f, t_l - t_f,
                              cat="serving", tid=lane,
-                             args={"generated": len(req.output)})
+                             args={**ctx, "generated": len(req.output)})
             if self.prefix_cache is not None:
                 # retiring drops the sequence's node refs: its cached
                 # prefix pages fall to the LRU free-pool (evicted only

@@ -33,16 +33,18 @@ from typing import Optional
 
 from .. import flags
 from . import metrics, tracing
+from .flight_recorder import FlightRecorder
 from .metrics import (REGISTRY, counter, find, gauge, histogram,
-                      prometheus_text, reset, snapshot)
+                      prometheus_text, reset, set_help, snapshot)
 from .tracing import TRACER, Tracer
 
 tracer = TRACER
 
 __all__ = ["metrics", "tracing", "REGISTRY", "counter", "gauge",
            "histogram", "snapshot", "prometheus_text", "reset", "find",
-           "tracer", "Tracer", "TRACER", "metrics_enabled", "count_sync",
-           "assert_overhead", "StepTimer", "export_chrome_trace"]
+           "set_help", "tracer", "Tracer", "TRACER", "FlightRecorder",
+           "metrics_enabled", "count_sync", "assert_overhead", "StepTimer",
+           "export_chrome_trace"]
 
 
 def metrics_enabled() -> bool:

@@ -21,13 +21,20 @@ dict lookup either.
 from __future__ import annotations
 
 import math
+import re
 import threading
 from bisect import bisect_left
 from typing import Dict, List, Optional, Tuple
 
+from .. import flags
+
 __all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry", "REGISTRY",
            "counter", "gauge", "histogram", "snapshot", "prometheus_text",
-           "reset", "find"]
+           "reset", "find", "set_help", "OVERFLOW_LABEL"]
+
+# the reserved label set every over-cap series of a family folds into
+# (FLAGS_metrics_max_series cardinality guard)
+OVERFLOW_LABEL = "__overflow__"
 
 # default histogram bucket ladder: 1/2/5 per decade over 1e-3 .. 1e5 —
 # covers sub-microsecond spans (ms units) through multi-minute step times
@@ -155,13 +162,35 @@ def _series_name(name: str, labels) -> str:
 
 class MetricRegistry:
     """Name → labeled-series map.  Lookup of an existing series is one
-    plain dict get (no lock); creation is double-checked under the lock."""
+    plain dict get (no lock); creation is double-checked under the lock.
+
+    Cardinality guard (ISSUE 6 satellite): at most
+    ``FLAGS_metrics_max_series`` LABELED series per (kind, family) — a
+    serving process labelling by tenant/model/route cannot grow the
+    registry without bound.  Once a family hits the cap, every further
+    label set resolves to that family's single
+    ``{series=__overflow__}`` series and ``metrics.dropped_series`` is
+    bumped (per overflowing lookup-miss; hot paths cache handles, so
+    steady state bumps once per would-be series).  The unlabeled base
+    series and the overflow series itself never count toward the cap.
+    """
 
     def __init__(self):
         self._counters: Dict[tuple, Counter] = {}
         self._gauges: Dict[tuple, Gauge] = {}
         self._histograms: Dict[tuple, Histogram] = {}
-        self._lock = threading.Lock()
+        self._nlabeled: Dict[tuple, int] = {}   # (kind, family) -> count
+        self._help: Dict[str, str] = {}
+        # reentrant: a SIGTERM handler (flight-recorder dump ->
+        # snapshot()) can interrupt a main-thread frame already holding
+        # the lock (a /metrics scrape mid-export) — a plain Lock would
+        # deadlock the shutdown path
+        self._lock = threading.RLock()
+        # created directly (not via counter()): _get must be able to bump
+        # it while already holding the non-reentrant registry lock
+        self._dropped = Counter("metrics.dropped_series")
+        self._counters[_series_key("metrics.dropped_series", {})] = \
+            self._dropped
 
     def _get(self, table, cls, name, labels, **kw):
         key = _series_key(name, labels)
@@ -170,9 +199,28 @@ class MetricRegistry:
             with self._lock:
                 m = table.get(key)
                 if m is None:
+                    fam = (cls.__name__, name)
+                    cap = int(flags.flag("metrics_max_series"))
+                    if key[1] and cap > 0 \
+                            and self._nlabeled.get(fam, 0) >= cap:
+                        # family at the cap: fold into the overflow series
+                        okey = (name, (("series", OVERFLOW_LABEL),))
+                        m = table.get(okey)
+                        if m is None:
+                            m = cls(name, okey[1], **kw)
+                            table[okey] = m
+                        self._dropped.inc()
+                        return m
                     m = cls(name, key[1], **kw)
                     table[key] = m
+                    if key[1] and key[1] != (("series", OVERFLOW_LABEL),):
+                        self._nlabeled[fam] = self._nlabeled.get(fam, 0) + 1
         return m
+
+    def set_help(self, name: str, text: str) -> None:
+        """Attach a ``# HELP`` line to a metric family (optional; the
+        exposition falls back to the family's dotted name)."""
+        self._help[name] = text
 
     def counter(self, name: str, **labels) -> Counter:
         return self._get(self._counters, Counter, name, labels)
@@ -219,50 +267,100 @@ class MetricRegistry:
         ``[le, count]`` buckets."""
         out: Dict[str, dict] = {"counters": {}, "gauges": {},
                                 "histograms": {}}
-        for (n, lb), c in list(self._counters.items()):
+        # materialize under the creation lock: exports run concurrently
+        # with series creation (live GET /metrics, flight-recorder dumps)
+        # and dict iteration during insertion raises RuntimeError
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._histograms.items())
+        for (n, lb), c in counters:
             out["counters"][_series_name(n, lb)] = c.value
-        for (n, lb), g in list(self._gauges.items()):
+        for (n, lb), g in gauges:
             out["gauges"][_series_name(n, lb)] = g.value
-        for (n, lb), h in list(self._histograms.items()):
+        for (n, lb), h in hists:
             out["histograms"][_series_name(n, lb)] = {
                 **h.summary(), "buckets": h.nonzero_buckets()}
         return out
 
     def prometheus_text(self, namespace: str = "paddle_tpu") -> str:
-        """Prometheus text exposition of the whole registry."""
+        """Prometheus text exposition of the whole registry, conformant
+        to the line format a strict parser accepts (ISSUE 6 satellite):
+        ``# HELP`` + ``# TYPE`` exactly once per family (help text
+        backslash/newline-escaped), metric and label names sanitized to
+        ``[a-zA-Z_:][a-zA-Z0-9_:]*`` / ``[a-zA-Z_][a-zA-Z0-9_]*``, label
+        VALUES escaped (backslash, double-quote, newline), histograms as
+        cumulative ``_bucket{le=...}`` ladders ending at ``le="+Inf"``
+        (== ``_count``) plus ``_sum``/``_count``."""
         def sane(name):
-            return (namespace + "_" + name).replace(".", "_").replace(
-                "-", "_")
+            return re.sub(r"[^a-zA-Z0-9_:]", "_", namespace + "_" + name)
+
+        def sane_label(name):
+            return re.sub(r"[^a-zA-Z0-9_]", "_", name)
 
         def esc(v):
-            # exposition-format label escaping: \ " and newline
+            # exposition-format label-value escaping: \ " and newline
             return str(v).replace("\\", "\\\\").replace('"', '\\"') \
                 .replace("\n", "\\n")
+
+        def esc_help(v):
+            return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
         def lbl(labels, extra=()):
             items = tuple(labels) + tuple(extra)
             if not items:
                 return ""
-            return "{" + ",".join(f'{k}="{esc(v)}"' for k, v in items) + "}"
+            return "{" + ",".join(
+                f'{sane_label(k)}="{esc(v)}"' for k, v in items) + "}"
+
+        def num(v):
+            if v != v:
+                return "NaN"
+            if v == math.inf:
+                return "+Inf"
+            if v == -math.inf:
+                return "-Inf"
+            return repr(v) if isinstance(v, float) else str(v)
+
+        def families(table):
+            """family name -> sorted [(labels, series)] groups.  Copied
+            under the creation lock: a live /metrics scrape races series
+            creation on other threads."""
+            with self._lock:
+                items = sorted(table.items())
+            fams: Dict[str, list] = {}
+            for (n, lb), m in items:
+                fams.setdefault(n, []).append((lb, m))
+            return sorted(fams.items())
 
         lines: List[str] = []
-        for (n, lb), c in sorted(self._counters.items()):
-            lines.append(f"# TYPE {sane(n)} counter")
-            lines.append(f"{sane(n)}{lbl(lb)} {c.value}")
-        for (n, lb), g in sorted(self._gauges.items()):
-            lines.append(f"# TYPE {sane(n)} gauge")
-            lines.append(f"{sane(n)}{lbl(lb)} {g.value}")
-        for (n, lb), h in sorted(self._histograms.items()):
+
+        def head(n, kind):
+            lines.append(
+                f"# HELP {sane(n)} {esc_help(self._help.get(n, n))}")
+            lines.append(f"# TYPE {sane(n)} {kind}")
+
+        for n, group in families(self._counters):
+            head(n, "counter")
+            for lb, c in group:
+                lines.append(f"{sane(n)}{lbl(lb)} {num(c.value)}")
+        for n, group in families(self._gauges):
+            head(n, "gauge")
+            for lb, g in group:
+                lines.append(f"{sane(n)}{lbl(lb)} {num(g.value)}")
+        for n, group in families(self._histograms):
+            head(n, "histogram")
             base = sane(n)
-            lines.append(f"# TYPE {base} histogram")
-            cum = 0
-            for i, cnt in enumerate(h.bucket_counts):
-                cum += cnt
-                le = (f"{h.bounds[i]:g}" if i < len(h.bounds) else "+Inf")
-                lines.append(
-                    f"{base}_bucket{lbl(lb, (('le', le),))} {cum}")
-            lines.append(f"{base}_sum{lbl(lb)} {h.sum}")
-            lines.append(f"{base}_count{lbl(lb)} {h.count}")
+            for lb, h in group:
+                cum = 0
+                for i, cnt in enumerate(h.bucket_counts):
+                    cum += cnt
+                    le = (f"{h.bounds[i]:g}" if i < len(h.bounds)
+                          else "+Inf")
+                    lines.append(
+                        f"{base}_bucket{lbl(lb, (('le', le),))} {cum}")
+                lines.append(f"{base}_sum{lbl(lb)} {num(h.sum)}")
+                lines.append(f"{base}_count{lbl(lb)} {h.count}")
         return "\n".join(lines) + "\n"
 
 
@@ -276,3 +374,4 @@ snapshot = REGISTRY.snapshot
 prometheus_text = REGISTRY.prometheus_text
 reset = REGISTRY.reset
 find = REGISTRY.find
+set_help = REGISTRY.set_help

@@ -25,8 +25,14 @@ import time
 from typing import Dict, List, Optional
 
 from .. import flags
+from . import metrics as _metrics
 
 __all__ = ["Tracer", "TRACER", "device_tracing_available"]
+
+# process-wide visibility for FLAGS_trace_max_events overflow (ISSUE 6
+# satellite): dropping a span is telemetry too — a flat buffer cap no
+# longer hides a tracer that stopped recording mid-run
+_DROPPED_EVENTS = _metrics.counter("tracing.dropped_events")
 
 
 def device_tracing_available() -> bool:
@@ -52,14 +58,19 @@ class Tracer:
     def __init__(self, max_events: Optional[int] = None):
         self._events: List[dict] = []
         self._enabled = False
+        self._active = False
         self._max = max_events
+        self._ring = None           # flight-recorder sink (bounded deque)
         self.dropped = 0
         self._tids: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     @property
     def enabled(self) -> bool:
-        return self._enabled
+        """True when spans are being recorded anywhere — the flat export
+        buffer (``start``) OR an attached flight-recorder ring.  Every
+        instrumentation site gates on this one attribute."""
+        return self._active
 
     # -------------------------------------------------------- lifecycle --
     def start(self, clear: bool = True) -> "Tracer":
@@ -68,11 +79,31 @@ class Tracer:
             self.dropped = 0
             self._tids = {}
         self._enabled = True
+        self._active = True
         return self
 
     def stop(self) -> "Tracer":
         self._enabled = False
+        self._active = self._ring is not None
         return self
+
+    def attach_ring(self, ring) -> None:
+        """Attach a bounded ``deque(maxlen=...)`` that receives EVERY
+        event from now on (even with the flat buffer stopped) — the crash
+        flight recorder's always-on last-N-spans window.  The deque's
+        maxlen is the bound; eviction is free."""
+        self._ring = ring
+        self._active = True
+
+    def detach_ring(self) -> None:
+        self._ring = None
+        self._active = self._enabled
+
+    # a serving process mints one lane per request trace-id: the name->tid
+    # map must be bounded or it (and thread_metadata()) grows forever.
+    # Past the cap, lanes get a stable hashed tid with no stored metadata
+    # (numeric lanes in the viewer — degraded naming, bounded memory).
+    MAX_NAMED_LANES = 8192
 
     # ------------------------------------------------------------ events --
     def _tid(self, tid) -> int:
@@ -87,19 +118,37 @@ class Tracer:
             with self._lock:
                 n = self._tids.get(tid)
                 if n is None:
+                    if len(self._tids) >= self.MAX_NAMED_LANES:
+                        # stable but unnamed; offset clear of stored tids
+                        return (hash(tid) & 0x3FFFFFFF) \
+                            + self.MAX_NAMED_LANES + 1
                     n = len(self._tids) + 1
                     self._tids[tid] = n
-                    self._events.append(
-                        {"ph": "M", "pid": 0, "tid": n,
-                         "name": "thread_name", "args": {"name": tid}})
+                    self._append({"ph": "M", "pid": 0, "tid": n,
+                                  "name": "thread_name",
+                                  "args": {"name": tid}})
         return n
 
+    def thread_metadata(self) -> List[dict]:
+        """Fresh thread_name metadata events for every known lane — the
+        flight recorder prepends these to a ring dump, where the original
+        metadata events may have been evicted."""
+        return [{"ph": "M", "pid": 0, "tid": n, "name": "thread_name",
+                 "args": {"name": name}}
+                for name, n in sorted(self._tids.items(), key=lambda x: x[1])]
+
     def _append(self, ev: dict) -> None:
+        ring = self._ring
+        if ring is not None:
+            ring.append(ev)         # deque(maxlen): bounded, oldest out
+        if not self._enabled:
+            return
         cap = self._max
         if cap is None:
             cap = int(flags.flag("trace_max_events"))
         if cap and len(self._events) >= cap:
             self.dropped += 1
+            _DROPPED_EVENTS.inc()
             return
         self._events.append(ev)
 
@@ -108,7 +157,7 @@ class Tracer:
         """Retroactive complete ("X") event: ``t0``/``dur`` in seconds on
         the perf_counter clock (the serving drain stamps request phases
         from timestamps it recorded at dispatch time)."""
-        if not self._enabled:
+        if not self._active:
             return
         ev = {"ph": "X", "name": name, "cat": cat, "pid": 0,
               "tid": self._tid(tid), "ts": t0 * 1e6,
@@ -121,7 +170,7 @@ class Tracer:
     def span(self, name: str, *, cat: str = "host", tid=None,
              args: Optional[dict] = None):
         """Context-managed live span around host work."""
-        if not self._enabled:
+        if not self._active:
             yield self
             return
         t0 = time.perf_counter()
@@ -133,7 +182,7 @@ class Tracer:
 
     def instant(self, name: str, *, cat: str = "host", tid=None,
                 args: Optional[dict] = None) -> None:
-        if not self._enabled:
+        if not self._active:
             return
         ev = {"ph": "i", "s": "t", "name": name, "cat": cat, "pid": 0,
               "tid": self._tid(tid), "ts": time.perf_counter() * 1e6}
@@ -143,7 +192,7 @@ class Tracer:
 
     def counter(self, name: str, **values) -> None:
         """Chrome counter ("C") track, e.g. queue depth over time."""
-        if not self._enabled:
+        if not self._active:
             return
         self._append({"ph": "C", "name": name, "pid": 0,
                       "ts": time.perf_counter() * 1e6, "args": dict(values)})

@@ -1,0 +1,23 @@
+"""Serving front door (ISSUE 6): a stdlib-only long-lived HTTP process
+over the continuous-batching engine, built as an observability plane —
+OpenAI-compatible streaming ``/v1/completions``, live ``/metrics``
+(Prometheus), ``/healthz`` + ``/statusz``, SLO-burn load shedding off
+the PR 5 latency histograms, per-request trace-context ids, and a crash
+flight recorder (watchdog timeout / SIGTERM / unhandled-crash dumps).
+
+Quickstart::
+
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.serving import serve_forever
+    serve_forever(ContinuousBatchingEngine(model, ...), port=8000)
+
+The HTTP wire format lives in ``serving.http``, admission policy in
+``serving.slo``, the process in ``serving.server``.
+"""
+
+from . import http, slo
+from .server import ServingServer, serve_forever
+from .slo import SLOController
+
+__all__ = ["ServingServer", "SLOController", "serve_forever", "http",
+           "slo"]

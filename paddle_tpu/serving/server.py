@@ -1,0 +1,617 @@
+"""The serving front door: a long-lived asyncio HTTP process over
+``ContinuousBatchingEngine`` with observability as its first-class
+citizen (ISSUE 6 tentpole; ROADMAP "Serving front door").
+
+Architecture — one engine thread, one event loop, a thread-safe seam:
+
+- The **engine thread** owns the ``ContinuousBatchingEngine`` exclusively
+  (the engine is deliberately not thread-safe — its state is device
+  arrays chained between dispatches).  It pulls submissions from a
+  thread-safe inbox, admits them through the engine's existing admission
+  path, runs the fused engine step in a loop, and after each step diffs
+  every live request's ``output`` (which grows at the engine's existing
+  ``sync_every`` drains — streaming granularity IS the drain cadence, no
+  new host<->device syncs) and posts fresh tokens into the owning HTTP
+  connection's asyncio queue via ``loop.call_soon_threadsafe``.
+- The **event loop** parses HTTP, makes the SLO admission decision
+  (``slo.SLOController`` — histogram burn, not queue length), enqueues,
+  and streams Server-Sent Events as token batches arrive.
+
+Endpoints:
+
+- ``POST /v1/completions`` — OpenAI-compatible completion over token ids
+  (``prompt``: list of ints; no tokenizer in-tree, so ``text`` fields
+  carry space-joined ids and ``token_ids`` the raw list).  ``stream``
+  true sends SSE chunks per drain; the response/chunk ``id`` is the
+  request's trace-context id, the SAME id on its engine lifecycle spans.
+- ``GET /metrics`` — live Prometheus exposition of the whole registry.
+- ``GET /healthz`` — liveness (engine thread up).
+- ``GET /statusz`` — engine/pool/prefix-cache gauges, jit cache stats,
+  SLO burn state, flight-recorder state, build/flag info.
+
+Observability wiring: every request carries a trace id from accept
+through retire (one Chrome-trace track), the flight recorder's span ring
+is attached for the server's lifetime with periodic registry snapshots
+folded in from the engine loop, the watchdog watches every engine step
+(a hung device dispatch fires the timeout hook → flight-recorder dump),
+and SIGTERM dumps before shutdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import queue
+import threading
+import time
+from typing import List, Optional
+
+from .. import flags
+from .. import observability as _obs
+from ..observability.flight_recorder import FlightRecorder
+from . import http as _http
+from .slo import SHED, SLOController
+
+__all__ = ["ServingServer", "serve_forever"]
+
+
+class _HttpMetrics:
+    """Registry handles for the HTTP layer, resolved once (the PR 5
+    serving-engine idiom)."""
+
+    __slots__ = ("requests", "streams", "responses", "inflight",
+                 "request_ms")
+
+    def __init__(self):
+        m = _obs.metrics
+        self.requests = m.counter("serving.http.requests")
+        self.streams = m.counter("serving.http.streams")
+        # one labeled series per status code: bounded, guard-safe
+        self.responses = lambda code: m.counter("serving.http.responses",
+                                                code=str(code))
+        self.inflight = m.gauge("serving.http.inflight")
+        self.request_ms = m.histogram("serving.http.request_ms")
+
+
+class _Stream:
+    """Bridge between one HTTP connection (event loop side) and its
+    engine request (engine thread side)."""
+
+    __slots__ = ("trace_id", "prompt", "max_new_tokens", "q", "loop",
+                 "req", "sent", "cancelled", "t_accept")
+
+    def __init__(self, trace_id, prompt, max_new_tokens, loop):
+        self.trace_id = trace_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.q: asyncio.Queue = asyncio.Queue()
+        self.loop = loop
+        self.req = None               # engine Request, set on engine thread
+        self.sent = 0                 # tokens already pushed to the client
+        self.cancelled = False
+        self.t_accept = time.perf_counter()
+
+    def post(self, item) -> None:
+        """Engine thread -> event loop handoff."""
+        if self.cancelled:
+            return
+        try:
+            self.loop.call_soon_threadsafe(self.q.put_nowait, item)
+        except RuntimeError:
+            # the handler's event loop is closed (embedder tore it down
+            # mid-request): stop posting — this must never look like an
+            # engine crash to the engine loop
+            self.cancelled = True
+
+
+class ServingServer:
+    """Long-lived serving process over one ``ContinuousBatchingEngine``.
+
+    The engine must be constructed by the caller (model/pool sizing is
+    workload policy); the server owns its lifecycle from ``start()`` to
+    ``close()``.  ``slo=None`` builds a flag-configured
+    ``SLOController``; ``slo=False`` disables shedding.
+    ``flight_recorder=None`` builds one and attaches its ring (watchdog /
+    SIGTERM / excepthook triggers are wired by ``install_crash_hooks`` or
+    ``serve_forever``, not implicitly — signal handlers belong to the
+    process owner); ``flight_recorder=False`` runs without.
+    """
+
+    def __init__(self, engine, *, model_name: str = "paddle-tpu",
+                 slo=None, flight_recorder=None, watchdog=None,
+                 poll_s: float = 0.02):
+        self.engine = engine
+        self.model_name = model_name
+        self.slo: Optional[SLOController] = \
+            SLOController() if slo is None else (slo or None)
+        self.flight_recorder: Optional[FlightRecorder] = \
+            FlightRecorder() if flight_recorder is None \
+            else (flight_recorder or None)
+        self._watchdog = watchdog     # CommTaskManager or None
+        self._poll_s = poll_s
+        self._inbox: "queue.SimpleQueue[_Stream]" = queue.SimpleQueue()
+        self._live: List[_Stream] = []
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._dead = False            # set BEFORE the final inbox sweep
+        self._t0 = time.perf_counter()
+        self._engine_error: Optional[BaseException] = None
+        self._next_rid = 0
+        self._rid_lock = threading.Lock()
+        self._m = _HttpMetrics()
+        self._asyncio_server = None
+
+    # ------------------------------------------------------- lifecycle --
+    def start(self) -> "ServingServer":
+        """Attach the flight-recorder ring and start the engine thread."""
+        if self._thread is not None:
+            return self
+        if self.flight_recorder is not None:
+            self.flight_recorder.attach()
+        self._stop.clear()
+        self._dead = False
+        self._thread = threading.Thread(target=self._engine_loop,
+                                        name="serving-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30)
+            if t.is_alive():
+                # a hung device step outlived the join: do NOT forget the
+                # thread — start() would spawn a second owner over the
+                # (not thread-safe) engine.  engine_alive() stays True and
+                # start() keeps returning early until it actually exits.
+                import sys
+                print("[paddle_tpu serving] engine thread did not exit "
+                      "within 30s; refusing to forget it", file=sys.stderr)
+            else:
+                self._thread = None
+        if self.flight_recorder is not None:
+            self.flight_recorder.detach()
+
+    def install_crash_hooks(self, **kw) -> None:
+        """Wire the flight recorder's watchdog/SIGTERM/excepthook dump
+        triggers (main-thread serving processes; see FlightRecorder)."""
+        if self.flight_recorder is not None:
+            self.flight_recorder.install(manager=self._watchdog, **kw)
+
+    async def start_http(self, host: str = "127.0.0.1", port: int = 0):
+        """Bind a real socket listener (bench/production path; the tests
+        drive ``handle`` over in-process transports instead).  Returns
+        the bound (host, port)."""
+        self.start()
+        self._asyncio_server = await asyncio.start_server(
+            self.handle, host, port)
+        return self._asyncio_server.sockets[0].getsockname()[:2]
+
+    async def stop_http(self) -> None:
+        if self._asyncio_server is not None:
+            self._asyncio_server.close()
+            await self._asyncio_server.wait_closed()
+            self._asyncio_server = None
+        self.close()
+
+    # ------------------------------------------------------ engine loop --
+    def engine_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _engine_loop(self) -> None:
+        eng = self.engine
+        wd = self._watchdog
+        fr = self.flight_recorder
+        finish = "server_shutdown"
+        flush = False                 # a step ran since the last idle flush
+        try:
+            while not self._stop.is_set():
+                while True:
+                    try:
+                        h = self._inbox.get_nowait()
+                    except queue.Empty:
+                        break
+                    h.req = eng.submit(h.prompt, h.max_new_tokens,
+                                       trace_id=h.trace_id)
+                    self._live.append(h)
+                if eng.has_work():
+                    if wd is not None:
+                        tid = wd.begin("serving.engine_step")
+                        try:
+                            eng.step()
+                        finally:
+                            wd.end(tid)
+                    else:
+                        eng.step()
+                    self._publish()
+                    flush = True
+                else:
+                    if flush:
+                        # one idle step() after the last active one is the
+                        # public tail-drain flush: with no active slots it
+                        # drains any pending window and returns
+                        eng.step()
+                        self._publish()
+                        flush = False
+                    self._wake.wait(self._poll_s)
+                    self._wake.clear()
+                if fr is not None:
+                    fr.maybe_snapshot()
+        except Exception as e:
+            # the engine died mid-serve: THE flight-recorder moment.
+            # Dump, then fall through to retire every waiter — clients
+            # get an 'error' finish instead of hanging forever
+            finish = "error"
+            self._engine_error = e
+            import sys
+            import traceback
+            print(f"[paddle_tpu serving] engine thread died: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            traceback.print_exc()
+            if fr is not None:
+                fr.dump(reason=f"engine-crash-{type(e).__name__}")
+        finally:
+            # retire in-flight streams AND submissions still in the inbox
+            # (enqueued after the last sweep) so no handler hangs.
+            # _dead is set FIRST: a handler that enqueues after this sweep
+            # observes it and retires its own stream (submit-vs-death race)
+            self._dead = True
+            while True:
+                try:
+                    self._live.append(self._inbox.get_nowait())
+                except queue.Empty:
+                    break
+            for h in list(self._live):
+                h.post(("done", {"finish_reason": finish,
+                                 "n": len(h.req.output) if h.req else 0}))
+            self._live.clear()
+
+    def _publish(self) -> None:
+        """Diff every live request's drained output; push fresh tokens."""
+        eos = self.engine.gen_cfg.eos_token_id
+        for h in list(self._live):
+            req = h.req
+            out = req.output
+            if len(out) > h.sent:
+                h.post(("tokens", list(out[h.sent:])))
+                h.sent = len(out)
+            if req.done:
+                reason = "stop" if (eos is not None and out
+                                    and out[-1] == eos) else "length"
+                h.post(("done", {"finish_reason": reason, "n": len(out)}))
+                self._live.remove(h)
+
+    # ---------------------------------------------------------- handler --
+    async def handle(self, reader, writer) -> None:
+        """One HTTP connection (asyncio.start_server signature; equally
+        happy with in-process stream stand-ins)."""
+        t0 = time.perf_counter()
+        status = 500
+        # counted from connection accept so responses{code} never
+        # outruns requests (parse failures are requests too)
+        self._m.requests.inc()
+        self._m.inflight.inc(1)
+        try:
+            try:
+                method, path, headers, body = \
+                    await _http.read_request(reader)
+            except _http.HttpError as e:
+                status = e.status
+                writer.write(_http.error_response(e.status, e.message))
+                await writer.drain()
+                return
+            status = await self._route(method, path, body, writer)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            status = 499              # client went away mid-stream
+        except Exception as e:
+            try:
+                writer.write(_http.error_response(
+                    500, f"{type(e).__name__}: {e}",
+                    err_type="internal_error"))
+                await writer.drain()
+            except Exception:
+                pass
+        finally:
+            self._m.inflight.inc(-1)
+            self._m.responses(status).inc()
+            self._m.request_ms.observe((time.perf_counter() - t0) * 1e3)
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _route(self, method, path, body, writer) -> int:
+        path = path.split("?", 1)[0]
+        if path == "/metrics" and method == "GET":
+            text = _obs.prometheus_text().encode()
+            writer.write(_http.response(
+                200, text, content_type="text/plain; version=0.0.4"))
+            await writer.drain()
+            return 200
+        if path == "/healthz" and method == "GET":
+            alive = self.engine_alive()
+            writer.write(_http.json_response(
+                200 if alive else 503,
+                {"status": "ok" if alive else "engine thread down"}))
+            await writer.drain()
+            return 200 if alive else 503
+        if path == "/statusz" and method == "GET":
+            writer.write(_http.json_response(200, self.statusz()))
+            await writer.drain()
+            return 200
+        if path == "/v1/completions" and method == "POST":
+            return await self._completions(body, writer)
+        if path in ("/metrics", "/healthz", "/statusz", "/v1/completions"):
+            writer.write(_http.error_response(405, f"{method} not allowed"))
+            await writer.drain()
+            return 405
+        writer.write(_http.error_response(404, f"no route {path}"))
+        await writer.drain()
+        return 404
+
+    # ------------------------------------------------------ completions --
+    def _parse_prompt(self, p) -> List[int]:
+        if isinstance(p, str):
+            try:
+                p = [int(t) for t in p.split()]
+            except ValueError:
+                raise _http.HttpError(
+                    400, "string prompts must be space-separated token ids "
+                         "(no tokenizer in-tree)")
+        if not isinstance(p, list) or not p or \
+                not all(isinstance(t, int) and not isinstance(t, bool)
+                        for t in p):
+            raise _http.HttpError(
+                400, "prompt must be a non-empty list of token ids")
+        vocab = self.engine.g.config.vocab_size
+        if not all(0 <= t < vocab for t in p):
+            # out-of-range ids would be silently clamped by the embedding
+            # gather and return plausible-looking garbage
+            raise _http.HttpError(
+                400, f"token ids must be in [0, {vocab})")
+        return p
+
+    def _trace_id(self) -> str:
+        with self._rid_lock:
+            n = self._next_rid
+            self._next_rid += 1
+        return f"cmpl-{os.getpid():x}-{n:06x}-{os.urandom(4).hex()}"
+
+    async def _completions(self, body, writer) -> int:
+        try:
+            payload = json.loads(body.decode() or "{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as e:
+            writer.write(_http.error_response(400, f"bad JSON body: {e}"))
+            await writer.drain()
+            return 400
+        try:
+            prompt = self._parse_prompt(payload.get("prompt"))
+        except _http.HttpError as e:
+            writer.write(_http.error_response(e.status, e.message))
+            await writer.drain()
+            return e.status
+        max_tokens = payload.get("max_tokens",
+                                 self.engine.gen_cfg.max_new_tokens)
+        if not isinstance(max_tokens, int) or isinstance(max_tokens, bool) \
+                or max_tokens < 1:
+            writer.write(_http.error_response(
+                400, "max_tokens must be a positive integer"))
+            await writer.drain()
+            return 400
+        # a prompt whose page demand exceeds the whole KV pool would raise
+        # MemoryError inside engine admission and kill the engine thread —
+        # reject it here instead (admission truncates to max_seq_len-1, so
+        # the truncated length is the demand that matters)
+        g = self.engine.g
+        need = -(-min(len(prompt), g.max_seq_len - 1) // g.page_size)
+        if need > g.num_pages:
+            writer.write(_http.error_response(
+                413, f"prompt needs {need} KV pages but the pool only has "
+                     f"{g.num_pages}"))
+            await writer.drain()
+            return 413
+        stream = bool(payload.get("stream", False))
+
+        if not self.engine_alive():
+            # the engine thread is down (crashed or closed): refuse
+            # rather than enqueue into a dead inbox
+            why = (f": {type(self._engine_error).__name__}"
+                   if self._engine_error is not None else "")
+            writer.write(_http.error_response(
+                503, f"engine thread down{why}",
+                err_type="internal_error"))
+            await writer.drain()
+            return 503
+
+        # SLO-driven admission: histogram burn, not queue length
+        if self.slo is not None and self.slo.decide() == SHED:
+            writer.write(_http.error_response(
+                503, "shedding load: serving latency SLO burn "
+                     f"(see /statusz)", err_type="overloaded_error",
+                extra_headers=(("Retry-After", "1"),)))
+            await writer.drain()
+            return 503
+
+        trace_id = self._trace_id()
+        h = _Stream(trace_id, prompt, max_tokens,
+                    asyncio.get_running_loop())
+        self._inbox.put(h)
+        self._wake.set()
+        if self._dead:
+            # the engine exited between the liveness check and the put:
+            # its final sweep may have missed this submission, so retire
+            # it here (a double 'done' is harmless — first one wins)
+            h.post(("done", {"finish_reason": "error"
+                             if self._engine_error else "server_shutdown",
+                             "n": 0}))
+        try:
+            if stream:
+                self._m.streams.inc()
+                code = await self._stream_response(h, writer)
+            else:
+                code = await self._unary_response(h, writer)
+        except BaseException:
+            # CancelledError (caller timeout / loop teardown) included:
+            # nobody is reading this queue any more — stop posting to it
+            h.cancelled = True
+            raise
+        if _obs.TRACER.enabled:
+            _obs.TRACER.event("http.request", h.t_accept,
+                              time.perf_counter() - h.t_accept,
+                              cat="serving", tid=trace_id,
+                              args={"trace_id": trace_id,
+                                    "stream": stream,
+                                    "prompt_tokens": len(prompt)})
+        return code
+
+    def _chunk(self, h: _Stream, token_ids, finish_reason=None) -> dict:
+        return {"id": h.trace_id, "object": "text_completion.chunk",
+                "model": self.model_name,
+                "choices": [{"index": 0,
+                             "text": " ".join(str(t) for t in token_ids),
+                             "token_ids": list(token_ids),
+                             "finish_reason": finish_reason}]}
+
+    async def _stream_response(self, h: _Stream, writer) -> int:
+        writer.write(_http.sse_headers(
+            extra_headers=(("X-Request-Id", h.trace_id),)))
+        await writer.drain()
+        # the response head is out: from here NO error document may be
+        # written into the event stream — failures terminate it and are
+        # reported by status code only
+        try:
+            while True:
+                kind, payload = await h.q.get()
+                if kind == "tokens":
+                    writer.write(_http.sse_event(self._chunk(h, payload)))
+                    await writer.drain()
+                else:
+                    writer.write(_http.sse_event(self._chunk(
+                        h, (), finish_reason=payload["finish_reason"])))
+                    writer.write(_http.sse_done())
+                    await writer.drain()
+                    return 200
+        except (ConnectionError, RuntimeError,
+                asyncio.IncompleteReadError):
+            # client disconnected: stop posting; the engine finishes the
+            # request (continuous batching has no cheap mid-flight cancel)
+            h.cancelled = True
+            return 499
+        except Exception as e:
+            h.cancelled = True
+            import sys
+            print(f"[paddle_tpu serving] stream {h.trace_id} failed "
+                  f"mid-flight: {type(e).__name__}: {e}", file=sys.stderr)
+            return 500
+
+    async def _unary_response(self, h: _Stream, writer) -> int:
+        toks: List[int] = []
+        while True:
+            kind, payload = await h.q.get()
+            if kind == "tokens":
+                toks.extend(payload)
+            else:
+                finish = payload["finish_reason"]
+                break
+        if finish in ("error", "server_shutdown"):
+            # the engine died (or shut down) before this request finished:
+            # headers are not out yet on the unary path, so report it as
+            # the failure it is instead of a 200 with finish='error'
+            writer.write(_http.error_response(
+                503, f"engine {finish} before the request completed",
+                err_type="internal_error",
+                extra_headers=(("X-Request-Id", h.trace_id),)))
+            await writer.drain()
+            return 503
+        out = {"id": h.trace_id, "object": "text_completion",
+               "model": self.model_name,
+               "choices": [{"index": 0,
+                            "text": " ".join(str(t) for t in toks),
+                            "token_ids": toks,
+                            "finish_reason": finish}],
+               "usage": {"prompt_tokens": len(h.prompt),
+                         "completion_tokens": len(toks),
+                         "total_tokens": len(h.prompt) + len(toks)}}
+        writer.write(_http.json_response(
+            200, out, extra_headers=(("X-Request-Id", h.trace_id),)))
+        await writer.drain()
+        return 200
+
+    # ----------------------------------------------------------- status --
+    def statusz(self) -> dict:
+        """Everything a human (or scraper) needs to know the process is
+        sane: engine/pool/prefix gauges, jit cache stats, SLO burn,
+        flight recorder, build/flag info."""
+        import sys
+
+        import jax
+
+        from .. import jit as _jit
+        eng = self.engine
+        out = {
+            "uptime_s": round(time.perf_counter() - self._t0, 3),
+            "model": self.model_name,
+            "engine": {
+                **eng.last_stats,
+                "waiting": len(eng.waiting),
+                "slots_busy": sum(r is not None for r in eng.slot_req),
+                "slots": eng.B,
+                "streams_live": len(self._live),
+            },
+            "slo": self.slo.state() if self.slo is not None else None,
+            "flight_recorder": None,
+            "jit_cache": _jit.cache_stats(),
+            "build": {
+                "jax": jax.__version__,
+                "backend": jax.default_backend(),
+                "python": sys.version.split()[0],
+                "pid": os.getpid(),
+            },
+            "flags": flags.get_flags(),
+        }
+        fr = self.flight_recorder
+        if fr is not None:
+            out["flight_recorder"] = {
+                "ring_events": len(fr._ring),
+                "ring_capacity": fr.max_events,
+                "last_dump": fr.last_dump,
+                "dumps": int(_obs.metrics.counter(
+                    "flight_recorder.dumps").value),
+            }
+        return out
+
+
+async def _serve_async(server: ServingServer, host: str, port: int):
+    bound = await server.start_http(host, port)
+    print(f"[paddle_tpu serving] listening on http://{bound[0]}:{bound[1]}"
+          f"  (/v1/completions, /metrics, /healthz, /statusz)")
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await server.stop_http()
+
+
+def serve_forever(engine, *, host: str = "127.0.0.1", port: int = 8000,
+                  **kw) -> None:
+    """Blocking convenience entry: build the server, wire crash hooks
+    (watchdog + SIGTERM + excepthook flight-recorder dumps), serve until
+    killed."""
+    from ..distributed.watchdog import get_comm_task_manager
+    kw.setdefault("watchdog", get_comm_task_manager())
+    server = ServingServer(engine, **kw)
+    server.start()
+    server.install_crash_hooks()
+    try:
+        asyncio.run(_serve_async(server, host, port))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()

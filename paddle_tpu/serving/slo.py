@@ -1,0 +1,158 @@
+"""SLO-driven admission control and load shedding for the HTTP front door.
+
+The ROADMAP requirement verbatim: "Admission control and load-shedding
+should read the PR 5 registry directly — reject/queue on TTFT/ITL
+histogram SLOs, not queue length."  Queue length is a proxy that lies in
+both directions (a deep queue of tiny requests is fine; a shallow queue
+behind a hung prefill is not); the histograms ARE the user experience.
+
+Mechanics: the controller watches the ``serving.ttft_ms`` and
+``serving.itl_ms`` histograms the engine already records at its drains.
+Over a rolling window of the last ``FLAGS_serving_slo_window``
+observations (tracked as deltas against a per-histogram base snapshot —
+O(1) per decision, no sample buffer) it computes the violation rate: the
+fraction of observations whose latency bucket lies above the SLO target
+(``FLAGS_serving_slo_ttft_ms`` / ``_itl_ms``).  With a violation budget
+of ``1 - FLAGS_serving_slo_quantile`` (e.g. 5% for a p95 SLO):
+
+- rate <= budget                → **admit** (healthy)
+- budget < rate <= burn*budget  → **queue** (admitted, counted as at-risk
+  — the engine's waiting queue absorbs it; dashboards see the burn start)
+- rate > burn*budget            → **shed** (the HTTP layer 503s with
+  Retry-After; the engine never sees the request)
+
+Every decision increments ``serving.http.slo_decision{decision=...}``;
+sheds additionally bump the flat ``serving.http.shed`` counter the bench
+stamps into results.  Cold start (fewer than
+``FLAGS_serving_slo_min_samples`` fresh observations) always admits.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from .. import flags
+from ..observability import metrics as _metrics
+
+__all__ = ["SLOController"]
+
+ADMIT, QUEUE, SHED = "admit", "queue", "shed"
+
+
+def _over_target(h, target: float) -> int:
+    """Observations in buckets wholly above ``target``: counts of every
+    bucket whose LOWER edge is >= target (conservative — the bucket
+    straddling the target is counted as meeting it)."""
+    bad = 0
+    counts = list(h.bucket_counts)
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        lo = h.bounds[i - 1] if i > 0 else 0.0
+        if lo >= target:
+            bad += c
+    return bad
+
+
+class SLOController:
+    """Burn-rate admission decisions off the live serving histograms.
+
+    Construction resolves every registry handle once; ``decide()`` is a
+    handful of integer reads per call — cheap enough for the per-request
+    HTTP path.  All thresholds default from flags so a serving process is
+    tunable by env (``FLAGS_serving_slo_*``) without code."""
+
+    def __init__(self, *, ttft_ms: Optional[float] = None,
+                 itl_ms: Optional[float] = None,
+                 quantile: Optional[float] = None,
+                 burn: Optional[float] = None,
+                 min_samples: Optional[int] = None,
+                 window: Optional[int] = None):
+        f = flags.flag
+        self.ttft_ms = float(f("serving_slo_ttft_ms")
+                             if ttft_ms is None else ttft_ms)
+        self.itl_ms = float(f("serving_slo_itl_ms")
+                            if itl_ms is None else itl_ms)
+        self.quantile = float(f("serving_slo_quantile")
+                              if quantile is None else quantile)
+        self.burn = float(f("serving_slo_burn") if burn is None else burn)
+        self.min_samples = int(f("serving_slo_min_samples")
+                               if min_samples is None else min_samples)
+        self.window = int(f("serving_slo_window")
+                          if window is None else window)
+        self._hists = {
+            "ttft": (_metrics.histogram("serving.ttft_ms"), self.ttft_ms),
+            "itl": (_metrics.histogram("serving.itl_ms"), self.itl_ms),
+        }
+        # per-term window base: (count, over-target count) at last rebase,
+        # plus the completed previous window's (n, bad) — burn is computed
+        # over previous + current so a rebase never zeroes the evidence
+        # (without the carry, sustained overload would flap back to admit
+        # for min_samples observations after every rebase)
+        self._base: Dict[str, Tuple[int, int]] = {
+            k: (0, 0) for k in self._hists}
+        self._prev: Dict[str, Tuple[int, int]] = {
+            k: (0, 0) for k in self._hists}
+        self._decisions = {
+            d: _metrics.counter("serving.http.slo_decision", decision=d)
+            for d in (ADMIT, QUEUE, SHED)}
+        self._shed = _metrics.counter("serving.http.shed")
+        self.last: Dict[str, dict] = {}
+
+    # ------------------------------------------------------------ burn --
+    def burn_rates(self) -> Dict[str, dict]:
+        """Current-window violation rate per SLO term (also the /statusz
+        payload).  Rebases a term's window once it accumulates
+        ``window`` fresh observations."""
+        out: Dict[str, dict] = {}
+        for name, (h, target) in self._hists.items():
+            if target <= 0:
+                continue
+            cnt, bad = h.count, _over_target(h, target)
+            b_cnt, b_bad = self._base[name]
+            if cnt < b_cnt:             # histogram was reset under us
+                self._base[name] = (0, 0)
+                self._prev[name] = (0, 0)
+                b_cnt = b_bad = 0
+            dc, db = cnt - b_cnt, bad - b_bad
+            if dc >= self.window:
+                self._prev[name] = (dc, db)
+                self._base[name] = (cnt, bad)
+                dc = db = 0             # current window restarts empty
+            pc, pb = self._prev[name]
+            n, nbad = dc + pc, db + pb  # previous + current window
+            rate = (nbad / n) if n > 0 else 0.0
+            out[name] = {"target_ms": target, "window_n": n,
+                         "violation_rate": round(rate, 4),
+                         "active": n >= self.min_samples}
+        self.last = out
+        return out
+
+    def decide(self, record: bool = True) -> str:
+        """One admission decision: ``"admit"`` / ``"queue"`` / ``"shed"``,
+        counted in the registry unless ``record=False``."""
+        budget = max(1.0 - self.quantile, 1e-9)
+        worst = 0.0
+        for term in self.burn_rates().values():
+            if term["active"]:
+                worst = max(worst, term["violation_rate"])
+        if worst > self.burn * budget:
+            decision = SHED
+        elif worst > budget:
+            decision = QUEUE
+        else:
+            decision = ADMIT
+        if record:
+            self._decisions[decision].inc()
+            if decision == SHED:
+                self._shed.inc()
+        return decision
+
+    def state(self) -> dict:
+        """Config + live burn view for /statusz."""
+        return {"ttft_ms": self.ttft_ms, "itl_ms": self.itl_ms,
+                "quantile": self.quantile, "burn": self.burn,
+                "min_samples": self.min_samples, "window": self.window,
+                "violation_budget": round(max(1.0 - self.quantile, 0.0), 4),
+                "terms": self.burn_rates(),
+                "shed_total": int(self._shed.value)}

@@ -119,6 +119,219 @@ def test_tracer_event_cap():
     assert len(tr._events) == 3 and tr.dropped == 3
 
 
+def test_tracer_cap_drops_counted_in_registry():
+    """ISSUE 6 satellite: hitting FLAGS_trace_max_events is no longer a
+    silent drop — every dropped event bumps tracing.dropped_events, so a
+    /metrics scrape shows a tracer that stopped recording mid-run."""
+    ctr = obs.metrics.counter("tracing.dropped_events")
+    before = ctr.value
+    tr = obs.Tracer(max_events=2)
+    tr.start()
+    for i in range(7):
+        tr.instant(f"d{i}")
+    assert tr.dropped == 5
+    assert ctr.value == before + 5
+
+
+def test_tracer_ring_records_while_stopped():
+    """The flight-recorder seam: an attached bounded ring receives every
+    event even with the flat export buffer stopped, and the deque bound
+    caps memory."""
+    from collections import deque
+    ring = deque(maxlen=3)
+    tr = obs.Tracer()
+    assert not tr.enabled
+    tr.attach_ring(ring)
+    assert tr.enabled                    # ring-only recording is "on"
+    for i in range(6):
+        tr.instant(f"r{i}")
+    assert tr._events == []              # flat buffer untouched
+    assert [e["name"] for e in ring] == ["r3", "r4", "r5"]
+    tr.detach_ring()
+    assert not tr.enabled
+    tr.instant("after")
+    assert len(ring) == 3                # nothing recorded after detach
+
+
+# ---------------------------------------------------------------------------
+# cardinality guard (ISSUE 6 satellite: FLAGS_metrics_max_series)
+# ---------------------------------------------------------------------------
+
+def test_metric_registry_cardinality_guard():
+    old = flags.get_flags(["metrics_max_series"])
+    flags.set_flags({"metrics_max_series": 4})
+    try:
+        dropped = obs.metrics.counter("metrics.dropped_series")
+        d0 = dropped.value
+        series = [obs.metrics.counter("t9cap.reqs", tenant=f"t{i}")
+                  for i in range(10)]
+        # first 4 label sets are real series; the rest fold into ONE
+        # __overflow__ series instead of growing the registry
+        assert len({id(s) for s in series}) == 5
+        overflow = series[-1]
+        assert overflow is series[4]
+        assert dict(overflow.labels) == {"series": "__overflow__"}
+        assert dropped.value == d0 + 6
+        # the overflow series still records (folded, not lost)
+        for s in series:
+            s.inc()
+        assert overflow.value == 6
+        snap = obs.snapshot()
+        assert "t9cap.reqs{series=__overflow__}" in snap["counters"]
+        assert sum(1 for k in snap["counters"]
+                   if k.startswith("t9cap.reqs{")) == 5
+        # unlabeled base series and repeat lookups of existing labeled
+        # series are never capped
+        assert obs.metrics.counter("t9cap.reqs") is not overflow
+        assert obs.metrics.counter("t9cap.reqs", tenant="t0") is series[0]
+        # histograms guard independently per (kind, family)
+        hs = [obs.metrics.histogram("t9cap.lat_ms", tenant=f"t{i}")
+              for i in range(6)]
+        assert len({id(h) for h in hs}) == 5
+        hs[-1].observe(1.0)
+        assert obs.metrics.histogram(
+            "t9cap.lat_ms", tenant="t99").count == 1   # same overflow series
+    finally:
+        flags.set_flags(old)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition conformance (ISSUE 6 satellite): a strict
+# line-format parser accepts the whole registry's output
+# ---------------------------------------------------------------------------
+
+_PROM_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_PROM_VALUE = r"(?:[-+]?[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|[+-]Inf|NaN)"
+
+
+def _parse_prom_labels(s):
+    """Strict label-body scan: k="v" pairs, values may contain escaped
+    backslash / quote / newline and nothing raw."""
+    import re
+    labels = {}
+    i = 0
+    while i < len(s):
+        m = re.match(r"[a-zA-Z_][a-zA-Z0-9_]*", s[i:])
+        assert m, f"bad label name at {s[i:]!r}"
+        k = m.group(0)
+        i += len(k)
+        assert s[i] == "=" and s[i + 1] == '"', f"bad label syntax {s!r}"
+        i += 2
+        v = []
+        while True:
+            c = s[i]
+            if c == "\\":
+                nxt = s[i + 1]
+                assert nxt in ("\\", '"', "n"), f"bad escape \\{nxt}"
+                v.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+                i += 2
+            elif c == '"':
+                i += 1
+                break
+            else:
+                assert c != "\n", "raw newline in label value"
+                v.append(c)
+                i += 1
+        labels[k] = "".join(v)
+        if i < len(s):
+            assert s[i] == ",", f"expected ',' at {s[i:]!r}"
+            i += 1
+    return labels
+
+
+def parse_prometheus(text):
+    """Strict exposition-format parser: HELP then TYPE exactly once per
+    family, every sample belongs to the most recent family, histogram
+    ladders are cumulative and end at le="+Inf" == _count.  Returns
+    {family: {"type", "help", "samples": [(name, labels, value)]}}."""
+    import re
+    assert text.endswith("\n"), "exposition must end with a newline"
+    families, cur = {}, None
+    for ln in text.splitlines():
+        if ln.startswith("# HELP "):
+            m = re.fullmatch(rf"# HELP ({_PROM_NAME}) (.*)", ln)
+            assert m, f"bad HELP line: {ln!r}"
+            name = m.group(1)
+            assert name not in families, f"duplicate family {name}"
+            families[name] = {"help": m.group(2), "type": None,
+                              "samples": []}
+            cur = name
+        elif ln.startswith("# TYPE "):
+            m = re.fullmatch(
+                rf"# TYPE ({_PROM_NAME}) "
+                r"(counter|gauge|histogram|summary|untyped)", ln)
+            assert m, f"bad TYPE line: {ln!r}"
+            assert m.group(1) == cur, "TYPE must follow its HELP"
+            assert families[cur]["type"] is None, "duplicate TYPE"
+            families[cur]["type"] = m.group(2)
+        elif ln.startswith("#"):
+            continue
+        else:
+            m = re.fullmatch(
+                rf"({_PROM_NAME})(?:\{{(.*)\}})? ({_PROM_VALUE})", ln)
+            assert m, f"bad sample line: {ln!r}"
+            name = m.group(1)
+            labels = _parse_prom_labels(m.group(2)) if m.group(2) else {}
+            fam = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and name[:-len(suffix)] == cur:
+                    fam = cur
+            assert fam == cur, f"sample {name} outside its family group"
+            families[fam]["samples"].append((name, labels, m.group(3)))
+    for name, fam in families.items():
+        assert fam["type"] is not None, f"family {name} missing TYPE"
+        if fam["type"] != "histogram":
+            continue
+        groups = {}
+        for sname, labels, value in fam["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            groups.setdefault(key, {"buckets": [], "sum": None,
+                                    "count": None})
+            g = groups[key]
+            if sname == name + "_bucket":
+                g["buckets"].append((labels["le"], float(value)))
+            elif sname == name + "_sum":
+                g["sum"] = float(value)
+            elif sname == name + "_count":
+                g["count"] = float(value)
+        for key, g in groups.items():
+            assert g["sum"] is not None and g["count"] is not None
+            les = [le for le, _ in g["buckets"]]
+            assert les[-1] == "+Inf", "ladder must end at +Inf"
+            bounds = [float(le) for le in les[:-1]]
+            assert bounds == sorted(bounds), "le bounds must ascend"
+            cums = [c for _, c in g["buckets"]]
+            assert cums == sorted(cums), "bucket counts must be cumulative"
+            assert cums[-1] == g["count"], "+Inf bucket != _count"
+    return families
+
+
+def test_prometheus_exposition_conformance():
+    """Golden conformance: awkward label values round-trip through the
+    escaper, HELP/TYPE emitted once per family, and the ENTIRE process
+    registry (every series every test has created) parses strictly."""
+    awkward = 'a"b\\c\nd,e={}'
+    obs.metrics.counter("t9conf.reqs_total", path=awkward).inc(3)
+    obs.metrics.gauge("t9conf.depth").set(2.5)
+    h = obs.metrics.histogram("t9conf.lat_ms")
+    for v in (0.5, 3.0, 7000.0):
+        h.observe(v)
+    obs.metrics.set_help("t9conf.reqs_total", "requests\nby path\\slash")
+    fams = parse_prometheus(obs.prometheus_text())
+    fam = fams["paddle_tpu_t9conf_reqs_total"]
+    assert fam["type"] == "counter"
+    assert fam["help"] == "requests\\nby path\\\\slash"   # escaped once
+    (name, labels, value), = fam["samples"]
+    assert labels == {"path": awkward} and value == "3"   # round-trip
+    assert fams["paddle_tpu_t9conf_depth"]["type"] == "gauge"
+    hist = fams["paddle_tpu_t9conf_lat_ms"]
+    assert hist["type"] == "histogram"
+    counts = [s for s in hist["samples"]
+              if s[0] == "paddle_tpu_t9conf_lat_ms_count"]
+    assert counts[0][2] == "3"
+
+
 # ---------------------------------------------------------------------------
 # assert_overhead — the generalized warm-path contract
 # ---------------------------------------------------------------------------
