@@ -4,7 +4,8 @@ in benchmarks/ of this repo with per-config JSON results").
 Usage:
     python benchmarks/run.py [config ...] [--cpu] [--fused-gather=0|1]
                              [--trace=PATH]
-configs: resnet gpt2 llama dit moe decode serve http_serve all (default: all)
+configs: resnet gpt2 llama dit moe decode serve http_serve router_serve
+         all (default: all)
 
 --fused-gather pins FLAGS_grouped_matmul_fused_gather for the run (A/B of
 the in-kernel MoE dispatch gather; the =0 arm writes <config>_nofuse.json).
@@ -285,6 +286,19 @@ def run_serve():
             **bench._run_serve_metrics(_on_tpu())}
 
 
+def run_router_serve():
+    """ISSUE 7: multi-replica router A/B (`python benchmarks/run.py
+    router_serve --cpu`) — two serving replicas (prefix cache on) behind
+    the RouterServer on the 50%-shared mix: prefix-aware scored
+    placement (residency digest + session/overlay affinity) vs
+    round-robin.  Stamps both arms' tok/s, fleet prefix hit rate,
+    tokens saved, per-replica hit split, warm-compile and failover
+    counters into results/router_serve.json; outputs must bit-match
+    across arms (greedy placement-invariance)."""
+    import bench
+    return {"config": "router_serve", **bench._run_router_serve(_on_tpu())}
+
+
 def run_http_serve():
     """ISSUE 6: HTTP front door A/B (`python benchmarks/run.py http_serve
     --cpu`) — concurrent streaming clients against the real-socket
@@ -303,7 +317,7 @@ CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
            "dit": run_dit, "moe": run_moe, "decode": run_decode,
            "longctx": run_longctx, "grad_comm": run_grad_comm,
            "serve_prefix": run_serve_prefix, "serve": run_serve,
-           "http_serve": run_http_serve}
+           "http_serve": run_http_serve, "router_serve": run_router_serve}
 
 
 def _supervise(names, timeout):

@@ -139,6 +139,41 @@ define_flag("serving_slo_min_samples", 64,
 define_flag("serving_slo_window", 512,
             "Observations per SLO decision window: burn is computed over "
             "deltas since the window base, rebased every this-many.")
+define_flag("router_placement", "scored",
+            "Multi-replica router placement policy (paddle_tpu/router/): "
+            "'scored' = expected prefix-hit pages (residency digest) minus "
+            "load, with session affinity; 'round_robin' = naive rotation, "
+            "no affinity (the A/B baseline arm).")
+define_flag("router_health_interval_s", 2.0,
+            "Seconds between router health polls of each replica "
+            "(/healthz + /readyz + /statusz); consecutive failures back "
+            "the poll off exponentially up to 8x this interval.")
+define_flag("router_dead_after", 3,
+            "Consecutive failed health polls before the router marks a "
+            "replica dead (new traffic re-routes; polling continues so a "
+            "recovered replica rejoins).")
+define_flag("router_poll_timeout_s", 5.0,
+            "Per-request timeout for router health polls, the connect "
+            "phase of proxied completions, and a STREAMING completion's "
+            "response head (written at admission, so slower means the "
+            "replica is wedged); a unary head waits out generation "
+            "unbounded.")
+define_flag("router_digest_max", 4096,
+            "Cap on prefix-residency digest entries a replica advertises "
+            "via /statusz (breadth-first from the radix root, so a "
+            "truncated digest keeps the leading pages placement scores).")
+define_flag("router_session_cap", 4096,
+            "Max tracked session-affinity pins in the router (LRU "
+            "eviction; an evicted session is re-placed by score, which "
+            "the residency digest steers back to its page-holding "
+            "replica).")
+define_flag("router_hit_weight", 1.0,
+            "Placement score weight per expected prefix-hit TOKEN "
+            "(digest match x page_size).")
+define_flag("router_load_weight", 1.0,
+            "Placement score penalty weight per queued/busy request on a "
+            "replica, in page_size token units (one queued request "
+            "offsets one cached page at 1.0).")
 define_flag("flight_recorder_events", 4096,
             "Bounded ring of recent trace spans kept by the crash flight "
             "recorder (observability/flight_recorder.py); the ring is "

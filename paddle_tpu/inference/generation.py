@@ -822,6 +822,21 @@ class ContinuousBatchingEngine:
             s["prefix_evictable_pages"] = self.prefix_cache.evictable_pages()
         return s
 
+    def prefix_digest(self, max_entries: Optional[int] = None):
+        """Prefix-residency digest for router placement (ISSUE 7): the
+        chain hashes of this engine's indexed KV pages plus the page
+        geometry a router needs to compute matching hashes for an
+        incoming prompt (``prefix_cache.block_hashes``).  ``None`` with
+        the cache off — a digest-less replica scores zero expected hits
+        and degrades to pure load-based placement."""
+        if self.prefix_cache is None:
+            return None
+        if max_entries is None:
+            max_entries = flags.flag("router_digest_max")
+        return {"page_size": self.g.page_size,
+                "algo": "blake2b8-chain",
+                "hashes": self.prefix_cache.digest(max_entries)}
+
     # ---- drain: the ONLY host<->device sync of the steady state ----
     def _drain(self) -> List[Request]:
         done: List[Request] = []

@@ -40,6 +40,7 @@ this module runs unless ``FLAGS_prefix_cache`` (or the engine's
 
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 # process-wide serving telemetry lives in the observability registry
@@ -60,6 +61,47 @@ def serving_stats() -> Dict[str, int]:
             for k in _SERVING_KEYS}
 
 
+# ---------------------------------------------------------------------------
+# Residency digest (ISSUE 7): stable chain hashes over token pages.
+#
+# Each cached page is identified by the hash chain of its WHOLE root path
+# (parent chain digest + this page's token block), so digest membership of
+# block k implies the full k-page prefix is resident — exactly the radix
+# index's match semantics, collapsed to O(1) set lookups.  The router
+# computes the same chain over an incoming prompt (``block_hashes``) and
+# scores each replica by its longest leading match against the replica's
+# advertised digest.  blake2b/8-byte keeps the wire size per entry at 16
+# hex chars and is stable across processes and hosts (no PYTHONHASHSEED).
+# ---------------------------------------------------------------------------
+
+_DIGEST_ALGO = "blake2b8-chain"
+
+
+def _chain(parent: bytes, tokens: Sequence[int]) -> bytes:
+    blk = ",".join(str(int(t)) for t in tokens).encode()
+    return hashlib.blake2b(parent + b"|" + blk, digest_size=8).digest()
+
+
+def block_hashes(tokens: Sequence[int], page_size: int,
+                 limit: Optional[int] = None) -> List[str]:
+    """Chain hashes (hex) of the prompt's full token pages, in order.
+
+    ``block_hashes(p, s)[k-1]`` identifies the k-page prefix of ``p``:
+    the same value a :class:`PrefixCache` holding that prefix reports in
+    its :meth:`~PrefixCache.digest`.  Partial trailing pages are not
+    hashed (the index is page-granular)."""
+    page = int(page_size)
+    n = len(tokens) // page
+    if limit is not None:
+        n = min(n, int(limit))
+    out: List[str] = []
+    h = b""
+    for i in range(n):
+        h = _chain(h, tokens[i * page:(i + 1) * page])
+        out.append(h.hex())
+    return out
+
+
 class _Node:
     """One cached page: an edge of the radix index.
 
@@ -70,7 +112,7 @@ class _Node:
     """
 
     __slots__ = ("tokens", "page", "end", "parent", "children", "active",
-                 "ready")
+                 "ready", "chain")
 
     def __init__(self, tokens: Tuple[int, ...], page: int, end: int,
                  parent: Optional["_Node"]):
@@ -81,6 +123,10 @@ class _Node:
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.active = 0
         self.ready = False
+        # root-path chain digest: membership in a residency digest implies
+        # the whole prefix up to ``end`` is resident (see block_hashes)
+        self.chain = _chain(parent.chain, tokens) if parent is not None \
+            else b""
 
 
 class MatchPlan:
@@ -245,6 +291,33 @@ class PrefixCache:
             stack.extend(x.children.values())
             n += 1
         return n - 1                     # minus the root sentinel
+
+    def digest(self, max_entries: int = 4096) -> List[str]:
+        """Residency digest: chain hashes (hex) of up to ``max_entries``
+        indexed pages, breadth-first from the root so a truncated digest
+        keeps the SHALLOW entries — the leading pages the router's
+        longest-prefix scoring walks first.  Pending nodes are included:
+        their KV is being written by a live producer and will be resident
+        by the time a routed request's admission matches them.
+
+        Unlike every other cache read, this one runs on the HTTP/statusz
+        thread while the engine thread mutates the index — each
+        ``list()`` below is a GIL-atomic snapshot of one children dict
+        (no Python callbacks during the C-level copy), so a concurrent
+        admit/evict can tear the digest across levels (advisory data)
+        but can never raise "dict changed size during iteration"."""
+        out: List[str] = []
+        frontier = [self._root]
+        while frontier and len(out) < max_entries:
+            nxt: List[_Node] = []
+            for node in frontier:
+                for child in list(node.children.values()):
+                    out.append(child.chain.hex())
+                    if len(out) >= max_entries:
+                        return out
+                    nxt.append(child)
+            frontier = nxt
+        return out
 
     def _reclaim(self, n: int) -> int:
         """Evict up to ``n`` idle pages, leaf-first in LRU order, back to

@@ -1,0 +1,32 @@
+"""Multi-replica serving router (ISSUE 7): prefix-aware, session-affine
+placement over N ``ServingServer`` replicas with aggregated SLO shedding
+and failover — stdlib asyncio, zero new deps, same discipline as
+``paddle_tpu/serving``.
+
+Quickstart (production: N replica processes, one router)::
+
+    # on each replica host / port
+    python -m paddle_tpu.serving --port 8001
+    python -m paddle_tpu.serving --port 8002
+
+    # the router
+    python -m paddle_tpu.router --replica 127.0.0.1:8001 \\
+                                --replica 127.0.0.1:8002 --port 8080
+
+In-process fleets (tests, benches) wrap started ``ServingServer``
+instances in ``InprocReplica`` handles instead — the identical code path
+minus the sockets.
+
+Placement lives in ``router.placement`` (scored prefix-residency +
+load, session affinity), transports in ``router.replica``, the process
+in ``router.server``.
+"""
+
+from . import placement, replica
+from .placement import Placer, ReplicaState
+from .replica import HttpReplica, InprocReplica, ReplicaClient
+from .server import RouterServer, route_forever
+
+__all__ = ["RouterServer", "route_forever", "ReplicaClient",
+           "InprocReplica", "HttpReplica", "Placer", "ReplicaState",
+           "placement", "replica"]

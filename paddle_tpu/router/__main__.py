@@ -1,0 +1,65 @@
+"""``python -m paddle_tpu.router`` — the multi-replica router as a real
+process (ISSUE 7 satellite; also the ``paddle-tpu-router`` console
+script).
+
+Replicas are ``--replica HOST:PORT`` upstreams (spawn each with
+``python -m paddle_tpu.serving``); placement policy and health/scoring
+knobs ride the ``FLAGS_router_*`` flag family, settable here via
+``--set NAME=VALUE`` exactly like the replica launcher.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="paddle-tpu-router",
+        description="Prefix-aware, session-affine router over N "
+                    "paddle_tpu serving replicas: one OpenAI-compatible "
+                    "front door with aggregate SLO shedding, health "
+                    "checking and failover.")
+    p.add_argument("--replica", action="append", required=True,
+                   metavar="HOST:PORT", dest="replicas",
+                   help="one serving replica upstream; repeat per replica")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--policy", choices=("scored", "round_robin"),
+                   default=None,
+                   help="placement policy (default: "
+                        "FLAGS_router_placement)")
+    p.add_argument("--model-name", default="paddle-tpu")
+    p.add_argument("--set", action="append", default=[],
+                   metavar="NAME=VALUE", dest="flag_sets",
+                   help="set any FLAGS_* by name, repeatable "
+                        "(e.g. --set router_health_interval_s=1.0)")
+    return p
+
+
+def parse_replicas(specs: List[str]):
+    from .replica import HttpReplica
+    out = []
+    for i, spec in enumerate(specs):
+        host, sep, port = spec.rpartition(":")
+        if not sep or not port.isdigit():
+            raise SystemExit(
+                f"--replica expects HOST:PORT, got {spec!r}")
+        out.append(HttpReplica(f"r{i}", host or "127.0.0.1", int(port)))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    from ..serving.__main__ import apply_flag_sets
+    apply_flag_sets(args.flag_sets)
+    replicas = parse_replicas(args.replicas)
+    from .server import route_forever
+    route_forever(replicas, host=args.host, port=args.port,
+                  model_name=args.model_name, policy=args.policy)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,298 @@
+"""Placement: which replica serves this request.
+
+Scored, not round-robin (the ISSUE 7 tentpole).  Each replica advertises
+a prefix-residency digest via ``/statusz`` — the chain hashes of the KV
+pages its radix index holds (``inference.prefix_cache.block_hashes``
+semantics: membership of hash k implies the whole k-page prefix is
+resident).  The router computes the same chain over the incoming prompt
+and scores every candidate:
+
+    score = hit_weight * expected_hit_tokens
+          - load_weight * load * page_size
+
+``expected_hit_tokens`` is the longest LEADING run of the prompt's page
+hashes found in the replica's digest, times its page size — exactly the
+prefill tokens its cache would skip.  ``load`` counts requests ahead of
+this one (the router's own live in-flight count plus the replica's last
+polled queue depth), priced in page-size token units so one queued
+request offsets one cached page at the default weights
+(``FLAGS_router_hit_weight`` / ``FLAGS_router_load_weight``).
+
+Two refinements make the score robust without tight polling:
+
+- **Routed overlay**: the instant a prompt is routed, its leading hashes
+  are credited to that replica's digest view (bounded LRU).  The replica
+  will hold those pages by the time any follow-up sharing them arrives —
+  the pending->ready lifecycle of the PR 4 cache even shares them within
+  one admission batch — so placement concentrates shared prefixes
+  without waiting for the next ``/statusz`` poll to confirm.
+- **Session affinity**: ``X-Session-Id`` pins a conversation to the
+  replica holding its pages (LRU-capped at ``FLAGS_router_session_cap``;
+  an evicted or orphaned session is simply re-scored, and the digest
+  steers it home).
+
+``round_robin`` (``FLAGS_router_placement``) is the baseline arm of the
+``router_serve`` A/B: plain rotation, no affinity, no digest.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import flags
+from .. import observability as _obs
+from ..inference.prefix_cache import block_hashes
+
+__all__ = ["ReplicaState", "Placer"]
+
+# placement reasons, the `router.placement{reason=}` label set
+AFFINITY, PREFIX, LOAD, ROUND_ROBIN = \
+    "affinity", "prefix", "load", "round_robin"
+
+
+class ReplicaState:
+    """The router's live view of one replica: health, load, digest."""
+
+    def __init__(self, client):
+        self.client = client
+        self.id = client.id
+        # health: ok flips False the moment a poll (or a proxied connect)
+        # fails — excluded from NEW placements immediately; `dead` is the
+        # reported state after FLAGS_router_dead_after consecutive
+        # failures.  Polling continues either way so a recovered replica
+        # rejoins.
+        self.ok = False
+        self.ready = False
+        self.fails = 0
+        self.last_poll: Optional[float] = None
+        self.next_poll: float = 0.0     # monotonic deadline for the poller
+        # placement inputs from the last successful /statusz
+        self.digest: frozenset = frozenset()
+        self.page_size: int = 0
+        self.queue_depth: int = 0       # waiting + busy slots, replica-side
+        self.slo_decision: str = "admit"
+        self.retry_after_s: int = 1
+        # router-side live signals
+        self.inflight = 0               # proxied requests currently open
+        # routed overlay: hash -> poll generation at credit time, so
+        # entries the digest never confirms (page evicted replica-side,
+        # or never committed) age out instead of scoring phantom hits
+        # forever
+        self.routed: "OrderedDict[str, int]" = OrderedDict()
+        self._poll_gen = 0              # completed /statusz polls
+        self.failovers = 0
+
+    # ------------------------------------------------------------ state --
+    def status(self, dead_after: int) -> str:
+        if not self.ok:
+            return "dead" if self.fails >= dead_after else "suspect"
+        return "ready" if self.ready else "warming"
+
+    def apply_statusz(self, doc: dict) -> None:
+        """Fold one successful /statusz poll into the placement view."""
+        self.ok = True
+        self.fails = 0
+        self.last_poll = time.perf_counter()
+        self.ready = bool(doc.get("ready", True))
+        eng = doc.get("engine") or {}
+        self.queue_depth = int(eng.get("waiting", 0) or 0) + \
+            int(eng.get("slots_busy", 0) or 0)
+        dig = doc.get("prefix_digest")
+        if dig:
+            self.page_size = int(dig.get("page_size", 0) or 0)
+            confirmed = frozenset(dig.get("hashes") or ())
+            self.digest = confirmed
+            # overlay entries the index now confirms have served their
+            # purpose; entries still unconfirmed after two full polls
+            # were evicted (or never committed) replica-side — drop both
+            # so the advertised truth is the steady-state signal.  Two
+            # polls, not one: a credit from just before this poll may
+            # predate its request's admission on the replica.
+            self._poll_gen += 1
+            gen = self._poll_gen
+            for h in [h for h, g in self.routed.items()
+                      if h in confirmed or gen - g >= 2]:
+                del self.routed[h]
+        else:
+            self.digest = frozenset()
+            self.routed.clear()
+        slo = doc.get("slo")
+        if slo:
+            self.slo_decision = str(slo.get("decision", "admit"))
+            try:
+                self.retry_after_s = max(1, int(slo.get(
+                    "retry_after_s", 1)))
+            except (TypeError, ValueError):
+                self.retry_after_s = 1
+        else:
+            self.slo_decision = "admit"
+            self.retry_after_s = 1
+
+    def mark_failed(self) -> None:
+        """A poll or proxied connect failed: out of the candidate set NOW
+        (re-route first, diagnose later); backoff grows in the poller."""
+        self.ok = False
+        self.ready = False
+        self.fails += 1
+
+    # -------------------------------------------------------- placement --
+    def expected_hit_pages(self, hashes: Sequence[str]) -> int:
+        """Longest leading run of ``hashes`` this replica holds (digest
+        semantics: hash k resident => the whole k-page prefix is)."""
+        n = 0
+        for h in hashes:
+            if h in self.digest or h in self.routed:
+                n += 1
+            else:
+                break
+        return n
+
+    def credit_routed(self, hashes: Sequence[str], cap: int) -> None:
+        """Optimistically credit the leading hashes of a prompt just
+        routed here (bounded; oldest credits fall off first)."""
+        for h in hashes:
+            if h in self.routed:
+                self.routed.move_to_end(h)
+            self.routed[h] = self._poll_gen
+        while len(self.routed) > cap:
+            self.routed.popitem(last=False)
+
+    def load(self) -> int:
+        """Requests ahead of a new arrival: the router's own live
+        in-flight count plus the replica's last-polled queue depth."""
+        return self.inflight + self.queue_depth
+
+    def describe(self, dead_after: int) -> dict:
+        age = None if self.last_poll is None else \
+            round(time.perf_counter() - self.last_poll, 3)
+        return {**self.client.describe(),
+                "state": self.status(dead_after),
+                "consecutive_fails": self.fails,
+                "last_poll_age_s": age,
+                "queue_depth": self.queue_depth,
+                "inflight": self.inflight,
+                "digest_entries": len(self.digest),
+                "routed_overlay": len(self.routed),
+                "page_size": self.page_size,
+                "slo": {"decision": self.slo_decision,
+                        "retry_after_s": self.retry_after_s},
+                "failovers": self.failovers}
+
+
+class Placer:
+    """Policy object: ``place()`` picks one candidate and records why."""
+
+    def __init__(self, policy: Optional[str] = None,
+                 session_cap: Optional[int] = None,
+                 hit_weight: Optional[float] = None,
+                 load_weight: Optional[float] = None):
+        f = flags.flag
+        self.policy = str(f("router_placement")
+                          if policy is None else policy)
+        if self.policy not in ("scored", "round_robin"):
+            raise ValueError(
+                f"router_placement must be 'scored' or 'round_robin', "
+                f"got {self.policy!r}")
+        self.session_cap = int(f("router_session_cap")
+                               if session_cap is None else session_cap)
+        self.hit_weight = float(f("router_hit_weight")
+                                if hit_weight is None else hit_weight)
+        self.load_weight = float(f("router_load_weight")
+                                 if load_weight is None else load_weight)
+        self._sessions: "OrderedDict[str, str]" = OrderedDict()
+        self._rr = 0
+        m = _obs.metrics
+        self._placed = {r: m.counter("router.placement", reason=r)
+                        for r in (AFFINITY, PREFIX, LOAD, ROUND_ROBIN)}
+        self._pins = m.gauge("router.session_pins")
+        self._evictions = m.counter("router.session_evictions")
+        self._hit_pages = m.histogram("router.prefix_hit_pages")
+
+    # --------------------------------------------------------- sessions --
+    def _pin(self, session_id: str, replica_id: str) -> None:
+        if session_id in self._sessions:
+            self._sessions.move_to_end(session_id)
+        self._sessions[session_id] = replica_id
+        while len(self._sessions) > self.session_cap:
+            self._sessions.popitem(last=False)
+            self._evictions.inc()
+        self._pins.set(len(self._sessions))
+
+    def pinned(self, session_id: Optional[str]) -> Optional[str]:
+        return self._sessions.get(session_id) if session_id else None
+
+    def session_state(self) -> dict:
+        return {"pins": len(self._sessions), "cap": self.session_cap,
+                "evictions": int(self._evictions.value)}
+
+    # -------------------------------------------------------- placement --
+    def hashes_for(self, prompt: Sequence[int],
+                   candidates: List[ReplicaState]) -> Dict[int, List[str]]:
+        """Prompt page hashes per distinct candidate page size (one chain
+        walk per geometry; a fleet normally has exactly one)."""
+        out: Dict[int, List[str]] = {}
+        if self.policy != "scored" or not prompt:
+            return out
+        # bounded: scoring stops at the first miss and the overlay credit
+        # caps at router_digest_max anyway, so hashing pages past that
+        # would be pure per-request overhead on huge prompts
+        limit = int(flags.flag("router_digest_max"))
+        for s in candidates:
+            ps = s.page_size
+            if ps > 0 and ps not in out:
+                out[ps] = block_hashes(prompt, ps, limit=limit)
+        return out
+
+    def place(self, prompt: Sequence[int], session_id: Optional[str],
+              candidates: List[ReplicaState]
+              ) -> Tuple[ReplicaState, str]:
+        """Pick one of ``candidates`` (non-empty, pre-filtered to ready &
+        not-shedding).  Returns ``(state, reason)`` and records the
+        decision, the routed-overlay credit, and the session pin."""
+        if self.policy == "round_robin":
+            choice = candidates[self._rr % len(candidates)]
+            self._rr += 1
+            self._placed[ROUND_ROBIN].inc()
+            return choice, ROUND_ROBIN
+
+        hashes = self.hashes_for(prompt, candidates)
+        pin = self.pinned(session_id)
+        choice = reason = None
+        if pin is not None:
+            for s in candidates:
+                if s.id == pin:
+                    choice, reason = s, AFFINITY
+                    break
+            # a pinned replica that is dead/shedding falls through to the
+            # score — which the digest steers back to wherever the
+            # session's pages actually live (possibly a survivor that
+            # never saw it: then it is a plain cold re-place)
+        if choice is None:
+            best = None
+            # load priced in ONE token unit fleet-wide: a digest-less
+            # replica (page_size 0) must not get a discounted penalty
+            # relative to page-ful peers, or it soaks up traffic
+            # regardless of load
+            unit = max((s.page_size for s in candidates), default=0) or 1
+            for i, s in enumerate(candidates):
+                hits = s.expected_hit_pages(hashes.get(s.page_size, ()))
+                score = self.hit_weight * hits * s.page_size \
+                    - self.load_weight * s.load() * unit
+                key = (score, -s.load(), -((i - self._rr) % len(candidates)))
+                if best is None or key > best[0]:
+                    best = (key, s, hits)
+            _, choice, hits = best
+            reason = PREFIX if hits > 0 else LOAD
+            if reason == LOAD:
+                self._rr += 1           # rotate ties among equal loads
+            self._hit_pages.observe(float(hits))
+        hs = hashes.get(choice.page_size)
+        if hs:
+            # overlay bounded like the advertised digest itself
+            choice.credit_routed(hs, cap=int(flags.flag("router_digest_max")))
+        if session_id:
+            self._pin(session_id, choice.id)
+        self._placed[reason].inc()
+        return choice, reason

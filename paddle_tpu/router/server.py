@@ -1,0 +1,657 @@
+"""RouterServer: the multi-replica serving front door (ISSUE 7 tentpole).
+
+One asyncio process fronting N ``ServingServer`` replicas behind the
+same OpenAI-compatible API the replicas themselves speak:
+
+- ``POST /v1/completions`` — placed by score (prefix residency + load +
+  session affinity; see ``placement.py``), proxied to the chosen replica
+  with the router's trace id in ``X-Trace-Id`` so replica engine spans
+  land on the SAME Chrome-trace lane as the router span (one request,
+  one correlated track, fleet-wide).  Streaming responses relay SSE
+  frames as they arrive (client TTFT rides the replica's drain cadence,
+  not the request's completion).
+- ``GET /metrics`` — the router process registry (``router.*`` series;
+  with in-process replicas this IS the fleet aggregate, because the
+  registry is process-wide and carries every replica's ``serving.*``
+  series too.  HTTP replicas export their own ``/metrics`` — point the
+  scraper at each; ``/statusz`` here aggregates their placement view).
+- ``GET /healthz`` — fleet liveness: 200 while >= 1 replica answers
+  polls.  ``GET /readyz`` — fleet readiness: 200 while >= 1 replica is
+  warm (a ``warmup=True`` replica is NOT ready until its bucket compile
+  finishes — the router never places live traffic on a cold engine).
+- ``GET /statusz`` — per-replica state (health, load, digest size, SLO
+  burn), session-pin table, placement/failover counters.
+
+Health: each replica is polled (``/statusz``) every
+``FLAGS_router_health_interval_s`` with exponential backoff on failure
+(up to 8x); ``FLAGS_router_dead_after`` consecutive failures report it
+``dead``.  A failed poll excludes the replica from NEW placements
+immediately — re-route first, diagnose later — while polling continues
+so a recovered replica rejoins.  Without a background poll task (the
+tier-1 tests run one event loop per request), stale state refreshes
+inline before placement, so the router is correct, just lazier.
+
+Failover: a replica dying mid-conversation fails only its in-flight
+requests.  A connect-phase failure re-places the request on the
+next-best candidate (``router.failover{phase=connect}``); an upstream
+EOF after the SSE head is out terminates the client stream CLEANLY — a
+synthesized ``finish_reason: "error"`` chunk plus ``data: [DONE]``, the
+same shape a replica's own engine-crash path emits, never a silent
+truncation (``router.failover{phase=stream}``).  Unary upstream failure
+after dispatch is a 502 (the generation may have partially run — the
+router does not re-run it on another replica).
+
+Fleet admission: per-replica SLO burn (the ``serving/slo.py`` windows,
+read from each ``/statusz``) aggregates at the router — when every live
+replica is shedding, the router sheds fleet-wide with ``Retry-After``
+derived from the soonest replica's live burn window (min of their
+``retry_after_s``), mirrored into the JSON error body.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .. import flags
+from .. import observability as _obs
+from ..serving import http as _http
+from .placement import Placer, ReplicaState
+from .replica import ReplicaClient
+
+__all__ = ["RouterServer", "route_forever"]
+
+_TRACE_ID_OK = _http.SAFE_ID_OK
+_SESSION_ID_OK = _TRACE_ID_OK
+
+
+class _RouterMetrics:
+    """Registry handles resolved once (the PR 5 idiom)."""
+
+    __slots__ = ("requests", "streams", "responses", "inflight",
+                 "request_ms", "failover", "shed", "slo_decision",
+                 "health_polls", "replicas_gauge")
+
+    def __init__(self):
+        m = _obs.metrics
+        self.requests = m.counter("router.requests")
+        self.streams = m.counter("router.streams")
+        self.responses = lambda code: m.counter("router.responses",
+                                                code=str(code))
+        self.inflight = m.gauge("router.inflight")
+        self.request_ms = m.histogram("router.request_ms")
+        self.failover = lambda phase: m.counter("router.failover",
+                                                phase=phase)
+        self.shed = m.counter("router.shed")
+        self.slo_decision = lambda d: m.counter("router.slo_decision",
+                                                decision=d)
+        self.health_polls = lambda r: m.counter("router.health_polls",
+                                                result=r)
+        self.replicas_gauge = lambda s: m.gauge("router.replicas", state=s)
+
+
+class RouterServer:
+    """Routes the replica-compatible API over N replica clients.
+
+    ``replicas``: list of ``ReplicaClient`` (``InprocReplica`` handles
+    for same-process fleets, ``HttpReplica`` for real deployments).
+    ``policy`` overrides ``FLAGS_router_placement``.
+    """
+
+    def __init__(self, replicas: List[ReplicaClient], *,
+                 model_name: str = "paddle-tpu",
+                 policy: Optional[str] = None,
+                 health_interval_s: Optional[float] = None,
+                 dead_after: Optional[int] = None,
+                 poll_timeout_s: Optional[float] = None):
+        if not replicas:
+            raise ValueError("RouterServer needs at least one replica")
+        ids = [r.id for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        f = flags.flag
+        self.states = [ReplicaState(r) for r in replicas]
+        self.model_name = model_name
+        self.placer = Placer(policy=policy)
+        self.health_interval_s = float(f("router_health_interval_s")
+                                       if health_interval_s is None
+                                       else health_interval_s)
+        self.dead_after = int(f("router_dead_after")
+                              if dead_after is None else dead_after)
+        self.poll_timeout_s = float(f("router_poll_timeout_s")
+                                    if poll_timeout_s is None
+                                    else poll_timeout_s)
+        self._m = _RouterMetrics()
+        self._t0 = time.perf_counter()
+        self._next_rid = 0
+        self._health_tasks: List[asyncio.Task] = []
+        self._refresh_task: Optional[asyncio.Task] = None
+        self._asyncio_server = None
+
+    # ------------------------------------------------------------ health --
+    async def _get_json(self, client: ReplicaClient, path: str) -> dict:
+        """One GET against a replica, parsed as JSON (poll path: the
+        whole exchange is bounded by the poll timeout)."""
+        reader, close = await asyncio.wait_for(
+            client.open("GET", path), self.poll_timeout_s)
+        try:
+            status, headers, body = await asyncio.wait_for(
+                _read_response(reader), self.poll_timeout_s)
+        finally:
+            close()
+        if status != 200:
+            raise ConnectionError(f"{path} -> {status}")
+        return json.loads(body.decode())
+
+    async def poll_replica(self, state: ReplicaState) -> bool:
+        """Poll one replica's /statusz into its placement view."""
+        try:
+            doc = await self._get_json(state.client, "/statusz")
+        except (Exception, asyncio.TimeoutError):
+            state.mark_failed()
+            self._m.health_polls("fail").inc()
+            # exponent capped BEFORE the power: fails grows without bound
+            # on a long-dead replica and 2.0**1024 is OverflowError, which
+            # would kill the health loop and strand the replica dead even
+            # after it recovers
+            backoff = 2.0 ** min(state.fails, 3)
+            state.next_poll = time.perf_counter() + \
+                self.health_interval_s * backoff
+            return False
+        state.apply_statusz(doc)
+        self._m.health_polls("ok").inc()
+        state.next_poll = time.perf_counter() + self.health_interval_s
+        return True
+
+    async def poll_replicas(self) -> None:
+        """Poll every replica once, concurrently (tests and the inline
+        staleness refresh call this; the background loop paces itself)."""
+        await asyncio.gather(*(self.poll_replica(s) for s in self.states))
+        self._export_replica_gauges()
+
+    def _export_replica_gauges(self) -> None:
+        counts = {s: 0 for s in ("ready", "warming", "suspect", "dead")}
+        for st in self.states:
+            counts[st.status(self.dead_after)] += 1
+        for s, n in counts.items():
+            self._m.replicas_gauge(s).set(n)
+
+    async def _health_loop(self, state: ReplicaState) -> None:
+        while True:
+            now = time.perf_counter()
+            if now >= state.next_poll:
+                await self.poll_replica(state)
+                self._export_replica_gauges()
+            await asyncio.sleep(
+                max(0.05, min(self.health_interval_s,
+                              state.next_poll - time.perf_counter())))
+
+    def start_health(self) -> None:
+        """Spawn one background poll task per replica on the RUNNING
+        loop (production path; tests poll explicitly instead)."""
+        if self._health_tasks:
+            return
+        self._health_tasks = [
+            asyncio.get_running_loop().create_task(self._health_loop(s))
+            for s in self.states]
+
+    def stop_health(self) -> None:
+        for t in self._health_tasks:
+            t.cancel()
+        self._health_tasks = []
+
+    async def _refresh_if_stale(self) -> None:
+        """Inline refresh when no background poller owns freshness: a
+        state never polled, or polled longer than the health interval
+        ago, re-polls before placement (dead replicas respect their
+        backoff deadline so a down upstream does not add a connect
+        timeout to every request).  Concurrent arrivals share ONE
+        in-flight refresh — a herd of requests landing on stale state
+        must not each launch a full fleet of duplicate polls."""
+        if self._health_tasks:
+            return
+        task = self._refresh_task
+        if task is None or task.done() or \
+                task.get_loop() is not asyncio.get_running_loop():
+            # (loop check: the in-process test idiom runs one event loop
+            # per request — a task stranded on a finished loop is stale)
+            task = asyncio.ensure_future(self._refresh_stale_now())
+            self._refresh_task = task
+        # awaiting a shared Task is cancel-safe: cancelling one awaiter
+        # does not cancel the refresh the others are waiting on
+        await task
+
+    async def _refresh_stale_now(self) -> None:
+        now = time.perf_counter()
+
+        def stale(s: ReplicaState) -> bool:
+            if s.ok:
+                return s.last_poll is None or \
+                    now - s.last_poll > self.health_interval_s
+            # failing replicas respect their backoff deadline — a dead
+            # upstream must not add a connect timeout to every request
+            return now >= s.next_poll
+
+        todo = [s for s in self.states if stale(s)]
+        if todo:
+            await asyncio.gather(*(self.poll_replica(s) for s in todo))
+            self._export_replica_gauges()
+
+    # ----------------------------------------------------------- handler --
+    async def handle(self, reader, writer) -> None:
+        """One client HTTP connection (asyncio.start_server signature;
+        in-process stream stand-ins equally welcome)."""
+        t0 = time.perf_counter()
+        status = 500
+        self._m.requests.inc()
+        self._m.inflight.inc(1)
+        try:
+            try:
+                method, path, headers, body = \
+                    await _http.read_request(reader)
+            except _http.HttpError as e:
+                status = e.status
+                writer.write(_http.error_response(e.status, e.message))
+                await writer.drain()
+                return
+            status = await self._route(method, path, headers, body, writer)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            status = 499
+        except Exception as e:
+            try:
+                writer.write(_http.error_response(
+                    500, f"{type(e).__name__}: {e}",
+                    err_type="internal_error"))
+                await writer.drain()
+            except Exception:
+                pass
+        finally:
+            self._m.inflight.inc(-1)
+            self._m.responses(status).inc()
+            self._m.request_ms.observe((time.perf_counter() - t0) * 1e3)
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _route(self, method, path, headers, body, writer) -> int:
+        path = path.split("?", 1)[0]
+        if path == "/metrics" and method == "GET":
+            text = _obs.prometheus_text().encode()
+            writer.write(_http.response(
+                200, text, content_type="text/plain; version=0.0.4"))
+            await writer.drain()
+            return 200
+        if path == "/healthz" and method == "GET":
+            await self._refresh_if_stale()
+            up = sum(s.ok for s in self.states)
+            ok = up >= 1
+            writer.write(_http.json_response(
+                200 if ok else 503,
+                {"status": "ok" if ok else "no replica answering",
+                 "replicas_up": up, "replicas": len(self.states)}))
+            await writer.drain()
+            return 200 if ok else 503
+        if path == "/readyz" and method == "GET":
+            await self._refresh_if_stale()
+            n = len(self._candidates(include_shedding=True))
+            writer.write(_http.json_response(
+                200 if n else 503,
+                {"ready": bool(n), "replicas_ready": n}))
+            await writer.drain()
+            return 200 if n else 503
+        if path == "/statusz" and method == "GET":
+            await self._refresh_if_stale()
+            writer.write(_http.json_response(200, self.statusz()))
+            await writer.drain()
+            return 200
+        if path == "/v1/completions" and method == "POST":
+            return await self._completions(headers, body, writer)
+        if path in ("/metrics", "/healthz", "/readyz", "/statusz",
+                    "/v1/completions"):
+            writer.write(_http.error_response(405, f"{method} not allowed"))
+            await writer.drain()
+            return 405
+        writer.write(_http.error_response(404, f"no route {path}"))
+        await writer.drain()
+        return 404
+
+    # -------------------------------------------------------- completions --
+    def _candidates(self, include_shedding: bool = False
+                    ) -> List[ReplicaState]:
+        return [s for s in self.states if s.ok and s.ready
+                and (include_shedding or s.slo_decision != "shed")]
+
+    def _trace_id(self, headers) -> str:
+        t = headers.get("x-trace-id", "")
+        if t and _TRACE_ID_OK(t):
+            return t
+        n = self._next_rid
+        self._next_rid += 1
+        return f"cmpl-rtr-{os.getpid():x}-{n:06x}-{os.urandom(4).hex()}"
+
+    def _session_id(self, headers) -> Optional[str]:
+        s = headers.get("x-session-id", "")
+        return s if s and _SESSION_ID_OK(s) else None
+
+    async def _completions(self, headers, body, writer) -> int:
+        # the replica owns request validation (vocab bounds, pool sizing);
+        # the router only needs the token ids for placement hashing —
+        # an unparseable prompt simply places by load and lets the
+        # replica return its 400
+        prompt: List[int] = []
+        payload: dict = {}
+        try:
+            doc = json.loads(body.decode() or "{}")
+            if isinstance(doc, dict):
+                payload = doc
+            p = payload.get("prompt")
+            if isinstance(p, str):
+                p = [int(t) for t in p.split()]
+            if isinstance(p, list) and all(
+                    isinstance(t, int) and not isinstance(t, bool)
+                    for t in p):
+                prompt = p
+        except (ValueError, UnicodeDecodeError):
+            pass
+        stream = bool(payload.get("stream", False))
+
+        await self._refresh_if_stale()
+        live = self._candidates(include_shedding=True)
+        if not live:
+            # nobody to route to: distinguish "down" from "warming"
+            warming = any(s.ok and not s.ready for s in self.states)
+            self._m.slo_decision("unavailable").inc()
+            ra = max(1, int(self.health_interval_s + 0.999))
+            writer.write(_http.error_response(
+                503,
+                "no replica ready (fleet warming)" if warming
+                else "no replica available",
+                err_type="overloaded_error" if warming
+                else "internal_error",
+                extra_headers=(("Retry-After", str(ra)),),
+                fields={"retry_after_s": ra}))
+            await writer.drain()
+            return 503
+        candidates = [s for s in live if s.slo_decision != "shed"]
+        if not candidates:
+            # fleet-wide shed: every live replica is burning its SLO —
+            # 503 BEFORE any replica melts, Retry-After from the soonest
+            # replica's live burn window
+            ra = min(s.retry_after_s for s in live)
+            self._m.slo_decision("shed").inc()
+            self._m.shed.inc()
+            writer.write(_http.error_response(
+                503, "shedding load: every replica is burning its "
+                     "latency SLO (see /statusz)",
+                err_type="overloaded_error",
+                extra_headers=(("Retry-After", str(ra)),),
+                fields={"retry_after_s": ra}))
+            await writer.drain()
+            return 503
+        self._m.slo_decision("admit").inc()
+
+        trace_id = self._trace_id(headers)
+        session_id = self._session_id(headers)
+        if stream:
+            self._m.streams.inc()
+        t_accept = time.perf_counter()
+        code = await self._proxy(trace_id, session_id, prompt, body,
+                                 candidates, writer, stream)
+        if _obs.TRACER.enabled:
+            _obs.TRACER.event("router.request", t_accept,
+                              time.perf_counter() - t_accept,
+                              cat="router", tid=trace_id,
+                              args={"trace_id": trace_id,
+                                    "stream": stream,
+                                    "prompt_tokens": len(prompt)})
+        return code
+
+    async def _proxy(self, trace_id, session_id, prompt, body,
+                     candidates: List[ReplicaState], writer,
+                     stream: bool = False) -> int:
+        """Place and relay, re-placing on connect-phase failure."""
+        tried: List[str] = []
+        while candidates:
+            state, reason = self.placer.place(prompt, session_id,
+                                              candidates)
+            tried.append(state.id)
+            up = (("X-Trace-Id", trace_id),
+                  ("X-Router-Reason", reason))
+            try:
+                up_reader, close = await state.client.open(
+                    "POST", "/v1/completions", headers=up, body=body)
+            except Exception:
+                # connect-phase death: this replica is out of the
+                # candidate set NOW; the request re-places on the rest
+                state.mark_failed()
+                state.failovers += 1
+                self._m.failover("connect").inc()
+                self._export_replica_gauges()
+                candidates = [s for s in candidates
+                              if s.id not in tried]
+                continue
+            state.inflight += 1
+            try:
+                return await self._relay(state, up_reader, trace_id,
+                                         writer, stream)
+            finally:
+                state.inflight -= 1
+                close()
+        writer.write(_http.error_response(
+            502, f"every candidate replica failed at connect "
+                 f"(tried {tried})", err_type="internal_error"))
+        await writer.drain()
+        return 502
+
+    async def _relay(self, state: ReplicaState, up, trace_id,
+                     writer, stream: bool = False) -> int:
+        """Forward one upstream response.  SSE streams frame-by-frame
+        with clean synthesized termination on upstream death; everything
+        else buffers per Content-Length (replica responses are always
+        close-delimited with an explicit length outside SSE)."""
+        try:
+            # a replica writes a STREAM head immediately at admission, so
+            # a head slower than the poll timeout is the same wedge signal
+            # a failed health poll reports — don't hang the client on a
+            # replica that accepts connects but never answers.  A UNARY
+            # head arrives only when generation completes: legitimately
+            # unbounded, never timed.
+            if stream and self.poll_timeout_s > 0:
+                status, headers, head_raw = await asyncio.wait_for(
+                    _read_head(up), self.poll_timeout_s)
+            else:
+                status, headers, head_raw = await _read_head(up)
+        except (Exception, asyncio.IncompleteReadError):
+            # died before the head: nothing reached the client yet
+            state.mark_failed()
+            state.failovers += 1
+            self._m.failover("stream").inc()
+            writer.write(_http.error_response(
+                502, f"replica {state.id} died before responding",
+                err_type="internal_error"))
+            await writer.drain()
+            return 502
+        ctype = headers.get("content-type", "")
+        if ctype.startswith("text/event-stream"):
+            # re-emit the head with the serving replica stamped on it
+            writer.write(_head_with(head_raw, (
+                ("X-Router-Replica", state.id),)))
+            await writer.drain()
+            done_seen = False
+            tail = b"\n"              # the head ended cleanly on a boundary
+            while True:
+                line = await up.readline()
+                if not line:          # close-delimited: EOF ends the body
+                    break
+                if line.strip() == b"data: [DONE]":
+                    done_seen = True
+                writer.write(line)
+                tail = line
+                if line == b"\n":     # frame boundary: flush per event
+                    await writer.drain()
+            # a death (or TCP segmentation at EOF) can end the relay
+            # mid-line or mid-frame — even AFTER the [DONE] line if its
+            # blank-line terminator was lost.  Close the last event out
+            # so whatever follows (the already-relayed [DONE], or the
+            # synthesized error chunk) parses as its own frame instead
+            # of gluing onto the wreckage.
+            repaired = False
+            if not tail.endswith(b"\n"):
+                writer.write(b"\n")
+                repaired = True
+            if tail.strip():
+                writer.write(b"\n")
+                repaired = True
+            if not done_seen:
+                # upstream died mid-stream: terminate CLEANLY — the same
+                # finish-reason shape a replica's own crash path emits,
+                # never a silent truncation the client mistakes for EOS
+                state.mark_failed()
+                state.failovers += 1
+                self._m.failover("stream").inc()
+                writer.write(_http.sse_event(
+                    {"id": trace_id, "object": "text_completion.chunk",
+                     "model": self.model_name,
+                     "choices": [{"index": 0, "text": "", "token_ids": [],
+                                  "finish_reason": "error"}]}))
+                writer.write(_http.sse_done())
+                await writer.drain()
+            elif repaired:
+                await writer.drain()
+            return status
+        # unary / error document: bounded body per Content-Length
+        try:
+            n = int(headers.get("content-length", "0"))
+            body = await up.readexactly(n) if n else b""
+        except (Exception, asyncio.IncompleteReadError):
+            state.mark_failed()
+            state.failovers += 1
+            self._m.failover("stream").inc()
+            writer.write(_http.error_response(
+                502, f"replica {state.id} died mid-response "
+                     f"(the request may have partially run; not retried)",
+                err_type="internal_error"))
+            await writer.drain()
+            return 502
+        writer.write(_head_with(head_raw, (
+            ("X-Router-Replica", state.id),)) + body)
+        await writer.drain()
+        return status
+
+    # ------------------------------------------------------------ status --
+    def statusz(self) -> dict:
+        return {
+            "uptime_s": round(time.perf_counter() - self._t0, 3),
+            "model": self.model_name,
+            "role": "router",
+            "policy": self.placer.policy,
+            "weights": {"hit": self.placer.hit_weight,
+                        "load": self.placer.load_weight},
+            "health": {"interval_s": self.health_interval_s,
+                       "dead_after": self.dead_after,
+                       "poll_timeout_s": self.poll_timeout_s,
+                       "background": bool(self._health_tasks)},
+            "replicas": [s.describe(self.dead_after)
+                         for s in self.states],
+            "sessions": self.placer.session_state(),
+            "failover": {
+                "connect": int(_obs.metrics.counter(
+                    "router.failover", phase="connect").value),
+                "stream": int(_obs.metrics.counter(
+                    "router.failover", phase="stream").value)},
+            "shed_total": int(self._m.shed.value),
+            "pid": os.getpid(),
+        }
+
+    # --------------------------------------------------------- lifecycle --
+    async def start_http(self, host: str = "127.0.0.1", port: int = 0):
+        """Bind a listener and start background health polling."""
+        self.start_health()
+        await self.poll_replicas()      # first view before first request
+        self._asyncio_server = await asyncio.start_server(
+            self.handle, host, port)
+        return self._asyncio_server.sockets[0].getsockname()[:2]
+
+    async def stop_http(self) -> None:
+        self.stop_health()
+        if self._asyncio_server is not None:
+            self._asyncio_server.close()
+            await self._asyncio_server.wait_closed()
+            self._asyncio_server = None
+
+
+# ---------------------------------------------------------------------------
+# upstream response parsing helpers
+# ---------------------------------------------------------------------------
+
+async def _read_head(reader) -> Tuple[int, Dict[str, str], bytes]:
+    """Status + headers + the raw head bytes (terminator included)."""
+    raw = bytearray()
+    while True:
+        line = await reader.readline()
+        if not line:
+            raise ConnectionError("upstream EOF before response head")
+        raw.extend(line)
+        if line in (b"\r\n", b"\n"):
+            break
+        if len(raw) > _http.MAX_LINE * 4:
+            raise ConnectionError("upstream head too large")
+    text = bytes(raw).decode("latin-1")
+    lines = [ln for ln in text.split("\r\n") if ln]
+    parts = lines[0].split()
+    status = int(parts[1])
+    headers: Dict[str, str] = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return status, headers, bytes(raw)
+
+
+async def _read_response(reader) -> Tuple[int, Dict[str, str], bytes]:
+    """Whole bounded response (poll path — never SSE)."""
+    status, headers, _ = await _read_head(reader)
+    n = int(headers.get("content-length", "0"))
+    body = await reader.readexactly(n) if n else await reader.read()
+    return status, headers, body
+
+
+def _head_with(head_raw: bytes,
+               extra: Tuple[Tuple[str, str], ...]) -> bytes:
+    """Insert headers just before the head terminator."""
+    ins = "".join(f"{k}: {v}\r\n" for k, v in extra).encode("latin-1")
+    if head_raw.endswith(b"\r\n\r\n"):
+        return head_raw[:-2] + ins + b"\r\n"
+    return head_raw + ins      # defensive; replica heads are CRLF-framed
+
+
+# ---------------------------------------------------------------------------
+# production entry
+# ---------------------------------------------------------------------------
+
+async def _route_async(router: RouterServer, host: str, port: int):
+    bound = await router.start_http(host, port)
+    print(f"[paddle_tpu router] listening on http://{bound[0]}:{bound[1]}"
+          f"  ({len(router.states)} replicas, "
+          f"policy={router.placer.policy})")
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await router.stop_http()
+
+
+def route_forever(replicas: List[ReplicaClient], *,
+                  host: str = "127.0.0.1", port: int = 8080,
+                  **kw) -> None:
+    """Blocking convenience entry: build the router and serve until
+    killed (``python -m paddle_tpu.router`` wraps this)."""
+    router = RouterServer(replicas, **kw)
+    try:
+        asyncio.run(_route_async(router, host, port))
+    except KeyboardInterrupt:
+        pass

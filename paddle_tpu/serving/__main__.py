@@ -1,0 +1,119 @@
+"""``python -m paddle_tpu.serving`` — spawn one serving replica as a
+real process (ISSUE 7 satellite; also the ``paddle-tpu-serve`` console
+script).
+
+Argparse rides on top of the existing flag system: every
+``FLAGS_serving_slo_*`` / ``FLAGS_prefix_cache`` / ``FLAGS_metrics``
+knob keeps working via environment or ``--set NAME=VALUE``, while the
+few launch-shape decisions (bind address, model preset, engine
+geometry) get first-class options.  The replica starts with
+``warmup=True`` so ``/readyz`` flips to ready only after the bucket
+compile — a router never routes to it cold.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional
+
+from .. import flags
+
+_PRESETS = ("tiny", "llama2_7b", "llama2_13b", "mixtral_tiny")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="paddle-tpu-serve",
+        description="One paddle_tpu serving replica: OpenAI-compatible "
+                    "streaming /v1/completions over the continuous-"
+                    "batching engine, with /metrics, /healthz, /readyz "
+                    "and /statusz.")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--preset", choices=_PRESETS, default="tiny",
+                   help="model config preset (random-init weights unless "
+                        "--checkpoint is given)")
+    p.add_argument("--checkpoint", default=None,
+                   help="optional paddle_tpu state-dict file to load "
+                        "into the model (paddle.load format)")
+    p.add_argument("--model-name", default=None,
+                   help="name reported in completion responses "
+                        "(default: the preset)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="engine slots (continuous-batching width)")
+    p.add_argument("--max-seq-len", type=int, default=1024)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--prefill-bucket", type=int, default=64)
+    p.add_argument("--num-pages", type=int, default=None,
+                   help="KV pool pages (default: engine sizing rule)")
+    p.add_argument("--max-new-tokens", type=int, default=128,
+                   help="default completion budget when the request "
+                        "omits max_tokens")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="enable the shared-prefix KV cache "
+                        "(FLAGS_prefix_cache for this process)")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip the readiness warmup compile (the replica "
+                        "reports ready immediately; a router may then "
+                        "route onto cold compiles)")
+    p.add_argument("--set", action="append", default=[],
+                   metavar="NAME=VALUE", dest="flag_sets",
+                   help="set any FLAGS_* by name, repeatable "
+                        "(e.g. --set serving_slo_ttft_ms=500)")
+    return p
+
+
+def apply_flag_sets(pairs: List[str]) -> None:
+    """``--set NAME=VALUE`` pairs -> ``flags.set_flags`` (which parses
+    string values by each flag's registered type)."""
+    updates = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"--set expects NAME=VALUE, got {pair!r}")
+        name, value = pair.split("=", 1)
+        updates[name.removeprefix("FLAGS_")] = value
+    try:
+        flags.set_flags(updates)
+    except ValueError as e:
+        raise SystemExit(str(e))
+
+
+def build_engine(args):
+    """Model + engine from parsed args (import-heavy, so deferred)."""
+    import paddle_tpu as paddle
+    from ..inference import ContinuousBatchingEngine, GenerationConfig
+    from ..models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(args.seed)
+    cfg = getattr(LlamaConfig, args.preset)()
+    model = LlamaForCausalLM(cfg)
+    if args.checkpoint:
+        state = paddle.load(args.checkpoint)
+        model.set_state_dict(state)
+    kw = dict(max_batch=args.max_batch,
+              gen=GenerationConfig(max_new_tokens=args.max_new_tokens),
+              max_seq_len=args.max_seq_len, page_size=args.page_size,
+              prefill_bucket=args.prefill_bucket)
+    if args.num_pages is not None:
+        kw["num_pages"] = args.num_pages
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    apply_flag_sets(args.flag_sets)
+    if args.prefix_cache:
+        # single source of truth: the engine's prefix_cache=None default
+        # reads this flag, and /statusz's flag dump stays honest
+        flags.set_flags({"prefix_cache": True})
+    engine = build_engine(args)
+    from .server import serve_forever
+    serve_forever(engine, host=args.host, port=args.port,
+                  model_name=args.model_name or args.preset,
+                  warmup=not args.no_warmup)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,10 +17,19 @@ by any HTTP/1.x client without chunked-decoding support.
 from __future__ import annotations
 
 import json
-from typing import Dict, Tuple
+import re
+from typing import Dict, Optional, Tuple
 
 __all__ = ["HttpError", "read_request", "response", "sse_headers",
-           "sse_event", "sse_done", "json_response", "error_response"]
+           "sse_event", "sse_done", "json_response", "error_response",
+           "SAFE_ID_OK"]
+
+# charset a caller-supplied trace/session id must satisfy to be honored
+# (anything else would leak into trace lanes, log lines, and response
+# headers).  One definition shared by the replica server and the router:
+# the router->replica X-Trace-Id propagation contract depends on both
+# sides accepting the same ids, so the rule must not drift.
+SAFE_ID_OK = re.compile(r"[A-Za-z0-9._:\-]{1,128}").fullmatch
 
 MAX_LINE = 16 * 1024
 MAX_HEADERS = 64
@@ -28,7 +37,8 @@ MAX_BODY = 8 * 1024 * 1024
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             405: "Method Not Allowed", 413: "Payload Too Large",
-            500: "Internal Server Error", 503: "Service Unavailable"}
+            500: "Internal Server Error", 502: "Bad Gateway",
+            503: "Service Unavailable"}
 
 
 class HttpError(Exception):
@@ -110,12 +120,17 @@ def json_response(status: int, obj,
 
 def error_response(status: int, message: str, *,
                    err_type: str = "invalid_request_error",
-                   extra_headers: Tuple[Tuple[str, str], ...] = ()) -> bytes:
-    """OpenAI-shaped error envelope."""
-    return json_response(
-        status, {"error": {"message": message, "type": err_type,
-                           "code": status}},
-        extra_headers=extra_headers)
+                   extra_headers: Tuple[Tuple[str, str], ...] = (),
+                   fields: Optional[Dict[str, object]] = None) -> bytes:
+    """OpenAI-shaped error envelope.  ``fields`` merge into the error
+    object (e.g. ``retry_after_s`` mirroring a ``Retry-After`` header so
+    JSON-only clients see the backoff too)."""
+    err: Dict[str, object] = {"message": message, "type": err_type,
+                              "code": status}
+    if fields:
+        err.update(fields)
+    return json_response(status, {"error": err},
+                         extra_headers=extra_headers)
 
 
 def sse_headers(extra_headers: Tuple[Tuple[str, str], ...] = ()) -> bytes:

@@ -25,9 +25,13 @@ Endpoints:
   true sends SSE chunks per drain; the response/chunk ``id`` is the
   request's trace-context id, the SAME id on its engine lifecycle spans.
 - ``GET /metrics`` — live Prometheus exposition of the whole registry.
-- ``GET /healthz`` — liveness (engine thread up).
+- ``GET /healthz`` — liveness (engine thread up; the pre-ISSUE-7 shape).
+- ``GET /readyz`` — readiness: 503 until the engine's bucket warmup
+  compile has completed (``warmup=True``), so a router never places
+  live traffic on a replica that would compile under it.
 - ``GET /statusz`` — engine/pool/prefix-cache gauges, jit cache stats,
-  SLO burn state, flight-recorder state, build/flag info.
+  SLO burn state, the prefix-residency digest (router placement),
+  flight-recorder state, build/flag info.
 
 Observability wiring: every request carries a trace id from accept
 through retire (one Chrome-trace track), the flight recorder's span ring
@@ -54,6 +58,8 @@ from . import http as _http
 from .slo import SHED, SLOController
 
 __all__ = ["ServingServer", "serve_forever"]
+
+_TRACE_ID_OK = _http.SAFE_ID_OK
 
 
 class _HttpMetrics:
@@ -120,9 +126,14 @@ class ServingServer:
 
     def __init__(self, engine, *, model_name: str = "paddle-tpu",
                  slo=None, flight_recorder=None, watchdog=None,
-                 poll_s: float = 0.02):
+                 poll_s: float = 0.02, warmup: bool = False):
         self.engine = engine
         self.model_name = model_name
+        # readiness (ISSUE 7): with warmup=True the engine thread compiles
+        # the step-program pair on junk traffic before /readyz reports
+        # ready, so a router never places live traffic on a cold replica
+        self._warmup = warmup
+        self._ready = threading.Event()
         self.slo: Optional[SLOController] = \
             SLOController() if slo is None else (slo or None)
         self.flight_recorder: Optional[FlightRecorder] = \
@@ -152,10 +163,16 @@ class ServingServer:
             self.flight_recorder.attach()
         self._stop.clear()
         self._dead = False
+        self._ready.clear()
         self._thread = threading.Thread(target=self._engine_loop,
                                         name="serving-engine", daemon=True)
         self._thread.start()
         return self
+
+    def ready(self) -> bool:
+        """Readiness: the engine thread is up AND (when ``warmup=True``)
+        its bucket warmup compile has completed."""
+        return self.engine_alive() and self._ready.is_set()
 
     def close(self) -> None:
         self._stop.set()
@@ -209,6 +226,9 @@ class ServingServer:
         finish = "server_shutdown"
         flush = False                 # a step ran since the last idle flush
         try:
+            if self._warmup:
+                self._warm()
+            self._ready.set()
             while not self._stop.is_set():
                 while True:
                     try:
@@ -270,6 +290,31 @@ class ServingServer:
                                  "n": len(h.req.output) if h.req else 0}))
             self._live.clear()
 
+    def _warm(self) -> None:
+        """Compile the engine's step-program pair (T=prefill_bucket mixed
+        + T=1 decode) by driving one junk request to completion on the
+        engine thread, BEFORE ``/readyz`` flips to ready.  The warmup
+        prompt is deterministic; with the prefix cache on its few pages
+        land idle in the LRU pool (evicted at the first real pressure)
+        and greedy outputs are unaffected (the PR 4 bit-match contract).
+        """
+        eng = self.engine
+        vocab = eng.g.config.vocab_size
+        n = eng.g.prefill_bucket + 3      # chunked prefill + partial tail
+        # clamp to what the pool physically holds: warmup exists to
+        # compile the step programs (any length crosses the T=bucket and
+        # T=1 programs), not to exercise pool exhaustion — an oversized
+        # warmup prompt on an undersized pool would MemoryError the
+        # engine thread and leave a permanently-unready process behind a
+        # launcher that exited 0
+        alloc = eng.g.cache.allocator
+        n = max(1, min(n, alloc.num_pages * alloc.page_size - 2))
+        prompt = [(i % (vocab - 1)) + 1 for i in range(n)]
+        req = eng.submit(prompt, max_new_tokens=2, trace_id="warmup")
+        while not req.done and not self._stop.is_set():
+            eng.step()
+        eng.step()                        # idle tail-flush drain
+
     def _publish(self) -> None:
         """Diff every live request's drained output; push fresh tokens."""
         eos = self.engine.gen_cfg.eos_token_id
@@ -304,7 +349,7 @@ class ServingServer:
                 writer.write(_http.error_response(e.status, e.message))
                 await writer.drain()
                 return
-            status = await self._route(method, path, body, writer)
+            status = await self._route(method, path, headers, body, writer)
         except (ConnectionError, asyncio.IncompleteReadError):
             status = 499              # client went away mid-stream
         except Exception as e:
@@ -325,7 +370,7 @@ class ServingServer:
             except Exception:
                 pass
 
-    async def _route(self, method, path, body, writer) -> int:
+    async def _route(self, method, path, headers, body, writer) -> int:
         path = path.split("?", 1)[0]
         if path == "/metrics" and method == "GET":
             text = _obs.prometheus_text().encode()
@@ -334,19 +379,31 @@ class ServingServer:
             await writer.drain()
             return 200
         if path == "/healthz" and method == "GET":
+            # liveness, the pre-ISSUE-7 shape: engine thread up.  A cold
+            # (warming) replica is ALIVE here but not ready below.
             alive = self.engine_alive()
             writer.write(_http.json_response(
                 200 if alive else 503,
                 {"status": "ok" if alive else "engine thread down"}))
             await writer.drain()
             return 200 if alive else 503
+        if path == "/readyz" and method == "GET":
+            ready = self.ready()
+            why = ("ok" if ready else
+                   "engine warmup compile in progress"
+                   if self.engine_alive() else "engine thread down")
+            writer.write(_http.json_response(
+                200 if ready else 503, {"ready": ready, "status": why}))
+            await writer.drain()
+            return 200 if ready else 503
         if path == "/statusz" and method == "GET":
             writer.write(_http.json_response(200, self.statusz()))
             await writer.drain()
             return 200
         if path == "/v1/completions" and method == "POST":
-            return await self._completions(body, writer)
-        if path in ("/metrics", "/healthz", "/statusz", "/v1/completions"):
+            return await self._completions(headers, body, writer)
+        if path in ("/metrics", "/healthz", "/readyz", "/statusz",
+                    "/v1/completions"):
             writer.write(_http.error_response(405, f"{method} not allowed"))
             await writer.drain()
             return 405
@@ -376,13 +433,22 @@ class ServingServer:
                 400, f"token ids must be in [0, {vocab})")
         return p
 
-    def _trace_id(self) -> str:
+    def _trace_id(self, headers=None) -> str:
+        """Request id == trace-context id.  A syntactically-safe
+        ``X-Trace-Id`` request header is honored (the multi-replica
+        router propagates its id here so one request is ONE correlated
+        trace track, router span + replica engine spans on one lane);
+        anything else gets a fresh id."""
+        if headers:
+            t = headers.get("x-trace-id", "")
+            if t and _TRACE_ID_OK(t):
+                return t
         with self._rid_lock:
             n = self._next_rid
             self._next_rid += 1
         return f"cmpl-{os.getpid():x}-{n:06x}-{os.urandom(4).hex()}"
 
-    async def _completions(self, body, writer) -> int:
+    async def _completions(self, headers, body, writer) -> int:
         try:
             payload = json.loads(body.decode() or "{}")
             if not isinstance(payload, dict):
@@ -430,16 +496,22 @@ class ServingServer:
             await writer.drain()
             return 503
 
-        # SLO-driven admission: histogram burn, not queue length
+        # SLO-driven admission: histogram burn, not queue length.
+        # Retry-After is derived from the LIVE burn window (how long the
+        # current violation rate takes to dilute back under the shed
+        # threshold at the live observation rate), not a constant, and is
+        # mirrored into the JSON error body for header-blind clients.
         if self.slo is not None and self.slo.decide() == SHED:
+            ra = self.slo.retry_after_s()
             writer.write(_http.error_response(
                 503, "shedding load: serving latency SLO burn "
                      f"(see /statusz)", err_type="overloaded_error",
-                extra_headers=(("Retry-After", "1"),)))
+                extra_headers=(("Retry-After", str(ra)),),
+                fields={"retry_after_s": ra}))
             await writer.drain()
             return 503
 
-        trace_id = self._trace_id()
+        trace_id = self._trace_id(headers)
         h = _Stream(trace_id, prompt, max_tokens,
                     asyncio.get_running_loop())
         self._inbox.put(h)
@@ -558,6 +630,7 @@ class ServingServer:
         out = {
             "uptime_s": round(time.perf_counter() - self._t0, 3),
             "model": self.model_name,
+            "ready": self.ready(),
             "engine": {
                 **eng.last_stats,
                 "waiting": len(eng.waiting),
@@ -565,6 +638,10 @@ class ServingServer:
                 "slots": eng.B,
                 "streams_live": len(self._live),
             },
+            # router placement inputs (ISSUE 7): which prefixes this
+            # replica holds, as chain hashes a router scores against
+            "prefix_digest": eng.prefix_digest()
+            if hasattr(eng, "prefix_digest") else None,
             "slo": self.slo.state() if self.slo is not None else None,
             "flight_recorder": None,
             "jit_cache": _jit.cache_stats(),

@@ -29,6 +29,8 @@ stamps into results.  Cold start (fewer than
 
 from __future__ import annotations
 
+import math
+import time
 from typing import Dict, Optional, Tuple
 
 from .. import flags
@@ -93,6 +95,11 @@ class SLOController:
             k: (0, 0) for k in self._hists}
         self._prev: Dict[str, Tuple[int, int]] = {
             k: (0, 0) for k in self._hists}
+        # wall-clock window epochs + completed-window observation rates:
+        # the live traffic-rate estimate behind retry_after_s()
+        now = time.perf_counter()
+        self._t0: Dict[str, float] = {k: now for k in self._hists}
+        self._prev_rate: Dict[str, float] = {k: 0.0 for k in self._hists}
         self._decisions = {
             d: _metrics.counter("serving.http.slo_decision", decision=d)
             for d in (ADMIT, QUEUE, SHED)}
@@ -105,6 +112,7 @@ class SLOController:
         payload).  Rebases a term's window once it accumulates
         ``window`` fresh observations."""
         out: Dict[str, dict] = {}
+        now = time.perf_counter()
         for name, (h, target) in self._hists.items():
             if target <= 0:
                 continue
@@ -113,11 +121,15 @@ class SLOController:
             if cnt < b_cnt:             # histogram was reset under us
                 self._base[name] = (0, 0)
                 self._prev[name] = (0, 0)
+                self._t0[name] = now
+                self._prev_rate[name] = 0.0
                 b_cnt = b_bad = 0
             dc, db = cnt - b_cnt, bad - b_bad
             if dc >= self.window:
                 self._prev[name] = (dc, db)
                 self._base[name] = (cnt, bad)
+                self._prev_rate[name] = dc / max(now - self._t0[name], 1e-6)
+                self._t0[name] = now
                 dc = db = 0             # current window restarts empty
             pc, pb = self._prev[name]
             n, nbad = dc + pc, db + pb  # previous + current window
@@ -148,11 +160,51 @@ class SLOController:
                 self._shed.inc()
         return decision
 
+    def _obs_per_s(self, name: str) -> float:
+        """Live observation-rate estimate for one term: the current
+        window's throughput, falling back to the last completed window's
+        rate early in a fresh window."""
+        h, _target = self._hists[name]
+        dc = h.count - self._base[name][0]
+        dt = time.perf_counter() - self._t0[name]
+        if dc >= 2 and dt > 0:
+            return dc / dt
+        return self._prev_rate[name]
+
+    def retry_after_s(self) -> int:
+        """``Retry-After`` seconds derived from the LIVE burn window (not
+        a constant): for every term burning past the shed threshold,
+        estimate how many healthy observations it takes to dilute the
+        violation rate back under ``burn * budget`` and divide by the
+        term's live observation rate.  Clamped to [1, 60]s; 1 when no
+        term is burning (shouldn't be asked, but never 0 — clients must
+        always back off at least a beat)."""
+        budget = max(1.0 - self.quantile, 1e-9)
+        worst = 1.0
+        for name, term in self.burn_rates().items():
+            if not term["active"]:
+                continue
+            rate = term["violation_rate"]
+            if rate <= self.burn * budget:
+                continue
+            n = term["window_n"]
+            # healthy obs h with nbad/(n + h) == burn*budget
+            need = (rate * n) / (self.burn * budget) - n
+            per_s = self._obs_per_s(name)
+            if per_s > 0:
+                worst = max(worst, need / per_s)
+            # a burning term with NO live rate estimate (traffic stopped
+            # entirely) keeps the 1s floor: the next probe re-measures
+        return int(min(60.0, math.ceil(worst)))
+
     def state(self) -> dict:
-        """Config + live burn view for /statusz."""
+        """Config + live burn view for /statusz (also what the
+        multi-replica router aggregates fleet admission from)."""
         return {"ttft_ms": self.ttft_ms, "itl_ms": self.itl_ms,
                 "quantile": self.quantile, "burn": self.burn,
                 "min_samples": self.min_samples, "window": self.window,
                 "violation_budget": round(max(1.0 - self.quantile, 0.0), 4),
                 "terms": self.burn_rates(),
+                "decision": self.decide(record=False),
+                "retry_after_s": self.retry_after_s(),
                 "shed_total": int(self._shed.value)}

@@ -1,0 +1,626 @@
+"""Multi-replica router (ISSUE 7): placement, session affinity, SLO
+aggregation, health and failover — all driven through in-process
+transports (InprocReplica wraps real started ServingServers; no
+sockets, so tier-1 stays offline).
+
+The bit-identity oracle is a direct single-engine run: whatever path a
+request takes through the router fleet, greedy outputs must match it
+exactly (the PR 2/PR 4 contract, extended through one more hop).
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import ContinuousBatchingEngine, GenerationConfig
+from paddle_tpu.inference.prefix_cache import block_hashes
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.router import InprocReplica, Placer, ReplicaState, RouterServer
+from paddle_tpu.serving import ServingServer, SLOController
+
+from test_observability import parse_prometheus
+from test_serving_http import (completion_body, http_bytes,
+                               split_response, sse_chunks)
+
+
+# ---------------------------------------------------------------------------
+# fixtures / helpers
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("gen", GenerationConfig(max_new_tokens=6))
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_bucket", 8)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+PROMPTS = ([1, 2, 3, 4, 5], [9, 8, 7], [4, 5, 6, 7])
+
+
+@pytest.fixture(scope="module")
+def oracle(model):
+    eng = _engine(model)
+    rids = [eng.add_request(p) for p in PROMPTS]
+    out = eng.run()
+    return {tuple(p): out[r] for p, r in zip(PROMPTS, rids)}
+
+
+class Fleet:
+    """N started replicas + a router over them, torn down together."""
+
+    def __init__(self, model, n=2, policy="scored", prefix_cache=False,
+                 slo=False, engine_kw=None, **router_kw):
+        self.servers = [
+            ServingServer(_engine(model, prefix_cache=prefix_cache,
+                                  **(engine_kw or {})),
+                          slo=(slo() if callable(slo) else slo),
+                          flight_recorder=False).start()
+            for _ in range(n)]
+        self.replicas = [InprocReplica(f"r{i}", s)
+                         for i, s in enumerate(self.servers)]
+        router_kw.setdefault("health_interval_s", 1e9)
+        self.router = RouterServer(self.replicas, policy=policy,
+                                   **router_kw)
+
+    def close(self):
+        for s in self.servers:
+            s.close()
+
+    def engine(self, i):
+        return self.servers[i].engine
+
+
+async def do(router, method, path, body=None, headers=()):
+    head = [f"{method} {path} HTTP/1.1", "Host: test"]
+    head += [f"{k}: {v}" for k, v in headers]
+    body = body or b""
+    head.append(f"Content-Length: {len(body)}")
+    raw = ("\r\n".join(head) + "\r\n\r\n").encode() + body
+    r = asyncio.StreamReader()
+    r.feed_data(raw)
+    r.feed_eof()
+    from test_serving_http import MemWriter
+    w = MemWriter()
+    await router.handle(r, w)
+    return split_response(w.buf)
+
+
+def completions_via(router, prompt, max_tokens, stream=False, headers=()):
+    return do(router, "POST", "/v1/completions",
+              completion_body(list(prompt), max_tokens, stream=stream),
+              headers=headers)
+
+
+# ---------------------------------------------------------------------------
+# pure placement semantics (no engines)
+# ---------------------------------------------------------------------------
+
+class _FakeClient:
+    def __init__(self, rid):
+        self.id = rid
+
+    def describe(self):
+        return {"id": self.id, "transport": "fake"}
+
+
+def _state(rid, hashes=(), page_size=8, queue=0, ready=True):
+    s = ReplicaState(_FakeClient(rid))
+    s.ok = True
+    s.ready = ready
+    s.page_size = page_size
+    s.digest = frozenset(hashes)
+    s.queue_depth = queue
+    return s
+
+
+def test_placement_scored_prefers_digest_holder():
+    obs.reset("router.")
+    prompt = list(range(1, 33))                  # 4 pages of 8
+    hs = block_hashes(prompt, 8)
+    a = _state("a", hashes=hs[:3])               # holds 3 leading pages
+    b = _state("b")
+    placer = Placer(policy="scored")
+    choice, reason = placer.place(prompt, None, [b, a])
+    assert choice.id == "a" and reason == "prefix"
+    # load can outbid residency: 3 cached pages vs 4 queued requests
+    a.queue_depth = 4
+    placer2 = Placer(policy="scored")
+    choice, reason = placer2.place(prompt, None, [b, a])
+    assert choice.id == "b" and reason == "load"
+
+
+def test_placement_routed_overlay_concentrates_shared_prefixes():
+    """The instant prompt P routes to a replica, P's pages count as
+    resident there — a second request sharing the prefix follows WITHOUT
+    waiting for a /statusz poll to confirm the digest."""
+    prompt = list(range(1, 33))
+    a, b = _state("a"), _state("b")
+    placer = Placer(policy="scored")
+    first, _ = placer.place(prompt, None, [a, b])
+    follow, reason = placer.place(prompt + [77, 78], None, [a, b])
+    assert follow.id == first.id and reason == "prefix"
+
+
+def test_placement_routed_overlay_ages_out_unconfirmed_credits():
+    """An overlay credit the replica's digest never confirms (the pages
+    were evicted replica-side, or never committed) stops scoring as a
+    hit after two /statusz polls; a confirmed credit hands off to the
+    digest and keeps scoring."""
+    prompt = list(range(1, 33))
+    hs = block_hashes(prompt, 8)
+    a, b = _state("a"), _state("b")
+    a.credit_routed(hs, cap=64)
+    assert a.expected_hit_pages(hs) == 4
+    unconfirmed = {"ready": True,
+                   "prefix_digest": {"page_size": 8, "hashes": []}}
+    a.apply_statusz(unconfirmed)   # poll 1: credit may predate admission
+    assert a.expected_hit_pages(hs) == 4
+    a.apply_statusz(unconfirmed)   # poll 2: still absent -> evicted, drop
+    assert a.expected_hit_pages(hs) == 0 and not a.routed
+    b.credit_routed(hs, cap=64)
+    b.apply_statusz({"ready": True,
+                     "prefix_digest": {"page_size": 8,
+                                       "hashes": list(hs)}})
+    assert not b.routed and b.expected_hit_pages(hs) == 4
+
+
+def test_placement_session_affinity_and_lru_cap():
+    prompt = list(range(1, 17))
+    a, b = _state("a"), _state("b")
+    placer = Placer(policy="scored", session_cap=2)
+    pin, _ = placer.place(prompt, "s1", [a, b])
+    # the pinned replica keeps the session even when the other looks
+    # cheaper on load
+    pin.queue_depth = 3
+    again, reason = placer.place(prompt, "s1", [a, b])
+    assert again.id == pin.id and reason == "affinity"
+    # LRU cap: two fresh sessions evict s1
+    placer.place(prompt, "s2", [a, b])
+    placer.place(prompt, "s3", [a, b])
+    assert placer.pinned("s1") is None
+    assert placer.session_state()["evictions"] >= 1
+
+
+def test_placement_round_robin_rotates():
+    a, b = _state("a"), _state("b")
+    placer = Placer(policy="round_robin")
+    seq = [placer.place([1, 2, 3], None, [a, b])[0].id
+           for _ in range(4)]
+    assert seq == ["a", "b", "a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: bit identity through the router
+# ---------------------------------------------------------------------------
+
+def test_router_stream_bit_identical(model, oracle):
+    """Streamed and unary outputs through the router bit-match the
+    direct single-engine oracle; the response carries the router trace
+    id on every chunk AND which replica served it."""
+    fleet = Fleet(model, n=2)
+    try:
+        async def main():
+            outs = await asyncio.gather(
+                completions_via(fleet.router, PROMPTS[0], 6, stream=True),
+                completions_via(fleet.router, PROMPTS[1], 6, stream=False),
+                completions_via(fleet.router, PROMPTS[2], 6, stream=True))
+            return outs
+
+        (s0, h0, b0), (s1, h1, b1), (s2, h2, b2) = asyncio.run(main())
+        assert (s0, s1, s2) == (200, 200, 200)
+        for headers in (h0, h1, h2):
+            assert headers["x-router-replica"] in ("r0", "r1")
+        chunks = sse_chunks(b0)
+        toks = [t for c in chunks for t in c["choices"][0]["token_ids"]]
+        assert toks == oracle[tuple(PROMPTS[0])]
+        assert b0.rstrip().endswith(b"data: [DONE]")
+        # one trace context: every chunk id == X-Request-Id, router-minted
+        ids = {c["id"] for c in chunks}
+        assert ids == {h0["x-request-id"]}
+        assert h0["x-request-id"].startswith("cmpl-rtr-")
+        doc = json.loads(b1)
+        assert doc["choices"][0]["token_ids"] == oracle[tuple(PROMPTS[1])]
+        toks2 = [t for c in sse_chunks(b2)
+                 for t in c["choices"][0]["token_ids"]]
+        assert toks2 == oracle[tuple(PROMPTS[2])]
+    finally:
+        fleet.close()
+
+
+def test_router_trace_id_propagates_to_replica_spans(model):
+    """The router's X-Trace-Id reaches the replica engine: the replica
+    response (relayed back) carries the router-minted id, so one request
+    is ONE correlated trace lane across both processes."""
+    fleet = Fleet(model, n=1)
+    try:
+        status, headers, body = asyncio.run(completions_via(
+            fleet.router, PROMPTS[0], 4, stream=False,
+            headers=(("X-Trace-Id", "tracked-abc123"),)))
+        assert status == 200
+        # the replica honored the propagated id end-to-end
+        assert json.loads(body)["id"] == "tracked-abc123"
+        assert headers["x-request-id"] == "tracked-abc123"
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# session affinity + prefix-aware placement with real caches
+# ---------------------------------------------------------------------------
+
+def test_session_affinity_routes_to_page_holding_replica(model):
+    """Multi-turn session: every turn lands on the SAME replica, whose
+    prefix cache serves the conversation history (hits observed in THAT
+    replica's engine stats; the other replica never sees the session)."""
+    obs.reset("router.")
+    fleet = Fleet(model, n=2, prefix_cache=True,
+                  engine_kw={"gen": GenerationConfig(max_new_tokens=4)})
+    try:
+        base = list(range(1, 33))                # 4 full pages of 8
+        turns = [base,
+                 base + list(range(40, 52)),     # history grows per turn
+                 base + list(range(40, 64))]
+
+        async def run_turns():
+            outs = []
+            for t in turns:
+                outs.append(await completions_via(
+                    fleet.router, t, 4, stream=False,
+                    headers=(("X-Session-Id", "conv-1"),)))
+            return outs
+
+        outs = asyncio.run(run_turns())
+        assert all(o[0] == 200 for o in outs)
+        served = {o[1]["x-router-replica"] for o in outs}
+        assert len(served) == 1                  # pinned to one replica
+        holder = int(served.pop()[1:])
+        other = 1 - holder
+        hold_stats = fleet.engine(holder).stats()
+        other_stats = fleet.engine(other).stats()
+        # turns 2 and 3 hit the history pages on the holding replica
+        assert hold_stats["prefix_hits"] >= 2
+        assert hold_stats["prefix_tokens_saved"] >= 2 * len(base) - 8
+        assert other_stats["prefix_hits"] == 0
+        assert len(fleet.engine(other).completed) == 0
+    finally:
+        fleet.close()
+
+
+def test_scored_placement_without_session_follows_prefix(model):
+    """No session header at all: the routed-overlay digest still sends a
+    shared-prefix follow-up to the replica that cached it."""
+    fleet = Fleet(model, n=2, prefix_cache=True)
+    try:
+        shared = list(range(100, 132))           # 4 full pages
+
+        async def main():
+            a = await completions_via(fleet.router, shared, 4)
+            b = await completions_via(
+                fleet.router, shared + [7, 8, 9], 4)
+            return a, b
+
+        (sa, ha, _), (sb, hb, _) = asyncio.run(main())
+        assert sa == 200 and sb == 200
+        assert ha["x-router-replica"] == hb["x-router-replica"]
+        holder = int(ha["x-router-replica"][1:])
+        assert fleet.engine(holder).stats()["prefix_hits"] >= 1
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# health, readiness, failover
+# ---------------------------------------------------------------------------
+
+def test_router_does_not_route_to_unready_replica(model, oracle):
+    """A cold (never-started) replica reports ready=false — the router
+    places everything on the warm one."""
+    fleet = Fleet(model, n=1)
+    cold = ServingServer(_engine(model), slo=False, flight_recorder=False,
+                         warmup=True)            # NOT started: not ready
+    fleet.replicas.append(InprocReplica("r1", cold))
+    fleet.router = RouterServer(fleet.replicas, policy="scored",
+                                health_interval_s=1e9)
+    try:
+        async def main():
+            outs = [await completions_via(fleet.router, PROMPTS[0], 6)
+                    for _ in range(3)]
+            ready = await do(fleet.router, "GET", "/readyz")
+            statusz = await do(fleet.router, "GET", "/statusz")
+            return outs, ready, statusz
+
+        outs, ready, statusz = asyncio.run(main())
+        for status, headers, body in outs:
+            assert status == 200
+            assert headers["x-router-replica"] == "r0"
+            assert json.loads(body)["choices"][0]["token_ids"] == \
+                oracle[tuple(PROMPTS[0])]
+        assert ready[0] == 200                   # >= 1 replica ready
+        doc = json.loads(statusz[2])
+        states = {r["id"]: r["state"] for r in doc["replicas"]}
+        assert states == {"r0": "ready", "r1": "warming"}
+    finally:
+        fleet.close()
+
+
+def test_replica_warmup_readiness_and_zero_recompile_routing(model):
+    """warmup=True: /readyz flips only after the bucket warmup compile,
+    and warm routed traffic afterwards compiles NOTHING (the acceptance
+    contract: the router never places live traffic on a cold engine)."""
+    server = ServingServer(_engine(model), slo=False,
+                           flight_recorder=False, warmup=True).start()
+    fleet_router = RouterServer([InprocReplica("r0", server)],
+                                health_interval_s=1e9)
+    try:
+        deadline = time.perf_counter() + 120
+        while not server.ready():
+            assert time.perf_counter() < deadline, "warmup never finished"
+            time.sleep(0.02)
+        assert asyncio.run(do(fleet_router, "GET", "/readyz"))[0] == 200
+
+        with obs.assert_overhead(record=True) as rec:
+            async def main():
+                return await asyncio.gather(
+                    completions_via(fleet_router, [6, 7, 8], 6,
+                                    stream=True),
+                    completions_via(fleet_router, [2, 4], 6))
+            outs = asyncio.run(main())
+        assert all(o[0] == 200 for o in outs)
+        assert rec.compiles == 0                 # routed AND warm
+    finally:
+        server.close()
+
+
+def test_failover_kill_replica_mid_stream(model, oracle):
+    """Killing a replica mid-stream fails ONLY its in-flight request —
+    terminated cleanly (finish_reason 'error' + [DONE], never a silent
+    truncation), counted in router.failover — while the next request
+    flows to the survivor and still bit-matches the oracle."""
+    obs.reset("router.")
+    fleet = Fleet(model, n=2)
+    try:
+        async def main():
+            # long enough to straddle several drains
+            victim_prompt = list(PROMPTS[0])
+            r = asyncio.StreamReader()
+            r.feed_data(http_bytes(
+                "POST", "/v1/completions",
+                completion_body(victim_prompt, 64, stream=True)))
+            r.feed_eof()
+            from test_serving_http import MemWriter
+            w = MemWriter()
+            task = asyncio.create_task(fleet.router.handle(r, w))
+            deadline = time.perf_counter() + 60
+            while b"data: " not in w.buf:
+                assert time.perf_counter() < deadline, "no first chunk"
+                await asyncio.sleep(0.005)
+            _, victim_headers, _ = split_response(w.buf)
+            victim = victim_headers["x-router-replica"]
+            # kill the serving replica mid-stream
+            for rep in fleet.replicas:
+                if rep.id == victim:
+                    rep.kill()
+            await asyncio.wait_for(task, 30)     # no hang
+            survivor_out = await completions_via(
+                fleet.router, PROMPTS[1], 6, stream=False)
+            healthz = await do(fleet.router, "GET", "/healthz")
+            statusz = await do(fleet.router, "GET", "/statusz")
+            return w.buf, victim, survivor_out, healthz, statusz
+
+        raw, victim, (s2, h2, b2), healthz, statusz = asyncio.run(main())
+        status, headers, body = split_response(raw)
+        assert status == 200                     # SSE head was out
+        chunks = sse_chunks(body)
+        # clean termination: an explicit error finish, then [DONE]
+        assert chunks[-1]["choices"][0]["finish_reason"] == "error"
+        assert body.rstrip().endswith(b"data: [DONE]")
+        assert obs.metrics.counter("router.failover",
+                                   phase="stream").value >= 1
+        # the very next request succeeds on the survivor, bit-identical
+        assert s2 == 200
+        assert h2["x-router-replica"] != victim
+        assert json.loads(b2)["choices"][0]["token_ids"] == \
+            oracle[tuple(PROMPTS[1])]
+        assert healthz[0] == 200                 # fleet still alive
+        doc = json.loads(statusz[2])
+        dead = {r["id"]: r for r in doc["replicas"]}[victim]
+        assert dead["state"] in ("suspect", "dead")
+    finally:
+        fleet.close()
+
+
+def test_failover_at_connect_replaces_transparently(model, oracle):
+    """A replica dead BEFORE dispatch: the router re-places the request
+    on the next candidate — the client sees a plain 200."""
+    obs.reset("router.")
+    fleet = Fleet(model, n=2)
+    try:
+        async def main():
+            warm = await completions_via(fleet.router, PROMPTS[2], 6)
+            first = warm[1]["x-router-replica"]
+            # kill the OTHER replica so the scored/load choice may well
+            # pick the dead one next — the router must recover silently
+            for rep in fleet.replicas:
+                if rep.id != first:
+                    rep.kill()
+            outs = [await completions_via(fleet.router, PROMPTS[0], 6)
+                    for _ in range(3)]
+            return first, outs
+
+        first, outs = asyncio.run(main())
+        for status, headers, body in outs:
+            assert status == 200
+            assert headers["x-router-replica"] == first
+            assert json.loads(body)["choices"][0]["token_ids"] == \
+                oracle[tuple(PROMPTS[0])]
+    finally:
+        fleet.close()
+
+
+def test_wedged_replica_stream_head_times_out_502(model):
+    """A replica that accepts the dispatch but never writes a response
+    head (process wedged, socket alive) must fail the STREAM request
+    within ``poll_timeout_s`` — a 502 and a failover count, never a
+    client hang (the unary path stays untimed: its head legitimately
+    waits out the whole generation)."""
+    obs.reset("router.")
+    fleet = Fleet(model, n=1, poll_timeout_s=0.2)
+    try:
+        real = fleet.replicas[0]
+
+        class Wedged:
+            """Health polls (GET) pass through so the replica stays a
+            placement candidate; completions (POST) connect fine and
+            then never produce a byte."""
+            id = real.id
+
+            async def open(self, method, path, headers=(), body=b""):
+                if method == "GET":
+                    return await real.open(method, path, headers, body)
+                return asyncio.StreamReader(), (lambda: None)
+
+            def describe(self):
+                return real.describe()
+
+        fleet.router.states[0].client = Wedged()
+        t0 = time.perf_counter()
+        status, headers, body = asyncio.run(completions_via(
+            fleet.router, PROMPTS[0], 4, stream=True))
+        took = time.perf_counter() - t0
+        assert status == 502
+        assert took < 5.0, f"wedged head should time out fast, took {took}"
+        assert obs.metrics.counter("router.failover",
+                                   phase="stream").value >= 1
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# aggregated SLO shedding
+# ---------------------------------------------------------------------------
+
+def test_router_sheds_when_every_replica_burns(model):
+    """Fleet-wide admission: when every live replica's burn window says
+    shed, the router 503s BEFORE dispatch, with Retry-After derived from
+    the soonest replica's live burn window and mirrored in the body."""
+    obs.reset("serving.")
+    obs.reset("router.")
+    mk_slo = lambda: SLOController(ttft_ms=100.0, itl_ms=0.0,  # noqa: E731
+                                   quantile=0.95, burn=2.0,
+                                   min_samples=8, window=64)
+    fleet = Fleet(model, n=2, slo=mk_slo)
+    try:
+        ttft = obs.metrics.histogram("serving.ttft_ms")
+        for _ in range(32):                      # both replicas burn (the
+            ttft.observe(5000.0)                 # in-process registry is
+                                                 # fleet-shared)
+        async def main():
+            await fleet.router.poll_replicas()
+            shed = await completions_via(fleet.router, [1, 2, 3], 2)
+            statusz = await do(fleet.router, "GET", "/statusz")
+            return shed, statusz
+
+        (status, headers, body), statusz = asyncio.run(main())
+        assert status == 503
+        err = json.loads(body)["error"]
+        assert err["type"] == "overloaded_error"
+        ra = int(headers["retry-after"])
+        assert 1 <= ra <= 60
+        assert err["retry_after_s"] == ra
+        assert obs.metrics.counter("router.shed").value >= 1
+        assert obs.metrics.counter("router.slo_decision",
+                                   decision="shed").value >= 1
+        doc = json.loads(statusz[2])
+        assert all(r["slo"]["decision"] == "shed"
+                   for r in doc["replicas"])
+        # neither engine ever saw the request
+        assert all(len(fleet.engine(i).completed) == 0 for i in (0, 1))
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# endpoints
+# ---------------------------------------------------------------------------
+
+def test_router_metrics_healthz_statusz(model):
+    obs.reset("router.")
+    fleet = Fleet(model, n=2)
+    try:
+        async def main():
+            c = await completions_via(fleet.router, PROMPTS[0], 4)
+            m = await do(fleet.router, "GET", "/metrics")
+            h = await do(fleet.router, "GET", "/healthz")
+            s = await do(fleet.router, "GET", "/statusz")
+            nf = await do(fleet.router, "GET", "/nope")
+            bad = await do(fleet.router, "GET", "/v1/completions")
+            return c, m, h, s, nf, bad
+
+        c, m, h, s, nf, bad = asyncio.run(main())
+        assert c[0] == 200
+        assert m[0] == 200
+        fams = parse_prometheus(m[2].decode())
+        for fam in ("paddle_tpu_router_requests",
+                    "paddle_tpu_router_placement",
+                    "paddle_tpu_router_request_ms"):
+            assert fam in fams, fam
+        # the in-process fleet registry aggregates the replicas' serving
+        # series in the SAME scrape
+        assert "paddle_tpu_serving_ttft_ms" in fams
+        assert h[0] == 200
+        assert json.loads(h[2])["replicas_up"] == 2
+        doc = json.loads(s[2])
+        assert doc["policy"] == "scored"
+        assert len(doc["replicas"]) == 2
+        assert {r["state"] for r in doc["replicas"]} == {"ready"}
+        assert doc["sessions"]["cap"] > 0
+        assert nf[0] == 404 and bad[0] == 405
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# launchers (argparse surface only — no sockets, no model build)
+# ---------------------------------------------------------------------------
+
+def test_launcher_arg_surfaces():
+    from paddle_tpu.router.__main__ import build_parser as router_parser
+    from paddle_tpu.router.__main__ import parse_replicas
+    from paddle_tpu.serving.__main__ import apply_flag_sets
+    from paddle_tpu.serving.__main__ import build_parser as serve_parser
+
+    s = serve_parser().parse_args(
+        ["--port", "8001", "--preset", "tiny", "--prefix-cache",
+         "--set", "serving_slo_ttft_ms=500"])
+    assert s.port == 8001 and s.prefix_cache and not s.no_warmup
+
+    from paddle_tpu import flags
+    old = flags.get_flags(["serving_slo_ttft_ms"])
+    try:
+        apply_flag_sets(s.flag_sets)
+        assert flags.flag("serving_slo_ttft_ms") == 500.0
+    finally:
+        flags.set_flags(old)
+    with pytest.raises(SystemExit):
+        apply_flag_sets(["no_such_flag_ever=1"])
+
+    r = router_parser().parse_args(
+        ["--replica", "127.0.0.1:8001", "--replica", "h2:8002",
+         "--policy", "round_robin"])
+    reps = parse_replicas(r.replicas)
+    assert [x.id for x in reps] == ["r0", "r1"]
+    assert (reps[1].host, reps[1].port) == ("h2", 8002)
+    with pytest.raises(SystemExit):
+        parse_replicas(["nocolon"])

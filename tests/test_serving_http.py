@@ -335,8 +335,14 @@ def test_slo_shed_path_503(model):
             server, "POST", "/v1/completions",
             completion_body([1, 2, 3], 2)))
         assert status == 503
-        assert headers["retry-after"] == "1"
-        assert json.loads(body)["error"]["type"] == "overloaded_error"
+        err = json.loads(body)["error"]
+        assert err["type"] == "overloaded_error"
+        # Retry-After is derived from the live burn window (ISSUE 7), not
+        # a constant: a positive integer, mirrored into the JSON body for
+        # header-blind clients, and consistent with the controller's view
+        ra = int(headers["retry-after"])
+        assert 1 <= ra <= 60
+        assert err["retry_after_s"] == ra
         assert shed.value == s0 + 1
         assert obs.metrics.counter("serving.http.slo_decision",
                                    decision="shed").value >= 1
