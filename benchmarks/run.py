@@ -66,6 +66,10 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 RESULTS = pathlib.Path(__file__).resolve().parent / "results"
 
+# per-process cache of the static-analysis stamp (ISSUE 8): the package
+# tree cannot change mid-run, so one analysis serves every config
+_LINT_STAMP = None
+
 
 def _on_tpu():
     import jax
@@ -493,6 +497,25 @@ def main(argv):
         except Exception as e:
             result.setdefault("metrics_error",
                               f"{type(e).__name__}: {str(e)[:120]}")
+        # static-analysis stamp (ISSUE 8): the analyzer version + finding
+        # count over the package this result was produced by, so a bench
+        # record also certifies the tree was invariant-clean.  Computed
+        # once per process (the tree cannot change mid-run) and reused
+        # for every config's result.
+        global _LINT_STAMP
+        if _LINT_STAMP is None:
+            try:
+                from paddle_tpu import analysis as _lint
+                rep = _lint.package_report()
+                _LINT_STAMP = {
+                    "analyzer": rep["analyzer"], "version": rep["version"],
+                    "findings": len(rep["findings"]),
+                    "suppressed": rep["suppressed"],
+                    "counts": rep["counts"]}
+            except Exception as e:
+                _LINT_STAMP = {
+                    "error": f"{type(e).__name__}: {str(e)[:120]}"}
+        result["static_analysis"] = _LINT_STAMP
         path = RESULTS / f"{name}{RESULT_SUFFIX}.json"
         path.write_text(json.dumps(result, indent=2) + "\n")
         print(f"{name}: {json.dumps(result)}")

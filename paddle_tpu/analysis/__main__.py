@@ -1,0 +1,118 @@
+"""CLI: ``python -m paddle_tpu.analysis [paths...]`` / ``paddle-tpu-lint``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .core import ANALYZER_NAME, __version__, rule_catalog, run
+from .reporters import (apply_baseline, render_json, render_text,
+                        write_baseline)
+
+
+def _parse_rules(spec: str) -> set:
+    return {s.strip() for s in spec.split(",") if s.strip()}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="paddle-tpu-lint",
+        description=("repo-native static analysis enforcing the engine's "
+                     "hot-path invariants (JL001-JL007); see "
+                     "docs/jaxlint.md"))
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to analyze (default: "
+                         "./paddle_tpu if present, else the installed "
+                         "paddle_tpu package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", metavar="JLxxx[,..]",
+                    help="run only these rules")
+    ap.add_argument("--ignore", metavar="JLxxx[,..]",
+                    help="skip these rules")
+    ap.add_argument("--baseline", metavar="PATH",
+                    help="filter findings recorded in this baseline file")
+    ap.add_argument("--write-baseline", metavar="PATH",
+                    help="write current findings as a new baseline and "
+                         "exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--version", action="version",
+                    version=f"{ANALYZER_NAME} {__version__}")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, cls in rule_catalog().items():
+            print(f"{rid}  {cls.title}")
+            for line in cls.rationale.split(". "):
+                if line.strip():
+                    print(f"       {line.strip().rstrip('.')}.")
+        return 0
+
+    select = _parse_rules(args.select) if args.select else None
+    ignore = _parse_rules(args.ignore) if args.ignore else None
+    # a typo'd selector must not green-light a dirty tree by running
+    # zero rules and exiting 0
+    known = set(rule_catalog())
+    unknown = ((select or set()) | (ignore or set())) - known
+    if unknown:
+        print(f"{ANALYZER_NAME}: unknown rule id(s): "
+              f"{', '.join(sorted(unknown))} (known: "
+              f"{', '.join(sorted(known))})", file=sys.stderr)
+        return 2
+
+    from pathlib import Path
+    if not args.paths:
+        # the console script must work from any cwd: prefer a local
+        # checkout, fall back to the installed package
+        if Path("paddle_tpu").is_dir():
+            args.paths = ["paddle_tpu"]
+        else:
+            import os
+            args.paths = [os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))]
+
+    # a typo'd path analyzing 0 files must not green-light a dirty tree
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        print(f"{ANALYZER_NAME}: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    try:
+        ctx = run(args.paths, select=select, ignore=ignore)
+    except OSError as e:
+        print(f"{ANALYZER_NAME}: {e}", file=sys.stderr)
+        return 2
+    if ctx.files == 0 and not ctx.parse_errors:
+        print(f"{ANALYZER_NAME}: no python files found under: "
+              f"{', '.join(args.paths)}", file=sys.stderr)
+        return 2
+
+    findings = ctx.findings
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        print(f"{ANALYZER_NAME}: wrote baseline with {len(findings)} "
+              f"entr{'y' if len(findings) == 1 else 'ies'} to "
+              f"{args.write_baseline}")
+        return 0
+    if args.baseline:
+        try:
+            findings, matched = apply_baseline(args.baseline, findings)
+        except (OSError, ValueError) as e:
+            print(f"{ANALYZER_NAME}: bad baseline: {e}", file=sys.stderr)
+            return 2
+
+    out = render_json(ctx, findings) if args.format == "json" \
+        else render_text(ctx, findings)
+    print(out, end="" if out.endswith("\n") else "\n")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:     # `... | head` closed the pipe: not an error
+        sys.exit(0)

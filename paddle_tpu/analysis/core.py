@@ -1,0 +1,283 @@
+"""jaxlint core: findings, suppressions, the rule registry, the runner.
+
+The analysis layer is deliberately stdlib-only (``ast`` + ``tokenize``):
+it must run as a tier-1 gate on any box — no device, no sockets, no jax
+import needed to *parse* the package (importing ``paddle_tpu.analysis``
+does pull in the parent package, but the analyzer itself never imports
+the modules it checks, so a module with a device-only import still
+lints).
+
+Suppression grammar (reason is REQUIRED — a bare disable is itself a
+finding, ``JL000``)::
+
+    x = risky()          # jaxlint: disable=JL002 -- drain-time sync, marked upstream
+    # jaxlint: disable=JL001,JL003 -- static python ints, never traced
+    y = other_risky()
+    # jaxlint: disable-file=JL004 -- fixture module, flags are synthetic
+
+A trailing comment suppresses its own physical line; a comment alone on
+a line suppresses the next line as well; ``disable-file`` suppresses the
+whole module for the listed rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+ANALYZER_NAME = "jaxlint"
+__version__ = "0.1.0"
+
+# JL000 is the meta-rule for malformed suppressions; real rules register
+# below via @register.
+META_RULE = "JL000"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*(disable|disable-file)\s*=\s*"
+    r"(?P<ids>[A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
+    r"(?P<rest>.*)$")
+_REASON_RE = re.compile(r"^\s*--\s*(?P<reason>\S.*)$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str           # run-relative posix path
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> Tuple[str, str, int, int, str]:
+        return (self.path, self.line, self.col, self.rule, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclass
+class _Suppression:
+    lines: Set[int]               # physical lines this comment covers
+    rules: Set[str]               # rule ids; never empty
+    whole_file: bool
+    reason: str
+    comment_line: int
+
+
+class ModuleInfo:
+    """One parsed module: source, AST, parent links, suppressions."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source, filename=rel)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.suppressions: List[_Suppression] = []
+        self.bad_suppressions: List[Finding] = []
+        self._parse_suppressions()
+
+    # -- suppression handling -------------------------------------------
+    def _parse_suppressions(self) -> None:
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError):
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m is None:
+                # only a directive-shaped comment (the tool name followed
+                # by a colon) is a malformed suppression; prose that
+                # merely mentions the tool name is not
+                if re.search(r"#\s*jaxlint\s*:", tok.string):
+                    self.bad_suppressions.append(Finding(
+                        META_RULE, self.rel, tok.start[0], tok.start[1],
+                        "malformed jaxlint suppression (expected "
+                        "'# jaxlint: disable=JLxxx -- <reason>')"))
+                continue
+            ids = {s.strip() for s in m.group("ids").split(",")}
+            rm = _REASON_RE.match(m.group("rest") or "")
+            if rm is None:
+                self.bad_suppressions.append(Finding(
+                    META_RULE, self.rel, tok.start[0], tok.start[1],
+                    f"suppression of {','.join(sorted(ids))} has no reason "
+                    "— append ' -- <why this is intentionally kept>'"))
+                continue
+            line = tok.start[0]
+            whole_line_comment = tok.line[:tok.start[1]].strip() == ""
+            lines = {line} | ({line + 1} if whole_line_comment else set())
+            self.suppressions.append(_Suppression(
+                lines=lines, rules=ids,
+                whole_file=(m.group(1) == "disable-file"),
+                reason=rm.group("reason").strip(), comment_line=line))
+        self._expand_to_statement_spans()
+
+    # simple (body-less) statements only: a trailing comment anywhere on
+    # a black-wrapped multi-line call must cover the whole statement,
+    # but a standalone comment inside a function must NOT expand to the
+    # enclosing def/if block
+    _SIMPLE_STMTS = (ast.Expr, ast.Assign, ast.AugAssign, ast.AnnAssign,
+                     ast.Return, ast.Raise, ast.Assert, ast.Delete)
+
+    def _expand_to_statement_spans(self) -> None:
+        if not self.suppressions:
+            return
+        spans = [(n.lineno, n.end_lineno or n.lineno)
+                 for n in ast.walk(self.tree)
+                 if isinstance(n, self._SIMPLE_STMTS)
+                 and (n.end_lineno or n.lineno) > n.lineno]
+        for s in self.suppressions:
+            extra: Set[int] = set()
+            for line in s.lines:
+                best = None
+                for a, b in spans:
+                    if a <= line <= b and (
+                            best is None or b - a < best[1] - best[0]):
+                        best = (a, b)
+                if best is not None:
+                    extra.update(range(best[0], best[1] + 1))
+            s.lines |= extra
+
+    def allows(self, rule: str, line: int) -> bool:
+        """True when ``rule`` is suppressed (with a reason) at ``line``."""
+        for s in self.suppressions:
+            if rule in s.rules and (s.whole_file or line in s.lines):
+                return True
+        return False
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+
+class Rule:
+    """Base rule.  Subclasses set ``rule_id``/``title``/``rationale`` and
+    implement ``visit`` (per module); cross-module rules also implement
+    ``finalize`` (called once after every module was visited)."""
+
+    rule_id: str = ""
+    title: str = ""
+    rationale: str = ""
+
+    def visit(self, mod: ModuleInfo, ctx: "RunContext") -> None:
+        raise NotImplementedError
+
+    def finalize(self, ctx: "RunContext") -> None:  # pragma: no cover
+        pass
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator adding a rule to the process-wide catalog."""
+    if not cls.rule_id or cls.rule_id in _REGISTRY:
+        raise ValueError(f"bad or duplicate rule id: {cls.rule_id!r}")
+    _REGISTRY[cls.rule_id] = cls
+    return cls
+
+
+def rule_catalog() -> Dict[str, type]:
+    from . import rules  # noqa: F401  (import registers the catalog)
+    return dict(sorted(_REGISTRY.items()))
+
+
+@dataclass
+class RunContext:
+    """Mutable state of one analyzer run."""
+
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    files: int = 0
+    parse_errors: List[Finding] = field(default_factory=list)
+
+    def report(self, mod: ModuleInfo, rule: str, node, message: str) -> None:
+        line = getattr(node, "lineno", 0) if not isinstance(node, int) \
+            else node
+        col = getattr(node, "col_offset", 0) if not isinstance(node, int) \
+            else 0
+        if mod.allows(rule, line):
+            self.suppressed += 1
+            return
+        self.findings.append(Finding(rule, mod.rel, line, col, message))
+
+
+def _iter_py_files(paths: Sequence[Path]) -> Iterable[Path]:
+    for p in paths:
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" in f.parts:
+                    continue
+                yield f
+
+
+def _relpath(f: Path, roots: Sequence[Path]) -> str:
+    for root in roots:
+        try:
+            base = root if root.is_dir() else root.parent
+            return f.resolve().relative_to(base.resolve().parent).as_posix()
+        except ValueError:
+            continue
+    return f.as_posix()
+
+
+def make_rules(select: Optional[Set[str]] = None,
+               ignore: Optional[Set[str]] = None) -> Dict[str, Rule]:
+    return {rid: cls() for rid, cls in rule_catalog().items()
+            if (select is None or rid in select)
+            and (ignore is None or rid not in ignore)}
+
+
+def analyze_modules(mods: Sequence[ModuleInfo], active: Dict[str, Rule],
+                    ctx: RunContext) -> RunContext:
+    """THE analyze loop — shared by ``run`` and ``analyze_source`` so the
+    fixture-test entry point cannot drift from the real one."""
+    for mod in mods:
+        ctx.findings.extend(mod.bad_suppressions)
+        for rule in active.values():
+            rule.visit(mod, ctx)
+    for rule in active.values():
+        rule.finalize(ctx)
+    ctx.findings.extend(ctx.parse_errors)
+    ctx.findings.sort(key=Finding.key)
+    return ctx
+
+
+def run(paths: Sequence[str], select: Optional[Set[str]] = None,
+        ignore: Optional[Set[str]] = None) -> RunContext:
+    """Analyze every ``*.py`` under ``paths`` with the selected rules."""
+    active = make_rules(select, ignore)
+    ctx = RunContext()
+    roots = [Path(p) for p in paths]
+    mods: List[ModuleInfo] = []
+    for f in _iter_py_files(roots):
+        rel = _relpath(f, roots)
+        try:
+            src = f.read_text(encoding="utf-8")
+            mod = ModuleInfo(f, rel, src)
+        except (SyntaxError, UnicodeDecodeError, ValueError) as e:
+            # ValueError: ast.parse on NUL bytes — one corrupt file must
+            # not kill the whole run
+            ctx.parse_errors.append(Finding(
+                META_RULE, rel, getattr(e, "lineno", 0) or 0, 0,
+                f"could not parse: {type(e).__name__}: {e}"))
+            continue
+        ctx.files += 1
+        mods.append(mod)
+    return analyze_modules(mods, active, ctx)

@@ -1,0 +1,72 @@
+"""jaxlint output: text/JSON rendering and baseline files.
+
+A baseline is the incremental-adoption tool: ``--write-baseline`` stamps
+today's findings into a JSON file keyed by (rule, path, message) with
+counts — line numbers are deliberately NOT part of the key, so ordinary
+edits above a known finding don't resurrect it — and ``--baseline``
+filters up to that many matching findings per key on later runs.  New
+findings (or more of an existing kind) still fail the run.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from .core import ANALYZER_NAME, Finding, RunContext, __version__
+
+
+def render_text(ctx: RunContext, findings: List[Finding]) -> str:
+    lines = [f.render() for f in findings]
+    counts = Counter(f.rule for f in findings)
+    summary = (f"{ANALYZER_NAME} {__version__}: {len(findings)} finding(s) "
+               f"in {ctx.files} file(s)"
+               + (f", {ctx.suppressed} suppressed" if ctx.suppressed else ""))
+    if counts:
+        summary += " [" + ", ".join(
+            f"{r}={n}" for r, n in sorted(counts.items())) + "]"
+    return "\n".join(lines + [summary])
+
+
+def render_json(ctx: RunContext, findings: List[Finding]) -> str:
+    return json.dumps({
+        "analyzer": ANALYZER_NAME,
+        "version": __version__,
+        "files": ctx.files,
+        "suppressed": ctx.suppressed,
+        "counts": dict(Counter(f.rule for f in findings)),
+        "findings": [{"rule": f.rule, "path": f.path, "line": f.line,
+                      "col": f.col, "message": f.message}
+                     for f in findings],
+    }, indent=2) + "\n"
+
+
+def _baseline_key(f: Finding) -> str:
+    return f"{f.rule}|{f.path}|{f.message}"
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    counts: Counter = Counter(_baseline_key(f) for f in findings)
+    Path(path).write_text(json.dumps({
+        "analyzer": ANALYZER_NAME, "version": __version__,
+        "entries": dict(sorted(counts.items())),
+    }, indent=2) + "\n", encoding="utf-8")
+
+
+def apply_baseline(path: str,
+                   findings: List[Finding]) -> Tuple[List[Finding], int]:
+    """Filter findings present in the baseline; returns (kept, matched)."""
+    data = json.loads(Path(path).read_text(encoding="utf-8"))
+    budget: Dict[str, int] = dict(data.get("entries", {}))
+    kept: List[Finding] = []
+    matched = 0
+    for f in findings:
+        k = _baseline_key(f)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            matched += 1
+        else:
+            kept.append(f)
+    return kept, matched

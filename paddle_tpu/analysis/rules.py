@@ -1,0 +1,652 @@
+"""jaxlint rule catalog (JL001–JL007).
+
+Every rule is distilled from a bug class actually hit and fixed in this
+repo's history (PRs 1–7); the rationale strings cite the incident.  The
+rules are heuristic AST checks: they aim for zero false positives on
+idiomatic code, and anything intentionally kept carries an inline
+``# jaxlint: disable=JLxxx -- <reason>`` suppression at the site.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import astutil as A
+from .core import ModuleInfo, Rule, RunContext, register
+
+# modules whose function bodies are the serving/train hot path: the
+# JL002 sync discipline applies here (everywhere else the eager
+# Paddle-API compat layer legitimately syncs on user request)
+_HOT_PATH = ("/inference/", "/serving/", "/kernels/")
+_HOT_SUFFIX = ("models/pretrain.py",)
+
+# window (physical lines, same function, either side) within which a
+# ``count_sync()`` call marks an adjacent sync as intentional
+_SYNC_MARK_WINDOW = 8
+
+_UPPER_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+
+
+def _is_hot_path(rel: str) -> bool:
+    r = "/" + rel.replace("\\", "/")
+    return any(p in r for p in _HOT_PATH) or r.endswith(_HOT_SUFFIX)
+
+
+def _enum_literal(node: ast.AST) -> bool:
+    """A bounded-enum iterable: constants, UPPER_CASE constant names, or
+    a tuple/list of those (``for d in (ADMIT, QUEUE, SHED)``)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return bool(_UPPER_RE.match(node.id))
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return all(_enum_literal(e) for e in node.elts)
+    return False
+
+
+def _enclosing_loop_iter(mod: ModuleInfo,
+                         name_node: ast.Name) -> Optional[ast.AST]:
+    """The iterable of the innermost for-loop/comprehension binding
+    ``name_node``, or None.  Innermost binding wins (shadowing); both
+    the JL004 enum-read and JL006 enum-label predicates derive from
+    this single traversal."""
+    def targets(t: ast.AST) -> Set[str]:
+        return {n.id for n in ast.walk(t) if isinstance(n, ast.Name)}
+
+    cur = mod.parents.get(name_node)
+    while cur is not None:
+        if isinstance(cur, ast.For) and name_node.id in targets(cur.target):
+            return cur.iter
+        if isinstance(cur, (ast.ListComp, ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp)):
+            for gen in cur.generators:
+                if name_node.id in targets(gen.target):
+                    return gen.iter
+        cur = mod.parents.get(cur)
+    return None
+
+
+def _bound_by_literal_loop(mod: ModuleInfo, name_node: ast.Name) -> bool:
+    """True when ``name_node`` is bound by an enclosing loop over a
+    bounded-enum iterable (the enum loop idiom)."""
+    it = _enclosing_loop_iter(mod, name_node)
+    return it is not None and _enum_literal(it)
+
+
+def _literal_loop_values(mod: ModuleInfo,
+                         name_node: ast.Name) -> Optional[List[str]]:
+    """String elements of the literal iterable binding ``name_node``
+    through an enclosing for/comprehension, if any."""
+    it = _enclosing_loop_iter(mod, name_node)
+    if isinstance(it, (ast.Tuple, ast.List)):
+        vals = [e.value for e in it.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+        if len(vals) == len(it.elts):
+            return vals
+    return None
+
+
+# ---------------------------------------------------------------- JL001 --
+
+@register
+class PallasIntScalars(Rule):
+    rule_id = "JL001"
+    title = "raw Python int scalars inside Pallas kernel bodies"
+    rationale = (
+        "Python-int divisors, `.at[]` semaphore indices, loop bounds and "
+        "clip bounds become i64 literals under x64; the i64->i32 "
+        "convert_element_type they force breaks Mosaic lowering (the PR 2 "
+        "round-4 recursion bug).  In-kernel int scalars must be np.int32 "
+        "and integer division jax.lax.div / jax.lax.rem.")
+
+    _CLIP_CALLS = {"clip", "minimum", "maximum"}
+    _LOOP_CALLS = {"fori_loop", "while_loop"}
+
+    def visit(self, mod: ModuleInfo, ctx: RunContext) -> None:
+        for fn in A.kernel_functions(mod.tree):
+            for node in ast.walk(fn):
+                self._check(mod, ctx, fn, node)
+
+    def _check(self, mod, ctx, fn, node) -> None:
+        if isinstance(node, ast.BinOp) and \
+                isinstance(node.op, (ast.FloorDiv, ast.Mod)):
+            if not (A.int_literal(node.left) and A.int_literal(node.right)):
+                op = "//" if isinstance(node.op, ast.FloorDiv) else "%"
+                ctx.report(mod, self.rule_id, node,
+                           f"`{op}` on traced values in Pallas kernel "
+                           f"`{fn.name}` — use jax.lax.div/jax.lax.rem "
+                           "with np.int32 operands (python-int division "
+                           "lowers through i64 under x64 and breaks "
+                           "Mosaic)")
+        elif isinstance(node, ast.Subscript):
+            v = node.value
+            if isinstance(v, ast.Attribute) and v.attr == "at":
+                elts = node.slice.elts if isinstance(node.slice, ast.Tuple) \
+                    else [node.slice]
+                for e in elts:
+                    if A.int_literal(e):
+                        ctx.report(mod, self.rule_id, e,
+                                   "raw Python int index in `.at[...]` in "
+                                   f"Pallas kernel `{fn.name}` — wrap "
+                                   "semaphore/ref indices in np.int32")
+        elif isinstance(node, ast.Call):
+            tail = A.last_attr(node)
+            if tail in self._LOOP_CALLS:
+                # fori_loop(lower, upper, body, init) / while_loop(cond,
+                # body, init): bounds AND the init carry must be int32
+                idxs = (0, 1, 3) if tail == "fori_loop" else (2,)
+                for i in idxs:
+                    if i < len(node.args) and A.int_literal(node.args[i]):
+                        ctx.report(mod, self.rule_id, node.args[i],
+                                   f"raw Python int bound/carry to "
+                                   f"`{tail}` in Pallas kernel "
+                                   f"`{fn.name}` — use an np.int32 "
+                                   "constant (a bare int is i64 under "
+                                   "x64)")
+            elif tail in self._CLIP_CALLS:
+                for arg in node.args:
+                    if A.int_literal(arg):
+                        ctx.report(mod, self.rule_id, arg,
+                                   f"raw Python int bound in `{tail}` in "
+                                   f"Pallas kernel `{fn.name}` — wrap in "
+                                   "np.int32 (int clip bounds embed i64 "
+                                   "constants under x64)")
+
+
+# ---------------------------------------------------------------- JL002 --
+
+@register
+class HiddenHostSync(Rule):
+    rule_id = "JL002"
+    title = "sync-forcing calls on the serving/train hot path"
+    rationale = (
+        "`.item()`, `bool()/float()/int()` on device arrays, np.asarray, "
+        "jax.device_get and block_until_ready each force a host<->device "
+        "round trip; on the engine step / train step they serialize the "
+        "dispatch pipeline (PR 5's zero-added-syncs overhead contract).  "
+        "Intentional syncs (the drain) must be marked with "
+        "observability.count_sync() at the site so assert_overhead can "
+        "hold the contract.")
+
+    _HARD_SYNCS = {"item", "block_until_ready", "device_get"}
+    _CASTS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+              "bool", "float", "int"}
+    # device-expression marker inside a cast argument: `jnp.` is the
+    # device namespace; bare `jax.` would also match host-side utilities
+    # (jax.devices(), jax.tree_util...) and over-fire
+    _DEVICE_MARK = "jnp."
+
+    def visit(self, mod: ModuleInfo, ctx: RunContext) -> None:
+        jitted = A.jitted_functions(mod.tree)
+        hot = _is_hot_path(mod.rel)
+        if not hot and not jitted:
+            return
+        marks = self._count_sync_lines(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = A.last_attr(node)
+            if tail in self._HARD_SYNCS and isinstance(
+                    node.func, (ast.Attribute, ast.Name)):
+                if tail == "item" and (node.args or node.keywords):
+                    continue
+                # ancestor walk only for actual sync candidates — this
+                # runs over every module in the tier-1 gate
+                in_jit = any(self._encloses(mod, j, node) for j in jitted)
+                if in_jit:
+                    ctx.report(mod, self.rule_id, node,
+                               f"`{tail}` inside a jitted function — a "
+                               "traced value cannot be synced; hoist the "
+                               "read out of the jitted body")
+                elif hot and not self._marked(mod, node, marks):
+                    ctx.report(mod, self.rule_id, node,
+                               f"sync-forcing `{tail}` on the hot path — "
+                               "mark an intentional drain with "
+                               "observability.count_sync() beside it, or "
+                               "move it off the engine/train step")
+            elif hot and A.dotted(node.func) in self._CASTS and node.args:
+                src = ast.unparse(node.args[0])
+                if self._DEVICE_MARK in src and \
+                        not self._marked(mod, node, marks):
+                    d = A.dotted(node.func)
+                    ctx.report(mod, self.rule_id, node,
+                               f"`{d}(...)` of a device expression on the "
+                               "hot path forces a device->host transfer — "
+                               "mark it with observability.count_sync() "
+                               "or keep the value on device")
+
+    @staticmethod
+    def _encloses(mod: ModuleInfo, outer: ast.AST, node: ast.AST) -> bool:
+        cur = mod.parents.get(node)
+        while cur is not None:
+            if cur is outer:
+                return True
+            cur = mod.parents.get(cur)
+        return False
+
+    @staticmethod
+    def _count_sync_lines(mod: ModuleInfo) -> Dict[ast.AST, List[int]]:
+        """count_sync() call lines grouped by enclosing function."""
+        out: Dict[ast.AST, List[int]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    A.last_attr(node) == "count_sync":
+                fn = mod.enclosing_function(node)
+                out.setdefault(fn, []).append(node.lineno)
+        return out
+
+    def _marked(self, mod: ModuleInfo, node: ast.Call,
+                marks: Dict[ast.AST, List[int]]) -> bool:
+        fn = mod.enclosing_function(node)
+        return any(abs(line - node.lineno) <= _SYNC_MARK_WINDOW
+                   for line in marks.get(fn, ()))
+
+
+# ---------------------------------------------------------------- JL003 --
+
+@register
+class RecompileHazard(Rule):
+    rule_id = "JL003"
+    title = "warm-path recompile hazards"
+    rationale = (
+        "Zero warm recompiles is the engine contract (PR 2, telemetry-"
+        "asserted).  A jax.jit wrapper built and invoked in one "
+        "expression compiles on EVERY call; a static_argnums spec "
+        "computed at the call site varies the cache key; Python "
+        "branching on a traced parameter inside a jitted body either "
+        "fails at trace time or silently bakes one branch in.")
+
+    def visit(self, mod: ModuleInfo, ctx: RunContext) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                self._check_call(mod, ctx, node)
+        for fn, static in A.jitted_functions(mod.tree).items():
+            self._check_traced_branching(mod, ctx, fn, static)
+
+    def _check_call(self, mod, ctx, node: ast.Call) -> None:
+        # jit-wrapped-and-immediately-invoked: jax.jit(f)(args)
+        if isinstance(node.func, ast.Call) and \
+                A.dotted(node.func.func) in A.JIT_NAMES:
+            ctx.report(mod, self.rule_id, node,
+                       "jax.jit(...)(...) compiles on every call — hoist "
+                       "the wrapper to module scope or cache it on the "
+                       "instance")
+        # call-site-varying static spec
+        d = A.dotted(node.func)
+        if d in A.JIT_NAMES or (d in A.PARTIAL_NAMES and node.args and
+                                A.dotted(node.args[0]) in A.JIT_NAMES):
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnames") and \
+                        not A.literal_only(kw.value):
+                    ctx.report(mod, self.rule_id, node,
+                               f"{kw.arg} computed at the call site — a "
+                               "varying static spec defeats the jit "
+                               "cache; spell the spec as a literal")
+
+    _SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "aval"}
+    _SAFE_CALLS = {"isinstance", "len", "callable", "hasattr", "getattr"}
+
+    def _check_traced_branching(self, mod, ctx, fn, static: Set[str]) -> None:
+        args = fn.args
+        params = {p.arg for p in args.posonlyargs + args.args +
+                  args.kwonlyargs} - static - {"self", "cls"}
+        if not params:
+            return
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            bad = self._traced_ref(mod, node.test, params)
+            if bad:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                ctx.report(mod, self.rule_id, node,
+                           f"`{kind}` on traced parameter `{bad}` inside "
+                           f"jitted `{fn.name}` — Python branching on a "
+                           "tracer recompiles per value or bakes one "
+                           "branch in; use lax.cond/jnp.where or mark "
+                           "the argument static")
+
+    def _traced_ref(self, mod: ModuleInfo, test: ast.AST,
+                    params: Set[str]) -> Optional[str]:
+        for name in ast.walk(test):
+            if not (isinstance(name, ast.Name) and name.id in params):
+                continue
+            if self._safe_context(mod, name, test):
+                continue
+            return name.id
+        return None
+
+    def _safe_context(self, mod: ModuleInfo, name: ast.Name,
+                      test: ast.AST) -> bool:
+        # p.shape / p.ndim / p.dtype…, len(p), isinstance(p, …),
+        # `p is None` — all static at trace time
+        cur: ast.AST = name
+        parent = mod.parents.get(cur)
+        while parent is not None:
+            if isinstance(parent, ast.Attribute) and \
+                    parent.attr in self._SAFE_ATTRS:
+                return True
+            if isinstance(parent, ast.Call) and \
+                    A.dotted(parent.func) in self._SAFE_CALLS:
+                return True
+            # `is`/`is not` are identity checks; `in`/`not in` with the
+            # parameter as the CONTAINER is the static dict/pytree-
+            # membership idiom (`if "ef" in state:`) — structure, not
+            # values.  The param as the MEMBER (`if x in (1, 2):`) is a
+            # genuine trace-time bool() on a tracer and stays flagged.
+            if isinstance(parent, ast.Compare):
+                ops_ok = all(isinstance(op, (ast.Is, ast.IsNot, ast.In,
+                                             ast.NotIn))
+                             for op in parent.ops)
+                has_membership = any(isinstance(op, (ast.In, ast.NotIn))
+                                     for op in parent.ops)
+                if ops_ok and not (has_membership and cur is parent.left):
+                    return True
+            if parent is test or isinstance(
+                    parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            cur, parent = parent, mod.parents.get(parent)
+        return False
+
+
+# ---------------------------------------------------------------- JL004 --
+
+@register
+class FlagHygiene(Rule):
+    rule_id = "JL004"
+    title = "flag registry hygiene"
+    rationale = (
+        "The flag registry (flags.py + per-module define_flag) is the "
+        "tuning surface every bench/launcher reaches for; a read of an "
+        "unregistered flag is a KeyError at runtime on exactly the box "
+        "you cannot reach (the chip-capture queue), and a registered-"
+        "but-never-read flag is dead configuration that silently lies "
+        "about being a knob.")
+
+    def __init__(self):
+        self.defines: Dict[str, Tuple[ModuleInfo, ast.AST]] = {}
+        self.reads: Dict[str, List[Tuple[ModuleInfo, ast.AST]]] = {}
+        self.dynamic_reads = 0
+        self.registry_seen = False
+
+    def visit(self, mod: ModuleInfo, ctx: RunContext) -> None:
+        # the rule is whole-package: it only reports when the registry
+        # home (the module DEFINING define_flag) is in the analyzed set,
+        # so a single-subtree run never mislabels reads as unregistered
+        if any(isinstance(n, ast.FunctionDef) and n.name == "define_flag"
+               for n in ast.walk(mod.tree)):
+            self.registry_seen = True
+        flag_aliases = self._flag_fn_aliases(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = A.last_attr(node)
+            d = A.dotted(node.func)
+            if tail == "define_flag" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                self.defines.setdefault(node.args[0].value, (mod, node))
+            elif (tail == "flag" and (d is None or d.endswith(".flag")
+                                      or d == "flag")) \
+                    or (d in flag_aliases):
+                self._record_read(mod, node)
+            elif tail == "get_flags" and node.args:
+                self._record_get_flags(mod, node)
+            elif tail == "set_flags" and node.args and \
+                    isinstance(node.args[0], ast.Dict):
+                for k in node.args[0].keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        name = k.value.removeprefix("FLAGS_")
+                        self.reads.setdefault(name, []).append((mod, k))
+
+    @staticmethod
+    def _flag_fn_aliases(mod: ModuleInfo) -> Set[str]:
+        """Local names bound to the flag reader: ``f = flags.flag``."""
+        out: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, (ast.Attribute, ast.Name)):
+                d = A.dotted(node.value)
+                if d and (d.endswith(".flag") or d == "flag"):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+        return out
+
+    def _record_read(self, mod: ModuleInfo, node: ast.Call) -> None:
+        if not node.args:
+            return
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            self.reads.setdefault(arg.value.removeprefix("FLAGS_"),
+                                  []).append((mod, node))
+        elif isinstance(arg, ast.Name):
+            vals = _literal_loop_values(mod, arg)
+            if vals is not None:
+                for v in vals:
+                    self.reads.setdefault(v.removeprefix("FLAGS_"),
+                                          []).append((mod, node))
+            else:
+                self.dynamic_reads += 1
+        else:
+            self.dynamic_reads += 1
+
+    def _record_get_flags(self, mod: ModuleInfo, node: ast.Call) -> None:
+        arg = node.args[0]
+        names: List[str] = []
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            names = [arg.value]
+        elif isinstance(arg, (ast.List, ast.Tuple)):
+            names = [e.value for e in arg.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str)]
+        for n in names:
+            self.reads.setdefault(n.removeprefix("FLAGS_"),
+                                  []).append((mod, node))
+
+    def finalize(self, ctx: RunContext) -> None:
+        if not self.registry_seen or not self.defines:
+            return  # subtree run without the registry in scope
+        for name, sites in sorted(self.reads.items()):
+            if name not in self.defines:
+                mod, node = sites[0]
+                ctx.report(mod, self.rule_id, node,
+                           f"flag `{name}` is read but never registered "
+                           "with define_flag — a KeyError at first use")
+        if self.dynamic_reads:
+            return  # cannot prove a flag dead past unresolved dynamic reads
+        if not self.reads:
+            return  # registry-only run (no reader modules in scope)
+        for name, (mod, node) in sorted(self.defines.items()):
+            if name not in self.reads:
+                ctx.report(mod, self.rule_id, node,
+                           f"flag `{name}` is registered but never read — "
+                           "dead configuration (wire it or delete it)")
+
+
+# ---------------------------------------------------------------- JL005 --
+
+@register
+class AsyncBlockingCall(Rule):
+    rule_id = "JL005"
+    title = "blocking calls inside async handlers"
+    rationale = (
+        "serving/ and router/ run one asyncio event loop for every "
+        "connection; one time.sleep / file read / subprocess in a "
+        "handler stalls EVERY live stream (head-of-line blocking the "
+        "PR 6/7 front door exists to avoid).  Blocking work belongs on "
+        "the engine thread or in run_in_executor.")
+
+    # urllib.request is the I/O submodule; bare "urllib." would flag the
+    # pure-CPU urllib.parse helpers every HTTP server legitimately uses
+    _DOTTED_PREFIXES = ("subprocess.", "socket.", "shutil.", "requests.",
+                        "urllib.request.")
+    _DOTTED_EXACT = {"time.sleep", "os.system", "os.popen", "os.waitpid",
+                     "input", "open", "io.open"}
+    _BLOCKING_ATTRS = {"read_text", "write_text", "read_bytes",
+                       "write_bytes"}
+
+    def visit(self, mod: ModuleInfo, ctx: RunContext) -> None:
+        r = "/" + mod.rel.replace("\\", "/")
+        if "/serving/" not in r and "/router/" not in r:
+            return
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            # nested sync defs are skipped: a sync closure is exactly
+            # what gets handed to run_in_executor
+            for node in A.walk_function_body(fn, into_nested=False):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = A.dotted(node.func)
+                tail = A.last_attr(node)
+                blocking = (
+                    d in self._DOTTED_EXACT
+                    or (d is not None and
+                        d.startswith(self._DOTTED_PREFIXES))
+                    or tail in self._BLOCKING_ATTRS)
+                if blocking:
+                    ctx.report(mod, self.rule_id, node,
+                               f"blocking call `{d or tail}` inside "
+                               f"async `{fn.name}` — it stalls every "
+                               "live stream on this loop; use the "
+                               "asyncio equivalent or run_in_executor")
+
+
+# ---------------------------------------------------------------- JL006 --
+
+@register
+class UnboundedMetricLabels(Rule):
+    rule_id = "JL006"
+    title = "metric labels fed from unbounded request data"
+    rationale = (
+        "Every distinct label value is a new series; labeling by request "
+        "id / session id / prompt text grows the registry until the "
+        "FLAGS_metrics_max_series guard starts folding real telemetry "
+        "into __overflow__ (the PR 5/6 cardinality incident class).  "
+        "Label values must come from literals, bounded enums, or casts "
+        "of small scalars.")
+
+    _METRIC_CALLS = {"counter", "gauge", "histogram"}
+    _CAST_CALLS = {"str", "int", "round", "bool"}
+
+    def visit(self, mod: ModuleInfo, ctx: RunContext) -> None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or \
+                    A.last_attr(node) not in self._METRIC_CALLS:
+                continue
+            if not node.args or not self._is_family_name(node.args[0]):
+                continue  # jnp.histogram(arr, ...) etc., not a metric
+            fam = node.args[0]
+            if isinstance(fam, ast.JoinedStr) and not \
+                    self._bounded_joined(fam):
+                # a family name interpolated from request data explodes
+                # the registry exactly like an unbounded label would
+                ctx.report(mod, self.rule_id, node,
+                           "metric FAMILY name interpolated from an "
+                           "unbounded expression — per-request family "
+                           "names explode the registry; interpolate "
+                           "plain variables/constants only")
+            bad = [kw.arg for kw in node.keywords
+                   if kw.arg is not None and kw.arg != "bounds"
+                   and not self._bounded(mod, kw.value)]
+            if bad:
+                ctx.report(mod, self.rule_id, node,
+                           "metric label(s) "
+                           + ", ".join(f"`{b}`" for b in bad)
+                           + " fed from an unbounded expression — label "
+                           "values must be literals, enum loops, or "
+                           "scalar casts (per-request values explode the "
+                           "series cardinality)")
+
+    @staticmethod
+    def _bounded_joined(fam: ast.JoinedStr) -> bool:
+        """f-string family parts must be plain variables or constants
+        (`f"{name}.steps"`), not attribute/subscript/call expressions
+        (`f"req.{req.request_id}"`)."""
+        return all(isinstance(v.value, (ast.Name, ast.Constant))
+                   for v in fam.values
+                   if isinstance(v, ast.FormattedValue))
+
+    @staticmethod
+    def _is_family_name(arg: ast.AST) -> bool:
+        """Metric families are string names: a literal, an f-string, or
+        an UPPER_CASE constant — an array positional arg means this is
+        numpy/jnp histogram(), not the registry."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return True
+        if isinstance(arg, ast.JoinedStr):
+            return True
+        return isinstance(arg, ast.Name) and bool(_UPPER_RE.match(arg.id))
+
+    def _bounded(self, mod: ModuleInfo, v: ast.AST) -> bool:
+        if isinstance(v, ast.Constant):
+            return True
+        if isinstance(v, ast.Name):
+            return bool(_UPPER_RE.match(v.id)) or \
+                _bound_by_literal_loop(mod, v)
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) and \
+                v.func.id in self._CAST_CALLS and len(v.args) == 1 and \
+                isinstance(v.args[0], (ast.Name, ast.Constant)):
+            return True
+        if isinstance(v, ast.IfExp):
+            return self._bounded(mod, v.body) and \
+                self._bounded(mod, v.orelse)
+        return False
+
+
+# ---------------------------------------------------------------- JL007 --
+
+@register
+class EngineSingleOwner(Rule):
+    rule_id = "JL007"
+    title = "direct engine calls from asyncio handler code"
+    rationale = (
+        "The ContinuousBatchingEngine is single-owner: its state is "
+        "device arrays chained between dispatches, owned by the engine "
+        "thread (PR 6).  An engine METHOD call from an asyncio handler "
+        "races the step loop; handlers must post through the inbox "
+        "(submit()/the _Stream seam).  Attribute READS of engine config "
+        "are fine — only calls fire.")
+
+    _ENGINE_SEGMENTS = {"engine", "_engine"}
+
+    def visit(self, mod: ModuleInfo, ctx: RunContext) -> None:
+        r = "/" + mod.rel.replace("\\", "/")
+        if "/serving/" not in r and "/router/" not in r:
+            return
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            aliases = self._engine_aliases(fn)
+            for node in A.walk_function_body(fn, into_nested=False):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute):
+                    continue
+                segs = A.attr_segments(node.func.value)
+                if not segs:
+                    continue
+                rooted = any(s in self._ENGINE_SEGMENTS for s in segs) or \
+                    segs[0] in aliases
+                if rooted:
+                    ctx.report(mod, self.rule_id, node,
+                               f"engine method `{node.func.attr}()` "
+                               f"called from async `{fn.name}` — the "
+                               "engine is single-owner (engine thread); "
+                               "post through the inbox instead")
+
+    def _engine_aliases(self, fn: ast.AsyncFunctionDef) -> Set[str]:
+        # only `x = self.engine` (chain ENDING in the engine) aliases the
+        # engine object itself; `cfg = self.engine.config` is a read of a
+        # plain value and calling methods on it is fine
+        out: Set[str] = set()
+        for node in A.walk_function_body(fn, into_nested=False):
+            if isinstance(node, ast.Assign):
+                segs = A.attr_segments(node.value)
+                if segs and segs[-1] in self._ENGINE_SEGMENTS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+        return out

@@ -159,8 +159,29 @@ def _check_nan_inf(name, arrays):
             if not bool(jnp.isfinite(a).all()):
                 if cfg is not None and not cfg.report(name, a):
                     continue                   # CHECK-only modes: log, go on
+                if flags.flag("check_nan_inf_level") >= 1:
+                    # level >=1 (reference FLAGS_check_nan_inf_level):
+                    # report statistics only, never abort
+                    import sys
+                    print(f"[paddle_tpu check_nan_inf] op '{name}': "
+                          f"{int(jnp.isnan(a).sum())} NaN, "
+                          f"{int(jnp.isinf(a).sum())} Inf "
+                          f"in {a.shape} {a.dtype}", file=sys.stderr)
+                    continue
                 raise FloatingPointError(
                     f"NaN/Inf detected in output of op '{name}'")
+
+
+def _log_memory_stats(name):
+    """FLAGS_log_memory_stats: one-line live-buffer census after each
+    eager op (the reference's allocator stat logging; here backed by the
+    device.memory_debug live-array census since PJRT owns allocation)."""
+    import sys
+
+    from ..device.memory_debug import live_arrays_report
+    rep = live_arrays_report(top=0)
+    print(f"[paddle_tpu memory] after '{name}': {rep['total_arrays']} "
+          f"live arrays, {rep['total_bytes']} bytes", file=sys.stderr)
 
 
 from ..utils.cache import LruCache
@@ -346,6 +367,8 @@ def apply(name: str, prim: Callable, tensor_args: Sequence, kwargs: dict | None 
                     out = prim(*arrays, **kwargs)
         if flags.flag("check_nan_inf") and not tracing:
             _check_nan_inf(name, out if isinstance(out, (tuple, list)) else (out,))
+        if flags.flag("log_memory_stats") and not tracing:
+            _log_memory_stats(name)
         res = _wrap_outputs(out, None)
         if _STATIC_RECORD_HOOK is not None:
             _STATIC_RECORD_HOOK(name, prim, kwargs, tensor_args, res)
@@ -428,6 +451,8 @@ def apply(name: str, prim: Callable, tensor_args: Sequence, kwargs: dict | None 
     )
     if flags.flag("check_nan_inf"):
         _check_nan_inf(name, flat)
+    if flags.flag("log_memory_stats"):
+        _log_memory_stats(name)
     res = _wrap_outputs(out, node)
     if _STATIC_RECORD_HOOK is not None:
         _STATIC_RECORD_HOOK(name, prim, kwargs, tensor_args, res)

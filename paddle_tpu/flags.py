@@ -64,6 +64,7 @@ define_flag("eager_op_jit", True, "Cache per-op jitted executables in eager mode
 define_flag("log_memory_stats", False, "Log live buffer stats after each op.")
 define_flag("enable_async_trace", False, "Collective watchdog tracing.")
 define_flag("comm_timeout_s", 600, "Collective/barrier watchdog timeout in seconds.")
+# jaxlint: disable=JL004 -- reference-API parity: user scripts set_flags this; XLA/PJRT owns device memory so the value is intentionally unread
 define_flag("allocator_strategy", "auto_growth", "Kept for API parity; XLA/PJRT owns device memory.")
 define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest.")
 define_flag("flash_attention_block_q", 512, "Pallas flash attention query block.")

@@ -153,8 +153,10 @@ def _tune(kind, key, M, K, N, E, bm, dtype):
             bn_, bk_ = cand
             f = jax.jit(lambda a, b: tgmm(a, b, tg, E, bm=bm, bn=bn_,
                                           bk=bk_))
-            f(lhs, rhs).block_until_ready()      # compile outside the timer
-            return lambda: f(lhs, rhs).block_until_ready()
+            # compile outside the timer; blocking IS the measurement
+            # jaxlint: disable=JL002 -- autotune timing harness runs at tuning time, not in the engine step
+            f(lhs, rhs).block_until_ready()
+            return lambda: f(lhs, rhs).block_until_ready()  # jaxlint: disable=JL002 -- autotune timing harness, see above
     else:
         trans = kind == "gmm_t"
         rhs = jnp.ones((E, N, K) if trans else (E, K, N), dtype)
@@ -163,8 +165,9 @@ def _tune(kind, key, M, K, N, E, bm, dtype):
             bn_, bk_ = cand
             f = jax.jit(lambda a, b: gmm(a, b, tg, bm=bm, bn=bn_, bk=bk_,
                                          trans_rhs=trans))
+            # jaxlint: disable=JL002 -- autotune timing harness runs at tuning time, not in the engine step
             f(lhs, rhs).block_until_ready()
-            return lambda: f(lhs, rhs).block_until_ready()
+            return lambda: f(lhs, rhs).block_until_ready()  # jaxlint: disable=JL002 -- autotune timing harness, see above
 
     return autotune.lookup_or_tune(key, cands, bench, None)
 
@@ -346,10 +349,14 @@ def _tgmm_kernel(*refs, nm, bm, bk, bn, lfused, rfused, rscaled):
 
     m = pl.program_id(2)
     g_here = group_ref[m]
+    # neighbor-row clamps stay np.int32: a bare python 0 is an i64 under
+    # x64 mode and the i64->i32 convert breaks Mosaic (the PR 2 class)
     first = jnp.logical_or(m == 0,
-                           group_ref[jnp.maximum(m - 1, 0)] != g_here)
+                           group_ref[jnp.maximum(m - 1, np.int32(0))]
+                           != g_here)
     last = jnp.logical_or(
-        m == nm - 1, group_ref[jnp.minimum(m + 1, nm - 1)] != g_here)
+        m == nm - 1,
+        group_ref[jnp.minimum(m + 1, np.int32(nm - 1))] != g_here)
 
     @pl.when(first)
     def _init():

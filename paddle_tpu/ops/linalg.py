@@ -20,6 +20,11 @@ def _t(x):
 
 
 def _precision():
+    if flags.flag("use_deterministic_ops"):
+        # deterministic lowering: pin MXU matmuls to highest precision —
+        # no bf16 multi-pass decomposition, so accumulation order (and
+        # the result bits) stop depending on the autotuned pass split
+        return "highest"
     p = flags.flag("tpu_matmul_precision")
     return None if p == "default" else p
 

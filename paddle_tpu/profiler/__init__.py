@@ -97,6 +97,7 @@ class RecordEvent:
             self._t0 = None
             dt = time.perf_counter() - t0
             if self._hist is None:
+                # jaxlint: disable=JL006 -- RecordEvent names are code literals at their call sites (developer-bounded), and the max_series guard caps the family
                 self._hist = _metrics.histogram(
                     _EVENT_FAMILY, event=self.name,
                     type=self.event_type.name)
