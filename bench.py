@@ -797,6 +797,97 @@ def _run_serve_prefix(on_tpu):
     }
 
 
+def _run_spec_decode(on_tpu):
+    """ISSUE 9: speculative-decoding A/B (`benchmarks/run.py spec_decode`)
+    — the continuous-batching engine on a repetitive-suffix traffic mix
+    (templated/extraction-style prompts whose tail repeats a short
+    pattern), spec OFF vs ngram/fused at K in {4, 8}.  Same requests,
+    same weights, fresh engine per arm; every spec arm's greedy outputs
+    must bit-match the spec-off arm, and each arm stamps its acceptance
+    rate and committed tokens-per-dispatch from the engine's drain-time
+    spec books."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                      GenerationConfig)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        n_req, slots, max_seq, page, bucket = 32, 8, 1024, 32, 128
+        head_len, pat_len, pat_reps, budget = 64, 8, 32, 96
+    else:
+        cfg = LlamaConfig.tiny()
+        n_req, slots, max_seq, page, bucket = 16, 4, 384, 16, 64
+        head_len, pat_len, pat_reps, budget = 24, 6, 12, 40
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    prompts = []
+    for _ in range(n_req):
+        head = list(rng.integers(1, cfg.vocab_size, head_len))
+        pat = list(rng.integers(1, cfg.vocab_size, pat_len))
+        prompts.append(head + pat * pat_reps)
+    # ONE warmup prompt shared by every arm (drawn once — the arms must
+    # see bit-identical traffic end to end, warmup included)
+    warm = list(rng.integers(1, cfg.vocab_size, bucket + 3))
+    total_tokens = n_req * budget
+
+    def arm(spec, k):
+        eng = ContinuousBatchingEngine(
+            model, max_batch=slots,
+            gen=GenerationConfig(max_new_tokens=budget),
+            max_seq_len=max_seq, page_size=page, prefill_bucket=bucket,
+            spec_decode=spec, spec_k=k)
+        eng.add_request(warm, max_new_tokens=4)    # compile all programs
+        eng.run()
+        rids = [eng.add_request(p) for p in prompts]
+        t0 = time.perf_counter()
+        res = eng.run()
+        dt = time.perf_counter() - t0
+        outs = [res[r] for r in rids]
+        stats = eng.stats()
+        del eng
+        return sum(len(o) for o in outs) / dt, stats, outs
+
+    off_tps, off_stats, base = arm("", 4)
+    out = {
+        "spec_decode_requests": n_req,
+        "spec_decode_prompt_len": head_len + pat_len * pat_reps,
+        "spec_decode_budget": budget,
+        "spec_decode_total_tokens": total_tokens,
+        "spec_decode_off_tok_per_sec": round(off_tps, 1),
+        "spec_decode_off_stats_zero": bool(
+            not off_stats["spec_decode_enabled"]),
+    }
+    best = off_tps
+    for mode in ("ngram", "fused"):
+        for k in (4, 8):
+            tps, st, outs = arm(mode, k)
+            drafted = st["spec_drafted_tokens"]
+            steps = max(st["spec_steps"], 1)
+            tag = f"spec_decode_{mode}_k{k}"
+            out[f"{tag}_tok_per_sec"] = round(tps, 1)
+            out[f"{tag}_speedup"] = round(tps / max(off_tps, 1e-9), 3)
+            out[f"{tag}_accept_rate"] = round(
+                st["spec_accepted_tokens"] / drafted, 3) if drafted else 0.0
+            out[f"{tag}_tokens_per_dispatch"] = round(
+                st["spec_committed_tokens"] / steps, 3)
+            out[f"{tag}_drafted"] = drafted
+            out[f"{tag}_accepted"] = st["spec_accepted_tokens"]
+            out[f"{tag}_rejected"] = st["spec_rejected_tokens"]
+            out[f"{tag}_bit_match"] = bool(outs == base)
+            best = max(best, tps)
+    out["spec_decode_best_speedup"] = round(best / max(off_tps, 1e-9), 3)
+    return out
+
+
 def _hist_record(h):
     """Summary + populated buckets of a registry histogram, JSON-able."""
     return {**h.summary(), "buckets": h.nonzero_buckets()}
@@ -1280,6 +1371,7 @@ _EXTRAS = (("large", _run_large), ("decode", _run_decode),
            ("dit", _run_dit), ("flash", _run_flash_autotune),
            ("grad_comm", _run_grad_comm),
            ("serve_prefix", _run_serve_prefix),
+           ("spec_decode", _run_spec_decode),
            ("serve", _run_serve_metrics),
            ("http_serve", _run_http_serve),
            ("router_serve", _run_router_serve))

@@ -5,7 +5,7 @@ Usage:
     python benchmarks/run.py [config ...] [--cpu] [--fused-gather=0|1]
                              [--trace=PATH]
 configs: resnet gpt2 llama dit moe decode serve http_serve router_serve
-         all (default: all)
+         spec_decode all (default: all)
 
 --fused-gather pins FLAGS_grouped_matmul_fused_gather for the run (A/B of
 the in-kernel MoE dispatch gather; the =0 arm writes <config>_nofuse.json).
@@ -278,6 +278,17 @@ def run_serve_prefix():
     return out
 
 
+def run_spec_decode():
+    """ISSUE 9: speculative-decoding A/B (`python benchmarks/run.py
+    spec_decode --cpu`) — the continuous-batching engine on a
+    repetitive-suffix mix, spec OFF vs prompt-lookup ngram verification
+    and fused K-step decode at K in {4, 8}.  Stamps every arm's tok/s,
+    acceptance rate, committed tokens-per-dispatch and the bit-match
+    flag vs the off arm into results/spec_decode.json."""
+    import bench
+    return {"config": "spec_decode", **bench._run_spec_decode(_on_tpu())}
+
+
 def run_serve():
     """ISSUE 5: serving observability A/B (`python benchmarks/run.py serve
     --cpu`) — continuous-batching engine with metrics ON vs OFF: TTFT/ITL/
@@ -320,7 +331,8 @@ def run_http_serve():
 CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
            "dit": run_dit, "moe": run_moe, "decode": run_decode,
            "longctx": run_longctx, "grad_comm": run_grad_comm,
-           "serve_prefix": run_serve_prefix, "serve": run_serve,
+           "serve_prefix": run_serve_prefix, "spec_decode": run_spec_decode,
+           "serve": run_serve,
            "http_serve": run_http_serve, "router_serve": run_router_serve}
 
 

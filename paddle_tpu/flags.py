@@ -101,6 +101,28 @@ define_flag("prefix_cache_min_pages", 1,
             "Minimum cached-prefix length IN PAGES for an admission to "
             "take a prefix-cache hit; shorter matches prefill from "
             "scratch (guards against sharing overhead on tiny matches).")
+define_flag("spec_decode", "",
+            "Speculative decoding mode for the serving engine "
+            "(inference/speculative.py): '' = off (bit-identical to the "
+            "plain engine), 'ngram' = prompt-lookup speculation — a "
+            "host-rebuilt token-history table drives a DEVICE-side n-gram "
+            "drafter, and K tokens are verified in ONE mixed-mode ragged "
+            "dispatch at the T=spec_k bucket with device-resident "
+            "longest-accepted-prefix acceptance, 'fused' = K sequential "
+            "decode steps fused into one jitted dispatch (the self-draft "
+            "degenerate case; amortizes host->device dispatch latency). "
+            "Greedy outputs in both modes bit-match the spec-off oracle.")
+define_flag("spec_k", 4,
+            "Tokens per speculative dispatch: the verify step runs at the "
+            "T=spec_k query bucket ('ngram' proposes spec_k-1 draft "
+            "tokens per step), 'fused' commits up to spec_k tokens per "
+            "dispatch.  Bucketed so warm spec steps never recompile.")
+define_flag("spec_ngram_max", 3,
+            "Longest n-gram context the device-side drafter matches "
+            "against the request's prompt+output history (longest match "
+            "wins, most recent occurrence breaks ties; shorter contexts "
+            "are fallbacks).  History is rebuilt host-side at drain time "
+            "only — spec steps issue zero extra host<->device syncs.")
 define_flag("metrics", True,
             "Process-wide metrics registry collection on the serving/train "
             "hot paths (paddle_tpu/observability/): per-request TTFT/ITL "

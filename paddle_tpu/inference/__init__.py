@@ -23,12 +23,14 @@ from .generation import (ContinuousBatchingEngine, GenerationConfig,
                          LlamaGenerator, Request, generate)
 from .kv_cache import PagedKVCache, PageAllocator
 from .prefix_cache import PrefixCache, serving_stats
+from .speculative import SpecConfig, SpecHistory, resolve_spec_config
 
 __all__ = [
     "Config", "Predictor", "create_predictor", "PredictorTensor",
     "GenerationConfig", "LlamaGenerator", "generate",
     "ContinuousBatchingEngine", "Request",
     "PagedKVCache", "PageAllocator", "PrefixCache", "serving_stats",
+    "SpecConfig", "SpecHistory", "resolve_spec_config",
 ]
 
 

@@ -54,6 +54,7 @@ from ..kernels.paged_attention import (paged_attention,
 from ..kernels.rms_norm import rms_norm_fp32
 from ..models.llama import LlamaConfig, LlamaForCausalLM, _rope_cos_sin
 from ..utils import extract_params, stack_params
+from . import speculative as _sp
 from .kv_cache import PagedKVCache
 
 
@@ -226,48 +227,63 @@ class LlamaGenerator:
             "blocks": blocks,
         }
 
-    def _step_jit(self, gc: GenerationConfig, t: int):
-        """The fused serving step, jitted for (sampling config, q bucket)."""
-        key = (gc._key(), t)
+    def _step_jit(self, gc: GenerationConfig, t: int, track_recent=False):
+        """The fused serving step, jitted for (sampling config, q bucket).
+        ``track_recent`` (ngram spec engines) threads the drafter's
+        recent-token ring through the step as extra chained state."""
+        key = (gc._key(), t, bool(track_recent))
         if key not in self._jit_cache:
             import functools
             self._jit_cache[key] = jax.jit(
-                functools.partial(self._step_fn, gc, t),
+                functools.partial(self._step_fn, gc, t, bool(track_recent)),
                 donate_argnums=(1, 2))
         return self._jit_cache[key]
 
-    # ---- the ONE engine step ----
-    def _step_fn(self, gc, T, params, kc, vc, tokens, q_lens, positions,
-                 finished, decode_mask, commit_mask, counts, budgets,
-                 block_tables, key):
-        """One fused serving step: admit (slots derived in-jit) →
-        ragged attention over every layer → ONE batched KV commit → sample.
+    def _spec_jit(self, gc: GenerationConfig, k: int, nmax: int):
+        """The T=K speculative verify step (ISSUE 9, ngram mode), jitted
+        per (sampling config, K, drafter context) — K is bucketed, so
+        warm spec steps never recompile."""
+        key = ("spec", gc._key(), k, nmax)
+        if key not in self._jit_cache:
+            import functools
+            self._jit_cache[key] = jax.jit(
+                functools.partial(self._spec_verify_fn, gc, k, nmax),
+                donate_argnums=(1, 2))
+        return self._jit_cache[key]
 
-        tokens:      [B, T] — this step's query tokens (decode rows use
-                     column 0; prefill rows their prompt chunk).
-        q_lens:      [B] — valid tokens per row (0 = idle row).
-        positions:   [B] — cache tokens BEFORE this step (write cursor).
-        decode_mask: [B] — rows whose column-0 token is generated output
-                     (EOS is only checked on generated tokens, never on
-                     prompt tokens).
-        commit_mask: [B] — rows whose sample this step is a real generated
-                     token (decode rows + the final prompt chunk).
-        counts/budgets: [B] — generated-so-far / max_new_tokens per row;
-                     the budget freeze happens on device.
-        All of it device-resident and chained between calls — the host
-        loop is sync-free.
+    def _fused_jit(self, gc: GenerationConfig, k: int):
+        """The fused K-steps-per-dispatch decode program (ISSUE 9, fused
+        mode): K sequential T=1 steps unrolled in ONE jitted dispatch."""
+        key = ("fused", gc._key(), k)
+        if key not in self._jit_cache:
+            import functools
+            self._jit_cache[key] = jax.jit(
+                functools.partial(self._fused_decode_fn, gc, k),
+                donate_argnums=(1, 2))
+        return self._jit_cache[key]
+
+    # ---- the shared transformer core of every serving step ----
+    def _forward_tokens(self, params, kc, vc, tokens, ql, positions,
+                        block_tables):
+        """Run the whole model over this step's query tokens: derive write
+        slots in-jit from the block table, stream every layer through the
+        mixed-mode ``ragged_paged_attention`` kernel (the step's own K/V
+        rows fold in causally), commit all layers' fresh KV in ONE batched
+        scatter, and return the final-norm hidden states for ALL T
+        positions.  Callers own freeze semantics, sampling and
+        bookkeeping — this core is shared verbatim by the plain step, the
+        T=K speculative verify step and the fused K-step decode loop, so
+        a prefill chunk, a decode token and a draft verification are
+        literally the same program shape.
+
+        tokens: [B, T] int32 (don't-care cols may hold drafter pad values
+        — embedding lookups clip, and their slots are routed to -1 / not
+        attended).  ql: [B] valid tokens per row (0 = inert row).
+        positions: [B] cache tokens BEFORE this step (the write cursor).
         """
         c = self.config
-        B = tokens.shape[0]
+        B, T = tokens.shape
         page = self.page_size
-
-        if gc.eos_token_id is not None:
-            finished = jnp.logical_or(
-                finished,
-                jnp.logical_and(decode_mask, tokens[:, 0] == gc.eos_token_id))
-        # a sequence that filled the cache freezes (no slot rewrite)
-        finished = jnp.logical_or(finished, positions >= self.max_seq_len)
-        ql = jnp.where(finished, 0, q_lens).astype(jnp.int32)
 
         # token positions & write slots, derived in-jit from the block table
         offs = jnp.arange(T, dtype=jnp.int32)
@@ -282,7 +298,8 @@ class LlamaGenerator:
         cos = jnp.take(self._cos, pos_c, axis=0)          # [B, T, d/2]
         sin = jnp.take(self._sin, pos_c, axis=0)
         ctx_prev = jnp.minimum(positions, self.max_seq_len).astype(jnp.int32)
-        h = jnp.take(params["embed"], tokens, axis=0)     # [B, T, H]
+        toks = jnp.clip(tokens, 0, params["embed"].shape[0] - 1)
+        h = jnp.take(params["embed"], toks, axis=0)       # [B, T, H]
 
         def layer(carry, xs):
             x, = carry
@@ -324,6 +341,43 @@ class LlamaGenerator:
             v_all.reshape(L, B * T, kvh, dh), slots)
 
         h = rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
+        return h, kc, vc
+
+    # ---- the ONE engine step ----
+    def _step_fn(self, gc, T, track_recent, params, kc, vc, tokens, q_lens,
+                 positions, finished, decode_mask, commit_mask, counts,
+                 budgets, block_tables, key, recent=None):
+        """One fused serving step: admit (slots derived in-jit) →
+        ragged attention over every layer → ONE batched KV commit → sample.
+
+        tokens:      [B, T] — this step's query tokens (decode rows use
+                     column 0; prefill rows their prompt chunk).
+        q_lens:      [B] — valid tokens per row (0 = idle row).
+        positions:   [B] — cache tokens BEFORE this step (write cursor).
+        decode_mask: [B] — rows whose column-0 token is generated output
+                     (EOS is only checked on generated tokens, never on
+                     prompt tokens).
+        commit_mask: [B] — rows whose sample this step is a real generated
+                     token (decode rows + the final prompt chunk).
+        counts/budgets: [B] — generated-so-far / max_new_tokens per row;
+                     the budget freeze happens on device.
+        recent:      [B, nmax] (``track_recent`` only) — the ngram
+                     drafter's ring of last committed tokens, appended to
+                     on every committing row so the verify step's context
+                     is exact even across prefill/mixed steps.
+        All of it device-resident and chained between calls — the host
+        loop is sync-free.
+        """
+        if gc.eos_token_id is not None:
+            finished = jnp.logical_or(
+                finished,
+                jnp.logical_and(decode_mask, tokens[:, 0] == gc.eos_token_id))
+        # a sequence that filled the cache freezes (no slot rewrite)
+        finished = jnp.logical_or(finished, positions >= self.max_seq_len)
+        ql = jnp.where(finished, 0, q_lens).astype(jnp.int32)
+
+        h, kc, vc = self._forward_tokens(params, kc, vc, tokens, ql,
+                                         positions, block_tables)
         last_ix = jnp.maximum(ql - 1, 0)
         last = jnp.take_along_axis(h, last_ix[:, None, None], axis=1)[:, 0]
         logits = (last @ params["head"]).astype(jnp.float32)
@@ -334,11 +388,129 @@ class LlamaGenerator:
         new_positions = jnp.where(
             finished, positions,
             jnp.minimum(positions + ql, self.max_seq_len))
-        counts = counts + jnp.where(
-            jnp.logical_and(commit_mask, jnp.logical_not(finished)), 1, 0)
+        committed = jnp.logical_and(commit_mask, jnp.logical_not(finished))
+        counts = counts + jnp.where(committed, 1, 0)
         finished = jnp.logical_or(finished, counts >= budgets)
-        return (out_tokens, new_positions, finished, jnp.all(finished),
-                counts, kc, vc, key)
+        out = (out_tokens, new_positions, finished, jnp.all(finished),
+               counts, kc, vc, key)
+        if track_recent:
+            recent = _sp.shift_append(recent, out_tokens[:, None],
+                                      committed.astype(jnp.int32))
+            return out + (recent,)
+        return out
+
+    # ---- ISSUE 9: the T=K speculative verify step (ngram mode) ----
+    def _spec_verify_fn(self, gc, K, nmax, params, kc, vc, last_tok, recent,
+                        hist, hist_len, positions, finished, counts,
+                        budgets, write_caps, block_tables, key):
+        """One speculative decode dispatch: draft K-1 tokens on device
+        from the history table, verify all of them in ONE mixed-mode
+        T=K forward, commit the longest accepted prefix plus the bonus
+        token, and roll back everything else — all device-resident.
+
+        Rollback is positional: rejected rows' KV was written but
+        ``positions`` only advances by the commit count, so the ragged
+        kernel (which masks by context length) can never read a stale
+        row, and the cursor overwrites it in place when real tokens reach
+        it.  Greedy outputs bit-match sequential decoding because a
+        draft is only accepted when it EQUALS the verifier's own argmax.
+
+        Returns (sampled [B,K], n_commit [B], drafted [B], last_tok,
+        positions, finished, all_done, counts, recent, kc, vc, key).
+        """
+        if gc.eos_token_id is not None:
+            # EOS on the chained input token: the prefill handoff case —
+            # the final prompt chunk's sample is EOS-checked here exactly
+            # like the plain decode step checks its column-0 input
+            finished = jnp.logical_or(finished,
+                                      last_tok == gc.eos_token_id)
+        finished = jnp.logical_or(finished, positions >= self.max_seq_len)
+        drafts, draft_len = _sp.lookup_drafts(hist, hist_len, recent, K,
+                                              nmax)
+        # structural write-coverage guarantee: never write past the pages
+        # the block table actually owns (``write_caps`` = tokens covered),
+        # whatever the host's growth managed under pool pressure — a
+        # capped row just commits fewer tokens this dispatch and resumes
+        cap_room = jnp.maximum(write_caps - positions, 0)
+        ql = jnp.where(finished, 0,
+                       jnp.minimum(1 + draft_len, cap_room)).astype(jnp.int32)
+        drafted = jnp.maximum(ql - 1, 0)          # drafts actually dispatched
+        tokens = jnp.concatenate([last_tok[:, None], drafts], axis=1)
+
+        h, kc, vc = self._forward_tokens(params, kc, vc, tokens, ql,
+                                         positions, block_tables)
+        B = tokens.shape[0]
+        logits = (h @ params["head"]).astype(jnp.float32)      # [B, K, V]
+        key, sub = jax.random.split(key)
+        # one independent key per position: token-level sequential
+        # sampling semantics (greedy ignores the key entirely)
+        sampled = _sample(logits.reshape(B * K, -1), sub, gc).reshape(B, K)
+
+        n_commit = _sp.accept_length(tokens, sampled, ql)
+        if gc.eos_token_id is not None:
+            n_commit, hit_eos = _sp.eos_clamp(sampled, n_commit,
+                                              gc.eos_token_id)
+            finished = jnp.logical_or(finished, hit_eos)
+        n_commit = jnp.minimum(n_commit, jnp.maximum(budgets - counts, 0))
+        n_commit = jnp.minimum(n_commit,
+                               jnp.maximum(self.max_seq_len - positions, 0))
+        counts = counts + n_commit
+        finished = jnp.logical_or(finished, counts >= budgets)
+        positions = positions + n_commit
+        finished = jnp.logical_or(finished, positions >= self.max_seq_len)
+
+        picked = jnp.take_along_axis(
+            sampled, jnp.maximum(n_commit - 1, 0)[:, None], axis=1)[:, 0]
+        last_tok = jnp.where(n_commit > 0, picked, last_tok)
+        recent = _sp.shift_append(recent, sampled, n_commit)
+        return (sampled, n_commit, drafted, last_tok, positions, finished,
+                jnp.all(finished), counts, recent, kc, vc, key)
+
+    # ---- ISSUE 9: fused K-steps-per-dispatch decode (fused mode) ----
+    def _fused_decode_fn(self, gc, K, params, kc, vc, last_tok, positions,
+                         finished, counts, budgets, write_caps,
+                         block_tables, key):
+        """K sequential T=1 decode steps unrolled inside ONE jitted
+        program — the host dispatches once per K tokens (the self-draft
+        degenerate case of speculation: every token is committed, so
+        this purely amortizes host->device dispatch latency).  Each
+        unrolled step replays the plain step's freeze semantics exactly
+        (input-EOS check, capacity freeze, budget freeze), so committed
+        tokens form a prefix of the [B, K] output and greedy outputs
+        bit-match the sequential engine.
+
+        Returns (out [B,K], n_commit [B], last_tok, positions, finished,
+        all_done, counts, kc, vc, key).
+        """
+        outs, n_commit = [], None
+        tok = last_tok
+        for _ in range(K):
+            if gc.eos_token_id is not None:
+                finished = jnp.logical_or(finished, tok == gc.eos_token_id)
+            finished = jnp.logical_or(finished,
+                                      positions >= self.max_seq_len)
+            # structural write-coverage clamp (see _spec_verify_fn): a row
+            # whose block table ran out of grown pages stalls — commits
+            # resume next dispatch once the host grew/reclaimed pages
+            ql = jnp.where(jnp.logical_or(finished,
+                                          positions >= write_caps),
+                           0, 1).astype(jnp.int32)
+            h, kc, vc = self._forward_tokens(params, kc, vc, tok[:, None],
+                                             ql, positions, block_tables)
+            logits = (h[:, 0] @ params["head"]).astype(jnp.float32)
+            key, sub = jax.random.split(key)
+            sampled = _sample(logits, sub, gc)
+            out = jnp.where(ql > 0, sampled, tok)
+            positions = positions + ql
+            committed = (ql > 0).astype(jnp.int32)
+            counts = counts + committed
+            finished = jnp.logical_or(finished, counts >= budgets)
+            outs.append(out)
+            n_commit = committed if n_commit is None else n_commit + committed
+            tok = out
+        out_mat = jnp.stack(outs, axis=1)                      # [B, K]
+        return (out_mat, n_commit, tok, positions, finished,
+                jnp.all(finished), counts, kc, vc, key)
 
     # ---- host loop ----
     def generate(self, prompts: Sequence[Sequence[int]],
@@ -507,10 +679,20 @@ class _ServingMetrics:
                  "queue_wait", "ttft", "itl", "queue_depth", "queue_now",
                  "occupancy", "steps", "drains", "pages_in_use",
                  "peak_pages", "active_seqs", "cached_pages",
-                 "evictable_pages")
+                 "evictable_pages", "spec_drafted", "spec_accepted",
+                 "spec_rejected", "accept_len")
 
     def __init__(self):
         m = _obs.metrics
+        # speculative decoding (ISSUE 9): drafted/accepted/rejected token
+        # counters + per-dispatch accepted-prefix-length histogram, all
+        # folded in at the existing drain (never per step)
+        self.spec_drafted = m.counter("serving.spec.drafted_tokens")
+        self.spec_accepted = m.counter("serving.spec.accepted_tokens")
+        self.spec_rejected = m.counter("serving.spec.rejected_tokens")
+        self.accept_len = m.histogram(
+            "serving.spec.accept_len",
+            bounds=[0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0])
         self.requests = m.counter("serving.requests_total")
         self.completed = m.counter("serving.requests_completed")
         self.tokens = m.counter("serving.tokens_generated")
@@ -574,7 +756,9 @@ class ContinuousBatchingEngine:
     def __init__(self, model: LlamaForCausalLM, *, max_batch: int = 8,
                  gen: Optional[GenerationConfig] = None,
                  prefix_cache: Optional[bool] = None,
-                 metrics: Optional[bool] = None, **kw):
+                 metrics: Optional[bool] = None,
+                 spec_decode=None, spec_k: Optional[int] = None,
+                 spec_ngram_max: Optional[int] = None, **kw):
         self.gen_cfg = gen or GenerationConfig()
         self.g = LlamaGenerator(model, max_batch=max_batch, **kw)
         B = max_batch
@@ -596,7 +780,11 @@ class ContinuousBatchingEngine:
         self._bt = np.zeros((B, self.g.pages_per_seq), np.int32)
         self._bt_dev = jnp.asarray(self._bt)
         self._ql1 = jnp.ones((B,), i32)
-        self._pending: List[tuple] = []  # (out_dev [B], commit np [B], t_disp)
+        # pending window entries are ("step", out_dev [B], commit np [B],
+        # None, t_disp) for plain steps and ("spec", out_dev [B, K],
+        # n_commit_dev [B], drafted_dev [B] | None, t_disp) for
+        # speculative dispatches — drained together
+        self._pending: List[tuple] = []
         self._steps_since_drain = 0
         # per-slot hard cap on VALID generated tokens, set when a sequence
         # freezes early (KV pool ran dry mid-decode): the device keeps
@@ -619,6 +807,30 @@ class ContinuousBatchingEngine:
         # the COW page copies to dispatch once the row is cleared to start
         self._gate: List[tuple] = [()] * B
         self._cow_pairs: List[List[tuple]] = [[] for _ in range(B)]
+        # ---- speculative decoding (ISSUE 9) ----
+        # resolved once; the verify/fused programs are jitted per
+        # (sampling config, K) so every warm spec step reuses them
+        self.spec = _sp.resolve_spec_config(spec_decode, spec_k,
+                                            spec_ngram_max)
+        self._spec_counts = {"spec_steps": 0, "spec_committed_tokens": 0,
+                             "spec_drafted_tokens": 0,
+                             "spec_accepted_tokens": 0,
+                             "spec_rejected_tokens": 0}
+        if self.spec is not None and self.spec.mode == "ngram":
+            # host-owned history table (rebuilt at admission/drain only)
+            # + the device-resident recent-token ring the steps maintain
+            self._hist = _sp.SpecHistory(B, self.g.max_seq_len)
+            self._recent = jnp.full((B, self.spec.ngram_max),
+                                    int(_sp.CTX_PAD), jnp.int32)
+        else:
+            self._hist = None
+            self._recent = None
+        # per-row write caps for the spec programs (tokens the block
+        # table covers): cached device array, refreshed only when an
+        # allocation/truncation/admission changed it — the same
+        # dirty-flag pattern as _bt_dev, so warm spec steps upload nothing
+        self._caps_dev = jnp.zeros((B,), jnp.int32)
+        self._caps_dirty = True
         self.last_stats: dict = self.stats()
         if prefix_cache:
             from .prefix_cache import PrefixCache
@@ -673,6 +885,10 @@ class ContinuousBatchingEngine:
         ``sync_every`` steps.  Returns requests retired by this call."""
         t_host0 = time.perf_counter() if _obs.TRACER.enabled else None
         self._admit()
+        # requests retired by a mid-step emergency drain (pool pressure
+        # under speculative overestimate) must still ride this call's
+        # return — callers stream completions off it
+        early_done: List[Request] = []
         if all(r is None for r in self.slot_req):
             return self._drain() if self._pending else []
         g = self.g
@@ -682,7 +898,25 @@ class ContinuousBatchingEngine:
         prompt_rows = [b for b in range(B)
                        if self.slot_req[b] is not None and not self._gate[b]
                        and self.prompt_pos[b] < len(self.slot_req[b].prompt)]
+        # ISSUE 9: decode-only steps ride the speculative lane — ONE
+        # dispatch verifies/commits up to K tokens per row.  Mixed steps
+        # (prefill chunks in flight, or prefix-gated rows whose shared
+        # pages are still being produced) use the plain bucket step.
+        spec_lane = (self.spec is not None and not prompt_rows
+                     and not any(self._gate)
+                     and any(r is not None for r in self.slot_req))
         T = g.prefill_bucket if prompt_rows else 1
+        if spec_lane:
+            # the device may commit up to K tokens per row this dispatch:
+            # bump the host-side length bound FIRST so the shared growth
+            # loop below covers every position the step can write
+            # (safe-by-overestimate; the drain resyncs the bound to the
+            # device's true commit count and rolls surplus pages back)
+            for b in range(B):
+                req = self.slot_req[b]
+                if req is not None and self.prompt_pos[b] >= len(req.prompt):
+                    self.host_lens[b] = min(
+                        int(self.host_lens[b]) + self.spec.k, g.max_seq_len)
 
         # grow pages BEFORE the step: every position this step writes must
         # already be inside the allocated table (prompts are allocated in
@@ -696,13 +930,30 @@ class ContinuousBatchingEngine:
             while alloc.context_len(req.req_id) <= int(self.host_lens[b]) \
                     and alloc.context_len(req.req_id) < g.max_seq_len:
                 if alloc.available_pages == 0:
+                    if self.spec is not None and self._pending:
+                        # the speculative overestimate may be what holds
+                        # the pool: drain now — the drain resyncs host
+                        # lengths and rolls surplus tail pages back —
+                        # then retry this row's growth (at most once:
+                        # the pending window is empty afterwards)
+                        early_done.extend(self._drain())
+                        if self.slot_req[b] is None:
+                            break
+                        continue
                     # pool ran dry mid-decode (undersized num_pages):
                     # finalize THIS sequence early instead of raising —
                     # freeze it on device (no further writes) and cap its
                     # valid output at what was generated before this step
                     if self._gen_cap[b] is None:
-                        self._gen_cap[b] = len(req.output) + sum(
-                            int(c[b]) for _, c, _ in self._pending)
+                        n = len(req.output)
+                        for kind, _o, cm, _dl, _t in self._pending:
+                            if kind != "step":
+                                # degraded path (pool exhausted): the
+                                # exact cap needs the in-flight spec
+                                # commit counts — one marked sync
+                                _obs.count_sync()
+                            n += int(cm[b])
+                        self._gen_cap[b] = n
                         self.finished = self.finished.at[b].set(True)
                     break
                 alloc.extend(req.req_id,
@@ -714,6 +965,29 @@ class ContinuousBatchingEngine:
                 grew = True
         if grew:
             self._bt_dev = jnp.asarray(self._bt)
+            self._caps_dirty = True
+
+        if spec_lane:
+            # ---- speculative lane: ngram verify / fused K-step ----
+            out_mat, ncommit, dlen = self._dispatch_spec()
+            t_step = time.perf_counter()
+            self._pending.append(("spec", out_mat, ncommit, dlen, t_step))
+            if self._obs is not None:
+                o = self._obs
+                o.steps.inc()
+                o.occupancy.observe(
+                    sum(r is not None for r in self.slot_req) / B)
+                o.queue_depth.observe(len(self.waiting))
+                o.queue_now.set(len(self.waiting))
+            if t_host0 is not None:
+                _obs.TRACER.event("engine.step", t_host0, t_step - t_host0,
+                                  cat="serving", tid="engine",
+                                  args={"T": int(self.spec.k),
+                                        "spec": self.spec.mode})
+            self._steps_since_drain += 1
+            if self._steps_since_drain >= self.g.sync_every:
+                return early_done + self._drain()
+            return early_done
 
         ql = np.zeros((B,), np.int32)
         decode = np.zeros((B,), bool)
@@ -750,18 +1024,30 @@ class ContinuousBatchingEngine:
             tokens_in = tokens_in.at[:, 0].set(
                 jnp.where(dm, self.tokens, tokens_in[:, 0]))
 
-        step = g._step_jit(self.gen_cfg, T)
-        (self.tokens, self.positions, self.finished, _all_done, self.counts,
-         kc, vc, self.key) = step(
-            g.params, *g.cache.arrays, tokens_in, jnp.asarray(ql),
-            self.positions, self.finished, dm, jnp.asarray(commit),
-            self.counts, self.budgets, self._bt_dev, self.key)
+        # ngram spec engines thread the drafter's recent-token ring
+        # through EVERY step (prefill commits update it too), so the
+        # verify step's context is exact when the row reaches decode
+        track = self.spec is not None and self.spec.mode == "ngram"
+        step = g._step_jit(self.gen_cfg, T, track)
+        if track:
+            (self.tokens, self.positions, self.finished, _all_done,
+             self.counts, kc, vc, self.key, self._recent) = step(
+                g.params, *g.cache.arrays, tokens_in, jnp.asarray(ql),
+                self.positions, self.finished, dm, jnp.asarray(commit),
+                self.counts, self.budgets, self._bt_dev, self.key,
+                self._recent)
+        else:
+            (self.tokens, self.positions, self.finished, _all_done,
+             self.counts, kc, vc, self.key) = step(
+                g.params, *g.cache.arrays, tokens_in, jnp.asarray(ql),
+                self.positions, self.finished, dm, jnp.asarray(commit),
+                self.counts, self.budgets, self._bt_dev, self.key)
         g.cache.update(kc, vc)
         # host dispatch timestamp rides the pending window: the drain
         # stamps TTFT/ITL per committed token from it — dispatch-side
         # wall clock, no device sync
         t_step = time.perf_counter()
-        self._pending.append((self.tokens, commit, t_step))
+        self._pending.append(("step", self.tokens, commit, None, t_step))
         if self._obs is not None:
             o = self._obs
             o.steps.inc()
@@ -787,8 +1073,8 @@ class ContinuousBatchingEngine:
                         req.req_id, int(self.prompt_pos[b]))
         self._steps_since_drain += 1
         if self._steps_since_drain >= self.g.sync_every:
-            return self._drain()
-        return []
+            return early_done + self._drain()
+        return early_done
 
     # ---- prefix-cache gates: rows waiting on producer prefill ----
     def _open_gates(self):
@@ -811,6 +1097,52 @@ class ContinuousBatchingEngine:
             self.g.cache.update(*self._cow_jit(
                 *self.g.cache.arrays, jnp.asarray(src), jnp.asarray(dst)))
 
+    # ---- ISSUE 9: the speculative dispatch (decode-only batches) ----
+    def _dispatch_spec(self):
+        """Dispatch ONE speculative step: the T=K ngram verify program or
+        the fused K-step decode program.  Everything the step consumes
+        beyond the chained engine state is either static (K, sampling
+        config) or drain-refreshed (the history table), so the warm spec
+        loop is dispatch-only — zero per-step host reads or uploads.
+
+        Returns the pending-window payload ``(out [B, K], n_commit [B],
+        drafted [B] | None)`` — device arrays, materialized at the drain.
+        """
+        g = self.g
+        spec = self.spec
+        # per-row write caps: tokens the block table actually covers —
+        # the device clamps ql against them, so a step can NEVER scatter
+        # into pages the row does not own (pad entries point at page 0).
+        # Cached: only an allocation/truncation/admission refreshes it
+        if self._caps_dirty:
+            alloc = g.cache.allocator
+            caps = np.zeros((self.B,), np.int32)
+            for b in range(self.B):
+                req = self.slot_req[b]
+                if req is not None:
+                    caps[b] = alloc.context_len(req.req_id)
+            self._caps_dev = jnp.asarray(caps)
+            self._caps_dirty = False
+        write_caps = self._caps_dev
+        if spec.mode == "ngram":
+            hist, hist_len = self._hist.device_arrays()
+            step = g._spec_jit(self.gen_cfg, spec.k, spec.ngram_max)
+            (out, ncommit, dlen, self.tokens, self.positions, self.finished,
+             _all_done, self.counts, self._recent, kc, vc, self.key) = step(
+                g.params, *g.cache.arrays, self.tokens, self._recent, hist,
+                hist_len, self.positions, self.finished, self.counts,
+                self.budgets, write_caps, self._bt_dev, self.key)
+        else:
+            step = g._fused_jit(self.gen_cfg, spec.k)
+            (out, ncommit, self.tokens, self.positions, self.finished,
+             _all_done, self.counts, kc, vc, self.key) = step(
+                g.params, *g.cache.arrays, self.tokens, self.positions,
+                self.finished, self.counts, self.budgets, write_caps,
+                self._bt_dev, self.key)
+            dlen = None
+        g.cache.update(kc, vc)
+        return out, ncommit, dlen
+
     # ---- serving telemetry ----
     def stats(self) -> dict:
         """Pool + prefix-cache telemetry (refreshed at every drain into
@@ -820,6 +1152,11 @@ class ContinuousBatchingEngine:
         if self.prefix_cache is not None:
             s["prefix_cached_pages"] = self.prefix_cache.cached_pages()
             s["prefix_evictable_pages"] = self.prefix_cache.evictable_pages()
+        s["spec_decode_enabled"] = self.spec is not None
+        if self.spec is not None:
+            s["spec_mode"] = self.spec.mode
+            s["spec_k"] = self.spec.k
+            s.update(self._spec_counts)
         return s
 
     def prefix_digest(self, max_entries: Optional[int] = None):
@@ -847,24 +1184,40 @@ class ContinuousBatchingEngine:
         # window length varies (partial windows at tail/run end) and a
         # jnp.stack would compile one executable per distinct length —
         # breaking the warm loop's zero-recompile contract
-        mat = np.stack([np.asarray(o) for o, _, _ in self._pending], axis=1)
-        commits = np.stack([c for _, c, _ in self._pending], axis=1)  # [B, n]
-        step_ts = [t for _, _, t in self._pending]
         obs = self._obs
         if obs is not None:
             obs.drains.inc()
             _obs.count_sync()        # the window's host<->device transfer
+        window = [(kind, np.asarray(out), np.asarray(cm),
+                   None if dl is None else np.asarray(dl), t)
+                  for kind, out, cm, dl, t in self._pending]
         self._pending.clear()
         self._steps_since_drain = 0
+        self._fold_spec_metrics(window)
         fin = np.asarray(self.finished)
         alloc = self.g.cache.allocator
         eos = self.gen_cfg.eos_token_id
+        bt_dirty = False
         for b in range(self.B):
             req = self.slot_req[b]
             if req is None:
                 continue
             prev_len = len(req.output)
-            new_tok = [int(t) for t in mat[b][commits[b]]]
+            # committed tokens this window + their dispatch stamps: a
+            # plain step contributes its column-0 sample where the host
+            # marked the row committing; a spec step contributes its
+            # device-computed accepted prefix (frozen rows: 0 tokens)
+            new_tok: List[int] = []
+            tok_ts: List[float] = []
+            for kind, out, cm, _dl, t in window:
+                if kind == "step":
+                    if cm[b]:
+                        new_tok.append(int(out[b]))
+                        tok_ts.append(t)
+                else:
+                    for v in out[b, :int(cm[b])]:
+                        new_tok.append(int(v))
+                        tok_ts.append(t)
             req.output.extend(new_tok)
             if obs is not None:
                 # TTFT/ITL from the committing steps' dispatch stamps;
@@ -878,8 +1231,7 @@ class ContinuousBatchingEngine:
                 room = min(room, max(0, cap_v - prev_len))
                 if eos is not None and eos in new_tok:
                     room = min(room, new_tok.index(eos) + 1)
-                for j in np.nonzero(commits[b])[0][:room]:
-                    tj = step_ts[j]
+                for tj in tok_ts[:room]:
                     if req.t_first is None:
                         req.t_first = tj
                         base = req.t_enqueue if req.t_enqueue is not None \
@@ -905,6 +1257,14 @@ class ContinuousBatchingEngine:
                 if obs is not None and len(req.output) > req.n_emitted:
                     obs.tokens.inc(len(req.output) - req.n_emitted)
                     req.n_emitted = len(req.output)
+                if self.spec is not None and \
+                        self.prompt_pos[b] >= len(req.prompt):
+                    bt_dirty |= self._rollback_tail(b, req)
+                if self._hist is not None and new_tok:
+                    # the drafter's n-gram table grows ONLY here: retired
+                    # (drained) tokens, at the existing sync point —
+                    # never a per-step host read
+                    self._hist.extend_row(b, new_tok)
                 continue                     # still running
             req.done = True
             if obs is not None:
@@ -945,10 +1305,77 @@ class ContinuousBatchingEngine:
             self.finished = self.finished.at[b].set(True)
             self.completed[req.req_id] = req.output
             done.append(req)
+        if bt_dirty:
+            self._bt_dev = jnp.asarray(self._bt)
+            self._caps_dirty = True
         self.last_stats = self.stats()
         if obs is not None:
             obs.update_pool(self.last_stats)
         return done
+
+    def _fold_spec_metrics(self, window) -> None:
+        """Fold the window's speculative telemetry into the engine books
+        and the registry (drafted/accepted/rejected token counters + the
+        accept_len histogram) — at the drain, never per step."""
+        if self.spec is None:
+            return
+        obs = self._obs
+        n_spec = c_tot = d_tot = a_tot = r_tot = 0
+        for kind, _out, cm, dl, _t in window:
+            if kind != "spec":
+                continue
+            n_spec += 1
+            for b in range(self.B):
+                n = int(cm[b])
+                d = int(dl[b]) if dl is not None else 0
+                if n <= 0 and d <= 0:
+                    continue
+                acc = min(max(n - 1, 0), d)
+                c_tot += n
+                d_tot += d
+                a_tot += acc
+                r_tot += d - acc
+                if obs is not None and n > 0:
+                    # ngram: accepted drafts per dispatch; fused: extra
+                    # tokens beyond the first (both = tokens amortized
+                    # onto one dispatch)
+                    obs.accept_len.observe(float(n - 1))
+        if not n_spec:
+            return
+        sc = self._spec_counts
+        sc["spec_steps"] += n_spec
+        sc["spec_committed_tokens"] += c_tot
+        sc["spec_drafted_tokens"] += d_tot
+        sc["spec_accepted_tokens"] += a_tot
+        sc["spec_rejected_tokens"] += r_tot
+        if obs is not None:
+            if d_tot:
+                obs.spec_drafted.inc(d_tot)
+            if a_tot:
+                obs.spec_accepted.inc(a_tot)
+            if r_tot:
+                obs.spec_rejected.inc(r_tot)
+
+    def _rollback_tail(self, b: int, req: Request) -> bool:
+        """Block-table tail rollback (ISSUE 9): resync the host length
+        bound to the device's true commit count and release surplus tail
+        pages the speculative overestimate grew for tokens that were then
+        rejected.  ``PageAllocator.truncate`` is refcount-aware, so only
+        THIS sequence's references drop — prefix-shared and COW pages can
+        never be yanked from a sibling.  K tokens of headroom stay
+        allocated so the steady state doesn't thrash truncate/extend.
+        Returns True when the row's block table changed."""
+        g = self.g
+        true_len = len(req.prompt) + len(req.output)
+        self.host_lens[b] = true_len
+        alloc = g.cache.allocator
+        keep = min(true_len + self.spec.k, g.max_seq_len)
+        if alloc.context_len(req.req_id) > keep + g.page_size:
+            alloc.truncate(req.req_id, keep)
+            self._bt[b] = alloc.block_table(
+                [req.req_id], max_pages=g.pages_per_seq)[0]
+            return True
+        return False
 
     # ---- admission (host-known free slots only; frees appear at drains) ----
     def _admit(self):
@@ -1044,3 +1471,15 @@ class ContinuousBatchingEngine:
         self.budgets = jnp.asarray(budgets.astype(np.int32))
         self.finished = jnp.where(m, jnp.zeros((), bool), self.finished)
         self._bt_dev = jnp.asarray(self._bt)
+        self._caps_dirty = True
+        if self._hist is not None:
+            # seed the drafter (ISSUE 9): the full prompt into the
+            # history table, the prompt tail into the device recent ring
+            # — the context the first verify step's drafts match against
+            nmax = self.spec.ngram_max
+            rec_np = np.full((self.B, nmax), int(_sp.CTX_PAD), np.int32)
+            for b, req in admitted:
+                self._hist.reset_row(b, req.prompt)
+                rec_np[b] = _sp.recent_window(req.prompt, nmax)
+            self._recent = jnp.where(m[:, None], jnp.asarray(rec_np),
+                                     self._recent)

@@ -203,6 +203,23 @@ class PageAllocator:
         self._grow(seq_id, start + num_tokens)
         return self.slots(seq_id, start, num_tokens)
 
+    def truncate(self, seq_id: int, num_tokens: int) -> int:
+        """Shrink a sequence's page list to cover exactly ``num_tokens``
+        (speculative-decoding tail rollback, ISSUE 9: pages grown for
+        draft tokens that were then rejected).  Each dropped tail page
+        loses ONE reference — this sequence's — so a page shared with the
+        prefix cache or a sibling sequence survives with its other
+        references intact (the same structural double-free guard as
+        :meth:`free`).  Returns the number of references dropped."""
+        pages = self._pages[seq_id]
+        keep = max(0, -(-int(num_tokens) // self.page_size))
+        dropped = pages[keep:]
+        del pages[keep:]
+        for p in dropped:
+            self.release_page(p)
+        self._lens[seq_id] = min(self._lens[seq_id], int(num_tokens))
+        return len(dropped)
+
     def cow(self, seq_id: int,
             page_index: int) -> Optional[Tuple[int, int]]:
         """Copy-on-write: make entry ``page_index`` of the sequence's page

@@ -153,6 +153,27 @@ class TestPagedAttentionMosaic:
                     q_, kc, vc, bt_, cl_, ql_, kn_, vn_, False)[0],
             q, k_cache, v_cache, bt, cl, ql, kn, vn)
 
+    @pytest.mark.parametrize("K", [4, 8])
+    def test_spec_verify_bucket_kernel(self, K):
+        """ISSUE 9: the speculative verify step runs the mixed-mode
+        kernel at the NEW T=K bucket (K in {4, 8}, ragged q_lens =
+        1 + draft_len per row) — cross-lower it so a chip-only Mosaic
+        failure can't hide behind CPU interpret mode.  T*group here is
+        not a sublane multiple, exercising the q-row pad path."""
+        from paddle_tpu.kernels.paged_attention import \
+            _pallas_ragged_paged_attention
+
+        q = _rand((self.b, K, self.qh, self.d))
+        k_cache, v_cache, bt, cl = self._cache()
+        ql = jnp.asarray([K, 1], jnp.int32)   # full draft vs no-draft row
+        kn = _rand((self.b, K, self.kvh, self.d), seed=3)
+        vn = _rand((self.b, K, self.kvh, self.d), seed=4)
+        _export_tpu(
+            lambda q_, kc, vc, bt_, cl_, ql_, kn_, vn_:
+                _pallas_ragged_paged_attention(
+                    q_, kc, vc, bt_, cl_, ql_, kn_, vn_, False)[0],
+            q, k_cache, v_cache, bt, cl, ql, kn, vn)
+
 
 class TestWeightOnlyMosaic:
     def test_w8a16(self):

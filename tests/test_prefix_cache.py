@@ -108,6 +108,63 @@ def test_allocator_rollback_on_bad_shared_page():
     assert a.free_pages == 4
 
 
+def test_allocator_truncate_respects_shared_refcounts():
+    """ISSUE 9: speculative tail rollback.  Truncating a sequence whose
+    leading pages are prefix-shared drops ONLY that sequence's tail
+    references — shared pages keep the sibling's (and the cache's)
+    refcounts, exclusive tail pages return to the free list."""
+    a = PageAllocator(num_pages=8, page_size=4)
+    a.allocate(0, 8)                      # 2 pages, shared below
+    shared = a.page_list(0)
+    a.allocate(1, 8, shared_pages=shared)
+    a.extend(1, 8)                        # +2 exclusive tail pages
+    tail = a.page_list(1)[2:]
+    assert a.pages_in_use == 4
+    # rollback to 10 tokens: ceil(10/4) = 3 pages -> drop ONE tail page
+    assert a.truncate(1, 10) == 1
+    assert a.page_list(1) == shared + tail[:1]
+    assert a.context_len(1) == 10
+    assert [a.ref_count(p) for p in shared] == [2, 2]
+    # rollback INTO the shared region: shared pages lose only seq 1's ref
+    assert a.truncate(1, 4) == 2
+    assert [a.ref_count(p) for p in shared] == [2, 1]
+    assert all(a.ref_count(p) == 0 for p in tail)
+    a.free(1)
+    assert [a.ref_count(p) for p in shared] == [1, 1]   # seq 0 intact
+    a.free(0)
+    assert a.free_pages == 8
+
+
+def test_allocator_truncate_cow_sibling_unaffected():
+    """Truncate after a COW privatization: dropping the COW copy can
+    never touch the original shared page the sibling still reads."""
+    a = PageAllocator(num_pages=6, page_size=4)
+    a.allocate(0, 8)
+    orig = a.page_list(0)
+    a.allocate(1, 8, shared_pages=orig)
+    src, dst = a.cow(1, 1)                # privatize page 1 of seq 1
+    assert a.ref_count(src) == 1 and a.ref_count(dst) == 1
+    a.truncate(1, 4)                      # drop the COW copy entirely
+    assert a.ref_count(dst) == 0          # copy freed...
+    assert a.ref_count(src) == 1          # ...original untouched (seq 0)
+    assert a.page_list(0) == orig
+    a.free(0)
+    a.free(1)
+    assert a.free_pages == 6
+
+
+def test_allocator_truncate_noop_and_regrow():
+    a = PageAllocator(num_pages=4, page_size=4)
+    a.allocate(0, 6)                      # 2 pages (partial tail)
+    assert a.truncate(0, 6) == 0          # covering pages: no-op
+    assert a.truncate(0, 5) == 0          # same page count: no-op
+    assert a.context_len(0) == 5
+    a.extend(0, 7)                        # regrow after rollback
+    assert a.context_len(0) == 12 and len(a.page_list(0)) == 3
+    a.free(0)
+    assert a.free_pages == 4
+
+
 def test_allocator_stats_prefix_counters_default_zero():
     a = PageAllocator(num_pages=4, page_size=4)
     a.allocate(0, 8)
