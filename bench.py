@@ -1595,6 +1595,44 @@ def _parent_main():
     return 0
 
 
+def _gate_main():
+    """``bench.py --gate`` (ISSUE 10): run the normal driver bench in a
+    child, then gate its record against the committed
+    ``benchmarks/results/llama.json`` (same metric family: the flagship
+    train tok/s + MFU) with the benchmarks/check.py guardbands.  Prints
+    the record with the verdict stamped as ``regression_gate``; exits 3
+    on a regression so CI fails loudly instead of archiving the slowdown.
+    """
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks import check as _check
+
+    # budget must cover _parent_main's own worst case (probe retries +
+    # measured child + per-extra children on TPU), not just the CPU path
+    rc, out, err = _spawn([], dict(os.environ), 9000)
+    result = _extract_json(out)
+    if result is None:
+        print(json.dumps({"metric": "llama_train_tokens_per_sec_per_chip",
+                          "value": 0.0, "unit": "tokens/s",
+                          "error": f"bench child rc={rc}: {err[-400:]}"}))
+        return 1
+    baseline = _check.load_result(_check.RESULTS / "llama.json")
+    verdict = _check.gate_result(result, baseline)
+    if rc != 0:
+        # salvaged partial line (driver killed mid-extras): gate what
+        # landed, but say so and never report the run as fully green
+        verdict["notes"].append(f"driver bench exited rc={rc}; "
+                                "record may be partial")
+        print(f"[bench --gate] driver rc={rc}: salvaged a partial "
+              "record; gating what landed", file=sys.stderr)
+    print(json.dumps(result))
+    if not verdict["pass"]:
+        for r in verdict["regressions"]:
+            print(f"REGRESSION {r['key']}: {r['baseline']} -> "
+                  f"{r['candidate']} — {r['why']}", file=sys.stderr)
+        return 3
+    return 2 if rc != 0 else 0
+
+
 def main():
     if "--probe" in sys.argv:
         return _probe_main()
@@ -1602,6 +1640,8 @@ def main():
         return _child_main()
     if "--extra" in sys.argv:
         return _extra_main(sys.argv[sys.argv.index("--extra") + 1])
+    if "--gate" in sys.argv:
+        return _gate_main()
     return _parent_main()
 
 

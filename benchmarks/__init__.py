@@ -1,0 +1,2 @@
+"""Benchmark harness package: ``run.py`` (per-config ladder) and
+``check.py`` (the regression gate, ``python -m benchmarks.check``)."""

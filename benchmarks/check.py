@@ -1,0 +1,410 @@
+"""Bench regression gate (ISSUE 10 tentpole, part 3).
+
+A PR that quietly regresses decode tok/s used to sail through tier-1:
+the committed ``benchmarks/results/*.json`` trajectory was recorded but
+never COMPARED against.  This module is the comparison — stdlib-only (no
+jax import: the gate must run in a second on any box):
+
+    python -m benchmarks.check                  # committed vs committed
+    python -m benchmarks.check --candidate DIR  # fresh run vs committed
+    python -m benchmarks.check --self-test      # gate self-check
+    python benchmarks/run.py serve --cpu --gate # gate inline per config
+    python bench.py --gate                      # gate the driver bench
+
+Per-metric semantics:
+
+- **throughput** (``*tok_per_sec*``, ``*per_sec*``, ``speedup``, ``mfu``,
+  ``hit_rate``, ``accept_rate``, ``*savings_frac*``, ``tokens_per_dispatch``):
+  higher is better; a drop beyond the throughput guardband fails.
+- **latency** (``*_ms`` scalars and the ``{p50, p95, p99}`` histogram
+  records the serve configs stamp): lower is better, compared at p50/p95
+  with the (wider — host timers are noisy) latency guardband.
+- **contract booleans** (``*_match``, ``*bit_match*``, ``finite``,
+  ``loss_decreased``, ``*_beats_rr``, ``*stats_zero``): a baseline
+  ``true`` that turns ``false`` is a regression at ANY band — these are
+  determinism/correctness stamps, not measurements.
+
+Guardbands default to 15% (throughput) / 50% (latency) — wide enough
+that an identical re-run or normal CPU jitter passes, tight enough that
+the acceptance-criterion synthetic 20% tok/s regression fails.  Records
+whose platforms differ (a CPU smoke vs a chip capture) or that carry an
+``error`` are skipped with a note, never failed: the gate judges
+regressions, not infrastructure.
+
+The verdict is stamped into each candidate result as
+``"regression_gate"`` — next to the existing ``metrics`` /
+``static_analysis`` / ``provenance`` stamps — so a results file carries
+its own pass/fail history.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+RESULTS = pathlib.Path(__file__).resolve().parent / "results"
+
+BAND_THROUGHPUT = 0.15
+BAND_LATENCY = 0.50
+
+# key fragments that mark a higher-is-better measurement
+_HIGHER = ("tok_per_sec", "per_sec", "speedup", "mfu", "hit_rate",
+           "accept_rate", "savings_frac", "tokens_per_dispatch",
+           "vs_baseline")
+# boolean contract stamps: True in the baseline must stay True
+_BOOL_TRUE_CONTRACT = ("match", "finite", "decreased", "beats_rr",
+                       "stats_zero")
+# keys that are bookkeeping, provenance or environment — never gated
+_SKIP = {"config", "platform", "device_kind", "metric", "unit", "wall_s",
+         "metrics", "jit_cache_stats", "static_analysis", "provenance",
+         "regression_gate", "trace_path", "error", "previous",
+         "bench_diag", "bench_partial", "grouped_matmul_fused_gather",
+         "metrics_error"}
+# noisy-by-construction / workload-shaped fragments that are never
+# gated: queue wait, client chunk gaps and batch occupancy measure the
+# traffic mix, not the engine (and occupancy is higher-is-better — the
+# {p50,p95} record shape must not drag it into latency semantics)
+_NOISY = ("queue_wait", "chunk_gap", "queue_depth", "occupancy")
+
+
+def classify(key: str, value) -> Optional[str]:
+    """Metric class for a result key: 'throughput' | 'latency' |
+    'bool_contract' | 'latency_record' | None (not gated)."""
+    if key in _SKIP:
+        return None
+    k = key.lower()
+    if any(n in k for n in _NOISY):
+        return None
+    if isinstance(value, bool):
+        return "bool_contract" if any(f in k for f in
+                                      _BOOL_TRUE_CONTRACT) else None
+    if isinstance(value, dict):
+        return "latency_record" if "p50" in value and "p95" in value \
+            else None
+    if not isinstance(value, (int, float)):
+        return None
+    if any(f in k for f in _HIGHER):
+        return "throughput"
+    if k.endswith("_ms") or "_ms_per_" in k or k.endswith("ms_per_token"):
+        return "latency"
+    return None
+
+
+def _ratio(baseline: float, candidate: float) -> float:
+    return candidate / baseline
+
+
+def compare_result(candidate: dict, baseline: dict,
+                   band_throughput: float = BAND_THROUGHPUT,
+                   band_latency: float = BAND_LATENCY) -> dict:
+    """Gate one candidate record against one baseline record.  Returns
+    the verdict dict stamped as ``"regression_gate"``."""
+    verdict: Dict[str, object] = {
+        "pass": True, "checked": 0,
+        "band_throughput": band_throughput,
+        "band_latency": band_latency,
+        "regressions": [], "improvements": [], "notes": []}
+    regressions: List[dict] = verdict["regressions"]  # type: ignore
+    improvements: List[str] = verdict["improvements"]  # type: ignore
+    notes: List[str] = verdict["notes"]  # type: ignore
+
+    for side, rec in (("baseline", baseline), ("candidate", candidate)):
+        if not isinstance(rec, dict) or "error" in rec:
+            notes.append(f"skipped: {side} is an error record")
+            return verdict
+    if candidate.get("platform") != baseline.get("platform"):
+        notes.append(
+            f"skipped: platform mismatch "
+            f"({baseline.get('platform')} -> {candidate.get('platform')})")
+        return verdict
+
+    def check(key: str, kind: str, b, c) -> None:
+        verdict["checked"] = int(verdict["checked"]) + 1
+        if kind == "bool_contract":
+            if bool(b) and not bool(c):
+                regressions.append(
+                    {"key": key, "kind": kind, "baseline": b,
+                     "candidate": c,
+                     "why": "contract flag flipped true -> false"})
+            return
+        b, c = float(b), float(c)
+        if b == 0:
+            # a zero baseline (CPU smoke records round tiny MFUs to 0)
+            # carries no relative signal — nothing to gate against
+            notes.append(f"{key}: zero baseline, not compared")
+            return
+        r = _ratio(b, c)
+        if kind == "throughput":
+            if r < 1.0 - band_throughput:
+                regressions.append(
+                    {"key": key, "kind": kind, "baseline": b,
+                     "candidate": c, "ratio": round(r, 4),
+                     "band": band_throughput,
+                     "why": f"dropped {(1 - r) * 100:.1f}% "
+                            f"(> {band_throughput * 100:.0f}% band)"})
+            elif r > 1.0 + band_throughput:
+                improvements.append(f"{key}: {r:.2f}x")
+        else:  # latency: lower is better
+            if r > 1.0 + band_latency:
+                regressions.append(
+                    {"key": key, "kind": kind, "baseline": b,
+                     "candidate": c, "ratio": round(r, 4),
+                     "band": band_latency,
+                     "why": f"grew {(r - 1) * 100:.1f}% "
+                            f"(> {band_latency * 100:.0f}% band)"})
+            elif r < 1.0 - band_latency:
+                improvements.append(f"{key}: {r:.2f}x")
+
+    # the driver bench's headline lives under the literal key "value";
+    # its direction comes from the sibling "metric" name
+    # ({"metric": "llama_train_tokens_per_sec_per_chip", "value": ...})
+    metric_name = str(baseline.get("metric", ""))
+    if isinstance(baseline.get("value"), (int, float)) and \
+            not isinstance(baseline.get("value"), bool) and \
+            isinstance(candidate.get("value"), (int, float)) and \
+            baseline.get("metric") == candidate.get("metric") and \
+            any(f in metric_name for f in _HIGHER):
+        check(f"value ({metric_name})", "throughput",
+              baseline["value"], candidate["value"])
+
+    for key, b_val in baseline.items():
+        kind = classify(key, b_val)
+        if kind is None:
+            continue
+        c_val = candidate.get(key)
+        if c_val is None:
+            # a GATED key vanishing from the candidate is itself the
+            # silent-regression path (a refactor that stops stamping
+            # tok/s or a bit-match flag must not green-light); renames
+            # require an intentional re-baseline
+            verdict["checked"] = int(verdict["checked"]) + 1
+            regressions.append(
+                {"key": key, "kind": kind, "baseline": b_val,
+                 "candidate": None,
+                 "why": "gated metric missing from candidate"})
+            continue
+        if kind == "latency_record":
+            if not isinstance(c_val, dict):
+                notes.append(f"{key}: candidate is not a record")
+                continue
+            for q in ("p50", "p95"):
+                if isinstance(b_val.get(q), (int, float)) and \
+                        isinstance(c_val.get(q), (int, float)):
+                    check(f"{key}.{q}", "latency", b_val[q], c_val[q])
+            continue
+        if isinstance(b_val, bool) != isinstance(c_val, bool):
+            notes.append(f"{key}: type changed")
+            continue
+        check(key, kind, b_val, c_val)
+
+    verdict["pass"] = not regressions
+    return verdict
+
+
+# ---------------------------------------------------------------------------
+# result-file plumbing
+# ---------------------------------------------------------------------------
+
+def load_result(path: pathlib.Path) -> Optional[dict]:
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def gate_result(candidate: dict, baseline: Optional[dict],
+                **bands) -> dict:
+    """Gate + stamp: returns the verdict and writes it into the candidate
+    record under ``regression_gate`` (with the comparison timestamp).
+
+    An error-record baseline (a timed-out run archived by run.py with
+    the last good numbers under ``previous``) is unwrapped to that
+    ``previous`` — one transient infra failure must not blind the gate
+    for the next run (regression laundering via a flaky CI retry)."""
+    note = None
+    if isinstance(baseline, dict) and "error" in baseline and \
+            isinstance(baseline.get("previous"), dict):
+        note = ("baseline was an error record; compared against its "
+                "preserved 'previous'")
+        baseline = baseline["previous"]
+    if baseline is None:
+        verdict = {"pass": True, "checked": 0, "regressions": [],
+                   "improvements": [],
+                   "notes": ["skipped: no baseline record"]}
+    else:
+        verdict = compare_result(candidate, baseline, **bands)
+    if note:
+        verdict["notes"].append(note)
+    verdict["checked_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())
+    candidate["regression_gate"] = verdict
+    return verdict
+
+
+def gate_dirs(candidate_dir: pathlib.Path, baseline_dir: pathlib.Path,
+              configs: Optional[List[str]] = None, stamp: bool = False,
+              **bands) -> Tuple[int, List[str]]:
+    """Gate every candidate result against its baseline namesake.
+    Returns (number of failing configs, report lines)."""
+    lines: List[str] = []
+    failed = 0
+    # gate ARTIFACTS (a rejected/skipped candidate parked beside its
+    # kept baseline by run.py --gate) are not configs: comparing one
+    # against itself would report the regressed record as a passing
+    # config
+    paths = sorted(p for p in candidate_dir.glob("*.json")
+                   if not p.stem.endswith(("_rejected", "_skipped")))
+    if configs:
+        paths = [p for p in paths if p.stem in set(configs)]
+        missing = set(configs) - {p.stem for p in paths}
+        for m in sorted(missing):
+            lines.append(f"{m}: MISSING candidate result")
+            failed += 1
+    if not paths:
+        lines.append(f"no candidate results under {candidate_dir}")
+        return failed + 1, lines
+    for path in paths:
+        candidate = load_result(path)
+        if candidate is None:
+            lines.append(f"{path.stem}: unreadable candidate JSON")
+            failed += 1
+            continue
+        baseline = load_result(baseline_dir / path.name)
+        verdict = gate_result(candidate, baseline, **bands)
+        if stamp:
+            path.write_text(json.dumps(candidate, indent=2) + "\n")
+        status = "PASS" if verdict["pass"] else "FAIL"
+        note = f" ({verdict['notes'][0]})" if verdict["notes"] else ""
+        lines.append(f"{path.stem}: {status} "
+                     f"[{verdict['checked']} metrics]{note}")
+        for r in verdict["regressions"]:
+            lines.append(f"  REGRESSION {r['key']}: "
+                         f"{r['baseline']} -> {r['candidate']} "
+                         f"— {r['why']}")
+        for s in verdict["improvements"]:
+            lines.append(f"  improvement {s}")
+        if not verdict["pass"]:
+            failed += 1
+    return failed, lines
+
+
+# ---------------------------------------------------------------------------
+# self-test (ISSUE 10 satellite): identical inputs pass, a synthetic 20%
+# tok/s regression fails — the gate gates itself before gating anything
+# ---------------------------------------------------------------------------
+
+def self_test() -> Tuple[bool, List[str]]:
+    base = {"config": "synthetic", "platform": "cpu",
+            "serve_metrics_on_tok_per_sec": 1000.0,
+            "serve_ttft_ms": {"count": 10, "p50": 40.0, "p95": 90.0,
+                              "p99": 120.0},
+            "serve_tokens_match": True, "wall_s": 1.0}
+    lines: List[str] = []
+    ok = True
+
+    v = compare_result(dict(base), dict(base))
+    lines.append(f"identical inputs: "
+                 f"{'PASS' if v['pass'] else 'FAIL'} "
+                 f"[{v['checked']} metrics]")
+    ok &= v["pass"] and v["checked"] > 0
+
+    slow = dict(base, serve_metrics_on_tok_per_sec=800.0)   # -20%
+    v = compare_result(slow, dict(base))
+    caught = not v["pass"] and any(
+        r["key"] == "serve_metrics_on_tok_per_sec"
+        for r in v["regressions"])
+    lines.append("synthetic 20% tok/s regression: "
+                 + ("CAUGHT" if caught else "MISSED"))
+    ok &= caught
+
+    broken = dict(base, serve_tokens_match=False)
+    v = compare_result(broken, dict(base))
+    caught = not v["pass"]
+    lines.append("contract flag flip: "
+                 + ("CAUGHT" if caught else "MISSED"))
+    ok &= caught
+
+    jitter = dict(base, serve_metrics_on_tok_per_sec=950.0,
+                  serve_ttft_ms={"count": 10, "p50": 48.0, "p95": 101.0,
+                                 "p99": 130.0})
+    v = compare_result(jitter, dict(base))
+    lines.append("in-band jitter (-5% tok/s, +20% p50): "
+                 + ("PASS" if v["pass"] else "FAIL"))
+    ok &= v["pass"]
+    return ok, lines
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m benchmarks.check",
+        description="Gate bench results against the committed baseline.")
+    ap.add_argument("configs", nargs="*",
+                    help="config names to gate (default: every candidate "
+                         "result present)")
+    ap.add_argument("--baseline", default=str(RESULTS),
+                    help="baseline results dir (default: the committed "
+                         "benchmarks/results)")
+    ap.add_argument("--candidate", default=None,
+                    help="candidate results dir or single JSON file "
+                         "(default: the baseline dir — an identical "
+                         "re-run, which must pass)")
+    ap.add_argument("--band-throughput", type=float,
+                    default=BAND_THROUGHPUT,
+                    help="allowed fractional throughput drop")
+    ap.add_argument("--band-latency", type=float, default=BAND_LATENCY,
+                    help="allowed fractional latency growth")
+    ap.add_argument("--stamp", action="store_true",
+                    help="write the verdict into each candidate JSON "
+                         "(automatic when candidate != baseline)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the gate's own pass/fail self-checks")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        ok, lines = self_test()
+        print("\n".join(lines))
+        print("self-test:", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+
+    baseline_dir = pathlib.Path(args.baseline)
+    bands = {"band_throughput": args.band_throughput,
+             "band_latency": args.band_latency}
+    if args.candidate is not None and \
+            pathlib.Path(args.candidate).is_file():
+        path = pathlib.Path(args.candidate)
+        candidate = load_result(path)
+        if candidate is None:
+            print(f"unreadable candidate JSON: {path}")
+            return 2
+        bpath = baseline_dir / path.name
+        verdict = gate_result(candidate, load_result(bpath), **bands)
+        if path.resolve() != bpath.resolve():
+            # same rule as dir mode: an identity run (candidate IS the
+            # committed baseline file) is never stamped
+            path.write_text(json.dumps(candidate, indent=2) + "\n")
+        print(json.dumps(verdict, indent=2))
+        return 0 if verdict["pass"] else 3
+
+    candidate_dir = pathlib.Path(args.candidate) if args.candidate \
+        else baseline_dir
+    stamp = args.stamp or candidate_dir.resolve() != baseline_dir.resolve()
+    failed, lines = gate_dirs(candidate_dir, baseline_dir,
+                              configs=args.configs or None, stamp=stamp,
+                              **bands)
+    print("\n".join(lines))
+    print(f"regression gate: {'PASS' if not failed else 'FAIL'} "
+          f"({len(lines)} lines, {failed} failing)")
+    return 0 if not failed else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,9 +3,21 @@ in benchmarks/ of this repo with per-config JSON results").
 
 Usage:
     python benchmarks/run.py [config ...] [--cpu] [--fused-gather=0|1]
-                             [--trace=PATH]
+                             [--trace=PATH] [--gate]
 configs: resnet gpt2 llama dit moe decode serve http_serve router_serve
          spec_decode all (default: all)
+
+--gate compares each fresh result against the committed
+results/<config>.json (benchmarks/check.py guardbands), stamps the
+verdict into the result as "regression_gate", and exits nonzero on any
+regression.  A PASSING result replaces the committed record; a FAILING
+one is written to results/<config>_rejected.json and the baseline is
+kept, so a re-run cannot compare regressed-vs-regressed and go green.
+An UNCOMPARABLE one (platform mismatch, errored config) lands in
+results/<config>_skipped.json, also keeping the baseline — a CPU smoke
+under --gate never clobbers a chip capture.  (A valid result over an
+error-record baseline does replace it: that is recovery, and the gate
+compares against the error record's preserved "previous" first.)
 
 --fused-gather pins FLAGS_grouped_matmul_fused_gather for the run (A/B of
 the in-kernel MoE dispatch gather; the =0 arm writes <config>_nofuse.json).
@@ -62,6 +74,15 @@ for _a in [a for a in sys.argv if a.startswith("--trace")]:
     sys.argv.remove(_a)
     TRACE_PATH = _a.split("=", 1)[1] if "=" in _a else "trace.json"
 
+# `--gate`: regression gate (ISSUE 10) — each fresh result is compared
+# against the committed results/<config>.json BEFORE overwriting it, the
+# verdict is stamped into the result as "regression_gate", and the run
+# exits nonzero on any regression.  `python -m benchmarks.check` is the
+# standalone (no-bench-run) form of the same comparison.
+GATE = "--gate" in sys.argv
+if GATE:
+    sys.argv = [a for a in sys.argv if a != "--gate"]
+
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 RESULTS = pathlib.Path(__file__).resolve().parent / "results"
@@ -69,6 +90,35 @@ RESULTS = pathlib.Path(__file__).resolve().parent / "results"
 # per-process cache of the static-analysis stamp (ISSUE 8): the package
 # tree cannot change mid-run, so one analysis serves every config
 _LINT_STAMP = None
+
+# per-process cache of the provenance stamp (ISSUE 10 satellite): git SHA
+# + tree state + timestamp, so a results file traces back to the commit
+# that produced it (the commit cannot change mid-run either)
+_PROVENANCE = None
+
+
+def _provenance():
+    global _PROVENANCE
+    if _PROVENANCE is None:
+        import platform as _platform
+        import subprocess
+
+        def _git(*args):
+            try:
+                return subprocess.run(
+                    ["git", "-C", str(ROOT), *args], capture_output=True,
+                    text=True, timeout=10).stdout.strip()
+            except Exception:
+                return ""
+        _PROVENANCE = {
+            "git_sha": _git("rev-parse", "HEAD") or "unknown",
+            "git_dirty": bool(_git("status", "--porcelain")),
+            "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()),
+            "python": sys.version.split()[0],
+            "hostname": _platform.node(),
+        }
+    return _PROVENANCE
 
 
 def _on_tpu():
@@ -353,6 +403,8 @@ def _supervise(names, timeout):
         cmd = [sys.executable, os.path.abspath(__file__), "--inproc", name]
         if CPU_PINNED:
             cmd.append("--cpu")
+        if GATE:
+            cmd.append("--gate")
         if FUSED_GATHER is not None:
             # the child derives its flag AND its result-file suffix from
             # argv — without this the B arm would write <name>.json and
@@ -397,6 +449,16 @@ def _supervise(names, timeout):
                 child.wait()
         if err is not None and _fresh_ok(path, t0):
             err = None              # result landed; only the exit failed
+        rej = RESULTS / f"{name}{RESULT_SUFFIX}_rejected.json"
+        if err is not None and GATE and _fresh_ok(rej, t0):
+            # the child's nonzero exit was the regression gate, not an
+            # infra failure: the rejected candidate landed beside the
+            # (untouched) baseline — do NOT clobber the baseline with an
+            # error record
+            failed += 1
+            print(f"{name}: REGRESSION GATE FAIL (candidate at {rej}; "
+                  "baseline kept)")
+            continue
         if err is not None:
             failed += 1
             _write_error(path, name, err, t0, prev)
@@ -528,7 +590,40 @@ def main(argv):
                 _LINT_STAMP = {
                     "error": f"{type(e).__name__}: {str(e)[:120]}"}
         result["static_analysis"] = _LINT_STAMP
+        # provenance stamp (ISSUE 10 satellite): which commit, when,
+        # which interpreter — a results file is now traceable
+        result["provenance"] = _provenance()
         path = RESULTS / f"{name}{RESULT_SUFFIX}.json"
+        if GATE:
+            # regression gate (ISSUE 10): compare against the committed
+            # record; the verdict rides the result.  A FAILING candidate
+            # is written to <name>_rejected.json and the baseline file is
+            # left untouched — overwriting it would make a re-run compare
+            # regressed-vs-regressed and go green (regression laundering)
+            from benchmarks import check as _check
+            baseline = _check.load_result(path)
+            verdict = _check.gate_result(result, baseline)
+            bail = next((n for n in verdict["notes"]
+                         if n.startswith("skipped:")), None)
+            if not verdict["pass"]:
+                failed += 1
+                for r in verdict["regressions"]:
+                    print(f"{name}: REGRESSION {r['key']}: "
+                          f"{r['baseline']} -> {r['candidate']} "
+                          f"— {r['why']}")
+                path = RESULTS / f"{name}{RESULT_SUFFIX}_rejected.json"
+                print(f"{name}: gate FAIL — candidate -> {path}; "
+                      "baseline kept")
+            elif bail and baseline is not None and \
+                    "baseline is an error record" not in bail:
+                # the comparison bailed (platform mismatch, candidate
+                # error): an UNCOMPARABLE candidate must not replace the
+                # baseline either — a CPU smoke under --gate would
+                # silently clobber a TPU capture.  (A valid candidate
+                # over an error-record baseline IS written: recovery.)
+                path = RESULTS / f"{name}{RESULT_SUFFIX}_skipped.json"
+                print(f"{name}: gate SKIPPED ({bail[9:].strip()}) — "
+                      f"candidate -> {path}; baseline kept")
         path.write_text(json.dumps(result, indent=2) + "\n")
         print(f"{name}: {json.dumps(result)}")
     return 1 if failed else 0

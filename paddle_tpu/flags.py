@@ -197,6 +197,42 @@ define_flag("router_load_weight", 1.0,
             "Placement score penalty weight per queued/busy request on a "
             "replica, in page_size token units (one queued request "
             "offsets one cached page at 1.0).")
+define_flag("serving_sentinel", True,
+            "Online regression sentinel (observability/sentinel.py) in the "
+            "serving front door: EWMA+MAD drift detectors over TTFT/ITL, "
+            "per-phase step_ms, warm recompiles, queue depth and spec "
+            "accept rate, swept from the engine loop.  Anomalies bump "
+            "observability.anomaly{series,kind}, land as tracer instant "
+            "events, trigger a rate-limited flight-recorder dump (reason "
+            "'anomaly') and surface in /statusz.  Detectors need "
+            "FLAGS_sentinel_min_samples warm sweeps before they can fire, "
+            "so short-lived processes never false-positive.")
+define_flag("sentinel_alpha", 0.2,
+            "EWMA smoothing factor for the sentinel's baseline mean and "
+            "absolute-deviation trackers (observability/sentinel.py); "
+            "smaller adapts slower and flags longer after a level shift.")
+define_flag("sentinel_k", 4.0,
+            "Sentinel anomaly threshold: a sample is anomalous when "
+            "|value - ewma| > k * max(deviation, 10% of the baseline) — "
+            "the EWMA analog of a k-MAD robust outlier test.")
+define_flag("sentinel_min_samples", 16,
+            "Observations a sentinel detector must fold into its baseline "
+            "before it may flag anomalies (cold-start guard: a fresh "
+            "process learns its own normal first).")
+define_flag("sentinel_interval_s", 1.0,
+            "Minimum seconds between sentinel sweeps when driven from the "
+            "serving engine loop (Sentinel.maybe_check); each sweep reads "
+            "only host-side registry series — never a device sync.")
+define_flag("sentinel_history", 64,
+            "Bounded count of recent anomaly records the sentinel retains "
+            "for /statusz (oldest evicted first; the counters keep the "
+            "full totals).")
+define_flag("flight_recorder_min_interval_s", 30.0,
+            "Per-REASON rate limit on flight-recorder dumps: repeat dumps "
+            "with the same reason inside this window are suppressed "
+            "(counted in flight_recorder.suppressed_dumps) so a flapping "
+            "anomaly detector cannot write an unbounded stream of trace "
+            "files.  <=0 disables the limit.")
 define_flag("flight_recorder_events", 4096,
             "Bounded ring of recent trace spans kept by the crash flight "
             "recorder (observability/flight_recorder.py); the ring is "

@@ -798,6 +798,13 @@ class ContinuousBatchingEngine:
             metrics = _obs.metrics_enabled()
         self._obs: Optional[_ServingMetrics] = \
             _ServingMetrics() if metrics else None
+        # ---- per-phase step attribution (ISSUE 10): every dispatch is
+        # classified by program shape (prefill chunk / decode / spec
+        # verify / fused-K / COW copy / drain) — stamp() on the hot path
+        # is one list append; durations, histograms and EWMA baselines
+        # all fold at the existing drain
+        self.attribution: Optional[_obs.StepAttribution] = \
+            _obs.StepAttribution() if metrics else None
         # ---- prefix cache (ISSUE 4): radix-shared KV pages ----
         if prefix_cache is None:
             prefix_cache = flags.flag("prefix_cache")
@@ -972,6 +979,12 @@ class ContinuousBatchingEngine:
             out_mat, ncommit, dlen = self._dispatch_spec()
             t_step = time.perf_counter()
             self._pending.append(("spec", out_mat, ncommit, dlen, t_step))
+            if self.attribution is not None:
+                # committed-token counts are device-resident until the
+                # drain; credit_tokens() supplies them there
+                self.attribution.stamp(
+                    "spec_verify" if self.spec.mode == "ngram"
+                    else "fused_k", int(self.spec.k), t_step)
             if self._obs is not None:
                 o = self._obs
                 o.steps.inc()
@@ -1048,6 +1061,12 @@ class ContinuousBatchingEngine:
         # wall clock, no device sync
         t_step = time.perf_counter()
         self._pending.append(("step", self.tokens, commit, None, t_step))
+        if self.attribution is not None:
+            # a mixed step (prefill chunks in flight) is the prefill-
+            # chunk program shape; T=1 is pure decode.  Tokens = query
+            # tokens this dispatch processed (prompt chunk + decode cols)
+            self.attribution.stamp("prefill" if T > 1 else "decode",
+                                   int(T), t_step, int(ql.sum()))
         if self._obs is not None:
             o = self._obs
             o.steps.inc()
@@ -1096,6 +1115,8 @@ class ContinuousBatchingEngine:
                 src[i], dst[i] = s, d
             self.g.cache.update(*self._cow_jit(
                 *self.g.cache.arrays, jnp.asarray(src), jnp.asarray(dst)))
+            if self.attribution is not None:
+                self.attribution.stamp("cow_copy", 0)
 
     # ---- ISSUE 9: the speculative dispatch (decode-only batches) ----
     def _dispatch_spec(self):
@@ -1159,6 +1180,38 @@ class ContinuousBatchingEngine:
             s.update(self._spec_counts)
         return s
 
+    def inflight_requests(self, top_k: int = 8) -> List[dict]:
+        """Oldest in-flight requests (busy slots + waiting queue) with
+        their trace ids — the ``/statusz`` hung-request table (ISSUE 10
+        satellite): a request stuck in prefill or starved in the queue is
+        findable by id and age without exporting a trace dump.
+
+        Read-only over host state, safe to call from the statusz thread
+        while the engine thread runs (worst case a row retires mid-walk
+        and simply drops out of the next scrape)."""
+        now = time.perf_counter()
+
+        def row(req: Request, state: str, slot) -> dict:
+            t0 = req.t_enqueue
+            return {"req_id": req.req_id, "trace_id": req.trace_id,
+                    "state": state, "slot": slot,
+                    "age_s": None if t0 is None else round(now - t0, 3),
+                    "prompt_tokens": len(req.prompt),
+                    "generated": len(req.output)}
+
+        rows = []
+        for b in range(self.B):
+            req = self.slot_req[b]
+            if req is None:
+                continue
+            state = "prefill" if self.prompt_pos[b] < len(req.prompt) \
+                else "decode"
+            rows.append(row(req, state, b))
+        for req in list(self.waiting):
+            rows.append(row(req, "queued", None))
+        rows.sort(key=lambda r: -(r["age_s"] or 0.0))
+        return rows[:top_k]
+
     def prefix_digest(self, max_entries: Optional[int] = None):
         """Prefix-residency digest for router placement (ISSUE 7): the
         chain hashes of this engine's indexed KV pages plus the page
@@ -1185,6 +1238,8 @@ class ContinuousBatchingEngine:
         # jnp.stack would compile one executable per distinct length —
         # breaking the warm loop's zero-recompile contract
         obs = self._obs
+        attr = self.attribution
+        t_drain0 = time.perf_counter() if attr is not None else None
         if obs is not None:
             obs.drains.inc()
             _obs.count_sync()        # the window's host<->device transfer
@@ -1194,6 +1249,11 @@ class ContinuousBatchingEngine:
         self._pending.clear()
         self._steps_since_drain = 0
         self._fold_spec_metrics(window)
+        if attr is not None:
+            # fold the window's dispatch stamps (the final one closes
+            # against the drain's entry time) AFTER the spec token
+            # credits landed in _fold_spec_metrics
+            attr.fold(t_drain0)
         fin = np.asarray(self.finished)
         alloc = self.g.cache.allocator
         eos = self.gen_cfg.eos_token_id
@@ -1311,6 +1371,10 @@ class ContinuousBatchingEngine:
         self.last_stats = self.stats()
         if obs is not None:
             obs.update_pool(self.last_stats)
+        if attr is not None:
+            # the drain IS a phase: the steady state's one blocking
+            # host<->device transfer plus retire bookkeeping
+            attr.observe_host("drain", time.perf_counter() - t_drain0)
         return done
 
     def _fold_spec_metrics(self, window) -> None:
@@ -1342,6 +1406,10 @@ class ContinuousBatchingEngine:
                     obs.accept_len.observe(float(n - 1))
         if not n_spec:
             return
+        if self.attribution is not None and c_tot:
+            self.attribution.credit_tokens(
+                "spec_verify" if self.spec.mode == "ngram" else "fused_k",
+                c_tot)
         sc = self._spec_counts
         sc["spec_steps"] += n_spec
         sc["spec_committed_tokens"] += c_tot

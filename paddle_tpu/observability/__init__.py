@@ -32,17 +32,20 @@ import time
 from typing import Optional
 
 from .. import flags
-from . import metrics, tracing
+from . import catalog, metrics, tracing
+from .attribution import StepAttribution
 from .flight_recorder import FlightRecorder
 from .metrics import (REGISTRY, counter, find, gauge, histogram,
                       prometheus_text, reset, set_help, snapshot)
+from .sentinel import Sentinel
 from .tracing import TRACER, Tracer
 
 tracer = TRACER
 
-__all__ = ["metrics", "tracing", "REGISTRY", "counter", "gauge",
+__all__ = ["metrics", "tracing", "catalog", "REGISTRY", "counter", "gauge",
            "histogram", "snapshot", "prometheus_text", "reset", "find",
            "set_help", "tracer", "Tracer", "TRACER", "FlightRecorder",
+           "StepAttribution", "Sentinel",
            "metrics_enabled", "count_sync", "assert_overhead", "StepTimer",
            "export_chrome_trace"]
 

@@ -1,0 +1,241 @@
+"""Metric series catalog: the documented surface of the registry.
+
+One table maps every metric family the package can emit to its kind,
+label set and meaning.  ``docs/metrics.md`` is GENERATED from this table
+(``python -m paddle_tpu.observability.catalog``), and a tier-1 drift test
+asserts (a) every family the test process actually created is cataloged
+and (b) the committed markdown matches the generator's output — an
+emitted-but-undocumented series, or a stale doc, is a test failure, not a
+review nitpick (ISSUE 10 satellite).
+
+Keep entries in the family's home module order; the generator groups by
+dotted prefix.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from . import metrics as _metrics
+
+__all__ = ["CATALOG", "undocumented", "generate_markdown", "apply_help"]
+
+# family -> (kind, labels, meaning)
+CATALOG: Dict[str, tuple] = {
+    # ---- serving: request lifecycle (PR 5) ----
+    "serving.requests_total": (
+        "counter", "", "requests submitted to the engine"),
+    "serving.requests_completed": (
+        "counter", "", "requests retired by the engine"),
+    "serving.tokens_generated": (
+        "counter", "", "generated tokens retired across all requests"),
+    "serving.prefill_tokens": (
+        "counter", "", "prompt tokens prefilled (post prefix-cache trim)"),
+    "serving.steps": ("counter", "", "engine dispatches"),
+    "serving.drains": (
+        "counter", "", "host<->device drains (the steady state's only "
+        "sync; one per sync_every steps)"),
+    "serving.queue_wait_ms": (
+        "histogram", "", "enqueue -> admission wait per request"),
+    "serving.ttft_ms": (
+        "histogram", "", "enqueue -> first token per request "
+        "(dispatch-stamped, drain-folded)"),
+    "serving.itl_ms": (
+        "histogram", "", "inter-token latency per generated token after "
+        "the first"),
+    "serving.queue_depth": (
+        "histogram", "", "waiting-queue depth observed at each step"),
+    "serving.queue_depth_now": (
+        "gauge", "", "live waiting-queue depth"),
+    "serving.batch_occupancy": (
+        "histogram", "", "busy slots / max_batch per step"),
+    # ---- serving: per-phase step attribution (PR 10) ----
+    "serving.step_ms": (
+        "histogram", "phase=prefill|decode|spec_verify|fused_k|cow_copy"
+        "|drain",
+        "per-phase dispatch-to-dispatch engine step wall time "
+        "(observability/attribution.py; folded at drains)"),
+    "serving.tokens_per_sec": (
+        "gauge", "phase=...",
+        "per-phase throughput over the last drained window"),
+    # ---- serving: KV pool + prefix cache (PR 2/4) ----
+    "serving.pages_in_use": ("gauge", "", "allocated KV pages"),
+    "serving.peak_pages_in_use": (
+        "gauge", "", "high-water allocated KV pages"),
+    "serving.active_seqs": ("gauge", "", "sequences holding pages"),
+    "serving.prefix_cached_pages": (
+        "gauge", "", "radix-indexed shared KV pages"),
+    "serving.prefix_evictable_pages": (
+        "gauge", "", "idle cached pages the LRU pool could reclaim"),
+    "serving.prefix_hits": (
+        "counter", "", "admissions that attached a cached prefix"),
+    "serving.prefix_tokens_saved": (
+        "counter", "", "prompt tokens skipped via cached prefixes"),
+    "serving.cow_copies": (
+        "counter", "", "copy-on-write page privatizations"),
+    "serving.evicted_pages": (
+        "counter", "", "cached pages reclaimed under memory pressure"),
+    # ---- serving: speculative decoding (PR 9) ----
+    "serving.spec.drafted_tokens": (
+        "counter", "", "draft tokens dispatched for verification"),
+    "serving.spec.accepted_tokens": (
+        "counter", "", "draft tokens accepted by the verifier"),
+    "serving.spec.rejected_tokens": (
+        "counter", "", "draft tokens rolled back"),
+    "serving.spec.accept_len": (
+        "histogram", "", "tokens committed per speculative dispatch "
+        "beyond the first"),
+    # ---- serving: HTTP front door (PR 6) ----
+    "serving.http.requests": ("counter", "", "HTTP requests accepted"),
+    "serving.http.streams": ("counter", "", "streaming completions"),
+    "serving.http.responses": (
+        "counter", "code=...", "responses by status code"),
+    "serving.http.inflight": ("gauge", "", "open HTTP requests"),
+    "serving.http.request_ms": (
+        "histogram", "", "HTTP request wall time"),
+    "serving.http.slo_decision": (
+        "counter", "decision=admit|queue|shed", "SLO-burn admission "
+        "decisions"),
+    "serving.http.shed": (
+        "counter", "", "requests shed with 503 + Retry-After"),
+    # ---- router fleet plane (PR 7) ----
+    "router.requests": ("counter", "", "router requests accepted"),
+    "router.streams": ("counter", "", "router streaming completions"),
+    "router.responses": (
+        "counter", "code=...", "router responses by status code"),
+    "router.inflight": ("gauge", "", "open router requests"),
+    "router.request_ms": ("histogram", "", "router request wall time"),
+    "router.placement": (
+        "counter", "reason=affinity|prefix|load|round_robin",
+        "placement decisions by reason"),
+    "router.prefix_hit_pages": (
+        "histogram", "", "expected prefix-hit depth of scored "
+        "placements"),
+    "router.session_pins": ("gauge", "", "live session-affinity pins"),
+    "router.session_evictions": (
+        "counter", "", "LRU-evicted session pins"),
+    "router.failover": (
+        "counter", "phase=connect|stream", "requests that hit a dead "
+        "replica"),
+    "router.slo_decision": (
+        "counter", "decision=admit|shed|unavailable", "fleet admission "
+        "decisions"),
+    "router.shed": ("counter", "", "fleet-wide sheds"),
+    "router.health_polls": (
+        "counter", "result=ok|fail", "replica /statusz polls"),
+    "router.replicas": (
+        "gauge", "state=ready|warming|suspect|dead",
+        "replica count by health state"),
+    # ---- regression sentinel (PR 10) ----
+    "observability.anomaly": (
+        "counter", "series=...,kind=drift|burst",
+        "sentinel anomalies by watched series and detector kind "
+        "(observability/sentinel.py; each also lands as a tracer "
+        "instant event and a rate-limited flight-recorder dump)"),
+    # ---- train loop (PR 5 StepTimer, default name) ----
+    "train.steps": ("counter", "", "train steps dispatched"),
+    "train.step_ms": (
+        "histogram", "", "warm train-step wall time (compile-bearing "
+        "steps excluded)"),
+    "train.tokens_per_sec": (
+        "gauge", "", "throughput of the last warm train step"),
+    "train.recompiles": (
+        "counter", "", "XLA backend compiles attributed to train steps"),
+    "train.grad_comm_bytes": (
+        "counter", "", "analytic gradient-sync traffic"),
+    # ---- compile telemetry (PR 2/5) ----
+    "jit.backend_compiles": (
+        "counter", "", "process-wide XLA backend compiles"),
+    "jit.backend_compile_ms": (
+        "histogram", "", "XLA backend compile durations"),
+    "jit.to_static_compiles": (
+        "counter", "", "to_static guard-cache compiles"),
+    "jit.to_static_evictions": (
+        "counter", "", "to_static guard-cache LRU evictions"),
+    "jit.to_static_bucket_pads": (
+        "counter", "", "to_static bucket-padding events"),
+    # ---- observability runtime guards (PR 5/6) ----
+    "host.device_syncs": (
+        "counter", "", "marked intentional host<->device syncs "
+        "(count_sync; assert_overhead bounds these)"),
+    "metrics.dropped_series": (
+        "counter", "", "label sets folded into {series=__overflow__} by "
+        "the FLAGS_metrics_max_series cardinality guard"),
+    "tracing.dropped_events": (
+        "counter", "", "trace events dropped at the "
+        "FLAGS_trace_max_events cap"),
+    "flight_recorder.dumps": (
+        "counter", "", "flight-recorder dump files written"),
+    "flight_recorder.suppressed_dumps": (
+        "counter", "", "dumps swallowed by the per-reason rate limit "
+        "(FLAGS_flight_recorder_min_interval_s)"),
+    # ---- profiler frontend (PR 5) ----
+    "profiler.host_events_ms": (
+        "histogram", "event=...,type=...", "RecordEvent span durations"),
+    # ---- collective watchdog (PR 5) ----
+    "watchdog.timeouts": ("counter", "", "watchdog timeout fires"),
+    "watchdog.outstanding_tasks": (
+        "gauge", "", "collectives currently in flight"),
+    "watchdog.last_heartbeat_age_s": (
+        "gauge", "", "seconds since the last collective completed"),
+}
+
+
+def undocumented(families: Optional[Dict[str, str]] = None) -> list:
+    """Families present in the registry but missing from the catalog.
+    ``train.*``-shaped StepTimer families with custom names are the
+    caller's to exclude (tests use throwaway ``t9...`` names)."""
+    if families is None:
+        families = _metrics.REGISTRY.families()
+    return sorted(n for n in families if n not in CATALOG)
+
+
+def apply_help() -> None:
+    """Attach every catalog entry's meaning as the family's Prometheus
+    ``# HELP`` text."""
+    for name, (_kind, _labels, help_text) in CATALOG.items():
+        _metrics.REGISTRY.set_help(name, help_text)
+
+
+def generate_markdown() -> str:
+    """Render docs/metrics.md from the catalog (grouped by family
+    prefix), byte-for-byte reproducible so the drift test can compare."""
+    groups: Dict[str, list] = {}
+    for name, (kind, labels, help_text) in CATALOG.items():
+        groups.setdefault(name.split(".", 1)[0], []).append(
+            (name, kind, labels, help_text))
+    lines = [
+        "# Metric series catalog",
+        "",
+        "Every registry family `paddle_tpu` emits, generated from",
+        "`paddle_tpu/observability/catalog.py`",
+        "(`python -m paddle_tpu.observability.catalog` rewrites this",
+        "file; a tier-1 drift test keeps it honest).  Scrape them live",
+        "from a serving replica's `/metrics` (strict Prometheus text,",
+        "dots sanitized to underscores) or grab the JSON snapshot",
+        "stamped into every bench result under `\"metrics\"`.",
+        "",
+        "`train.*` rows describe the default `StepTimer(\"train\")`;",
+        "a custom timer name replaces the prefix.",
+    ]
+    for prefix in sorted(groups):
+        lines += ["", f"## `{prefix}.*`", "",
+                  "| series | kind | labels | meaning |",
+                  "|---|---|---|---|"]
+        for name, kind, labels, help_text in groups[prefix]:
+            lbl = f"`{labels}`" if labels else "—"
+            lines.append(f"| `{name}` | {kind} | {lbl} | {help_text} |")
+    return "\n".join(lines) + "\n"
+
+
+def main() -> int:
+    import pathlib
+    out = pathlib.Path(__file__).resolve().parents[2] / "docs/metrics.md"
+    out.write_text(generate_markdown())
+    print(f"wrote {out} ({len(CATALOG)} families)")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -46,6 +46,9 @@ from .tracing import TRACER
 __all__ = ["FlightRecorder"]
 
 _DUMPS = _metrics.counter("flight_recorder.dumps")
+# per-reason rate-limited dumps that were swallowed (ISSUE 10 satellite:
+# a flapping anomaly detector must not write an unbounded file stream)
+_SUPPRESSED = _metrics.counter("flight_recorder.suppressed_dumps")
 
 
 class FlightRecorder:
@@ -67,6 +70,7 @@ class FlightRecorder:
     def __init__(self, path: Optional[str] = None,
                  max_events: Optional[int] = None,
                  snapshot_every_s: Optional[float] = None,
+                 min_interval_s: Optional[float] = None,
                  tracer=TRACER, registry=_metrics.REGISTRY):
         self.path = path or str(flags.flag("flight_recorder_path"))
         self.max_events = int(max_events
@@ -74,6 +78,13 @@ class FlightRecorder:
         self.snapshot_every_s = float(
             snapshot_every_s if snapshot_every_s is not None
             else flags.flag("flight_recorder_snapshot_s"))
+        # per-REASON dump rate limit: a storm of same-reason triggers
+        # (flapping sentinel, watchdog re-fires) yields one file per
+        # window; distinct reasons never shadow each other
+        self.min_interval_s = float(
+            min_interval_s if min_interval_s is not None
+            else flags.flag("flight_recorder_min_interval_s"))
+        self._last_reason_dump: dict = {}   # reason -> (t, path)
         self._tracer = tracer
         self._registry = registry
         self._ring: deque = deque(maxlen=self.max_events)
@@ -127,6 +138,14 @@ class FlightRecorder:
         returns the path.  Safe from any thread (watchdog poller, signal
         handler, excepthook) — serialized by a lock, never raises."""
         with self._dump_lock:
+            prev = self._last_reason_dump.get(reason)
+            if prev is not None and path is None and \
+                    self.min_interval_s > 0 and \
+                    time.perf_counter() - prev[0] < self.min_interval_s:
+                # same-reason dump inside the window: suppressed, counted,
+                # and the existing file stands as the window's evidence
+                _SUPPRESSED.inc()
+                return prev[1]
             out = path or self._dump_path(reason)
             try:
                 # other threads may still be appending spans / creating
@@ -164,6 +183,7 @@ class FlightRecorder:
                       file=sys.stderr)
                 return out
             _DUMPS.inc()
+            self._last_reason_dump[reason] = (time.perf_counter(), out)
             self.last_dump = out
             print(f"[paddle_tpu flight_recorder] {reason}: dumped "
                   f"{len(events)} events -> {out}", file=sys.stderr)

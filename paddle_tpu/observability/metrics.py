@@ -242,6 +242,19 @@ class MetricRegistry:
             out.extend(m for (n, _), m in list(t.items()) if n == name)
         return out
 
+    def families(self) -> Dict[str, str]:
+        """Family name -> kind for every series ever created in this
+        process — the surface the metrics catalog's drift test audits
+        (an emitted-but-undocumented family is a doc regression)."""
+        out: Dict[str, str] = {}
+        with self._lock:
+            for table, kind in ((self._counters, "counter"),
+                                (self._gauges, "gauge"),
+                                (self._histograms, "histogram")):
+                for (n, _lb) in table:
+                    out.setdefault(n, kind)
+        return out
+
     def reset(self, prefix: str = "") -> None:
         """Zero every series whose name starts with ``prefix`` ("" = all)
         — IN PLACE, so metric handles already resolved by hot paths (the

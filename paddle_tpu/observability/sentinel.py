@@ -1,0 +1,312 @@
+"""Online regression sentinel (ISSUE 10 tentpole, part 2).
+
+Five PRs of raw telemetry (metrics registry, span tracer, flight recorder,
+/metrics plane) still required a human to read a histogram before a slow
+step looked any different from a normal one.  The sentinel closes that
+loop: EWMA + absolute-deviation drift detectors (the streaming analog of a
+k-MAD robust outlier test) watch selected registry series and, the moment
+a sample breaks from its learned baseline,
+
+- bump ``observability.anomaly{series=...,kind=...}`` (bounded labels:
+  the watch list is fixed at construction),
+- emit a tracer instant event carrying the full anomaly record (so the
+  flight-recorder ring — and therefore any dump — contains the evidence),
+- trigger a rate-limited flight-recorder dump with reason ``anomaly``
+  (the per-reason rate limit lives in ``FlightRecorder.dump``), and
+- retain a bounded history for the replica's ``/statusz`` ``anomalies``
+  section, which the router aggregates fleet-wide.
+
+Watched series (the regression surface of the serving stack):
+
+==========================  =========  ==================================
+series                      kind       sample per sweep
+==========================  =========  ==================================
+serving.ttft_ms             drift      mean of NEW observations (Δsum/Δn)
+serving.itl_ms              drift      mean of new observations
+serving.queue_wait_ms       drift      mean of new observations
+serving.step_ms{phase=...}  drift      mean of new observations, per phase
+jit.backend_compiles        burst      Δcount — ANY warm recompile after
+                                       the warmup window is an anomaly
+serving.queue_depth_now     drift      gauge level
+spec accept rate            drift      Δaccepted / Δdrafted
+==========================  =========  ==================================
+
+Every sweep reads host-side registry floats only — a sentinel check can
+never add a device sync, so it is safe to call from the serving engine
+loop at the ``FLAGS_sentinel_interval_s`` cadence.
+
+Cold start: a detector must fold ``FLAGS_sentinel_min_samples`` samples
+into its baseline before it may fire, so a fresh process (or a short test
+run) learns its own normal first and steady traffic produces zero
+anomalies.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .. import flags
+from . import metrics as _metrics
+from .attribution import Ewma
+from .tracing import TRACER
+
+__all__ = ["Drift", "Sentinel"]
+
+# relative deviation floor: the threshold never collapses below 10% of
+# the baseline level, so a near-constant series (dev -> 0) does not flag
+# every harmless wiggle
+_REL_FLOOR = 0.1
+# absolute deviation floor for ms/count-scale series: a baseline learned
+# at exactly 0 (idle queue, quiet latency window) must not make the very
+# first nonzero sample a guaranteed "anomaly" with an absurd ratio —
+# deviations under this are never anomalous.  Ratio-scale detectors
+# (accept rate lives in [0, 1]) pass their own smaller floor.
+_ABS_FLOOR = 1.0
+_RATE_FLOOR = 0.05
+
+
+class Drift:
+    """Drift detector for one scalar series: the shared ``Ewma``
+    baseline recurrence (attribution.py — one definition serves both
+    the cost table and the detectors) plus a k-of-deviation threshold.
+    ``update(v)`` returns the anomaly deviation ratio (>1 means fired)
+    or ``None`` while normal / warming up.
+
+    The baseline keeps learning THROUGH anomalies (a persistent level
+    shift fires for a while, then becomes the new normal — the detector
+    flags regressions, it does not hold grudges)."""
+
+    __slots__ = ("ewma", "k", "min_samples", "min_dev", "fired")
+
+    def __init__(self, alpha: float, k: float, min_samples: int,
+                 min_dev: float = _ABS_FLOOR):
+        self.ewma = Ewma(alpha)
+        self.k = k
+        self.min_samples = min_samples
+        self.min_dev = min_dev
+        self.fired = 0
+
+    @property
+    def mean(self) -> float:
+        return self.ewma.mean
+
+    @property
+    def n(self) -> int:
+        return self.ewma.n
+
+    def update(self, v: float) -> Optional[float]:
+        ratio = None
+        e = self.ewma
+        if e.n >= self.min_samples:
+            floor = max(e.dev, _REL_FLOOR * abs(e.mean), self.min_dev)
+            dev = abs(v - e.mean)
+            if dev > self.k * floor:
+                ratio = dev / (self.k * floor)
+                self.fired += 1
+        e.update(v)
+        return ratio
+
+    def state(self) -> Dict[str, float]:
+        e = self.ewma
+        return {"ewma": round(e.mean, 4), "dev": round(e.dev, 4),
+                "n": e.n, "fired": self.fired}
+
+
+class _HistDelta:
+    """Windowed mean of a histogram's NEW observations since the last
+    sweep (Δsum / Δcount) — one (count, sum) snapshot per series."""
+
+    __slots__ = ("count", "sum")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+
+    def sample(self, h) -> Optional[float]:
+        dc = h.count - self.count
+        ds = h.sum - self.sum
+        self.count = h.count
+        self.sum = h.sum
+        if dc <= 0:
+            return None
+        return ds / dc
+
+
+class Sentinel:
+    """Drift detection over the live registry.  Construct once per
+    process (the serving server does, behind ``FLAGS_serving_sentinel``),
+    call ``maybe_check()`` from the engine loop, read ``state()`` from
+    ``/statusz``."""
+
+    # histogram families watched via windowed means (every label set of
+    # each family gets its own detector, so per-phase step_ms series are
+    # tracked independently)
+    HIST_FAMILIES = ("serving.ttft_ms", "serving.itl_ms",
+                     "serving.queue_wait_ms", "serving.step_ms")
+    GAUGE_FAMILIES = ("serving.queue_depth_now",)
+
+    def __init__(self, registry=_metrics.REGISTRY, tracer=TRACER,
+                 flight_recorder=None, alpha: Optional[float] = None,
+                 k: Optional[float] = None,
+                 min_samples: Optional[int] = None,
+                 interval_s: Optional[float] = None,
+                 history: Optional[int] = None):
+        f = flags.flag
+        self._registry = registry
+        self._tracer = tracer
+        self._fr = flight_recorder
+        self.alpha = float(f("sentinel_alpha") if alpha is None else alpha)
+        self.k = float(f("sentinel_k") if k is None else k)
+        self.min_samples = int(f("sentinel_min_samples")
+                               if min_samples is None else min_samples)
+        self.interval_s = float(f("sentinel_interval_s")
+                                if interval_s is None else interval_s)
+        self._detectors: Dict[str, Drift] = {}
+        self._hist_state: Dict[str, _HistDelta] = {}
+        self._last_check: Optional[float] = None
+        self.checks = 0
+        self.anomalies_total = 0
+        self.recent: deque = deque(maxlen=int(
+            f("sentinel_history") if history is None else history))
+        # burst probe state: compile count at the last sweep + warm sweeps
+        # seen (a compile burst is only anomalous once the process proved
+        # it CAN run warm — min_samples sweeps without a single compile)
+        self._compiles = registry.counter("jit.backend_compiles")
+        self._compiles_seen = self._compiles.value
+        self._warm_sweeps = 0
+        # spec accept-rate probe state
+        self._spec_acc = registry.counter("serving.spec.accepted_tokens")
+        self._spec_drf = registry.counter("serving.spec.drafted_tokens")
+        self._spec_seen = (self._spec_acc.value, self._spec_drf.value)
+
+    # --------------------------------------------------------- detectors --
+    def _detector(self, series: str,
+                  min_dev: float = _ABS_FLOOR) -> Drift:
+        d = self._detectors.get(series)
+        if d is None:
+            d = self._detectors[series] = Drift(self.alpha, self.k,
+                                                self.min_samples,
+                                                min_dev=min_dev)
+        return d
+
+    def _flag(self, series: str, kind: str, value: float, baseline: float,
+              ratio: float, now: float) -> dict:
+        # wall-clock stamp, NOT perf_counter: the router merges these
+        # records across replica processes, whose perf_counter epochs
+        # are not comparable
+        rec = {"series": series, "kind": kind, "value": round(value, 4),
+               "baseline": round(baseline, 4), "ratio": round(ratio, 3),
+               "t": round(time.time(), 3)}
+        self.anomalies_total += 1
+        self.recent.append(rec)
+        # the watch list is fixed at construction: series/kind label
+        # values are drawn from the bounded HIST/GAUGE family tuples plus
+        # the two literal probes below — never from request data
+        self._registry.counter("observability.anomaly",
+                               series=str(series), kind=str(kind)).inc()
+        if self._tracer.enabled:
+            self._tracer.instant("observability.anomaly", cat="sentinel",
+                                 tid="sentinel", args=rec)
+        if self._fr is not None:
+            # off the engine thread: dump() serializes the whole ring +
+            # a registry snapshot to disk — inline it would stall every
+            # in-flight request's next token behind the write (a latency
+            # anomaly must not CAUSE a latency spike).  Rate-limited per
+            # reason inside dump(), so a flapping detector yields one
+            # file (and mostly no-op threads) per
+            # FLAGS_flight_recorder_min_interval_s.
+            threading.Thread(target=self._fr.dump,
+                             kwargs={"reason": "anomaly"},
+                             name="sentinel-dump", daemon=True).start()
+        return rec
+
+    # ------------------------------------------------------------- sweep --
+    def maybe_check(self, now: Optional[float] = None) -> List[dict]:
+        """Time-gated ``check()`` — cheap to call every engine-loop
+        iteration (one float compare when inside the interval)."""
+        now = time.perf_counter() if now is None else now
+        if self._last_check is not None and \
+                now - self._last_check < self.interval_s:
+            return []
+        return self.check(now)
+
+    def check(self, now: Optional[float] = None) -> List[dict]:
+        """One sweep over every watched series; returns the anomalies it
+        flagged (empty in the steady state)."""
+        now = time.perf_counter() if now is None else now
+        self._last_check = now
+        self.checks += 1
+        out: List[dict] = []
+
+        for fam in self.HIST_FAMILIES:
+            for h in self._registry.find(fam, "histogram"):
+                name = _metrics._series_name(h.name, h.labels)
+                st = self._hist_state.get(name)
+                if st is None:
+                    st = self._hist_state[name] = _HistDelta()
+                v = st.sample(h)
+                if v is None:
+                    continue
+                det = self._detector(name)
+                base = det.mean
+                ratio = det.update(v)
+                if ratio is not None:
+                    out.append(self._flag(name, "drift", v, base, ratio,
+                                          now))
+
+        for fam in self.GAUGE_FAMILIES:
+            for g in self._registry.find(fam, "gauge"):
+                name = _metrics._series_name(g.name, g.labels)
+                det = self._detector(name)
+                base = det.mean
+                ratio = det.update(float(g.value))
+                if ratio is not None:
+                    out.append(self._flag(name, "drift", float(g.value),
+                                          base, ratio, now))
+
+        # warm-recompile burst: after min_samples consecutive compile-free
+        # sweeps the process is warm — ANY backend compile after that is a
+        # bucket miss / cache invalidation the engine contract forbids
+        c = self._compiles.value
+        fresh = c - self._compiles_seen
+        self._compiles_seen = c
+        if fresh > 0:
+            if self._warm_sweeps >= self.min_samples:
+                out.append(self._flag("jit.backend_compiles", "burst",
+                                      float(fresh), 0.0, float(fresh),
+                                      now))
+            self._warm_sweeps = 0
+        else:
+            self._warm_sweeps += 1
+
+        # speculative accept rate: a drafting regression shows up as the
+        # per-sweep acceptance ratio drifting off its baseline
+        acc, drf = self._spec_acc.value, self._spec_drf.value
+        da, dd = acc - self._spec_seen[0], drf - self._spec_seen[1]
+        self._spec_seen = (acc, drf)
+        if dd > 0:
+            det = self._detector("serving.spec.accept_rate",
+                                 min_dev=_RATE_FLOOR)
+            base = det.mean
+            ratio = det.update(da / dd)
+            if ratio is not None:
+                out.append(self._flag("serving.spec.accept_rate", "drift",
+                                      da / dd, base, ratio, now))
+        return out
+
+    # ------------------------------------------------------------- export --
+    def state(self) -> dict:
+        """The /statusz ``anomalies`` section: totals, recent records,
+        and every detector's live baseline."""
+        # dict()/list() snapshots are single C-level copies (atomic under
+        # the GIL): statusz runs on the HTTP thread while the engine
+        # thread inserts detectors / appends records
+        return {"checks": self.checks,
+                "anomalies_total": self.anomalies_total,
+                "recent": list(self.recent),
+                "detectors": {name: d.state()
+                              for name, d in sorted(
+                                  dict(self._detectors).items())}}

@@ -74,6 +74,10 @@ class ReplicaState:
         self.queue_depth: int = 0       # waiting + busy slots, replica-side
         self.slo_decision: str = "admit"
         self.retry_after_s: int = 1
+        # sentinel view from the last poll (ISSUE 10): anomaly totals +
+        # recent records, aggregated fleet-wide in the router's /statusz
+        self.anomaly_total = 0
+        self.anomalies_recent: list = []
         # router-side live signals
         self.inflight = 0               # proxied requests currently open
         # routed overlay: hash -> poll generation at credit time, so
@@ -118,6 +122,19 @@ class ReplicaState:
         else:
             self.digest = frozenset()
             self.routed.clear()
+        anomalies = doc.get("anomalies")
+        if isinstance(anomalies, dict):
+            try:
+                self.anomaly_total = int(
+                    anomalies.get("anomalies_total", 0) or 0)
+            except (TypeError, ValueError):
+                self.anomaly_total = 0
+            recent = anomalies.get("recent")
+            self.anomalies_recent = list(recent)[-16:] \
+                if isinstance(recent, list) else []
+        else:
+            self.anomaly_total = 0
+            self.anomalies_recent = []
         slo = doc.get("slo")
         if slo:
             self.slo_decision = str(slo.get("decision", "admit"))
@@ -178,6 +195,7 @@ class ReplicaState:
                 "page_size": self.page_size,
                 "slo": {"decision": self.slo_decision,
                         "retry_after_s": self.retry_after_s},
+                "anomalies": self.anomaly_total,
                 "failovers": self.failovers}
 
 

@@ -564,6 +564,10 @@ class RouterServer:
                        "background": bool(self._health_tasks)},
             "replicas": [s.describe(self.dead_after)
                          for s in self.states],
+            # fleet-wide sentinel view (ISSUE 10): per-replica anomaly
+            # totals from the last polls plus a merged recent tail, each
+            # record tagged with the replica that reported it
+            "anomalies": self._fleet_anomalies(),
             "sessions": self.placer.session_state(),
             "failover": {
                 "connect": int(_obs.metrics.counter(
@@ -573,6 +577,18 @@ class RouterServer:
             "shed_total": int(self._m.shed.value),
             "pid": os.getpid(),
         }
+
+    def _fleet_anomalies(self) -> dict:
+        recent = []
+        for s in self.states:
+            for rec in s.anomalies_recent:
+                if isinstance(rec, dict):
+                    recent.append({**rec, "replica": s.id})
+        recent.sort(key=lambda r: r.get("t") or 0.0)
+        return {"total": sum(s.anomaly_total for s in self.states),
+                "by_replica": {s.id: s.anomaly_total
+                               for s in self.states},
+                "recent": recent[-32:]}
 
     # --------------------------------------------------------- lifecycle --
     async def start_http(self, host: str = "127.0.0.1", port: int = 0):

@@ -126,7 +126,8 @@ class ServingServer:
 
     def __init__(self, engine, *, model_name: str = "paddle-tpu",
                  slo=None, flight_recorder=None, watchdog=None,
-                 poll_s: float = 0.02, warmup: bool = False):
+                 sentinel=None, poll_s: float = 0.02,
+                 warmup: bool = False):
         self.engine = engine
         self.model_name = model_name
         # readiness (ISSUE 7): with warmup=True the engine thread compiles
@@ -139,6 +140,14 @@ class ServingServer:
         self.flight_recorder: Optional[FlightRecorder] = \
             FlightRecorder() if flight_recorder is None \
             else (flight_recorder or None)
+        # regression sentinel (ISSUE 10): EWMA+MAD drift detection over
+        # the live registry, swept from the engine loop.  ``None`` builds
+        # one per FLAGS_serving_sentinel (metrics on only — with the
+        # registry dark there is nothing to watch); ``False`` disables.
+        if sentinel is None and flags.flag("serving_sentinel") \
+                and _obs.metrics_enabled():
+            sentinel = _obs.Sentinel(flight_recorder=self.flight_recorder)
+        self.sentinel: Optional[_obs.Sentinel] = sentinel or None
         self._watchdog = watchdog     # CommTaskManager or None
         self._poll_s = poll_s
         self._inbox: "queue.SimpleQueue[_Stream]" = queue.SimpleQueue()
@@ -261,6 +270,10 @@ class ServingServer:
                     self._wake.clear()
                 if fr is not None:
                     fr.maybe_snapshot()
+                if self.sentinel is not None:
+                    # host-side registry reads only (never a device
+                    # sync); time-gated by FLAGS_sentinel_interval_s
+                    self.sentinel.maybe_check()
         except Exception as e:
             # the engine died mid-serve: THE flight-recorder moment.
             # Dump, then fall through to retire every waiter — clients
@@ -643,6 +656,20 @@ class ServingServer:
             "prefix_digest": eng.prefix_digest()
             if hasattr(eng, "prefix_digest") else None,
             "slo": self.slo.state() if self.slo is not None else None,
+            # latency quantiles (ISSUE 10 satellite): the p50/p95/p99
+            # the registry already computes, surfaced per series incl.
+            # every per-phase step_ms — a scraper-free latency read
+            "latency": self._latency_summaries(),
+            # hung-request table: top-K oldest in-flight with trace ids
+            "inflight_requests": eng.inflight_requests()
+            if hasattr(eng, "inflight_requests") else None,
+            # per-(phase, bucket) EWMA step-cost table (ISSUE 10)
+            "attribution": eng.attribution.baselines()
+            if getattr(eng, "attribution", None) is not None else None,
+            # sentinel verdicts (ISSUE 10): recent anomalies + detector
+            # baselines; the router aggregates these fleet-wide
+            "anomalies": self.sentinel.state()
+            if self.sentinel is not None else None,
             "flight_recorder": None,
             "jit_cache": _jit.cache_stats(),
             "build": {
@@ -661,7 +688,24 @@ class ServingServer:
                 "last_dump": fr.last_dump,
                 "dumps": int(_obs.metrics.counter(
                     "flight_recorder.dumps").value),
+                "suppressed": int(_obs.metrics.counter(
+                    "flight_recorder.suppressed_dumps").value),
+                "min_interval_s": fr.min_interval_s,
             }
+        return out
+
+    @staticmethod
+    def _latency_summaries() -> dict:
+        """p50/p95/p99 per latency series (every label set — the
+        per-phase ``serving.step_ms{phase=...}`` family included)."""
+        from ..observability.metrics import _series_name
+        out = {}
+        for fam in ("serving.ttft_ms", "serving.itl_ms",
+                    "serving.queue_wait_ms", "serving.step_ms"):
+            for h in _obs.REGISTRY.find(fam, "histogram"):
+                s = h.summary()
+                out[_series_name(h.name, h.labels)] = {
+                    k: s[k] for k in ("count", "p50", "p95", "p99")}
         return out
 
 

@@ -1,0 +1,256 @@
+"""Bench regression gate (ISSUE 10): comparison semantics, verdict
+stamping, the CLI self-test, and the identical-re-run acceptance
+criterion over the COMMITTED benchmarks/results/.  Stdlib-only — the
+gate must never need jax."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from benchmarks import check  # noqa: E402
+
+
+BASE = {"config": "synthetic", "platform": "cpu",
+        "serve_metrics_on_tok_per_sec": 1000.0,
+        "serve_metrics_overhead_frac": 0.01,
+        "decode_ms_per_token_b1": 5.0,
+        "serve_ttft_ms": {"count": 10, "p50": 40.0, "p95": 90.0,
+                          "p99": 120.0},
+        "serve_queue_wait_ms": {"count": 10, "p50": 3.0, "p95": 9.0},
+        "serve_tokens_match": True,
+        "serve_requests": 24, "wall_s": 3.0,
+        "metrics": {"counters": {}},
+        "static_analysis": {"findings": 0}}
+
+
+def test_identical_records_pass():
+    v = check.compare_result(dict(BASE), dict(BASE))
+    assert v["pass"] and v["checked"] > 0 and v["regressions"] == []
+
+
+def test_synthetic_20pct_tok_per_sec_regression_fails():
+    slow = dict(BASE, serve_metrics_on_tok_per_sec=800.0)
+    v = check.compare_result(slow, dict(BASE))
+    assert not v["pass"]
+    (r,) = [x for x in v["regressions"]
+            if x["key"] == "serve_metrics_on_tok_per_sec"]
+    assert r["ratio"] == pytest.approx(0.8)
+
+
+def test_in_band_jitter_passes():
+    jig = dict(BASE, serve_metrics_on_tok_per_sec=900.0,   # -10% < 15%
+               decode_ms_per_token_b1=6.0,                 # +20% < 50%
+               serve_ttft_ms={"count": 10, "p50": 50.0, "p95": 110.0,
+                              "p99": 140.0})
+    assert check.compare_result(jig, dict(BASE))["pass"]
+
+
+def test_latency_record_regression_caught():
+    slow = dict(BASE, serve_ttft_ms={"count": 10, "p50": 70.0,
+                                     "p95": 90.0, "p99": 120.0})
+    v = check.compare_result(slow, dict(BASE))
+    assert not v["pass"]
+    assert any(r["key"] == "serve_ttft_ms.p50" for r in v["regressions"])
+
+
+def test_scalar_latency_regression_caught():
+    slow = dict(BASE, decode_ms_per_token_b1=9.0)          # +80%
+    v = check.compare_result(slow, dict(BASE))
+    assert any(r["key"] == "decode_ms_per_token_b1"
+               for r in v["regressions"])
+
+
+def test_contract_boolean_flip_fails_any_band():
+    broken = dict(BASE, serve_tokens_match=False)
+    v = check.compare_result(broken, dict(BASE),
+                             band_throughput=0.99, band_latency=9.0)
+    assert not v["pass"]
+    assert v["regressions"][0]["kind"] == "bool_contract"
+
+
+def test_error_and_platform_mismatch_skip_not_fail():
+    err = {"config": "x", "error": "boom"}
+    assert check.compare_result(dict(BASE), err)["pass"]
+    assert check.compare_result(err, dict(BASE))["pass"]
+    tpu = dict(BASE, platform="tpu")
+    v = check.compare_result(tpu, dict(BASE))
+    assert v["pass"] and v["checked"] == 0
+    assert any("platform mismatch" in n for n in v["notes"])
+
+
+def test_missing_gated_metric_is_a_regression():
+    """A refactor that stops stamping a gated key (tok/s, a bit-match
+    flag) is the silent-regression path itself — notes are not enough."""
+    for key in ("serve_metrics_on_tok_per_sec", "serve_tokens_match"):
+        cand = {k: v for k, v in BASE.items() if k != key}
+        v = check.compare_result(cand, dict(BASE))
+        assert not v["pass"]
+        (r,) = [x for x in v["regressions"] if x["key"] == key]
+        assert "missing" in r["why"]
+
+
+def test_occupancy_record_not_gated_as_latency():
+    """serve_batch_occupancy is a higher-is-better fraction; its
+    {p50,p95} record shape must not drag it into latency semantics."""
+    assert check.classify("serve_batch_occupancy",
+                          {"p50": 0.4, "p95": 0.9}) is None
+    base = dict(BASE, serve_batch_occupancy={"count": 10, "p50": 0.4,
+                                             "p95": 0.9})
+    better = dict(base, serve_batch_occupancy={"count": 10, "p50": 0.7,
+                                               "p95": 0.95})
+    assert check.compare_result(better, base)["pass"]
+
+
+def test_cli_file_mode_identity_not_stamped(tmp_path):
+    """Pointing --candidate at the committed baseline file itself is an
+    identity run and must not rewrite the committed record."""
+    serve = ROOT / "benchmarks" / "results" / "serve.json"
+    before = serve.read_bytes()
+    r = _run_cli("--candidate", str(serve))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert serve.read_bytes() == before
+
+
+def test_noisy_and_bookkeeping_keys_not_gated():
+    # queue wait is workload-shaped; wall_s / counts are bookkeeping
+    assert check.classify("serve_queue_wait_ms", {"p50": 1, "p95": 2}) \
+        is None
+    assert check.classify("http_client_chunk_gap_ms", 5.0) is None
+    assert check.classify("wall_s", 3.0) is None
+    assert check.classify("serve_requests", 24) is None
+    assert check.classify("metrics", {}) is None
+    # and the gated classes classify as expected
+    assert check.classify("serve_metrics_on_tok_per_sec", 1.0) \
+        == "throughput"
+    assert check.classify("decode_ms_per_token_b1", 1.0) == "latency"
+    assert check.classify("serve_ttft_ms", {"p50": 1, "p95": 2}) \
+        == "latency_record"
+    assert check.classify("serve_tokens_match", True) == "bool_contract"
+
+
+def test_driver_headline_value_gated_via_metric_name():
+    """bench.py's record keeps its tok/s under the literal key "value";
+    the sibling "metric" name classifies it (the bench.py --gate path)."""
+    base = {"metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 5000.0, "unit": "tokens/s", "platform": "cpu"}
+    assert check.compare_result(dict(base), base)["pass"]
+    v = check.compare_result(dict(base, value=3500.0), base)
+    assert not v["pass"]
+    assert v["regressions"][0]["key"].startswith("value (")
+    # a record without a rate-shaped metric name is not value-gated
+    other = {"metric": "something_else", "value": 5.0, "platform": "cpu"}
+    assert check.compare_result(dict(other, value=1.0), other)["pass"]
+
+
+def test_zero_baseline_skipped_with_note():
+    base = dict(BASE, dit_mfu=0.0)
+    cand = dict(base)
+    v = check.compare_result(cand, base)
+    assert v["pass"]
+    assert any("zero baseline" in n for n in v["notes"])
+
+
+def test_error_baseline_unwraps_to_previous():
+    """run.py archives a timed-out run as {"error": ..., "previous":
+    <last good record>}; the gate must compare against that previous —
+    one transient infra failure must not blind the next gated run."""
+    err_baseline = {"config": "serve", "error": "timeout after 2400s",
+                    "previous": dict(BASE)}
+    regressed = dict(BASE, serve_metrics_on_tok_per_sec=700.0)
+    v = check.gate_result(regressed, err_baseline)
+    assert not v["pass"]
+    assert any(r["key"] == "serve_metrics_on_tok_per_sec"
+               for r in v["regressions"])
+    assert any("previous" in n for n in v["notes"])
+    # healthy candidate over the same error baseline: clean pass
+    assert check.gate_result(dict(BASE), dict(err_baseline))["pass"]
+    # error baseline WITHOUT a previous: nothing to compare, skip-pass
+    v2 = check.gate_result(dict(BASE), {"config": "serve", "error": "x"})
+    assert v2["pass"] and v2["checked"] == 0
+
+
+def test_gate_result_stamps_verdict():
+    cand = dict(BASE)
+    verdict = check.gate_result(cand, dict(BASE))
+    assert cand["regression_gate"] is verdict
+    assert verdict["pass"] and verdict["checked_at"]
+    # no baseline at all: pass with a note, still stamped
+    cand2 = dict(BASE)
+    v2 = check.gate_result(cand2, None)
+    assert v2["pass"] and "regression_gate" in cand2
+    assert any("no baseline" in n for n in v2["notes"])
+
+
+def test_gate_dirs_stamps_and_fails_on_regression(tmp_path):
+    basedir = tmp_path / "base"
+    canddir = tmp_path / "cand"
+    basedir.mkdir()
+    canddir.mkdir()
+    (basedir / "serve.json").write_text(json.dumps(BASE))
+    (canddir / "serve.json").write_text(json.dumps(
+        dict(BASE, serve_metrics_on_tok_per_sec=700.0)))
+    (basedir / "ok.json").write_text(json.dumps(BASE))
+    (canddir / "ok.json").write_text(json.dumps(BASE))
+    # gate artifacts parked beside results are never treated as configs
+    (canddir / "serve_rejected.json").write_text(json.dumps(
+        dict(BASE, serve_metrics_on_tok_per_sec=1.0)))
+    (canddir / "old_skipped.json").write_text(json.dumps(BASE))
+    failed, lines = check.gate_dirs(canddir, basedir, stamp=True)
+    assert failed == 1
+    assert not any("serve_rejected" in ln or "old_skipped" in ln
+                   for ln in lines)
+    stamped = json.loads((canddir / "serve.json").read_text())
+    assert stamped["regression_gate"]["pass"] is False
+    ok = json.loads((canddir / "ok.json").read_text())
+    assert ok["regression_gate"]["pass"] is True
+    assert any("REGRESSION" in ln for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# acceptance criteria: the CLI passes against the committed results on an
+# identical re-run and exits nonzero on a synthetic 20% regression
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "benchmarks.check", *args],
+        capture_output=True, text=True, cwd=str(ROOT), timeout=120)
+
+
+def test_cli_self_test_passes():
+    r = _run_cli("--self-test")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "CAUGHT" in r.stdout
+
+
+def test_cli_identical_rerun_of_committed_results_passes():
+    results = ROOT / "benchmarks" / "results"
+    before = {p.name: p.read_bytes() for p in results.glob("*.json")}
+    r = _run_cli()
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "regression gate: PASS" in r.stdout
+    # the identity run never stamps (mutates) the committed baseline
+    after = {p.name: p.read_bytes() for p in results.glob("*.json")}
+    assert after == before
+
+
+def test_cli_synthetic_regression_exits_nonzero(tmp_path):
+    serve = ROOT / "benchmarks" / "results" / "serve.json"
+    doc = json.loads(serve.read_text())
+    key = "serve_metrics_on_tok_per_sec"
+    assert key in doc
+    doc[key] = doc[key] * 0.8                 # the synthetic 20% drop
+    cand = tmp_path / "serve.json"
+    cand.write_text(json.dumps(doc))
+    r = _run_cli("--candidate", str(cand))
+    assert r.returncode == 3, r.stdout + r.stderr
+    stamped = json.loads(cand.read_text())
+    assert stamped["regression_gate"]["pass"] is False
+    assert any(x["key"] == key
+               for x in stamped["regression_gate"]["regressions"])

@@ -586,6 +586,11 @@ def test_router_metrics_healthz_statusz(model):
         assert len(doc["replicas"]) == 2
         assert {r["state"] for r in doc["replicas"]} == {"ready"}
         assert doc["sessions"]["cap"] > 0
+        # ISSUE 10: fleet-aggregated sentinel view (polled from each
+        # replica's statusz anomalies section)
+        assert set(doc["anomalies"]) == {"total", "by_replica", "recent"}
+        assert set(doc["anomalies"]["by_replica"]) == \
+            {r["id"] for r in doc["replicas"]}
         assert nf[0] == 404 and bad[0] == 405
     finally:
         fleet.close()

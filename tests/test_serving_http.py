@@ -302,6 +302,14 @@ def test_healthz_statusz(model):
         assert doc["slo"]["quantile"] == flags.flag("serving_slo_quantile")
         assert doc["build"]["jax"] and doc["build"]["pid"] == os.getpid()
         assert doc["flags"]["metrics"] == flags.flag("metrics")
+        # ISSUE 10: latency quantiles, hung-request table, per-phase
+        # attribution and the sentinel's anomaly section ride statusz
+        assert {"count", "p50", "p95", "p99"} <= set(
+            doc["latency"]["serving.ttft_ms"])
+        assert isinstance(doc["inflight_requests"], list)
+        assert doc["attribution"] is not None
+        if flags.flag("serving_sentinel"):
+            assert "anomalies_total" in doc["anomalies"]
         server.close()
         hstatus2 = asyncio.run(main())[0][0]
         assert hstatus2 == 503                   # engine thread down
