@@ -1365,6 +1365,244 @@ def _run_router_serve(on_tpu):
     }
 
 
+def _run_fleet_chaos(on_tpu):
+    """ISSUE 12: supervised-fleet churn under load (`benchmarks/run.py
+    fleet_chaos`) — a 2→3→1-replica scenario driven END-TO-END by the
+    FleetSupervisor's closed loop: the load ramp trips the queue signal
+    (hysteresis + cooldown) and grows the fleet to 3; a seeded fault
+    plan SIGKILLs a replica mid-stream (crash-restart converges back);
+    then the idle cool-down drains the fleet to 1 via the graceful
+    drain protocol.  The contract stamps are the product: zero
+    client-visible hard failures beyond the synthesized-error shape,
+    survivor streams bit-identical to a direct-engine oracle, the
+    fleet back at target within the backoff budget, and the steady
+    warm window at 0 compiles.  (Throughput is stamped observationally
+    — churn makes it workload-shaped, so it is deliberately named
+    outside the gate's *_per_sec class.)"""
+    import asyncio
+    import json as _json
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.fleet import (ChaosController, ChaosPlan, FaultEvent,
+                                  FleetSupervisor, InprocReplicaHandle)
+    from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                      GenerationConfig)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.router import RouterServer
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        slots, max_seq, page, bucket = 8, 1024, 32, 128
+        budget, n_load, prompt_len = 64, 24, 96
+    else:
+        cfg = LlamaConfig.tiny()
+        slots, max_seq, page, bucket = 2, 256, 8, 8
+        budget, n_load, prompt_len = 48, 32, 6
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    import numpy as np
+    rng = np.random.default_rng(0)
+    prompts = [[int(t) for t in rng.integers(1, cfg.vocab_size,
+                                             prompt_len)]
+               for _ in range(n_load)]
+
+    # oracle: every prompt's greedy output from a direct engine run
+    def _engine():
+        return ContinuousBatchingEngine(
+            model, max_batch=slots,
+            gen=GenerationConfig(max_new_tokens=budget),
+            max_seq_len=max_seq, page_size=page, prefill_bucket=bucket)
+
+    oracle_eng = _engine()
+    rids = [oracle_eng.add_request(list(p)) for p in prompts]
+    oracle_out = oracle_eng.run()
+    oracle = {tuple(p): oracle_out[r] for p, r in zip(prompts, rids)}
+
+    def factory():
+        eng = _engine()
+        eng.add_request(list(rng.integers(1, cfg.vocab_size, bucket + 3)),
+                        max_new_tokens=4)
+        eng.run()                          # warm both step programs
+        return eng
+
+    plan = ChaosPlan([FaultEvent(1000, "kill", "fs0")])
+    chaos = ChaosController(plan)
+    router = RouterServer([], allow_empty=True, health_interval_s=1e9,
+                          dead_after=2, poll_timeout_s=0.5)
+    sup = FleetSupervisor(
+        router, lambda rid: InprocReplicaHandle(rid, factory,
+                                                client_wrap=chaos.wrap),
+        target=2, min_replicas=1, max_replicas=3, restart_budget=3,
+        backoff_base_s=0.1, backoff_max_s=1.0, backoff_reset_s=1e9,
+        drain_timeout_s=30.0, hot_ticks=2, cold_ticks=50, cooldown_s=1.0,
+        scale_up_load=1.5, scale_down_load=0.5,
+        on_spawn=chaos.register_handle)
+
+    verdicts = {"ok": 0, "synth_error": 0, "hard_failure": 0}
+    out = {}
+
+    async def request(prompt, stream):
+        body = _json.dumps({"prompt": prompt, "max_tokens": budget,
+                            "stream": stream}).encode()
+        head = ("POST /v1/completions HTTP/1.1\r\nHost: chaos\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n").encode()
+        r = asyncio.StreamReader()
+        r.feed_data(head + body)
+        r.feed_eof()
+        buf = bytearray()
+
+        class W:
+            def write(self, b):
+                buf.extend(b)
+
+            async def drain(self):
+                pass
+
+            def close(self):
+                pass
+
+            async def wait_closed(self):
+                pass
+
+        await router.handle(r, W())
+        return bytes(buf)
+
+    def judge(raw, prompt):
+        head, _, body = raw.partition(b"\r\n\r\n")
+        status = int(head.split()[1])
+        if status != 200:
+            return "hard_failure"
+        text = body.decode(errors="replace")
+        if "data: [DONE]" not in text:
+            return "hard_failure"
+        toks, finish = [], None
+        for ln in text.splitlines():
+            if ln.startswith("data: ") and ln != "data: [DONE]":
+                c = _json.loads(ln[6:])["choices"][0]
+                toks += c["token_ids"]
+                finish = c["finish_reason"] or finish
+        if finish in ("stop", "length") and toks == oracle[tuple(prompt)]:
+            return "ok"
+        return "synth_error" if finish == "error" else "hard_failure"
+
+    async def converge(deadline_s=300.0):
+        t_end = time.perf_counter() + deadline_s
+        while True:
+            sup.tick()
+            await router.poll_replicas()
+            if sup.converged() and \
+                    len(router._candidates()) == sup.target:
+                return True
+            if time.perf_counter() > t_end:
+                return False
+            await asyncio.sleep(0.05)
+
+    async def drive():
+        sup.start()
+        assert await converge()
+        out["replicas_start"] = len(router.states)
+
+        # steady warm window: supervised, 0 compiles
+        with obs.assert_overhead(record=True) as rec:
+            for p in prompts[:2]:
+                sup.tick()
+                v = judge(await request(list(p), stream=True), p)
+                verdicts[v] += 1
+            await router.poll_replicas()
+        out["warm_compiles"] = int(rec.compiles)
+
+        # load ramp: the queue signal must grow the fleet 2 -> 3
+        t0 = time.perf_counter()
+        toks_before = obs.metrics.counter(
+            "serving.tokens_generated").value
+        tasks = [asyncio.ensure_future(request(list(p), True))
+                 for p in prompts]
+        scaled = False
+        killed = False
+        while not all(t.done() for t in tasks):
+            sup.tick()
+            await router.poll_replicas()
+            if not scaled and sup.target == 3:
+                scaled = True
+            if scaled and not killed:
+                # scale-up tripped and fs0 is mid-stream: SIGKILL it
+                # (the third replica may still be warming — exactly the
+                # churn overlap a real incident produces)
+                busy = any(st.sent > 0
+                           for st in chaos._clients["fs0"]
+                           .inner.server._live)
+                if busy:
+                    chaos.advance(1000)
+                    killed = True
+            await asyncio.sleep(0.02)
+        for t, p in zip(tasks, prompts):
+            verdicts[judge(t.result(), p)] += 1
+        out["scaled_to_3"] = scaled
+        out["killed_mid_stream"] = killed
+        assert await converge()            # crash-restart back to 3
+        wall = time.perf_counter() - t0
+        out["tokens_total"] = int(obs.metrics.counter(
+            "serving.tokens_generated").value - toks_before)
+        out["churn_wall_s"] = round(wall, 2)
+        out["tok_per_s_observed"] = round(out["tokens_total"] / wall, 1)
+        out["replicas_peak"] = len(router.states)
+
+        # idle cool-down: the cold signal drains the fleet to min (1)
+        t_end = time.perf_counter() + 300
+        while sup.target > 1 or not sup.converged():
+            sup.tick()
+            await router.poll_replicas()
+            assert time.perf_counter() < t_end, sup.state()
+            await asyncio.sleep(0.05)
+        out["replicas_final"] = len(router.states)
+
+    try:
+        asyncio.run(drive())
+    finally:
+        sup.shutdown(drain=False, timeout_s=5.0)
+
+    m = obs.metrics
+    n_req = sum(verdicts.values())
+    return {
+        "fleet_chaos_requests": n_req,
+        "fleet_chaos_replicas_start": out.get("replicas_start"),
+        "fleet_chaos_replicas_peak": out.get("replicas_peak"),
+        "fleet_chaos_replicas_final": out.get("replicas_final"),
+        "fleet_chaos_scaled_under_load_match": bool(out.get("scaled_to_3")),
+        "fleet_chaos_killed_mid_stream_match": bool(
+            out.get("killed_mid_stream")),
+        "fleet_chaos_hard_failures": verdicts["hard_failure"],
+        "fleet_chaos_zero_hard_failures_match":
+            verdicts["hard_failure"] == 0,
+        "fleet_chaos_synth_errors": verdicts["synth_error"],
+        "fleet_chaos_survivor_bit_match": verdicts["ok"] >= 1 and
+            verdicts["ok"] + verdicts["synth_error"] == n_req,
+        "fleet_chaos_converged_match":
+            out.get("replicas_final") == 1,
+        "fleet_chaos_restarts": int(m.counter(
+            "fleet.replica_restarts").value),
+        "fleet_chaos_scale_ups": int(m.counter(
+            "fleet.scale_events", direction="up").value),
+        "fleet_chaos_scale_downs": int(m.counter(
+            "fleet.scale_events", direction="down").value),
+        "fleet_chaos_drains_clean": int(m.counter(
+            "fleet.drains", outcome="clean").value),
+        "fleet_chaos_drain_timeouts": int(m.counter(
+            "fleet.drains", outcome="timeout").value),
+        "fleet_chaos_warm_compiles": out.get("warm_compiles"),
+        "fleet_chaos_warm_zero_compiles_match":
+            out.get("warm_compiles") == 0,
+        "fleet_chaos_tokens_total": out.get("tokens_total"),
+        "fleet_chaos_churn_wall_s": out.get("churn_wall_s"),
+        "fleet_chaos_tok_per_s_observed": out.get("tok_per_s_observed"),
+    }
+
+
 # extras measured after the flagship ladder, each in its own subprocess
 _EXTRAS = (("large", _run_large), ("decode", _run_decode),
            ("moe", _run_moe), ("gpt2", _run_gpt2_compiled_vs_eager),
@@ -1374,7 +1612,8 @@ _EXTRAS = (("large", _run_large), ("decode", _run_decode),
            ("spec_decode", _run_spec_decode),
            ("serve", _run_serve_metrics),
            ("http_serve", _run_http_serve),
-           ("router_serve", _run_router_serve))
+           ("router_serve", _run_router_serve),
+           ("fleet_chaos", _run_fleet_chaos))
 
 
 def _force_host_devices(n=8):

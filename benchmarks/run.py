@@ -5,7 +5,7 @@ Usage:
     python benchmarks/run.py [config ...] [--cpu] [--fused-gather=0|1]
                              [--trace=PATH] [--gate]
 configs: resnet gpt2 llama dit moe decode serve http_serve router_serve
-         spec_decode all (default: all)
+         fleet_chaos spec_decode all (default: all)
 
 --gate compares each fresh result against the committed
 results/<config>.json (benchmarks/check.py guardbands), stamps the
@@ -378,12 +378,27 @@ def run_http_serve():
     return {"config": "http_serve", **bench._run_http_serve(_on_tpu())}
 
 
+def run_fleet_chaos():
+    """ISSUE 12: supervised-fleet churn under chaos (`python
+    benchmarks/run.py fleet_chaos --cpu`) — a 2→3→1 replica scenario
+    where the FleetSupervisor's closed loop does all the driving: the
+    load ramp trips the queue signal and scales to 3, a seeded fault
+    plan SIGKILLs a replica mid-stream (crash-restart converges the
+    fleet back), and the idle cool-down drains to 1 via the graceful
+    drain protocol.  Gated stamps: zero hard failures beyond the
+    synthesized-error contract, survivor bit-identity vs the
+    direct-engine oracle, convergence, 0 warm compiles."""
+    import bench
+    return {"config": "fleet_chaos", **bench._run_fleet_chaos(_on_tpu())}
+
+
 CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
            "dit": run_dit, "moe": run_moe, "decode": run_decode,
            "longctx": run_longctx, "grad_comm": run_grad_comm,
            "serve_prefix": run_serve_prefix, "spec_decode": run_spec_decode,
            "serve": run_serve,
-           "http_serve": run_http_serve, "router_serve": run_router_serve}
+           "http_serve": run_http_serve, "router_serve": run_router_serve,
+           "fleet_chaos": run_fleet_chaos}
 
 
 def _supervise(names, timeout):

@@ -473,11 +473,12 @@ class AsyncBlockingCall(Rule):
     rule_id = "JL005"
     title = "blocking calls inside async handlers"
     rationale = (
-        "serving/ and router/ run one asyncio event loop for every "
-        "connection; one time.sleep / file read / subprocess in a "
+        "serving/, router/ and fleet/ run one asyncio event loop for "
+        "every connection; one time.sleep / file read / subprocess in a "
         "handler stalls EVERY live stream (head-of-line blocking the "
         "PR 6/7 front door exists to avoid).  Blocking work belongs on "
-        "the engine thread or in run_in_executor.")
+        "the engine thread, the supervisor's control-loop thread, or in "
+        "run_in_executor.")
 
     # urllib.request is the I/O submodule; bare "urllib." would flag the
     # pure-CPU urllib.parse helpers every HTTP server legitimately uses
@@ -490,7 +491,8 @@ class AsyncBlockingCall(Rule):
 
     def visit(self, mod: ModuleInfo, ctx: RunContext) -> None:
         r = "/" + mod.rel.replace("\\", "/")
-        if "/serving/" not in r and "/router/" not in r:
+        if "/serving/" not in r and "/router/" not in r \
+                and "/fleet/" not in r:
             return
         for fn in ast.walk(mod.tree):
             if not isinstance(fn, ast.AsyncFunctionDef):
@@ -615,7 +617,8 @@ class EngineSingleOwner(Rule):
 
     def visit(self, mod: ModuleInfo, ctx: RunContext) -> None:
         r = "/" + mod.rel.replace("\\", "/")
-        if "/serving/" not in r and "/router/" not in r:
+        if "/serving/" not in r and "/router/" not in r \
+                and "/fleet/" not in r:
             return
         for fn in ast.walk(mod.tree):
             if not isinstance(fn, ast.AsyncFunctionDef):

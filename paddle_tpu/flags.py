@@ -227,6 +227,50 @@ define_flag("sentinel_history", 64,
             "Bounded count of recent anomaly records the sentinel retains "
             "for /statusz (oldest evicted first; the counters keep the "
             "full totals).")
+define_flag("fleet_drain_timeout_s", 30.0,
+            "Bound on a replica's graceful drain: after admission stops "
+            "(SIGTERM or /drainz), in-flight requests get this many "
+            "seconds to finish before the supervisor (or the replica's "
+            "own shutdown path) stops waiting and exits/kills anyway.")
+define_flag("fleet_restart_budget", 3,
+            "Consecutive crash-restarts the fleet supervisor grants one "
+            "replica slot before marking it permanently failed (counted "
+            "in fleet.replicas{state=failed}; a replica that stays ready "
+            "past FLAGS_fleet_backoff_reset_s earns its budget back).")
+define_flag("fleet_backoff_base_s", 0.5,
+            "First crash-restart delay; doubles per consecutive restart "
+            "up to FLAGS_fleet_backoff_max_s.")
+define_flag("fleet_backoff_max_s", 30.0,
+            "Cap on the exponential crash-restart backoff.")
+define_flag("fleet_backoff_reset_s", 60.0,
+            "A replica continuously ready this long has its restart "
+            "count (and so its backoff and budget) reset at the next "
+            "crash — an old flap must not doom a now-stable replica.")
+define_flag("fleet_min_replicas", 1,
+            "Autoscaler floor: scale-down never drains below this.")
+define_flag("fleet_max_replicas", 8,
+            "Autoscaler ceiling: scale-up never spawns above this.")
+define_flag("fleet_scale_up_load", 4.0,
+            "Autoscale-up threshold on mean placeable-replica load "
+            "(router in-flight + polled queue depth, requests): hot "
+            "when above this OR when every placeable replica is "
+            "shedding its SLO.")
+define_flag("fleet_scale_down_load", 0.5,
+            "Autoscale-down threshold on mean placeable-replica load: "
+            "cold only below this with zero shedding and a quiet "
+            "anomaly stream (hysteresis gap vs fleet_scale_up_load).")
+define_flag("fleet_hot_ticks", 3,
+            "Consecutive hot supervisor ticks required before a "
+            "scale-up (hysteresis: one burst must not grow the fleet).")
+define_flag("fleet_cold_ticks", 10,
+            "Consecutive cold supervisor ticks required before a "
+            "scale-down (cold evidence is cheaper than a re-warmup).")
+define_flag("fleet_scale_cooldown_s", 30.0,
+            "Minimum seconds between autoscale actions in either "
+            "direction, so a burst cannot flap the fleet.")
+define_flag("fleet_tick_interval_s", 1.0,
+            "Seconds between fleet-supervisor control-loop ticks when "
+            "run_forever paces itself (tests tick explicitly).")
 define_flag("flight_recorder_min_interval_s", 30.0,
             "Per-REASON rate limit on flight-recorder dumps: repeat dumps "
             "with the same reason inside this window are suppressed "

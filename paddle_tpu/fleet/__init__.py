@@ -1,0 +1,27 @@
+"""Fleet lifecycle supervisor (ISSUE 12): sentinel-driven autoscaling,
+graceful drain, crash restart with backoff, and a deterministic chaos
+harness — stdlib-only, same discipline as ``serving/`` and ``router/``.
+
+Quickstart (production: supervisor + router + N replica processes in
+one command)::
+
+    python -m paddle_tpu.fleet --replicas 2 --port 8080
+
+In-process fleets (tests, benches) hand the supervisor an
+``InprocReplicaHandle`` spawner over shared model weights instead — the
+identical control loop minus the sockets, which is how the seeded chaos
+scenarios stay deterministic and offline.
+
+The supervisor lives in ``fleet.supervisor`` (slot lifecycle, backoff
+budgets, the autoscale signal loop), fault injection in ``fleet.chaos``
+(explicit/seeded fault plans over a transport-seam wrapper).
+"""
+
+from . import chaos, supervisor
+from .chaos import ChaosController, ChaosPlan, FaultEvent
+from .supervisor import (FleetSupervisor, InprocReplicaHandle,
+                         ProcessReplicaHandle, ReplicaHandle)
+
+__all__ = ["FleetSupervisor", "ReplicaHandle", "InprocReplicaHandle",
+           "ProcessReplicaHandle", "ChaosPlan", "ChaosController",
+           "FaultEvent", "supervisor", "chaos"]

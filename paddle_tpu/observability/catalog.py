@@ -124,8 +124,36 @@ CATALOG: Dict[str, tuple] = {
     "router.health_polls": (
         "counter", "result=ok|fail", "replica /statusz polls"),
     "router.replicas": (
-        "gauge", "state=ready|warming|suspect|dead",
+        "gauge", "state=ready|warming|suspect|dead|draining",
         "replica count by health state"),
+    "router.replica_rejoins": (
+        "counter", "", "dead/suspect -> live replica transitions (each "
+        "also lands as a router.replica_rejoin tracer instant; the "
+        "rejoined replica's routed-overlay staleness is reset)"),
+    # ---- fleet lifecycle supervisor (PR 12) ----
+    "fleet.replicas": (
+        "gauge", "state=starting|ready|draining|backoff|failed",
+        "supervised replica slots by lifecycle state "
+        "(fleet/supervisor.py; failed = restart budget exhausted, "
+        "permanently down)"),
+    "fleet.target_replicas": (
+        "gauge", "", "the autoscaler's current fleet-size target"),
+    "fleet.replica_restarts": (
+        "counter", "", "crash-restarts performed (after exponential "
+        "backoff, within FLAGS_fleet_restart_budget)"),
+    "fleet.crashes": (
+        "counter", "kind=exit|wedged",
+        "replica deaths detected: process/engine exit, or a wedge (the "
+        "router reports it dead while the process is still alive — the "
+        "SIGSTOP shape; the supervisor kills and restarts it)"),
+    "fleet.scale_events": (
+        "counter", "direction=up|down",
+        "autoscale actions taken after hysteresis + cooldown"),
+    "fleet.drains": (
+        "counter", "outcome=clean|timeout|died",
+        "graceful drains: clean (in-flight finished inside "
+        "FLAGS_fleet_drain_timeout_s), timeout (bound expired, "
+        "hard-killed), died (replica crashed mid-drain)"),
     # ---- regression sentinel (PR 10) ----
     "observability.anomaly": (
         "counter", "series=...,kind=drift|burst",

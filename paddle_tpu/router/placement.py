@@ -68,6 +68,13 @@ class ReplicaState:
         self.fails = 0
         self.last_poll: Optional[float] = None
         self.next_poll: float = 0.0     # monotonic deadline for the poller
+        # drain protocol (ISSUE 12): a draining replica is excluded from
+        # NEW placements while its in-flight streams finish.  The pin is
+        # the supervisor's immediate signal (set via mark_draining before
+        # the replica's own /statusz can confirm); `reported_draining`
+        # follows the replica's advertised state.
+        self.drain_pin = False
+        self.reported_draining = False
         # placement inputs from the last successful /statusz
         self.digest: frozenset = frozenset()
         self.page_size: int = 0
@@ -89,17 +96,43 @@ class ReplicaState:
         self.failovers = 0
 
     # ------------------------------------------------------------ state --
+    @property
+    def draining(self) -> bool:
+        return self.drain_pin or self.reported_draining
+
     def status(self, dead_after: int) -> str:
         if not self.ok:
             return "dead" if self.fails >= dead_after else "suspect"
+        if self.draining:
+            return "draining"
         return "ready" if self.ready else "warming"
 
-    def apply_statusz(self, doc: dict) -> None:
-        """Fold one successful /statusz poll into the placement view."""
+    def apply_statusz(self, doc: dict,
+                      dead_after: Optional[int] = None) -> None:
+        """Fold one successful /statusz poll into the placement view.
+        ``dead_after`` (the router passes its threshold) scopes rejoin
+        handling to DEAD->live transitions only."""
+        if not self.ok and self.fails > 0 and \
+                (dead_after is None or self.fails >= dead_after):
+            # dead -> live transition: the replica rejoined.  Reset
+            # placement-score staleness — the routed overlay (and its
+            # aging generations) predate the death, so a rejoined
+            # replica must not be scored on phantom pre-death credits;
+            # the fresh digest below is the only truth it restarts with.
+            # (A single-poll suspect blip is NOT a rejoin: the replica
+            # never stopped serving, its overlay credits are valid.)
+            self.routed.clear()
+            self._poll_gen = 0
+            _obs.metrics.counter("router.replica_rejoins").inc()
+            if _obs.TRACER.enabled:
+                _obs.TRACER.instant("router.replica_rejoin",
+                                    args={"replica": self.id,
+                                          "after_fails": self.fails})
         self.ok = True
         self.fails = 0
         self.last_poll = time.perf_counter()
         self.ready = bool(doc.get("ready", True))
+        self.reported_draining = bool(doc.get("draining", False))
         eng = doc.get("engine") or {}
         self.queue_depth = int(eng.get("waiting", 0) or 0) + \
             int(eng.get("slots_busy", 0) or 0)
@@ -186,6 +219,7 @@ class ReplicaState:
             round(time.perf_counter() - self.last_poll, 3)
         return {**self.client.describe(),
                 "state": self.status(dead_after),
+                "draining": self.draining,
                 "consecutive_fails": self.fails,
                 "last_poll_age_s": age,
                 "queue_depth": self.queue_depth,

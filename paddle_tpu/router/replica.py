@@ -147,10 +147,18 @@ class InprocReplica(ReplicaClient):
         ``router.failover`` count).  ``close_server=True`` also stops the
         engine thread, so health polls and liveness agree it is gone."""
         self._killed = True
-        for task, writer in list(self._conns):
-            writer.sever()
+        self.sever_streams()
         if close_server:
             self.server.close()
+
+    def sever_streams(self) -> None:
+        """Cut every in-flight response mid-stream WITHOUT killing the
+        replica (the chaos harness's dropped-TCP-connection fault): the
+        handler side sees ConnectionResetError at its next drain, the
+        router side sees EOF sans terminator.  New connections still
+        succeed."""
+        for task, writer in list(self._conns):
+            writer.sever()
 
     def revive(self) -> None:
         """Bring a killed replica back (rejoin-after-recovery tests)."""

@@ -59,6 +59,7 @@ from typing import Dict, List, Optional, Tuple
 from .. import flags
 from .. import observability as _obs
 from ..serving import http as _http
+from ..serving.slo import jittered_retry_after
 from .placement import Placer, ReplicaState
 from .replica import ReplicaClient
 
@@ -112,9 +113,14 @@ class RouterServer:
                  policy: Optional[str] = None,
                  health_interval_s: Optional[float] = None,
                  dead_after: Optional[int] = None,
-                 poll_timeout_s: Optional[float] = None):
-        if not replicas:
-            raise ValueError("RouterServer needs at least one replica")
+                 poll_timeout_s: Optional[float] = None,
+                 allow_empty: bool = False):
+        # an empty replica set is only sane when a fleet supervisor owns
+        # the set and will register replicas as they warm (ISSUE 12); a
+        # hand-launched router with zero upstreams is a misconfiguration
+        if not replicas and not allow_empty:
+            raise ValueError("RouterServer needs at least one replica "
+                             "(or allow_empty=True under a supervisor)")
         ids = [r.id for r in replicas]
         if len(set(ids)) != len(ids):
             raise ValueError(f"duplicate replica ids: {ids}")
@@ -133,7 +139,8 @@ class RouterServer:
         self._m = _RouterMetrics()
         self._t0 = time.perf_counter()
         self._next_rid = 0
-        self._health_tasks: List[asyncio.Task] = []
+        self._health_tasks: Dict[str, asyncio.Task] = {}
+        self._health_loop_obj: Optional[asyncio.AbstractEventLoop] = None
         self._refresh_task: Optional[asyncio.Task] = None
         self._asyncio_server = None
 
@@ -167,7 +174,7 @@ class RouterServer:
             state.next_poll = time.perf_counter() + \
                 self.health_interval_s * backoff
             return False
-        state.apply_statusz(doc)
+        state.apply_statusz(doc, dead_after=self.dead_after)
         self._m.health_polls("ok").inc()
         state.next_poll = time.perf_counter() + self.health_interval_s
         return True
@@ -179,14 +186,83 @@ class RouterServer:
         self._export_replica_gauges()
 
     def _export_replica_gauges(self) -> None:
-        counts = {s: 0 for s in ("ready", "warming", "suspect", "dead")}
+        counts = {s: 0 for s in ("ready", "warming", "suspect", "dead",
+                                 "draining")}
         for st in self.states:
             counts[st.status(self.dead_after)] += 1
         for s, n in counts.items():
             self._m.replicas_gauge(s).set(n)
 
+    # ----------------------------------------- supervisor registration --
+    def add_replica(self, client: ReplicaClient) -> ReplicaState:
+        """Register a replica live (the fleet supervisor's seam: called
+        once a spawned replica passes /readyz warmup).  A same-id
+        re-register (crash-restart) replaces the stale state.  List
+        append/replace is GIL-atomic against concurrent placement
+        snapshots — candidates read a momentarily-old set at worst."""
+        state = ReplicaState(client)
+        for i, s in enumerate(self.states):
+            if s.id == client.id:
+                self.states[i] = state
+                break
+        else:
+            self.states.append(state)
+        loop = self._health_loop_obj
+        if loop is not None and not loop.is_closed():
+            # background polling is on: the new replica gets its poll
+            # task too (threadsafe — the supervisor calls from its own
+            # control-loop thread; the replaced state's task self-
+            # terminates on its next wake, no longer being in states)
+            loop.call_soon_threadsafe(self._spawn_health_task, state)
+        self._export_replica_gauges()
+        return state
+
+    def remove_replica(self, rid: str) -> bool:
+        """Drop a replica from the set (drained out or permanently
+        failed).  In-flight relays hold their own state reference and
+        finish unaffected; session pins to the id simply re-score."""
+        for s in list(self.states):
+            if s.id == rid:
+                self.states.remove(s)
+                loop = self._health_loop_obj
+                if loop is not None and not loop.is_closed():
+                    loop.call_soon_threadsafe(self._cancel_health_task,
+                                              rid)
+                self._export_replica_gauges()
+                return True
+        return False
+
+    def mark_draining(self, rid: str, draining: bool = True) -> bool:
+        """Pin a replica `draining` router-side IMMEDIATELY (excluded
+        from new placements before its next /statusz can confirm);
+        in-flight streams and honored session pins finish out."""
+        for s in self.states:
+            if s.id == rid:
+                s.drain_pin = draining
+                self._export_replica_gauges()
+                return True
+        return False
+
+    def fleet_signals(self) -> dict:
+        """The autoscaler's aggregate inputs, from the polled view: SLO
+        burn (shedding placeable replicas), load (router in-flight +
+        polled queue depth), and the PR 10 anomaly stream."""
+        live = [s for s in self.states if s.ok]
+        placeable = [s for s in live if s.ready and not s.draining]
+        shedding = sum(1 for s in placeable if s.slo_decision == "shed")
+        return {
+            "replicas": len(self.states),
+            "live": len(live),
+            "placeable": len(placeable),
+            "shedding": shedding,
+            "all_shedding": bool(placeable) and shedding == len(placeable),
+            "mean_load": (sum(s.load() for s in placeable)
+                          / len(placeable)) if placeable else 0.0,
+            "anomaly_total": sum(s.anomaly_total for s in self.states),
+        }
+
     async def _health_loop(self, state: ReplicaState) -> None:
-        while True:
+        while state in self.states:     # self-terminates after removal
             now = time.perf_counter()
             if now >= state.next_poll:
                 await self.poll_replica(state)
@@ -194,20 +270,41 @@ class RouterServer:
             await asyncio.sleep(
                 max(0.05, min(self.health_interval_s,
                               state.next_poll - time.perf_counter())))
+        # identity-guarded: a same-id replacement may already own the slot
+        if self._health_tasks.get(state.id) is asyncio.current_task():
+            self._health_tasks.pop(state.id, None)
+
+    def _cancel_health_task(self, rid: str) -> None:
+        t = self._health_tasks.pop(rid, None)
+        if t is not None:
+            t.cancel()
+
+    def _spawn_health_task(self, state: ReplicaState) -> None:
+        loop = self._health_loop_obj
+        if loop is None or loop.is_closed():
+            return      # background polling stopped since this was queued
+        old = self._health_tasks.pop(state.id, None)
+        if old is not None:
+            old.cancel()
+        self._health_tasks[state.id] = \
+            loop.create_task(self._health_loop(state))
 
     def start_health(self) -> None:
         """Spawn one background poll task per replica on the RUNNING
-        loop (production path; tests poll explicitly instead)."""
+        loop (production path; tests poll explicitly instead).  Replicas
+        registered LATER (the fleet supervisor's add_replica) get their
+        poll task on this loop too."""
         if self._health_tasks:
             return
-        self._health_tasks = [
-            asyncio.get_running_loop().create_task(self._health_loop(s))
-            for s in self.states]
+        self._health_loop_obj = asyncio.get_running_loop()
+        for s in self.states:
+            self._spawn_health_task(s)
 
     def stop_health(self) -> None:
-        for t in self._health_tasks:
+        for t in self._health_tasks.values():
             t.cancel()
-        self._health_tasks = []
+        self._health_tasks = {}
+        self._health_loop_obj = None
 
     async def _refresh_if_stale(self) -> None:
         """Inline refresh when no background poller owns freshness: a
@@ -217,7 +314,7 @@ class RouterServer:
         timeout to every request).  Concurrent arrivals share ONE
         in-flight refresh — a herd of requests landing on stale state
         must not each launch a full fleet of duplicate polls."""
-        if self._health_tasks:
+        if self._health_loop_obj is not None:   # background poller owns it
             return
         task = self._refresh_task
         if task is None or task.done() or \
@@ -329,7 +426,10 @@ class RouterServer:
     # -------------------------------------------------------- completions --
     def _candidates(self, include_shedding: bool = False
                     ) -> List[ReplicaState]:
+        # draining replicas are excluded from NEW placements (their
+        # in-flight streams finish out; a pinned session re-scores)
         return [s for s in self.states if s.ok and s.ready
+                and not s.draining
                 and (include_shedding or s.slo_decision != "shed")]
 
     def _trace_id(self, headers) -> str:
@@ -372,7 +472,7 @@ class RouterServer:
             # nobody to route to: distinguish "down" from "warming"
             warming = any(s.ok and not s.ready for s in self.states)
             self._m.slo_decision("unavailable").inc()
-            ra = max(1, int(self.health_interval_s + 0.999))
+            ra = jittered_retry_after(max(1.0, self.health_interval_s))
             writer.write(_http.error_response(
                 503,
                 "no replica ready (fleet warming)" if warming
@@ -387,8 +487,9 @@ class RouterServer:
         if not candidates:
             # fleet-wide shed: every live replica is burning its SLO —
             # 503 BEFORE any replica melts, Retry-After from the soonest
-            # replica's live burn window
-            ra = min(s.retry_after_s for s in live)
+            # replica's live burn window (re-jittered: N shed clients
+            # with one identical deadline would re-herd the fleet)
+            ra = jittered_retry_after(min(s.retry_after_s for s in live))
             self._m.slo_decision("shed").inc()
             self._m.shed.inc()
             writer.write(_http.error_response(
@@ -561,7 +662,7 @@ class RouterServer:
             "health": {"interval_s": self.health_interval_s,
                        "dead_after": self.dead_after,
                        "poll_timeout_s": self.poll_timeout_s,
-                       "background": bool(self._health_tasks)},
+                       "background": self._health_loop_obj is not None},
             "replicas": [s.describe(self.dead_after)
                          for s in self.states],
             # fleet-wide sentinel view (ISSUE 10): per-replica anomaly

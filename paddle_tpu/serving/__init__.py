@@ -17,7 +17,7 @@ The HTTP wire format lives in ``serving.http``, admission policy in
 
 from . import http, slo
 from .server import ServingServer, serve_forever
-from .slo import SLOController
+from .slo import SLOController, jittered_retry_after
 
-__all__ = ["ServingServer", "SLOController", "serve_forever", "http",
-           "slo"]
+__all__ = ["ServingServer", "SLOController", "jittered_retry_after",
+           "serve_forever", "http", "slo"]

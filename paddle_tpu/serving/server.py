@@ -47,6 +47,7 @@ import asyncio
 import json
 import os
 import queue
+import signal
 import threading
 import time
 from typing import List, Optional
@@ -55,7 +56,7 @@ from .. import flags
 from .. import observability as _obs
 from ..observability.flight_recorder import FlightRecorder
 from . import http as _http
-from .slo import SHED, SLOController
+from .slo import SHED, SLOController, jittered_retry_after
 
 __all__ = ["ServingServer", "serve_forever"]
 
@@ -156,6 +157,11 @@ class ServingServer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._dead = False            # set BEFORE the final inbox sweep
+        # graceful drain (ISSUE 12): once set, new completions 503 while
+        # in-flight requests run to completion — shutdown is a bounded
+        # protocol (FLAGS_fleet_drain_timeout_s), not a SIGKILL
+        self._draining = False
+        self._conns_open = 0          # event-loop-side open connections
         self._t0 = time.perf_counter()
         self._engine_error: Optional[BaseException] = None
         self._next_rid = 0
@@ -172,6 +178,7 @@ class ServingServer:
             self.flight_recorder.attach()
         self._stop.clear()
         self._dead = False
+        self._draining = False
         self._ready.clear()
         self._thread = threading.Thread(target=self._engine_loop,
                                         name="serving-engine", daemon=True)
@@ -180,8 +187,74 @@ class ServingServer:
 
     def ready(self) -> bool:
         """Readiness: the engine thread is up AND (when ``warmup=True``)
-        its bucket warmup compile has completed."""
-        return self.engine_alive() and self._ready.is_set()
+        its bucket warmup compile has completed AND the server is not
+        draining — a draining replica must fall out of router placement
+        the moment its ``/readyz``//``/statusz`` is next polled."""
+        return self.engine_alive() and self._ready.is_set() \
+            and not self._draining
+
+    # ------------------------------------------------------------- drain --
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admission: new completions 503 from here on; in-flight
+        requests (accepted streams AND inbox submissions) run to
+        completion.  Idempotent, safe from any thread or signal
+        handler — it only sets flags."""
+        self._draining = True
+        self._wake.set()
+
+    def drained(self) -> bool:
+        """True once a begun drain has retired every in-flight request:
+        no live streams, an empty inbox, an idle engine.  (Reads are
+        GIL-atomic snapshots of engine-thread state — the monotone
+        drain direction makes a momentarily-stale read harmless.)"""
+        if not self._draining:
+            return False
+        if self._dead or self._thread is None:
+            return True                  # engine gone: nothing to wait out
+        return not self._live and self._inbox.empty() \
+            and not self.engine.has_work()
+
+    def drain(self, timeout_s: Optional[float] = None,
+              poll_s: float = 0.02) -> bool:
+        """Blocking graceful shutdown: stop admission, wait out in-flight
+        requests bounded by ``FLAGS_fleet_drain_timeout_s`` (or
+        ``timeout_s``), then close.  Returns True when the drain
+        completed inside the bound.  Call from a non-event-loop thread
+        (the supervisor / main-thread shutdown path); the asyncio side
+        uses the same flags via ``begin_drain()``/``drained()``."""
+        self.begin_drain()
+        deadline = time.perf_counter() + float(
+            flags.flag("fleet_drain_timeout_s")
+            if timeout_s is None else timeout_s)
+        while time.perf_counter() < deadline and not self.drained():
+            time.sleep(poll_s)
+        ok = self.drained()
+        self.close()
+        return ok
+
+    def install_drain_signal(self):
+        """SIGTERM → ``begin_drain()`` (chaining any previous handler):
+        shutdown becomes stop-admission-and-wait instead of mid-stream
+        death.  Install BEFORE ``install_crash_hooks`` so the flight
+        recorder's SIGTERM dump fires first and then chains here —
+        ``serve_forever`` wires exactly that order.  Returns the
+        previous handler (test seam)."""
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_sigterm(signum, frame):
+            self.begin_drain()
+            if callable(prev):
+                prev(signum, frame)
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:               # not the main thread
+            return None
+        return prev
 
     def close(self) -> None:
         self._stop.set()
@@ -226,7 +299,12 @@ class ServingServer:
 
     # ------------------------------------------------------ engine loop --
     def engine_alive(self) -> bool:
-        return self._thread is not None and self._thread.is_alive()
+        # _dead is set (before the final stream sweep) the moment the
+        # loop stops serving; counting it here makes liveness flip
+        # DETERMINISTICALLY with the sweep's client-visible retirements
+        # instead of racing the thread's last instructions on exit
+        return self._thread is not None and self._thread.is_alive() \
+            and not self._dead
 
     def _engine_loop(self) -> None:
         eng = self.engine
@@ -353,6 +431,7 @@ class ServingServer:
         # outruns requests (parse failures are requests too)
         self._m.requests.inc()
         self._m.inflight.inc(1)
+        self._conns_open += 1         # per-server (the gauge is process-wide)
         try:
             try:
                 method, path, headers, body = \
@@ -374,6 +453,7 @@ class ServingServer:
             except Exception:
                 pass
         finally:
+            self._conns_open -= 1
             self._m.inflight.inc(-1)
             self._m.responses(status).inc()
             self._m.request_ms.observe((time.perf_counter() - t0) * 1e3)
@@ -385,6 +465,19 @@ class ServingServer:
 
     async def _route(self, method, path, headers, body, writer) -> int:
         path = path.split("?", 1)[0]
+        if path == "/drainz" and method == "POST":
+            # the fleet supervisor's drain trigger (SIGTERM's HTTP twin):
+            # stop admission NOW, report what is still in flight; the
+            # caller polls /statusz (or waits for process exit on the
+            # SIGTERM path) for completion
+            self.begin_drain()
+            writer.write(_http.json_response(200, {
+                "draining": True,
+                "streams_live": len(self._live),
+                "waiting": len(self.engine.waiting),
+                "drained": self.drained()}))
+            await writer.drain()
+            return 200
         if path == "/metrics" and method == "GET":
             text = _obs.prometheus_text().encode()
             writer.write(_http.response(
@@ -416,7 +509,7 @@ class ServingServer:
         if path == "/v1/completions" and method == "POST":
             return await self._completions(headers, body, writer)
         if path in ("/metrics", "/healthz", "/readyz", "/statusz",
-                    "/v1/completions"):
+                    "/v1/completions", "/drainz"):
             writer.write(_http.error_response(405, f"{method} not allowed"))
             await writer.drain()
             return 405
@@ -497,6 +590,21 @@ class ServingServer:
             await writer.drain()
             return 413
         stream = bool(payload.get("stream", False))
+
+        if self._draining:
+            # graceful drain: admission is closed but in-flight requests
+            # are still finishing — the router should already be steering
+            # around this replica; a direct client retries elsewhere
+            # (jittered so a drained-out fleet's clients don't re-herd)
+            ra = jittered_retry_after(2)
+            writer.write(_http.error_response(
+                503, "draining: admission closed, in-flight requests "
+                     "finishing (see /statusz)",
+                err_type="overloaded_error",
+                extra_headers=(("Retry-After", str(ra)),),
+                fields={"retry_after_s": ra}))
+            await writer.drain()
+            return 503
 
         if not self.engine_alive():
             # the engine thread is down (crashed or closed): refuse
@@ -644,6 +752,11 @@ class ServingServer:
             "uptime_s": round(time.perf_counter() - self._t0, 3),
             "model": self.model_name,
             "ready": self.ready(),
+            # drain protocol (ISSUE 12): the router marks this replica
+            # `draining` off its next poll; the supervisor polls
+            # `drained` for completion on the /drainz path
+            "draining": self._draining,
+            "drained": self.drained(),
             "engine": {
                 **eng.last_stats,
                 "waiting": len(eng.waiting),
@@ -714,21 +827,39 @@ async def _serve_async(server: ServingServer, host: str, port: int):
     print(f"[paddle_tpu serving] listening on http://{bound[0]}:{bound[1]}"
           f"  (/v1/completions, /metrics, /healthz, /statusz)")
     try:
-        while True:
-            await asyncio.sleep(3600)
+        while not server.draining:
+            await asyncio.sleep(0.1)
+        # SIGTERM (or /drainz) began a drain: wait out in-flight requests
+        # bounded by FLAGS_fleet_drain_timeout_s, then give the handlers
+        # a short grace to flush their final frames before the listener
+        # closes — exit is clean (rc 0), never a mid-stream cut
+        deadline = time.perf_counter() + float(
+            flags.flag("fleet_drain_timeout_s"))
+        while time.perf_counter() < deadline and not server.drained():
+            await asyncio.sleep(0.05)
+        t_flush = time.perf_counter()
+        while time.perf_counter() - t_flush < 2.0 and server._conns_open:
+            await asyncio.sleep(0.02)
+        print("[paddle_tpu serving] drain "
+              f"{'complete' if server.drained() else 'TIMED OUT'}; "
+              "shutting down")
     finally:
         await server.stop_http()
 
 
 def serve_forever(engine, *, host: str = "127.0.0.1", port: int = 8000,
                   **kw) -> None:
-    """Blocking convenience entry: build the server, wire crash hooks
-    (watchdog + SIGTERM + excepthook flight-recorder dumps), serve until
-    killed."""
+    """Blocking convenience entry: build the server, wire the SIGTERM
+    graceful-drain handler plus crash hooks (watchdog + SIGTERM +
+    excepthook flight-recorder dumps — the dump fires first, then
+    chains into the drain), serve until killed.  SIGTERM shutdown is a
+    bounded drain protocol: admission stops, in-flight requests finish
+    (up to ``FLAGS_fleet_drain_timeout_s``), exit code 0."""
     from ..distributed.watchdog import get_comm_task_manager
     kw.setdefault("watchdog", get_comm_task_manager())
     server = ServingServer(engine, **kw)
     server.start()
+    server.install_drain_signal()     # BEFORE crash hooks: dump chains here
     server.install_crash_hooks()
     try:
         asyncio.run(_serve_async(server, host, port))

@@ -30,15 +30,28 @@ stamps into results.  Cold start (fewer than
 from __future__ import annotations
 
 import math
+import random
 import time
 from typing import Dict, Optional, Tuple
 
 from .. import flags
 from ..observability import metrics as _metrics
 
-__all__ = ["SLOController"]
+__all__ = ["SLOController", "jittered_retry_after"]
 
 ADMIT, QUEUE, SHED = "admit", "queue", "shed"
+
+
+def jittered_retry_after(seconds: float, frac: float = 0.2,
+                         rng: Optional[random.Random] = None) -> int:
+    """``Retry-After`` seconds with ±``frac`` uniform jitter, clamped to
+    [1, 60].  Every shed path (replica and router) emits through this:
+    a fleet that 503s a thundering herd with one identical Retry-After
+    re-synchronizes the herd onto a recovering replica at exactly the
+    worst moment — the jitter spreads the retry wave out.  ``rng`` is a
+    test seam (defaults to the module RNG)."""
+    r = (rng or random).uniform(1.0 - frac, 1.0 + frac)
+    return int(min(60.0, max(1.0, math.ceil(seconds * r))))
 
 
 def _over_target(h, target: float) -> int:
@@ -176,9 +189,10 @@ class SLOController:
         a constant): for every term burning past the shed threshold,
         estimate how many healthy observations it takes to dilute the
         violation rate back under ``burn * budget`` and divide by the
-        term's live observation rate.  Clamped to [1, 60]s; 1 when no
-        term is burning (shouldn't be asked, but never 0 — clients must
-        always back off at least a beat)."""
+        term's live observation rate.  ±20% jittered and clamped to
+        [1, 60]s so synchronized clients don't re-herd a recovering
+        replica; at least 1 even when no term is burning (shouldn't be
+        asked, but never 0 — clients must always back off a beat)."""
         budget = max(1.0 - self.quantile, 1e-9)
         worst = 1.0
         for name, term in self.burn_rates().items():
@@ -195,7 +209,7 @@ class SLOController:
                 worst = max(worst, need / per_s)
             # a burning term with NO live rate estimate (traffic stopped
             # entirely) keeps the 1s floor: the next probe re-measures
-        return int(min(60.0, math.ceil(worst)))
+        return jittered_retry_after(worst)
 
     def state(self) -> dict:
         """Config + live burn view for /statusz (also what the

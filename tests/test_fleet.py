@@ -1,0 +1,695 @@
+"""Fleet lifecycle supervisor (ISSUE 12): slot lifecycle / backoff /
+budget / autoscale semantics on fake handles, the deterministic chaos
+harness, and the full in-process chaos scenario — mid-stream SIGKILL, a
+wedged replica, and a scale-down drain over real engines behind the
+router, with bit-identity against a direct-engine oracle.
+
+Everything runs in-process (InprocReplicaHandle + InprocReplica
+transports — no sockets), so tier-1 stays offline and the seeded fault
+plan is applied at explicit supervisor ticks: same plan, same traffic,
+same lifecycle, every run.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import flags
+from paddle_tpu import observability as obs
+from paddle_tpu.fleet import (ChaosController, ChaosPlan, FaultEvent,
+                              FleetSupervisor, InprocReplicaHandle)
+from paddle_tpu.fleet.supervisor import (BACKOFF, DRAINING, FAILED, READY,
+                                         STARTING, ReplicaHandle)
+from paddle_tpu.inference import ContinuousBatchingEngine, GenerationConfig
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.router import RouterServer
+
+from test_serving_http import (MemWriter, completion_body,
+                               split_response, sse_chunks)
+
+
+# ---------------------------------------------------------------------------
+# fake-handle plumbing: supervisor semantics without engines
+# ---------------------------------------------------------------------------
+
+class FakeClient:
+    def __init__(self, rid):
+        self.id = rid
+
+    def describe(self):
+        return {"id": self.id, "transport": "fake"}
+
+
+class FakeHandle(ReplicaHandle):
+    def __init__(self, rid):
+        super().__init__(rid)
+        self.spawn_count = 0
+        self._alive = False
+        self.ready_now = False
+        self.drained_now = False
+        self.drain_begun = False
+        self.killed = False
+        self.stopped = False
+
+    def spawn(self):
+        self.spawn_count += 1
+        self._alive = True
+
+    def alive(self):
+        return self._alive
+
+    def ready(self):
+        return self._alive and self.ready_now
+
+    def client(self):
+        return FakeClient(self.id)
+
+    def begin_drain(self):
+        self.drain_begun = True
+
+    def drained(self):
+        return self.drained_now
+
+    def stop(self, timeout_s=5.0):
+        self.stopped = True
+        self._alive = False
+
+    def kill(self):
+        self.killed = True
+        self._alive = False
+
+    def die(self):
+        self._alive = False
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _sup(n=2, clock=None, **kw):
+    """Supervisor over fake handles + an empty router; autoscale knobs
+    default to 'never fire' so lifecycle tests stay deterministic."""
+    handles = {}
+
+    def spawner(rid):
+        h = FakeHandle(rid)
+        handles.setdefault(rid, []).append(h)
+        return h
+
+    router = RouterServer([], allow_empty=True, health_interval_s=1e9,
+                          dead_after=2)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 8)
+    kw.setdefault("hot_ticks", 10**9)
+    kw.setdefault("cold_ticks", 10**9)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("backoff_base_s", 1.0)
+    kw.setdefault("backoff_max_s", 8.0)
+    kw.setdefault("backoff_reset_s", 100.0)
+    kw.setdefault("restart_budget", 2)
+    kw.setdefault("drain_timeout_s", 10.0)
+    sup = FleetSupervisor(router, spawner, target=n,
+                          clock=clock or Clock(), **kw)
+    return sup, router, handles
+
+
+def _mark_live(router, rid, **attrs):
+    """Simulate a successful poll on a registered replica's state."""
+    for s in router.states:
+        if s.id == rid:
+            s.ok = True
+            s.ready = True
+            s.fails = 0
+            for k, v in attrs.items():
+                setattr(s, k, v)
+            return s
+    raise AssertionError(f"{rid} not registered")
+
+
+def test_ready_gating_registers_with_router():
+    sup, router, handles = _sup(2)
+    sup.start()
+    assert [s.state for s in sup._slots] == [STARTING, STARTING]
+    sup.tick()
+    assert router.states == []            # not ready: never registered
+    handles["fs0"][0].ready_now = True
+    sup.tick()
+    assert [s.id for s in router.states] == ["fs0"]
+    handles["fs1"][0].ready_now = True
+    sup.tick()
+    assert sorted(s.id for s in router.states) == ["fs0", "fs1"]
+    assert sup.converged()
+
+
+def test_crash_restart_backoff_doubles_then_budget_exhausts():
+    obs.reset("fleet.")
+    clock = Clock()
+    sup, router, handles = _sup(1, clock=clock, restart_budget=2,
+                                backoff_base_s=1.0)
+    sup.start()
+    handles["fs0"][0].ready_now = True
+    sup.tick()
+    assert sup._slots[0].state == READY
+
+    # crash 1: backoff base * 2^0 = 1s
+    handles["fs0"][0].die()
+    sup.tick()
+    assert sup._slots[0].state == BACKOFF
+    assert router.states == []            # deregistered immediately
+    clock.t = 0.5
+    sup.tick()
+    assert sup._slots[0].state == BACKOFF  # deadline not reached
+    clock.t = 1.1
+    sup.tick()                             # restart 1 (fresh handle)
+    assert sup._slots[0].state == STARTING
+    assert len(handles["fs0"]) == 2
+    assert int(obs.metrics.counter("fleet.replica_restarts").value) == 1
+
+    # crash 2 while STARTING: backoff doubles (2^1 = 2s)
+    handles["fs0"][1].die()
+    sup.tick()
+    assert sup._slots[0].state == BACKOFF
+    clock.t = 2.5
+    sup.tick()
+    assert sup._slots[0].state == BACKOFF  # 1.1 + 2.0 = 3.1 deadline
+    clock.t = 3.2
+    sup.tick()                             # restart 2: budget now spent
+    assert sup._slots[0].state == STARTING
+
+    # crash 3: budget (2) exhausted => permanently failed, NOT respun
+    handles["fs0"][2].die()
+    sup.tick()
+    assert sup._slots[0].state == FAILED
+    clock.t = 1000.0
+    sup.tick()
+    assert sup._slots[0].state == FAILED
+    assert len(handles["fs0"]) == 3        # no fourth generation, ever
+    snap = obs.snapshot()["gauges"]
+    assert snap["fleet.replicas{state=failed}"] == 1
+    assert int(obs.metrics.counter("fleet.crashes",
+                                   kind="exit").value) == 3
+
+
+def test_long_stable_replica_earns_restart_budget_back():
+    clock = Clock()
+    sup, router, handles = _sup(1, clock=clock, restart_budget=1,
+                                backoff_reset_s=50.0)
+    sup.start()
+    handles["fs0"][0].ready_now = True
+    sup.tick()
+    handles["fs0"][0].die()
+    sup.tick()                             # restart 0 -> backoff
+    clock.t = 2.0
+    sup.tick()                             # restart (budget now spent)
+    handles["fs0"][1].ready_now = True
+    sup.tick()
+    assert sup._slots[0].state == READY
+    # stays ready past backoff_reset_s: the old flap is forgiven
+    clock.t = 60.0
+    sup.tick()
+    handles["fs0"][1].die()
+    sup.tick()
+    assert sup._slots[0].state == BACKOFF  # restarted again, NOT failed
+
+
+def test_wedged_replica_killed_and_restarted():
+    obs.reset("fleet.")
+    clock = Clock()
+    sup, router, handles = _sup(1, clock=clock)
+    sup.start()
+    handles["fs0"][0].ready_now = True
+    sup.tick()
+    # the router's poller gave up on it (dead_after=2 consecutive fails)
+    # but the process is still alive: the SIGSTOP/wedge shape
+    st = router.states[0]
+    st.mark_failed()
+    st.mark_failed()
+    sup.tick()
+    assert handles["fs0"][0].killed
+    assert sup._slots[0].state == BACKOFF
+    assert int(obs.metrics.counter("fleet.crashes",
+                                   kind="wedged").value) == 1
+
+
+def test_scale_up_hysteresis_and_cooldown():
+    obs.reset("fleet.")
+    clock = Clock()
+    sup, router, handles = _sup(1, clock=clock, hot_ticks=3,
+                                cooldown_s=10.0, max_replicas=3,
+                                scale_up_load=2.0)
+    sup.start()
+    handles["fs0"][0].ready_now = True
+    sup.tick()
+    _mark_live(router, "fs0", queue_depth=10)   # hot: load 10 > 2.0
+    sup.tick()
+    sup.tick()
+    assert sup.target == 1                 # 2 hot ticks < hysteresis (3)
+    sup.tick()
+    assert sup.target == 2                 # third consecutive: scale up
+    assert "fs1" in handles                # new slot spawned
+    # the new slot is mid-spawn: hysteresis freezes until it lands (a
+    # half-landed scale-up must not read as "still hot")
+    for _ in range(5):
+        sup.tick()
+    assert sup.target == 2
+    handles["fs1"][0].ready_now = True
+    sup.tick()                             # fs1 registers: settled again
+    _mark_live(router, "fs1", queue_depth=10)
+    # cooldown: staying hot cannot scale again inside 10s
+    for _ in range(4):
+        sup.tick()
+    assert sup.target == 2
+    clock.t = 11.0
+    sup.tick()
+    sup.tick()
+    sup.tick()
+    assert sup.target == 3
+    assert int(obs.metrics.counter("fleet.scale_events",
+                                   direction="up").value) == 2
+
+
+def test_backoff_slot_does_not_freeze_scale_up():
+    """A crash-looping replica must not pin the fleet at its degraded
+    size: its capacity is already absent from the signals, so the hot
+    streak keeps accumulating while it sits in BACKOFF (cold stays
+    frozen — that capacity is coming back)."""
+    clock = Clock()
+    sup, router, handles = _sup(2, clock=clock, hot_ticks=1,
+                                cooldown_s=0.0, max_replicas=3,
+                                scale_up_load=2.0,
+                                backoff_base_s=1000.0)
+    sup.start()
+    handles["fs0"][0].ready_now = True
+    handles["fs1"][0].ready_now = True
+    sup.tick()
+    _mark_live(router, "fs0")
+    _mark_live(router, "fs1")
+    handles["fs1"][0].die()
+    sup.tick()                             # fs1 -> BACKOFF (long)
+    assert sup._slots[1].state == BACKOFF
+    _mark_live(router, "fs0", queue_depth=10)   # survivor is hot
+    sup.tick()
+    assert sup.target == 3                 # scale-up fired regardless
+    assert "fs2" in handles                # replacement capacity spawned
+    clock = Clock()
+    sup, router, handles = _sup(1, clock=clock, hot_ticks=1,
+                                cooldown_s=0.0, max_replicas=2,
+                                scale_up_load=10**9)
+    sup.start()
+    handles["fs0"][0].ready_now = True
+    sup.tick()
+    _mark_live(router, "fs0", slo_decision="shed")
+    sup.tick()
+    assert sup.target == 2                 # fleet SLO burn => grow
+
+
+def test_scale_down_drains_victim_and_removes_it():
+    obs.reset("fleet.")
+    clock = Clock()
+    sup, router, handles = _sup(2, clock=clock, cold_ticks=2,
+                                cooldown_s=0.0, min_replicas=1,
+                                scale_down_load=0.5, drain_timeout_s=5.0)
+    sup.start()
+    handles["fs0"][0].ready_now = True
+    handles["fs1"][0].ready_now = True
+    sup.tick()
+    _mark_live(router, "fs0")
+    _mark_live(router, "fs1")
+    sup.tick()
+    sup.tick()                             # second cold tick: scale down
+    assert sup.target == 1
+    draining = [s for s in sup._slots if s.state == DRAINING]
+    assert len(draining) == 1
+    victim = draining[0].handle
+    assert victim.drain_begun
+    # router-side: pinned draining immediately, out of new placements
+    rs = next(s for s in router.states if s.id == victim.id)
+    assert rs.drain_pin and rs.draining
+    assert victim.id not in [s.id for s in router._candidates()]
+    # in-flight not done yet: slot stays
+    sup.tick()
+    assert any(s.state == DRAINING for s in sup._slots)
+    victim.drained_now = True
+    sup.tick()
+    assert [s.state for s in sup._slots] == [READY]
+    assert victim.stopped
+    assert victim.id not in [s.id for s in router.states]
+    assert int(obs.metrics.counter("fleet.drains",
+                                   outcome="clean").value) == 1
+
+
+def test_drain_timeout_hard_kills():
+    obs.reset("fleet.")
+    clock = Clock()
+    sup, router, handles = _sup(2, clock=clock, cold_ticks=1,
+                                cooldown_s=0.0, min_replicas=1,
+                                drain_timeout_s=3.0)
+    sup.start()
+    handles["fs0"][0].ready_now = True
+    handles["fs1"][0].ready_now = True
+    sup.tick()
+    _mark_live(router, "fs0")
+    _mark_live(router, "fs1")
+    sup.tick()                             # cold tick 1: drain begins
+    victim = next(s for s in sup._slots if s.state == DRAINING).handle
+    clock.t = 4.0                          # past the drain bound
+    sup.tick()
+    assert victim.killed
+    assert int(obs.metrics.counter("fleet.drains",
+                                   outcome="timeout").value) == 1
+
+
+def test_anomaly_stream_blocks_scale_down():
+    clock = Clock()
+    sup, router, handles = _sup(2, clock=clock, cold_ticks=1,
+                                cooldown_s=0.0, min_replicas=1)
+    sup.start()
+    handles["fs0"][0].ready_now = True
+    handles["fs1"][0].ready_now = True
+    sup.tick()
+    _mark_live(router, "fs0")
+    _mark_live(router, "fs1", anomaly_total=3)  # fresh anomalies
+    sup.tick()
+    assert sup.target == 2                 # delta>0: no shrink
+    sup.tick()                             # delta now 0: cold fires
+    assert sup.target == 1
+
+
+def test_no_scale_down_with_zero_placeable_replicas():
+    clock = Clock()
+    sup, router, handles = _sup(2, clock=clock, cold_ticks=1,
+                                cooldown_s=0.0, min_replicas=1)
+    sup.start()                            # nothing ever becomes ready
+    for _ in range(5):
+        sup.tick()
+    assert sup.target == 2                 # an outage is not "cold"
+
+
+# ---------------------------------------------------------------------------
+# chaos plan semantics
+# ---------------------------------------------------------------------------
+
+def test_chaos_plan_seeded_generation_is_deterministic():
+    a = ChaosPlan.generate(42, ticks=50, targets=["fs0", "fs1", "fs2"])
+    b = ChaosPlan.generate(42, ticks=50, targets=["fs0", "fs1", "fs2"])
+    assert a.describe() == b.describe()
+    c = ChaosPlan.generate(43, ticks=50, targets=["fs0", "fs1", "fs2"])
+    assert a.describe() != c.describe()
+    # every paired fault carries its recovery
+    kinds = [e.kind for e in a.events]
+    for fault, recovery in (("wedge", "unwedge"), ("refuse", "allow"),
+                            ("throttle", "unthrottle")):
+        assert kinds.count(fault) == kinds.count(recovery)
+
+
+def test_chaos_plan_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        FaultEvent(0, "meteor", "fs0")
+
+
+def test_chaos_controller_applies_in_tick_order():
+    plan = ChaosPlan([FaultEvent(2, "refuse", "r0"),
+                      FaultEvent(5, "allow", "r0"),
+                      FaultEvent(5, "wedge", "r1")])
+    ctl = ChaosController(plan)
+
+    class _C:
+        def __init__(self, rid):
+            self.id = rid
+
+        def describe(self):
+            return {"id": self.id}
+
+    c0, c1 = ctl.wrap(_C("r0")), ctl.wrap(_C("r1"))
+    assert ctl.advance(1) == []
+    assert [e.kind for e in ctl.advance(2)] == ["refuse"]
+    assert c0.refuse and not c1.wedged
+    applied = ctl.advance(10)
+    assert {e.kind for e in applied} == {"allow", "wedge"}
+    assert not c0.refuse and c1.wedged
+    assert ctl.exhausted()
+
+
+# ---------------------------------------------------------------------------
+# the full in-process chaos scenario (the ISSUE 12 acceptance criterion)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+BUDGET = 48          # long enough that a kill lands mid-stream reliably
+PROMPTS = ([1, 2, 3, 4, 5], [9, 8, 7], [4, 5, 6, 7], [11, 12, 13])
+
+
+def _engine(model):
+    return ContinuousBatchingEngine(
+        model, max_batch=2, gen=GenerationConfig(max_new_tokens=BUDGET),
+        max_seq_len=128, page_size=8, prefill_bucket=8)
+
+
+@pytest.fixture(scope="module")
+def oracle(model):
+    eng = _engine(model)
+    rids = [eng.add_request(list(p)) for p in PROMPTS]
+    out = eng.run()
+    return {tuple(p): out[r] for p, r in zip(PROMPTS, rids)}
+
+
+def _warmed_factory(model):
+    def factory():
+        eng = _engine(model)
+        # compile both step programs (T=bucket chunked prefill crossing
+        # into T=1 decode) BEFORE the server starts: a spawned replica
+        # is warm by construction, so ready-gated routing stays 0-compile
+        eng.add_request(list(range(1, 13)), max_new_tokens=4)
+        eng.run()
+        return eng
+    return factory
+
+
+async def _request(router, prompt, stream=False, headers=()):
+    head = [f"POST /v1/completions HTTP/1.1", "Host: chaos"]
+    head += [f"{k}: {v}" for k, v in headers]
+    body = completion_body(list(prompt), BUDGET, stream=stream)
+    head.append(f"Content-Length: {len(body)}")
+    raw = ("\r\n".join(head) + "\r\n\r\n").encode() + body
+    r = asyncio.StreamReader()
+    r.feed_data(raw)
+    r.feed_eof()
+    w = MemWriter()
+    await router.handle(r, w)
+    return split_response(w.buf)
+
+
+def _stream_verdict(status, body, prompt, oracle):
+    """Classify one streamed response against the synthesized-error
+    contract: 'ok' (bit-matches the oracle), 'synth_error' (clean
+    error chunk + [DONE]), else 'hard_failure'."""
+    if status != 200:
+        return "hard_failure"
+    text = body.decode(errors="replace")
+    if "data: [DONE]" not in text:
+        return "hard_failure"              # truncated stream: the crime
+    chunks = sse_chunks(body)
+    finishes = [c["choices"][0]["finish_reason"] for c in chunks
+                if c["choices"][0]["finish_reason"]]
+    toks = [t for c in chunks for t in c["choices"][0]["token_ids"]]
+    if finishes and finishes[-1] in ("stop", "length") and \
+            toks == oracle[tuple(prompt)]:
+        return "ok"
+    if finishes and finishes[-1] == "error":
+        return "synth_error"
+    return "hard_failure"
+
+
+async def _converge(sup, router, deadline_s=240.0):
+    """Tick the supervisor (and poll the router) until the fleet shape
+    matches intent; returns ticks consumed.  Engine builds happen on
+    spawn threads, so this awaits real time, bounded.  (The fault plan
+    is advanced at explicit phase boundaries, never in here — that is
+    what keeps the scenario deterministic.)"""
+    deadline = time.perf_counter() + deadline_s
+    ticks = 0
+    while True:
+        sup.tick()
+        await router.poll_replicas()
+        ticks += 1
+        if sup.converged() and \
+                len(router._candidates()) == sup.target:
+            return ticks
+        assert time.perf_counter() < deadline, \
+            f"fleet never converged: {sup.state()}"
+        await asyncio.sleep(0.05)
+
+
+@pytest.mark.filterwarnings("ignore::RuntimeWarning")
+def test_fleet_chaos_scenario(model, oracle):
+    """Mid-stream SIGKILL + wedged replica + scale-down drain, one
+    seeded/explicit fault plan, zero dropped sessions outside the
+    synthesized-error contract, survivors bit-identical, fleet
+    converges back to target — with warm routed traffic at 0 compiles
+    and no syncs beyond the engine's existing drain cadence."""
+    plan = ChaosPlan([
+        # ticks are phase-anchored by the test (deterministic): 100 =
+        # kill mid-stream, 200 = wedge, 300+ = scale-down (no fault —
+        # the drain is a supervisor action, listed for the log)
+        FaultEvent(100, "kill", "fs0"),
+        FaultEvent(200, "wedge", "fs1"),
+        FaultEvent(260, "unwedge", "fs1"),
+    ])
+    chaos = ChaosController(plan)
+    spawner = lambda rid: InprocReplicaHandle(
+        rid, _warmed_factory(model), client_wrap=chaos.wrap)
+    router = RouterServer([], allow_empty=True, policy="round_robin",
+                          health_interval_s=1e9, dead_after=2,
+                          poll_timeout_s=0.25)
+    sup = FleetSupervisor(router, spawner, target=2, min_replicas=1,
+                          max_replicas=3, restart_budget=3,
+                          backoff_base_s=0.05, backoff_max_s=0.5,
+                          backoff_reset_s=1e9, drain_timeout_s=20.0,
+                          hot_ticks=10**9, cold_ticks=10**9,
+                          cooldown_s=0.0,
+                          on_spawn=chaos.register_handle)
+    hard_failures = []
+    synth_errors = 0
+
+    async def drive():
+        nonlocal synth_errors
+        sup.start()
+        await _converge(sup, router)
+        assert len(router.states) == 2
+
+        # ---- phase B: warm routed traffic, supervisor running --------
+        drains0 = obs.metrics.counter("serving.drains").value
+        with obs.assert_overhead(record=True) as rec:
+            for p in PROMPTS[:2]:
+                sup.tick()
+                status, headers, body = await _request(router, p)
+                assert status == 200
+            await router.poll_replicas()
+        drains = obs.metrics.counter("serving.drains").value - drains0
+        assert rec.compiles == 0           # warm + supervised: no compile
+        assert rec.syncs <= drains         # only the existing drain syncs
+
+        # ---- phase C: mid-stream SIGKILL (plan tick 100) -------------
+        tasks = [asyncio.ensure_future(
+            _request(router, p, stream=True)) for p in PROMPTS]
+        # wait until BOTH replicas have in-flight streams past their
+        # first drain (tokens already on the wire: genuinely mid-stream)
+        deadline = time.perf_counter() + 60
+        while True:
+            vict = chaos._clients.get("fs0")
+            live0 = vict is not None and \
+                any(st.sent > 0 for st in vict.inner.server._live)
+            live1 = any(st.sent > 0
+                        for rid, c in chaos._clients.items()
+                        if rid != "fs0"
+                        for st in c.inner.server._live)
+            if live0 and live1:
+                break
+            assert time.perf_counter() < deadline, "streams never started"
+            await asyncio.sleep(0.005)
+        chaos.advance(100)                 # SIGKILL fs0, mid-stream
+        results = await asyncio.gather(*tasks)
+        verdicts = [_stream_verdict(st, bd, p, oracle)
+                    for (st, hd, bd), p in zip(results, PROMPTS)]
+        hard_failures.extend(v for v in verdicts if v == "hard_failure")
+        synth_errors += verdicts.count("synth_error")
+        assert verdicts.count("synth_error") >= 1       # fs0 was busy
+        assert all(v in ("ok", "synth_error") for v in verdicts), verdicts
+        assert obs.metrics.counter("router.failover",
+                                   phase="stream").value >= 1
+
+        # ---- phase D: supervisor converges back to 2 -----------------
+        # (fresh handle generations re-register with chaos via on_spawn)
+        await _converge(sup, router)
+        assert int(obs.metrics.counter("fleet.replica_restarts").value) >= 1
+
+        # ---- phase E: wedge fs1 (plan tick 200) ----------------------
+        chaos.advance(200)
+        for _ in range(2):                 # dead_after=2 failed polls
+            await router.poll_replicas()
+        await _converge(sup, router)
+        chaos.advance(260)                 # unwedge: no-op on the fresh
+        assert int(obs.metrics.counter(   # generation, applied for the log
+            "fleet.crashes", kind="wedged").value) >= 1
+        # traffic stayed servable throughout on the survivor
+        status, headers, body = await _request(router, PROMPTS[0])
+        assert status == 200
+
+        # ---- phase F: scale-down drain -------------------------------
+        # two in-flight streams (one per replica), then shrink to 1:
+        # the victim's stream must FINISH (drain, not kill)
+        tasks = [asyncio.ensure_future(
+            _request(router, p, stream=True)) for p in PROMPTS[:2]]
+        deadline = time.perf_counter() + 60
+        while not all(c.inner.server._live
+                      for c in chaos._clients.values()
+                      if c.inner.server.engine_alive()):
+            assert time.perf_counter() < deadline
+            await asyncio.sleep(0.01)
+        sup.set_target(1)
+        sup.tick()                         # victim pinned draining NOW
+        draining = [s for s in sup._slots if s.state == DRAINING]
+        assert len(draining) == 1
+        victim_id = draining[0].handle.id
+        assert victim_id not in [s.id for s in router._candidates()]
+        # a new request during the drain lands on the survivor only
+        status, headers, body = await _request(router, PROMPTS[2])
+        assert status == 200
+        assert headers.get("x-router-replica") != victim_id
+        results = await asyncio.gather(*tasks)
+        verdicts = [_stream_verdict(st, bd, p, oracle)
+                    for (st, hd, bd), p in zip(results, PROMPTS[:2])]
+        assert verdicts == ["ok", "ok"], verdicts   # drained, not dropped
+        await _converge(sup, router)
+        assert len(sup._slots) == 1 and sup._slots[0].state == READY
+        assert len(router.states) == 1
+        assert int(obs.metrics.counter("fleet.drains",
+                                       outcome="clean").value) >= 1
+
+    try:
+        asyncio.run(drive())
+    finally:
+        sup.shutdown(drain=False, timeout_s=5.0)
+    assert hard_failures == []
+    assert synth_errors >= 1
+
+
+# ---------------------------------------------------------------------------
+# launcher argparse surface (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+def test_fleet_launcher_arg_surface():
+    from paddle_tpu.fleet.__main__ import build_parser
+    p = build_parser()
+    args = p.parse_args(["--replicas", "3", "--port", "9090",
+                         "--replica-port-base", "9101",
+                         "--preset", "tiny", "--prefix-cache",
+                         "--set", "fleet_restart_budget=5",
+                         "--set", "fleet_drain_timeout_s=7.5"])
+    assert args.replicas == 3
+    assert args.port == 9090
+    assert args.replica_port_base == 9101
+    assert args.prefix_cache is True
+    assert args.flag_sets == ["fleet_restart_budget=5",
+                              "fleet_drain_timeout_s=7.5"]
+    with pytest.raises(SystemExit):
+        p.parse_args(["--policy", "bogus"])
+    # --set values flow through the shared flag parser
+    from paddle_tpu.serving.__main__ import apply_flag_sets
+    old = flags.flag("fleet_restart_budget")
+    try:
+        apply_flag_sets(["fleet_restart_budget=5"])
+        assert flags.flag("fleet_restart_budget") == 5
+    finally:
+        flags.set_flags({"fleet_restart_budget": old})

@@ -441,6 +441,57 @@ def test_failover_kill_replica_mid_stream(model, oracle):
         fleet.close()
 
 
+def test_replica_rejoin_resets_staleness_and_traces(model):
+    """ISSUE 12 satellite: a dead->live transition emits ONE
+    router.replica_rejoin instant + counter AND clears the routed
+    overlay, so a rejoined replica is never scored on pre-death
+    credits — only on the fresh digest it just advertised."""
+    obs.reset("router.")
+    # prefix cache ON: a digest-less replica clears its overlay on
+    # every poll anyway, which would mask what this test asserts
+    fleet = Fleet(model, n=2, prefix_cache=True)
+    rejoins = obs.metrics.counter("router.replica_rejoins")
+    try:
+        async def main():
+            await fleet.router.poll_replicas()
+            st = fleet.router.states[0]
+            assert rejoins.value == 0          # first poll is no rejoin
+            # a single-poll suspect BLIP is not a rejoin either: the
+            # replica never stopped serving, its overlay stays valid
+            st.credit_routed(["blip"], cap=16)
+            st.mark_failed()
+            await fleet.router.poll_replicas()
+            assert st.ok and int(rejoins.value) == 0
+            assert "blip" in st.routed
+            # credit phantom overlay entries, then kill the replica
+            st.credit_routed(["h1", "h2", "h3"], cap=16)
+            fleet.replicas[0].kill()
+            for _ in range(3):                 # fails past dead_after
+                await fleet.router.poll_replicas()
+            assert not st.ok and st.fails >= 3
+            assert st.routed                   # stale credits linger...
+            obs.TRACER.start()
+            fleet.replicas[0].revive()
+            await fleet.router.poll_replicas()
+            events = list(obs.TRACER._events)
+            obs.TRACER.stop()
+            return st, events
+
+        st, events = asyncio.run(main())
+        assert st.ok                           # rejoined
+        assert st.routed == {}                 # ...and are gone on rejoin
+        assert int(rejoins.value) == 1         # exactly one per rejoin
+        marks = [e for e in events
+                 if e.get("name") == "router.replica_rejoin"]
+        assert len(marks) == 1
+        assert marks[0]["args"]["replica"] == st.id
+        # a healthy re-poll is NOT a rejoin
+        asyncio.run(fleet.router.poll_replicas())
+        assert int(rejoins.value) == 1
+    finally:
+        fleet.close()
+
+
 def test_failover_at_connect_replaces_transparently(model, oracle):
     """A replica dead BEFORE dispatch: the router re-places the request
     on the next candidate — the client sees a plain 200."""

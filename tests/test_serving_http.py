@@ -585,6 +585,209 @@ def test_flight_recorder_crash_hook(model, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# graceful drain protocol + Retry-After jitter (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def test_retry_after_jitter_stays_inside_clamp():
+    """±20% jitter on every shed-path Retry-After, never outside the
+    [1, 60]s clamp (the thundering-herd satellite)."""
+    import random
+
+    from paddle_tpu.serving.slo import jittered_retry_after
+
+    seen = set()
+    for seed in range(200):
+        rng = random.Random(seed)
+        for base in (0.2, 1, 7, 30, 59, 60, 400):
+            v = jittered_retry_after(base, rng=rng)
+            assert 1 <= v <= 60, (base, v)
+            if base == 30:
+                seen.add(v)
+                assert 24 <= v <= 36, v    # ±20% around 30
+    assert len(seen) > 3                   # it actually jitters
+
+
+def test_drain_stops_admission_and_finishes_inflight(model, oracle):
+    """begin_drain(): new completions 503 (jittered Retry-After),
+    /readyz flips unready, /statusz reports draining — while the
+    in-flight stream finishes BIT-IDENTICAL to the oracle."""
+    server = ServingServer(_engine(model), slo=False,
+                           flight_recorder=False).start()
+    try:
+        async def main():
+            t = asyncio.ensure_future(do(
+                server, "POST", "/v1/completions",
+                completion_body(list(PROMPTS[0]), 6, stream=True)))
+            deadline = time.perf_counter() + 60
+            while not server._live:        # stream admitted = in flight
+                assert time.perf_counter() < deadline
+                await asyncio.sleep(0.005)
+            server.begin_drain()
+            refused = await do(server, "POST", "/v1/completions",
+                               completion_body([1, 2], 2))
+            ready = await do(server, "GET", "/readyz")
+            statusz = await do(server, "GET", "/statusz")
+            return await t, refused, ready, statusz
+
+        (status, headers, body), refused, ready, statusz = \
+            asyncio.run(main())
+        # the in-flight stream drained out complete, not cut
+        assert status == 200
+        chunks = sse_chunks(body)
+        toks = [t for c in chunks for t in c["choices"][0]["token_ids"]]
+        assert toks == oracle[tuple(PROMPTS[0])]
+        assert chunks[-1]["choices"][0]["finish_reason"] in ("stop",
+                                                            "length")
+        # admission closed: 503 + jittered-but-clamped Retry-After
+        assert refused[0] == 503
+        err = json.loads(refused[2])["error"]
+        assert "draining" in err["message"]
+        ra = int(refused[1]["retry-after"])
+        assert 1 <= ra <= 60 and err["retry_after_s"] == ra
+        assert ready[0] == 503             # a router would stop placing
+        doc = json.loads(statusz[2])
+        assert doc["draining"] is True
+        # everything retired: the drain is complete
+        deadline = time.perf_counter() + 30
+        while not server.drained():
+            assert time.perf_counter() < deadline
+            time.sleep(0.01)
+    finally:
+        server.close()
+
+
+def test_drainz_endpoint(model):
+    server = ServingServer(_engine(model), slo=False,
+                           flight_recorder=False).start()
+    try:
+        status, _, body = asyncio.run(do(server, "POST", "/drainz"))
+        assert status == 200
+        assert json.loads(body)["draining"] is True
+        assert asyncio.run(do(server, "GET", "/drainz"))[0] == 405
+        assert server.draining
+        status, _, _ = asyncio.run(do(
+            server, "POST", "/v1/completions",
+            completion_body([1, 2, 3], 2)))
+        assert status == 503
+    finally:
+        server.close()
+
+
+def test_sigterm_drains_active_streams_and_dumps(model, oracle, tmp_path):
+    """The ISSUE 12 satellite: SIGTERM during active streams — the
+    flight-recorder dump fires (first, then chains into the drain
+    handler), every in-flight request finishes bit-identical, and the
+    server reaches drained() cleanly."""
+    fr = obs.FlightRecorder(path=str(tmp_path / "term.json"),
+                            max_events=64, snapshot_every_s=1e9)
+    server = ServingServer(_engine(model), slo=False,
+                           flight_recorder=fr).start()
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        # serve_forever's wiring order: drain handler first, then the
+        # flight recorder's dump hook chains to it
+        server.install_drain_signal()
+        fr.install(watchdog=False, sigterm=True, excepthook=False)
+
+        async def main():
+            tasks = [asyncio.ensure_future(do(
+                server, "POST", "/v1/completions",
+                completion_body(list(p), 6, stream=True)))
+                for p in PROMPTS[:2]]
+            deadline = time.perf_counter() + 60
+            while len(server._live) < 2:   # both genuinely in flight
+                assert time.perf_counter() < deadline
+                await asyncio.sleep(0.005)
+            os.kill(os.getpid(), signal.SIGTERM)
+            return await asyncio.gather(*tasks)
+
+        results = asyncio.run(main())
+        assert server.draining             # the drain handler ran
+        # dump fired BEFORE the chain, reason sigterm
+        assert fr.last_dump is not None
+        assert _load_chrome_trace(fr.last_dump)["metadata"][
+            "reason"] == "sigterm"
+        # in-flight requests finished: complete, bit-identical streams
+        for (status, headers, body), p in zip(results, PROMPTS[:2]):
+            assert status == 200
+            chunks = sse_chunks(body)
+            toks = [t for c in chunks
+                    for t in c["choices"][0]["token_ids"]]
+            assert toks == oracle[tuple(p)]
+            assert chunks[-1]["choices"][0]["finish_reason"] in (
+                "stop", "length")
+        deadline = time.perf_counter() + 30
+        while not server.drained():
+            assert time.perf_counter() < deadline
+            time.sleep(0.01)
+    finally:
+        fr.uninstall()
+        signal.signal(signal.SIGTERM, prev)
+        server.close()
+
+
+@pytest.mark.slow
+def test_sigterm_drain_real_process(tmp_path):
+    """Real-socket variant: a launcher-spawned replica process holding
+    an active stream gets SIGTERM — the stream completes ([DONE], no
+    error finish) and the process exits 0 (the serve_forever drain
+    path), never a mid-stream cut."""
+    import http.client
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serving", "--port", str(port),
+         "--max-batch", "2", "--max-seq-len", "256",
+         "--prefill-bucket", "16", "--max-new-tokens", "64",
+         "--set", "fleet_drain_timeout_s=60"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        deadline = time.time() + 300
+        while True:                        # wait out the warmup compile
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=2)
+                conn.request("GET", "/readyz")
+                if conn.getresponse().status == 200:
+                    conn.close()
+                    break
+                conn.close()
+            except OSError:
+                pass
+            assert time.time() < deadline, "replica never became ready"
+            assert proc.poll() is None, "replica died during warmup"
+            time.sleep(0.5)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/v1/completions",
+                     completion_body([5, 6, 7, 8], 64, stream=True))
+        resp = conn.getresponse()
+        assert resp.status == 200
+        first = resp.fp.readline()         # head of the event stream out
+        assert first is not None
+        proc.send_signal(signal.SIGTERM)   # mid-stream
+        body = first + resp.read()         # stream runs to completion
+        conn.close()
+        text = body.decode()
+        assert "data: [DONE]" in text
+        chunks = sse_chunks(body)
+        toks = [t for c in chunks for t in c["choices"][0]["token_ids"]]
+        assert len(toks) == 64             # full budget: drained, not cut
+        finishes = [c["choices"][0]["finish_reason"] for c in chunks
+                    if c["choices"][0]["finish_reason"]]
+        assert finishes == ["length"]
+        assert proc.wait(timeout=90) == 0  # exit clean
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+# ---------------------------------------------------------------------------
 # real socket round trip (slow: binds a port; tier-1 runs -m 'not slow')
 # ---------------------------------------------------------------------------
 

@@ -403,6 +403,25 @@ def test_jl005_scoped_to_serving_and_router():
     assert ctx.findings == []
 
 
+def test_jl005_covers_fleet_package():
+    """ISSUE 12 satellite: the fleet supervisor/chaos modules run on the
+    same event loop as the router — blocking calls in their async defs
+    are the same head-of-line hazard."""
+    ctx = lint(_ASYNC_POS, rel="paddle_tpu/fleet/chaos.py",
+               select={"JL005"})
+    assert len(ctx.findings) == 3
+    # the supervisor's SYNC control loop (tick/run_forever on a side
+    # thread) stays exempt: blocking there is the design
+    src = """
+        import time
+
+        def run_forever(self, interval_s):
+            time.sleep(interval_s)
+    """
+    ctx = lint(src, rel="paddle_tpu/fleet/supervisor.py", select={"JL005"})
+    assert ctx.findings == []
+
+
 # ------------------------------------------------------------------ JL006 --
 
 def test_jl006_fires_on_request_data_labels():
@@ -483,6 +502,17 @@ def test_jl007_quiet_on_engine_thread_and_reads():
                 return 200
     """
     ctx = lint(src, rel="paddle_tpu/serving/server.py", select={"JL007"})
+    assert ctx.findings == []
+
+
+def test_jl007_covers_fleet_package():
+    src = """
+        async def drain(self):
+            self.engine.step()
+    """
+    ctx = lint(src, rel="paddle_tpu/fleet/supervisor.py", select={"JL007"})
+    assert len(ctx.findings) == 1
+    ctx = lint(src, rel="paddle_tpu/io/h.py", select={"JL007"})
     assert ctx.findings == []
 
 
