@@ -1365,6 +1365,124 @@ def _run_router_serve(on_tpu):
     }
 
 
+def _run_kv_quant(on_tpu):
+    """ISSUE 13: quantized-KV-plane A/B — the continuous-batching engine
+    on the 50%-shared serve_prefix traffic mix, cache-fp32 pool vs int8
+    pool at EQUAL POOL BYTES (the int8 arm gets ~4x the pages the same
+    HBM budget buys), both arms prefix-cached with the host-RAM spill
+    ring on.  Stamps per-arm tok/s, the resident-session high-water mark
+    (the acceptance lever: >= 1.8x at equal bytes), spill/swap-in counts,
+    and the bit-stability contract (two int8 runs are identical)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                      GenerationConfig, PagedKVCache)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        n_req, slots, max_seq, page, bucket = 48, 16, 1024, 32, 128
+        shared_len, tail_range, budget_range = 512, (16, 65), (16, 49)
+        base_pages, spill, fp_dtype = 64, 128, "bfloat16"
+    else:
+        cfg = LlamaConfig.tiny()
+        n_req, slots, max_seq, page, bucket = 24, 8, 256, 16, 64
+        shared_len, tail_range, budget_range = 96, (8, 17), (8, 17)
+        base_pages, spill, fp_dtype = 20, 48, "float32"
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    shared = list(rng.integers(1, cfg.vocab_size, shared_len))
+    prompts, budgets = [], []
+    for i in range(n_req):
+        tail = int(rng.integers(*tail_range))
+        if i % 2 == 0:                      # the 50% shared-prefix mix
+            prompts.append(shared +
+                           list(rng.integers(1, cfg.vocab_size, tail)))
+        else:                               # unique, same length profile
+            prompts.append(
+                list(rng.integers(1, cfg.vocab_size, shared_len + tail)))
+        budgets.append(int(rng.integers(*budget_range)))
+    # a second shared wave after the crush: re-hits land on pages that
+    # pressure may have spilled, exercising the swap-in path
+    wave2 = [shared + list(rng.integers(1, cfg.vocab_size, 8))
+             for _ in range(4)]
+
+    bpp = {d: PagedKVCache.bytes_per_page(
+        cfg.num_hidden_layers, cfg.num_key_value_heads, page,
+        cfg.head_dim, d) for d in (fp_dtype, "int8")}
+    pool_bytes = base_pages * bpp[fp_dtype]
+    pages = {fp_dtype: base_pages, "int8": pool_bytes // bpp["int8"]}
+
+    def arm(dtype):
+        eng = ContinuousBatchingEngine(
+            model, max_batch=slots,
+            gen=GenerationConfig(max_new_tokens=int(budget_range[1])),
+            max_seq_len=max_seq, page_size=page, prefill_bucket=bucket,
+            num_pages=int(pages[dtype]), prefix_cache=True,
+            kv_spill_pages=spill, cache_dtype=dtype)
+        # warmup compiles the step pair + COW + swap-in programs on junk
+        # traffic that shares nothing with the measured requests.  Its
+        # OWN rng: every arm must see byte-identical traffic end to end
+        # or the bit-stability contract compares different runs
+        wrng = np.random.default_rng(12345)
+        eng.add_request(list(wrng.integers(1, cfg.vocab_size, bucket + 3)),
+                        max_new_tokens=4)
+        eng.run()
+        rids = [eng.add_request(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        high_water = 0
+        t0 = time.perf_counter()
+        while eng.has_work():
+            eng.step()
+            high_water = max(high_water,
+                             eng.g.cache.allocator.stats()["active_seqs"])
+        res = eng.run()
+        dt = time.perf_counter() - t0
+        rids2 = [eng.add_request(p, max_new_tokens=4) for p in wave2]
+        res2 = eng.run()
+        toks = sum(len(res[r]) for r in rids)
+        st = eng.stats()
+        outs = [res[r] for r in rids] + [res2[r] for r in rids2]
+        del eng
+        return {"tps": toks / dt, "hw": high_water, "stats": st,
+                "outputs": outs}
+
+    fp = arm(fp_dtype)
+    q1 = arm("int8")
+    q2 = arm("int8")                        # the bit-stability contract
+    ratio = q1["hw"] / max(fp["hw"], 1)
+    agree = sum(a == b for a, b in zip(fp["outputs"], q1["outputs"]))
+    return {
+        "kv_quant_requests": n_req,
+        "kv_quant_pool_bytes": int(pool_bytes),
+        "kv_quant_pages_fp": int(pages[fp_dtype]),
+        "kv_quant_pages_int8": int(pages["int8"]),
+        "kv_quant_fp_dtype": fp_dtype,
+        "kv_quant_fp_tok_per_sec": round(fp["tps"], 1),
+        "kv_quant_int8_tok_per_sec": round(q1["tps"], 1),
+        "kv_quant_fp_resident_high_water": fp["hw"],
+        "kv_quant_int8_resident_high_water": q1["hw"],
+        "kv_quant_capacity_ratio": round(ratio, 3),
+        "kv_quant_capacity_match": bool(ratio >= 1.8),
+        "kv_quant_int8_bit_stable_match": bool(
+            q1["outputs"] == q2["outputs"]),
+        "kv_quant_output_agreement": round(agree / len(fp["outputs"]), 3),
+        "kv_quant_fp_spilled_pages": fp["stats"].get("kv_spilled_pages", 0),
+        "kv_quant_fp_swapins": fp["stats"].get("kv_swapins", 0),
+        "kv_quant_int8_spilled_pages": q1["stats"].get(
+            "kv_spilled_pages", 0),
+        "kv_quant_int8_swapins": q1["stats"].get("kv_swapins", 0),
+        "kv_quant_int8_prefix_hits": q1["stats"]["prefix_hits"],
+        "kv_quant_fp_prefix_hits": fp["stats"]["prefix_hits"],
+    }
+
+
 def _run_fleet_chaos(on_tpu):
     """ISSUE 12: supervised-fleet churn under load (`benchmarks/run.py
     fleet_chaos`) — a 2→3→1-replica scenario driven END-TO-END by the
@@ -1613,6 +1731,7 @@ _EXTRAS = (("large", _run_large), ("decode", _run_decode),
            ("serve", _run_serve_metrics),
            ("http_serve", _run_http_serve),
            ("router_serve", _run_router_serve),
+           ("kv_quant", _run_kv_quant),
            ("fleet_chaos", _run_fleet_chaos))
 
 

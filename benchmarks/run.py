@@ -5,7 +5,7 @@ Usage:
     python benchmarks/run.py [config ...] [--cpu] [--fused-gather=0|1]
                              [--trace=PATH] [--gate]
 configs: resnet gpt2 llama dit moe decode serve http_serve router_serve
-         fleet_chaos spec_decode all (default: all)
+         fleet_chaos spec_decode kv_quant all (default: all)
 
 --gate compares each fresh result against the committed
 results/<config>.json (benchmarks/check.py guardbands), stamps the
@@ -392,13 +392,25 @@ def run_fleet_chaos():
     return {"config": "fleet_chaos", **bench._run_fleet_chaos(_on_tpu())}
 
 
+def run_kv_quant():
+    """ISSUE 13: quantized-KV-plane A/B (`python benchmarks/run.py
+    kv_quant --cpu`) — cache-fp pool vs int8 pool at equal pool bytes on
+    the 50%-shared serve_prefix mix, spill ring on.  Gated stamps:
+    resident-session high-water >= 1.8x on the int8 arm
+    (kv_quant_capacity_match) and int8 bit-stability run-to-run
+    (kv_quant_int8_bit_stable_match); tok/s both arms, spill/swap-in
+    counts and the output-agreement fraction ride along."""
+    import bench
+    return {"config": "kv_quant", **bench._run_kv_quant(_on_tpu())}
+
+
 CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
            "dit": run_dit, "moe": run_moe, "decode": run_decode,
            "longctx": run_longctx, "grad_comm": run_grad_comm,
            "serve_prefix": run_serve_prefix, "spec_decode": run_spec_decode,
            "serve": run_serve,
            "http_serve": run_http_serve, "router_serve": run_router_serve,
-           "fleet_chaos": run_fleet_chaos}
+           "kv_quant": run_kv_quant, "fleet_chaos": run_fleet_chaos}
 
 
 def _supervise(names, timeout):

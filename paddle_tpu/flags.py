@@ -101,6 +101,25 @@ define_flag("prefix_cache_min_pages", 1,
             "Minimum cached-prefix length IN PAGES for an admission to "
             "take a prefix-cache hit; shorter matches prefill from "
             "scratch (guards against sharing overhead on tiny matches).")
+define_flag("kv_cache_dtype", "auto",
+            "Serving KV page-pool storage dtype: 'auto' follows the "
+            "model dtype, 'fp32'/'float32'/'bf16'/'bfloat16' force a "
+            "float pool, 'int8' stores pages quantized with per-(layer, "
+            "kv-head, page) fp32 absmax scales (ISSUE 13) — the ragged "
+            "paged-attention kernel dequantizes on its VMEM slot right "
+            "after the page DMA and the batched commit requantizes per "
+            "page, so ~4x more resident tokens fit the same HBM bytes.  "
+            "Greedy outputs stay bit-stable run-to-run and within the "
+            "documented quantization tolerance of a float pool.")
+define_flag("kv_spill_pages", 0,
+            "Capacity (in pages) of the pinned-host-RAM spill ring for "
+            "LRU-evicted prefix-cache pages (inference/kv_spill.py): "
+            "under memory pressure an idle cached page spills its KV "
+            "bytes to host RAM instead of dropping, and a later "
+            "admission that matches it swaps it back in asynchronously "
+            "— eviction becomes a DMA instead of a re-prefill.  0 = off "
+            "(evictions drop, the pre-ISSUE-13 behavior).  Requires the "
+            "prefix cache.")
 define_flag("spec_decode", "",
             "Speculative decoding mode for the serving engine "
             "(inference/speculative.py): '' = off (bit-identical to the "

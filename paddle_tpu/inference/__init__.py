@@ -22,6 +22,7 @@ import numpy as np
 from .generation import (ContinuousBatchingEngine, GenerationConfig,
                          LlamaGenerator, Request, generate)
 from .kv_cache import PagedKVCache, PageAllocator
+from .kv_spill import HostSpillPool
 from .prefix_cache import PrefixCache, serving_stats
 from .speculative import SpecConfig, SpecHistory, resolve_spec_config
 
@@ -30,6 +31,7 @@ __all__ = [
     "GenerationConfig", "LlamaGenerator", "generate",
     "ContinuousBatchingEngine", "Request",
     "PagedKVCache", "PageAllocator", "PrefixCache", "serving_stats",
+    "HostSpillPool",
     "SpecConfig", "SpecHistory", "resolve_spec_config",
 ]
 

@@ -50,7 +50,8 @@ from .. import observability as _obs
 from ..kernels.paged_attention import (paged_attention,
                                        ragged_paged_attention,
                                        write_kv_pages,
-                                       write_kv_pages_all_layers)
+                                       write_kv_pages_all_layers,
+                                       write_kv_pages_all_layers_quantized)
 from ..kernels.rms_norm import rms_norm_fp32
 from ..models.llama import LlamaConfig, LlamaForCausalLM, _rope_cos_sin
 from ..utils import extract_params, stack_params
@@ -58,18 +59,24 @@ from . import speculative as _sp
 from .kv_cache import PagedKVCache
 
 
-def _cow_copy_pages(kc, vc, src, dst):
+def _cow_copy_pages(cache, src, dst):
     """Whole-page KV copies src[i] -> dst[i] across every layer/head (the
     prefix cache's copy-on-write privatization).  Entries with src < 0
     are no-ops: their dst is routed out of bounds, which scatter drops.
     Jitted once per engine over the fixed [max_batch] pair bucket and
-    donated like the step, so warm hit admissions never recompile."""
+    donated like the step, so warm hit admissions never recompile.
+
+    ``cache`` is the pool tuple — ``(k, v)`` float or ``(k, v, k_scale,
+    v_scale)`` int8: every plane indexes pages on axis 2, so one loop
+    copies them all, and an int8 COW moves 4x fewer bytes."""
     valid = src >= 0
     s = jnp.maximum(src, 0)
-    d = jnp.where(valid, dst, kc.shape[2])
-    kc = kc.at[:, :, d].set(jnp.take(kc, s, axis=2), mode="drop")
-    vc = vc.at[:, :, d].set(jnp.take(vc, s, axis=2), mode="drop")
-    return kc, vc
+    out = []
+    for arr in cache:
+        d = jnp.where(valid, dst, arr.shape[2])
+        out.append(arr.at[:, :, d].set(jnp.take(arr, s, axis=2),
+                                       mode="drop"))
+    return tuple(out)
 
 
 @dataclass
@@ -181,6 +188,13 @@ class LlamaGenerator:
         self.config = c
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len or c.max_position_embeddings
+        if cache_dtype is None:
+            # FLAGS_kv_cache_dtype: "auto" follows the model dtype;
+            # "int8" turns on the quantized memory plane (ISSUE 13)
+            fd = flags.flag("kv_cache_dtype")
+            cache_dtype = None if fd == "auto" else fd
+        cache_dtype = {"fp32": "float32", "bf16": "bfloat16"}.get(
+            cache_dtype, cache_dtype)
         if page_size in (None, "auto"):
             # the page IS the decode kernel's KV tile: consult the measured
             # autotune cache (populated by the bench's decode sweep), fall
@@ -236,7 +250,7 @@ class LlamaGenerator:
             import functools
             self._jit_cache[key] = jax.jit(
                 functools.partial(self._step_fn, gc, t, bool(track_recent)),
-                donate_argnums=(1, 2))
+                donate_argnums=(1,))
         return self._jit_cache[key]
 
     def _spec_jit(self, gc: GenerationConfig, k: int, nmax: int):
@@ -248,7 +262,7 @@ class LlamaGenerator:
             import functools
             self._jit_cache[key] = jax.jit(
                 functools.partial(self._spec_verify_fn, gc, k, nmax),
-                donate_argnums=(1, 2))
+                donate_argnums=(1,))
         return self._jit_cache[key]
 
     def _fused_jit(self, gc: GenerationConfig, k: int):
@@ -259,11 +273,11 @@ class LlamaGenerator:
             import functools
             self._jit_cache[key] = jax.jit(
                 functools.partial(self._fused_decode_fn, gc, k),
-                donate_argnums=(1, 2))
+                donate_argnums=(1,))
         return self._jit_cache[key]
 
     # ---- the shared transformer core of every serving step ----
-    def _forward_tokens(self, params, kc, vc, tokens, ql, positions,
+    def _forward_tokens(self, params, cache, tokens, ql, positions,
                         block_tables):
         """Run the whole model over this step's query tokens: derive write
         slots in-jit from the block table, stream every layer through the
@@ -280,10 +294,19 @@ class LlamaGenerator:
         — embedding lookups clip, and their slots are routed to -1 / not
         attended).  ql: [B] valid tokens per row (0 = inert row).
         positions: [B] cache tokens BEFORE this step (the write cursor).
+        cache: the pool tuple — (kc, vc) float, or (kc, vc, ks, vs) for
+        the int8 plane (per-(layer, kv-head, page) fp32 scales): pages
+        dequantize inside the kernel and the commit requantizes per
+        page, so the two modes share this whole function.
         """
         c = self.config
         B, T = tokens.shape
         page = self.page_size
+        quant = len(cache) == 4
+        if quant:
+            kc, vc, ks, vs = cache
+        else:
+            kc, vc = cache
 
         # token positions & write slots, derived in-jit from the block table
         offs = jnp.arange(T, dtype=jnp.int32)
@@ -303,7 +326,11 @@ class LlamaGenerator:
 
         def layer(carry, xs):
             x, = carry
-            lp, kcl, vcl = xs                 # cache slices: READ-ONLY
+            if quant:
+                lp, kcl, vcl, ksl, vsl = xs   # cache slices: READ-ONLY
+            else:
+                lp, kcl, vcl = xs
+                ksl = vsl = None
             y = rms_norm_fp32(x, lp["input_layernorm.weight"], c.rms_norm_eps)
             q = (y @ lp["self_attn.q_proj.weight"]).reshape(
                 B, T, c.num_attention_heads, c.head_dim)
@@ -318,7 +345,8 @@ class LlamaGenerator:
             # committed to the cache only at the end of the step
             attn = ragged_paged_attention(q, kcl, vcl, block_tables,
                                           ctx_prev, q_lens=ql,
-                                          k_new=k, v_new=v)
+                                          k_new=k, v_new=v,
+                                          k_scale=ksl, v_scale=vsl)
             x = x + (attn.reshape(B, T, -1) @ lp["self_attn.o_proj.weight"])
             y = rms_norm_fp32(x, lp["post_attention_layernorm.weight"],
                               c.rms_norm_eps)
@@ -332,19 +360,29 @@ class LlamaGenerator:
                 x = x + act @ lp["mlp.down_proj.weight"]
             return (x,), (k, v)
 
-        (h,), (k_all, v_all) = jax.lax.scan(layer, (h,),
-                                            (params["blocks"], kc, vc))
+        xs = (params["blocks"], kc, vc, ks, vs) if quant else \
+            (params["blocks"], kc, vc)
+        (h,), (k_all, v_all) = jax.lax.scan(layer, (h,), xs)
         L = k_all.shape[0]
         kvh, dh = c.num_key_value_heads, c.head_dim
-        kc, vc = write_kv_pages_all_layers(
-            kc, vc, k_all.reshape(L, B * T, kvh, dh),
-            v_all.reshape(L, B * T, kvh, dh), slots)
+        k_all = k_all.reshape(L, B * T, kvh, dh)
+        v_all = v_all.reshape(L, B * T, kvh, dh)
+        if quant:
+            # quantize fresh K/V per page on the way in (page-level RMW:
+            # the absmax scale covers every row of the page)
+            kc, vc, ks, vs = write_kv_pages_all_layers_quantized(
+                kc, vc, ks, vs, k_all, v_all, positions, ql,
+                block_tables, self.max_seq_len)
+            out_cache = (kc, vc, ks, vs)
+        else:
+            kc, vc = write_kv_pages_all_layers(kc, vc, k_all, v_all, slots)
+            out_cache = (kc, vc)
 
         h = rms_norm_fp32(h, params["norm"], c.rms_norm_eps)
-        return h, kc, vc
+        return h, out_cache
 
     # ---- the ONE engine step ----
-    def _step_fn(self, gc, T, track_recent, params, kc, vc, tokens, q_lens,
+    def _step_fn(self, gc, T, track_recent, params, cache, tokens, q_lens,
                  positions, finished, decode_mask, commit_mask, counts,
                  budgets, block_tables, key, recent=None):
         """One fused serving step: admit (slots derived in-jit) →
@@ -376,8 +414,8 @@ class LlamaGenerator:
         finished = jnp.logical_or(finished, positions >= self.max_seq_len)
         ql = jnp.where(finished, 0, q_lens).astype(jnp.int32)
 
-        h, kc, vc = self._forward_tokens(params, kc, vc, tokens, ql,
-                                         positions, block_tables)
+        h, cache = self._forward_tokens(params, cache, tokens, ql,
+                                        positions, block_tables)
         last_ix = jnp.maximum(ql - 1, 0)
         last = jnp.take_along_axis(h, last_ix[:, None, None], axis=1)[:, 0]
         logits = (last @ params["head"]).astype(jnp.float32)
@@ -392,7 +430,7 @@ class LlamaGenerator:
         counts = counts + jnp.where(committed, 1, 0)
         finished = jnp.logical_or(finished, counts >= budgets)
         out = (out_tokens, new_positions, finished, jnp.all(finished),
-               counts, kc, vc, key)
+               counts, cache, key)
         if track_recent:
             recent = _sp.shift_append(recent, out_tokens[:, None],
                                       committed.astype(jnp.int32))
@@ -400,7 +438,7 @@ class LlamaGenerator:
         return out
 
     # ---- ISSUE 9: the T=K speculative verify step (ngram mode) ----
-    def _spec_verify_fn(self, gc, K, nmax, params, kc, vc, last_tok, recent,
+    def _spec_verify_fn(self, gc, K, nmax, params, cache, last_tok, recent,
                         hist, hist_len, positions, finished, counts,
                         budgets, write_caps, block_tables, key):
         """One speculative decode dispatch: draft K-1 tokens on device
@@ -416,7 +454,7 @@ class LlamaGenerator:
         draft is only accepted when it EQUALS the verifier's own argmax.
 
         Returns (sampled [B,K], n_commit [B], drafted [B], last_tok,
-        positions, finished, all_done, counts, recent, kc, vc, key).
+        positions, finished, all_done, counts, recent, cache, key).
         """
         if gc.eos_token_id is not None:
             # EOS on the chained input token: the prefill handoff case —
@@ -437,8 +475,8 @@ class LlamaGenerator:
         drafted = jnp.maximum(ql - 1, 0)          # drafts actually dispatched
         tokens = jnp.concatenate([last_tok[:, None], drafts], axis=1)
 
-        h, kc, vc = self._forward_tokens(params, kc, vc, tokens, ql,
-                                         positions, block_tables)
+        h, cache = self._forward_tokens(params, cache, tokens, ql,
+                                        positions, block_tables)
         B = tokens.shape[0]
         logits = (h @ params["head"]).astype(jnp.float32)      # [B, K, V]
         key, sub = jax.random.split(key)
@@ -464,10 +502,10 @@ class LlamaGenerator:
         last_tok = jnp.where(n_commit > 0, picked, last_tok)
         recent = _sp.shift_append(recent, sampled, n_commit)
         return (sampled, n_commit, drafted, last_tok, positions, finished,
-                jnp.all(finished), counts, recent, kc, vc, key)
+                jnp.all(finished), counts, recent, cache, key)
 
     # ---- ISSUE 9: fused K-steps-per-dispatch decode (fused mode) ----
-    def _fused_decode_fn(self, gc, K, params, kc, vc, last_tok, positions,
+    def _fused_decode_fn(self, gc, K, params, cache, last_tok, positions,
                          finished, counts, budgets, write_caps,
                          block_tables, key):
         """K sequential T=1 decode steps unrolled inside ONE jitted
@@ -480,7 +518,7 @@ class LlamaGenerator:
         bit-match the sequential engine.
 
         Returns (out [B,K], n_commit [B], last_tok, positions, finished,
-        all_done, counts, kc, vc, key).
+        all_done, counts, cache, key).
         """
         outs, n_commit = [], None
         tok = last_tok
@@ -495,8 +533,8 @@ class LlamaGenerator:
             ql = jnp.where(jnp.logical_or(finished,
                                           positions >= write_caps),
                            0, 1).astype(jnp.int32)
-            h, kc, vc = self._forward_tokens(params, kc, vc, tok[:, None],
-                                             ql, positions, block_tables)
+            h, cache = self._forward_tokens(params, cache, tok[:, None],
+                                            ql, positions, block_tables)
             logits = (h[:, 0] @ params["head"]).astype(jnp.float32)
             key, sub = jax.random.split(key)
             sampled = _sample(logits, sub, gc)
@@ -510,7 +548,7 @@ class LlamaGenerator:
             tok = out
         out_mat = jnp.stack(outs, axis=1)                      # [B, K]
         return (out_mat, n_commit, tok, positions, finished,
-                jnp.all(finished), counts, kc, vc, key)
+                jnp.all(finished), counts, cache, key)
 
     # ---- host loop ----
     def generate(self, prompts: Sequence[Sequence[int]],
@@ -559,11 +597,11 @@ class LlamaGenerator:
                     chunk[i, :n] = np.asarray(p[s0:s0 + n], np.int32)
             commit = np.zeros((MB,), bool)
             commit[:B] = (lens > s0) & (lens <= s0 + T)   # prompt ends here
-            out, positions, finished, _ad, counts, kc, vc, key = step_p(
-                self.params, *self.cache.arrays, jnp.asarray(chunk),
+            out, positions, finished, _ad, counts, cache, key = step_p(
+                self.params, self.cache.arrays, jnp.asarray(chunk),
                 jnp.asarray(ql), positions, finished, no_mask,
                 jnp.asarray(commit), counts, budgets, bt_dev, key)
-            self.cache.update(kc, vc)
+            self.cache.update(*cache)
             first = jnp.where(jnp.asarray(commit), out, first)
 
         # device-resident decode loop (sync-free; one dispatch per step)
@@ -595,11 +633,11 @@ class LlamaGenerator:
                 bt[:B] = alloc.block_table(seq_ids, max_pages=bt_width)
                 bt_dev = jnp.asarray(bt)
 
-            tokens, positions, finished, all_done, counts, kc, vc, key = \
-                step_d(self.params, *self.cache.arrays, tokens[:, None],
+            tokens, positions, finished, all_done, counts, cache, key = \
+                step_d(self.params, self.cache.arrays, tokens[:, None],
                        ql1, positions, finished, all_mask, all_mask,
                        counts, budgets, bt_dev, key)
-            self.cache.update(kc, vc)
+            self.cache.update(*cache)
             collected.append(tokens)
             host_lens = np.minimum(host_lens + 1, self.max_seq_len)
 
@@ -758,7 +796,8 @@ class ContinuousBatchingEngine:
                  prefix_cache: Optional[bool] = None,
                  metrics: Optional[bool] = None,
                  spec_decode=None, spec_k: Optional[int] = None,
-                 spec_ngram_max: Optional[int] = None, **kw):
+                 spec_ngram_max: Optional[int] = None,
+                 kv_spill_pages: Optional[int] = None, **kw):
         self.gen_cfg = gen or GenerationConfig()
         self.g = LlamaGenerator(model, max_batch=max_batch, **kw)
         B = max_batch
@@ -838,18 +877,34 @@ class ContinuousBatchingEngine:
         # dirty-flag pattern as _bt_dev, so warm spec steps upload nothing
         self._caps_dev = jnp.zeros((B,), jnp.int32)
         self._caps_dirty = True
+        self.spill = None
         self.last_stats: dict = self.stats()
         if prefix_cache:
             from .prefix_cache import PrefixCache
             self.prefix_cache = PrefixCache(
                 self.g.cache.allocator, self.g.page_size,
                 min_pages=flags.flag("prefix_cache_min_pages"))
-            self._cow_jit = jax.jit(_cow_copy_pages, donate_argnums=(0, 1))
+            self._cow_jit = jax.jit(_cow_copy_pages, donate_argnums=(0,))
             # warm the copy program with an all-no-op call so the first
             # cache hit (and every later one) stays zero-recompile
             none = jnp.full((B,), -1, jnp.int32)
-            self.g.cache.update(*self._cow_jit(*self.g.cache.arrays,
+            self.g.cache.update(*self._cow_jit(self.g.cache.arrays,
                                                none, none))
+            # ---- host-RAM spill tier (ISSUE 13): LRU-evicted prefix
+            # pages spill to a pinned-host ring instead of dropping, and
+            # admission swaps them back asynchronously — eviction becomes
+            # a DMA instead of a re-prefill
+            if kv_spill_pages is None:
+                kv_spill_pages = flags.flag("kv_spill_pages")
+            if kv_spill_pages and kv_spill_pages > 0:
+                from .kv_spill import HostSpillPool
+                self.spill = HostSpillPool(self.g.cache,
+                                           int(kv_spill_pages))
+                self.prefix_cache.set_spill(self.spill)
+                # warm the swap-in upload program (out-of-range page ->
+                # dropped scatter) so a warm swap-in never compiles
+                self.spill.warm()
+            self.last_stats = self.stats()
 
     # ---- public api ----
     def submit(self, prompt: Sequence[int],
@@ -1044,18 +1099,18 @@ class ContinuousBatchingEngine:
         step = g._step_jit(self.gen_cfg, T, track)
         if track:
             (self.tokens, self.positions, self.finished, _all_done,
-             self.counts, kc, vc, self.key, self._recent) = step(
-                g.params, *g.cache.arrays, tokens_in, jnp.asarray(ql),
+             self.counts, cache, self.key, self._recent) = step(
+                g.params, g.cache.arrays, tokens_in, jnp.asarray(ql),
                 self.positions, self.finished, dm, jnp.asarray(commit),
                 self.counts, self.budgets, self._bt_dev, self.key,
                 self._recent)
         else:
             (self.tokens, self.positions, self.finished, _all_done,
-             self.counts, kc, vc, self.key) = step(
-                g.params, *g.cache.arrays, tokens_in, jnp.asarray(ql),
+             self.counts, cache, self.key) = step(
+                g.params, g.cache.arrays, tokens_in, jnp.asarray(ql),
                 self.positions, self.finished, dm, jnp.asarray(commit),
                 self.counts, self.budgets, self._bt_dev, self.key)
-        g.cache.update(kc, vc)
+        g.cache.update(*cache)
         # host dispatch timestamp rides the pending window: the drain
         # stamps TTFT/ITL per committed token from it — dispatch-side
         # wall clock, no device sync
@@ -1114,7 +1169,7 @@ class ContinuousBatchingEngine:
             for i, (s, d) in enumerate(starting):
                 src[i], dst[i] = s, d
             self.g.cache.update(*self._cow_jit(
-                *self.g.cache.arrays, jnp.asarray(src), jnp.asarray(dst)))
+                self.g.cache.arrays, jnp.asarray(src), jnp.asarray(dst)))
             if self.attribution is not None:
                 self.attribution.stamp("cow_copy", 0)
 
@@ -1149,19 +1204,19 @@ class ContinuousBatchingEngine:
             hist, hist_len = self._hist.device_arrays()
             step = g._spec_jit(self.gen_cfg, spec.k, spec.ngram_max)
             (out, ncommit, dlen, self.tokens, self.positions, self.finished,
-             _all_done, self.counts, self._recent, kc, vc, self.key) = step(
-                g.params, *g.cache.arrays, self.tokens, self._recent, hist,
+             _all_done, self.counts, self._recent, cache, self.key) = step(
+                g.params, g.cache.arrays, self.tokens, self._recent, hist,
                 hist_len, self.positions, self.finished, self.counts,
                 self.budgets, write_caps, self._bt_dev, self.key)
         else:
             step = g._fused_jit(self.gen_cfg, spec.k)
             (out, ncommit, self.tokens, self.positions, self.finished,
-             _all_done, self.counts, kc, vc, self.key) = step(
-                g.params, *g.cache.arrays, self.tokens, self.positions,
+             _all_done, self.counts, cache, self.key) = step(
+                g.params, g.cache.arrays, self.tokens, self.positions,
                 self.finished, self.counts, self.budgets, write_caps,
                 self._bt_dev, self.key)
             dlen = None
-        g.cache.update(kc, vc)
+        g.cache.update(*cache)
         return out, ncommit, dlen
 
     # ---- serving telemetry ----
@@ -1169,10 +1224,16 @@ class ContinuousBatchingEngine:
         """Pool + prefix-cache telemetry (refreshed at every drain into
         ``last_stats``).  With the cache off, every prefix counter is 0."""
         s = self.g.cache.allocator.stats()
+        s["kv_cache_dtype"] = ("int8" if self.g.cache.quantized
+                               else str(self.g.cache.k.dtype))
         s["prefix_cache_enabled"] = self.prefix_cache is not None
         if self.prefix_cache is not None:
             s["prefix_cached_pages"] = self.prefix_cache.cached_pages()
             s["prefix_evictable_pages"] = self.prefix_cache.evictable_pages()
+            s["prefix_spilled_pages"] = self.prefix_cache.spilled_pages()
+        s["kv_spill_enabled"] = self.spill is not None
+        if self.spill is not None:
+            s.update(self.spill.stats())
         s["spec_decode_enabled"] = self.spec is not None
         if self.spec is not None:
             s["spec_mode"] = self.spec.mode
@@ -1486,7 +1547,15 @@ class ContinuousBatchingEngine:
             self.waiting.popleft()
             b = free.pop(0)
             if plan is not None:
-                cache.attach(plan)            # pin before any reclaim runs
+                try:
+                    # pin before any reclaim runs; spilled matches swap
+                    # back in here (host->device upload, dispatch-only)
+                    cache.attach(plan)
+                except MemoryError:
+                    # swap-in raced out of pages — retry next admission
+                    self.waiting.appendleft(req)
+                    free.insert(0, b)
+                    break
                 shared = [x.page for x in plan.nodes]
             else:
                 shared = ()

@@ -156,6 +156,15 @@ class PageAllocator:
         self._ref[p] = 1
         return p
 
+    def acquire_page(self) -> int:
+        """Allocate one standalone page carrying a single reference (the
+        spill tier's swap-in target; the holder releases it via
+        :meth:`release_page`).  Reclaims from the prefix cache under
+        pressure like any other allocation; raises MemoryError dry."""
+        p = self._alloc_page()
+        self.peak_in_use = max(self.peak_in_use, self.pages_in_use)
+        return p
+
     def _grow(self, seq_id: int, new_len: int) -> None:
         pages = self._pages[seq_id]
         need = -(-new_len // self.page_size)       # ceil
@@ -300,7 +309,15 @@ class PageAllocator:
 
 
 class PagedKVCache:
-    """Device KV pool for all layers + the allocator that addresses it."""
+    """Device KV pool for all layers + the allocator that addresses it.
+
+    ``dtype="int8"`` stores the pool quantized (the ISSUE 13 memory
+    plane): int8 pages with one fp32 absmax scale per (layer, kv-head,
+    page) riding in ``k_scale``/``v_scale``.  The ragged paged-attention
+    kernel dequantizes on its VMEM slot right after the DMA wait and the
+    engine's batched commit requantizes per page on the way in, so
+    nothing above the cache changes shape — the pool just holds ~4x more
+    tokens per HBM byte."""
 
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
                  num_kv_heads: int, head_dim: int, dtype="bfloat16"):
@@ -308,20 +325,53 @@ class PagedKVCache:
         self.page_size = page_size
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
-        dt = jnp.dtype(dtype)
+        self.quantized = str(dtype) == "int8"
         shape = (num_layers, num_kv_heads, num_pages, page_size, head_dim)
-        self.k = jnp.zeros(shape, dt)
-        self.v = jnp.zeros(shape, dt)
+        if self.quantized:
+            self.k = jnp.zeros(shape, jnp.int8)
+            self.v = jnp.zeros(shape, jnp.int8)
+            # all-zero pages dequantize to exactly 0 under any scale;
+            # 1.0 keeps untouched pages' dequant well-defined
+            self.k_scale = jnp.ones(shape[:3], jnp.float32)
+            self.v_scale = jnp.ones(shape[:3], jnp.float32)
+            # pool bytes saved vs an equal-page fp32 pool (K and V, minus
+            # the scale planes) — the capacity headroom the quantized
+            # plane buys at fixed HBM budget
+            per = num_layers * num_kv_heads * num_pages
+            saved = 2 * (per * page_size * head_dim * 3 - per * 4)
+            _serving_bump("kv.quant_bytes_saved", max(saved, 0))
+        else:
+            dt = jnp.dtype(dtype)
+            self.k = jnp.zeros(shape, dt)
+            self.v = jnp.zeros(shape, dt)
+            self.k_scale = None
+            self.v_scale = None
         self.allocator = PageAllocator(num_pages, page_size)
 
     @property
     def arrays(self):
+        """The donated device state of one engine step: ``(k, v)`` for a
+        float pool, ``(k, v, k_scale, v_scale)`` when quantized."""
+        if self.quantized:
+            return self.k, self.v, self.k_scale, self.v_scale
         return self.k, self.v
 
-    def update(self, k, v) -> None:
+    def update(self, k, v, k_scale=None, v_scale=None) -> None:
         """Store the cache arrays returned by a jitted (donating) step."""
         self.k, self.v = k, v
+        if self.quantized:
+            self.k_scale, self.v_scale = k_scale, v_scale
 
     @staticmethod
     def pages_for(max_batch: int, max_seq_len: int, page_size: int) -> int:
         return max_batch * (-(-max_seq_len // page_size))
+
+    @staticmethod
+    def bytes_per_page(num_layers: int, num_kv_heads: int, page_size: int,
+                       head_dim: int, dtype="bfloat16") -> int:
+        """HBM bytes one pool page costs (K + V + scales, all layers) —
+        the unit the kv_quant bench equalizes across dtype arms."""
+        per = num_layers * num_kv_heads
+        if str(dtype) == "int8":
+            return 2 * per * (page_size * head_dim + 4)
+        return 2 * per * page_size * head_dim * jnp.dtype(dtype).itemsize

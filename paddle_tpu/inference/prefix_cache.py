@@ -109,10 +109,18 @@ class _Node:
     admission, or the producer that inserted it); ``ready`` flips once
     the producer's prefill has dispatched the page's KV writes.  A node
     with ``active == 0`` and ``ready`` sits in the LRU idle pool.
+
+    With a spill tier attached (ISSUE 13), an evicted node may be
+    *spilled* instead of dropped: ``page`` becomes -1 and ``spill``
+    holds its host-ring slot until an admission match swaps it back in.
+    Spill only happens to idle device-leaves (nodes whose children, if
+    any, are themselves spilled), so a spilled node's children are
+    always spilled and a matched chain's spilled nodes form a
+    contiguous tail run.
     """
 
     __slots__ = ("tokens", "page", "end", "parent", "children", "active",
-                 "ready", "chain")
+                 "ready", "chain", "spill")
 
     def __init__(self, tokens: Tuple[int, ...], page: int, end: int,
                  parent: Optional["_Node"]):
@@ -123,6 +131,7 @@ class _Node:
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.active = 0
         self.ready = False
+        self.spill = None          # host-ring slot id when spilled
         # root-path chain digest: membership in a residency digest implies
         # the whole prefix up to ``end`` is resident (see block_hashes)
         self.chain = _chain(parent.chain, tokens) if parent is not None \
@@ -165,7 +174,17 @@ class PrefixCache:
         self._seq_pending: Dict[int, List[_Node]] = {}
         # idle pool: insertion order IS the LRU order (oldest first)
         self._idle: Dict[_Node, None] = {}
+        # spill tier (ISSUE 13): evicted-but-host-resident nodes, same
+        # insertion-order-is-LRU idiom; None until set_spill() attaches
+        self._spill_pool = None
+        self._spilled: Dict[_Node, None] = {}
         allocator.set_reclaimer(self._reclaim, self.evictable_pages)
+
+    def set_spill(self, pool) -> None:
+        """Attach a :class:`~paddle_tpu.inference.kv_spill.HostSpillPool`:
+        LRU evictions now demote pages to host RAM instead of dropping
+        them, and admission matches on spilled nodes swap them back."""
+        self._spill_pool = pool
 
     # ------------------------------------------------------------- lookup
     def plan(self, tokens: Sequence[int]) -> MatchPlan:
@@ -194,27 +213,55 @@ class PrefixCache:
             start = n - 1
         if len(nodes) < self.min_pages:
             nodes, start, cow = [], 0, False
-        fresh = -(-n // page) - len(nodes) + (1 if cow else 0)
+        # spilled matches (a contiguous tail run of the chain) each need
+        # a fresh device page for their swap-in; they are NOT evictable
+        # supply (no device page to reclaim)
+        n_spilled = sum(1 for x in nodes if x.spill is not None)
+        fresh = -(-n // page) - len(nodes) + (1 if cow else 0) + n_spilled
         wait = [x for x in nodes if not x.ready]
-        idle_matched = sum(1 for x in nodes if x.active == 0)
+        idle_matched = sum(1 for x in nodes
+                           if x.active == 0 and x.spill is None)
         return MatchPlan(nodes, start, cow, fresh, wait, idle_matched)
 
     # ------------------------------------------------- admission lifecycle
     def attach(self, plan: MatchPlan) -> None:
         """Pin the matched chain BEFORE allocating fresh pages, so the
         allocator's reclaim pass cannot evict pages this admission is
-        about to share."""
+        about to share.  Spilled matches are swapped back in HERE (after
+        the whole chain is pinned, so the swap-in's own page allocation
+        cannot reclaim a node this admission needs): a fresh device page
+        is acquired and the host bytes upload as one dispatched program,
+        ordered before the consumer's first prefill chunk by dispatch
+        order alone.  Raises MemoryError (after unpinning) if the pool
+        cannot supply the swap-in page — callers retry the admission."""
         for x in plan.nodes:
             if x.active == 0:
                 self._idle.pop(x, None)
             x.active += 1
+        try:
+            for x in plan.nodes:
+                if x.spill is not None:
+                    self._swap_in(x)
+        except MemoryError:
+            self.detach(plan)
+            raise
 
     def detach(self, plan: MatchPlan) -> None:
-        """Undo :meth:`attach` (allocation-failure rollback path)."""
+        """Undo :meth:`attach` (allocation-failure rollback path).  A
+        node swapped in by attach stays live-idle — its KV is back on
+        device and valid; a still-spilled node stays in the spill LRU."""
         for x in plan.nodes:
             x.active -= 1
-            if x.active == 0 and x.ready:
+            if x.active == 0 and x.ready and x.spill is None:
                 self._idle[x] = None
+
+    def _swap_in(self, x: _Node) -> None:
+        """Promote a spilled node back to a live device page."""
+        page = self.alloc.acquire_page()
+        self._spill_pool.swap_in(x.spill, page)
+        self._spilled.pop(x, None)
+        x.page = page
+        x.spill = None
 
     def admit(self, seq_id: int, tokens: Sequence[int],
               plan: MatchPlan) -> List[Tuple[int, int]]:
@@ -279,18 +326,27 @@ class PrefixCache:
     def evictable_pages(self) -> int:
         """Exact count of pages `_reclaim` could free right now.  A
         sequence always references a root-chain prefix, so every idle
-        node's subtree is idle: the idle pool is fully reclaimable."""
+        node's subtree is idle or spilled: reclaim drains the idle pool
+        completely, device-leaf-first (spilled descendants hold no
+        device page and never block their ancestors)."""
         return len(self._idle)
 
     def cached_pages(self) -> int:
-        """Pages the index currently pins (idle + in active use)."""
+        """DEVICE pages the index currently pins (idle + in active use);
+        spilled nodes hold host bytes, not pool pages — see
+        :meth:`spilled_pages`."""
         n = 0
         stack = [self._root]
         while stack:
             x = stack.pop()
             stack.extend(x.children.values())
-            n += 1
+            if x.spill is None:
+                n += 1
         return n - 1                     # minus the root sentinel
+
+    def spilled_pages(self) -> int:
+        """Indexed pages currently demoted to the host spill ring."""
+        return len(self._spilled)
 
     def digest(self, max_entries: int = 4096) -> List[str]:
         """Residency digest: chain hashes (hex) of up to ``max_entries``
@@ -328,7 +384,12 @@ class PrefixCache:
         while freed < n and progress:
             progress = False
             for x in list(self._idle):   # insertion order = oldest first
-                if x.children:           # interior: wait for its leaves
+                # interior: wait for its leaves.  A child demoted to the
+                # spill ring holds no device page, so a node whose whole
+                # child set is spilled is a device-leaf — evicting it
+                # frees a page (and its spilled subtree stays matchable
+                # behind it until ring pressure or a drop retires it)
+                if any(c.spill is None for c in x.children.values()):
                     continue
                 self._evict(x)
                 freed += 1
@@ -339,9 +400,45 @@ class PrefixCache:
 
     def _evict(self, x: _Node) -> None:
         del self._idle[x]
+        pool = self._spill_pool
+        if pool is not None and self.alloc.ref_count(x.page) == 1:
+            # demote to host RAM instead of dropping: the device page
+            # (cache-exclusively held, or spilling would free nothing)
+            # returns to the free list, the node stays indexed
+            slot = pool.spill(x.page)
+            if slot is None:
+                # ring full: drop the coldest unpinned spilled node
+                # (strictly colder than the page being demoted) and
+                # reuse its slot.  Pinned-but-not-yet-swapped nodes of
+                # an in-flight admission are never victims.
+                victim = next((s for s in self._spilled
+                               if s.active == 0), None)
+                if victim is not None:
+                    self._unlink(victim)
+                    slot = pool.spill(x.page)
+            if slot is not None:
+                self.alloc.release_page(x.page)
+                x.page = -1
+                x.spill = slot
+                self._spilled[x] = None
+                self.alloc.record_evictions(1)
+                return
         self._unlink(x)
         self.alloc.record_evictions(1)
 
     def _unlink(self, x: _Node) -> None:
+        # a dropped node orphans its subtree; live children cannot exist
+        # here (reclaim is device-leaf-first, ring victims and never-
+        # ready nodes are childless-or-spilled), but a spilled subtree's
+        # host slots must be retired with it or they leak
+        for c in list(x.children.values()):
+            self._unlink(c)
         del x.parent.children[x.tokens]
-        self.alloc.release_page(x.page)
+        if x.spill is not None:
+            # spilled: no device page to release — retire the host slot
+            # (the no-leak / no-double-free contract of the spill tier)
+            self._spilled.pop(x, None)
+            self._spill_pool.free_slot(x.spill)
+            x.spill = None
+        else:
+            self.alloc.release_page(x.page)

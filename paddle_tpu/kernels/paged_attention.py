@@ -102,13 +102,16 @@ def _reference_paged_attention(q, k_cache, v_cache, block_tables,
 
 def _reference_ragged_paged_attention(q, k_cache, v_cache, block_tables,
                                       context_lens, q_lens=None, k_new=None,
-                                      v_new=None):
+                                      v_new=None, k_scale=None, v_scale=None):
     """XLA oracle for the mixed prefill+decode form.
 
     q: [B, T, qh, d]; k_new/v_new: [B, T, kvh, d] — the step's fresh rows,
     attended with an intra-step causal mask on top of the cached context.
     Rows with token index >= q_lens[b] are don't-care (garbage-but-finite,
-    exactly like the kernel).  Returns (out [B, T, qh, d], lse [B, T, qh]).
+    exactly like the kernel).  With ``k_scale``/``v_scale`` (int8 pool,
+    one fp32 per (kv-head, page)) gathered pages are dequantized before
+    the math — the same dequant the kernel does on its VMEM slot.
+    Returns (out [B, T, qh, d], lse [B, T, qh]).
     """
     b, t, qh, d = q.shape
     kvh, n_pages, page_size, _ = k_cache.shape
@@ -118,8 +121,15 @@ def _reference_ragged_paged_attention(q, k_cache, v_cache, block_tables,
     scale = 1.0 / math.sqrt(d)
 
     flat = block_tables.reshape(-1)
-    k = jnp.take(k_cache, flat, axis=1).reshape(kvh, b, S, d)
-    v = jnp.take(v_cache, flat, axis=1).reshape(kvh, b, S, d)
+    k = jnp.take(k_cache, flat, axis=1)        # [kvh, B*P, page, d]
+    v = jnp.take(v_cache, flat, axis=1)
+    if k_scale is not None:
+        k = k.astype(jnp.float32) * jnp.take(
+            k_scale.astype(jnp.float32), flat, axis=1)[..., None, None]
+        v = v.astype(jnp.float32) * jnp.take(
+            v_scale.astype(jnp.float32), flat, axis=1)[..., None, None]
+    k = k.reshape(kvh, b, S, d)
+    v = v.reshape(kvh, b, S, d)
 
     qg = q.reshape(b, t, kvh, group, d).astype(jnp.float32)
     s = jnp.einsum("btkgd,kbsd->btkgs", qg, k.astype(jnp.float32)) * scale
@@ -151,13 +161,19 @@ def _reference_ragged_paged_attention(q, k_cache, v_cache, block_tables,
 # ---------------------------------------------------------------- kernel ---
 
 def _ragged_paged_attn_kernel(*refs, page_size, ppc, scale, t, group,
-                              has_new):
+                              has_new, quantized=False):
     """One (sequence, kv_head, page_chunk) program.
 
     Double-buffered page loop over this chunk's live pages (slot = absolute
     page index % 2, so the prefetch chain crosses chunk boundaries); the
     final chunk folds the step's fresh K/V rows with a causal mask and
     normalizes.
+
+    ``quantized`` (int8 pool): the DMA moves the page's int8 bytes (4x
+    fewer than fp32) and the per-(kv-head, page) fp32 scale rides in as a
+    VMEM-resident row — dequant happens on the VMEM slot right after
+    ``wait()``, so the online-softmax math stays fp32 and nothing above
+    the kernel changes shape.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -167,6 +183,8 @@ def _ragged_paged_attn_kernel(*refs, page_size, ppc, scale, t, group,
     q_ref = next(it)
     knew_ref = next(it) if has_new else None
     vnew_ref = next(it) if has_new else None
+    ksc_ref = next(it) if quantized else None
+    vsc_ref = next(it) if quantized else None
     k_hbm, v_hbm = next(it), next(it)
     o_ref, lse_ref = next(it), next(it)
     kbuf, vbuf, sem = next(it), next(it), next(it)
@@ -242,6 +260,10 @@ def _ragged_paged_attn_kernel(*refs, page_size, ppc, scale, t, group,
             v_copy(p, slot).wait()
             k = kbuf[slot].astype(jnp.float32)                 # [page, d]
             v = vbuf[slot].astype(jnp.float32)
+            if quantized:   # static: dequant on the VMEM slot post-wait
+                pid = bt_ref[b, p]
+                k = k * ksc_ref[h, pid]      # SMEM scalar load, dynamic id
+                v = v * vsc_ref[h, pid]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             pos = p * page_size + jax.lax.broadcasted_iota(
@@ -276,7 +298,7 @@ def _ragged_paged_attn_kernel(*refs, page_size, ppc, scale, t, group,
 
 def _pallas_ragged_paged_attention(q, k_cache, v_cache, block_tables,
                                    context_lens, q_lens, k_new, v_new,
-                                   interpret):
+                                   interpret, k_scale=None, v_scale=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -319,13 +341,23 @@ def _pallas_ragged_paged_attention(q, k_cache, v_cache, block_tables,
                             lambda b_, h, c, *_: (b_, h, _I0, _I0))
         operands += [kn, vn]
         in_specs += [spec, spec]
+    quantized = k_scale is not None
+    if quantized:
+        # one fp32 per (kv-head, page), SMEM-resident (kvh * n_pages * 4
+        # bytes): scalar loads at [head, page id] — the same dynamic-
+        # index shape as the scalar-prefetched block table
+        sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
+        in_specs += [sspec, sspec]
     operands += [k_cache, v_cache]
     in_specs += [pl.BlockSpec(memory_space=pltpu.ANY),
                  pl.BlockSpec(memory_space=pltpu.ANY)]
 
     kernel = functools.partial(
         _ragged_paged_attn_kernel, page_size=page_size, ppc=ppc,
-        scale=1.0 / math.sqrt(d), t=t, group=group, has_new=has_new)
+        scale=1.0 / math.sqrt(d), t=t, group=group, has_new=has_new,
+        quantized=quantized)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, kvh, n_chunks),
@@ -365,7 +397,7 @@ def _pallas_ragged_paged_attention(q, k_cache, v_cache, block_tables,
 
 def ragged_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
                            *, q_lens=None, k_new=None, v_new=None,
-                           with_lse=False):
+                           k_scale=None, v_scale=None, with_lse=False):
     """Mixed-mode serving attention: prefill chunks and decode tokens in one
     call over a paged KV cache.
 
@@ -385,6 +417,11 @@ def ragged_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
                     KV rows, folded in with a causal mask (token j attends
                     new tokens <= j).  They need not be written to the
                     cache before the call; commit them after the step.
+      k_scale/v_scale: [num_kv_heads, num_pages] fp32 — per-(kv-head,
+                    page) dequant scales of an int8 cache pool.  Pages
+                    are dequantized inside the kernel (on the VMEM slot,
+                    right after the DMA wait) — nothing downstream
+                    changes shape.
       with_lse:     also return the per-query logsumexp [batch, T, q_heads]
                     (fp32) for online-softmax merging of extra keys.
 
@@ -396,18 +433,25 @@ def ragged_paged_attention(q, k_cache, v_cache, block_tables, context_lens,
         raise ValueError(f"q heads ({qh}) must be a multiple of kv heads ({kvh})")
     if (k_new is None) != (v_new is None):
         raise ValueError("k_new and v_new must be given together")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
     on_tpu = jax.default_backend() == "tpu"
     interpret = flags.flag("paged_attention_interpret")
-    # f32 sublane is 8; bf16 packs 16 — page_size must tile the sublane dim
+    # f32 sublane is 8; bf16 packs 16 — page_size must tile the sublane
+    # dim.  int8 packs 32 sublanes per tile, so a quantized pool needs
+    # page_size % 32 == 0 to keep each page a whole-tile DMA.
     ok = page_size % 8 == 0 and d % 128 in (0, 64)
+    if k_scale is not None:
+        ok = ok and page_size % 32 == 0
     if (on_tpu or interpret) and ok:
         out, lse = _pallas_ragged_paged_attention(
             q, k_cache, v_cache, block_tables, context_lens, q_lens,
-            k_new, v_new, interpret=not on_tpu)
+            k_new, v_new, interpret=not on_tpu, k_scale=k_scale,
+            v_scale=v_scale)
     else:
         out, lse = _reference_ragged_paged_attention(
             q, k_cache, v_cache, block_tables, context_lens, q_lens,
-            k_new, v_new)
+            k_new, v_new, k_scale=k_scale, v_scale=v_scale)
     return (out, lse) if with_lse else out
 
 
@@ -483,3 +527,116 @@ def write_kv_pages_all_layers(k_cache, v_cache, k_all, v_all, slot_mapping):
     flat_k = flat_k.at[:, :, safe].set(kn, mode="drop")
     flat_v = flat_v.at[:, :, safe].set(vn, mode="drop")
     return (flat_k.reshape(k_cache.shape), flat_v.reshape(v_cache.shape))
+
+
+def _requantize_pages(flat, fresh, lslot, new_scale_shape):
+    """Shared K/V half of the quantized commit: scatter fresh fp32 rows
+    into the dequantized gathered pages, recompute each page's absmax
+    scale, requantize.  ``flat``: [L, kvh, G*page, d] fp32 (G gathered
+    pages); returns (int8 pages [L, kvh, G, page, d], scales [L, kvh, G]).
+    """
+    L, kvh, _, d = flat.shape
+    G, page = new_scale_shape
+    flat = flat.at[:, :, lslot].set(fresh, mode="drop")
+    pages = flat.reshape(L, kvh, G, page, d)
+    amax = jnp.max(jnp.abs(pages), axis=(3, 4))            # [L, kvh, G]
+    scales = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(pages / scales[..., None, None]),
+                 -127.0, 127.0).astype(jnp.int8)
+    return q, scales
+
+
+def write_kv_pages_all_layers_quantized(k_cache, v_cache, k_scale, v_scale,
+                                        k_all, v_all, positions, q_lens,
+                                        block_tables, max_len):
+    """The int8 pool's batched all-layer commit: quantize fresh K/V per
+    page on the way in (EQuARX-style blockwise int8 + fp32 absmax scales,
+    one scale per (layer, kv-head, page)).
+
+    Because the scale is page-granular, the commit is a page-level
+    read-modify-write: gather the pages this step's tokens land in,
+    dequantize with the old scales, insert the fresh fp32 rows, recompute
+    each page's absmax scale, requantize, and scatter pages + scales
+    back.  Rows never share a write page (COW privatizes shared pages
+    before any write), so per-row page windows cannot collide.  Rounding
+    is round-to-nearest — the commit is bit-deterministic, and a page
+    whose scale did not change requantizes its old rows to exactly the
+    same int8 bytes.
+
+    Rows of a touched page PAST the sequence's post-step extent are
+    zeroed before the absmax: a recycled page may still hold a previous
+    occupant's bytes (pages are never scrubbed on free), and without the
+    mask a large-magnitude predecessor would inflate the new occupant's
+    scale arbitrarily — the stale region is unreachable through
+    ``context_lens`` anyway, so zeroing it is free and keeps the error
+    bound relative to the page's OWN live content.
+
+    k_cache/v_cache: [L, kvh, n_pages, page, d] int8; k_scale/v_scale:
+    [L, kvh, n_pages] fp32; k_all/v_all: [L, B*T, kvh, d] fresh rows;
+    positions/q_lens: [B] (write cursor / valid tokens per row);
+    block_tables: [B, W].  Returns the four updated arrays.
+    """
+    L, kvh, n_pages, page, d = k_cache.shape
+    B, W = block_tables.shape
+    T = k_all.shape[1] // B
+    # a T-token run starting anywhere in a page straddles at most Pmax
+    # pages; gathering exactly that window keeps the RMW O(B * Pmax)
+    Pmax = 1 + (max(T - 1, 0) + page - 1) // page
+
+    pos0 = positions.astype(jnp.int32)
+    offs = jnp.arange(T, dtype=jnp.int32)
+    pos = pos0[:, None] + offs[None, :]                    # [B, T]
+    pos_c = jnp.minimum(pos, max_len - 1)
+    valid = jnp.logical_and(offs[None, :] < q_lens[:, None],
+                            pos < max_len)                 # [B, T]
+    first = jnp.minimum(pos0, max_len - 1) // page         # [B]
+
+    # touched pages per row: page-list indices [first, first + npg)
+    ntok = jnp.sum(valid.astype(jnp.int32), axis=1)        # [B]
+    off0 = jnp.minimum(pos0, max_len - 1) % page
+    npg = jnp.where(ntok > 0, (off0 + ntok + page - 1) // page, 0)
+    j = jnp.arange(Pmax, dtype=jnp.int32)
+    touched = j[None, :] < npg[:, None]                    # [B, Pmax]
+    plist = jnp.minimum(first[:, None] + j[None, :], W - 1)
+    page_ids = jnp.take_along_axis(block_tables.astype(jnp.int32),
+                                   plist, axis=1)          # [B, Pmax]
+    flat_pid = jnp.where(touched, page_ids, n_pages).reshape(-1)
+    safe_pid = jnp.minimum(flat_pid, n_pages - 1)
+
+    # gather + dequant the write window
+    kg = jnp.take(k_cache, safe_pid, axis=2)   # [L, kvh, B*Pmax, page, d]
+    vg = jnp.take(v_cache, safe_pid, axis=2)
+    ksg = jnp.take(k_scale, safe_pid, axis=2)  # [L, kvh, B*Pmax]
+    vsg = jnp.take(v_scale, safe_pid, axis=2)
+    # live-extent mask: row r of window page j holds a valid token iff
+    # its global position is below the sequence's post-step extent —
+    # everything past it is a recycled page's stale bytes, zeroed so it
+    # cannot inflate the absmax scale of the new occupant's rows
+    r = jnp.arange(page, dtype=jnp.int32)
+    gpos = ((first[:, None] + j[None, :]) * page)[:, :, None] \
+        + r[None, None, :]                                 # [B, Pmax, page]
+    live = (gpos < (pos0 + ntok)[:, None, None]).reshape(
+        1, 1, B * Pmax * page, 1).astype(jnp.float32)
+    kf = (kg.astype(jnp.float32) * ksg[..., None, None]).reshape(
+        L, kvh, B * Pmax * page, d) * live
+    vf = (vg.astype(jnp.float32) * vsg[..., None, None]).reshape(
+        L, kvh, B * Pmax * page, d) * live
+
+    # fresh rows land at window-local slots (invalid tokens -> drop)
+    b_ix = jnp.arange(B, dtype=jnp.int32)[:, None]
+    rel = pos_c // page - first[:, None]                   # [B, T]
+    lslot = jnp.where(valid,
+                      (b_ix * Pmax + rel) * page + pos_c % page,
+                      B * Pmax * page).reshape(B * T)
+    kn = jnp.swapaxes(k_all, 1, 2).astype(jnp.float32)     # [L, kvh, B*T, d]
+    vn = jnp.swapaxes(v_all, 1, 2).astype(jnp.float32)
+
+    kq, ks_new = _requantize_pages(kf, kn, lslot, (B * Pmax, page))
+    vq, vs_new = _requantize_pages(vf, vn, lslot, (B * Pmax, page))
+
+    # untouched window entries were routed to n_pages: scatter drops them
+    k_cache = k_cache.at[:, :, flat_pid].set(kq, mode="drop")
+    v_cache = v_cache.at[:, :, flat_pid].set(vq, mode="drop")
+    k_scale = k_scale.at[:, :, flat_pid].set(ks_new, mode="drop")
+    v_scale = v_scale.at[:, :, flat_pid].set(vs_new, mode="drop")
+    return k_cache, v_cache, k_scale, v_scale

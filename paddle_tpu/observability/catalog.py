@@ -75,6 +75,19 @@ CATALOG: Dict[str, tuple] = {
         "counter", "", "copy-on-write page privatizations"),
     "serving.evicted_pages": (
         "counter", "", "cached pages reclaimed under memory pressure"),
+    # ---- serving: quantized KV plane + host spill tier (PR 13) ----
+    "serving.kv.quant_bytes_saved": (
+        "counter", "", "pool bytes the int8 KV plane saves vs an "
+        "equal-page fp32 pool (stamped once per cache construction)"),
+    "serving.kv.spilled_pages": (
+        "counter", "", "LRU-evicted prefix-cache pages demoted to the "
+        "pinned-host-RAM spill ring instead of dropped"),
+    "serving.kv.swapins": (
+        "counter", "", "spilled pages swapped back into the device pool "
+        "by an admission match"),
+    "serving.kv.swapin_wait_ms": (
+        "histogram", "", "host time dispatching one spilled page's "
+        "swap-in upload (dispatch-only; no device sync)"),
     # ---- serving: speculative decoding (PR 9) ----
     "serving.spec.drafted_tokens": (
         "counter", "", "draft tokens dispatched for verification"),

@@ -153,6 +153,72 @@ class TestPagedAttentionMosaic:
                     q_, kc, vc, bt_, cl_, ql_, kn_, vn_, False)[0],
             q, k_cache, v_cache, bt, cl, ql, kn, vn)
 
+    def _int8_cache(self):
+        """int8 KV pool + per-(kv-head, page) fp32 scales (ISSUE 13)."""
+        rng = np.random.default_rng(7)
+        kc = jnp.asarray(rng.integers(
+            -127, 128, (self.kvh, self.n_pages, self.page_size, self.d)),
+            jnp.int8)
+        vc = jnp.asarray(rng.integers(
+            -127, 128, (self.kvh, self.n_pages, self.page_size, self.d)),
+            jnp.int8)
+        ks = jnp.asarray(rng.uniform(0.005, 0.02,
+                                     (self.kvh, self.n_pages)), jnp.float32)
+        vs = jnp.asarray(rng.uniform(0.005, 0.02,
+                                     (self.kvh, self.n_pages)), jnp.float32)
+        bt = jnp.zeros((self.b, self.max_pages), jnp.int32)
+        cl = jnp.full((self.b,), 40, jnp.int32)
+        return kc, vc, ks, vs, bt, cl
+
+    @pytest.mark.parametrize("T,ql", [(1, (1, 1)),     # pure decode
+                                      (4, (4, 1)),     # T=K spec verify
+                                      (16, (16, 3))])  # prefill chunk
+    def test_int8_kernel_all_serving_modes(self, T, ql):
+        """ISSUE 13: cross-lower the int8 ragged kernel in every serving
+        program shape — decode T=1, the T=K verify bucket and a ragged
+        prefill chunk — so the chip-capture queue isn't blocked on a
+        lowering surprise (the SMEM scale load at a dynamic page id is
+        exactly the construct interpret mode cannot exercise)."""
+        from paddle_tpu.kernels.paged_attention import \
+            _pallas_ragged_paged_attention
+
+        kc, vc, ks, vs, bt, cl = self._int8_cache()
+        q = _rand((self.b, T, self.qh, self.d), jnp.float32)
+        qlv = jnp.asarray(ql, jnp.int32)
+        kn = _rand((self.b, T, self.kvh, self.d), jnp.float32, seed=3)
+        vn = _rand((self.b, T, self.kvh, self.d), jnp.float32, seed=4)
+        _export_tpu(
+            lambda q_, kc_, vc_, bt_, cl_, ql_, kn_, vn_, ks_, vs_:
+                _pallas_ragged_paged_attention(
+                    q_, kc_, vc_, bt_, cl_, ql_, kn_, vn_, False,
+                    ks_, vs_)[0],
+            q, kc, vc, bt, cl, qlv, kn, vn, ks, vs)
+
+    def test_int8_quantized_commit_lowering(self):
+        """The page-RMW quantized commit must also reach the chip: lower
+        the all-layer gather->dequant->insert->requant->scatter program
+        over an int8 pool at the decode shape."""
+        from paddle_tpu.kernels.paged_attention import \
+            write_kv_pages_all_layers_quantized
+
+        L, B, T = 2, self.b, 1
+        rng = np.random.default_rng(9)
+        kc = jnp.asarray(rng.integers(
+            -127, 128,
+            (L, self.kvh, self.n_pages, self.page_size, self.d)), jnp.int8)
+        vc = jnp.asarray(kc)
+        ks = jnp.ones((L, self.kvh, self.n_pages), jnp.float32)
+        vs = jnp.ones((L, self.kvh, self.n_pages), jnp.float32)
+        k_all = _rand((L, B * T, self.kvh, self.d), jnp.float32)
+        v_all = _rand((L, B * T, self.kvh, self.d), jnp.float32, seed=5)
+        pos = jnp.asarray([40, 33], jnp.int32)
+        qlv = jnp.ones((B,), jnp.int32)
+        bt = jnp.zeros((B, self.max_pages), jnp.int32)
+        _export_tpu(
+            lambda *a: write_kv_pages_all_layers_quantized(
+                *a, self.max_pages * self.page_size),
+            kc, vc, ks, vs, k_all, v_all, pos, qlv, bt)
+
     @pytest.mark.parametrize("K", [4, 8])
     def test_spec_verify_bucket_kernel(self, K):
         """ISSUE 9: the speculative verify step runs the mixed-mode

@@ -109,6 +109,49 @@ def test_jl001_alias_reuse_covers_every_kernel():
     assert len(ctx.findings) == 2
 
 
+def test_jl001_scale_indexing_bug_shape():
+    """ISSUE 13: the int8 dequant path indexes an SMEM scale row at a
+    page id derived in-kernel — a bare python-int in that derivation is
+    exactly the Mosaic i64 class JL001 exists for.  The fixture mirrors
+    the bug shape (python-int divisor feeding the scale index, plus a
+    raw int literal in the fallback index) and must fire; the np.int32
+    discipline of the real kernel must stay quiet."""
+    bad = """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import pallas as pl
+
+        def _dequant_kernel(sc_ref, bt_ref, x_ref, o_ref):
+            b = pl.program_id(0)
+            p = bt_ref[b, b // 2]            # JL001: python-int divisor
+            s = sc_ref[b, p]
+            o_ref[...] = x_ref[...].astype(jnp.float32) * s
+
+        def entry(sc, bt, x):
+            return pl.pallas_call(_dequant_kernel, out_shape=x)(sc, bt, x)
+    """
+    ctx = lint(bad, select={"JL001"})
+    assert len(ctx.findings) == 1 and "//" in ctx.findings[0].message
+
+    good = """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import pallas as pl
+
+        def _dequant_kernel(sc_ref, bt_ref, x_ref, o_ref):
+            b = pl.program_id(0)
+            p = bt_ref[b, jax.lax.div(b, np.int32(2))]
+            s = sc_ref[b, p]
+            o_ref[...] = x_ref[...].astype(jnp.float32) * s
+
+        def entry(sc, bt, x):
+            return pl.pallas_call(_dequant_kernel, out_shape=x)(sc, bt, x)
+    """
+    assert lint(good, select={"JL001"}).findings == []
+
+
 def test_jl001_resolves_partial_alias():
     src = """
         import functools
