@@ -1490,13 +1490,15 @@ def _run_fleet_chaos(on_tpu):
     (hysteresis + cooldown) and grows the fleet to 3; a seeded fault
     plan SIGKILLs a replica mid-stream (crash-restart converges back);
     then the idle cool-down drains the fleet to 1 via the graceful
-    drain protocol.  The contract stamps are the product: zero
-    client-visible hard failures beyond the synthesized-error shape,
-    survivor streams bit-identical to a direct-engine oracle, the
-    fleet back at target within the backoff budget, and the steady
-    warm window at 0 compiles.  (Throughput is stamped observationally
-    — churn makes it workload-shaped, so it is deliberately named
-    outside the gate's *_per_sec class.)"""
+    drain protocol.  The contract stamps are the product: ZERO loss
+    (ISSUE 14 — the killed replica's greedy streams RESUME on
+    survivors via the router's replay journal and bit-match the
+    no-fault oracle: 0 synthesized-error streams, 0 hard failures,
+    stamped as migration_zero_loss_match), the fleet back at target
+    within the backoff budget, digest DELTA sync carrying the polls,
+    and the steady warm window at 0 compiles.  (Throughput is stamped
+    observationally — churn makes it workload-shaped, so it is
+    deliberately named outside the gate's *_per_sec class.)"""
     import asyncio
     import json as _json
 
@@ -1531,10 +1533,14 @@ def _run_fleet_chaos(on_tpu):
 
     # oracle: every prompt's greedy output from a direct engine run
     def _engine():
+        # prefix cache ON (ISSUE 14): journal replays land as prefix
+        # hits, drain migration has an index to import into, and the
+        # router's polls exercise digest delta sync
         return ContinuousBatchingEngine(
             model, max_batch=slots,
             gen=GenerationConfig(max_new_tokens=budget),
-            max_seq_len=max_seq, page_size=page, prefill_bucket=bucket)
+            max_seq_len=max_seq, page_size=page, prefill_bucket=bucket,
+            prefix_cache=True)
 
     oracle_eng = _engine()
     rids = [oracle_eng.add_request(list(p)) for p in prompts]
@@ -1700,6 +1706,26 @@ def _run_fleet_chaos(on_tpu):
         "fleet_chaos_synth_errors": verdicts["synth_error"],
         "fleet_chaos_survivor_bit_match": verdicts["ok"] >= 1 and
             verdicts["ok"] + verdicts["synth_error"] == n_req,
+        # ISSUE 14: a mid-stream SIGKILL RESUMES the greedy stream on a
+        # survivor — every stream bit-matches the no-fault oracle, zero
+        # synthesized errors, zero hard failures
+        "fleet_chaos_resumed_streams": int(m.counter(
+            "router.resumes", outcome="resumed").value),
+        "fleet_chaos_migration_zero_loss_match": bool(
+            out.get("killed_mid_stream"))
+            and verdicts["synth_error"] == 0
+            and verdicts["hard_failure"] == 0
+            and verdicts["ok"] == n_req
+            and int(m.counter("router.resumes",
+                              outcome="resumed").value) >= 1,
+        "fleet_chaos_digest_delta_syncs": int(m.counter(
+            "router.digest_sync", mode="delta").value),
+        "fleet_chaos_digest_full_syncs": int(m.counter(
+            "router.digest_sync", mode="full").value),
+        "fleet_chaos_migrations_ok": int(m.counter(
+            "fleet.migrations", outcome="ok").value),
+        "fleet_chaos_migrations_skipped": int(m.counter(
+            "fleet.migrations", outcome="skipped").value),
         "fleet_chaos_converged_match":
             out.get("replicas_final") == 1,
         "fleet_chaos_restarts": int(m.counter(

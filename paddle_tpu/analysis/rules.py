@@ -16,6 +16,21 @@ from typing import Dict, List, Optional, Set, Tuple
 from . import astutil as A
 from .core import ModuleInfo, Rule, RunContext, register
 
+# the asyncio serving plane: modules that share one event loop per
+# process (JL005/JL007 scope).  The session-transfer module
+# (inference/migration.py, ISSUE 14) is included even though it is
+# sync today — its functions are invoked from the /migratez handlers'
+# executor seam, and an async def creeping in there would block the
+# front door exactly like one in serving/ proper.
+_ASYNC_PLANE = ("/serving/", "/router/", "/fleet/",
+                "/inference/migration")
+
+
+def _in_async_plane(rel: str) -> bool:
+    r = "/" + rel.replace("\\", "/")
+    return any(p in r for p in _ASYNC_PLANE)
+
+
 # modules whose function bodies are the serving/train hot path: the
 # JL002 sync discipline applies here (everywhere else the eager
 # Paddle-API compat layer legitimately syncs on user request)
@@ -490,9 +505,7 @@ class AsyncBlockingCall(Rule):
                        "write_bytes"}
 
     def visit(self, mod: ModuleInfo, ctx: RunContext) -> None:
-        r = "/" + mod.rel.replace("\\", "/")
-        if "/serving/" not in r and "/router/" not in r \
-                and "/fleet/" not in r:
+        if not _in_async_plane(mod.rel):
             return
         for fn in ast.walk(mod.tree):
             if not isinstance(fn, ast.AsyncFunctionDef):
@@ -616,9 +629,7 @@ class EngineSingleOwner(Rule):
     _ENGINE_SEGMENTS = {"engine", "_engine"}
 
     def visit(self, mod: ModuleInfo, ctx: RunContext) -> None:
-        r = "/" + mod.rel.replace("\\", "/")
-        if "/serving/" not in r and "/router/" not in r \
-                and "/fleet/" not in r:
+        if not _in_async_plane(mod.rel):
             return
         for fn in ast.walk(mod.tree):
             if not isinstance(fn, ast.AsyncFunctionDef):

@@ -290,6 +290,38 @@ define_flag("fleet_scale_cooldown_s", 30.0,
 define_flag("fleet_tick_interval_s", 1.0,
             "Seconds between fleet-supervisor control-loop ticks when "
             "run_forever paces itself (tests tick explicitly).")
+define_flag("fleet_migrate_on_drain", True,
+            "Session-continuity migration (ISSUE 14): when the fleet "
+            "supervisor drains a replica for scale-down, the victim "
+            "exports its live sessions' KV pages to a supervisor-chosen "
+            "READY successor (inference/migration.py) before admission "
+            "closes, so the sessions' next turns / failover resumes hit "
+            "the successor's prefix cache instead of re-prefilling.  "
+            "Best-effort: a failed migration never blocks the drain.")
+define_flag("router_failover_resume", True,
+            "Journaled failover resume (ISSUE 14): an unplanned replica "
+            "death mid-stream re-places the session on a survivor and "
+            "REPLAYS its emitted tokens as a prefill (prefix-cache hits "
+            "make the replay cheap), continuing the client's SSE stream "
+            "with no synthesized error — greedy sessions only (replay "
+            "is bit-exact there).  Post-dispatch unary deaths re-run "
+            "the same way instead of 502.  Off restores the PR 7 "
+            "synthesized-error failover contract.")
+define_flag("router_journal_cap", 512,
+            "Max in-flight requests the router's replay journal tracks "
+            "(LRU; an evicted entry's stream falls back to the "
+            "synthesized-error failover path).")
+define_flag("router_journal_max_tokens", 4096,
+            "Per-request cap on journaled emitted tokens: a stream that "
+            "outgrows it is marked non-resumable (bounded memory; the "
+            "synthesized-error contract still applies to it).")
+define_flag("prefix_digest_log", 4096,
+            "Capacity of the prefix cache's digest change log (adds/"
+            "evictions per epoch) backing /statusz digest DELTA sync: a "
+            "router polling with digest_since gets only the changes "
+            "since its confirmed epoch instead of the full re-shipped "
+            "set; a request older than the log forces a full resync.  "
+            "0 disables delta sync (every poll ships the full set).")
 define_flag("flight_recorder_min_interval_s", 30.0,
             "Per-REASON rate limit on flight-recorder dumps: repeat dumps "
             "with the same reason inside this window are suppressed "

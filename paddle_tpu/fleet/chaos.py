@@ -25,6 +25,13 @@ Fault kinds and their real-world shapes:
   the replica itself stays healthy (the dropped-TCP shape).
 - ``throttle`` / ``unthrottle`` — slow frames: each response line is
   delayed ``arg`` seconds (degraded network / overloaded replica).
+- ``migrate_interrupt`` / ``partial_transfer`` — drain-migration faults
+  (ISSUE 14): a one-shot marker on the target's HANDLE consumed by its
+  next drain migration — ``migrate_interrupt`` kills the transfer
+  between export and import (nothing installed anywhere),
+  ``partial_transfer`` truncates every snapshot's page list mid-flight
+  (the importer must install the shorter contiguous chain and leak no
+  allocator refs).  Both leave the drain itself intact.
 
 Transport faults ride :class:`ChaosClient`, a ``ReplicaClient`` wrapper
 the router speaks through (``ChaosController.wrap`` is the
@@ -43,7 +50,8 @@ __all__ = ["FaultEvent", "ChaosPlan", "ChaosClient", "ChaosController",
            "KINDS"]
 
 KINDS = ("kill", "wedge", "unwedge", "refuse", "allow", "poll_timeout",
-         "poll_ok", "cut", "throttle", "unthrottle")
+         "poll_ok", "cut", "throttle", "unthrottle",
+         "migrate_interrupt", "partial_transfer")
 # (fault, recovery) pairs the seeded generator schedules together so a
 # generated plan never leaves a replica permanently faulted by accident
 _PAIRED = {"wedge": "unwedge", "refuse": "allow",
@@ -265,6 +273,12 @@ class ChaosController:
         elif e.kind == "unthrottle":
             if client is not None:
                 client.frame_delay_s = 0.0
+        elif e.kind == "migrate_interrupt":
+            if handle is not None:
+                handle._chaos_migrate = "interrupt"
+        elif e.kind == "partial_transfer":
+            if handle is not None:
+                handle._chaos_migrate = "partial"
 
     def advance(self, tick: int) -> List[FaultEvent]:
         applied: List[FaultEvent] = []

@@ -67,10 +67,14 @@ class _FleetMetrics:
     """Registry handles resolved once (the PR 5 idiom)."""
 
     __slots__ = ("replicas", "target", "restarts", "crashes", "scale",
-                 "drains")
+                 "drains", "migrations", "migrated_pages")
 
     def __init__(self):
         m = _obs.metrics
+        # jaxlint: disable=JL006 -- bounded by construction: outcome callers pass ok/skipped/failed literals
+        self.migrations = lambda o: m.counter("fleet.migrations",
+                                              outcome=o)
+        self.migrated_pages = m.counter("fleet.migrated_pages")
         # the lambda-param labels below are bounded by construction:
         # every caller passes a literal or a _STATES member
         # jaxlint: disable=JL006 -- bounded by construction: states are the _STATES tuple
@@ -121,6 +125,18 @@ class ReplicaHandle:
         raise NotImplementedError
 
     def kill(self) -> None:
+        raise NotImplementedError
+
+    # ---- session migration (ISSUE 14): optional per-transport ----
+    def export_sessions(self) -> list:
+        """Snapshot every live session's KV on this replica (the drain
+        victim side).  Transports without a migration path raise
+        NotImplementedError — the supervisor counts the drain migration
+        ``skipped`` and proceeds with a plain drain."""
+        raise NotImplementedError
+
+    def import_sessions(self, snaps: list) -> dict:
+        """Install exported snapshots on this replica (successor side)."""
         raise NotImplementedError
 
     def describe(self) -> dict:
@@ -227,6 +243,16 @@ class InprocReplicaHandle(ReplicaHandle):
         elif self.server is not None:
             self.server.close()
 
+    def export_sessions(self) -> list:
+        if self.server is None:
+            return []
+        return self.server.export_sessions()
+
+    def import_sessions(self, snaps: list) -> dict:
+        if self.server is None:
+            raise RuntimeError(f"replica {self.id} has no server")
+        return self.server.import_sessions(snaps)
+
 
 class ProcessReplicaHandle(ReplicaHandle):
     """A real ``paddle-tpu-serve`` subprocess on ``host:port``
@@ -304,6 +330,36 @@ class ProcessReplicaHandle(ReplicaHandle):
             self.proc.kill()
             self.proc.wait()
 
+    def _post_json(self, path: str, doc: dict,
+                   timeout_s: float = 15.0) -> dict:
+        import http.client
+        import json as _json
+        body = _json.dumps(doc).encode()
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout_s)
+        try:
+            conn.request("POST", path, body=body,
+                         headers={"Content-Type": "application/json",
+                                  "Content-Length": str(len(body))})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"{path} -> {resp.status}: {data[:200]!r}")
+            return _json.loads(data.decode())
+        finally:
+            conn.close()
+
+    def export_sessions(self) -> list:
+        # page payloads ride base64 JSON over the replica's /migratez
+        # endpoints; the timeout is generous relative to probes (the
+        # readbacks are control-path syncs, not dispatches)
+        return self._post_json("/migratez/export",
+                               {"all": True}).get("sessions", [])
+
+    def import_sessions(self, snaps: list) -> dict:
+        return self._post_json("/migratez/import", {"sessions": snaps})
+
     def suspend(self) -> None:
         """SIGSTOP (the chaos harness's wedge on a real process)."""
         if self.alive():
@@ -367,6 +423,7 @@ class FleetSupervisor:
                  cooldown_s: Optional[float] = None,
                  scale_up_load: Optional[float] = None,
                  scale_down_load: Optional[float] = None,
+                 migrate_on_drain: Optional[bool] = None,
                  on_spawn: Optional[Callable[[ReplicaHandle],
                                              None]] = None,
                  clock: Callable[[], float] = time.monotonic):
@@ -409,6 +466,9 @@ class FleetSupervisor:
         self.scale_down_load = float(f("fleet_scale_down_load")
                                      if scale_down_load is None
                                      else scale_down_load)
+        self.migrate_on_drain = bool(f("fleet_migrate_on_drain")
+                                     if migrate_on_drain is None
+                                     else migrate_on_drain)
         self._clock = clock
         self._slots: List[_Slot] = []
         self._next_slot = 0
@@ -628,9 +688,92 @@ class FleetSupervisor:
 
     def _begin_drain(self, slot: _Slot, now: float) -> None:
         self.router.mark_draining(slot.handle.id, True)
+        if self.migrate_on_drain:
+            # ISSUE 14: ship the victim's live sessions' KV to a READY
+            # successor BEFORE admission closes — scale-down becomes a
+            # DMA instead of a re-prefill when those sessions' next
+            # turns (or failover resumes) land on the successor.  Best
+            # effort by design: a failed migration never blocks the
+            # drain (the sessions still finish out on the victim).
+            # The transfer runs inline in THIS tick, bounded by the
+            # transport timeouts (2 x 15s worst case on the HTTP path):
+            # a wedged victim costs the control loop one delayed beat,
+            # after which crash/wedge handling resumes normally.
+            self._migrate_out(slot)
         slot.handle.begin_drain()
         slot.state = DRAINING
         slot.deadline = now + self.drain_timeout_s
+
+    # ------------------------------------- drain migration (ISSUE 14) --
+    def _pick_successor(self, victim: _Slot) -> Optional[_Slot]:
+        """Where the victim's sessions go: the least-loaded READY slot
+        other than the victim (the same load view scale-down uses)."""
+        ready = [s for s in self._slots
+                 if s is not victim and s.state == READY]
+        if not ready:
+            return None
+
+        def load(slot: _Slot) -> int:
+            rs = self._router_state(slot.handle.id)
+            return rs.load() if rs is not None else 0
+
+        return min(ready, key=load)
+
+    def _migrate_out(self, victim: _Slot) -> Optional[dict]:
+        succ = self._pick_successor(victim)
+        # chaos seam (fleet/chaos.py migrate_interrupt/partial_transfer):
+        # one-shot fault markers consumed by exactly one migration
+        fault = getattr(victim.handle, "_chaos_migrate", None)
+        victim.handle._chaos_migrate = None
+        try:
+            if succ is None:
+                self._m.migrations("skipped").inc()
+                return None
+            snaps = victim.handle.export_sessions()
+            if fault == "interrupt":
+                # the transfer dies between export and import (the
+                # victim exited / network cut): nothing installed, no
+                # refs leaked anywhere — the drain proceeds bare
+                raise RuntimeError("chaos: migrate_interrupt")
+            if fault == "partial":
+                # a truncated transfer: each snapshot loses the tail of
+                # its page list mid-flight — the import must install
+                # the shorter contiguous chain and leak nothing
+                snaps = [{**s, "pages": s["pages"][:len(s["pages"]) // 2]}
+                         for s in snaps]
+            if not snaps:
+                self._m.migrations("skipped").inc()
+                return None
+            result = succ.handle.import_sessions(snaps)
+            if not result.get("sessions") and result.get("aborted"):
+                # the successor installed NOTHING (per-snapshot aborts
+                # across the board — e.g. a geometry/dtype mismatch in
+                # a mixed fleet): that is a failed migration, not a
+                # success with zero pages
+                self._m.migrations("failed").inc()
+            else:
+                self._m.migrations("ok").inc()
+                self._m.migrated_pages.inc(int(result.get("imported", 0)))
+            return result
+        except NotImplementedError:
+            self._m.migrations("skipped").inc()
+            return None
+        except Exception as e:
+            from ..inference.migration import MigrationError
+            if isinstance(e, MigrationError):
+                # structurally unsupported (successor has no prefix
+                # cache / geometry mismatch): not a transfer failure
+                self._m.migrations("skipped").inc()
+                return None
+            # MigrationError (no prefix cache / geometry mismatch),
+            # transport death, chaos interrupt: count it, drain anyway
+            import sys
+            print(f"[paddle_tpu fleet] drain migration "
+                  f"{victim.handle.id} -> "
+                  f"{succ.handle.id if succ else '?'} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            self._m.migrations("failed").inc()
+            return None
 
     # ---------------------------------------------------------- status --
     def converged(self) -> bool:

@@ -23,6 +23,8 @@ from .generation import (ContinuousBatchingEngine, GenerationConfig,
                          LlamaGenerator, Request, generate)
 from .kv_cache import PagedKVCache, PageAllocator
 from .kv_spill import HostSpillPool
+from .migration import (MigrationError, export_session, import_session,
+                        import_sessions)
 from .prefix_cache import PrefixCache, serving_stats
 from .speculative import SpecConfig, SpecHistory, resolve_spec_config
 
@@ -32,6 +34,8 @@ __all__ = [
     "ContinuousBatchingEngine", "Request",
     "PagedKVCache", "PageAllocator", "PrefixCache", "serving_stats",
     "HostSpillPool",
+    "MigrationError", "export_session", "import_session",
+    "import_sessions",
     "SpecConfig", "SpecHistory", "resolve_spec_config",
 ]
 

@@ -718,7 +718,7 @@ class _ServingMetrics:
                  "occupancy", "steps", "drains", "pages_in_use",
                  "peak_pages", "active_seqs", "cached_pages",
                  "evictable_pages", "spec_drafted", "spec_accepted",
-                 "spec_rejected", "accept_len")
+                 "spec_rejected", "accept_len", "digest_epoch")
 
     def __init__(self):
         m = _obs.metrics
@@ -748,6 +748,7 @@ class _ServingMetrics:
         self.active_seqs = m.gauge("serving.active_seqs")
         self.cached_pages = m.gauge("serving.prefix_cached_pages")
         self.evictable_pages = m.gauge("serving.prefix_evictable_pages")
+        self.digest_epoch = m.gauge("serving.prefix_digest_epoch")
 
     def update_pool(self, stats: dict) -> None:
         """Fold the allocator/prefix-cache gauges in from engine.stats()
@@ -758,6 +759,7 @@ class _ServingMetrics:
         if "prefix_cached_pages" in stats:
             self.cached_pages.set(stats["prefix_cached_pages"])
             self.evictable_pages.set(stats["prefix_evictable_pages"])
+            self.digest_epoch.set(stats.get("prefix_digest_epoch", 0))
 
 
 class ContinuousBatchingEngine:
@@ -1231,6 +1233,12 @@ class ContinuousBatchingEngine:
             s["prefix_cached_pages"] = self.prefix_cache.cached_pages()
             s["prefix_evictable_pages"] = self.prefix_cache.evictable_pages()
             s["prefix_spilled_pages"] = self.prefix_cache.spilled_pages()
+            s["prefix_digest_epoch"] = self.prefix_cache.digest_epoch
+        # session-migration books (ISSUE 14; present once the engine has
+        # exported or imported at least one snapshot)
+        mc = getattr(self, "_migration_counts", None)
+        if mc is not None:
+            s.update(mc)
         s["kv_spill_enabled"] = self.spill is not None
         if self.spill is not None:
             s.update(self.spill.stats())
@@ -1273,20 +1281,43 @@ class ContinuousBatchingEngine:
         rows.sort(key=lambda r: -(r["age_s"] or 0.0))
         return rows[:top_k]
 
-    def prefix_digest(self, max_entries: Optional[int] = None):
+    def prefix_digest(self, max_entries: Optional[int] = None,
+                      since: Optional[str] = None):
         """Prefix-residency digest for router placement (ISSUE 7): the
         chain hashes of this engine's indexed KV pages plus the page
         geometry a router needs to compute matching hashes for an
         incoming prompt (``prefix_cache.block_hashes``).  ``None`` with
         the cache off — a digest-less replica scores zero expected hits
-        and degrades to pure load-based placement."""
-        if self.prefix_cache is None:
+        and degrades to pure load-based placement.
+
+        ``since="<gen>:<epoch>"`` (ISSUE 14 delta sync) asks for only
+        the adds/evictions after a previously confirmed epoch: the
+        answer is ``mode="delta"`` with ``adds``/``dels`` lists when the
+        change log still covers that epoch and the generation nonce
+        matches this cache instance, else ``mode="full"`` with the
+        whole (truncated) set — the caller resyncs and re-confirms."""
+        cache = self.prefix_cache
+        if cache is None:
             return None
         if max_entries is None:
             max_entries = flags.flag("router_digest_max")
-        return {"page_size": self.g.page_size,
-                "algo": "blake2b8-chain",
-                "hashes": self.prefix_cache.digest(max_entries)}
+        out = {"page_size": self.g.page_size,
+               "algo": "blake2b8-chain",
+               "gen": cache.digest_gen,
+               "epoch": cache.digest_epoch}
+        if since:
+            gen, _, ep = str(since).partition(":")
+            if gen == cache.digest_gen:
+                try:
+                    delta = cache.digest_delta(int(ep))
+                except ValueError:
+                    delta = None
+                if delta is not None:
+                    adds, dels = delta
+                    out.update(mode="delta", adds=adds, dels=dels)
+                    return out
+        out.update(mode="full", hashes=cache.digest(max_entries))
+        return out
 
     # ---- drain: the ONLY host<->device sync of the steady state ----
     def _drain(self) -> List[Request]:

@@ -132,6 +132,13 @@ class HostSpillPool:
         self.swapins += 1
         self._c_swapins.inc()
 
+    def peek(self, slot: int) -> Tuple[np.ndarray, ...]:
+        """Read a spilled page's host planes WITHOUT retiring the slot
+        (session migration, ISSUE 14: a spilled prefix page ships its
+        host-ring bytes to the successor directly — no swap-in, no
+        device round-trip)."""
+        return self._slots[slot]
+
     def free_slot(self, slot: int) -> None:
         """Retire a spilled page without swapping it in (its node was
         dropped from the index — ring pressure or trie unlink)."""

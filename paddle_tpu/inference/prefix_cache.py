@@ -41,6 +41,8 @@ this module runs unless ``FLAGS_prefix_cache`` (or the engine's
 from __future__ import annotations
 
 import hashlib
+import os
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 # process-wide serving telemetry lives in the observability registry
@@ -178,7 +180,57 @@ class PrefixCache:
         # insertion-order-is-LRU idiom; None until set_spill() attaches
         self._spill_pool = None
         self._spilled: Dict[_Node, None] = {}
+        # digest DELTA sync (ISSUE 14): every index membership change
+        # (insert / unlink) bumps ``digest_epoch`` and lands in a bounded
+        # change log, so a router that confirmed epoch E gets only the
+        # adds/evictions since E instead of the full re-shipped set.
+        # ``digest_gen`` nonces the epoch space per cache instance — a
+        # restarted replica's epoch 50 is NOT the old process's epoch 50,
+        # and a gen mismatch forces a full resync.
+        from .. import flags as _flags
+        log_cap = int(_flags.flag("prefix_digest_log"))
+        self.digest_gen = f"{os.getpid():x}-{os.urandom(4).hex()}"
+        self.digest_epoch = 0
+        self._digest_log: "deque" = deque(maxlen=max(0, log_cap) or None)
+        self._digest_log_on = log_cap > 0
         allocator.set_reclaimer(self._reclaim, self.evictable_pages)
+
+    # ---------------------------------------------- digest delta (ISSUE 14)
+    def _log_digest(self, op: str, node: "_Node") -> None:
+        """Record one membership change (op '+'/'-') at a fresh epoch."""
+        self.digest_epoch += 1
+        if self._digest_log_on:
+            self._digest_log.append((self.digest_epoch, op,
+                                     node.chain.hex()))
+
+    def digest_delta(self, since: int):
+        """Adds/evictions since confirmed epoch ``since`` → ``(adds,
+        dels)`` hash-hex lists, or None when the delta is not servable
+        (epoch from another life, or older than the log covers — the
+        caller must fall back to a full-set resync).  Safe from the
+        statusz thread: iterates a GIL-atomic ``list()`` snapshot of the
+        log while the engine thread appends."""
+        since = int(since)
+        if since == self.digest_epoch:
+            return [], []
+        if since > self.digest_epoch or not self._digest_log_on:
+            return None
+        log = list(self._digest_log)
+        if not log or log[0][0] > since + 1:
+            return None                     # log no longer covers `since`
+        adds: Dict[str, None] = {}
+        dels: Dict[str, None] = {}
+        for epoch, op, h in log:
+            if epoch <= since:
+                continue
+            if op == "+":
+                dels.pop(h, None)
+                adds[h] = None
+            elif h in adds:
+                del adds[h]                 # added then evicted: net zero
+            else:
+                dels[h] = None
+        return list(adds), list(dels)
 
     def set_spill(self, pool) -> None:
         """Attach a :class:`~paddle_tpu.inference.kv_spill.HostSpillPool`:
@@ -294,11 +346,51 @@ class PrefixCache:
             alloc.retain(pages[pi])      # the cache's own reference
             parent.children[key] = node
             node.active = 1              # the producer holds it
+            self._log_digest("+", node)
             pending.append(node)
             parent = node
         self._seq_nodes[seq_id] = held + pending
         self._seq_pending[seq_id] = list(pending)
         return cow_pairs
+
+    def chain(self, tokens: Sequence[int]) -> List[_Node]:
+        """The longest root-chain of indexed nodes matching ``tokens``
+        page-by-page — the raw trie walk, with none of :meth:`plan`'s
+        admission policy (no min_pages, no COW).  The session-migration
+        plane (inference/migration.py) exports from and imports onto
+        this chain."""
+        page = self.page
+        node, out = self._root, []
+        i = 0
+        while i + page <= len(tokens):
+            child = node.children.get(tuple(tokens[i:i + page]))
+            if child is None:
+                break
+            out.append(child)
+            node = child
+            i += page
+        return out
+
+    def install_node(self, parent: Optional[_Node],
+                     key: Sequence[int], page: int) -> _Node:
+        """Index one imported KV page (session migration, ISSUE 14): a
+        READY, idle node under ``parent`` (None = root) whose allocator
+        reference is the one the caller just acquired via
+        ``acquire_page()`` — ownership transfers to the cache, and the
+        node lands in the LRU idle pool exactly like a retired
+        sequence's page (evictable under pressure, matchable by the
+        next admission).  Raises if the edge already exists (callers
+        skip existing nodes and keep walking)."""
+        parent = parent if parent is not None else self._root
+        key = tuple(int(t) for t in key)
+        if key in parent.children:
+            raise ValueError("node already indexed for this token block")
+        node = _Node(key, int(page), parent.end + self.page, parent)
+        node.ready = True
+        parent.children[key] = node
+        self._idle[node] = None
+        self._log_digest("+", node)
+        return node
 
     def note_progress(self, seq_id: int, tokens_done: int) -> None:
         """Producer's chunked prefill has dispatched writes for tokens
@@ -434,6 +526,7 @@ class PrefixCache:
         for c in list(x.children.values()):
             self._unlink(c)
         del x.parent.children[x.tokens]
+        self._log_digest("-", x)
         if x.spill is not None:
             # spilled: no device page to release — retire the host slot
             # (the no-leak / no-double-free contract of the spill tier)

@@ -67,6 +67,10 @@ CATALOG: Dict[str, tuple] = {
         "gauge", "", "radix-indexed shared KV pages"),
     "serving.prefix_evictable_pages": (
         "gauge", "", "idle cached pages the LRU pool could reclaim"),
+    "serving.prefix_digest_epoch": (
+        "gauge", "", "prefix-digest change epoch (ISSUE 14 delta sync: "
+        "every index insert/eviction bumps it; routers confirm an epoch "
+        "and poll for only the changes since)"),
     "serving.prefix_hits": (
         "counter", "", "admissions that attached a cached prefix"),
     "serving.prefix_tokens_saved": (
@@ -88,6 +92,22 @@ CATALOG: Dict[str, tuple] = {
     "serving.kv.swapin_wait_ms": (
         "histogram", "", "host time dispatching one spilled page's "
         "swap-in upload (dispatch-only; no device sync)"),
+    # ---- serving: session migration (ISSUE 14) ----
+    "serving.kv.migration_exports": (
+        "counter", "", "session snapshots exported (inference/"
+        "migration.py: raw pool bytes — int8 pages ship quantized, "
+        "spilled pages ship their host-ring bytes)"),
+    "serving.kv.migration_imports": (
+        "counter", "", "session snapshots imported and indexed as "
+        "ready prefix-cache pages via acquire_page + the pre-warmed "
+        "donating upload"),
+    "serving.kv.migration_pages": (
+        "counter", "direction=out|in", "KV pages moved by session "
+        "migration"),
+    "serving.kv.migration_aborts": (
+        "counter", "", "transfers that failed mid-flight (the in-flight "
+        "page's allocator ref is released; already-linked pages stay "
+        "valid cache entries)"),
     # ---- serving: speculative decoding (PR 9) ----
     "serving.spec.drafted_tokens": (
         "counter", "", "draft tokens dispatched for verification"),
@@ -143,6 +163,26 @@ CATALOG: Dict[str, tuple] = {
         "counter", "", "dead/suspect -> live replica transitions (each "
         "also lands as a router.replica_rejoin tracer instant; the "
         "rejoined replica's routed-overlay staleness is reset)"),
+    # ---- router: failover resume + digest delta sync (ISSUE 14) ----
+    "router.resumes": (
+        "counter", "outcome=resumed|unary|finished|ineligible|exhausted",
+        "journaled failover-resume outcomes: resumed = a dead stream "
+        "continued on a survivor (unbroken client stream), unary = a "
+        "post-dispatch unary death re-ran, finished = only the finish "
+        "frame was lost, ineligible = replay impossible (PR 7 "
+        "synthesized-error/502 contract applied), exhausted = replay "
+        "attempted but no survivor could finish it"),
+    "router.journal_entries": (
+        "gauge", "", "in-flight requests tracked by the replay journal"),
+    "router.journal_evictions": (
+        "counter", "", "journal entries LRU-evicted past "
+        "FLAGS_router_journal_cap (their streams fall back to the "
+        "synthesized-error contract)"),
+    "router.digest_sync": (
+        "counter", "mode=full|delta", "prefix-digest syncs by mode: "
+        "delta = only adds/evictions since the confirmed epoch rode "
+        "the poll; full = complete set re-ship (first poll, replica "
+        "restart, or change-log miss)"),
     # ---- fleet lifecycle supervisor (PR 12) ----
     "fleet.replicas": (
         "gauge", "state=starting|ready|draining|backoff|failed",
@@ -167,6 +207,16 @@ CATALOG: Dict[str, tuple] = {
         "graceful drains: clean (in-flight finished inside "
         "FLAGS_fleet_drain_timeout_s), timeout (bound expired, "
         "hard-killed), died (replica crashed mid-drain)"),
+    "fleet.migrations": (
+        "counter", "outcome=ok|skipped|failed",
+        "drain-triggered session migrations (ISSUE 14): ok = the "
+        "victim's live sessions shipped to the chosen successor, "
+        "skipped = nothing to ship / no successor / transport without "
+        "a migration path, failed = the transfer died mid-flight "
+        "(best-effort: never blocks the drain)"),
+    "fleet.migrated_pages": (
+        "counter", "", "KV pages installed on successors by "
+        "drain-triggered migrations"),
     # ---- regression sentinel (PR 10) ----
     "observability.anomaly": (
         "counter", "series=...,kind=drift|burst",

@@ -78,6 +78,16 @@ class ReplicaState:
         # placement inputs from the last successful /statusz
         self.digest: frozenset = frozenset()
         self.page_size: int = 0
+        # digest DELTA sync (ISSUE 14): the last confirmed epoch and its
+        # generation nonce — the next poll asks for only the changes
+        # since (gen, epoch); a gen mismatch or log miss ships the full
+        # set again and re-anchors here
+        self.digest_gen: Optional[str] = None
+        self.digest_epoch: int = -1
+        # failover-resume eligibility (ISSUE 14): replaying a journal is
+        # bit-exact only against a greedy replica (advertised in
+        # /statusz engine.sampling); unknown = not eligible
+        self.greedy = False
         self.queue_depth: int = 0       # waiting + busy slots, replica-side
         self.slo_decision: str = "admit"
         self.retry_after_s: int = 1
@@ -99,6 +109,14 @@ class ReplicaState:
     @property
     def draining(self) -> bool:
         return self.drain_pin or self.reported_draining
+
+    def statusz_path(self) -> str:
+        """The poll target: once an epoch is confirmed, ask for the
+        digest delta instead of the full set (ISSUE 14)."""
+        if self.digest_gen and self.digest_epoch >= 0:
+            return (f"/statusz?digest_since="
+                    f"{self.digest_gen}:{self.digest_epoch}")
+        return "/statusz"
 
     def status(self, dead_after: int) -> str:
         if not self.ok:
@@ -136,10 +154,33 @@ class ReplicaState:
         eng = doc.get("engine") or {}
         self.queue_depth = int(eng.get("waiting", 0) or 0) + \
             int(eng.get("slots_busy", 0) or 0)
+        samp = (eng.get("sampling") if isinstance(eng, dict) else None)
+        self.greedy = isinstance(samp, dict) and \
+            samp.get("do_sample") is False
         dig = doc.get("prefix_digest")
         if dig:
             self.page_size = int(dig.get("page_size", 0) or 0)
-            confirmed = frozenset(dig.get("hashes") or ())
+            gen = dig.get("gen")
+            is_delta = (str(dig.get("mode", "full")) == "delta"
+                        and gen is not None and gen == self.digest_gen)
+            if is_delta:
+                # apply adds/evictions since the confirmed epoch to the
+                # held set — the per-poll full-set re-ship is gone
+                confirmed = (self.digest
+                             | frozenset(dig.get("adds") or ())) \
+                    - frozenset(dig.get("dels") or ())
+            else:
+                # full resync: first poll, epoch from another replica
+                # life, or the replica's change log no longer covers us
+                confirmed = frozenset(dig.get("hashes") or ())
+            _obs.metrics.counter(
+                "router.digest_sync",
+                mode="delta" if is_delta else "full").inc()
+            self.digest_gen = gen
+            try:
+                self.digest_epoch = int(dig.get("epoch", -1))
+            except (TypeError, ValueError):
+                self.digest_epoch = -1
             self.digest = confirmed
             # overlay entries the index now confirms have served their
             # purpose; entries still unconfirmed after two full polls
@@ -148,13 +189,15 @@ class ReplicaState:
             # polls, not one: a credit from just before this poll may
             # predate its request's admission on the replica.
             self._poll_gen += 1
-            gen = self._poll_gen
+            poll_gen = self._poll_gen
             for h in [h for h, g in self.routed.items()
-                      if h in confirmed or gen - g >= 2]:
+                      if h in confirmed or poll_gen - g >= 2]:
                 del self.routed[h]
         else:
             self.digest = frozenset()
             self.routed.clear()
+            self.digest_gen = None
+            self.digest_epoch = -1
         anomalies = doc.get("anomalies")
         if isinstance(anomalies, dict):
             try:
@@ -224,7 +267,9 @@ class ReplicaState:
                 "last_poll_age_s": age,
                 "queue_depth": self.queue_depth,
                 "inflight": self.inflight,
+                "greedy": self.greedy,
                 "digest_entries": len(self.digest),
+                "digest_epoch": self.digest_epoch,
                 "routed_overlay": len(self.routed),
                 "page_size": self.page_size,
                 "slo": {"decision": self.slo_decision,

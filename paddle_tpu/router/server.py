@@ -31,15 +31,24 @@ so a recovered replica rejoins.  Without a background poll task (the
 tier-1 tests run one event loop per request), stale state refreshes
 inline before placement, so the router is correct, just lazier.
 
-Failover: a replica dying mid-conversation fails only its in-flight
-requests.  A connect-phase failure re-places the request on the
-next-best candidate (``router.failover{phase=connect}``); an upstream
-EOF after the SSE head is out terminates the client stream CLEANLY — a
-synthesized ``finish_reason: "error"`` chunk plus ``data: [DONE]``, the
-same shape a replica's own engine-crash path emits, never a silent
-truncation (``router.failover{phase=stream}``).  Unary upstream failure
-after dispatch is a 502 (the generation may have partially run — the
-router does not re-run it on another replica).
+Failover (ISSUE 14: journaled resume): a replica dying mid-conversation
+no longer has to cost the conversation.  A connect-phase failure
+re-places the request on the next-best candidate
+(``router.failover{phase=connect}``).  An upstream death AFTER dispatch
+consults the per-request replay journal (``router/journal.py``): for a
+journaled GREEDY session the router re-places on a survivor, replays
+the prompt plus every already-relayed token as a prefill (prefix-cache
+hits — and drain migration, layer 4 — make the replay a near no-op),
+and keeps relaying from the next token: the client sees ONE unbroken
+SSE stream, bit-identical to a no-fault run
+(``router.resumes{outcome=resumed}``).  Post-dispatch unary deaths
+re-run the same way (``outcome=unary``).  Only when replay is
+impossible — journal evicted/overflowed, sampled session, no greedy
+survivor — does the PR 7 contract apply: a synthesized
+``finish_reason: "error"`` chunk plus ``data: [DONE]`` for streams
+(never a silent truncation), 502 for unary
+(``router.failover{phase=stream}``, ``router.resumes{outcome=
+ineligible|exhausted}``).
 
 Fleet admission: per-replica SLO burn (the ``serving/slo.py`` windows,
 read from each ``/statusz``) aggregates at the router — when every live
@@ -60,6 +69,7 @@ from .. import flags
 from .. import observability as _obs
 from ..serving import http as _http
 from ..serving.slo import jittered_retry_after
+from .journal import SessionJournal
 from .placement import Placer, ReplicaState
 from .replica import ReplicaClient
 
@@ -74,7 +84,7 @@ class _RouterMetrics:
 
     __slots__ = ("requests", "streams", "responses", "inflight",
                  "request_ms", "failover", "shed", "slo_decision",
-                 "health_polls", "replicas_gauge")
+                 "health_polls", "replicas_gauge", "resumes")
 
     def __init__(self):
         m = _obs.metrics
@@ -90,6 +100,8 @@ class _RouterMetrics:
         # jaxlint: disable=JL006 -- bounded by construction: phase callers pass literals only
         self.failover = lambda phase: m.counter("router.failover",
                                                 phase=phase)
+        # jaxlint: disable=JL006 -- bounded by construction: outcome callers pass resumed/unary/finished/ineligible/exhausted literals
+        self.resumes = lambda o: m.counter("router.resumes", outcome=o)
         self.shed = m.counter("router.shed")
         # jaxlint: disable=JL006 -- bounded by construction: decision callers pass admit/queue/shed literals
         self.slo_decision = lambda d: m.counter("router.slo_decision",
@@ -137,6 +149,10 @@ class RouterServer:
                                     if poll_timeout_s is None
                                     else poll_timeout_s)
         self._m = _RouterMetrics()
+        # failover-resume journal (ISSUE 14): prompt + relayed tokens per
+        # in-flight request, replayed onto a survivor on unplanned death
+        self.journal = SessionJournal()
+        self._resume_on = bool(f("router_failover_resume"))
         self._t0 = time.perf_counter()
         self._next_rid = 0
         self._health_tasks: Dict[str, asyncio.Task] = {}
@@ -162,7 +178,8 @@ class RouterServer:
     async def poll_replica(self, state: ReplicaState) -> bool:
         """Poll one replica's /statusz into its placement view."""
         try:
-            doc = await self._get_json(state.client, "/statusz")
+            doc = await self._get_json(state.client,
+                                       state.statusz_path())
         except (Exception, asyncio.TimeoutError):
             state.mark_failed()
             self._m.health_polls("fail").inc()
@@ -507,8 +524,8 @@ class RouterServer:
         if stream:
             self._m.streams.inc()
         t_accept = time.perf_counter()
-        code = await self._proxy(trace_id, session_id, prompt, body,
-                                 candidates, writer, stream)
+        code = await self._proxy(trace_id, session_id, prompt, payload,
+                                 body, candidates, writer, stream)
         if _obs.TRACER.enabled:
             _obs.TRACER.event("router.request", t_accept,
                               time.perf_counter() - t_accept,
@@ -518,20 +535,68 @@ class RouterServer:
                                     "prompt_tokens": len(prompt)})
         return code
 
-    async def _proxy(self, trace_id, session_id, prompt, body,
+    def _resume_candidates(self, tried: List[str]) -> List[ReplicaState]:
+        """Fresh placement candidates for a replay: live, ready, not yet
+        tried this request, and GREEDY (journal replay is bit-exact only
+        under greedy sampling — a sampled replica cannot continue the
+        stream faithfully)."""
+        return [s for s in self._candidates()
+                if s.id not in tried and s.greedy]
+
+    async def _proxy(self, trace_id, session_id, prompt, payload, body,
                      candidates: List[ReplicaState], writer,
                      stream: bool = False) -> int:
-        """Place and relay, re-placing on connect-phase failure."""
+        """Place and relay; re-place on connect-phase failure; RESUME on
+        post-dispatch death (ISSUE 14).
+
+        An unplanned upstream death mid-SSE used to synthesize a
+        ``finish_reason: "error"`` termination; with the journal on, the
+        router re-places the session on a greedy survivor, replays the
+        prompt plus every token the client already received as a
+        prefill (drain migration / the prefix cache make that a near
+        no-op), and keeps relaying from the next token — the client
+        sees one unbroken stream.  A unary request that dies after
+        dispatch re-runs the same way (generation is side-effect-free
+        and greedy replay is bit-exact) instead of 502ing; 502 remains
+        only when replay is impossible — journal evicted/overflowed, an
+        unparseable prompt, or a sampled session with no seedable
+        replay."""
+        entry = None
+        if self._resume_on and prompt and isinstance(payload, dict):
+            entry = self.journal.begin(trace_id, session_id, prompt,
+                                       payload)
+        try:
+            return await self._proxy_dispatch(trace_id, session_id,
+                                              prompt, body, candidates,
+                                              writer, stream, entry)
+        finally:
+            # unconditional: a client disconnect (ConnectionResetError
+            # raising out of a relay write) must not strand the entry
+            # in the journal until LRU pressure pushes it out
+            self.journal.finish(entry)
+
+    async def _proxy_dispatch(self, trace_id, session_id, prompt, body,
+                              candidates: List[ReplicaState], writer,
+                              stream, entry) -> int:
         tried: List[str] = []
-        while candidates:
-            state, reason = self.placer.place(prompt, session_id,
+        head_sent = [False]           # flipped by _relay at the SSE head
+        resuming = False              # a replay body is in flight
+        unary_replayed = False
+        died_post_dispatch = False    # a death a replay COULD recover
+        max_attempts = 2 * max(1, len(self.states)) + 2
+        for _attempt in range(max_attempts):
+            if not candidates:
+                break
+            place_prompt = entry.full_tokens if resuming else prompt
+            state, reason = self.placer.place(place_prompt, session_id,
                                               candidates)
             tried.append(state.id)
             up = (("X-Trace-Id", trace_id),
                   ("X-Router-Reason", reason))
+            body_now = entry.resume_body() if resuming else body
             try:
                 up_reader, close = await state.client.open(
-                    "POST", "/v1/completions", headers=up, body=body)
+                    "POST", "/v1/completions", headers=up, body=body_now)
             except Exception:
                 # connect-phase death: this replica is out of the
                 # candidate set NOW; the request re-places on the rest
@@ -544,23 +609,117 @@ class RouterServer:
                 continue
             state.inflight += 1
             try:
-                return await self._relay(state, up_reader, trace_id,
-                                         writer, stream)
+                outcome, status = await self._relay(
+                    state, up_reader, trace_id, writer, stream,
+                    entry=entry, head_sent=head_sent)
             finally:
                 state.inflight -= 1
                 close()
+            if outcome == "done":
+                if status == 200:
+                    if resuming:
+                        self._m.resumes("resumed").inc()
+                    elif unary_replayed:
+                        self._m.resumes("unary").inc()
+                return status
+            if outcome == "resume_reject":
+                # a healthy replica refused the replay (shed/400) after
+                # the client's head was already out: try the next one
+                candidates = [s for s in candidates if s.id not in tried]
+                continue
+            # the upstream died post-dispatch ("dead_prehead": nothing
+            # reached the client; "dead_stream": mid-SSE, head is out)
+            self._export_replica_gauges()
+            if outcome == "dead_prehead" and stream and not head_sent[0]:
+                # stream died before its head: nothing was sent — a
+                # plain transparent re-place, no replay needed
+                candidates = [s for s in candidates if s.id not in tried]
+                continue
+            # post-dispatch death with client-visible state (mid-SSE) or
+            # a consumed unary dispatch: only a journal replay recovers
+            died_post_dispatch = True
+            if entry is None or not entry.resumable:
+                break
+            if stream:
+                rem = entry.remaining()
+                if rem is None:
+                    break             # undeclared budget: cannot bound
+                if rem <= 0:
+                    # every budgeted token was already delivered — only
+                    # the finish frame was lost: close the stream out.
+                    # (Known approximation: if the final budgeted token
+                    # was ALSO the EOS, the no-fault finish would say
+                    # "stop"; the router cannot know the eos id, so
+                    # budget exhaustion reports "length".)
+                    writer.write(_http.sse_event(self._finish_chunk(
+                        trace_id, "length")))
+                    writer.write(_http.sse_done())
+                    await writer.drain()
+                    self._m.resumes("finished").inc()
+                    return status if head_sent[0] else 200
+            resume_cands = self._resume_candidates(tried)
+            if not resume_cands:
+                break
+            candidates = resume_cands
+            if stream:
+                resuming = True
+            else:
+                unary_replayed = True   # full re-run of the original body
+            entry.resumes += 1
+        # out of candidates (or replay-ineligible): end the request the
+        # PR 7 way — synthesized error for an open stream, 502 otherwise
+        if head_sent[0]:
+            if self._resume_on:
+                self._m.resumes(
+                    "exhausted" if resuming else "ineligible").inc()
+            writer.write(_http.sse_event(self._finish_chunk(
+                trace_id, "error")))
+            writer.write(_http.sse_done())
+            await writer.drain()
+            return 200
+        if self._resume_on and died_post_dispatch:
+            self._m.resumes(
+                "exhausted" if unary_replayed else "ineligible").inc()
         writer.write(_http.error_response(
-            502, f"every candidate replica failed at connect "
-                 f"(tried {tried})", err_type="internal_error"))
+            502, f"every candidate replica failed "
+                 f"(tried {tried}; the request was not resumable)",
+            err_type="internal_error"))
         await writer.drain()
         return 502
 
+    def _finish_chunk(self, trace_id, finish_reason: str) -> dict:
+        return {"id": trace_id, "object": "text_completion.chunk",
+                "model": self.model_name,
+                "choices": [{"index": 0, "text": "", "token_ids": [],
+                             "finish_reason": finish_reason}]}
+
+    @staticmethod
+    def _frame_data(frame: bytes):
+        """The payload of one SSE frame's ``data:`` line (None when the
+        frame has no data line)."""
+        for ln in frame.splitlines():
+            if ln.startswith(b"data:"):
+                return ln[5:].strip()
+        return None
+
     async def _relay(self, state: ReplicaState, up, trace_id,
-                     writer, stream: bool = False) -> int:
-        """Forward one upstream response.  SSE streams frame-by-frame
-        with clean synthesized termination on upstream death; everything
-        else buffers per Content-Length (replica responses are always
-        close-delimited with an explicit length outside SSE)."""
+                     writer, stream: bool = False, entry=None,
+                     head_sent=None) -> Tuple[str, int]:
+        """Forward one upstream response; returns ``(outcome, status)``.
+
+        ``("done", status)`` — fully relayed.  ``("dead_prehead", 0)`` —
+        upstream died before anything reached the client (re-place or
+        replay; the dispatch may have run).  ``("dead_stream", status)``
+        — died mid-SSE with the head out (resume or synthesize).
+        ``("resume_reject", status)`` — a replay got a non-SSE answer
+        after the head was out (healthy refusal: try another survivor).
+
+        SSE relays whole frames: lines buffer until the blank-line
+        terminator and a frame is written (and its token ids journaled)
+        only when complete, so a death mid-frame never leaks a partial
+        event to the client — what the client holds is exactly what the
+        journal replays."""
+        head_sent = head_sent if head_sent is not None else [False]
         try:
             # a replica writes a STREAM head immediately at admission, so
             # a head slower than the poll timeout is the same wedge signal
@@ -574,64 +733,73 @@ class RouterServer:
             else:
                 status, headers, head_raw = await _read_head(up)
         except (Exception, asyncio.IncompleteReadError):
-            # died before the head: nothing reached the client yet
+            # died before the head: nothing new reached the client
             state.mark_failed()
             state.failovers += 1
             self._m.failover("stream").inc()
-            writer.write(_http.error_response(
-                502, f"replica {state.id} died before responding",
-                err_type="internal_error"))
-            await writer.drain()
-            return 502
+            return "dead_prehead", 0
         ctype = headers.get("content-type", "")
         if ctype.startswith("text/event-stream"):
-            # re-emit the head with the serving replica stamped on it
-            writer.write(_head_with(head_raw, (
-                ("X-Router-Replica", state.id),)))
-            await writer.drain()
+            if not head_sent[0]:
+                # re-emit the head with the serving replica stamped on
+                # it; on a RESUMED stream the client's head is already
+                # out and the new upstream's head is dropped
+                writer.write(_head_with(head_raw, (
+                    ("X-Router-Replica", state.id),)))
+                await writer.drain()
+                head_sent[0] = True
+            frame = bytearray()
             done_seen = False
-            tail = b"\n"              # the head ended cleanly on a boundary
+            died = False
             while True:
                 line = await up.readline()
                 if not line:          # close-delimited: EOF ends the body
+                    # an incomplete trailing frame is DISCARDED (never
+                    # reached the client, never journaled) — the stream
+                    # state stays consistent for the replay
+                    died = not done_seen
                     break
-                if line.strip() == b"data: [DONE]":
+                frame.extend(line)
+                if line not in (b"\n", b"\r\n"):
+                    continue
+                # one complete frame
+                data = self._frame_data(bytes(frame))
+                if data == b"[DONE]":
                     done_seen = True
-                writer.write(line)
-                tail = line
-                if line == b"\n":     # frame boundary: flush per event
+                    writer.write(bytes(frame))
                     await writer.drain()
-            # a death (or TCP segmentation at EOF) can end the relay
-            # mid-line or mid-frame — even AFTER the [DONE] line if its
-            # blank-line terminator was lost.  Close the last event out
-            # so whatever follows (the already-relayed [DONE], or the
-            # synthesized error chunk) parses as its own frame instead
-            # of gluing onto the wreckage.
-            repaired = False
-            if not tail.endswith(b"\n"):
-                writer.write(b"\n")
-                repaired = True
-            if tail.strip():
-                writer.write(b"\n")
-                repaired = True
-            if not done_seen:
-                # upstream died mid-stream: terminate CLEANLY — the same
-                # finish-reason shape a replica's own crash path emits,
-                # never a silent truncation the client mistakes for EOS
+                    frame.clear()
+                    continue
+                finish = None
+                toks = ()
+                if data is not None and entry is not None and \
+                        entry.resumable:
+                    try:
+                        choice = json.loads(data)["choices"][0]
+                        finish = choice.get("finish_reason")
+                        toks = choice.get("token_ids") or ()
+                    except (ValueError, KeyError, IndexError, TypeError):
+                        pass
+                if finish in ("error", "server_shutdown") and \
+                        self._resume_on and entry is not None and \
+                        entry.resumable:
+                    # the replica's own crash/shutdown retire path: the
+                    # transport survived but the session died — suppress
+                    # the error frame and resume instead of relaying it
+                    died = True
+                    break
+                if toks:
+                    self.journal.record(entry, toks)
+                writer.write(bytes(frame))
+                await writer.drain()
+                frame.clear()
+            if died:
                 state.mark_failed()
                 state.failovers += 1
                 self._m.failover("stream").inc()
-                writer.write(_http.sse_event(
-                    {"id": trace_id, "object": "text_completion.chunk",
-                     "model": self.model_name,
-                     "choices": [{"index": 0, "text": "", "token_ids": [],
-                                  "finish_reason": "error"}]}))
-                writer.write(_http.sse_done())
-                await writer.drain()
-            elif repaired:
-                await writer.drain()
-            return status
-        # unary / error document: bounded body per Content-Length
+                return "dead_stream", status
+            return "done", status
+        # non-SSE: unary completion or an error document, bounded body
         try:
             n = int(headers.get("content-length", "0"))
             body = await up.readexactly(n) if n else b""
@@ -639,16 +807,17 @@ class RouterServer:
             state.mark_failed()
             state.failovers += 1
             self._m.failover("stream").inc()
-            writer.write(_http.error_response(
-                502, f"replica {state.id} died mid-response "
-                     f"(the request may have partially run; not retried)",
-                err_type="internal_error"))
-            await writer.drain()
-            return 502
+            # the client has this response's bytes not at all (unary
+            # head+body are written together below): replayable
+            return "dead_prehead", 0
+        if head_sent[0]:
+            # a replay answered with a non-SSE document into an open
+            # event stream — a healthy refusal (shed, 400), not a death
+            return "resume_reject", status
         writer.write(_head_with(head_raw, (
             ("X-Router-Replica", state.id),)) + body)
         await writer.drain()
-        return status
+        return "done", status
 
     # ------------------------------------------------------------ status --
     def statusz(self) -> dict:
@@ -670,6 +839,16 @@ class RouterServer:
             # record tagged with the replica that reported it
             "anomalies": self._fleet_anomalies(),
             "sessions": self.placer.session_state(),
+            # failover-resume plane (ISSUE 14)
+            "resume": {
+                "enabled": self._resume_on,
+                "journal_entries": len(self.journal),
+                "journal_cap": self.journal.cap,
+                "outcomes": {o: int(_obs.metrics.counter(
+                    "router.resumes", outcome=o).value)
+                    for o in ("resumed", "unary", "finished",
+                              "ineligible", "exhausted")},
+            },
             "failover": {
                 "connect": int(_obs.metrics.counter(
                     "router.failover", phase="connect").value),

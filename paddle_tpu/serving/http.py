@@ -36,7 +36,8 @@ MAX_HEADERS = 64
 MAX_BODY = 8 * 1024 * 1024
 
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
-            405: "Method Not Allowed", 413: "Payload Too Large",
+            405: "Method Not Allowed", 409: "Conflict",
+            413: "Payload Too Large",
             500: "Internal Server Error", 502: "Bad Gateway",
             503: "Service Unavailable"}
 

@@ -152,6 +152,11 @@ class ServingServer:
         self._watchdog = watchdog     # CommTaskManager or None
         self._poll_s = poll_s
         self._inbox: "queue.SimpleQueue[_Stream]" = queue.SimpleQueue()
+        # engine control ops (ISSUE 14): arbitrary fn(engine) calls
+        # marshalled onto the engine thread between steps — the seam the
+        # session-migration endpoints and the fleet supervisor use to
+        # touch single-owner engine state without racing the step loop
+        self._control: "queue.SimpleQueue" = queue.SimpleQueue()
         self._live: List[_Stream] = []
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -281,6 +286,64 @@ class ServingServer:
         if self.flight_recorder is not None:
             self.flight_recorder.install(manager=self._watchdog, **kw)
 
+    # ------------------------------------------------- engine control ops --
+    def run_on_engine(self, fn, timeout_s: float = 30.0):
+        """Run ``fn(engine)`` ON the engine thread (between steps) and
+        return its result — the only sanctioned way for another thread
+        to touch engine state.  Blocking; call from the supervisor /
+        executor threads, never from the event loop directly (async
+        handlers go through ``run_in_executor``)."""
+        if not self.engine_alive():
+            raise RuntimeError("engine thread down")
+        box: dict = {}
+        done = threading.Event()
+        self._control.put((fn, box, done))
+        self._wake.set()
+        if not done.wait(timeout_s):
+            raise TimeoutError(
+                f"engine thread did not service the control op within "
+                f"{timeout_s}s")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _run_control(self, eng) -> None:
+        while True:
+            try:
+                fn, box, done = self._control.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                box["result"] = fn(eng)
+            except BaseException as e:
+                box["error"] = e
+            done.set()
+
+    def export_sessions(self) -> List[dict]:
+        """Snapshot every in-flight session's KV (ISSUE 14 drain
+        migration, victim side).  Thread-safe; runs on the engine
+        thread.  Works while draining — exporting the sessions a drain
+        is about to strand is exactly the point."""
+        from ..inference import migration as _mig
+        return self.run_on_engine(_mig.export_all)
+
+    def import_sessions(self, snaps: List[dict],
+                        resume: bool = False) -> dict:
+        """Install exported session snapshots into this replica's
+        prefix cache (successor side).  Raises MigrationError when the
+        engine has no prefix cache to index into."""
+        from ..inference import migration as _mig
+        if self.engine.prefix_cache is None:
+            raise _mig.MigrationError(
+                "import needs the prefix cache (FLAGS_prefix_cache) on "
+                "the successor replica")
+
+        def op(eng):
+            return _mig.import_sessions(
+                eng, [_mig.from_wire(s) for s in snaps], resume=resume)
+
+        return self.run_on_engine(op)
+
     async def start_http(self, host: str = "127.0.0.1", port: int = 0):
         """Bind a real socket listener (bench/production path; the tests
         drive ``handle`` over in-process transports instead).  Returns
@@ -325,6 +388,7 @@ class ServingServer:
                     h.req = eng.submit(h.prompt, h.max_new_tokens,
                                        trace_id=h.trace_id)
                     self._live.append(h)
+                self._run_control(eng)
                 if eng.has_work():
                     if wd is not None:
                         tid = wd.begin("serving.engine_step")
@@ -376,6 +440,15 @@ class ServingServer:
                     self._live.append(self._inbox.get_nowait())
                 except queue.Empty:
                     break
+            # fail queued control ops so their callers don't wait out
+            # the full timeout against a dead thread
+            while True:
+                try:
+                    _fn, box, done = self._control.get_nowait()
+                except queue.Empty:
+                    break
+                box["error"] = RuntimeError("engine thread down")
+                done.set()
             for h in list(self._live):
                 h.post(("done", {"finish_reason": finish,
                                  "n": len(h.req.output) if h.req else 0}))
@@ -405,6 +478,13 @@ class ServingServer:
         while not req.done and not self._stop.is_set():
             eng.step()
         eng.step()                        # idle tail-flush drain
+        if eng.prefix_cache is not None:
+            # compile the session-migration upload program too (ISSUE
+            # 14) so a live import/migration never compiles under
+            # routed traffic (with spill on this is a cache hit — the
+            # spill tier warmed the same program at engine init)
+            from ..inference import migration as _mig
+            _mig.warm(eng)
 
     def _publish(self) -> None:
         """Diff every live request's drained output; push fresh tokens."""
@@ -464,7 +544,7 @@ class ServingServer:
                 pass
 
     async def _route(self, method, path, headers, body, writer) -> int:
-        path = path.split("?", 1)[0]
+        path, _, query = path.partition("?")
         if path == "/drainz" and method == "POST":
             # the fleet supervisor's drain trigger (SIGTERM's HTTP twin):
             # stop admission NOW, report what is still in flight; the
@@ -503,19 +583,146 @@ class ServingServer:
             await writer.drain()
             return 200 if ready else 503
         if path == "/statusz" and method == "GET":
-            writer.write(_http.json_response(200, self.statusz()))
+            # digest DELTA sync (ISSUE 14): ?digest_since=<gen>:<epoch>
+            # asks for only the index changes since the caller's last
+            # confirmed epoch instead of the full re-shipped set
+            since = None
+            if query:
+                from urllib.parse import parse_qs
+                since = (parse_qs(query).get("digest_since")
+                         or [None])[0]
+            writer.write(_http.json_response(
+                200, self.statusz(digest_since=since)))
             await writer.drain()
             return 200
+        if path == "/migratez/export" and method == "POST":
+            return await self._migrate_export(body, writer)
+        if path == "/migratez/import" and method == "POST":
+            return await self._migrate_import(body, writer)
         if path == "/v1/completions" and method == "POST":
             return await self._completions(headers, body, writer)
         if path in ("/metrics", "/healthz", "/readyz", "/statusz",
-                    "/v1/completions", "/drainz"):
+                    "/v1/completions", "/drainz", "/migratez/export",
+                    "/migratez/import"):
             writer.write(_http.error_response(405, f"{method} not allowed"))
             await writer.drain()
             return 405
         writer.write(_http.error_response(404, f"no route {path}"))
         await writer.drain()
         return 404
+
+    # ------------------------------------------- session migration (14) --
+    async def _migrate_export(self, body, writer) -> int:
+        """``POST /migratez/export`` — stream session snapshot(s):
+        ``{"req_id": N}`` one in-flight session, ``{"tokens": [...]}``
+        a parked session's prefix chain, ``{"all": true}`` every
+        in-flight session (the drain-migration bulk shape).  Runs on
+        the engine thread; allowed while draining (exporting what a
+        drain would otherwise strand is the point).  Bounded and
+        cancellable — aborting the connection at any byte costs
+        nothing (the snapshot is assembled before the first response
+        byte; no allocator state changes on export)."""
+        from ..inference import migration as _mig
+        try:
+            payload = json.loads(body.decode() or "{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as e:
+            writer.write(_http.error_response(400, f"bad JSON body: {e}"))
+            await writer.drain()
+            return 400
+        if not self.engine_alive():
+            writer.write(_http.error_response(
+                503, "engine thread down", err_type="internal_error"))
+            await writer.drain()
+            return 503
+
+        def op(eng):
+            if payload.get("all"):
+                snaps = _mig.export_all(eng)
+            elif "req_id" in payload:
+                snaps = [_mig.export_session(
+                    eng, req_id=int(payload["req_id"]))]
+            elif "tokens" in payload:
+                snaps = [_mig.export_session(
+                    eng, tokens=list(payload["tokens"]))]
+            else:
+                raise _mig.MigrationError(
+                    "body needs one of req_id / tokens / all")
+            return [_mig.to_wire(s) for s in snaps]
+
+        loop = asyncio.get_running_loop()
+        try:
+            snaps = await loop.run_in_executor(
+                None, self.run_on_engine, op)
+        except (_mig.MigrationError, ValueError, TypeError) as e:
+            writer.write(_http.error_response(400, str(e)))
+            await writer.drain()
+            return 400
+        except Exception as e:
+            writer.write(_http.error_response(
+                503, f"export failed: {type(e).__name__}: {e}",
+                err_type="internal_error"))
+            await writer.drain()
+            return 503
+        writer.write(_http.json_response(200, {"sessions": snaps}))
+        await writer.drain()
+        return 200
+
+    async def _migrate_import(self, body, writer) -> int:
+        """``POST /migratez/import`` — install exported session
+        snapshot(s) (``{"sessions": [...]}`` or one bare snapshot) into
+        this replica's prefix cache; ``"resume": true`` also registers
+        each session's continuation request on the engine thread.  Safe
+        to abort at any byte: a truncated body fails JSON parsing (400,
+        nothing installed) and a partial page list imports as a shorter
+        contiguous chain with zero dangling allocator refs."""
+        from ..inference import migration as _mig
+        try:
+            payload = json.loads(body.decode() or "{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as e:
+            writer.write(_http.error_response(400, f"bad JSON body: {e}"))
+            await writer.drain()
+            return 400
+        sessions = payload.get("sessions")
+        if sessions is None and "version" in payload:
+            sessions = [payload]
+        if not isinstance(sessions, list):
+            writer.write(_http.error_response(
+                400, "body needs a 'sessions' list (or one snapshot)"))
+            await writer.drain()
+            return 400
+        if self._draining:
+            writer.write(_http.error_response(
+                503, "draining: this replica is leaving the fleet and "
+                     "cannot adopt sessions", err_type="overloaded_error"))
+            await writer.drain()
+            return 503
+        if not self.engine_alive():
+            writer.write(_http.error_response(
+                503, "engine thread down", err_type="internal_error"))
+            await writer.drain()
+            return 503
+        resume = bool(payload.get("resume", False))
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(
+                None, self.import_sessions, sessions, resume)
+        except _mig.MigrationError as e:
+            writer.write(_http.error_response(409, str(e)))
+            await writer.drain()
+            return 409
+        except Exception as e:
+            writer.write(_http.error_response(
+                503, f"import failed: {type(e).__name__}: {e}",
+                err_type="internal_error"))
+            await writer.drain()
+            return 503
+        writer.write(_http.json_response(200, result))
+        await writer.drain()
+        return 200
 
     # ------------------------------------------------------ completions --
     def _parse_prompt(self, p) -> List[int]:
@@ -738,10 +945,12 @@ class ServingServer:
         return 200
 
     # ----------------------------------------------------------- status --
-    def statusz(self) -> dict:
+    def statusz(self, digest_since: Optional[str] = None) -> dict:
         """Everything a human (or scraper) needs to know the process is
         sane: engine/pool/prefix gauges, jit cache stats, SLO burn,
-        flight recorder, build/flag info."""
+        flight recorder, build/flag info.  ``digest_since`` (ISSUE 14)
+        requests a prefix-digest DELTA against a previously confirmed
+        ``<gen>:<epoch>`` instead of the full set."""
         import sys
 
         import jax
@@ -763,10 +972,16 @@ class ServingServer:
                 "slots_busy": sum(r is not None for r in eng.slot_req),
                 "slots": eng.B,
                 "streams_live": len(self._live),
+                # the router's failover-resume eligibility check (ISSUE
+                # 14): replaying a journal is bit-exact only for greedy
+                # sampling, and a seeded replay needs the seed
+                "sampling": {"do_sample": bool(eng.gen_cfg.do_sample),
+                             "seed": int(eng.gen_cfg.seed)},
             },
             # router placement inputs (ISSUE 7): which prefixes this
-            # replica holds, as chain hashes a router scores against
-            "prefix_digest": eng.prefix_digest()
+            # replica holds, as chain hashes a router scores against —
+            # full set, or adds/evictions since `digest_since` (ISSUE 14)
+            "prefix_digest": eng.prefix_digest(since=digest_since)
             if hasattr(eng, "prefix_digest") else None,
             "slo": self.slo.state() if self.slo is not None else None,
             # latency quantiles (ISSUE 10 satellite): the p50/p95/p99

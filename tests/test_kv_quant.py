@@ -481,6 +481,57 @@ def test_allocator_acquire_page_contract():
         alloc.release_page(p)             # double free raises
 
 
+@pytest.mark.parametrize("cache_dtype", [None, "int8"])
+def test_migration_of_spilled_prefix_ships_ring_bytes(cache_dtype):
+    """Spill <-> migration interaction (ISSUE 14 satellite): exporting a
+    parked session whose prefix pages were demoted to the host ring
+    ships the RING bytes directly — zero swap-ins, no device
+    round-trip — and the importer installs them verbatim (on the int8
+    plane the migrated pool bytes are bit-identical: a migration is a
+    memcpy of quantized bytes, not a dequant round-trip)."""
+    from paddle_tpu.inference import migration as mig
+    model = _tiny_model()
+    S = list(range(1, 17))                # 2 full pages of 8
+
+    def _eng():
+        return ContinuousBatchingEngine(
+            model, max_batch=2,
+            gen=GenerationConfig(max_new_tokens=8, do_sample=False),
+            max_seq_len=64, page_size=8, prefill_bucket=8, num_pages=8,
+            prefix_cache=True, kv_spill_pages=8, cache_dtype=cache_dtype)
+
+    eng = _eng()
+    r0 = eng.add_request(S + [30])
+    first = eng.run()[r0]
+    for i in range(3):                    # crush the pool: S spills
+        eng.add_request(list(range(60 + 8 * i, 76 + 8 * i)),
+                        max_new_tokens=12)
+    eng.run()
+    assert eng.prefix_cache.spilled_pages() >= 2
+    swapins0 = eng.spill.swapins
+    snap = mig.export_session(eng, tokens=S)
+    assert eng.spill.swapins == swapins0  # shipped WITHOUT swap-in
+    assert eng.prefix_cache.spilled_pages() >= 2   # ...and still spilled
+    assert [p["source"] for p in snap["pages"]] == ["spill", "spill"]
+
+    dst = _eng()
+    res = mig.import_session(dst, snap)
+    assert res["imported"] == len(snap["pages"]) == 2
+    if cache_dtype == "int8":
+        # the quantized bytes (and their scale rows) moved verbatim
+        nodes = dst.prefix_cache.chain(S)
+        assert len(nodes) == 2
+        for node, pg in zip(nodes, snap["pages"]):
+            for plane, arr in zip(pg["planes"], dst.g.cache.arrays):
+                assert np.array_equal(plane,
+                                      np.asarray(arr[:, :, node.page]))
+    r1 = dst.add_request(S + [30])
+    out = dst.run()[r1]
+    assert out == first                   # import, not recompute...
+    assert dst.g.cache.allocator.prefix_hits >= 1
+    assert dst.g.cache.allocator.prefix_tokens_saved >= 16
+
+
 def test_spill_telemetry_counters_and_stats():
     model = _tiny_model()
     c0 = obs.metrics.counter("serving.kv.spilled_pages").value

@@ -383,62 +383,112 @@ def test_replica_warmup_readiness_and_zero_recompile_routing(model):
         server.close()
 
 
-def test_failover_kill_replica_mid_stream(model, oracle):
-    """Killing a replica mid-stream fails ONLY its in-flight request —
-    terminated cleanly (finish_reason 'error' + [DONE], never a silent
-    truncation), counted in router.failover — while the next request
-    flows to the survivor and still bit-matches the oracle."""
+def _run_kill_mid_stream(fleet, prompt, max_tokens):
+    """Start a stream, kill the serving replica after the first chunk,
+    return (client bytes, victim id, survivor-check results)."""
+    async def main():
+        r = asyncio.StreamReader()
+        r.feed_data(http_bytes(
+            "POST", "/v1/completions",
+            completion_body(list(prompt), max_tokens, stream=True)))
+        r.feed_eof()
+        from test_serving_http import MemWriter
+        w = MemWriter()
+        task = asyncio.create_task(fleet.router.handle(r, w))
+        deadline = time.perf_counter() + 60
+        while b"data: " not in w.buf:
+            assert time.perf_counter() < deadline, "no first chunk"
+            await asyncio.sleep(0.005)
+        _, victim_headers, _ = split_response(w.buf)
+        victim = victim_headers["x-router-replica"]
+        # kill the serving replica mid-stream
+        for rep in fleet.replicas:
+            if rep.id == victim:
+                rep.kill()
+        await asyncio.wait_for(task, 30)         # no hang
+        survivor_out = await completions_via(
+            fleet.router, PROMPTS[1], 6, stream=False)
+        healthz = await do(fleet.router, "GET", "/healthz")
+        statusz = await do(fleet.router, "GET", "/statusz")
+        return w.buf, victim, survivor_out, healthz, statusz
+
+    return asyncio.run(main())
+
+
+def test_failover_kill_replica_mid_stream_resumes(model):
+    """ISSUE 14: killing a replica mid-stream no longer costs the
+    stream — the journal replays the prompt + relayed tokens on the
+    survivor and the client sees ONE unbroken SSE stream that
+    bit-matches a no-fault oracle (no synthesized error for journaled
+    greedy sessions), counted in router.resumes{outcome=resumed}."""
     obs.reset("router.")
+    # the no-fault oracle for the full 64-token budget
+    eng = _engine(model, gen=GenerationConfig(max_new_tokens=64))
+    rid = eng.add_request(list(PROMPTS[0]))
+    full_oracle = eng.run()[rid]
     fleet = Fleet(model, n=2)
     try:
-        async def main():
-            # long enough to straddle several drains
-            victim_prompt = list(PROMPTS[0])
-            r = asyncio.StreamReader()
-            r.feed_data(http_bytes(
-                "POST", "/v1/completions",
-                completion_body(victim_prompt, 64, stream=True)))
-            r.feed_eof()
-            from test_serving_http import MemWriter
-            w = MemWriter()
-            task = asyncio.create_task(fleet.router.handle(r, w))
-            deadline = time.perf_counter() + 60
-            while b"data: " not in w.buf:
-                assert time.perf_counter() < deadline, "no first chunk"
-                await asyncio.sleep(0.005)
-            _, victim_headers, _ = split_response(w.buf)
-            victim = victim_headers["x-router-replica"]
-            # kill the serving replica mid-stream
-            for rep in fleet.replicas:
-                if rep.id == victim:
-                    rep.kill()
-            await asyncio.wait_for(task, 30)     # no hang
-            survivor_out = await completions_via(
-                fleet.router, PROMPTS[1], 6, stream=False)
-            healthz = await do(fleet.router, "GET", "/healthz")
-            statusz = await do(fleet.router, "GET", "/statusz")
-            return w.buf, victim, survivor_out, healthz, statusz
-
-        raw, victim, (s2, h2, b2), healthz, statusz = asyncio.run(main())
+        raw, victim, (s2, h2, b2), healthz, statusz = \
+            _run_kill_mid_stream(fleet, PROMPTS[0], 64)
         status, headers, body = split_response(raw)
         assert status == 200                     # SSE head was out
         chunks = sse_chunks(body)
-        # clean termination: an explicit error finish, then [DONE]
-        assert chunks[-1]["choices"][0]["finish_reason"] == "error"
+        finishes = [c["choices"][0]["finish_reason"] for c in chunks
+                    if c["choices"][0]["finish_reason"]]
+        toks = [t for c in chunks for t in c["choices"][0]["token_ids"]]
+        # the zero-loss contract: no error finish, full bit-match
+        assert finishes and finishes[-1] in ("stop", "length"), finishes
+        assert toks == full_oracle
         assert body.rstrip().endswith(b"data: [DONE]")
+        assert obs.metrics.counter("router.resumes",
+                                   outcome="resumed").value >= 1
         assert obs.metrics.counter("router.failover",
                                    phase="stream").value >= 1
-        # the very next request succeeds on the survivor, bit-identical
-        assert s2 == 200
-        assert h2["x-router-replica"] != victim
-        assert json.loads(b2)["choices"][0]["token_ids"] == \
-            oracle[tuple(PROMPTS[1])]
+        # the very next request succeeds on the survivor
+        assert s2 == 200 and h2["x-router-replica"] != victim
         assert healthz[0] == 200                 # fleet still alive
         doc = json.loads(statusz[2])
         dead = {r["id"]: r for r in doc["replicas"]}[victim]
         assert dead["state"] in ("suspect", "dead")
+        assert doc["resume"]["outcomes"]["resumed"] >= 1
     finally:
         fleet.close()
+
+
+def test_failover_kill_mid_stream_without_journal_synthesizes_error(
+        model, oracle):
+    """With FLAGS_router_failover_resume off, the PR 7 contract holds
+    verbatim: clean termination (finish_reason 'error' + [DONE], never
+    a silent truncation), counted in router.failover — while the next
+    request flows to the survivor and still bit-matches the oracle."""
+    obs.reset("router.")
+    from paddle_tpu import flags as _flags
+    _flags.set_flags({"router_failover_resume": False})
+    try:
+        fleet = Fleet(model, n=2)
+        try:
+            raw, victim, (s2, h2, b2), healthz, _statusz = \
+                _run_kill_mid_stream(fleet, PROMPTS[0], 64)
+            status, headers, body = split_response(raw)
+            assert status == 200                 # SSE head was out
+            chunks = sse_chunks(body)
+            # clean termination: an explicit error finish, then [DONE]
+            assert chunks[-1]["choices"][0]["finish_reason"] == "error"
+            assert body.rstrip().endswith(b"data: [DONE]")
+            assert obs.metrics.counter("router.failover",
+                                       phase="stream").value >= 1
+            assert obs.metrics.counter("router.resumes",
+                                       outcome="resumed").value == 0
+            # the very next request succeeds on the survivor
+            assert s2 == 200
+            assert h2["x-router-replica"] != victim
+            assert json.loads(b2)["choices"][0]["token_ids"] == \
+                oracle[tuple(PROMPTS[1])]
+            assert healthz[0] == 200             # fleet still alive
+        finally:
+            fleet.close()
+    finally:
+        _flags.set_flags({"router_failover_resume": True})
 
 
 def test_replica_rejoin_resets_staleness_and_traces(model):

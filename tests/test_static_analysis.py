@@ -465,6 +465,31 @@ def test_jl005_covers_fleet_package():
     assert ctx.findings == []
 
 
+def test_jl005_covers_migration_module():
+    """ISSUE 14 satellite: the session-transfer module is part of the
+    asyncio serving plane (its functions run under the /migratez
+    handlers' executor seam) — an async def with blocking calls there
+    is the same head-of-line hazard as one in serving/ proper."""
+    ctx = lint(_ASYNC_POS, rel="paddle_tpu/inference/migration.py",
+               select={"JL005"})
+    assert len(ctx.findings) == 3
+    # its sync control-path functions (export/import run on the engine
+    # thread) stay exempt
+    src = """
+        import time
+
+        def export_session(engine, req_id):
+            time.sleep(0.01)
+    """
+    ctx = lint(src, rel="paddle_tpu/inference/migration.py",
+               select={"JL005"})
+    assert ctx.findings == []
+    # other inference/ modules are NOT in the async plane
+    ctx = lint(_ASYNC_POS, rel="paddle_tpu/inference/generation.py",
+               select={"JL005"})
+    assert ctx.findings == []
+
+
 # ------------------------------------------------------------------ JL006 --
 
 def test_jl006_fires_on_request_data_labels():
@@ -557,6 +582,19 @@ def test_jl007_covers_fleet_package():
     assert len(ctx.findings) == 1
     ctx = lint(src, rel="paddle_tpu/io/h.py", select={"JL007"})
     assert ctx.findings == []
+
+
+def test_jl007_covers_migration_module():
+    """ISSUE 14 satellite: engine single-ownership applies to the
+    transfer module too — imports/exports must ride the control-op
+    seam, never call the engine from an async def."""
+    src = """
+        async def migrate(self):
+            self.engine._drain()
+    """
+    ctx = lint(src, rel="paddle_tpu/inference/migration.py",
+               select={"JL007"})
+    assert len(ctx.findings) == 1
 
 
 # ------------------------------------------------- suppressions (JL000) --
