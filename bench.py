@@ -1554,10 +1554,18 @@ def _run_fleet_chaos(on_tpu):
         eng.run()                          # warm both step programs
         return eng
 
-    plan = ChaosPlan([FaultEvent(1000, "kill", "fs0")])
+    # poison (ISSUE 15): a request that kills its replica AT DISPATCH —
+    # armed by the plan's poison event, contained by the router's
+    # quarantine (FLAGS_router_poison_strikes, default 2)
+    poison = [int(t) for t in rng.integers(1, cfg.vocab_size,
+                                           prompt_len + 1)]
+    plan = ChaosPlan([FaultEvent(1000, "kill", "fs0"),
+                      FaultEvent(2000, "poison",
+                                 " ".join(str(t) for t in poison))])
     chaos = ChaosController(plan)
     router = RouterServer([], allow_empty=True, health_interval_s=1e9,
                           dead_after=2, poll_timeout_s=0.5)
+    from paddle_tpu.fleet import CascadeBreaker
     sup = FleetSupervisor(
         router, lambda rid: InprocReplicaHandle(rid, factory,
                                                 client_wrap=chaos.wrap),
@@ -1565,9 +1573,15 @@ def _run_fleet_chaos(on_tpu):
         backoff_base_s=0.1, backoff_max_s=1.0, backoff_reset_s=1e9,
         drain_timeout_s=30.0, hot_ticks=2, cold_ticks=50, cooldown_s=1.0,
         scale_up_load=1.5, scale_down_load=0.5,
+        # breaker attached (state stamped below) but windowed so the
+        # quarantine — not the breaker — is what contains the poison:
+        # 2 strikes < threshold 3 inside one 5s window by construction
+        breaker=CascadeBreaker(threshold=3, window_s=5.0,
+                               cooldown_s=1.0),
         on_spawn=chaos.register_handle)
 
     verdicts = {"ok": 0, "synth_error": 0, "hard_failure": 0}
+    pverdicts = {"ok": 0, "synth_error": 0, "hard_failure": 0}
     out = {}
 
     async def request(prompt, stream):
@@ -1676,6 +1690,62 @@ def _run_fleet_chaos(on_tpu):
         out["tok_per_s_observed"] = round(out["tokens_total"] / wall, 1)
         out["replicas_peak"] = len(router.states)
 
+        # ---- poison phase (ISSUE 15): a deterministically-fatal
+        # request must kill at most FLAGS_router_poison_strikes
+        # replicas, end quarantined (its re-submit refused 503), leave
+        # every concurrent healthy stream bit-identical, and the fleet
+        # must converge back to target behind it ----
+        deaths0 = int(obs.metrics.counter("fleet.crashes",
+                                          kind="exit").value)
+        healthy = prompts[:4]
+        htasks = [asyncio.ensure_future(request(list(p), True))
+                  for p in healthy]
+        # let every healthy stream get its first chunk out before the
+        # poison lands: mid-stream requests are victims, not suspects —
+        # the quarantine's dispatch-proximity attribution never strikes
+        # a streaming flight
+        t_first = time.perf_counter() + 120
+        while sum(1 for s in sup._slots
+                  if s.handle.server is not None
+                  for st in s.handle.server._live
+                  if st.sent > 0) < len(healthy):
+            sup.tick()
+            await router.poll_replicas()
+            assert time.perf_counter() < t_first, "healthy never started"
+            if all(t.done() for t in htasks):
+                break
+            await asyncio.sleep(0.01)
+        chaos.advance(2000)              # arm the poison prompt
+        ptask = asyncio.ensure_future(request(list(poison), True))
+        while not (ptask.done() and all(t.done() for t in htasks)):
+            sup.tick()
+            await router.poll_replicas()
+            await asyncio.sleep(0.02)
+        for t, p in zip(htasks, healthy):
+            pverdicts[judge(t.result(), p)] += 1
+        raw = ptask.result()
+        phead, _, pbody = raw.partition(b"\r\n\r\n")
+        out["poison_status"] = int(phead.split()[1])
+        # either a clean pre-head 503 (quarantined body) or — when a
+        # head got out before the first kill — the synthesized error
+        # termination; never a hanging stream, never a 200 completion
+        out["poison_stream_contained"] = (
+            (out["poison_status"] == 503 and b"quarantined" in pbody)
+            or (out["poison_status"] == 200
+                and b'"finish_reason": "error"' in pbody))
+        assert await converge()          # restarts rebuild the fleet
+        out["poison_deaths"] = int(obs.metrics.counter(
+            "fleet.crashes", kind="exit").value) - deaths0
+        # quarantine holds: the NEXT submit of the same signature is a
+        # deterministic clean 503 with a `quarantined` error body
+        raw2 = await request(list(poison), stream=False)
+        h2, _, b2 = raw2.partition(b"\r\n\r\n")
+        out["poison_resubmit_status"] = int(h2.split()[1])
+        out["poison_resubmit_refused"] = (
+            out["poison_resubmit_status"] == 503
+            and b"quarantined" in b2)
+        out["poison_breaker_state"] = sup.breaker.state
+
         # idle cool-down: the cold signal drains the fleet to min (1)
         t_end = time.perf_counter() + 300
         while sup.target > 1 or not sup.converged():
@@ -1691,6 +1761,8 @@ def _run_fleet_chaos(on_tpu):
         sup.shutdown(drain=False, timeout_s=5.0)
 
     m = obs.metrics
+    from paddle_tpu import flags as _pflags
+    _poison_strikes = int(_pflags.flag("router_poison_strikes"))
     n_req = sum(verdicts.values())
     return {
         "fleet_chaos_requests": n_req,
@@ -1718,6 +1790,34 @@ def _run_fleet_chaos(on_tpu):
             and verdicts["ok"] == n_req
             and int(m.counter("router.resumes",
                               outcome="resumed").value) >= 1,
+        # ISSUE 15: poison containment — the quarantine stops the
+        # replay-amplified kill chain at FLAGS_router_poison_strikes
+        # dead replicas, the signature ends quarantined (re-submit is a
+        # deterministic clean 503), every concurrent healthy stream
+        # bit-matches the no-fault oracle, and the fleet converges back
+        "fleet_chaos_poison_deaths": out.get("poison_deaths"),
+        "fleet_chaos_poison_strikes": _poison_strikes,
+        "fleet_chaos_poison_quarantined": int(m.counter(
+            "router.quarantine", action="quarantined").value),
+        "fleet_chaos_poison_quarantine_strikes": int(m.counter(
+            "router.quarantine", action="strike").value),
+        "fleet_chaos_poison_refused": int(m.counter(
+            "router.quarantine", action="refused").value),
+        "fleet_chaos_poison_healthy_ok": pverdicts["ok"],
+        "fleet_chaos_poison_healthy_requests": sum(pverdicts.values()),
+        "fleet_chaos_poison_resubmit_status":
+            out.get("poison_resubmit_status"),
+        "fleet_chaos_poison_breaker_state":
+            out.get("poison_breaker_state"),
+        "fleet_chaos_poison_containment_match": bool(
+            out.get("poison_deaths") is not None
+            and out["poison_deaths"] <= _poison_strikes
+            and int(m.counter("router.quarantine",
+                              action="quarantined").value) >= 1
+            and out.get("poison_stream_contained")
+            and out.get("poison_resubmit_refused")
+            and pverdicts["ok"] == sum(pverdicts.values())
+            and pverdicts["hard_failure"] == 0),
         "fleet_chaos_digest_delta_syncs": int(m.counter(
             "router.digest_sync", mode="delta").value),
         "fleet_chaos_digest_full_syncs": int(m.counter(

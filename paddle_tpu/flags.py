@@ -315,6 +315,50 @@ define_flag("router_journal_max_tokens", 4096,
             "Per-request cap on journaled emitted tokens: a stream that "
             "outgrows it is marked non-resumable (bounded memory; the "
             "synthesized-error contract still applies to it).")
+define_flag("router_poison_strikes", 2,
+            "Poison-request quarantine (ISSUE 15): a replica death "
+            "strikes every journaled request in-flight on it whose "
+            "current flight had relayed ZERO tokens (the death happened "
+            "at/near their dispatch — the poison shape; a mid-stream "
+            "request is a victim, not a suspect).  A request signature "
+            "(prompt-ids hash + sampling config) that accumulates this "
+            "many strikes without progress in between (a relayed token "
+            "absolves) is quarantined: replay stops and new submits are "
+            "refused 503 with a 'quarantined' error body.  "
+            "0 disables the quarantine.")
+define_flag("router_quarantine_ttl_s", 300.0,
+            "Seconds a quarantined request signature stays refused (and "
+            "seconds an un-quarantined signature's strikes persist).  A "
+            "latent kernel bug fixed by a restart should not ban the "
+            "prompt forever — TTL expiry re-admits it on probation.")
+define_flag("router_breaker_park_timeout_s", 20.0,
+            "How long a journaled failover resume parks while the "
+            "fleet's cascade breaker is open before giving up and "
+            "falling back to the synthesized-error contract (the "
+            "journal entry waits for a half-open probe slot or a "
+            "closed breaker; it never replays into an open one).")
+define_flag("fleet_cascade_threshold", 3,
+            "Cascade breaker (ISSUE 15): replica deaths inside "
+            "FLAGS_fleet_cascade_window_s that trip the breaker OPEN — "
+            "failover resume parks, new router admissions shed with "
+            "jittered Retry-After, crash restarts continue.  "
+            "0 disables the breaker.")
+define_flag("fleet_cascade_window_s", 30.0,
+            "Sliding window (seconds) the cascade breaker counts "
+            "replica deaths over.")
+define_flag("fleet_cascade_cooldown_s", 10.0,
+            "Seconds an OPEN cascade breaker waits (with no further "
+            "deaths) before going HALF-OPEN: one parked resume is "
+            "released as a probe; its survival closes the breaker, "
+            "another death re-opens it.")
+define_flag("serving_queue_timeout_s", 0.0,
+            "Queue-expiry shedding (ISSUE 15): a request still waiting "
+            "in the engine inbox (never admitted, zero prefill spent) "
+            "past this many seconds is retired instead of burning a "
+            "prefill on a client that already gave up: unary replies "
+            "504; a stream (SSE head already out) gets a finish frame "
+            "with finish_reason=queue_expired "
+            "(serving.http.queue_expired).  <=0 disables expiry.")
 define_flag("prefix_digest_log", 4096,
             "Capacity of the prefix cache's digest change log (adds/"
             "evictions per epoch) backing /statusz digest DELTA sync: a "

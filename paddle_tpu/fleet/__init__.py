@@ -17,11 +17,13 @@ budgets, the autoscale signal loop), fault injection in ``fleet.chaos``
 (explicit/seeded fault plans over a transport-seam wrapper).
 """
 
-from . import chaos, supervisor
+from . import breaker, chaos, supervisor
+from .breaker import CascadeBreaker
 from .chaos import ChaosController, ChaosPlan, FaultEvent
 from .supervisor import (FleetSupervisor, InprocReplicaHandle,
                          ProcessReplicaHandle, ReplicaHandle)
 
 __all__ = ["FleetSupervisor", "ReplicaHandle", "InprocReplicaHandle",
            "ProcessReplicaHandle", "ChaosPlan", "ChaosController",
-           "FaultEvent", "supervisor", "chaos"]
+           "FaultEvent", "CascadeBreaker", "supervisor", "chaos",
+           "breaker"]

@@ -30,8 +30,16 @@ Fault kinds and their real-world shapes:
   next drain migration — ``migrate_interrupt`` kills the transfer
   between export and import (nothing installed anywhere),
   ``partial_transfer`` truncates every snapshot's page list mid-flight
-  (the importer must install the shorter contiguous chain and leak no
-  allocator refs).  Both leave the drain itself intact.
+  (with integrity digests on, ISSUE 15, the importer REJECTS the
+  corrupt snapshot with zero leaked allocator refs).  Both leave the
+  drain itself intact.
+- ``poison`` — a deterministically-fatal request (ISSUE 15): the event
+  ``target`` is the poison PROMPT as space-joined token ids (not a
+  replica id — a poison kills whatever replica it is dispatched on).
+  Once armed, any ``/v1/completions`` whose prompt matches kills its
+  replica at dispatch through the ``InprocReplica`` seam (in-flight
+  streams sever, the engine dies) — the replay-amplification shape the
+  router's quarantine + the fleet's cascade breaker must contain.
 
 Transport faults ride :class:`ChaosClient`, a ``ReplicaClient`` wrapper
 the router speaks through (``ChaosController.wrap`` is the
@@ -51,7 +59,7 @@ __all__ = ["FaultEvent", "ChaosPlan", "ChaosClient", "ChaosController",
 
 KINDS = ("kill", "wedge", "unwedge", "refuse", "allow", "poll_timeout",
          "poll_ok", "cut", "throttle", "unthrottle",
-         "migrate_interrupt", "partial_transfer")
+         "migrate_interrupt", "partial_transfer", "poison")
 # (fault, recovery) pairs the seeded generator schedules together so a
 # generated plan never leaves a replica permanently faulted by accident
 _PAIRED = {"wedge": "unwedge", "refuse": "allow",
@@ -126,15 +134,28 @@ class ChaosClient:
     every code path a real network fault would.  ``inner`` stays
     reachable for handle-level verbs (kill severs the real streams)."""
 
-    def __init__(self, inner):
+    def __init__(self, inner, controller=None):
         self.inner = inner
         self.id = inner.id
+        self.controller = controller     # poison lookups + kill verb
         self.refuse = False
         self.wedged = False
         self.poll_black_hole = False
         self.frame_delay_s = 0.0
         # open relays: (outer_reader, pump_task or None) for cut support
         self._open: set = set()
+
+    def _poison_hit(self, path: str, body: bytes) -> bool:
+        c = self.controller
+        if c is None or not c.poison_prompts or \
+                path != "/v1/completions":
+            return False
+        try:
+            import json as _json
+            p = _json.loads(body.decode() or "{}").get("prompt")
+            return isinstance(p, list) and tuple(p) in c.poison_prompts
+        except (ValueError, UnicodeDecodeError):
+            return False
 
     async def open(self, method, path, headers=(), body=b""):
         if self.refuse:
@@ -147,6 +168,13 @@ class ChaosClient:
             return asyncio.StreamReader(), (lambda: None)
         reader, close = await self.inner.open(method, path,
                                               headers=headers, body=body)
+        if method == "POST" and self._poison_hit(path, body):
+            # poison (ISSUE 15): the dispatch is what kills the replica.
+            # The request reached it — NOW the engine dies: in-flight
+            # responses (this one included) sever, so the router sees a
+            # post-dispatch death on THIS replica, exactly the
+            # attribution evidence the quarantine strikes on.
+            self.controller.kill_replica(self.id)
         if self.frame_delay_s <= 0:
             # track for cut(): severing rides the inner replica's writer
             # seam (InprocReplica.sever_streams), no relay needed
@@ -225,14 +253,29 @@ class ChaosController:
         self.log: List[Tuple[int, dict]] = []
         self._clients: Dict[str, ChaosClient] = {}
         self._handles: Dict[str, object] = {}
+        # armed poison prompts (tuples of token ids) + kills they caused
+        self.poison_prompts: set = set()
+        self.poison_kills: List[str] = []
 
     def wrap(self, client) -> ChaosClient:
-        wrapped = ChaosClient(client)
+        wrapped = ChaosClient(client, controller=self)
         self._clients[client.id] = wrapped   # latest generation wins
         return wrapped
 
     def register_handle(self, handle) -> None:
         self._handles[handle.id] = handle
+
+    def kill_replica(self, rid: str) -> None:
+        """Kill one replica NOW (the poison dispatch seam): through its
+        registered handle when the supervisor owns it, else the inner
+        transport's kill."""
+        self.poison_kills.append(rid)
+        handle = self._handles.get(rid)
+        client = self._clients.get(rid)
+        if handle is not None:
+            handle.kill()
+        elif client is not None and hasattr(client.inner, "kill"):
+            client.inner.kill()
 
     def _apply(self, e: FaultEvent) -> None:
         client = self._clients.get(e.target)
@@ -279,6 +322,12 @@ class ChaosController:
         elif e.kind == "partial_transfer":
             if handle is not None:
                 handle._chaos_migrate = "partial"
+        elif e.kind == "poison":
+            # target = the poison PROMPT as space-joined token ids (a
+            # poison kills whatever replica it lands on, so no replica
+            # id to aim at)
+            self.poison_prompts.add(
+                tuple(int(t) for t in e.target.split()))
 
     def advance(self, tick: int) -> List[FaultEvent]:
         applied: List[FaultEvent] = []

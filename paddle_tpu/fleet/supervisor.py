@@ -52,6 +52,7 @@ from typing import Callable, List, Optional
 
 from .. import flags
 from .. import observability as _obs
+from .breaker import CascadeBreaker
 
 __all__ = ["FleetSupervisor", "ReplicaHandle", "InprocReplicaHandle",
            "ProcessReplicaHandle", "STARTING", "READY", "DRAINING",
@@ -426,6 +427,7 @@ class FleetSupervisor:
                  migrate_on_drain: Optional[bool] = None,
                  on_spawn: Optional[Callable[[ReplicaHandle],
                                              None]] = None,
+                 breaker=None,
                  clock: Callable[[], float] = time.monotonic):
         f = flags.flag
         self.router = router
@@ -478,6 +480,16 @@ class FleetSupervisor:
         self._last_anomaly_total = 0
         self._ticks = 0
         self._m = _FleetMetrics()
+        # cascade breaker (ISSUE 15): the supervisor owns detection (it
+        # sees every death), the router consumes state (parks resumes,
+        # sheds admissions).  ``breaker=None`` builds a flag-configured
+        # one on the same injectable clock; ``breaker=False`` disables.
+        if breaker is None:
+            breaker = CascadeBreaker(clock=clock)
+        self.breaker: Optional[CascadeBreaker] = breaker or None
+        if self.breaker is not None:
+            # plain attribute write: GIL-atomic vs the event loop's reads
+            self.router.breaker = self.breaker
 
     # --------------------------------------------------------- population --
     def _spawn_slot(self) -> _Slot:
@@ -520,6 +532,8 @@ class FleetSupervisor:
             slot.handle.kill()       # a wedge holds its port/engine hostage
         self._deregister(slot)
         self._m.crashes(kind).inc()
+        if self.breaker is not None:
+            self.breaker.record_death(now)
         if slot.ready_since is not None and \
                 now - slot.ready_since >= self.backoff_reset_s:
             slot.restarts = 0        # long-stable replica earns budget back
@@ -539,6 +553,10 @@ class FleetSupervisor:
         now = self._clock()
         self._ticks += 1
         actions: list = []
+        if self.breaker is not None:
+            # time-driven breaker transitions (open -> half-open after a
+            # death-free cooldown) ride the control loop's clock
+            self.breaker.update(now)
         for slot in list(self._slots):
             h = slot.handle
             if slot.state == DRAINING:
@@ -563,10 +581,13 @@ class FleetSupervisor:
                 elif not h.alive():
                     # died mid-drain (nonzero exit / engine crash): it
                     # was leaving anyway — count the unclean exit, don't
-                    # restart it
+                    # restart it (a death is still a death to the
+                    # cascade breaker's rate window)
                     self._deregister(slot)
                     self._m.crashes("exit").inc()
                     self._m.drains("died").inc()
+                    if self.breaker is not None:
+                        self.breaker.record_death(now)
                     self._slots.remove(slot)
                     actions.append(("drain_died", h.id))
                 continue
@@ -737,8 +758,10 @@ class FleetSupervisor:
                 raise RuntimeError("chaos: migrate_interrupt")
             if fault == "partial":
                 # a truncated transfer: each snapshot loses the tail of
-                # its page list mid-flight — the import must install
-                # the shorter contiguous chain and leak nothing
+                # its page list mid-flight — the export-stamped
+                # integrity digest no longer matches, so the importer
+                # must REJECT the corrupt snapshot (ISSUE 15; counted
+                # serving.kv.migration_rejected) and leak nothing
                 snaps = [{**s, "pages": s["pages"][:len(s["pages"]) // 2]}
                          for s in snaps]
             if not snaps:
@@ -806,6 +829,8 @@ class FleetSupervisor:
                        "restarts": s.restarts,
                        **s.handle.describe()} for s in self._slots],
             "signals": self.router.fleet_signals(),
+            "breaker": self.breaker.state_dict()
+            if self.breaker is not None else None,
         }
 
     # -------------------------------------------------------- lifecycle --

@@ -157,10 +157,8 @@ def _moe_ffn(y, lp, top_k, dispatch="dense", block_m=128):
     return out.reshape(shape)
 
 
-def _sample(logits, key, gc: GenerationConfig):
-    """logits: [B, V] fp32 → [B] int32 (traced; gc fields are static)."""
-    if not gc.do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def _filter_logits(logits, gc: GenerationConfig):
+    """Temperature / top-k / top-p logit filtering ([N, V] fp32)."""
     logits = logits / max(gc.temperature, 1e-6)
     if gc.top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -gc.top_k][:, None]
@@ -173,7 +171,28 @@ def _sample(logits, key, gc: GenerationConfig):
         cutoff_idx = jnp.sum(cum < gc.top_p, axis=-1)
         cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-    return jax.random.categorical(key, logits).astype(jnp.int32)
+    return logits
+
+
+def _sample(logits, key, pos, gc: GenerationConfig):
+    """logits: [N, V] fp32, pos: [N] int32 → [N] int32 (traced; gc
+    fields are static).
+
+    Sampling keys are POSITIONAL (ISSUE 15 satellite): row n draws with
+    ``fold_in(key, pos[n])`` where ``pos`` is the sequence index of the
+    token being sampled and ``key`` is the engine's never-advancing
+    ``jax.random.key(seed)``.  A draw is therefore a pure function of
+    (seed, token index, logits) — batch composition, step count, drain
+    cadence, and cross-replica replay never perturb a request's sampled
+    stream, which is exactly what lets a journaled failover resume (and
+    a migrated sampled session) continue seed-deterministically on a
+    survivor with the same config.  Greedy ignores the key entirely."""
+    if not gc.do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _filter_logits(logits, gc)
+    keys = jax.vmap(lambda p: jax.random.fold_in(key, p))(pos)
+    draw = jax.vmap(lambda k, row: jax.random.categorical(k, row))
+    return draw(keys, logits).astype(jnp.int32)
 
 
 class LlamaGenerator:
@@ -419,8 +438,10 @@ class LlamaGenerator:
         last_ix = jnp.maximum(ql - 1, 0)
         last = jnp.take_along_axis(h, last_ix[:, None, None], axis=1)[:, 0]
         logits = (last @ params["head"]).astype(jnp.float32)
-        key, sub = jax.random.split(key)
-        sampled = _sample(logits, sub, gc)
+        # positional sampling keys: the token being sampled lands at
+        # sequence index positions + ql; the chained key never advances
+        # (determinism across batch shapes and replicas — see _sample)
+        sampled = _sample(logits, key, positions + ql, gc)
         last_in = jnp.take_along_axis(tokens, last_ix[:, None], axis=1)[:, 0]
         out_tokens = jnp.where(finished, last_in, sampled)
         new_positions = jnp.where(
@@ -479,10 +500,13 @@ class LlamaGenerator:
                                         positions, block_tables)
         B = tokens.shape[0]
         logits = (h @ params["head"]).astype(jnp.float32)      # [B, K, V]
-        key, sub = jax.random.split(key)
-        # one independent key per position: token-level sequential
-        # sampling semantics (greedy ignores the key entirely)
-        sampled = _sample(logits.reshape(B * K, -1), sub, gc).reshape(B, K)
+        # one positional key per (row, slot): slot j samples the token
+        # at sequence index positions + j + 1 — token-level sequential
+        # sampling semantics (greedy ignores the keys entirely)
+        pos_k = positions[:, None] + \
+            jnp.arange(K, dtype=jnp.int32)[None, :] + 1
+        sampled = _sample(logits.reshape(B * K, -1), key,
+                          pos_k.reshape(B * K), gc).reshape(B, K)
 
         n_commit = _sp.accept_length(tokens, sampled, ql)
         if gc.eos_token_id is not None:
@@ -536,8 +560,7 @@ class LlamaGenerator:
             h, cache = self._forward_tokens(params, cache, tok[:, None],
                                             ql, positions, block_tables)
             logits = (h[:, 0] @ params["head"]).astype(jnp.float32)
-            key, sub = jax.random.split(key)
-            sampled = _sample(logits, sub, gc)
+            sampled = _sample(logits, key, positions + ql, gc)
             out = jnp.where(ql > 0, sampled, tok)
             positions = positions + ql
             committed = (ql > 0).astype(jnp.int32)
@@ -931,6 +954,20 @@ class ContinuousBatchingEngine:
     def add_request(self, prompt: Sequence[int],
                     max_new_tokens: Optional[int] = None) -> int:
         return self.submit(prompt, max_new_tokens).req_id
+
+    def cancel_waiting(self, req: Request) -> bool:
+        """Retire a request still in the WAITING queue — never admitted,
+        holding no pages, zero prefill spent (the queue-expiry shedding
+        seam, ISSUE 15).  Returns False once admission has already
+        picked it up (too late to shed for free)."""
+        try:
+            self.waiting.remove(req)
+        except ValueError:
+            return False
+        req.done = True
+        if self._obs is not None:
+            self._obs.queue_now.set(len(self.waiting))
+        return True
 
     def has_work(self) -> bool:
         return bool(self.waiting) or any(r is not None for r in self.slot_req)

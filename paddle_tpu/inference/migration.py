@@ -40,6 +40,7 @@ deployments).
 from __future__ import annotations
 
 import base64
+import hashlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -51,7 +52,7 @@ from .kv_spill import _upload_page
 
 __all__ = ["MigrationError", "export_session", "export_all",
            "import_session", "import_sessions", "warm",
-           "to_wire", "from_wire", "SNAP_VERSION"]
+           "to_wire", "from_wire", "snapshot_digest", "SNAP_VERSION"]
 
 SNAP_VERSION = 1
 
@@ -75,6 +76,7 @@ class _MigrationMetrics:
         self.pages_in = m.counter("serving.kv.migration_pages",
                                   direction="in")
         self.aborts = m.counter("serving.kv.migration_aborts")
+        self.rejected = m.counter("serving.kv.migration_rejected")
 
     @classmethod
     def get(cls) -> "_MigrationMetrics":
@@ -88,7 +90,7 @@ def _engine_counts(engine) -> Dict[str, int]:
     if mc is None:
         mc = {"migration_exports": 0, "migration_imports": 0,
               "migration_exported_pages": 0, "migration_imported_pages": 0,
-              "migration_aborts": 0}
+              "migration_aborts": 0, "migration_rejected": 0}
         engine._migration_counts = mc
     return mc
 
@@ -149,9 +151,38 @@ def _decode_planes(planes) -> Tuple[np.ndarray, ...]:
     return tuple(out)
 
 
+def snapshot_digest(snap: dict) -> str:
+    """Canonical blake2b integrity digest over a snapshot's semantic
+    content (ISSUE 15 satellite): version, tokens, and every page's
+    index/source plus each plane's dtype, shape and raw bytes — the
+    SAME value whether the planes are live numpy arrays (in-process
+    transfer) or their base64 wire encoding, so a digest stamped at
+    export survives the codec and any truncation/corruption in between
+    is detected at import."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(f"v{snap.get('version')}".encode())
+    h.update(b"|t")
+    h.update(",".join(str(int(t)) for t in snap.get("tokens", ()))
+             .encode())
+    pages = sorted(snap.get("pages", ()), key=lambda p: int(p["index"]))
+    for pg in pages:
+        h.update(f"|p{int(pg['index'])}:{pg.get('source', '')}"
+                 .encode())
+        for plane in _decode_planes(pg["planes"]):
+            plane = np.ascontiguousarray(plane)
+            h.update(f"{plane.dtype}{list(plane.shape)}".encode())
+            h.update(plane.tobytes())
+    return h.hexdigest()
+
+
 def to_wire(snap: dict) -> dict:
-    """A JSON-serializable copy of a snapshot (planes base64-encoded)."""
+    """A JSON-serializable copy of a snapshot (planes base64-encoded),
+    integrity-stamped: a ``digest`` computed at export rides the wire so
+    the importer can reject corrupt/truncated bytes before touching its
+    allocator (hand-built snapshots get theirs stamped here)."""
     out = dict(snap)
+    if "digest" not in out:
+        out["digest"] = snapshot_digest(snap)
     out["pages"] = [{**pg, "planes": _encode_planes(pg["planes"])}
                     for pg in snap["pages"]]
     return out
@@ -238,6 +269,22 @@ def export_session(engine, req_id: Optional[int] = None,
         n_full = len(snap["pages"])
         snap.update(tokens=toks, prompt_len=len(toks), emitted=[],
                     max_new_tokens=0, n_ctx=n_full * page, trace_id=None)
+    # per-request RNG state (ISSUE 15 satellite): the engine's sampling
+    # is positionally keyed — fold_in(key(seed), token index) — so the
+    # whole per-request "key state" is the seed + the derivation marker;
+    # a successor with the identical config resumes the sampled stream
+    # seed-deterministically from the exact token offset
+    gc = engine.gen_cfg
+    snap["sampling"] = {"do_sample": bool(gc.do_sample),
+                        "seed": int(gc.seed),
+                        "temperature": float(gc.temperature),
+                        "top_k": int(gc.top_k),
+                        "top_p": float(gc.top_p),
+                        "positional": True}
+    # integrity stamp (ISSUE 15 satellite): importers verify before
+    # touching their allocator — corrupt or truncated bytes are
+    # REJECTED, never half-installed
+    snap["digest"] = snapshot_digest(snap)
     mm.exports.inc()
     mm.pages_out.inc(len(snap["pages"]))
     mc = _engine_counts(engine)
@@ -321,6 +368,22 @@ def import_session(engine, snap: dict, resume: bool = False) -> dict:
     _check_geometry(engine, snap)
     mm = _MigrationMetrics.get()
     mc = _engine_counts(engine)
+    # integrity check (ISSUE 15 satellite): a digest-stamped snapshot
+    # whose bytes no longer hash to it (truncated page list, corrupt
+    # plane, bit-rot on the wire) is REJECTED before any allocator
+    # state changes — zero pages installed, zero refs to leak.  Legacy
+    # unstamped snapshots keep the structural contiguous-chain
+    # semantics (a hand-built partial snapshot is not corruption).
+    snap = from_wire(snap)   # decode planes ONCE (idempotent on live
+    #                          snapshots): the digest check and the
+    #                          install loop below share the arrays
+    want = snap.get("digest")
+    if want is not None and snapshot_digest(snap) != want:
+        mm.rejected.inc()
+        mc["migration_rejected"] += 1
+        raise MigrationError(
+            "snapshot integrity digest mismatch: the transfer was "
+            "corrupted or truncated in flight; nothing was installed")
     alloc = engine.g.cache.allocator
     page = engine.g.page_size
     toks = list(snap["tokens"])
@@ -344,7 +407,7 @@ def import_session(engine, snap: dict, resume: bool = False) -> dict:
                 continue
             pid = alloc.acquire_page()
             try:
-                planes = _decode_planes(pg["planes"])
+                planes = pg["planes"]    # decoded up front
                 engine.g.cache.update(*up(
                     engine.g.cache.arrays, jnp.int32(pid),
                     tuple(jnp.asarray(p) for p in planes)))
@@ -372,6 +435,23 @@ def import_session(engine, snap: dict, resume: bool = False) -> dict:
     remaining = int(snap.get("max_new_tokens", 0) or 0) \
         - len(snap.get("emitted") or ())
     if resume and remaining >= 1:
+        samp = snap.get("sampling")
+        if isinstance(samp, dict) and samp.get("do_sample"):
+            # sampled resume (ISSUE 15 satellite): seed-deterministic
+            # only when this engine's positional sampling config is
+            # IDENTICAL to the exporter's — otherwise keep the pages
+            # (they are valid prefix-cache entries either way) but skip
+            # the continuation rather than silently fork the stream
+            gc = engine.gen_cfg
+            mine = {"do_sample": bool(gc.do_sample),
+                    "seed": int(gc.seed),
+                    "temperature": float(gc.temperature),
+                    "top_k": int(gc.top_k),
+                    "top_p": float(gc.top_p),
+                    "positional": True}
+            if mine != samp:
+                out["resume_skipped"] = "sampling-mismatch"
+                return out
         req = engine.submit(toks, max_new_tokens=remaining,
                             trace_id=snap.get("trace_id"))
         out["resume_req_id"] = req.req_id

@@ -108,6 +108,10 @@ CATALOG: Dict[str, tuple] = {
         "counter", "", "transfers that failed mid-flight (the in-flight "
         "page's allocator ref is released; already-linked pages stay "
         "valid cache entries)"),
+    "serving.kv.migration_rejected": (
+        "counter", "", "snapshots refused by the blake2b integrity "
+        "check at import (ISSUE 15: corrupt or truncated bytes — "
+        "nothing installed, zero allocator refs leaked)"),
     # ---- serving: speculative decoding (PR 9) ----
     "serving.spec.drafted_tokens": (
         "counter", "", "draft tokens dispatched for verification"),
@@ -131,6 +135,11 @@ CATALOG: Dict[str, tuple] = {
         "decisions"),
     "serving.http.shed": (
         "counter", "", "requests shed with 503 + Retry-After"),
+    "serving.http.queue_expired": (
+        "counter", "", "requests retired from the engine inbox past "
+        "FLAGS_serving_queue_timeout_s BEFORE dispatch (ISSUE 15: "
+        "zero prefill spent on a client that already gave up; unary = "
+        "504, stream = finish_reason queue_expired)"),
     # ---- router fleet plane (PR 7) ----
     "router.requests": ("counter", "", "router requests accepted"),
     "router.streams": ("counter", "", "router streaming completions"),
@@ -151,8 +160,9 @@ CATALOG: Dict[str, tuple] = {
         "counter", "phase=connect|stream", "requests that hit a dead "
         "replica"),
     "router.slo_decision": (
-        "counter", "decision=admit|shed|unavailable", "fleet admission "
-        "decisions"),
+        "counter", "decision=admit|shed|unavailable|breaker",
+        "fleet admission decisions (breaker = shed because the cascade "
+        "breaker is open, ISSUE 15)"),
     "router.shed": ("counter", "", "fleet-wide sheds"),
     "router.health_polls": (
         "counter", "result=ok|fail", "replica /statusz polls"),
@@ -183,6 +193,18 @@ CATALOG: Dict[str, tuple] = {
         "delta = only adds/evictions since the confirmed epoch rode "
         "the poll; full = complete set re-ship (first poll, replica "
         "restart, or change-log miss)"),
+    # ---- poison quarantine (ISSUE 15) ----
+    "router.quarantine": (
+        "counter", "action=strike|quarantined|refused",
+        "poison-request crash attribution (router/quarantine.py): "
+        "strike = a journaled request was in flight on a dying "
+        "replica, quarantined = a signature struck out "
+        "(FLAGS_router_poison_strikes deaths with no relayed token in "
+        "between), refused = a quarantined signature's submit/replay "
+        "answered 503 instead of another corpse"),
+    "router.quarantine_entries": (
+        "gauge", "", "request signatures currently tracked by the "
+        "quarantine (strikes + quarantined; TTL-bounded)"),
     # ---- fleet lifecycle supervisor (PR 12) ----
     "fleet.replicas": (
         "gauge", "state=starting|ready|draining|backoff|failed",
@@ -217,6 +239,12 @@ CATALOG: Dict[str, tuple] = {
     "fleet.migrated_pages": (
         "counter", "", "KV pages installed on successors by "
         "drain-triggered migrations"),
+    "fleet.breaker_state": (
+        "gauge", "", "cascade-breaker state (fleet/breaker.py, ISSUE "
+        "15): 0=closed, 1=half-open (one parked resume probing), "
+        "2=open (resumes park, router admissions shed, restarts "
+        "continue); every transition also lands as a fleet.breaker "
+        "tracer instant and CLOSED->OPEN dumps the flight recorder"),
     # ---- regression sentinel (PR 10) ----
     "observability.anomaly": (
         "counter", "series=...,kind=drift|burst",

@@ -22,11 +22,12 @@ load, session affinity), transports in ``router.replica``, the process
 in ``router.server``.
 """
 
-from . import placement, replica
+from . import placement, quarantine, replica
 from .placement import Placer, ReplicaState
+from .quarantine import PoisonQuarantine
 from .replica import HttpReplica, InprocReplica, ReplicaClient
 from .server import RouterServer, route_forever
 
 __all__ = ["RouterServer", "route_forever", "ReplicaClient",
            "InprocReplica", "HttpReplica", "Placer", "ReplicaState",
-           "placement", "replica"]
+           "PoisonQuarantine", "placement", "quarantine", "replica"]

@@ -35,7 +35,8 @@ class JournalEntry:
     """One in-flight proxied request's replay state."""
 
     __slots__ = ("trace_id", "session_id", "prompt", "emitted",
-                 "max_tokens", "payload", "resumable", "resumes")
+                 "max_tokens", "payload", "resumable", "resumes",
+                 "sampling")
 
     def __init__(self, trace_id: str, session_id: Optional[str],
                  prompt: Sequence[int], payload: dict,
@@ -50,6 +51,11 @@ class JournalEntry:
         # by load only and cannot be resumed
         self.resumable = bool(self.prompt)
         self.resumes = 0                 # times this entry resumed
+        # the serving replica's advertised sampling config, stamped at
+        # dispatch (ISSUE 15 satellite): resume eligibility is no longer
+        # greedy-only — a survivor with the IDENTICAL seeded positional
+        # sampling config replays bit-exactly too
+        self.sampling: Optional[dict] = None
 
     @property
     def full_tokens(self) -> List[int]:

@@ -85,9 +85,13 @@ class ReplicaState:
         self.digest_gen: Optional[str] = None
         self.digest_epoch: int = -1
         # failover-resume eligibility (ISSUE 14): replaying a journal is
-        # bit-exact only against a greedy replica (advertised in
-        # /statusz engine.sampling); unknown = not eligible
+        # bit-exact against a greedy replica (advertised in /statusz
+        # engine.sampling); unknown = not eligible.  ISSUE 15 lifts the
+        # greedy-only rule: ``sampling`` keeps the full advertised
+        # config — a survivor whose seeded POSITIONAL sampling config
+        # matches the dead replica's replays bit-exactly too.
         self.greedy = False
+        self.sampling: Optional[dict] = None
         self.queue_depth: int = 0       # waiting + busy slots, replica-side
         self.slo_decision: str = "admit"
         self.retry_after_s: int = 1
@@ -157,6 +161,7 @@ class ReplicaState:
         samp = (eng.get("sampling") if isinstance(eng, dict) else None)
         self.greedy = isinstance(samp, dict) and \
             samp.get("do_sample") is False
+        self.sampling = dict(samp) if isinstance(samp, dict) else None
         dig = doc.get("prefix_digest")
         if dig:
             self.page_size = int(dig.get("page_size", 0) or 0)

@@ -71,6 +71,7 @@ from ..serving import http as _http
 from ..serving.slo import jittered_retry_after
 from .journal import SessionJournal
 from .placement import Placer, ReplicaState
+from .quarantine import PoisonQuarantine, request_signature
 from .replica import ReplicaClient
 
 __all__ = ["RouterServer", "route_forever"]
@@ -103,7 +104,7 @@ class _RouterMetrics:
         # jaxlint: disable=JL006 -- bounded by construction: outcome callers pass resumed/unary/finished/ineligible/exhausted literals
         self.resumes = lambda o: m.counter("router.resumes", outcome=o)
         self.shed = m.counter("router.shed")
-        # jaxlint: disable=JL006 -- bounded by construction: decision callers pass admit/queue/shed literals
+        # jaxlint: disable=JL006 -- bounded by construction: decision callers pass admit/shed/unavailable/breaker literals
         self.slo_decision = lambda d: m.counter("router.slo_decision",
                                                 decision=d)
         # jaxlint: disable=JL006 -- bounded by construction: result callers pass ok/fail literals
@@ -153,6 +154,15 @@ class RouterServer:
         # in-flight request, replayed onto a survivor on unplanned death
         self.journal = SessionJournal()
         self._resume_on = bool(f("router_failover_resume"))
+        # poison-request quarantine (ISSUE 15): crash attribution per
+        # request signature — a signature struck FLAGS_router_poison_
+        # strikes times without progress is refused instead of replayed
+        self.quarantine = PoisonQuarantine()
+        # cascade breaker (ISSUE 15): attached by the fleet supervisor
+        # (fleet/breaker.py); None = no breaker, resumes never park
+        self.breaker = None
+        self._park_timeout_s = float(f("router_breaker_park_timeout_s"))
+        self._parked = 0              # resumes currently parked
         self._t0 = time.perf_counter()
         self._next_rid = 0
         self._health_tasks: Dict[str, asyncio.Task] = {}
@@ -483,6 +493,41 @@ class RouterServer:
             pass
         stream = bool(payload.get("stream", False))
 
+        # poison quarantine (ISSUE 15): a signature that has struck out
+        # is refused with a clean 503 BEFORE any replica sees it — the
+        # alternative is another corpse and another restart-budget burn
+        sig = request_signature(prompt, payload) if prompt else None
+        if sig is not None and self.quarantine.quarantined(sig):
+            ra = jittered_retry_after(self.quarantine.refuse(sig))
+            writer.write(_http.error_response(
+                503, "request quarantined: this prompt+sampling "
+                     "signature has crashed "
+                     f"{self.quarantine.strikes} replica(s) "
+                     "(see /statusz quarantine)",
+                err_type="quarantined",
+                extra_headers=(("Retry-After", str(ra)),),
+                fields={"quarantined": True, "retry_after_s": ra}))
+            await writer.drain()
+            return 503
+
+        # cascade breaker (ISSUE 15): while the fleet is dying faster
+        # than the supervisor can attribute it, new admissions shed —
+        # jittered so the herd doesn't re-synchronize on a recovering
+        # fleet; crash restarts continue behind the breaker
+        br = self.breaker
+        if br is not None and br.state == "open":
+            ra = jittered_retry_after(max(1.0, br.cooldown_s))
+            self._m.slo_decision("breaker").inc()
+            writer.write(_http.error_response(
+                503, "cascade breaker open: the fleet's death rate "
+                     "tripped FLAGS_fleet_cascade_threshold "
+                     "(see /statusz breaker)",
+                err_type="overloaded_error",
+                extra_headers=(("Retry-After", str(ra)),),
+                fields={"retry_after_s": ra, "breaker": "open"}))
+            await writer.drain()
+            return 503
+
         await self._refresh_if_stale()
         live = self._candidates(include_shedding=True)
         if not live:
@@ -525,7 +570,8 @@ class RouterServer:
             self._m.streams.inc()
         t_accept = time.perf_counter()
         code = await self._proxy(trace_id, session_id, prompt, payload,
-                                 body, candidates, writer, stream)
+                                 body, candidates, writer, stream,
+                                 sig=sig)
         if _obs.TRACER.enabled:
             _obs.TRACER.event("router.request", t_accept,
                               time.perf_counter() - t_accept,
@@ -535,17 +581,54 @@ class RouterServer:
                                     "prompt_tokens": len(prompt)})
         return code
 
-    def _resume_candidates(self, tried: List[str]) -> List[ReplicaState]:
+    def _resume_candidates(self, tried: List[str],
+                           entry=None) -> List[ReplicaState]:
         """Fresh placement candidates for a replay: live, ready, not yet
-        tried this request, and GREEDY (journal replay is bit-exact only
-        under greedy sampling — a sampled replica cannot continue the
-        stream faithfully)."""
-        return [s for s in self._candidates()
-                if s.id not in tried and s.greedy]
+        tried this request, and replay-exact — GREEDY, or (ISSUE 15
+        satellite) a survivor advertising the IDENTICAL seeded
+        POSITIONAL sampling config as the replica the entry was
+        dispatched on: the positional key stream makes a sampled replay
+        bit-exact there too."""
+        origin = entry.sampling if entry is not None else None
+        seeded = (isinstance(origin, dict) and origin.get("positional")
+                  and origin.get("do_sample"))
+        out = []
+        for s in self._candidates():
+            if s.id in tried:
+                continue
+            if s.greedy or (seeded and s.sampling == origin):
+                out.append(s)
+        return out
+
+    async def _breaker_gate(self) -> Optional[str]:
+        """Park a post-death re-dispatch while the cascade breaker is
+        open (ISSUE 15): replaying dead requests onto survivors is
+        exactly how a cascade propagates.  Returns ``"go"`` (breaker
+        closed/absent), ``"probe"`` (this re-dispatch claimed the
+        half-open probe slot — its outcome decides the breaker), or
+        ``None`` (parked past FLAGS_router_breaker_park_timeout_s:
+        fall back to the PR 7 contract)."""
+        br = self.breaker
+        if br is None or not br.enabled or br.state == "closed":
+            return "go"
+        self._parked += 1
+        try:
+            deadline = time.perf_counter() + self._park_timeout_s
+            while True:
+                state = br.state
+                if state == "closed":
+                    return "go"
+                if state == "half_open" and br.claim_probe():
+                    return "probe"
+                if time.perf_counter() >= deadline:
+                    return None
+                await asyncio.sleep(0.02)
+        finally:
+            self._parked -= 1
 
     async def _proxy(self, trace_id, session_id, prompt, payload, body,
                      candidates: List[ReplicaState], writer,
-                     stream: bool = False) -> int:
+                     stream: bool = False, sig=None) -> int:
         """Place and relay; re-place on connect-phase failure; RESUME on
         post-dispatch death (ISSUE 14).
 
@@ -568,7 +651,7 @@ class RouterServer:
         try:
             return await self._proxy_dispatch(trace_id, session_id,
                                               prompt, body, candidates,
-                                              writer, stream, entry)
+                                              writer, stream, entry, sig)
         finally:
             # unconditional: a client disconnect (ConnectionResetError
             # raising out of a relay write) must not strand the entry
@@ -577,15 +660,22 @@ class RouterServer:
 
     async def _proxy_dispatch(self, trace_id, session_id, prompt, body,
                               candidates: List[ReplicaState], writer,
-                              stream, entry) -> int:
+                              stream, entry, sig=None) -> int:
         tried: List[str] = []
         head_sent = [False]           # flipped by _relay at the SSE head
         resuming = False              # a replay body is in flight
         unary_replayed = False
         died_post_dispatch = False    # a death a replay COULD recover
+        quarantined_out = False       # this signature struck out (15)
+        probe = False                 # this dispatch IS the half-open probe
         max_attempts = 2 * max(1, len(self.states)) + 2
         for _attempt in range(max_attempts):
             if not candidates:
+                break
+            if sig is not None and self.quarantine.quarantined(sig):
+                # struck out (possibly by a concurrent flight of the
+                # same signature): no more corpses
+                quarantined_out = True
                 break
             place_prompt = entry.full_tokens if resuming else prompt
             state, reason = self.placer.place(place_prompt, session_id,
@@ -600,6 +690,8 @@ class RouterServer:
             except Exception:
                 # connect-phase death: this replica is out of the
                 # candidate set NOW; the request re-places on the rest
+                # (no strike — the replica was ALREADY dead; nothing
+                # was dispatched, so this death is not attributable)
                 state.mark_failed()
                 state.failovers += 1
                 self._m.failover("connect").inc()
@@ -607,16 +699,37 @@ class RouterServer:
                 candidates = [s for s in candidates
                               if s.id not in tried]
                 continue
+            if entry is not None and entry.sampling is None:
+                # resume-eligibility evidence (ISSUE 15 satellite): the
+                # sampling config this entry's tokens were produced under
+                entry.sampling = state.sampling
             state.inflight += 1
+            flight_tokens = [False]   # this flight relayed >= 1 token
             try:
                 outcome, status = await self._relay(
                     state, up_reader, trace_id, writer, stream,
-                    entry=entry, head_sent=head_sent)
+                    entry=entry, head_sent=head_sent, sig=sig,
+                    flight_tokens=flight_tokens)
             finally:
                 state.inflight -= 1
                 close()
             if outcome == "done":
+                if probe and self.breaker is not None:
+                    # the probe replica ANSWERED: 200 closes the
+                    # breaker; a non-200 completion (shed, queue
+                    # expiry) is neither death nor health evidence —
+                    # hand the slot back so the next parked resume can
+                    # probe instead of wedging HALF_OPEN forever
+                    if status == 200:
+                        self.breaker.probe_result(True)
+                    else:
+                        self.breaker.release_probe()
+                    probe = False
                 if status == 200:
+                    if sig is not None:
+                        # a completed pass is progress too (a unary
+                        # relay only shows its tokens here)
+                        self.quarantine.progress(sig)
                     if resuming:
                         self._m.resumes("resumed").inc()
                     elif unary_replayed:
@@ -630,9 +743,31 @@ class RouterServer:
             # the upstream died post-dispatch ("dead_prehead": nothing
             # reached the client; "dead_stream": mid-SSE, head is out)
             self._export_replica_gauges()
+            if probe and self.breaker is not None:
+                # the half-open probe died: the breaker re-opens
+                self.breaker.probe_result(False)
+                probe = False
+            if sig is not None and not flight_tokens[0] and \
+                    self.quarantine.strike(sig):
+                # crash attribution (ISSUE 15): a death strikes only the
+                # requests whose CURRENT flight relayed zero tokens —
+                # the death happened at/near their dispatch, which is
+                # the poison shape; a request that was mid-stream when
+                # its replica died is a victim, not a suspect.  This
+                # signature has now struck out (poison_strikes
+                # dispatch-proximate deaths, no progress between) —
+                # replay is refused, not amplified.
+                quarantined_out = True
+                break
             if outcome == "dead_prehead" and stream and not head_sent[0]:
                 # stream died before its head: nothing was sent — a
-                # plain transparent re-place, no replay needed
+                # plain transparent re-place, no replay needed (but the
+                # cascade breaker gates it the same way: a post-death
+                # re-dispatch is a post-death re-dispatch)
+                gate = await self._breaker_gate()
+                if gate is None:
+                    break
+                probe = gate == "probe"
                 candidates = [s for s in candidates if s.id not in tried]
                 continue
             # post-dispatch death with client-visible state (mid-SSE) or
@@ -657,7 +792,15 @@ class RouterServer:
                     await writer.drain()
                     self._m.resumes("finished").inc()
                     return status if head_sent[0] else 200
-            resume_cands = self._resume_candidates(tried)
+            # cascade breaker (ISSUE 15): while the fleet is dying, the
+            # journal entry PARKS instead of replaying — the client's
+            # stream holds; a half-open breaker releases one parked
+            # resume as its probe
+            gate = await self._breaker_gate()
+            if gate is None:
+                break                 # parked out: PR 7 contract below
+            probe = gate == "probe"
+            resume_cands = self._resume_candidates(tried, entry)
             if not resume_cands:
                 break
             candidates = resume_cands
@@ -666,6 +809,28 @@ class RouterServer:
             else:
                 unary_replayed = True   # full re-run of the original body
             entry.resumes += 1
+        if probe and self.breaker is not None:
+            # we claimed the half-open probe but never completed a
+            # replay (candidates ran out / request turned ineligible):
+            # hand the slot back — an unreported probe must not wedge
+            # the breaker half-open forever
+            self.breaker.release_probe()
+        # quarantined (ISSUE 15): refuse cleanly — 503 with a
+        # `quarantined` body when nothing reached the client yet; an
+        # open stream can only be terminated the PR 7 way below
+        if quarantined_out and not head_sent[0]:
+            ra = jittered_retry_after(self.quarantine.refuse(sig))
+            writer.write(_http.error_response(
+                503, "request quarantined: this prompt+sampling "
+                     "signature keeps killing replicas "
+                     "(see /statusz quarantine)",
+                err_type="quarantined",
+                extra_headers=(("Retry-After", str(ra)),),
+                fields={"quarantined": True, "retry_after_s": ra}))
+            await writer.drain()
+            return 503
+        if quarantined_out:
+            self.quarantine.refuse(sig)
         # out of candidates (or replay-ineligible): end the request the
         # PR 7 way — synthesized error for an open stream, 502 otherwise
         if head_sent[0]:
@@ -704,7 +869,8 @@ class RouterServer:
 
     async def _relay(self, state: ReplicaState, up, trace_id,
                      writer, stream: bool = False, entry=None,
-                     head_sent=None) -> Tuple[str, int]:
+                     head_sent=None, sig=None,
+                     flight_tokens=None) -> Tuple[str, int]:
         """Forward one upstream response; returns ``(outcome, status)``.
 
         ``("done", status)`` — fully relayed.  ``("dead_prehead", 0)`` —
@@ -751,6 +917,7 @@ class RouterServer:
             frame = bytearray()
             done_seen = False
             died = False
+            progressed = False        # first relayed token absolves (15)
             while True:
                 line = await up.readline()
                 if not line:          # close-delimited: EOF ends the body
@@ -772,8 +939,10 @@ class RouterServer:
                     continue
                 finish = None
                 toks = ()
-                if data is not None and entry is not None and \
-                        entry.resumable:
+                journaling = entry is not None and entry.resumable
+                if data is not None and \
+                        (journaling or (sig is not None
+                                        and not progressed)):
                     try:
                         choice = json.loads(data)["choices"][0]
                         finish = choice.get("finish_reason")
@@ -781,15 +950,24 @@ class RouterServer:
                     except (ValueError, KeyError, IndexError, TypeError):
                         pass
                 if finish in ("error", "server_shutdown") and \
-                        self._resume_on and entry is not None and \
-                        entry.resumable:
+                        self._resume_on and journaling:
                     # the replica's own crash/shutdown retire path: the
                     # transport survived but the session died — suppress
                     # the error frame and resume instead of relaying it
                     died = True
                     break
                 if toks:
-                    self.journal.record(entry, toks)
+                    if journaling:
+                        self.journal.record(entry, toks)
+                    if flight_tokens is not None:
+                        flight_tokens[0] = True
+                    if not progressed and sig is not None:
+                        # quarantine absolution (ISSUE 15): this replica
+                        # did real work for this signature — an innocent
+                        # co-flier of repeated crashes streams tokens
+                        # between the deaths and never strikes out
+                        self.quarantine.progress(sig)
+                        progressed = True
                 writer.write(bytes(frame))
                 await writer.drain()
                 frame.clear()
@@ -849,6 +1027,11 @@ class RouterServer:
                     for o in ("resumed", "unary", "finished",
                               "ineligible", "exhausted")},
             },
+            # poison quarantine + cascade breaker (ISSUE 15)
+            "quarantine": self.quarantine.state(),
+            "breaker": (self.breaker.state_dict()
+                        if self.breaker is not None else None),
+            "parked_resumes": self._parked,
             "failover": {
                 "connect": int(_obs.metrics.counter(
                     "router.failover", phase="connect").value),

@@ -68,7 +68,7 @@ class _HttpMetrics:
     serving-engine idiom)."""
 
     __slots__ = ("requests", "streams", "responses", "inflight",
-                 "request_ms")
+                 "request_ms", "queue_expired")
 
     def __init__(self):
         m = _obs.metrics
@@ -79,6 +79,10 @@ class _HttpMetrics:
                                                 code=str(code))
         self.inflight = m.gauge("serving.http.inflight")
         self.request_ms = m.histogram("serving.http.request_ms")
+        # queue-expiry shedding (ISSUE 15): requests retired from the
+        # inbox with 504 before dispatch — prefill never spent on a
+        # client that already gave up
+        self.queue_expired = m.counter("serving.http.queue_expired")
 
 
 class _Stream:
@@ -151,6 +155,9 @@ class ServingServer:
         self.sentinel: Optional[_obs.Sentinel] = sentinel or None
         self._watchdog = watchdog     # CommTaskManager or None
         self._poll_s = poll_s
+        # queue-expiry shedding (ISSUE 15): a request still waiting in
+        # the engine inbox past this is retired 504 pre-dispatch
+        self._queue_timeout_s = float(flags.flag("serving_queue_timeout_s"))
         self._inbox: "queue.SimpleQueue[_Stream]" = queue.SimpleQueue()
         # engine control ops (ISSUE 14): arbitrary fn(engine) calls
         # marshalled onto the engine thread between steps — the seam the
@@ -389,6 +396,24 @@ class ServingServer:
                                        trace_id=h.trace_id)
                     self._live.append(h)
                 self._run_control(eng)
+                if self._queue_timeout_s > 0 and self._live:
+                    # queue-expiry shedding (ISSUE 15): a request that
+                    # admission hasn't picked up inside the bound is
+                    # retired 504 BEFORE its prefill is spent — the
+                    # client behind it gave up long ago; an admitted
+                    # request is past the point of free cancellation
+                    # and runs out (continuous batching has no cheap
+                    # mid-flight cancel)
+                    now = time.perf_counter()
+                    for h in list(self._live):
+                        if h.req is not None and not h.req.done and \
+                                now - h.t_accept > self._queue_timeout_s \
+                                and eng.cancel_waiting(h.req):
+                            self._m.queue_expired.inc()
+                            self._live.remove(h)
+                            h.post(("done",
+                                    {"finish_reason": "queue_expired",
+                                     "n": 0}))
                 if eng.has_work():
                     if wd is not None:
                         tid = wd.begin("serving.engine_step")
@@ -920,6 +945,18 @@ class ServingServer:
             else:
                 finish = payload["finish_reason"]
                 break
+        if finish == "queue_expired":
+            # queue-expiry shedding (ISSUE 15): the request waited in
+            # the inbox past FLAGS_serving_queue_timeout_s and was
+            # retired before dispatch — 504, zero prefill spent
+            writer.write(_http.error_response(
+                504, "request expired in queue before dispatch "
+                     f"(FLAGS_serving_queue_timeout_s="
+                     f"{self._queue_timeout_s})",
+                err_type="timeout_error",
+                extra_headers=(("X-Request-Id", h.trace_id),)))
+            await writer.drain()
+            return 504
         if finish in ("error", "server_shutdown"):
             # the engine died (or shut down) before this request finished:
             # headers are not out yet on the unary path, so report it as
@@ -973,10 +1010,16 @@ class ServingServer:
                 "slots": eng.B,
                 "streams_live": len(self._live),
                 # the router's failover-resume eligibility check (ISSUE
-                # 14): replaying a journal is bit-exact only for greedy
-                # sampling, and a seeded replay needs the seed
+                # 14/15): greedy replays are bit-exact anywhere; sampled
+                # replays are bit-exact on a survivor with the IDENTICAL
+                # seeded positional config — advertise the whole thing
                 "sampling": {"do_sample": bool(eng.gen_cfg.do_sample),
-                             "seed": int(eng.gen_cfg.seed)},
+                             "seed": int(eng.gen_cfg.seed),
+                             "temperature": float(
+                                 eng.gen_cfg.temperature),
+                             "top_k": int(eng.gen_cfg.top_k),
+                             "top_p": float(eng.gen_cfg.top_p),
+                             "positional": True},
             },
             # router placement inputs (ISSUE 7): which prefixes this
             # replica holds, as chain hashes a router scores against —

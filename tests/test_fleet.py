@@ -699,3 +699,131 @@ def test_fleet_launcher_arg_surface():
         assert flags.flag("fleet_restart_budget") == 5
     finally:
         flags.set_flags({"fleet_restart_budget": old})
+
+
+# ---------------------------------------------------------------------------
+# cascade breaker (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def test_cascade_breaker_state_machine_fake_clock():
+    """closed -> open past the death-rate threshold, open -> half-open
+    after a death-free cooldown, half-open -> closed on probe survival
+    / -> open on probe death; the sliding window forgets old deaths;
+    the fleet.breaker_state gauge tracks every transition."""
+    from paddle_tpu.fleet import CascadeBreaker
+    clock = Clock()
+    br = CascadeBreaker(threshold=3, window_s=10.0, cooldown_s=5.0,
+                        clock=clock)
+    g = obs.metrics.gauge("fleet.breaker_state")
+    assert br.state == "closed" and g.value == 0
+    br.record_death()
+    clock.t = 1.0
+    br.record_death()
+    assert br.state == "closed"            # 2 < threshold
+    clock.t = 2.0
+    br.record_death()
+    assert br.state == "open" and g.value == 2
+    # deaths keep it open; cooldown is measured from the LAST death,
+    # not the trip — an ongoing cascade keeps postponing the probe
+    clock.t = 4.0
+    br.record_death()
+    clock.t = 7.0                          # trip+5 but death+3: still open
+    assert br.update() == "open"
+    clock.t = 8.9
+    assert br.update() == "open"
+    clock.t = 9.0
+    assert br.update() == "half_open" and g.value == 1
+    # exactly one probe slot
+    assert br.claim_probe()
+    assert not br.claim_probe()
+    br.probe_result(False)                 # probe died: re-open
+    assert br.state == "open" and g.value == 2
+    clock.t = 14.5                         # probe death at 9.0 + cooldown
+    assert br.update() == "half_open"
+    assert br.claim_probe()
+    br.probe_result(True)                  # probe survived: close
+    assert br.state == "closed" and g.value == 0
+    # the window slides: two old deaths + one fresh stay closed
+    clock.t = 100.0
+    br.record_death()
+    br.record_death()
+    clock.t = 130.0
+    br.record_death()
+    assert br.state == "closed"
+    assert br.state_dict()["deaths_in_window"] == 1
+    # a death while half-open re-opens without probe_result
+    clock.t = 200.0
+    br.record_death()
+    br.record_death()
+    br.record_death()
+    assert br.state == "open"
+    clock.t = 206.0
+    br.update()
+    assert br.state == "half_open"
+    br.record_death()
+    assert br.state == "open"
+    # an abandoned probe claim is released, never wedging half-open
+    clock.t = 211.5                        # past the re-open's cooldown
+    br.update()
+    assert br.state == "half_open"
+    assert br.claim_probe() and not br.claim_probe()
+    br.release_probe()                     # claimer had no candidates
+    assert br.claim_probe()                # slot available again
+    br.probe_result(True)
+    # disabled breaker never opens
+    off = CascadeBreaker(threshold=0, clock=clock)
+    for _ in range(10):
+        off.record_death()
+    assert off.state == "closed" and not off.enabled
+
+
+def test_supervisor_deaths_trip_breaker_and_restarts_continue():
+    """The supervisor's crash paths feed the breaker; an OPEN breaker
+    never blocks crash-restarts (capacity rebuilds BEHIND it), the
+    router sees the shared breaker object, and sup.state() carries its
+    state_dict."""
+    from paddle_tpu.fleet import CascadeBreaker
+    clock = Clock()
+    br = CascadeBreaker(threshold=2, window_s=100.0, cooldown_s=50.0,
+                        clock=clock)
+    sup, router, handles = _sup(2, clock=clock, breaker=br,
+                                backoff_base_s=1.0, restart_budget=5)
+    assert router.breaker is br            # the shared object
+    sup.start()
+    for slot in sup._slots:
+        slot.handle.ready_now = True
+    sup.tick()                             # both register READY
+    assert br.state == "closed"
+    for slot in sup._slots:
+        slot.handle.die()
+    clock.t = 1.0
+    sup.tick()                             # two deaths in one window
+    assert br.state == "open"
+    assert sup.state()["breaker"]["state"] == "open"
+    assert sup.state()["breaker"]["deaths_in_window"] == 2
+    # restarts continue while open
+    clock.t = 2.5                          # past the 1s backoff
+    actions = sup.tick()
+    assert ("restart", "fs0") in actions and ("restart", "fs1") in actions
+    assert br.state == "open"              # restarting != recovered
+    # a death-free cooldown half-opens it (tick drives update())
+    clock.t = 60.0
+    sup.tick()
+    assert br.state == "half_open"
+    br.claim_probe()
+    br.probe_result(True)
+    assert br.state == "closed"
+
+
+def test_supervisor_builds_flag_breaker_by_default():
+    """breaker=None builds a flag-configured CascadeBreaker on the
+    supervisor's own clock and attaches it to the router;
+    breaker=False disables the whole plane."""
+    sup, router, _ = _sup(1)
+    assert sup.breaker is not None
+    assert router.breaker is sup.breaker
+    assert sup.breaker.threshold == int(flags.flag(
+        "fleet_cascade_threshold"))
+    sup2, router2, _ = _sup(1, breaker=False)
+    assert sup2.breaker is None
+    assert router2.breaker is None or router2.breaker is not sup2.breaker

@@ -215,8 +215,10 @@ def test_abort_mid_transfer_leaves_no_refs(model, oracle):
 
 
 def test_partial_snapshot_imports_contiguous_prefix(model):
-    """A truncated page list (the chaos partial_transfer shape) imports
-    as a shorter contiguous chain; non-contiguous tails are dropped."""
+    """An UNSTAMPED truncated page list (a hand-built partial snapshot,
+    digest stripped) imports as a shorter contiguous chain;
+    non-contiguous tails are dropped.  (A digest-stamped truncation is
+    REJECTED instead — see the integrity tests below.)"""
     a = _engine(model)
     req = a.submit(list(range(1, 34)), max_new_tokens=2)  # 4 full pages
     while not req.done:
@@ -226,16 +228,69 @@ def test_partial_snapshot_imports_contiguous_prefix(model):
     n = len(snap["pages"])
     assert n >= 4
     cut = dict(snap, pages=snap["pages"][: n // 2])
+    cut.pop("digest")                 # hand-built partial, not corruption
     b = _engine(model)
     res = mig.import_session(b, cut)
     assert res["imported"] == n // 2
     _books_balanced(b)
     # a gap in the page list ends the chain (no orphan nodes)
     gappy = dict(snap, pages=[snap["pages"][0], snap["pages"][2]])
+    gappy.pop("digest")
     c = _engine(model)
     res = mig.import_session(c, gappy)
     assert res["imported"] == 1
     _books_balanced(c)
+
+
+def test_corrupt_snapshot_rejected_zero_refs(model):
+    """ISSUE 15 satellite: export stamps a blake2b integrity digest;
+    import verifies it BEFORE touching the allocator.  A truncated or
+    bit-flipped snapshot is rejected — MigrationError, nothing
+    installed, the allocator books balance, and the
+    serving.kv.migration_rejected counter says so."""
+    import numpy as np
+    a = _engine(model)
+    req = a.submit(list(range(1, 34)), max_new_tokens=2)
+    while not req.done:
+        a.step()
+    a._drain()
+    snap = mig.export_session(a, tokens=list(range(1, 34)))
+    assert snap["digest"] == mig.snapshot_digest(snap)
+    # the wire codec preserves both the digest and its validity
+    wire = mig.to_wire(snap)
+    assert wire["digest"] == snap["digest"]
+    assert mig.snapshot_digest(mig.from_wire(wire)) == snap["digest"]
+
+    rej0 = int(obs.metrics.counter("serving.kv.migration_rejected").value)
+    b = _engine(model)
+    free0 = b.g.cache.allocator.free_pages
+
+    # truncated page list: the partial_transfer chaos shape
+    cut = dict(snap, pages=snap["pages"][:2])
+    with pytest.raises(mig.MigrationError, match="digest"):
+        mig.import_session(b, cut)
+    # corrupt plane bytes: bit-rot on the wire
+    bad = mig.from_wire(json.loads(json.dumps(wire)))
+    planes = list(bad["pages"][0]["planes"])
+    flipped = np.array(planes[0], copy=True)
+    flipped.flat[0] = np.bitwise_xor(
+        flipped.flat[0], np.array(1, flipped.dtype)) \
+        if flipped.dtype.kind in "iu" else flipped.flat[0] + 1.0
+    planes[0] = flipped
+    bad["pages"][0] = dict(bad["pages"][0], planes=tuple(planes))
+    with pytest.raises(mig.MigrationError, match="digest"):
+        mig.import_session(b, bad)
+
+    # zero pages installed, zero refs leaked, rejections counted
+    assert b.g.cache.allocator.free_pages == free0
+    assert b.prefix_cache.cached_pages() == 0
+    assert b.stats()["migration_rejected"] == 2
+    assert int(obs.metrics.counter(
+        "serving.kv.migration_rejected").value) == rej0 + 2
+    # the intact snapshot still imports fine afterwards
+    res = mig.import_session(b, snap)
+    assert res["imported"] == len(snap["pages"])
+    _books_balanced(b)
 
 
 # ---------------------------------------------------------------------------
@@ -678,9 +733,12 @@ def test_drain_migration_ships_sessions_to_successor(model):
 
 
 def test_chaos_migrate_interrupt_and_partial_transfer(model):
-    """The new fault kinds: an interrupted transfer installs nothing
-    and leaks nothing; a partial transfer installs the shorter chain —
-    and neither ever blocks the drain itself."""
+    """The drain-migration fault kinds: an interrupted transfer
+    installs nothing and leaks nothing; a partial (truncated) transfer
+    no longer matches its export-stamped integrity digest, so the
+    importer REJECTS it (ISSUE 15: migration failed + migration_rejected
+    counted, zero pages installed) — and neither ever blocks the drain
+    itself."""
     from paddle_tpu.fleet import ChaosController, ChaosPlan, FaultEvent
     obs.reset("fleet.")
     plan = ChaosPlan([FaultEvent(100, "migrate_interrupt", "fs0"),
@@ -709,8 +767,11 @@ def test_chaos_migrate_interrupt_and_partial_transfer(model):
     finally:
         sup.shutdown(drain=False, timeout_s=5.0)
 
-    # partial transfer: half of each snapshot's pages still install
+    # partial transfer: the truncated snapshots fail their integrity
+    # digests — the successor rejects them all (nothing installed, no
+    # refs leaked) and the drain still completes clean
     obs.reset("fleet.")
+    rej0 = int(obs.metrics.counter("serving.kv.migration_rejected").value)
     plan = ChaosPlan([FaultEvent(100, "partial_transfer", "fs0"),
                       FaultEvent(100, "partial_transfer", "fs1")])
     chaos = ChaosController(plan)
@@ -729,8 +790,122 @@ def test_chaos_migrate_interrupt_and_partial_transfer(model):
 
         asyncio.run(drive())
         assert int(obs.metrics.counter("fleet.migrations",
-                                       outcome="ok").value) == 1
+                                       outcome="failed").value) == 1
+        assert int(obs.metrics.counter(
+            "serving.kv.migration_rejected").value) > rej0
         surv = sup._slots[0].handle
+        assert surv.server.engine.stats().get("migration_imports", 0) == 0
         _books_balanced(surv.server.engine)
     finally:
         sup.shutdown(drain=False, timeout_s=5.0)
+
+
+# ---------------------------------------------------------------------------
+# slow tier: ProcessReplicaHandle's HTTP /migratez path over real sockets
+# (ROADMAP: the in-process path is the only tier-1-gated one)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_process_replica_http_migrate_path_end_to_end():
+    """Two launcher-spawned replica processes: ProcessReplicaHandle
+    exports every live session from A over POST /migratez/export and
+    imports into B over /migratez/import — the wire codec, the
+    export-stamped integrity digest, and the successor's import books
+    all exercised over real sockets (plus a corrupt-transfer rejection
+    on the same path)."""
+    import http.client
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    from paddle_tpu.fleet import ProcessReplicaHandle
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    ports = [free_port(), free_port()]
+    argv = lambda port: [
+        sys.executable, "-m", "paddle_tpu.serving", "--port", str(port),
+        "--max-batch", "2", "--max-seq-len", "256", "--page-size", "8",
+        "--prefill-bucket", "16", "--max-new-tokens", "64",
+        "--prefix-cache", "--seed", "0"]
+    procs = [subprocess.Popen(argv(p),
+                              env={**os.environ, "JAX_PLATFORMS": "cpu"})
+             for p in ports]
+    handles = [ProcessReplicaHandle(f"p{i}", "127.0.0.1", p)
+               for i, p in enumerate(ports)]
+    handles[0].proc, handles[1].proc = procs
+    try:
+        deadline = time.time() + 600
+        while not all(h.ready() for h in handles):
+            assert time.time() < deadline, "replicas never became ready"
+            assert all(p.poll() is None for p in procs), \
+                "a replica died during warmup"
+            time.sleep(0.5)
+
+        # a long stream holds a live session on A while we export it
+        conn = http.client.HTTPConnection("127.0.0.1", ports[0],
+                                          timeout=120)
+        conn.request("POST", "/v1/completions", json.dumps(
+            {"prompt": list(range(1, 18)), "max_tokens": 48,
+             "stream": True}).encode())
+        resp = conn.getresponse()
+        assert resp.status == 200
+        # wait for a couple of drained chunks so >= 1 full page exists
+        got = bytearray()
+        while got.count(b"data: ") < 3:
+            line = resp.fp.readline()
+            assert line, "stream ended before enough chunks"
+            got += line
+
+        snaps = handles[0].export_sessions()
+        assert len(snaps) == 1
+        snap = snaps[0]
+        assert snap["digest"]              # integrity-stamped on the wire
+        assert snap["pages"], "no pages exported"
+        assert snap["sampling"]["do_sample"] is False
+
+        # corrupt transfer: truncated page list must be REJECTED by B
+        cut = dict(snap, pages=snap["pages"][:1]) \
+            if len(snap["pages"]) > 1 else None
+        if cut is not None:
+            res = handles[1].import_sessions([cut])
+            assert res["sessions"] == 0 and res["aborted"] == 1
+
+        # the intact snapshot installs
+        res = handles[1].import_sessions([snap])
+        assert res["sessions"] == 1
+        assert res["imported"] >= 1
+        conn.close()                       # done with A's stream
+
+        # a follow-up turn on B rides the migrated pages (prefix hit,
+        # not recompute) — and its drain refreshes the /statusz stats
+        c2 = http.client.HTTPConnection("127.0.0.1", ports[1],
+                                        timeout=120)
+        c2.request("POST", "/v1/completions", json.dumps(
+            {"prompt": snap["tokens"], "max_tokens": 4}).encode())
+        r2 = c2.getresponse()
+        assert r2.status == 200
+        r2.read()
+        c2.close()
+
+        # B's books say imported (scraped off its real /statusz)
+        c3 = http.client.HTTPConnection("127.0.0.1", ports[1],
+                                        timeout=10)
+        c3.request("GET", "/statusz")
+        doc = json.loads(c3.getresponse().read())
+        c3.close()
+        eng = doc["engine"]
+        assert eng.get("migration_imports", 0) >= 1
+        assert eng.get("migration_imported_pages", 0) >= 1
+        assert eng.get("prefix_hits", 0) >= 1      # served, not recomputed
+        if cut is not None:
+            assert eng.get("migration_rejected", 0) == 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()

@@ -730,3 +730,275 @@ def test_launcher_arg_surfaces():
     assert (reps[1].host, reps[1].port) == ("h2", 8002)
     with pytest.raises(SystemExit):
         parse_replicas(["nocolon"])
+
+
+# ---------------------------------------------------------------------------
+# poison quarantine + cascade breaker (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def test_poison_quarantine_unit_fake_clock():
+    """Strike/TTL/absolution semantics on an injected clock: strikes
+    accumulate per signature, progress resets them (the innocent
+    co-flier contract), striking out quarantines for the TTL, and
+    expiry re-admits on probation."""
+    from paddle_tpu.router.quarantine import (PoisonQuarantine,
+                                              request_signature)
+    obs.reset("router.quarantine")
+    clock = [0.0]
+    q = PoisonQuarantine(strikes=2, ttl_s=10.0, clock=lambda: clock[0])
+    sig = request_signature([1, 2, 3], {"max_tokens": 8})
+    # same prompt, same sampling => same signature; different => not
+    assert sig == request_signature([1, 2, 3], {"max_tokens": 8,
+                                                "stream": True})
+    assert sig != request_signature([1, 2, 3], {"max_tokens": 9})
+    assert sig != request_signature([1, 2, 4], {"max_tokens": 8})
+
+    # innocent co-flier: strike, progress, strike, progress — never out
+    assert not q.strike(sig)
+    q.progress(sig)
+    assert not q.strike(sig)
+    q.progress(sig)
+    assert not q.quarantined(sig)
+    # poison: two strikes with NO progress in between => quarantined
+    assert not q.strike(sig)
+    assert q.strike(sig)
+    assert q.quarantined(sig)
+    # progress cannot un-quarantine (the verdict holds for the TTL)
+    q.progress(sig)
+    assert q.quarantined(sig)
+    assert q.refuse(sig) >= 1
+    # TTL expiry re-admits
+    clock[0] = 10.1
+    assert not q.quarantined(sig)
+    # stale strikes expire too (anchor = last strike)
+    sig2 = request_signature([7], {})
+    q.strike(sig2)
+    clock[0] = 30.0
+    assert not q.strike(sig2)            # old strike aged out: count is 1
+    c = obs.metrics.counter
+    assert int(c("router.quarantine", action="quarantined").value) == 1
+    assert int(c("router.quarantine", action="strike").value) >= 4
+    # disabled quarantine never strikes
+    off = PoisonQuarantine(strikes=0, ttl_s=10.0)
+    assert not off.strike(sig) and not off.quarantined(sig)
+
+
+def test_poison_request_quarantined_fleet_survives(model):
+    """ISSUE 15 tentpole e2e: a request that kills its replica AT
+    DISPATCH (the chaos `poison` fault) kills at most
+    FLAGS_router_poison_strikes replicas, ends quarantined with a clean
+    503 + `quarantined` error body, its re-submit is refused
+    deterministically, and a concurrent healthy stream still
+    bit-matches the no-fault oracle."""
+    from paddle_tpu.fleet import ChaosController, ChaosPlan, FaultEvent
+    obs.reset("router.")
+    eng = _engine(model, gen=GenerationConfig(max_new_tokens=64))
+    rid = eng.add_request(list(PROMPTS[0]))
+    full_oracle = eng.run()[rid]
+
+    servers = [ServingServer(
+        _engine(model, gen=GenerationConfig(max_new_tokens=64)),
+        slo=False, flight_recorder=False).start() for _ in range(3)]
+    replicas = [InprocReplica(f"r{i}", s)
+                for i, s in enumerate(servers)]
+    poison = [6, 6, 6, 6]
+    plan = ChaosPlan([FaultEvent(0, "poison",
+                                 " ".join(str(t) for t in poison))])
+    chaos = ChaosController(plan)
+    router = RouterServer([chaos.wrap(r) for r in replicas],
+                          health_interval_s=1e9)
+    chaos.advance(0)                     # arm the poison prompt
+    try:
+        async def main():
+            await router.poll_replicas()
+            # a healthy long stream in flight while the poison lands
+            r = asyncio.StreamReader()
+            r.feed_data(http_bytes(
+                "POST", "/v1/completions",
+                completion_body(list(PROMPTS[0]), 64, stream=True)))
+            r.feed_eof()
+            from test_serving_http import MemWriter
+            w = MemWriter()
+            ht = asyncio.create_task(router.handle(r, w))
+            deadline = time.perf_counter() + 60
+            while b"data: " not in w.buf:
+                assert time.perf_counter() < deadline, "no first chunk"
+                await asyncio.sleep(0.005)
+            p1 = await completions_via(router, poison, 8, stream=True)
+            await asyncio.wait_for(ht, 60)
+            p2 = await completions_via(router, poison, 8, stream=False)
+            statusz = await do(router, "GET", "/statusz")
+            return w.buf, p1, p2, statusz
+
+        raw, (p1st, _, p1body), (p2st, _, p2body), statusz = \
+            asyncio.run(main())
+        # the poison killed exactly poison_strikes replicas, then the
+        # quarantine refused to feed it a third
+        from paddle_tpu import flags as _flags
+        strikes = int(_flags.flag("router_poison_strikes"))
+        assert len(chaos.poison_kills) == strikes
+        assert p1st == 503
+        doc = json.loads(p1body)
+        assert doc["error"]["type"] == "quarantined"
+        assert doc["error"]["quarantined"] is True
+        assert doc["error"]["retry_after_s"] >= 1
+        # the re-submit is a deterministic clean refusal: 0 new kills
+        assert p2st == 503
+        assert json.loads(p2body)["error"]["type"] == "quarantined"
+        assert len(chaos.poison_kills) == strikes
+        c = obs.metrics.counter
+        assert int(c("router.quarantine",
+                     action="quarantined").value) == 1
+        assert int(c("router.quarantine", action="strike").value) >= 2
+        assert int(c("router.quarantine", action="refused").value) >= 2
+        # the concurrent healthy stream is untouched (or resumed):
+        # bit-identical to the no-fault oracle either way
+        status, _, body = split_response(raw)
+        assert status == 200
+        chunks = sse_chunks(body)
+        finishes = [c["choices"][0]["finish_reason"] for c in chunks
+                    if c["choices"][0]["finish_reason"]]
+        toks = [t for c in chunks
+                for t in c["choices"][0]["token_ids"]]
+        assert finishes and finishes[-1] in ("stop", "length")
+        assert toks == full_oracle
+        # statusz carries the quarantine state
+        qdoc = json.loads(statusz[2])["quarantine"]
+        assert qdoc["quarantined"] == 1 and qdoc["refused_total"] >= 2
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_breaker_open_sheds_new_admissions(model):
+    """An OPEN cascade breaker sheds new router admissions with a
+    jittered Retry-After (counted router.slo_decision{decision=
+    breaker}); closing it re-admits."""
+    from paddle_tpu.fleet import CascadeBreaker
+    obs.reset("router.")
+    fleet = Fleet(model, n=1)
+    clock = [0.0]
+    br = CascadeBreaker(threshold=1, window_s=60.0, cooldown_s=60.0,
+                        clock=lambda: clock[0])
+    br.record_death()
+    assert br.state == "open"
+    fleet.router.breaker = br
+    try:
+        st, hd, body = asyncio.run(
+            completions_via(fleet.router, PROMPTS[0], 4))
+        assert st == 503
+        doc = json.loads(body)
+        assert doc["error"]["breaker"] == "open"
+        assert 1 <= doc["error"]["retry_after_s"] <= 60
+        assert "retry-after" in hd
+        assert int(obs.metrics.counter(
+            "router.slo_decision", decision="breaker").value) == 1
+        # half-open / closed re-admit
+        clock[0] = 61.0
+        br.update()
+        assert br.state == "half_open"
+        st2, _, b2 = asyncio.run(
+            completions_via(fleet.router, PROMPTS[0], 4))
+        assert st2 == 200
+        assert json.loads(b2)["choices"][0]["token_ids"]
+    finally:
+        fleet.close()
+
+
+def test_breaker_parks_resume_until_half_open_probe_closes(model):
+    """ISSUE 15: a mid-stream death while the breaker is OPEN does not
+    replay — the journal entry PARKS; once the cooldown passes, the
+    half-open breaker releases it as the probe; the probe survives,
+    the breaker closes, and the client's stream is STILL unbroken and
+    bit-identical to the no-fault oracle."""
+    from paddle_tpu.fleet import CascadeBreaker
+    obs.reset("router.")
+    eng = _engine(model, gen=GenerationConfig(max_new_tokens=64))
+    rid = eng.add_request(list(PROMPTS[0]))
+    full_oracle = eng.run()[rid]
+    fleet = Fleet(model, n=2)
+    br = CascadeBreaker(threshold=1, window_s=60.0, cooldown_s=0.25)
+    fleet.router.breaker = br
+    try:
+        async def main():
+            r = asyncio.StreamReader()
+            r.feed_data(http_bytes(
+                "POST", "/v1/completions",
+                completion_body(list(PROMPTS[0]), 64, stream=True)))
+            r.feed_eof()
+            from test_serving_http import MemWriter
+            w = MemWriter()
+            task = asyncio.create_task(fleet.router.handle(r, w))
+            deadline = time.perf_counter() + 60
+            while b"data: " not in w.buf:
+                assert time.perf_counter() < deadline, "no first chunk"
+                await asyncio.sleep(0.005)
+            _, victim_headers, _ = split_response(w.buf)
+            victim = victim_headers["x-router-replica"]
+            # the death trips the breaker BEFORE the router can resume
+            br.record_death()
+            assert br.state == "open"
+            for rep in fleet.replicas:
+                if rep.id == victim:
+                    rep.kill()
+            # drive time-based transitions like the supervisor tick
+            saw_parked = False
+            while not task.done():
+                br.update()
+                if fleet.router._parked > 0:
+                    saw_parked = True
+                await asyncio.sleep(0.02)
+            await task
+            return w.buf, saw_parked
+
+        raw, saw_parked = asyncio.run(main())
+        status, _, body = split_response(raw)
+        assert status == 200
+        assert saw_parked                    # the resume really parked
+        chunks = sse_chunks(body)
+        finishes = [c["choices"][0]["finish_reason"] for c in chunks
+                    if c["choices"][0]["finish_reason"]]
+        toks = [t for c in chunks
+                for t in c["choices"][0]["token_ids"]]
+        assert finishes and finishes[-1] in ("stop", "length")
+        assert toks == full_oracle           # unbroken, bit-identical
+        assert br.state == "closed"          # the probe closed it
+        assert obs.metrics.counter("router.resumes",
+                                   outcome="resumed").value >= 1
+    finally:
+        fleet.close()
+
+
+def test_sampled_session_resumes_on_matching_seeded_survivor(model):
+    """ISSUE 15 satellite: the greedy-only resume eligibility is
+    lifted — positional sampling keys make a SAMPLED replay bit-exact
+    on a survivor with the identical seeded config, so a mid-stream
+    kill resumes seed-deterministically and matches the no-fault
+    sampled oracle."""
+    obs.reset("router.")
+    gen = GenerationConfig(max_new_tokens=48, do_sample=True,
+                           temperature=0.9, top_k=16, seed=11)
+    eng = _engine(model, gen=GenerationConfig(**gen.__dict__))
+    rid = eng.add_request(list(PROMPTS[0]))
+    full_oracle = eng.run()[rid]
+    fleet = Fleet(model, n=2,
+                  engine_kw={"gen": GenerationConfig(**gen.__dict__)})
+    try:
+        raw, victim, (s2, h2, b2), healthz, statusz = \
+            _run_kill_mid_stream(fleet, PROMPTS[0], 48)
+        status, headers, body = split_response(raw)
+        assert status == 200
+        chunks = sse_chunks(body)
+        finishes = [c["choices"][0]["finish_reason"] for c in chunks
+                    if c["choices"][0]["finish_reason"]]
+        toks = [t for c in chunks for t in c["choices"][0]["token_ids"]]
+        assert finishes and finishes[-1] in ("stop", "length"), finishes
+        assert toks == full_oracle           # sampled, still bit-exact
+        assert obs.metrics.counter("router.resumes",
+                                   outcome="resumed").value >= 1
+        doc = json.loads(statusz[2])
+        # the replicas advertise the full positional sampling config
+        for rep in doc["replicas"]:
+            assert rep["greedy"] is False
+    finally:
+        fleet.close()

@@ -819,3 +819,82 @@ def test_socket_round_trip(model, oracle):
 
     toks = asyncio.run(main())
     assert toks == oracle[tuple(PROMPTS[0])]
+
+
+# ---------------------------------------------------------------------------
+# queue-expiry shedding (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+def test_queue_expired_request_retired_504_before_dispatch(model):
+    """A request still WAITING in the engine inbox past
+    FLAGS_serving_queue_timeout_s is retired with 504 before any
+    prefill is spent (serving.http.queue_expired counts it); the
+    request occupying the slot finishes normally, and an admitted
+    request is never expired."""
+    obs.reset("serving.http.")
+    old = flags.get_flags(["serving_queue_timeout_s"])
+    flags.set_flags({"serving_queue_timeout_s": 0.05})
+    try:
+        # one slot: the first request parks the second in eng.waiting
+        server = ServingServer(
+            _engine(model, max_batch=1,
+                    gen=GenerationConfig(max_new_tokens=24)),
+            slo=False, flight_recorder=False).start()
+    finally:
+        flags.set_flags(old)
+    try:
+        async def main():
+            first = asyncio.ensure_future(do(
+                server, "POST", "/v1/completions",
+                completion_body(list(PROMPTS[0]), 24)))
+            # let the first admit (occupy the only slot)
+            deadline = time.perf_counter() + 30
+            while not any(r is not None
+                          for r in server.engine.slot_req):
+                assert time.perf_counter() < deadline
+                await asyncio.sleep(0.005)
+            second = asyncio.ensure_future(do(
+                server, "POST", "/v1/completions",
+                completion_body(list(PROMPTS[1]), 4)))
+            st2, _, body2 = await second
+            st1, _, body1 = await first
+            return st1, body1, st2, body2
+
+        st1, body1, st2, body2 = asyncio.run(main())
+        # the queued request expired 504 with zero prefill spent
+        assert st2 == 504
+        doc = json.loads(body2)
+        assert doc["error"]["type"] == "timeout_error"
+        assert "expired in queue" in doc["error"]["message"]
+        # the slot-holder finished normally
+        assert st1 == 200
+        assert json.loads(body1)["choices"][0]["finish_reason"] in (
+            "stop", "length")
+        assert int(obs.metrics.counter(
+            "serving.http.queue_expired").value) == 1
+        # the expired request never touched the engine's books
+        assert len(server.engine.waiting) == 0
+    finally:
+        server.close()
+
+
+def test_queue_expiry_off_by_default(model):
+    """serving_queue_timeout_s defaults to 0 (disabled): queued
+    requests wait out admission however long it takes."""
+    assert float(flags.flag("serving_queue_timeout_s")) == 0.0
+    server = ServingServer(_engine(model, max_batch=1), slo=False,
+                           flight_recorder=False).start()
+    try:
+        async def main():
+            a = asyncio.ensure_future(do(
+                server, "POST", "/v1/completions",
+                completion_body(list(PROMPTS[0]), 6)))
+            b = asyncio.ensure_future(do(
+                server, "POST", "/v1/completions",
+                completion_body(list(PROMPTS[1]), 6)))
+            return await a, await b
+
+        (sta, _, _), (stb, _, _) = asyncio.run(main())
+        assert sta == 200 and stb == 200
+    finally:
+        server.close()

@@ -800,3 +800,28 @@ def test_package_report_shape():
     assert rep["analyzer"] == "jaxlint"
     assert rep["version"] == analysis.__version__
     assert rep["counts"] == {} and rep["findings"] == []
+
+
+def test_jl005_jl007_cover_issue15_modules():
+    """ISSUE 15 satellite: the quarantine (router/) and cascade-breaker
+    (fleet/) modules live on the router's event-loop plane — JL005
+    (blocking calls in async defs) and JL007 (engine single-ownership)
+    scope to them exactly like the rest of their packages."""
+    for rel in ("paddle_tpu/router/quarantine.py",
+                "paddle_tpu/fleet/breaker.py"):
+        ctx = lint(_ASYNC_POS, rel=rel, select={"JL005"})
+        assert len(ctx.findings) == 3, rel
+        ctx = lint("""
+            async def probe(self):
+                self.engine.step()
+        """, rel=rel, select={"JL007"})
+        assert len(ctx.findings) == 1, rel
+    # their sync verbs (supervisor-thread callers) stay exempt
+    src = """
+        import time
+
+        def record_death(self, now=None):
+            time.sleep(0.0)
+    """
+    ctx = lint(src, rel="paddle_tpu/fleet/breaker.py", select={"JL005"})
+    assert ctx.findings == []
