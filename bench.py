@@ -1847,6 +1847,252 @@ def _run_fleet_chaos(on_tpu):
     }
 
 
+def _run_disagg(on_tpu):
+    """ISSUE 16: disaggregated prefill/decode serving A/B
+    (`benchmarks/run.py disagg`) — 2 prefill + 2 decode replicas vs 4
+    mixed replicas (same weights, same total slot count, prefix cache
+    ON) behind the RouterServer on the 50%-shared STREAMING traffic mix
+    with more concurrent clients than fleet slots.  In the mixed arm a
+    new stream waits for a slot held through an entire decode; in the
+    disagg arm the prefill replicas free their slots after ONE token
+    (the capped leg), the finished prefix ships to a decode replica
+    over the migration plane (`handoff: true`) and the router splices
+    both legs into one client stream — so TTFT decouples from decode
+    occupancy.  Client-side TTFT and inter-token-latency percentiles
+    are measured off per-write arrival timestamps.  The contract
+    stamps: outputs bit-match across arms (greedy splice invariance),
+    every handoff lands with ZERO re-prefilled full pages, zero warm
+    compiles in both measured windows, and disagg beats mixed on p95
+    TTFT or p95 ITL."""
+    import asyncio
+    import json as _json
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                      GenerationConfig)
+    from paddle_tpu.inference import migration as _mig
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.router import InprocReplica, RouterServer
+    from paddle_tpu.serving import ServingServer
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        slots, max_seq, page, bucket = 4, 1024, 32, 128
+        n_groups, group_size, n_unique = 6, 3, 14
+        shared_len, tail_range, budget_range, clients = \
+            512, (16, 65), (48, 81), 24
+    else:
+        cfg = LlamaConfig.tiny()
+        slots, max_seq, page, bucket = 2, 256, 16, 64
+        n_groups, group_size, n_unique = 4, 3, 12
+        shared_len, tail_range, budget_range, clients = \
+            96, (8, 25), (24, 33), 12
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    # same 50%-shared mix as router_serve, but streamed and with LONGER
+    # decode budgets: slot hold time is the mixed arm's admission tax
+    reqs = []
+    for g in range(n_groups):
+        shared = [int(t) for t in rng.integers(1, cfg.vocab_size,
+                                               shared_len)]
+        for _ in range(group_size):
+            tail = int(rng.integers(*tail_range))
+            reqs.append((shared +
+                         [int(t) for t in rng.integers(
+                             1, cfg.vocab_size, tail)],
+                         int(rng.integers(*budget_range))))
+    for _ in range(n_unique):
+        tail = int(rng.integers(*tail_range))
+        reqs.append(([int(t) for t in rng.integers(
+                         1, cfg.vocab_size, shared_len + tail)],
+                     int(rng.integers(*budget_range))))
+    order = rng.permutation(len(reqs))
+    n_req = len(reqs)
+
+    def arm(roles):
+        servers = []
+        for role in roles:
+            eng = ContinuousBatchingEngine(
+                model, max_batch=slots,
+                gen=GenerationConfig(max_new_tokens=int(budget_range[1])),
+                max_seq_len=max_seq, page_size=page,
+                prefill_bucket=bucket, prefix_cache=True)
+            # warm both step programs BEFORE the engine thread takes
+            # over, then the migration upload program: the handoff
+            # import must not compile inside the measured window (the
+            # serving warmup path only runs it under warmup=True)
+            eng.add_request(list(rng.integers(1, cfg.vocab_size,
+                                              bucket + 3)),
+                            max_new_tokens=4)
+            eng.run()
+            _mig.warm(eng)
+            servers.append(ServingServer(eng, slo=False,
+                                         flight_recorder=False,
+                                         role=role).start())
+        replicas = [InprocReplica(f"r{i}", s)
+                    for i, s in enumerate(servers)]
+        router = RouterServer(replicas, policy="scored",
+                              health_interval_s=1e9)
+        books = {o: obs.metrics.counter("router.handoff", outcome=o)
+                 for o in ("ok", "export_failed", "import_failed",
+                           "no_successor")}
+        reprefill = obs.metrics.counter(
+            "serving.kv.handoff_reprefill_tokens")
+        base = {o: c.value for o, c in books.items()}
+        rp0 = reprefill.value
+
+        async def one(i):
+            prompt, budget = reqs[i]
+            body = _json.dumps({"prompt": prompt, "max_tokens": budget,
+                                "stream": True}).encode()
+            head = ("POST /v1/completions HTTP/1.1\r\nHost: bench\r\n"
+                    f"Content-Length: {len(body)}\r\n\r\n").encode()
+            r = asyncio.StreamReader()
+            r.feed_data(head + body)
+            r.feed_eof()
+            stamps = []
+
+            class W:
+                def write(self, b):
+                    stamps.append((time.perf_counter(), bytes(b)))
+
+                async def drain(self):
+                    pass
+
+                def close(self):
+                    pass
+
+                async def wait_closed(self):
+                    pass
+
+            t0 = time.perf_counter()
+            await router.handle(r, W())
+            raw = b"".join(b for _, b in stamps)
+            head_raw, _, _ = raw.partition(b"\r\n\r\n")
+            status = int(head_raw.split()[1])
+            assert status == 200, (status, raw[:200])
+            # replay the write timeline: each token-bearing SSE frame
+            # is stamped with its WRITE time — client-observed TTFT and
+            # inter-token gaps, queue wait included
+            toks, ttft, gaps, last = [], None, [], None
+            buf, in_body = b"", False
+            for t, chunk in stamps:
+                buf += chunk
+                if not in_body:
+                    if b"\r\n\r\n" not in buf:
+                        continue
+                    _, _, buf = buf.partition(b"\r\n\r\n")
+                    in_body = True
+                while b"\n" in buf:
+                    line, _, buf = buf.partition(b"\n")
+                    line = line.strip()
+                    if not line.startswith(b"data: ") or \
+                            line == b"data: [DONE]":
+                        continue
+                    ids = _json.loads(line[6:])["choices"][0][
+                        "token_ids"]
+                    if not ids:
+                        continue
+                    if ttft is None:
+                        ttft = t - t0
+                    else:
+                        gaps.append(t - last)
+                    last = t
+                    toks.extend(ids)
+            return i, toks, ttft, gaps
+
+        async def drive():
+            await router.poll_replicas()
+            sem = asyncio.Semaphore(clients)
+
+            async def worker(i):
+                async with sem:
+                    return await one(i)
+
+            return await asyncio.gather(*(worker(int(i)) for i in order))
+
+        try:
+            with obs.assert_overhead(record=True) as rec:
+                t0 = time.perf_counter()
+                results = asyncio.run(drive())
+                dt = time.perf_counter() - t0
+        finally:
+            for s in servers:
+                s.close()
+        outs = {i: toks for i, toks, _, _ in results}
+        ttfts = [ttft for _, _, ttft, _ in results if ttft is not None]
+        gaps = [g for _, _, _, gs in results for g in gs]
+        toks = sum(len(v) for v in outs.values())
+
+        def pct(xs, q):
+            return float(np.percentile(xs, q) * 1000) if xs else 0.0
+
+        return {"tps": toks / dt, "tokens": int(toks),
+                "outputs": [outs[i] for i in range(n_req)],
+                "ttft": {"p50": round(pct(ttfts, 50), 1),
+                         "p95": round(pct(ttfts, 95), 1)},
+                "itl": {"p50": round(pct(gaps, 50), 1),
+                        "p95": round(pct(gaps, 95), 1)},
+                "compiles": rec.compiles,
+                "handoff": {o: int(c.value - base[o])
+                            for o, c in books.items()},
+                "reprefill": int(reprefill.value - rp0)}
+
+    # arms interleaved, best-of-samples by p95 TTFT (the headline): host
+    # drift hits both fleets equally; routing and outputs are
+    # deterministic across samples
+    samples = 2
+    mixed = disagg = None
+    for _ in range(samples):
+        a = arm(["mixed"] * 4)
+        mixed = a if mixed is None or \
+            a["ttft"]["p95"] < mixed["ttft"]["p95"] else mixed
+        b = arm(["prefill", "prefill", "decode", "decode"])
+        disagg = b if disagg is None or \
+            b["ttft"]["p95"] < disagg["ttft"]["p95"] else disagg
+    return {
+        "disagg_requests": n_req,
+        "disagg_replicas": 4,
+        "disagg_clients": clients,
+        "disagg_shared_frac": round(n_groups * group_size / n_req, 3),
+        "disagg_shared_len": shared_len,
+        "disagg_ttft_ms": disagg["ttft"],
+        "disagg_mixed_ttft_ms": mixed["ttft"],
+        "disagg_itl_ms": disagg["itl"],
+        "disagg_mixed_itl_ms": mixed["itl"],
+        "disagg_tok_per_s_observed": round(disagg["tps"], 1),
+        "disagg_mixed_tok_per_s_observed": round(mixed["tps"], 1),
+        "disagg_handoffs_ok": disagg["handoff"]["ok"],
+        "disagg_handoffs_failed": sum(
+            v for o, v in disagg["handoff"].items() if o != "ok"),
+        "disagg_mixed_handoffs": sum(mixed["handoff"].values()),
+        "disagg_reprefill_tokens": disagg["reprefill"],
+        "disagg_warm_compiles": disagg["compiles"],
+        "disagg_mixed_warm_compiles": mixed["compiles"],
+        # contract: every stream got its decode leg via a clean KV
+        # handoff (no re-prefilled full pages anywhere), both arms at
+        # zero warm compiles, and the splice is output-invisible
+        "disagg_handoff_match": bool(
+            disagg["handoff"]["ok"] >= 1
+            and disagg["reprefill"] == 0
+            and disagg["compiles"] == 0 and mixed["compiles"] == 0
+            and disagg["outputs"] == mixed["outputs"]),
+        # the perf lever: role specialization must WIN on a tail
+        # latency axis at equal replica count
+        "disagg_beats_mixed": bool(
+            disagg["ttft"]["p95"] < mixed["ttft"]["p95"]
+            or disagg["itl"]["p95"] < mixed["itl"]["p95"]),
+    }
+
+
 # extras measured after the flagship ladder, each in its own subprocess
 _EXTRAS = (("large", _run_large), ("decode", _run_decode),
            ("moe", _run_moe), ("gpt2", _run_gpt2_compiled_vs_eager),
@@ -1858,7 +2104,8 @@ _EXTRAS = (("large", _run_large), ("decode", _run_decode),
            ("http_serve", _run_http_serve),
            ("router_serve", _run_router_serve),
            ("kv_quant", _run_kv_quant),
-           ("fleet_chaos", _run_fleet_chaos))
+           ("fleet_chaos", _run_fleet_chaos),
+           ("disagg", _run_disagg))
 
 
 def _force_host_devices(n=8):

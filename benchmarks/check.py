@@ -57,7 +57,7 @@ _HIGHER = ("tok_per_sec", "per_sec", "speedup", "mfu", "hit_rate",
            "vs_baseline")
 # boolean contract stamps: True in the baseline must stay True
 _BOOL_TRUE_CONTRACT = ("match", "finite", "decreased", "beats_rr",
-                       "stats_zero")
+                       "beats_mixed", "stats_zero")
 # keys that are bookkeeping, provenance or environment — never gated
 _SKIP = {"config", "platform", "device_kind", "metric", "unit", "wall_s",
          "metrics", "jit_cache_stats", "static_analysis", "provenance",

@@ -5,7 +5,7 @@ Usage:
     python benchmarks/run.py [config ...] [--cpu] [--fused-gather=0|1]
                              [--trace=PATH] [--gate]
 configs: resnet gpt2 llama dit moe decode serve http_serve router_serve
-         fleet_chaos spec_decode kv_quant all (default: all)
+         fleet_chaos spec_decode kv_quant disagg all (default: all)
 
 --gate compares each fresh result against the committed
 results/<config>.json (benchmarks/check.py guardbands), stamps the
@@ -404,13 +404,29 @@ def run_kv_quant():
     return {"config": "kv_quant", **bench._run_kv_quant(_on_tpu())}
 
 
+def run_disagg():
+    """ISSUE 16: disaggregated prefill/decode serving A/B (`python
+    benchmarks/run.py disagg --cpu`) — 2 prefill + 2 decode replicas vs
+    4 mixed replicas behind the router on the 50%-shared streaming mix
+    with more clients than fleet slots.  The prefill fleet runs the
+    1-token capped leg, the finished prefix ships to a decode replica
+    over the migration plane and the router splices both legs into one
+    stream.  Gated stamps: bit-identical outputs across arms with zero
+    re-prefilled full pages and zero warm compiles
+    (disagg_handoff_match), and a p95 TTFT-or-ITL win at equal replica
+    count (disagg_beats_mixed)."""
+    import bench
+    return {"config": "disagg", **bench._run_disagg(_on_tpu())}
+
+
 CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
            "dit": run_dit, "moe": run_moe, "decode": run_decode,
            "longctx": run_longctx, "grad_comm": run_grad_comm,
            "serve_prefix": run_serve_prefix, "spec_decode": run_spec_decode,
            "serve": run_serve,
            "http_serve": run_http_serve, "router_serve": run_router_serve,
-           "kv_quant": run_kv_quant, "fleet_chaos": run_fleet_chaos}
+           "kv_quant": run_kv_quant, "fleet_chaos": run_fleet_chaos,
+           "disagg": run_disagg}
 
 
 def _supervise(names, timeout):

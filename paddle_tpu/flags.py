@@ -384,6 +384,64 @@ define_flag("flight_recorder_path", "flight_record.json",
             "Base path for flight-recorder dumps; the trigger reason is "
             "suffixed to the stem so a SIGTERM dump never clobbers a "
             "watchdog-timeout dump.")
+define_flag("serving_role", "mixed",
+            "Disaggregated serving role (ISSUE 16) this replica "
+            "advertises via /statusz: 'prefill' replicas take new "
+            "requests and run chunked prefill at full occupancy, "
+            "'decode' replicas adopt handed-off sessions and run the "
+            "generation leg, 'mixed' does both (the classic fleet).  "
+            "The role is a routing preference, not an engine "
+            "capability — any role can serve any request.")
+define_flag("router_prefill_handoff", True,
+            "Prefill->decode handoff (ISSUE 16): with prefill-role "
+            "replicas in the fleet, the router caps a new stream's "
+            "first leg at one token on a prefill replica, ships the "
+            "finished prefix KV over /migratez to a decode successor, "
+            "and splices the decode leg into the SAME client stream "
+            "(journal replay semantics; 0 re-prefilled full pages on "
+            "the successor).  Off routes by role preference only, "
+            "with no mid-stream handoff.")
+define_flag("router_handoff_timeout_s", 30.0,
+            "Bound on each handoff transfer call (/migratez export and "
+            "import); past it the router falls back to re-prefilling "
+            "the session on a mixed replica — the stream never drops.")
+define_flag("router_spill_hit_weight", 0.5,
+            "Placement score multiplier for an expected prefix hit "
+            "whose page is SPILLED to the replica's host ring (ISSUE "
+            "16 satellite): swap-in costs a page upload, not a "
+            "re-prefill, so a spilled hit scores between resident "
+            "(1.0) and absent (0.0).")
+define_flag("router_overlay_cap", 4096,
+            "Global LRU cap on each replica's routed-overlay credit "
+            "map (the optimistic just-routed prefix hashes scored "
+            "before the next /statusz confirms them); evictions count "
+            "in router.overlay_evictions.")
+define_flag("router_quarantine_sweep_s", 5.0,
+            "Min seconds between TTL sweeps of the poison-quarantine "
+            "signature table on the read path (quarantined/progress "
+            "checks); strikes always sweep inline.  Bounds the table "
+            "even when no new strikes arrive.  <=0 sweeps every read.")
+define_flag("router_quarantine_cap", 4096,
+            "Max tracked poison-quarantine signatures (oldest evicted "
+            "first; router.quarantine_entries gauges the table).")
+define_flag("fleet_roles", "",
+            "Role-specialized fleet spec (ISSUE 16): comma-separated "
+            "role=target pairs, e.g. 'prefill=1,decode=2'.  Empty "
+            "grows a classic mixed fleet.  Per-role autoscaling moves "
+            "each role's target independently: prefill on queue depth "
+            "/ TTFT burn, decode on resident sessions / ITL burn.")
+define_flag("fleet_rebalance", True,
+            "Proactive hot-session rebalance (ISSUE 16): when a READY "
+            "replica is shedding on SLO burn while a same-role peer "
+            "still admits, the supervisor exports the burner's "
+            "sessions, pre-stages them on the peer via the migration "
+            "plane, and re-points the router's session pins — the "
+            "burner cools instead of melting.  In-flight streams "
+            "finish out on the source (drain semantics).")
+define_flag("fleet_rebalance_cooldown_s", 10.0,
+            "Min seconds between proactive rebalances (one victim per "
+            "pass; the cooldown lets the SLO window react before the "
+            "supervisor moves more state).")
 define_flag("use_native_dataloader", False,
             "Route DataLoader prefetch through the C++ ring-buffer engine "
             "(native/ringbuf.cc). Off by default: with in-process thread "

@@ -90,12 +90,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     for pair in args.flag_sets:
         launch += ["--set", pair]
 
-    def spawner(rid: str) -> ProcessReplicaHandle:
+    def spawner(rid: str, role: str = "mixed") -> ProcessReplicaHandle:
         # slot ids are "fs<i>"; a restarted slot keeps its port so the
-        # router's HttpReplica target stays valid across generations
+        # router's HttpReplica target stays valid across generations.
+        # The role param makes this a roleful spawner (ISSUE 16): a
+        # role-tagged slot must launch its subprocess with --role or
+        # the replica advertises "mixed" and the router never hands off.
         port = args.replica_port_base + int(rid.removeprefix("fs"))
+        extra = ["--role", role] if role != "mixed" else []
         return ProcessReplicaHandle(rid, args.host, port,
-                                    launch_args=launch)
+                                    launch_args=launch + extra)
 
     router = RouterServer([], policy=args.policy,
                           model_name=args.model_name or args.preset,

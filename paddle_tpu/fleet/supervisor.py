@@ -44,31 +44,64 @@ ticks on a side thread under the router's event loop safely.
 
 from __future__ import annotations
 
+import inspect
 import subprocess
 import sys
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from .. import flags
 from .. import observability as _obs
 from .breaker import CascadeBreaker
 
 __all__ = ["FleetSupervisor", "ReplicaHandle", "InprocReplicaHandle",
-           "ProcessReplicaHandle", "STARTING", "READY", "DRAINING",
-           "BACKOFF", "FAILED"]
+           "ProcessReplicaHandle", "parse_roles", "STARTING", "READY",
+           "DRAINING", "BACKOFF", "FAILED"]
 
 # slot lifecycle states (the fleet.replicas{state=} label set)
 STARTING, READY, DRAINING, BACKOFF, FAILED = \
     "starting", "ready", "draining", "backoff", "failed"
 _STATES = (STARTING, READY, DRAINING, BACKOFF, FAILED)
 
+# replica roles (ISSUE 16): disaggregated prefill/decode fleets
+_ROLES = ("prefill", "decode", "mixed")
+
+
+def parse_roles(spec: str) -> Optional[Dict[str, int]]:
+    """``FLAGS_fleet_roles`` syntax: ``"prefill=1,decode=2"`` -> per-role
+    replica targets.  Empty -> ``None`` (a plain mixed fleet; every
+    pre-role behavior is preserved bit-for-bit)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    out: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        role, eq, n = part.partition("=")
+        role = role.strip()
+        if not eq or role not in _ROLES:
+            raise ValueError(
+                f"fleet_roles expects 'role=N' with role in {_ROLES}, "
+                f"got {part!r}")
+        try:
+            count = int(n)
+        except ValueError:
+            raise ValueError(f"fleet_roles count must be int: {part!r}")
+        if count < 1:
+            raise ValueError(f"fleet_roles counts must be >= 1: {part!r}")
+        out[role] = out.get(role, 0) + count
+    return out or None
+
 
 class _FleetMetrics:
     """Registry handles resolved once (the PR 5 idiom)."""
 
     __slots__ = ("replicas", "target", "restarts", "crashes", "scale",
-                 "drains", "migrations", "migrated_pages")
+                 "drains", "migrations", "migrated_pages", "role_gauge",
+                 "rebalances")
 
     def __init__(self):
         m = _obs.metrics
@@ -76,6 +109,11 @@ class _FleetMetrics:
         self.migrations = lambda o: m.counter("fleet.migrations",
                                               outcome=o)
         self.migrated_pages = m.counter("fleet.migrated_pages")
+        # jaxlint: disable=JL006 -- bounded by construction: role callers pass prefill/decode/mixed literals
+        self.role_gauge = lambda r: m.gauge("fleet.role", role=r)
+        # jaxlint: disable=JL006 -- bounded by construction: outcome callers pass ok/skipped/failed literals
+        self.rebalances = lambda o: m.counter("fleet.rebalances",
+                                              outcome=o)
         # the lambda-param labels below are bounded by construction:
         # every caller passes a literal or a _STATES member
         # jaxlint: disable=JL006 -- bounded by construction: states are the _STATES tuple
@@ -386,15 +424,16 @@ class _Slot:
     """Bookkeeping for one managed replica position."""
 
     __slots__ = ("handle", "state", "restarts", "deadline", "ready_since",
-                 "registered")
+                 "registered", "role")
 
-    def __init__(self, handle: ReplicaHandle):
+    def __init__(self, handle: ReplicaHandle, role: str = "mixed"):
         self.handle = handle
         self.state = STARTING
         self.restarts = 0
         self.deadline = 0.0          # backoff or drain deadline (clock units)
         self.ready_since: Optional[float] = None
         self.registered = False
+        self.role = role             # sticky across crash-restarts
 
 
 class FleetSupervisor:
@@ -425,6 +464,9 @@ class FleetSupervisor:
                  scale_up_load: Optional[float] = None,
                  scale_down_load: Optional[float] = None,
                  migrate_on_drain: Optional[bool] = None,
+                 roles: Optional[Dict[str, int]] = None,
+                 rebalance: Optional[bool] = None,
+                 rebalance_cooldown_s: Optional[float] = None,
                  on_spawn: Optional[Callable[[ReplicaHandle],
                                              None]] = None,
                  breaker=None,
@@ -432,6 +474,20 @@ class FleetSupervisor:
         f = flags.flag
         self.router = router
         self._spawner = spawner
+        # role-specialized fleets (ISSUE 16): a spawner whose signature
+        # takes a second positional gets (rid, role) so it can launch
+        # the replica with --role / FLAGS_serving_role; legacy
+        # single-arg spawners keep working untouched
+        try:
+            params = [
+                p for p in
+                inspect.signature(spawner).parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                              p.VAR_POSITIONAL)]
+            self._spawner_roleful = len(params) >= 2 or any(
+                p.kind == p.VAR_POSITIONAL for p in params)
+        except (TypeError, ValueError):
+            self._spawner_roleful = False
         self._on_spawn = on_spawn
         self.min_replicas = int(f("fleet_min_replicas")
                                 if min_replicas is None else min_replicas)
@@ -471,11 +527,31 @@ class FleetSupervisor:
         self.migrate_on_drain = bool(f("fleet_migrate_on_drain")
                                      if migrate_on_drain is None
                                      else migrate_on_drain)
+        # disaggregated fleets (ISSUE 16): per-role targets; None keeps
+        # the mixed single-pool behavior bit-for-bit
+        self.roles = parse_roles(str(f("fleet_roles"))) \
+            if roles is None else (dict(roles) or None)
+        if self.roles is not None:
+            if sum(self.roles.values()) > self.max_replicas:
+                raise ValueError(
+                    f"fleet_roles wants {sum(self.roles.values())} "
+                    f"replicas > fleet_max_replicas={self.max_replicas}")
+            self.target = sum(self.roles.values())
+        # proactive rebalance (ISSUE 16): migrate hot sessions OFF an
+        # SLO-burning decode replica before it sheds
+        self._rebalance_on = bool(f("fleet_rebalance")
+                                  if rebalance is None else rebalance)
+        self.rebalance_cooldown_s = float(
+            f("fleet_rebalance_cooldown_s")
+            if rebalance_cooldown_s is None else rebalance_cooldown_s)
+        self._last_rebalance = -1e18
         self._clock = clock
         self._slots: List[_Slot] = []
         self._next_slot = 0
         self._hot_streak = 0
         self._cold_streak = 0
+        self._role_hot: Dict[str, int] = {}
+        self._role_cold: Dict[str, int] = {}
         self._last_scale = -1e18     # first scale never cooldown-blocked
         self._last_anomaly_total = 0
         self._ticks = 0
@@ -492,20 +568,35 @@ class FleetSupervisor:
             self.router.breaker = self.breaker
 
     # --------------------------------------------------------- population --
-    def _spawn_slot(self) -> _Slot:
+    def _build_handle(self, rid: str, role: str) -> ReplicaHandle:
+        if self._spawner_roleful:
+            return self._spawner(rid, role)
+        return self._spawner(rid)
+
+    def _spawn_slot(self, role: str = "mixed") -> _Slot:
         rid = f"fs{self._next_slot}"
         self._next_slot += 1
-        slot = _Slot(self._spawner(rid))
+        slot = _Slot(self._build_handle(rid, role), role=role)
         slot.handle.spawn()
         if self._on_spawn is not None:
             self._on_spawn(slot.handle)
         self._slots.append(slot)
         return slot
 
+    def _role_count(self, role: str) -> int:
+        return sum(1 for s in self._slots
+                   if s.role == role and s.state != FAILED)
+
     def start(self) -> "FleetSupervisor":
-        """Spawn the initial ``target`` replica slots (idempotent)."""
-        while len(self._slots) < self.target:
-            self._spawn_slot()
+        """Spawn the initial ``target`` replica slots (idempotent);
+        with roles, one slot per role unit."""
+        if self.roles is not None:
+            for role in sorted(self.roles):
+                while self._role_count(role) < self.roles[role]:
+                    self._spawn_slot(role)
+        else:
+            while len(self._slots) < self.target:
+                self._spawn_slot()
         self._export_gauges()
         return self
 
@@ -608,7 +699,8 @@ class FleetSupervisor:
             if slot.state == BACKOFF and now >= slot.deadline:
                 slot.restarts += 1
                 self._m.restarts.inc()
-                slot.handle = self._spawner(h.id)   # fresh handle, same id
+                # fresh handle, same id AND same role
+                slot.handle = self._build_handle(h.id, slot.role)
                 slot.handle.spawn()
                 if self._on_spawn is not None:
                     self._on_spawn(slot.handle)
@@ -624,6 +716,7 @@ class FleetSupervisor:
                 slot.ready_since = now
                 slot.registered = True
                 actions.append(("ready", h.id))
+        self._maybe_rebalance(now, actions)
         self._autoscale(now, actions)
         self._converge(now, actions)
         self._export_gauges()
@@ -645,8 +738,14 @@ class FleetSupervisor:
         # is coming back, and shrinking under it would double-shrink).
         if any(s.state in (STARTING, DRAINING) for s in self._slots):
             self._hot_streak = self._cold_streak = 0
+            self._role_hot.clear()
+            self._role_cold.clear()
             return
         in_backoff = any(s.state == BACKOFF for s in self._slots)
+        if self.roles is not None:
+            self._autoscale_roles(sig, anomaly_delta, in_backoff, now,
+                                  actions)
+            return
         hot = sig["placeable"] > 0 and (
             sig["all_shedding"] or sig["mean_load"] > self.scale_up_load)
         # an outage (zero placeable replicas) is not "cold": never shrink
@@ -672,11 +771,80 @@ class FleetSupervisor:
             self._m.scale("down").inc()
             actions.append(("scale_down", self.target))
 
+    def _autoscale_roles(self, sig: dict, anomaly_delta: int,
+                         in_backoff: bool, now: float,
+                         actions: list) -> None:
+        """Per-role autoscaling (ISSUE 16): each role scales on ITS
+        pressure signal — prefill burns TTFT in its admission queue
+        (mean queue depth), decode/mixed burn ITL in resident load —
+        with the same thresholds, hysteresis and shared cooldown as
+        the mixed path."""
+        cooled = now - self._last_scale >= self.cooldown_s
+        for role in sorted(self.roles):
+            rs = (sig.get("roles") or {}).get(role)
+            if rs is None or rs["placeable"] == 0:
+                # no live signal for this role (all down/warming):
+                # neither hot nor cold — converge handles population
+                self._role_hot[role] = self._role_cold[role] = 0
+                continue
+            metric = rs["mean_queue_depth"] if role == "prefill" \
+                else rs["mean_load"]
+            hot = rs["shedding"] == rs["placeable"] or \
+                metric > self.scale_up_load
+            cold = (not in_backoff and rs["shedding"] == 0
+                    and anomaly_delta == 0
+                    and metric < self.scale_down_load)
+            self._role_hot[role] = \
+                self._role_hot.get(role, 0) + 1 if hot else 0
+            self._role_cold[role] = \
+                self._role_cold.get(role, 0) + 1 if cold else 0
+            total = sum(self.roles.values())
+            if self._role_hot[role] >= self.hot_ticks and cooled and \
+                    total < self.max_replicas:
+                self.roles[role] += 1
+                self._last_scale = now
+                self._role_hot[role] = 0
+                cooled = False
+                self._m.scale("up").inc()
+                actions.append(("scale_up", (role, self.roles[role])))
+            elif self._role_cold[role] >= self.cold_ticks and cooled \
+                    and self.roles[role] > 1:
+                # per-role floor of 1: a disaggregated fleet never
+                # scales a phase out of existence
+                self.roles[role] -= 1
+                self._last_scale = now
+                self._role_cold[role] = 0
+                cooled = False
+                self._m.scale("down").inc()
+                actions.append(("scale_down", (role, self.roles[role])))
+        self.target = sum(self.roles.values())
+
     def _converge(self, now: float, actions: list) -> None:
         """Move the population toward ``target``: spawn for scale-up,
         drain victims for scale-down.  FAILED tombstones don't count —
         and are deliberately NOT replaced (the budget would mean
         nothing if exhaustion just minted a fresh slot)."""
+        if self.roles is not None:
+            for role in sorted(self.roles):
+                want = self.roles[role]
+                active = [s for s in self._slots if s.role == role
+                          and s.state in (STARTING, READY, BACKOFF)]
+                failed = sum(1 for s in self._slots
+                             if s.role == role and s.state == FAILED)
+                grow = want - len(active) - failed
+                while grow > 0:
+                    slot = self._spawn_slot(role)
+                    actions.append(("spawn", slot.handle.id))
+                    grow -= 1
+                excess = len(active) - want
+                while excess > 0:
+                    victim = self._pick_victim(role)
+                    if victim is None:
+                        break
+                    self._begin_drain(victim, now)
+                    actions.append(("drain", victim.handle.id))
+                    excess -= 1
+            return
         active = [s for s in self._slots
                   if s.state in (STARTING, READY, BACKOFF)]
         grow = self.target - len(active) \
@@ -694,10 +862,12 @@ class FleetSupervisor:
             actions.append(("drain", victim.handle.id))
             excess -= 1
 
-    def _pick_victim(self) -> Optional[_Slot]:
+    def _pick_victim(self, role: Optional[str] = None) -> Optional[_Slot]:
         """Scale-down victim: the least-loaded READY slot (its in-flight
-        tail is shortest), newest-first on ties."""
-        ready = [s for s in self._slots if s.state == READY]
+        tail is shortest), newest-first on ties; role-scoped when the
+        fleet is disaggregated."""
+        ready = [s for s in self._slots if s.state == READY
+                 and (role is None or s.role == role)]
         if not ready:
             return None
 
@@ -728,17 +898,21 @@ class FleetSupervisor:
     # ------------------------------------- drain migration (ISSUE 14) --
     def _pick_successor(self, victim: _Slot) -> Optional[_Slot]:
         """Where the victim's sessions go: the least-loaded READY slot
-        other than the victim (the same load view scale-down uses)."""
+        other than the victim (the same load view scale-down uses); a
+        same-role-or-mixed peer outranks a cross-role one (ISSUE 16) —
+        a prefill replica's sessions don't belong on the decode fleet."""
         ready = [s for s in self._slots
                  if s is not victim and s.state == READY]
         if not ready:
             return None
 
-        def load(slot: _Slot) -> int:
+        def key(slot: _Slot):
             rs = self._router_state(slot.handle.id)
-            return rs.load() if rs is not None else 0
+            load = rs.load() if rs is not None else 0
+            kin = slot.role == victim.role or slot.role == "mixed"
+            return (0 if kin else 1, load)
 
-        return min(ready, key=load)
+        return min(ready, key=key)
 
     def _migrate_out(self, victim: _Slot) -> Optional[dict]:
         succ = self._pick_successor(victim)
@@ -798,6 +972,86 @@ class FleetSupervisor:
             self._m.migrations("failed").inc()
             return None
 
+    # ------------------------------- proactive rebalance (ISSUE 16) --
+    def _pick_rebalance_peer(self, src: _Slot) -> Optional[_Slot]:
+        """A READY same-role-or-mixed peer the router reports ADMITTING
+        (not shedding, not draining), least-loaded first."""
+        best = None
+        for slot in self._slots:
+            if slot is src or slot.state != READY:
+                continue
+            if slot.role != src.role and slot.role != "mixed" \
+                    and src.role != "mixed":
+                continue
+            rs = self._router_state(slot.handle.id)
+            if rs is None or not rs.ok or rs.draining or \
+                    rs.slo_decision == "shed":
+                continue
+            if best is None or rs.load() < best[0]:
+                best = (rs.load(), slot)
+        return best[1] if best is not None else None
+
+    def _maybe_rebalance(self, now: float, actions: list) -> None:
+        """Migrate hot sessions OFF an SLO-burning replica BEFORE it
+        sheds (ISSUE 16): the first READY slot the router reports
+        shedding, with an admitting same-role-or-mixed peer, gets its
+        resident sessions' KV pre-staged on the peer over the migration
+        plane and their pins re-pointed there.  In-flight streams
+        finish out on the source (drain semantics); only FUTURE turns
+        move.  At most one rebalance per cooldown window — this is a
+        pressure valve, not a shuffle."""
+        if not self._rebalance_on or not self.migrate_on_drain:
+            return
+        if now - self._last_rebalance < self.rebalance_cooldown_s:
+            return
+        for slot in self._slots:
+            if slot.state != READY:
+                continue
+            rs = self._router_state(slot.handle.id)
+            if rs is None or not rs.ok or rs.slo_decision != "shed":
+                continue
+            peer = self._pick_rebalance_peer(slot)
+            if peer is None:
+                continue
+            self._last_rebalance = now
+            ok = self._rebalance(slot, peer)
+            actions.append(("rebalance" if ok else "rebalance_failed",
+                            (slot.handle.id, peer.handle.id)))
+            return
+
+    def _rebalance(self, src: _Slot, dst: _Slot) -> bool:
+        try:
+            snaps = src.handle.export_sessions()
+            if not snaps:
+                self._m.rebalances("skipped").inc()
+                return False
+            result = dst.handle.import_sessions(snaps)
+            if not result.get("sessions") and result.get("aborted"):
+                self._m.rebalances("failed").inc()
+                return False
+            moved = self.router.restage(src.handle.id, dst.handle.id)
+            self._m.rebalances("ok").inc()
+            self._m.migrated_pages.inc(int(result.get("imported", 0)))
+            if _obs.TRACER.enabled:
+                _obs.TRACER.instant(
+                    "fleet.rebalance",
+                    args={"src": src.handle.id, "dst": dst.handle.id,
+                          "sessions": len(snaps), "repinned": moved})
+            return True
+        except NotImplementedError:
+            self._m.rebalances("skipped").inc()
+            return False
+        except Exception as e:
+            from ..inference.migration import MigrationError
+            if isinstance(e, MigrationError):
+                self._m.rebalances("skipped").inc()
+                return False
+            print(f"[paddle_tpu fleet] rebalance {src.handle.id} -> "
+                  f"{dst.handle.id} failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            self._m.rebalances("failed").inc()
+            return False
+
     # ---------------------------------------------------------- status --
     def converged(self) -> bool:
         """Fleet shape matches intent: READY count == target (FAILED
@@ -811,22 +1065,36 @@ class FleetSupervisor:
 
     def _export_gauges(self) -> None:
         counts = {s: 0 for s in _STATES}
+        role_counts = {r: 0 for r in _ROLES}
         for slot in self._slots:
             counts[slot.state] += 1
+            if slot.state != FAILED:
+                role_counts[slot.role] += 1
         for s, n in counts.items():
             self._m.replicas(s).set(n)
+        for r, n in role_counts.items():
+            self._m.role_gauge(r).set(n)
         self._m.target.set(self.target)
 
     def state(self) -> dict:
         """Introspection for the launcher / tests / statusz."""
         return {
             "target": self.target,
+            "roles": dict(self.roles) if self.roles is not None else None,
             "ticks": self._ticks,
             "converged": self.converged(),
             "hot_streak": self._hot_streak,
             "cold_streak": self._cold_streak,
+            "role_streaks": {"hot": dict(self._role_hot),
+                             "cold": dict(self._role_cold)},
+            "rebalance": {
+                "enabled": self._rebalance_on,
+                "cooldown_s": self.rebalance_cooldown_s,
+                "outcomes": {o: int(_obs.metrics.counter(
+                    "fleet.rebalances", outcome=o).value)
+                    for o in ("ok", "skipped", "failed")}},
             "slots": [{"id": s.handle.id, "state": s.state,
-                       "restarts": s.restarts,
+                       "role": s.role, "restarts": s.restarts,
                        **s.handle.describe()} for s in self._slots],
             "signals": self.router.fleet_signals(),
             "breaker": self.breaker.state_dict()

@@ -1341,7 +1341,13 @@ class ContinuousBatchingEngine:
         out = {"page_size": self.g.page_size,
                "algo": "blake2b8-chain",
                "gen": cache.digest_gen,
-               "epoch": cache.digest_epoch}
+               "epoch": cache.digest_epoch,
+               # spill-aware scoring (ISSUE 16 satellite): the digest
+               # subset demoted to the host ring, shipped in FULL every
+               # poll (bounded by the spill ring; spill transitions
+               # don't change index membership, so the delta log can't
+               # carry them)
+               "spilled": cache.spilled_hashes()}
         if since:
             gen, _, ep = str(since).partition(":")
             if gen == cache.digest_gen:

@@ -51,7 +51,7 @@ from .. import observability as _obs
 from .kv_spill import _upload_page
 
 __all__ = ["MigrationError", "export_session", "export_all",
-           "import_session", "import_sessions", "warm",
+           "import_session", "import_sessions", "warm", "record_handoff",
            "to_wire", "from_wire", "snapshot_digest", "SNAP_VERSION"]
 
 SNAP_VERSION = 1
@@ -77,12 +77,45 @@ class _MigrationMetrics:
                                   direction="in")
         self.aborts = m.counter("serving.kv.migration_aborts")
         self.rejected = m.counter("serving.kv.migration_rejected")
+        # prefill->decode handoff (ISSUE 16): the continuous (not
+        # loss-event) use of this plane
+        # jaxlint: disable=JL006 -- bounded by construction: outcome callers pass ok/partial literals
+        self.handoff_sessions = lambda o: m.counter(
+            "serving.kv.handoff_sessions", outcome=o)
+        self.handoff_reprefill = m.counter(
+            "serving.kv.handoff_reprefill_tokens")
 
     @classmethod
     def get(cls) -> "_MigrationMetrics":
         if cls._instance is None:
             cls._instance = cls()
         return cls._instance
+
+
+def record_handoff(sessions: Sequence[dict], result: dict) -> None:
+    """Account one prefill->decode handoff import (ISSUE 16): compare
+    the full pages the shipped snapshots cover against the pages this
+    import actually installed (or found already indexed) and count the
+    shortfall as re-prefill debt — ``serving.kv.handoff_reprefill_
+    tokens`` stays 0 when every handed-off session admits with a full
+    prefix hit.  ``sessions`` are wire-form snapshots (tokens and
+    geometry ride in the clear); ``result`` is the bulk import totals."""
+    mm = _MigrationMetrics.get()
+    full_pages = 0
+    page = 0
+    for s in sessions:
+        geo = s.get("geometry") or {}
+        page = int(geo.get("page_size", 0) or 0) or page
+        toks = s.get("tokens") or ()
+        if page > 0:
+            full_pages += len(toks) // page
+    covered = int(result.get("imported", 0)) + \
+        int(result.get("skipped", 0))
+    short = max(0, full_pages - covered)
+    n = int(result.get("sessions", len(sessions)))
+    mm.handoff_sessions("ok" if short == 0 else "partial").inc(n)
+    if short and page > 0:
+        mm.handoff_reprefill.inc(short * page)
 
 
 def _engine_counts(engine) -> Dict[str, int]:

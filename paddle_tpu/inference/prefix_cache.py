@@ -440,6 +440,15 @@ class PrefixCache:
         """Indexed pages currently demoted to the host spill ring."""
         return len(self._spilled)
 
+    def spilled_hashes(self) -> List[str]:
+        """Chain hashes (hex) of the spilled-but-swappable nodes — the
+        digest subset whose next hit costs a page upload instead of a
+        re-prefill (ISSUE 16 satellite: the router scores these between
+        resident and absent).  Bounded by the spill ring capacity; the
+        ``list()`` snapshot is GIL-atomic against the engine thread
+        (same advisory-read contract as ``digest``)."""
+        return [n.chain.hex() for n in list(self._spilled)]
+
     def digest(self, max_entries: int = 4096) -> List[str]:
         """Residency digest: chain hashes (hex) of up to ``max_entries``
         indexed pages, breadth-first from the root so a truncated digest

@@ -112,6 +112,16 @@ CATALOG: Dict[str, tuple] = {
         "counter", "", "snapshots refused by the blake2b integrity "
         "check at import (ISSUE 15: corrupt or truncated bytes — "
         "nothing installed, zero allocator refs leaked)"),
+    # ---- serving: disaggregated prefill/decode handoff (ISSUE 16) ----
+    "serving.kv.handoff_sessions": (
+        "counter", "outcome=ok|partial", "prefill->decode handoffs "
+        "imported on this replica (the /migratez/import handoff path): "
+        "ok = every full page under the journaled tokens arrived, "
+        "partial = the decode leg re-prefills the shortfall"),
+    "serving.kv.handoff_reprefill_tokens": (
+        "counter", "", "tokens the decode leg re-prefills because "
+        "their pages did NOT survive the handoff (the disagg bench "
+        "gates this at zero)"),
     # ---- serving: speculative decoding (PR 9) ----
     "serving.spec.drafted_tokens": (
         "counter", "", "draft tokens dispatched for verification"),
@@ -175,13 +185,15 @@ CATALOG: Dict[str, tuple] = {
         "rejoined replica's routed-overlay staleness is reset)"),
     # ---- router: failover resume + digest delta sync (ISSUE 14) ----
     "router.resumes": (
-        "counter", "outcome=resumed|unary|finished|ineligible|exhausted",
+        "counter",
+        "outcome=resumed|unary|handoff|finished|ineligible|exhausted",
         "journaled failover-resume outcomes: resumed = a dead stream "
         "continued on a survivor (unbroken client stream), unary = a "
-        "post-dispatch unary death re-ran, finished = only the finish "
-        "frame was lost, ineligible = replay impossible (PR 7 "
-        "synthesized-error/502 contract applied), exhausted = replay "
-        "attempted but no survivor could finish it"),
+        "post-dispatch unary death re-ran, handoff = a disaggregated "
+        "prefill->decode splice completed (ISSUE 16), finished = only "
+        "the finish frame was lost, ineligible = replay impossible "
+        "(PR 7 synthesized-error/502 contract applied), exhausted = "
+        "replay attempted but no survivor could finish it"),
     "router.journal_entries": (
         "gauge", "", "in-flight requests tracked by the replay journal"),
     "router.journal_evictions": (
@@ -204,7 +216,25 @@ CATALOG: Dict[str, tuple] = {
         "answered 503 instead of another corpse"),
     "router.quarantine_entries": (
         "gauge", "", "request signatures currently tracked by the "
-        "quarantine (strikes + quarantined; TTL-bounded)"),
+        "quarantine (strikes + quarantined; TTL-bounded, capped at "
+        "FLAGS_router_quarantine_cap, swept every "
+        "FLAGS_router_quarantine_sweep_s on the read verbs)"),
+    # ---- router: disaggregated prefill/decode serving (ISSUE 16) ----
+    "router.handoff": (
+        "counter", "outcome=ok|export_failed|import_failed|no_successor",
+        "prefill->decode KV handoffs (router/server.py): ok = the "
+        "finished prefix shipped to a decode successor and the stream "
+        "spliced, export_failed / import_failed = the migration plane "
+        "refused (the stream re-prefills on a fallback replica "
+        "instead — never dropped), no_successor = no replay-exact "
+        "peer was placeable"),
+    "router.overlay_entries": (
+        "gauge", "", "routed-overlay credits across all replica views "
+        "(optimistic digest entries awaiting /statusz confirmation)"),
+    "router.overlay_evictions": (
+        "counter", "", "overlay credits LRU-evicted past "
+        "FLAGS_router_overlay_cap (bounds the per-replica credit map "
+        "on long-running routers)"),
     # ---- fleet lifecycle supervisor (PR 12) ----
     "fleet.replicas": (
         "gauge", "state=starting|ready|draining|backoff|failed",
@@ -239,6 +269,17 @@ CATALOG: Dict[str, tuple] = {
     "fleet.migrated_pages": (
         "counter", "", "KV pages installed on successors by "
         "drain-triggered migrations"),
+    # ---- fleet: role-specialized replicas (ISSUE 16) ----
+    "fleet.role": (
+        "gauge", "role=prefill|decode|mixed",
+        "non-failed supervised slots by serving role "
+        "(FLAGS_fleet_roles; a plain fleet is all-mixed)"),
+    "fleet.rebalances": (
+        "counter", "outcome=ok|skipped|failed",
+        "proactive session rebalances (ISSUE 16): an SLO-burning "
+        "replica's resident sessions pre-staged on an admitting "
+        "same-role-or-mixed peer BEFORE the shed, their router pins "
+        "re-pointed; in-flight streams finish out on the source"),
     "fleet.breaker_state": (
         "gauge", "", "cascade-breaker state (fleet/breaker.py, ISSUE "
         "15): 0=closed, 1=half-open (one parked resume probing), "

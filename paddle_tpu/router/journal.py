@@ -79,6 +79,17 @@ class JournalEntry:
         doc["max_tokens"] = max(1, self.remaining() or 1)
         return json.dumps(doc).encode()
 
+    def capped_body(self, max_tokens: int) -> bytes:
+        """The prefill leg of a disaggregated handoff (ISSUE 16): the
+        original request with its budget capped — the prefill replica
+        emits exactly ``max_tokens`` token(s) and frees its slot; the
+        journal carries the rest to a decode successor via
+        :meth:`resume_body`."""
+        doc = dict(self.payload)
+        doc["prompt"] = list(self.prompt)
+        doc["max_tokens"] = int(max_tokens)
+        return json.dumps(doc).encode()
+
 
 class SessionJournal:
     """LRU-bounded map of trace id -> :class:`JournalEntry`."""

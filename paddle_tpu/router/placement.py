@@ -77,6 +77,14 @@ class ReplicaState:
         self.reported_draining = False
         # placement inputs from the last successful /statusz
         self.digest: frozenset = frozenset()
+        # spill-aware scoring (ISSUE 16 satellite): the digest subset
+        # demoted to the replica's host ring — swappable, so a hit there
+        # scores between resident and absent
+        self.spilled: frozenset = frozenset()
+        # disaggregated serving (ISSUE 16): the replica's advertised
+        # role; phase routing prefers prefill replicas for new streams
+        # and decode replicas for handed-off generation legs
+        self.role: str = "mixed"
         self.page_size: int = 0
         # digest DELTA sync (ISSUE 14): the last confirmed epoch and its
         # generation nonce — the next poll asks for only the changes
@@ -108,6 +116,8 @@ class ReplicaState:
         self.routed: "OrderedDict[str, int]" = OrderedDict()
         self._poll_gen = 0              # completed /statusz polls
         self.failovers = 0
+        self._overlay_evictions = _obs.metrics.counter(
+            "router.overlay_evictions")
 
     # ------------------------------------------------------------ state --
     @property
@@ -155,6 +165,9 @@ class ReplicaState:
         self.last_poll = time.perf_counter()
         self.ready = bool(doc.get("ready", True))
         self.reported_draining = bool(doc.get("draining", False))
+        role = doc.get("role")
+        self.role = role if role in ("prefill", "decode", "mixed") \
+            else "mixed"
         eng = doc.get("engine") or {}
         self.queue_depth = int(eng.get("waiting", 0) or 0) + \
             int(eng.get("slots_busy", 0) or 0)
@@ -187,6 +200,10 @@ class ReplicaState:
             except (TypeError, ValueError):
                 self.digest_epoch = -1
             self.digest = confirmed
+            # the spilled subset ships in FULL every poll (bounded by
+            # the replica's spill ring) — spill transitions don't move
+            # index membership, so the delta log cannot carry them
+            self.spilled = frozenset(dig.get("spilled") or ())
             # overlay entries the index now confirms have served their
             # purpose; entries still unconfirmed after two full polls
             # were evicted (or never committed) replica-side — drop both
@@ -200,6 +217,7 @@ class ReplicaState:
                 del self.routed[h]
         else:
             self.digest = frozenset()
+            self.spilled = frozenset()
             self.routed.clear()
             self.digest_gen = None
             self.digest_epoch = -1
@@ -236,26 +254,46 @@ class ReplicaState:
         self.fails += 1
 
     # -------------------------------------------------------- placement --
-    def expected_hit_pages(self, hashes: Sequence[str]) -> int:
-        """Longest leading run of ``hashes`` this replica holds (digest
-        semantics: hash k resident => the whole k-page prefix is)."""
-        n = 0
+    def expected_hits(self, hashes: Sequence[str]) -> Tuple[int, int]:
+        """``(pages, spilled)`` over the longest leading run of
+        ``hashes`` this replica holds (digest semantics: hash k
+        resident => the whole k-page prefix is).  ``spilled`` counts
+        the run members demoted to the replica's host ring — hittable
+        after a swap-in upload, so they score between resident and
+        absent (ISSUE 16 satellite).  An overlay credit outranks a
+        stale spill mark: the page was just re-routed here and the
+        admission swap-in re-promotes it."""
+        n = sp = 0
         for h in hashes:
-            if h in self.digest or h in self.routed:
+            if h in self.routed:
                 n += 1
+            elif h in self.digest:
+                n += 1
+                if h in self.spilled:
+                    sp += 1
             else:
                 break
-        return n
+        return n, sp
 
-    def credit_routed(self, hashes: Sequence[str], cap: int) -> None:
+    def expected_hit_pages(self, hashes: Sequence[str]) -> int:
+        """Longest leading run of ``hashes`` this replica holds."""
+        return self.expected_hits(hashes)[0]
+
+    def credit_routed(self, hashes: Sequence[str],
+                      cap: Optional[int] = None) -> None:
         """Optimistically credit the leading hashes of a prompt just
-        routed here (bounded; oldest credits fall off first)."""
+        routed here (global LRU bound at ``FLAGS_router_overlay_cap``;
+        oldest credits fall off first, counted in
+        ``router.overlay_evictions``)."""
+        if cap is None:
+            cap = int(flags.flag("router_overlay_cap"))
         for h in hashes:
             if h in self.routed:
                 self.routed.move_to_end(h)
             self.routed[h] = self._poll_gen
         while len(self.routed) > cap:
             self.routed.popitem(last=False)
+            self._overlay_evictions.inc()
 
     def load(self) -> int:
         """Requests ahead of a new arrival: the router's own live
@@ -267,6 +305,7 @@ class ReplicaState:
             round(time.perf_counter() - self.last_poll, 3)
         return {**self.client.describe(),
                 "state": self.status(dead_after),
+                "role": self.role,
                 "draining": self.draining,
                 "consecutive_fails": self.fails,
                 "last_poll_age_s": age,
@@ -275,6 +314,7 @@ class ReplicaState:
                 "greedy": self.greedy,
                 "digest_entries": len(self.digest),
                 "digest_epoch": self.digest_epoch,
+                "spilled_entries": len(self.spilled),
                 "routed_overlay": len(self.routed),
                 "page_size": self.page_size,
                 "slo": {"decision": self.slo_decision,
@@ -303,6 +343,9 @@ class Placer:
                                 if hit_weight is None else hit_weight)
         self.load_weight = float(f("router_load_weight")
                                  if load_weight is None else load_weight)
+        # a spilled page is worth this fraction of a resident one: the
+        # bytes are one swap-in upload away, not a re-prefill away
+        self.spill_weight = float(f("router_spill_hit_weight"))
         self._sessions: "OrderedDict[str, str]" = OrderedDict()
         self._rr = 0
         m = _obs.metrics
@@ -324,6 +367,12 @@ class Placer:
 
     def pinned(self, session_id: Optional[str]) -> Optional[str]:
         return self._sessions.get(session_id) if session_id else None
+
+    def pin(self, session_id: str, replica_id: str) -> None:
+        """Public pin: the router's disaggregated handoff (ISSUE 16)
+        re-points a session at the decode replica its KV just shipped
+        to, so follow-up turns land where the pages live."""
+        self._pin(session_id, replica_id)
 
     def session_state(self) -> dict:
         return {"pins": len(self._sessions), "cap": self.session_cap,
@@ -379,8 +428,11 @@ class Placer:
             # regardless of load
             unit = max((s.page_size for s in candidates), default=0) or 1
             for i, s in enumerate(candidates):
-                hits = s.expected_hit_pages(hashes.get(s.page_size, ()))
-                score = self.hit_weight * hits * s.page_size \
+                hits, sp = s.expected_hits(hashes.get(s.page_size, ()))
+                # spilled pages are discounted, not free: resident >
+                # spilled > absent (ISSUE 16 satellite)
+                eff = (hits - sp) + self.spill_weight * sp
+                score = self.hit_weight * eff * s.page_size \
                     - self.load_weight * s.load() * unit
                 key = (score, -s.load(), -((i - self._rr) % len(candidates)))
                 if best is None or key > best[0]:
@@ -392,9 +444,20 @@ class Placer:
             self._hit_pages.observe(float(hits))
         hs = hashes.get(choice.page_size)
         if hs:
-            # overlay bounded like the advertised digest itself
-            choice.credit_routed(hs, cap=int(flags.flag("router_digest_max")))
+            choice.credit_routed(hs)
         if session_id:
             self._pin(session_id, choice.id)
         self._placed[reason].inc()
         return choice, reason
+
+    def repin(self, src: str, dst: str) -> int:
+        """Re-point every session pinned to replica ``src`` at ``dst``
+        (the supervisor's proactive rebalance: the sessions' KV was
+        just pre-staged on ``dst`` over the migration plane, so their
+        next turns should land there).  Returns the pin count moved."""
+        n = 0
+        for sid, rid in self._sessions.items():
+            if rid == src:
+                self._sessions[sid] = dst
+                n += 1
+        return n

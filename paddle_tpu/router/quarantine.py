@@ -84,14 +84,19 @@ class PoisonQuarantine:
     """
 
     def __init__(self, strikes: Optional[int] = None,
-                 ttl_s: Optional[float] = None, cap: int = 4096,
+                 ttl_s: Optional[float] = None, cap: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic):
         f = flags.flag
         self.strikes = int(f("router_poison_strikes")
                            if strikes is None else strikes)
         self.ttl_s = float(f("router_quarantine_ttl_s")
                            if ttl_s is None else ttl_s)
-        self.cap = int(cap)
+        self.cap = int(f("router_quarantine_cap") if cap is None else cap)
+        # write verbs purge inline; read verbs (quarantined/progress on
+        # the hot proxy path) sweep at most every sweep_s so a refuse-
+        # only workload still sheds expired records (ISSUE 16 satellite)
+        self._sweep_s = float(f("router_quarantine_sweep_s"))
+        self._last_sweep = clock()
         self._clock = clock
         self._records: "OrderedDict[str, _Record]" = OrderedDict()
         m = _obs.metrics
@@ -120,6 +125,7 @@ class PoisonQuarantine:
         return rec
 
     def _purge(self, now: float) -> None:
+        self._last_sweep = now
         dead = [s for s, r in self._records.items()
                 if self._expired(r, now)]
         for s in dead:
@@ -127,6 +133,12 @@ class PoisonQuarantine:
         while len(self._records) > self.cap:
             self._records.popitem(last=False)
         self._size.set(len(self._records))
+
+    def _maybe_sweep(self, now: float) -> None:
+        """Time-gated purge for the read verbs: amortised O(1) per call,
+        the table never carries expired records longer than sweep_s."""
+        if now - self._last_sweep >= self._sweep_s:
+            self._purge(now)
 
     # ----------------------------------------------------------- verbs --
     def strike(self, sig: Optional[str]) -> bool:
@@ -163,6 +175,7 @@ class PoisonQuarantine:
         this window, only the evidence resets.)"""
         if not self.enabled or sig is None:
             return
+        self._maybe_sweep(self._clock())
         rec = self._records.get(sig)
         if rec is not None and rec.quarantined_at is None:
             del self._records[sig]
@@ -171,7 +184,9 @@ class PoisonQuarantine:
     def quarantined(self, sig: Optional[str]) -> bool:
         if not self.enabled or sig is None:
             return False
-        rec = self._get(sig, self._clock())
+        now = self._clock()
+        self._maybe_sweep(now)
+        rec = self._get(sig, now)
         return rec is not None and rec.quarantined_at is not None
 
     def refuse(self, sig: str) -> int:

@@ -79,13 +79,21 @@ __all__ = ["RouterServer", "route_forever"]
 _TRACE_ID_OK = _http.SAFE_ID_OK
 _SESSION_ID_OK = _TRACE_ID_OK
 
+# handoff successor preference (ISSUE 16): the decode fleet takes the
+# generation leg; mixed absorbs; another prefill replica only as a last
+# resort.  The FALLBACK rank (a failed handoff re-prefills instead)
+# prefers mixed first — decode replicas keep their slots for handoffs.
+_HANDOFF_RANK = {"decode": 0, "mixed": 1, "prefill": 2}
+_FALLBACK_RANK = {"mixed": 0, "decode": 1, "prefill": 2}
+
 
 class _RouterMetrics:
     """Registry handles resolved once (the PR 5 idiom)."""
 
     __slots__ = ("requests", "streams", "responses", "inflight",
                  "request_ms", "failover", "shed", "slo_decision",
-                 "health_polls", "replicas_gauge", "resumes")
+                 "health_polls", "replicas_gauge", "resumes", "handoff",
+                 "overlay_entries")
 
     def __init__(self):
         m = _obs.metrics
@@ -101,8 +109,11 @@ class _RouterMetrics:
         # jaxlint: disable=JL006 -- bounded by construction: phase callers pass literals only
         self.failover = lambda phase: m.counter("router.failover",
                                                 phase=phase)
-        # jaxlint: disable=JL006 -- bounded by construction: outcome callers pass resumed/unary/finished/ineligible/exhausted literals
+        # jaxlint: disable=JL006 -- bounded by construction: outcome callers pass resumed/unary/handoff/finished/ineligible/exhausted literals
         self.resumes = lambda o: m.counter("router.resumes", outcome=o)
+        # jaxlint: disable=JL006 -- bounded by construction: outcome callers pass ok/export_failed/import_failed/no_successor literals
+        self.handoff = lambda o: m.counter("router.handoff", outcome=o)
+        self.overlay_entries = m.gauge("router.overlay_entries")
         self.shed = m.counter("router.shed")
         # jaxlint: disable=JL006 -- bounded by construction: decision callers pass admit/shed/unavailable/breaker literals
         self.slo_decision = lambda d: m.counter("router.slo_decision",
@@ -158,6 +169,12 @@ class RouterServer:
         # request signature — a signature struck FLAGS_router_poison_
         # strikes times without progress is refused instead of replayed
         self.quarantine = PoisonQuarantine()
+        # disaggregated prefill/decode serving (ISSUE 16): an eligible
+        # new stream places on the prefill fleet with a 1-token budget
+        # cap; the finished prefix ships to a decode successor over the
+        # migration plane and the two legs splice into ONE client stream
+        self._handoff_on = bool(f("router_prefill_handoff"))
+        self._handoff_timeout_s = float(f("router_handoff_timeout_s"))
         # cascade breaker (ISSUE 15): attached by the fleet supervisor
         # (fleet/breaker.py); None = no breaker, resumes never park
         self.breaker = None
@@ -219,6 +236,8 @@ class RouterServer:
             counts[st.status(self.dead_after)] += 1
         for s, n in counts.items():
             self._m.replicas_gauge(s).set(n)
+        self._m.overlay_entries.set(
+            sum(len(st.routed) for st in self.states))
 
     # ----------------------------------------- supervisor registration --
     def add_replica(self, client: ReplicaClient) -> ReplicaState:
@@ -277,6 +296,18 @@ class RouterServer:
         live = [s for s in self.states if s.ok]
         placeable = [s for s in live if s.ready and not s.draining]
         shedding = sum(1 for s in placeable if s.slo_decision == "shed")
+        # per-role aggregates (ISSUE 16): the supervisor scales each
+        # role on its own signal — prefill fleets on queue depth (TTFT
+        # pressure), decode fleets on resident load (ITL pressure)
+        by_role: Dict[str, List[ReplicaState]] = {}
+        for s in placeable:
+            by_role.setdefault(s.role, []).append(s)
+        roles = {r: {
+            "placeable": len(ss),
+            "shedding": sum(1 for x in ss if x.slo_decision == "shed"),
+            "mean_load": sum(x.load() for x in ss) / len(ss),
+            "mean_queue_depth": sum(x.queue_depth for x in ss) / len(ss),
+        } for r, ss in by_role.items()}
         return {
             "replicas": len(self.states),
             "live": len(live),
@@ -285,8 +316,16 @@ class RouterServer:
             "all_shedding": bool(placeable) and shedding == len(placeable),
             "mean_load": (sum(s.load() for s in placeable)
                           / len(placeable)) if placeable else 0.0,
+            "roles": roles,
             "anomaly_total": sum(s.anomaly_total for s in self.states),
         }
+
+    def restage(self, src: str, dst: str) -> int:
+        """Supervisor seam for the proactive rebalance (ISSUE 16): the
+        sessions pinned to ``src`` just had their KV pre-staged on
+        ``dst`` over the migration plane — re-point their pins so their
+        next turns land where the pages now live."""
+        return self.placer.repin(src, dst)
 
     async def _health_loop(self, state: ReplicaState) -> None:
         while state in self.states:     # self-terminates after removal
@@ -600,6 +639,69 @@ class RouterServer:
                 out.append(s)
         return out
 
+    def _handoff_successors(self, tried: List[str],
+                            entry) -> List[ReplicaState]:
+        """Replay-exact successors for a disaggregated handoff (ISSUE
+        16), decode replicas first, then least-loaded."""
+        out = self._resume_candidates(tried, entry)
+        out.sort(key=lambda s: (_HANDOFF_RANK.get(s.role, 1), s.load()))
+        return out
+
+    async def _post_json(self, client: ReplicaClient, path: str,
+                         doc: dict, timeout_s: float
+                         ) -> Tuple[int, dict]:
+        """One bounded JSON POST against a replica (migration plane)."""
+        body = json.dumps(doc).encode()
+        reader, close = await asyncio.wait_for(
+            client.open("POST", path,
+                        headers=(("Content-Type", "application/json"),),
+                        body=body), timeout_s)
+        try:
+            status, _headers, rbody = await asyncio.wait_for(
+                _read_response(reader), timeout_s)
+        finally:
+            close()
+        try:
+            out = json.loads(rbody.decode() or "{}")
+        except (ValueError, UnicodeDecodeError):
+            out = {}
+        return status, out if isinstance(out, dict) else {}
+
+    async def _handoff_kv(self, src: ReplicaState, dst: ReplicaState,
+                          entry) -> str:
+        """Ship the prefill leg's finished prefix from ``src`` to
+        ``dst`` over the ISSUE 14 migration plane: export the full
+        pages under the journal's token history, import them as ready
+        prefix-cache nodes (``resume: false`` — the ROUTER re-dispatches
+        the stream itself; ``handoff: true`` so the replica counts
+        ``serving.kv.handoff_*``).  Returns ``"ok"`` /
+        ``"export_failed"`` / ``"import_failed"``."""
+        t = self._handoff_timeout_s
+        try:
+            status, doc = await self._post_json(
+                src.client, "/migratez/export",
+                {"tokens": entry.full_tokens}, t)
+            sessions = doc.get("sessions") if status == 200 else None
+        except Exception:
+            sessions = None
+        if not sessions:
+            return "export_failed"
+        try:
+            status, doc = await self._post_json(
+                dst.client, "/migratez/import",
+                {"sessions": sessions, "resume": False,
+                 "handoff": True}, t)
+        except Exception:
+            return "import_failed"
+        # a 200 with zero installed sessions (geometry mismatch,
+        # integrity rejection — per-snapshot isolation aborts inside
+        # the bulk import) left the successor with NO prefix: treat it
+        # as failed so the stream falls back instead of paying a full
+        # re-prefill on a decode replica
+        if status != 200 or int(doc.get("sessions") or 0) < 1:
+            return "import_failed"
+        return "ok"
+
     async def _breaker_gate(self) -> Optional[str]:
         """Park a post-death re-dispatch while the cascade breaker is
         open (ISSUE 15): replaying dead requests onto survivors is
@@ -668,22 +770,67 @@ class RouterServer:
         died_post_dispatch = False    # a death a replay COULD recover
         quarantined_out = False       # this signature struck out (15)
         probe = False                 # this dispatch IS the half-open probe
+        # disaggregated prefill/decode (ISSUE 16 tentpole): an eligible
+        # new stream dispatches to the prefill fleet with a 1-token cap;
+        # the decode leg continues on a successor after the KV handoff.
+        # Eligible = streaming + journaled (the journal carries the
+        # splice) + a declared budget of >= 2 tokens + prefill
+        # candidates AND at least one non-prefill successor.  A session
+        # pinned to a live candidate stays conversational — affinity
+        # (and the prefix it implies) beats phase specialization.
+        all_cands = list(candidates)
+        handoff_on = (self._handoff_on and stream and entry is not None
+                      and entry.resumable
+                      and entry.max_tokens is not None
+                      and entry.max_tokens >= 2)
+        if handoff_on:
+            pin = self.placer.pinned(session_id)
+            if pin is not None and any(s.id == pin for s in candidates):
+                handoff_on = False
+            else:
+                pref = [s for s in candidates if s.role == "prefill"]
+                if pref and len(pref) < len(candidates):
+                    candidates = pref
+                else:
+                    handoff_on = False
+        via_handoff = False           # a decode leg ran after a handoff
+        forced: Optional[ReplicaState] = None
         max_attempts = 2 * max(1, len(self.states)) + 2
         for _attempt in range(max_attempts):
             if not candidates:
+                if handoff_on and not head_sent[0]:
+                    # the prefill arm exhausted before anything reached
+                    # the client: fall back to the unrestricted set —
+                    # disaggregation is an optimization, not a contract
+                    handoff_on = False
+                    candidates = [s for s in all_cands
+                                  if s.id not in tried]
+                    if candidates:
+                        continue
                 break
             if sig is not None and self.quarantine.quarantined(sig):
                 # struck out (possibly by a concurrent flight of the
                 # same signature): no more corpses
                 quarantined_out = True
                 break
-            place_prompt = entry.full_tokens if resuming else prompt
-            state, reason = self.placer.place(place_prompt, session_id,
-                                              candidates)
+            if forced is not None:
+                # the handoff already chose (and pre-staged KV on) the
+                # successor — placement scoring is moot
+                state, reason = forced, "handoff"
+                forced = None
+            else:
+                place_prompt = entry.full_tokens if resuming else prompt
+                state, reason = self.placer.place(place_prompt,
+                                                  session_id, candidates)
             tried.append(state.id)
             up = (("X-Trace-Id", trace_id),
                   ("X-Router-Reason", reason))
-            body_now = entry.resume_body() if resuming else body
+            armed = (handoff_on and not resuming
+                     and state.role == "prefill")
+            if armed:
+                body_now = entry.capped_body(1)
+            else:
+                body_now = entry.resume_body() if resuming else body
             try:
                 up_reader, close = await state.client.open(
                     "POST", "/v1/completions", headers=up, body=body_now)
@@ -709,10 +856,49 @@ class RouterServer:
                 outcome, status = await self._relay(
                     state, up_reader, trace_id, writer, stream,
                     entry=entry, head_sent=head_sent, sig=sig,
-                    flight_tokens=flight_tokens)
+                    flight_tokens=flight_tokens, handoff=armed)
             finally:
                 state.inflight -= 1
                 close()
+            if outcome == "handoff":
+                # the prefill leg delivered its capped token(s): ship
+                # the finished prefix to a decode successor over the
+                # migration plane (ISSUE 16) and splice the decode leg
+                # into the same client stream via the replay journal
+                succ = self._handoff_successors(tried, entry)
+                target = succ[0] if succ else None
+                verdict = "no_successor" if target is None else \
+                    await self._handoff_kv(state, target, entry)
+                self._m.handoff(verdict).inc()
+                if verdict == "ok":
+                    via_handoff = True
+                    if session_id is not None:
+                        # the session's KV now lives on the decode
+                        # replica: follow-up turns belong there
+                        self.placer.pin(session_id, target.id)
+                    forced = target
+                    candidates = succ
+                else:
+                    # never a dropped stream: re-prefill on a survivor,
+                    # mixed first; a refused import target goes to the
+                    # back of the line, and the (healthy) source
+                    # replica rejoins last — it still holds the prefix
+                    # when nothing else does
+                    tried = [t for t in tried if t != state.id]
+                    fb = [s for s in
+                          self._resume_candidates(tried, entry)
+                          if target is None or s.id != target.id]
+                    fb.sort(key=lambda s: (
+                        _FALLBACK_RANK.get(s.role, 1), s.load()))
+                    if target is not None:
+                        fb.append(target)
+                    if not fb:
+                        break
+                    forced = fb[0]
+                    candidates = fb
+                resuming = True
+                entry.resumes += 1
+                continue
             if outcome == "done":
                 if probe and self.breaker is not None:
                     # the probe replica ANSWERED: 200 closes the
@@ -731,7 +917,8 @@ class RouterServer:
                         # relay only shows its tokens here)
                         self.quarantine.progress(sig)
                     if resuming:
-                        self._m.resumes("resumed").inc()
+                        self._m.resumes("handoff" if via_handoff
+                                        else "resumed").inc()
                     elif unary_replayed:
                         self._m.resumes("unary").inc()
                 return status
@@ -870,7 +1057,8 @@ class RouterServer:
     async def _relay(self, state: ReplicaState, up, trace_id,
                      writer, stream: bool = False, entry=None,
                      head_sent=None, sig=None,
-                     flight_tokens=None) -> Tuple[str, int]:
+                     flight_tokens=None,
+                     handoff: bool = False) -> Tuple[str, int]:
         """Forward one upstream response; returns ``(outcome, status)``.
 
         ``("done", status)`` — fully relayed.  ``("dead_prehead", 0)`` —
@@ -879,6 +1067,10 @@ class RouterServer:
         — died mid-SSE with the head out (resume or synthesize).
         ``("resume_reject", status)`` — a replay got a non-SSE answer
         after the head was out (healthy refusal: try another survivor).
+        ``("handoff", status)`` — the capped prefill leg finished
+        (``handoff=True`` and the upstream reported ``length``): the
+        finish frame is suppressed and the dispatch loop splices a
+        decode leg into the same stream (ISSUE 16).
 
         SSE relays whole frames: lines buffer until the blank-line
         terminator and a frame is written (and its token ids journaled)
@@ -941,8 +1133,8 @@ class RouterServer:
                 toks = ()
                 journaling = entry is not None and entry.resumable
                 if data is not None and \
-                        (journaling or (sig is not None
-                                        and not progressed)):
+                        (journaling or handoff
+                         or (sig is not None and not progressed)):
                     try:
                         choice = json.loads(data)["choices"][0]
                         finish = choice.get("finish_reason")
@@ -956,6 +1148,20 @@ class RouterServer:
                     # the error frame and resume instead of relaying it
                     died = True
                     break
+                if handoff and finish == "length":
+                    # the capped prefill leg is complete (ISSUE 16):
+                    # journal any tokens riding the finish frame but
+                    # suppress the frame itself — the client's stream
+                    # continues on the decode leg, whose own finish
+                    # frame closes it out bit-identically
+                    if toks:
+                        if journaling:
+                            self.journal.record(entry, toks)
+                        if flight_tokens is not None:
+                            flight_tokens[0] = True
+                        if not progressed and sig is not None:
+                            self.quarantine.progress(sig)
+                    return "handoff", status
                 if toks:
                     if journaling:
                         self.journal.record(entry, toks)
@@ -1024,8 +1230,17 @@ class RouterServer:
                 "journal_cap": self.journal.cap,
                 "outcomes": {o: int(_obs.metrics.counter(
                     "router.resumes", outcome=o).value)
-                    for o in ("resumed", "unary", "finished",
+                    for o in ("resumed", "unary", "handoff", "finished",
                               "ineligible", "exhausted")},
+            },
+            # disaggregated prefill/decode handoff plane (ISSUE 16)
+            "handoff": {
+                "enabled": self._handoff_on,
+                "timeout_s": self._handoff_timeout_s,
+                "outcomes": {o: int(_obs.metrics.counter(
+                    "router.handoff", outcome=o).value)
+                    for o in ("ok", "export_failed", "import_failed",
+                              "no_successor")},
             },
             # poison quarantine + cascade breaker (ISSUE 15)
             "quarantine": self.quarantine.state(),

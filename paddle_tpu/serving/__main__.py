@@ -50,6 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-new-tokens", type=int, default=128,
                    help="default completion budget when the request "
                         "omits max_tokens")
+    p.add_argument("--role", choices=("mixed", "prefill", "decode"),
+                   default=None,
+                   help="disaggregated-serving role advertised via "
+                        "/statusz (FLAGS_serving_role for this process; "
+                        "the router's phase routing keys off it)")
     p.add_argument("--prefix-cache", action="store_true",
                    help="enable the shared-prefix KV cache "
                         "(FLAGS_prefix_cache for this process)")
@@ -107,6 +112,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # single source of truth: the engine's prefix_cache=None default
         # reads this flag, and /statusz's flag dump stays honest
         flags.set_flags({"prefix_cache": True})
+    if args.role:
+        # same single-source rule as --prefix-cache: the server's
+        # role=None default reads the flag
+        flags.set_flags({"serving_role": args.role})
     engine = build_engine(args)
     from .server import serve_forever
     serve_forever(engine, host=args.host, port=args.port,

@@ -132,9 +132,19 @@ class ServingServer:
     def __init__(self, engine, *, model_name: str = "paddle-tpu",
                  slo=None, flight_recorder=None, watchdog=None,
                  sentinel=None, poll_s: float = 0.02,
-                 warmup: bool = False):
+                 warmup: bool = False, role: Optional[str] = None):
         self.engine = engine
         self.model_name = model_name
+        # disaggregated serving (ISSUE 16): the role this replica
+        # advertises via /statusz — a routing preference the router's
+        # phase placement reads, never an engine capability (a decode
+        # replica still prefills what it is asked to)
+        self.role = str(flags.flag("serving_role") if role is None
+                        else role)
+        if self.role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"serving role must be mixed/prefill/decode, "
+                f"got {self.role!r}")
         # readiness (ISSUE 7): with warmup=True the engine thread compiles
         # the step-program pair on junk traffic before /readyz reports
         # ready, so a router never places live traffic on a cold replica
@@ -745,6 +755,11 @@ class ServingServer:
                 err_type="internal_error"))
             await writer.drain()
             return 503
+        if payload.get("handoff"):
+            # prefill->decode handoff accounting (ISSUE 16): how much
+            # of the shipped prefix this successor must re-prefill —
+            # the acceptance lever is 0 full pages
+            _mig.record_handoff(sessions, result)
         writer.write(_http.json_response(200, result))
         await writer.drain()
         return 200
@@ -997,6 +1012,9 @@ class ServingServer:
         out = {
             "uptime_s": round(time.perf_counter() - self._t0, 3),
             "model": self.model_name,
+            # disaggregated serving (ISSUE 16): the router's phase
+            # routing keys off this
+            "role": self.role,
             "ready": self.ready(),
             # drain protocol (ISSUE 12): the router marks this replica
             # `draining` off its next poll; the supervisor polls
