@@ -1483,6 +1483,114 @@ def _run_kv_quant(on_tpu):
     }
 
 
+def _run_tp_serve(on_tpu):
+    """ISSUE 18: tensor-parallel serving A/B (`benchmarks/run.py
+    tp_serve`) — the continuous-batching engine on the 50%-shared
+    prefix mix, tp=2 (kv-head-sharded fused step over the 'mp' mesh)
+    vs the tp=1 single-device oracle at EQUAL TOTAL POOL BYTES (page
+    ids and block tables are host-global, so both arms get the same
+    num_pages; the tp arm's per-shard storage halves).  The gated
+    stamps are the refactor's contract, not the speedup: every token
+    bit-identical across arms (tp_serve_tp_bit_match) and warm sharded
+    steps at ZERO compiles (tp_serve_warm_zero_compile_match) — on the
+    virtual CPU mesh the collectives are pure overhead, so tok/s is
+    observational until the chip-capture queue runs the real A/B."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                      GenerationConfig)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        n_req, slots, max_seq, page, bucket = 32, 8, 1024, 32, 128
+        shared_len, tail_range, budget_range = 512, (16, 65), (16, 49)
+        num_pages = slots * (max_seq // page)
+    else:
+        cfg = LlamaConfig.tiny()
+        n_req, slots, max_seq, page, bucket = 16, 4, 256, 16, 64
+        shared_len, tail_range, budget_range = 96, (8, 17), (8, 17)
+        num_pages = slots * (max_seq // page)
+
+    import jax
+    if len(jax.devices()) < 2:
+        return {"tp_serve_skipped": "needs >= 2 devices for the tp arm"}
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    shared = list(rng.integers(1, cfg.vocab_size, shared_len))
+    prompts, budgets = [], []
+    for i in range(n_req):
+        tail = int(rng.integers(*tail_range))
+        if i % 2 == 0:                      # the 50% shared-prefix mix
+            prompts.append(shared +
+                           list(rng.integers(1, cfg.vocab_size, tail)))
+        else:
+            prompts.append(
+                list(rng.integers(1, cfg.vocab_size, shared_len + tail)))
+        budgets.append(int(rng.integers(*budget_range)))
+
+    def arm(tp):
+        eng = ContinuousBatchingEngine(
+            model, max_batch=slots,
+            gen=GenerationConfig(max_new_tokens=int(budget_range[1])),
+            max_seq_len=max_seq, page_size=page, prefill_bucket=bucket,
+            num_pages=num_pages, prefix_cache=True, tensor_parallel=tp)
+        # warmup compiles the step pair + the COW fork program: two junk
+        # requests sharing a prefix, own rng so the measured traffic is
+        # byte-identical across arms
+        wrng = np.random.default_rng(12345)
+        junk = list(wrng.integers(1, cfg.vocab_size, bucket + 3))
+        eng.add_request(junk, max_new_tokens=4)
+        eng.add_request(junk[:bucket] +
+                        list(wrng.integers(1, cfg.vocab_size, 3)),
+                        max_new_tokens=4)
+        eng.run()
+        rids = [eng.add_request(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        with obs.assert_overhead(record=True) as rec:
+            t0 = time.perf_counter()
+            res = eng.run()
+            dt = time.perf_counter() - t0
+        toks = sum(len(res[r]) for r in rids)
+        st = eng.stats()
+        pool_bytes = eng.g.pool_bytes
+        outs = [res[r] for r in rids]
+        del eng
+        return {"tps": toks / dt, "toks": toks, "compiles": rec.compiles,
+                "syncs": rec.syncs, "stats": st, "outputs": outs,
+                "pool_bytes": pool_bytes}
+
+    base = arm(1)
+    tp2 = arm(2)
+    return {
+        "tp_serve_requests": n_req,
+        "tp_serve_tokens": base["toks"],
+        "tp_serve_pool_bytes": int(base["pool_bytes"]),
+        "tp_serve_tp1_tok_per_sec": round(base["tps"], 1),
+        "tp_serve_tp2_tok_per_sec": round(tp2["tps"], 1),
+        "tp_serve_tp2_speedup": round(tp2["tps"] / max(base["tps"], 1e-9),
+                                      3),
+        "tp_serve_tp_bit_match": bool(base["outputs"] == tp2["outputs"]),
+        "tp_serve_tp1_warm_compiles": base["compiles"],
+        "tp_serve_tp2_warm_compiles": tp2["compiles"],
+        "tp_serve_tp2_warm_syncs": tp2["syncs"],
+        "tp_serve_warm_zero_compile_match": bool(
+            base["compiles"] == 0 and tp2["compiles"] == 0),
+        "tp_serve_equal_pool_bytes_match": bool(
+            base["pool_bytes"] == tp2["pool_bytes"]),
+        "tp_serve_tp1_prefix_hits": base["stats"]["prefix_hits"],
+        "tp_serve_tp2_prefix_hits": tp2["stats"]["prefix_hits"],
+        "tp_serve_tp2_degree": tp2["stats"]["tp"],
+    }
+
+
 def _run_fleet_chaos(on_tpu):
     """ISSUE 12: supervised-fleet churn under load (`benchmarks/run.py
     fleet_chaos`) — a 2→3→1-replica scenario driven END-TO-END by the

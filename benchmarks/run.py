@@ -5,7 +5,7 @@ Usage:
     python benchmarks/run.py [config ...] [--cpu] [--fused-gather=0|1]
                              [--trace=PATH] [--gate]
 configs: resnet gpt2 llama dit moe decode serve http_serve router_serve
-         fleet_chaos spec_decode kv_quant disagg all (default: all)
+         fleet_chaos spec_decode kv_quant disagg tp_serve all (default: all)
 
 --gate compares each fresh result against the committed
 results/<config>.json (benchmarks/check.py guardbands), stamps the
@@ -404,6 +404,21 @@ def run_kv_quant():
     return {"config": "kv_quant", **bench._run_kv_quant(_on_tpu())}
 
 
+def run_tp_serve():
+    """ISSUE 18: tensor-parallel serving A/B (`python benchmarks/run.py
+    tp_serve --cpu`) — tp=2 (kv-head-sharded fused engine step over the
+    'mp' mesh) vs the tp=1 oracle at equal total pool bytes on the
+    50%-shared mix.  Gated stamps: bit-identical outputs across arms
+    (tp_serve_tp_bit_match) and zero warm compiles on BOTH arms
+    (tp_serve_warm_zero_compile_match); per-arm tok/s rides along
+    observationally (CPU-mesh collectives are pure overhead).  Needs an
+    'mp' axis: forces a multi-device host platform before the backend
+    initializes (a no-op for the TPU plugin)."""
+    import bench
+    bench._force_host_devices()
+    return {"config": "tp_serve", **bench._run_tp_serve(_on_tpu())}
+
+
 def run_disagg():
     """ISSUE 16: disaggregated prefill/decode serving A/B (`python
     benchmarks/run.py disagg --cpu`) — 2 prefill + 2 decode replicas vs
@@ -426,7 +441,7 @@ CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
            "serve": run_serve,
            "http_serve": run_http_serve, "router_serve": run_router_serve,
            "kv_quant": run_kv_quant, "fleet_chaos": run_fleet_chaos,
-           "disagg": run_disagg}
+           "disagg": run_disagg, "tp_serve": run_tp_serve}
 
 
 def _supervise(names, timeout):

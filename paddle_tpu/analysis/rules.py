@@ -1,7 +1,8 @@
-"""jaxlint rule catalog (JL001–JL007).
+"""jaxlint rule catalog (JL001–JL008).
 
 Every rule is distilled from a bug class actually hit and fixed in this
-repo's history (PRs 1–7); the rationale strings cite the incident.  The
+repo's history (PRs 1–7, plus the PR 18 tensor-parallel mesh-axis
+discipline); the rationale strings cite the incident.  The
 rules are heuristic AST checks: they aim for zero false positives on
 idiomatic code, and anything intentionally kept carries an inline
 ``# jaxlint: disable=JLxxx -- <reason>`` suppression at the site.
@@ -664,3 +665,71 @@ class EngineSingleOwner(Rule):
                         if isinstance(t, ast.Name):
                             out.add(t.id)
         return out
+
+
+# ---------------------------------------------------------------- JL008 --
+
+@register
+class HardcodedMeshAxisName(Rule):
+    rule_id = "JL008"
+    title = "hard-coded mesh axis name in shard_map-reachable code"
+    rationale = (
+        "Tensor-parallel serving (PR 18) names its mesh axis exactly "
+        "once, in a module-level constant (generation.MP_AXIS), and "
+        "every collective inside the sharded step references it.  A "
+        "string literal repeated at a call site survives an axis rename "
+        "or a second mesh silently: the axis_index/all_gather pair "
+        "desynchronises and the engine ships wrong tokens with no "
+        "error.  In any module that builds shard_map programs, the "
+        "axis-name argument to a lax collective must be the module "
+        "constant or a variable/attribute threaded from one — never a "
+        "bare string.")
+
+    # lax collectives that take a mesh axis name; value is the
+    # positional slot of that argument (the array comes first for all
+    # but axis_index/axis_size)
+    _COLLECTIVES = {"axis_index": 0, "axis_size": 0, "all_gather": 1,
+                    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+                    "psum_scatter": 1, "all_to_all": 1, "ppermute": 1,
+                    "pshuffle": 1}
+
+    def visit(self, mod: ModuleInfo, ctx: RunContext) -> None:
+        # "shard_map-reachable" gate: a module that never mentions
+        # shard_map traces its collectives under pmap/jit axis binders
+        # owned elsewhere; the constant-discipline contract is scoped to
+        # modules that build shard_map programs themselves.
+        if "shard_map" not in mod.source:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = A.last_attr(node)
+            axis: Optional[ast.AST] = None
+            if name in self._COLLECTIVES:
+                slot = self._COLLECTIVES[name]
+                if len(node.args) > slot:
+                    axis = node.args[slot]
+            if axis is None:
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        axis = kw.value
+                        break
+            if axis is None or not self._literal_axis(axis):
+                continue
+            ctx.report(mod, self.rule_id, node,
+                       f"collective `{name}` called with a hard-coded "
+                       "axis-name string — inside shard_map-reachable "
+                       "code the axis must come from the module-level "
+                       "mesh-axis constant (e.g. MP_AXIS), so a mesh "
+                       "rename cannot silently split the "
+                       "axis_index/all_gather pair")
+
+    @staticmethod
+    def _literal_axis(node: ast.AST) -> bool:
+        """A bare axis-name string, or a tuple containing one."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return True
+        if isinstance(node, ast.Tuple):
+            return any(isinstance(e, ast.Constant) and
+                       isinstance(e.value, str) for e in node.elts)
+        return False

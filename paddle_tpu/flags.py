@@ -120,6 +120,19 @@ define_flag("kv_spill_pages", 0,
             "— eviction becomes a DMA instead of a re-prefill.  0 = off "
             "(evictions drop, the pre-ISSUE-13 behavior).  Requires the "
             "prefix cache.")
+define_flag("serving_tensor_parallel", 1,
+            "Tensor-parallel shard count for the serving engine (engine "
+            "kwarg tensor_parallel=): >1 shards the WHOLE fused engine "
+            "step over an 'mp' mesh axis — attention by kv-head (each "
+            "shard's ragged kernel only sees its heads' page planes), "
+            "grouped MoE by expert, RMS-norm/embedding/sampling "
+            "replicated — so greedy and seeded-sampling outputs stay "
+            "bit-identical to the tp=1 single-device oracle.  The paged "
+            "KV pool stores [num_kv_heads/mp, ...] per shard while page "
+            "ids, block tables, the prefix cache, the spill ring and "
+            "migration snapshots stay host-global.  num_kv_heads and "
+            "num_attention_heads must be divisible by the shard count "
+            "and the process must have at least that many devices.")
 define_flag("spec_decode", "",
             "Speculative decoding mode for the serving engine "
             "(inference/speculative.py): '' = off (bit-identical to the "
@@ -216,6 +229,14 @@ define_flag("router_load_weight", 1.0,
             "Placement score penalty weight per queued/busy request on a "
             "replica, in page_size token units (one queued request "
             "offsets one cached page at 1.0).")
+define_flag("router_capacity_weight", 1.0,
+            "Weight folding a replica's advertised capacity (tensor-"
+            "parallel degree + KV pool GiB from /statusz) into router "
+            "ordering: handoff/fallback ranking and scored placement "
+            "subtract capacity_weight * ((tp - 1) + pool_bytes/GiB) so a "
+            "tp=4 replica legitimately outranks a tp=1 one at equal "
+            "role/load.  0 restores the pure lexicographic role>load "
+            "rank; homogeneous fleets order identically at any weight.")
 define_flag("serving_sentinel", True,
             "Online regression sentinel (observability/sentinel.py) in the "
             "serving front door: EWMA+MAD drift detectors over TTFT/ITL, "

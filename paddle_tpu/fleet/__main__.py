@@ -55,6 +55,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefix-cache", action="store_true",
                    help="enable the shared-prefix KV cache on every "
                         "replica")
+    p.add_argument("--tensor-parallel", type=int, default=None,
+                   help="tensor-parallel shard count forwarded to every "
+                        "replica subprocess (--tensor-parallel on each "
+                        "paddle-tpu-serve; outputs stay bit-identical "
+                        "to tp=1)")
+    p.add_argument("--cache-dtype", default=None,
+                   help="KV pool dtype forwarded to every replica "
+                        "subprocess (--cache-dtype on each "
+                        "paddle-tpu-serve)")
     p.add_argument("--set", action="append", default=[],
                    metavar="NAME=VALUE", dest="flag_sets",
                    help="set any FLAGS_* by name, repeatable — applied "
@@ -87,6 +96,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     launch: List[str] = ["--preset", args.preset]
     if args.prefix_cache:
         launch.append("--prefix-cache")
+    # engine knobs ride the replica's own argparse surface (ISSUE 18
+    # satellite): one threading path, so a knob the serving launcher
+    # grows is forwarded here by name instead of silently dropping
+    if args.tensor_parallel is not None:
+        launch += ["--tensor-parallel", str(args.tensor_parallel)]
+    if args.cache_dtype is not None:
+        launch += ["--cache-dtype", args.cache_dtype]
     for pair in args.flag_sets:
         launch += ["--set", pair]
 

@@ -194,13 +194,21 @@ class InprocReplicaHandle(ReplicaHandle):
     the router an ``InprocReplica`` — optionally wrapped by the chaos
     harness's fault-injecting transport (``client_wrap``)."""
 
-    def __init__(self, rid: str, engine_factory: Callable[[], object], *,
-                 warmup: bool = False, client_wrap=None, server_kw=None):
+    def __init__(self, rid: str, engine_factory: Callable[..., object], *,
+                 warmup: bool = False, client_wrap=None, server_kw=None,
+                 engine_kwargs=None):
         super().__init__(rid)
         self._factory = engine_factory
         self._warmup = warmup
         self._wrap = client_wrap
         self._server_kw = dict(server_kw or {})
+        # ONE dict for engine knobs (ISSUE 18 satellite): passed to the
+        # factory as **kwargs so every knob (tensor_parallel,
+        # cache_dtype, pool geometry) reaches the engine by NAME — the
+        # old idiom baked geometry positionally into each factory
+        # closure, and a knob added on one launch path silently dropped
+        # on the other
+        self._engine_kwargs = dict(engine_kwargs or {})
         self.server = None
         self._client = None
         self._builder: Optional[threading.Thread] = None
@@ -216,7 +224,7 @@ class InprocReplicaHandle(ReplicaHandle):
         def _build():
             try:
                 with _BUILD_LOCK:
-                    engine = self._factory()
+                    engine = self._factory(**self._engine_kwargs)
                 kw = dict(slo=False, flight_recorder=False)
                 kw.update(self._server_kw)
                 srv = ServingServer(engine, warmup=self._warmup, **kw)

@@ -58,6 +58,12 @@ from ..utils import extract_params, stack_params
 from . import speculative as _sp
 from .kv_cache import PagedKVCache
 
+# The serving tensor-parallel mesh axis (FLAGS_serving_tensor_parallel).
+# Every axis-name string reaching a shard_map-wrapped body must come
+# from this constant (jaxlint JL008): a hard-coded "mp" that drifts from
+# the mesh construction is a silent wrong-axis collective.
+MP_AXIS = "mp"
+
 
 def _cow_copy_pages(cache, src, dst):
     """Whole-page KV copies src[i] -> dst[i] across every layer/head (the
@@ -105,7 +111,7 @@ def _rope_bt(x, cos, sin):
     return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
 
 
-def _moe_ffn(y, lp, top_k, dispatch="dense", block_m=128):
+def _moe_ffn(y, lp, top_k, dispatch="dense", block_m=128, mp_shards=None):
     """Routed SwiGLU expert mixture for the serving path (reference:
     incubate fused_moe inference semantics).
 
@@ -118,6 +124,16 @@ def _moe_ffn(y, lp, top_k, dispatch="dense", block_m=128):
     - dense (non-grouped configs): every expert runs under a lax.scan over
       all rows, combined with top-k gate weights — exact routing, no
       capacity, transients bounded to one expert.
+
+    ``mp_shards`` > 1 (tensor-parallel serving, inside a shard_map body):
+    each shard runs the grouped path over its own E/mp expert bank —
+    non-owned (token, choice) entries route to a local discard group
+    whose rows the combine's sentinel read returns as zero — and the
+    partial outputs are all-gathered and summed in fixed shard order.
+    Bit-identical to the single-device mixture: a token has at most
+    ``top_k`` nonzero expert terms, every other shard contributes an
+    exact +0.0, and IEEE addition of two values is order-insensitive
+    bitwise for top_k <= 2 (the caller only enables sharding then).
     """
     gw = lp["mlp.gate.weight"]              # [H, E]
     shape = y.shape
@@ -132,6 +148,41 @@ def _moe_ffn(y, lp, top_k, dispatch="dense", block_m=128):
         # the 8-row sublane multiple that covers them (same math, less pad)
         bm = max(8, min(block_m, -(-N * top_k // 8) * 8))
         topv, topi, _, _ = _llama._route_topk(xf, gw, top_k)
+        if mp_shards and mp_shards > 1:
+            E_loc = E // mp_shards
+            my = jax.lax.axis_index(MP_AXIS)
+            own = (topi // E_loc) == my
+            # non-owned entries dispatch to local expert E_loc — a
+            # discard group appended to the shard's bank purely as a
+            # sort destination; its rows never reach the combine
+            local_e = jnp.where(own, topi % E_loc, E_loc).reshape(N * top_k)
+            inv, pos, tg = sorted_dispatch_plan(local_e, E_loc + 1, bm)
+            M = inv.shape[0]
+            own_flat = own.reshape(N * top_k)
+            inv = jnp.where(
+                (inv < N * top_k)
+                & jnp.take(own_flat, jnp.minimum(inv, N * top_k - 1)),
+                inv, N * top_k)
+            keep = (pos < M) & own_flat
+            gates = topv * keep.reshape(N, top_k)
+            pos = jnp.where(keep, pos, M)      # sentinel row reads zero
+            tg = jnp.minimum(tg, E_loc - 1)
+
+            def _loc(w):
+                return jax.lax.dynamic_slice_in_dim(
+                    w, my * E_loc, E_loc, axis=0)
+
+            part = _llama._grouped_ffn(
+                xf, _loc(lp["mlp.experts_gate"]),
+                _loc(lp["mlp.experts_up"]), _loc(lp["mlp.experts_down"]),
+                gates, inv, pos, tg, E_loc, top_k, bm)
+            parts = jax.lax.all_gather(part, MP_AXIS, axis=0)  # [mp, N, H]
+            # explicit left-assoc shard-order sum — NEVER psum, whose
+            # reduction order XLA leaves unspecified
+            out = parts[0]
+            for s in range(1, mp_shards):
+                out = out + parts[s]
+            return out.reshape(shape)
         inv, pos, tg = sorted_dispatch_plan(
             topi.reshape(N * top_k), E, bm)
         out = _llama._grouped_ffn(
@@ -202,11 +253,41 @@ class LlamaGenerator:
                  max_seq_len: Optional[int] = None, page_size=32,
                  cache_dtype: Optional[str] = None,
                  prefill_bucket: int = 64, sync_every: int = 8,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None,
+                 tensor_parallel: Optional[int] = None):
         c = model.config
         self.config = c
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len or c.max_position_embeddings
+        # tensor-parallel serving (FLAGS_serving_tensor_parallel): tp > 1
+        # shards the whole fused step over the `mp` mesh axis — attention
+        # by kv-head, grouped MoE by expert, everything else replicated —
+        # with per-shard KV page storage under host-global page ids
+        if tensor_parallel is None:
+            tensor_parallel = int(flags.flag("serving_tensor_parallel") or 1)
+        tp = max(int(tensor_parallel), 1)
+        if tp > 1:
+            if len(jax.devices()) < tp:
+                raise ValueError(
+                    f"tensor_parallel={tp} needs {tp} devices, have "
+                    f"{len(jax.devices())}")
+            if c.num_key_value_heads % tp or c.num_attention_heads % tp:
+                raise ValueError(
+                    f"tensor_parallel={tp} must divide num_kv_heads="
+                    f"{c.num_key_value_heads} and num_heads="
+                    f"{c.num_attention_heads}")
+            self.mesh = jax.sharding.Mesh(
+                np.asarray(jax.devices()[:tp]), (MP_AXIS,))
+        else:
+            self.mesh = None
+        self.tp = tp
+        # grouped MoE shards by expert only where the discard-group
+        # combine is provably bit-exact (top_k <= 2: at most two nonzero
+        # terms per token, IEEE pairwise-commutative) and the bank
+        # divides; otherwise the mixture stays replicated under tp
+        self._moe_shards = tp if (
+            tp > 1 and c.moe_num_experts and c.moe_dispatch == "grouped"
+            and c.moe_top_k <= 2 and c.moe_num_experts % tp == 0) else None
         if cache_dtype is None:
             # FLAGS_kv_cache_dtype: "auto" follows the model dtype;
             # "int8" turns on the quantized memory plane (ISSUE 13)
@@ -241,7 +322,19 @@ class LlamaGenerator:
             num_layers=c.num_hidden_layers,
             num_pages=self.num_pages,
             page_size=page_size, num_kv_heads=c.num_key_value_heads,
-            head_dim=c.head_dim, dtype=cache_dtype or c.dtype)
+            head_dim=c.head_dim, dtype=cache_dtype or c.dtype,
+            mesh=self.mesh, axis=MP_AXIS)
+        # host-global pool bytes (all shards) — advertised via stats() /
+        # /statusz so the router's capacity-weighted placement can rank
+        # heterogeneous replicas
+        self.pool_bytes = self.num_pages * PagedKVCache.bytes_per_page(
+            c.num_hidden_layers, c.num_key_value_heads, page_size,
+            c.head_dim, cache_dtype or c.dtype)
+        if _obs.metrics_enabled():
+            from ..observability import metrics as _metrics
+            _metrics.gauge("serving.tp.degree").set(tp)
+            _metrics.gauge("serving.tp.shard_pool_bytes").set(
+                self.pool_bytes // tp)
         cos, sin = _rope_cos_sin(self.max_seq_len, c.head_dim, c.rope_theta,
                                  jnp.float32)
         self._cos, self._sin = cos, sin
@@ -260,6 +353,43 @@ class LlamaGenerator:
             "blocks": blocks,
         }
 
+    def _tp_jit(self, fn, n_in, n_out, out_cache_idx):
+        """jit one engine program, shard_map-wrapping it over the ``mp``
+        mesh when tensor-parallel: the cache tuple (arg 1 in, index
+        ``out_cache_idx`` out) rides the pool's per-shard kv-head specs,
+        every other operand — weights, tokens, masks, the PRNG key — is
+        replicated.  Still ONE jitted program per bucket; pool donation
+        passes through jit(shard_map) unchanged, so warm tp steps keep
+        the 0-compile / 0-sync contract."""
+        if self.tp == 1:
+            return jax.jit(fn, donate_argnums=(1,))
+        from jax.sharding import PartitionSpec
+        rep = PartitionSpec()
+        cspec = self.cache.pspecs
+        in_specs = tuple(cspec if i == 1 else rep for i in range(n_in))
+        out_specs = tuple(cspec if i == out_cache_idx else rep
+                          for i in range(n_out))
+        return jax.jit(
+            jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                          out_specs=out_specs),
+            donate_argnums=(1,))
+
+    def pool_jit(self, fn, n_extra):
+        """jit a pool-maintenance program ``fn(cache, *extras) -> cache``
+        (COW page copies, spill swap-ins) with the pool donated — shard_
+        map-wrapped like the step when tensor-parallel, extras
+        replicated."""
+        if self.tp == 1:
+            return jax.jit(fn, donate_argnums=(0,))
+        from jax.sharding import PartitionSpec
+        rep = PartitionSpec()
+        cspec = self.cache.pspecs
+        return jax.jit(
+            jax.shard_map(fn, mesh=self.mesh,
+                          in_specs=(cspec,) + (rep,) * n_extra,
+                          out_specs=cspec),
+            donate_argnums=(0,))
+
     def _step_jit(self, gc: GenerationConfig, t: int, track_recent=False):
         """The fused serving step, jitted for (sampling config, q bucket).
         ``track_recent`` (ngram spec engines) threads the drafter's
@@ -267,9 +397,11 @@ class LlamaGenerator:
         key = (gc._key(), t, bool(track_recent))
         if key not in self._jit_cache:
             import functools
-            self._jit_cache[key] = jax.jit(
-                functools.partial(self._step_fn, gc, t, bool(track_recent)),
-                donate_argnums=(1,))
+            track = bool(track_recent)
+            self._jit_cache[key] = self._tp_jit(
+                functools.partial(self._step_fn, gc, t, track),
+                n_in=13 if track else 12, n_out=8 if track else 7,
+                out_cache_idx=5)
         return self._jit_cache[key]
 
     def _spec_jit(self, gc: GenerationConfig, k: int, nmax: int):
@@ -279,9 +411,9 @@ class LlamaGenerator:
         key = ("spec", gc._key(), k, nmax)
         if key not in self._jit_cache:
             import functools
-            self._jit_cache[key] = jax.jit(
+            self._jit_cache[key] = self._tp_jit(
                 functools.partial(self._spec_verify_fn, gc, k, nmax),
-                donate_argnums=(1,))
+                n_in=13, n_out=11, out_cache_idx=9)
         return self._jit_cache[key]
 
     def _fused_jit(self, gc: GenerationConfig, k: int):
@@ -290,9 +422,9 @@ class LlamaGenerator:
         key = ("fused", gc._key(), k)
         if key not in self._jit_cache:
             import functools
-            self._jit_cache[key] = jax.jit(
+            self._jit_cache[key] = self._tp_jit(
                 functools.partial(self._fused_decode_fn, gc, k),
-                donate_argnums=(1,))
+                n_in=10, n_out=9, out_cache_idx=7)
         return self._jit_cache[key]
 
     # ---- the shared transformer core of every serving step ----
@@ -326,6 +458,16 @@ class LlamaGenerator:
             kc, vc, ks, vs = cache
         else:
             kc, vc = cache
+        tp = self.tp
+        if tp > 1:
+            # inside the shard_map body: this shard's contiguous head
+            # blocks.  q heads group per kv head, so slicing kv heads
+            # [i*kvh_l, (i+1)*kvh_l) takes exactly the q heads
+            # [i*qh_l, (i+1)*qh_l) that attend to them — the all_gather
+            # on the head axis reassembles the oracle's layout bitwise
+            shard = jax.lax.axis_index(MP_AXIS)
+            qh_l = c.num_attention_heads // tp
+            kvh_l = c.num_key_value_heads // tp
 
         # token positions & write slots, derived in-jit from the block table
         offs = jnp.arange(T, dtype=jnp.int32)
@@ -361,18 +503,36 @@ class LlamaGenerator:
             k = _rope_bt(k, cos, sin)
             # prior context from the paged cache + this step's own rows
             # (causal), one mixed-mode kernel call; the fresh rows are
-            # committed to the cache only at the end of the step
-            attn = ragged_paged_attention(q, kcl, vcl, block_tables,
+            # committed to the cache only at the end of the step.  Under
+            # tp the cache slices kcl/vcl are already this shard's head
+            # planes (the scan carries per-shard storage), q/k/v slice to
+            # the matching head block, and each shard's kernel DMAs only
+            # its own heads' pages; the head-axis all_gather restores the
+            # full [B, T, qh, d] activation for the replicated o_proj
+            if tp > 1:
+                q_a = jax.lax.dynamic_slice_in_dim(
+                    q, shard * qh_l, qh_l, axis=2)
+                k_a = jax.lax.dynamic_slice_in_dim(
+                    k, shard * kvh_l, kvh_l, axis=2)
+                v_a = jax.lax.dynamic_slice_in_dim(
+                    v, shard * kvh_l, kvh_l, axis=2)
+            else:
+                q_a, k_a, v_a = q, k, v
+            attn = ragged_paged_attention(q_a, kcl, vcl, block_tables,
                                           ctx_prev, q_lens=ql,
-                                          k_new=k, v_new=v,
+                                          k_new=k_a, v_new=v_a,
                                           k_scale=ksl, v_scale=vsl)
+            if tp > 1:
+                attn = jax.lax.all_gather(attn, MP_AXIS, axis=2,
+                                          tiled=True)
             x = x + (attn.reshape(B, T, -1) @ lp["self_attn.o_proj.weight"])
             y = rms_norm_fp32(x, lp["post_attention_layernorm.weight"],
                               c.rms_norm_eps)
             if "mlp.experts_gate" in lp:          # MoE model serving
                 x = x + _moe_ffn(y, lp, c.moe_top_k,
                                  dispatch=c.moe_dispatch,
-                                 block_m=c.moe_block_m)
+                                 block_m=c.moe_block_m,
+                                 mp_shards=self._moe_shards)
             else:
                 act = jax.nn.silu(y @ lp["mlp.gate_proj.weight"]) * \
                     (y @ lp["mlp.up_proj.weight"])
@@ -386,6 +546,16 @@ class LlamaGenerator:
         kvh, dh = c.num_key_value_heads, c.head_dim
         k_all = k_all.reshape(L, B * T, kvh, dh)
         v_all = v_all.reshape(L, B * T, kvh, dh)
+        if tp > 1:
+            # each shard commits only its own heads' fresh rows to its
+            # local page planes (the int8 path below then computes its
+            # per-(layer, local-head, page) scale rows from the same
+            # bytes the oracle would — absmax is per-head, so the
+            # gathered global planes are bit-identical at any tp)
+            k_all = jax.lax.dynamic_slice_in_dim(
+                k_all, shard * kvh_l, kvh_l, axis=2)
+            v_all = jax.lax.dynamic_slice_in_dim(
+                v_all, shard * kvh_l, kvh_l, axis=2)
         if quant:
             # quantize fresh K/V per page on the way in (page-level RMW:
             # the absmax scale covers every row of the page)
@@ -896,6 +1066,20 @@ class ContinuousBatchingEngine:
         else:
             self._hist = None
             self._recent = None
+        # tensor-parallel: the step programs return carried state
+        # mesh-replicated (out_specs P() over the serving mesh).  Seed
+        # the carried arrays with the SAME sharding, or the first drain
+        # flips their layout and the second admission wave re-specializes
+        # every eager op AND the step program (warm contract: 0 compiles)
+        if self.g.tp > 1:
+            rep = jax.sharding.NamedSharding(
+                self.g.mesh, jax.sharding.PartitionSpec())
+            self.tokens, self.positions, self.finished, self.counts, \
+                self.key = jax.device_put(
+                    (self.tokens, self.positions, self.finished,
+                     self.counts, self.key), rep)
+            if self._recent is not None:
+                self._recent = jax.device_put(self._recent, rep)
         # per-row write caps for the spec programs (tokens the block
         # table covers): cached device array, refreshed only when an
         # allocation/truncation/admission changed it — the same
@@ -909,7 +1093,7 @@ class ContinuousBatchingEngine:
             self.prefix_cache = PrefixCache(
                 self.g.cache.allocator, self.g.page_size,
                 min_pages=flags.flag("prefix_cache_min_pages"))
-            self._cow_jit = jax.jit(_cow_copy_pages, donate_argnums=(0,))
+            self._cow_jit = self.g.pool_jit(_cow_copy_pages, n_extra=2)
             # warm the copy program with an all-no-op call so the first
             # cache hit (and every later one) stays zero-recompile
             none = jnp.full((B,), -1, jnp.int32)
@@ -1265,6 +1449,11 @@ class ContinuousBatchingEngine:
         s = self.g.cache.allocator.stats()
         s["kv_cache_dtype"] = ("int8" if self.g.cache.quantized
                                else str(self.g.cache.k.dtype))
+        # capacity advertisement (tensor-parallel serving): /statusz
+        # carries these so the router's capacity-weighted placement can
+        # rank heterogeneous fleets (a tp=4 replica outranks tp=1)
+        s["tp"] = self.g.tp
+        s["pool_bytes"] = self.g.pool_bytes
         s["prefix_cache_enabled"] = self.prefix_cache is not None
         if self.prefix_cache is not None:
             s["prefix_cached_pages"] = self.prefix_cache.cached_pages()

@@ -317,23 +317,38 @@ class PagedKVCache:
     kernel dequantizes on its VMEM slot right after the DMA wait and the
     engine's batched commit requantizes per page on the way in, so
     nothing above the cache changes shape — the pool just holds ~4x more
-    tokens per HBM byte."""
+    tokens per HBM byte.
+
+    Under tensor-parallel serving (``mesh=`` + ``axis=``) page *storage*
+    is shard-local: the pools (and int8 scale rows) are laid out
+    ``[num_kv_heads/mp, ...]`` per device via a NamedSharding on the
+    kv-head axis, while page ids, block tables, the allocator, the
+    prefix cache and the spill ring stay host-global.  ``np.asarray`` on
+    a page slice gathers the full global plane, so migration snapshots
+    and spill bytes are identical at any shard count."""
 
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
-                 num_kv_heads: int, head_dim: int, dtype="bfloat16"):
+                 num_kv_heads: int, head_dim: int, dtype="bfloat16",
+                 mesh=None, axis: str = "mp"):
         self.num_layers = num_layers
         self.page_size = page_size
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
         self.quantized = str(dtype) == "int8"
+        self.mesh = mesh
+        self.axis = axis
+        if mesh is not None and num_kv_heads % mesh.shape[axis] != 0:
+            raise ValueError(
+                f"num_kv_heads={num_kv_heads} not divisible by "
+                f"tensor-parallel degree {mesh.shape[axis]}")
         shape = (num_layers, num_kv_heads, num_pages, page_size, head_dim)
         if self.quantized:
-            self.k = jnp.zeros(shape, jnp.int8)
-            self.v = jnp.zeros(shape, jnp.int8)
+            self.k = self._pool(shape, jnp.int8, jnp.zeros)
+            self.v = self._pool(shape, jnp.int8, jnp.zeros)
             # all-zero pages dequantize to exactly 0 under any scale;
             # 1.0 keeps untouched pages' dequant well-defined
-            self.k_scale = jnp.ones(shape[:3], jnp.float32)
-            self.v_scale = jnp.ones(shape[:3], jnp.float32)
+            self.k_scale = self._pool(shape[:3], jnp.float32, jnp.ones)
+            self.v_scale = self._pool(shape[:3], jnp.float32, jnp.ones)
             # pool bytes saved vs an equal-page fp32 pool (K and V, minus
             # the scale planes) — the capacity headroom the quantized
             # plane buys at fixed HBM budget
@@ -342,11 +357,21 @@ class PagedKVCache:
             _serving_bump("kv.quant_bytes_saved", max(saved, 0))
         else:
             dt = jnp.dtype(dtype)
-            self.k = jnp.zeros(shape, dt)
-            self.v = jnp.zeros(shape, dt)
+            self.k = self._pool(shape, dt, jnp.zeros)
+            self.v = self._pool(shape, dt, jnp.zeros)
             self.k_scale = None
             self.v_scale = None
         self.allocator = PageAllocator(num_pages, page_size)
+
+    def _pool(self, shape, dt, fill):
+        """One pool plane: host-global shape, shard-local storage on the
+        kv-head axis (axis 1) when a mesh is configured."""
+        arr = fill(shape, dt)
+        if self.mesh is None:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec
+        spec = PartitionSpec(None, self.axis)
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
 
     @property
     def arrays(self):
@@ -355,6 +380,16 @@ class PagedKVCache:
         if self.quantized:
             return self.k, self.v, self.k_scale, self.v_scale
         return self.k, self.v
+
+    @property
+    def pspecs(self):
+        """shard_map partition specs matching ``.arrays`` order: every
+        plane (pools AND scale rows) is sharded on the kv-head axis."""
+        from jax.sharding import PartitionSpec
+        spec = PartitionSpec(None, self.axis)
+        if self.quantized:
+            return spec, spec, spec, spec
+        return spec, spec
 
     def update(self, k, v, k_scale=None, v_scale=None) -> None:
         """Store the cache arrays returned by a jitted (donating) step."""

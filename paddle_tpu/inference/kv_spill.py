@@ -56,6 +56,39 @@ def _upload_page(cache, page, host):
     return tuple(out)
 
 
+def make_upload_program(cache):
+    """Build the donating page swap-in program for this pool.
+
+    Single-device pools jit ``_upload_page`` directly.  Tensor-parallel
+    pools (``cache.mesh`` set) keep the HOST side of the wire format
+    global — a spilled/migrated page plane is always the full
+    ``[layers, num_kv_heads, ...]`` array — and re-shard on install: the
+    shard_map body slices each host plane to its shard's kv-head block
+    (every plane, pools and int8 scale rows alike, carries kv heads on
+    axis 1) before the scatter into shard-local storage.  Spill ring,
+    migration import and warmup all share this one program, so swap-in
+    bytes and compile counts are identical at any shard count."""
+    if getattr(cache, "mesh", None) is None:
+        return jax.jit(_upload_page, donate_argnums=(0,))
+    axis = cache.axis
+
+    def _sharded(pool, page, host):
+        i = jax.lax.axis_index(axis)
+        local = tuple(
+            jax.lax.dynamic_slice_in_dim(h, i * p.shape[1], p.shape[1],
+                                         axis=1)
+            for p, h in zip(pool, host))
+        return _upload_page(pool, page, local)
+
+    from jax.sharding import PartitionSpec
+    rep = PartitionSpec()
+    cspec = cache.pspecs
+    return jax.jit(
+        jax.shard_map(_sharded, mesh=cache.mesh,
+                      in_specs=(cspec, rep, rep), out_specs=cspec),
+        donate_argnums=(0,))
+
+
 class HostSpillPool:
     """Fixed ring of host-RAM page slots + the swap-in upload program.
 
@@ -70,7 +103,7 @@ class HostSpillPool:
         self._free: List[int] = list(range(self.capacity - 1, -1, -1))
         # slot -> host page planes, same order as cache.arrays
         self._slots: Dict[int, Tuple[np.ndarray, ...]] = {}
-        self._upload = jax.jit(_upload_page, donate_argnums=(0,))
+        self._upload = make_upload_program(cache)
         self.spilled_pages = 0           # cumulative spills
         self.swapins = 0                 # cumulative swap-ins
         m = _obs.metrics

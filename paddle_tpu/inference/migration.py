@@ -48,7 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as _obs
-from .kv_spill import _upload_page
+from .kv_spill import make_upload_program
 
 __all__ = ["MigrationError", "export_session", "export_all",
            "import_session", "import_sessions", "warm", "record_handoff",
@@ -357,8 +357,11 @@ def _uploader(engine):
     up = getattr(engine, "_mig_upload", None)
     if up is None:
         sp = engine.spill
-        up = sp._upload if sp is not None \
-            else jax.jit(_upload_page, donate_argnums=(0,))
+        # make_upload_program re-shards on install under tensor-parallel
+        # pools: snapshot page planes stay host-global on the wire (one
+        # digest at any tp), each shard scatters only its kv-head block
+        up = sp._upload if sp is not None else make_upload_program(
+            engine.g.cache)
         engine._mig_upload = up
     return up
 

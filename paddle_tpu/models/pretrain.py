@@ -41,6 +41,10 @@ from ..distributed.pipeline_spmd import (interleave_chunk_order,
 from ..utils import extract_params, functional_call, stack_params
 from .llama import LlamaConfig, LlamaDecoderLayer, _rope_cos_sin, _scaled_init
 
+# data-parallel mesh axis: collectives inside shard_map bodies must
+# reference this constant, not the literal (jaxlint JL008)
+DP_AXIS = "dp"
+
 
 def _remat(f, policy: str):
     """jax.checkpoint under a named policy (reference recompute pass:
@@ -731,14 +735,14 @@ class PretrainStep:
                 buf = qc.pack_bucket(flat, bucket)
                 e = ef_bufs.get(f"b{bi}")
                 red, e_new = qc.ring_all_reduce(
-                    buf, "dp", axis_size=n, int8=int8, block=block,
+                    buf, DP_AXIS, axis_size=n, int8=int8, block=block,
                     key=None if key is None else jax.random.fold_in(key, bi),
                     error_feedback=e)
                 if e is not None:
                     new_ef[f"b{bi}"] = e_new
                 # sum -> mean convention in fp32, THEN cast to grad dtype
                 qc.unpack_bucket(red / ntok, bucket, flat, synced)
-            loss = jax.lax.psum(loss_sum, "dp") / ntok
+            loss = jax.lax.psum(loss_sum, DP_AXIS) / ntok
             return loss, jax.tree_util.tree_unflatten(treedef, synced), new_ef
 
         # check_vma=False: the gathered grads are built from ppermute'd

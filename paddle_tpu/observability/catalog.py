@@ -122,6 +122,17 @@ CATALOG: Dict[str, tuple] = {
         "counter", "", "tokens the decode leg re-prefills because "
         "their pages did NOT survive the handoff (the disagg bench "
         "gates this at zero)"),
+    # ---- serving: tensor-parallel engine step (ISSUE 18) ----
+    "serving.tp.degree": (
+        "gauge", "", "tensor-parallel shard count of the serving engine "
+        "(FLAGS_serving_tensor_parallel; 1 = single-device step).  The "
+        "whole fused step is shard_map-sharded over the 'mp' mesh axis "
+        "— attention by kv-head, grouped MoE by expert — with outputs "
+        "bit-identical to tp=1"),
+    "serving.tp.shard_pool_bytes": (
+        "gauge", "", "per-shard KV page-pool bytes (host-global pool "
+        "bytes / tp): each shard stores only its kv heads' page planes "
+        "and int8 scale rows"),
     # ---- serving: speculative decoding (PR 9) ----
     "serving.spec.drafted_tokens": (
         "counter", "", "draft tokens dispatched for verification"),

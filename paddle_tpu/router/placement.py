@@ -86,6 +86,12 @@ class ReplicaState:
         # and decode replicas for handed-off generation legs
         self.role: str = "mixed"
         self.page_size: int = 0
+        # capacity advertisement (ISSUE 18): tensor-parallel degree and
+        # host-global KV pool bytes from /statusz engine stats — the
+        # weighted-rank inputs that let a tp=4 replica outrank a tp=1
+        # one at equal role/load (FLAGS_router_capacity_weight)
+        self.tp: int = 1
+        self.pool_bytes: int = 0
         # digest DELTA sync (ISSUE 14): the last confirmed epoch and its
         # generation nonce — the next poll asks for only the changes
         # since (gen, epoch); a gen mismatch or log miss ships the full
@@ -171,6 +177,14 @@ class ReplicaState:
         eng = doc.get("engine") or {}
         self.queue_depth = int(eng.get("waiting", 0) or 0) + \
             int(eng.get("slots_busy", 0) or 0)
+        try:
+            self.tp = max(int(eng.get("tp", 1) or 1), 1)
+        except (TypeError, ValueError):
+            self.tp = 1
+        try:
+            self.pool_bytes = max(int(eng.get("pool_bytes", 0) or 0), 0)
+        except (TypeError, ValueError):
+            self.pool_bytes = 0
         samp = (eng.get("sampling") if isinstance(eng, dict) else None)
         self.greedy = isinstance(samp, dict) and \
             samp.get("do_sample") is False
@@ -317,10 +331,44 @@ class ReplicaState:
                 "spilled_entries": len(self.spilled),
                 "routed_overlay": len(self.routed),
                 "page_size": self.page_size,
+                "tp": self.tp,
+                "pool_bytes": self.pool_bytes,
                 "slo": {"decision": self.slo_decision,
                         "retry_after_s": self.retry_after_s},
                 "anomalies": self.anomaly_total,
                 "failovers": self.failovers}
+
+
+# role tiers in the weighted successor rank are separated by a step no
+# realistic load or capacity term crosses: the capacity fold
+# differentiates WITHIN a tier (a tp=4 decode replica beats a tp=1
+# decode replica) without ever promoting across tiers
+_ROLE_STEP = 1e6
+
+
+def capacity_score(s: ReplicaState) -> float:
+    """A replica's advertised-capacity differentiator (ISSUE 18
+    satellite): tensor-parallel degree above baseline plus KV pool GiB.
+    Zero for a vanilla tp=1 replica with nothing advertised, so
+    homogeneous fleets order exactly as before at any weight."""
+    return (s.tp - 1) + s.pool_bytes / float(1 << 30)
+
+
+def weighted_rank(rank_map: Dict[str, int],
+                  capacity_weight: Optional[float] = None):
+    """Ascending sort key replacing the lexicographic (role, load)
+    tuple: role tier first (scaled far above everything else), then
+    load minus the capacity fold — so among same-role candidates a
+    bigger replica absorbs the work unless it is proportionally more
+    loaded."""
+    w = float(flags.flag("router_capacity_weight")
+              if capacity_weight is None else capacity_weight)
+
+    def key(s: ReplicaState) -> float:
+        return (_ROLE_STEP * rank_map.get(s.role, 1) + s.load()
+                - w * capacity_score(s))
+
+    return key
 
 
 class Placer:
@@ -329,7 +377,8 @@ class Placer:
     def __init__(self, policy: Optional[str] = None,
                  session_cap: Optional[int] = None,
                  hit_weight: Optional[float] = None,
-                 load_weight: Optional[float] = None):
+                 load_weight: Optional[float] = None,
+                 capacity_weight: Optional[float] = None):
         f = flags.flag
         self.policy = str(f("router_placement")
                           if policy is None else policy)
@@ -346,6 +395,9 @@ class Placer:
         # a spilled page is worth this fraction of a resident one: the
         # bytes are one swap-in upload away, not a re-prefill away
         self.spill_weight = float(f("router_spill_hit_weight"))
+        self.capacity_weight = float(f("router_capacity_weight")
+                                     if capacity_weight is None
+                                     else capacity_weight)
         self._sessions: "OrderedDict[str, str]" = OrderedDict()
         self._rr = 0
         m = _obs.metrics
@@ -432,8 +484,13 @@ class Placer:
                 # spilled pages are discounted, not free: resident >
                 # spilled > absent (ISSUE 16 satellite)
                 eff = (hits - sp) + self.spill_weight * sp
+                # capacity fold (ISSUE 18 satellite): advertised tp
+                # degree + pool bytes, in the fleet's token unit — a
+                # pure differentiator (identical across a homogeneous
+                # fleet, so scores shift uniformly and ordering holds)
                 score = self.hit_weight * eff * s.page_size \
-                    - self.load_weight * s.load() * unit
+                    - self.load_weight * s.load() * unit \
+                    + self.capacity_weight * capacity_score(s) * unit
                 key = (score, -s.load(), -((i - self._rr) % len(candidates)))
                 if best is None or key > best[0]:
                     best = (key, s, hits)

@@ -70,7 +70,7 @@ from .. import observability as _obs
 from ..serving import http as _http
 from ..serving.slo import jittered_retry_after
 from .journal import SessionJournal
-from .placement import Placer, ReplicaState
+from .placement import Placer, ReplicaState, weighted_rank
 from .quarantine import PoisonQuarantine, request_signature
 from .replica import ReplicaClient
 
@@ -642,9 +642,11 @@ class RouterServer:
     def _handoff_successors(self, tried: List[str],
                             entry) -> List[ReplicaState]:
         """Replay-exact successors for a disaggregated handoff (ISSUE
-        16), decode replicas first, then least-loaded."""
+        16), decode replicas first, then by weighted load-minus-capacity
+        (FLAGS_router_capacity_weight): a tp=4 decode replica
+        legitimately outranks an equally loaded tp=1 one."""
         out = self._resume_candidates(tried, entry)
-        out.sort(key=lambda s: (_HANDOFF_RANK.get(s.role, 1), s.load()))
+        out.sort(key=weighted_rank(_HANDOFF_RANK))
         return out
 
     async def _post_json(self, client: ReplicaClient, path: str,
@@ -888,8 +890,7 @@ class RouterServer:
                     fb = [s for s in
                           self._resume_candidates(tried, entry)
                           if target is None or s.id != target.id]
-                    fb.sort(key=lambda s: (
-                        _FALLBACK_RANK.get(s.role, 1), s.load()))
+                    fb.sort(key=weighted_rank(_FALLBACK_RANK))
                     if target is not None:
                         fb.append(target)
                     if not fb:

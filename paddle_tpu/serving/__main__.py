@@ -47,6 +47,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefill-bucket", type=int, default=64)
     p.add_argument("--num-pages", type=int, default=None,
                    help="KV pool pages (default: engine sizing rule)")
+    p.add_argument("--tensor-parallel", type=int, default=None,
+                   help="shard the fused engine step over this many "
+                        "devices on the 'mp' mesh axis "
+                        "(FLAGS_serving_tensor_parallel; outputs stay "
+                        "bit-identical to tp=1)")
+    p.add_argument("--cache-dtype", default=None,
+                   choices=("auto", "fp32", "float32", "bf16", "bfloat16",
+                            "int8"),
+                   help="KV page-pool storage dtype "
+                        "(FLAGS_kv_cache_dtype; int8 = quantized pages)")
     p.add_argument("--max-new-tokens", type=int, default=128,
                    help="default completion budget when the request "
                         "omits max_tokens")
@@ -84,10 +94,32 @@ def apply_flag_sets(pairs: List[str]) -> None:
         raise SystemExit(str(e))
 
 
+def engine_kwargs(args) -> dict:
+    """THE engine-kwargs dict from parsed args — the single source every
+    launch path (this launcher, the fleet spawner, in-process handles)
+    threads through to ``ContinuousBatchingEngine``.  New knobs land
+    here ONCE; before this, two call sites passed geometry positionally
+    and a knob added to one silently dropped on the other."""
+    from ..inference import GenerationConfig
+
+    kw = dict(max_batch=args.max_batch,
+              gen=GenerationConfig(max_new_tokens=args.max_new_tokens),
+              max_seq_len=args.max_seq_len, page_size=args.page_size,
+              prefill_bucket=args.prefill_bucket)
+    if args.num_pages is not None:
+        kw["num_pages"] = args.num_pages
+    if getattr(args, "tensor_parallel", None) is not None:
+        kw["tensor_parallel"] = args.tensor_parallel
+    if getattr(args, "cache_dtype", None) is not None:
+        kw["cache_dtype"] = None if args.cache_dtype == "auto" \
+            else args.cache_dtype
+    return kw
+
+
 def build_engine(args):
     """Model + engine from parsed args (import-heavy, so deferred)."""
     import paddle_tpu as paddle
-    from ..inference import ContinuousBatchingEngine, GenerationConfig
+    from ..inference import ContinuousBatchingEngine
     from ..models.llama import LlamaConfig, LlamaForCausalLM
 
     paddle.seed(args.seed)
@@ -96,13 +128,7 @@ def build_engine(args):
     if args.checkpoint:
         state = paddle.load(args.checkpoint)
         model.set_state_dict(state)
-    kw = dict(max_batch=args.max_batch,
-              gen=GenerationConfig(max_new_tokens=args.max_new_tokens),
-              max_seq_len=args.max_seq_len, page_size=args.page_size,
-              prefill_bucket=args.prefill_bucket)
-    if args.num_pages is not None:
-        kw["num_pages"] = args.num_pages
-    return ContinuousBatchingEngine(model, **kw)
+    return ContinuousBatchingEngine(model, **engine_kwargs(args))
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1027,6 +1027,13 @@ class ServingServer:
                 "slots_busy": sum(r is not None for r in eng.slot_req),
                 "slots": eng.B,
                 "streams_live": len(self._live),
+                # capacity advertisement (ISSUE 18): tensor-parallel
+                # degree + host-global KV pool bytes, the inputs of the
+                # router's capacity-weighted heterogeneous placement
+                # (explicit here so the advertisement never depends on
+                # drain cadence refreshing last_stats)
+                "tp": getattr(eng.g, "tp", 1),
+                "pool_bytes": getattr(eng.g, "pool_bytes", 0),
                 # the router's failover-resume eligibility check (ISSUE
                 # 14/15): greedy replays are bit-exact anywhere; sampled
                 # replays are bit-exact on a survivor with the IDENTICAL

@@ -241,6 +241,112 @@ class TestPagedAttentionMosaic:
             q, k_cache, v_cache, bt, cl, ql, kn, vn)
 
 
+class TestTensorParallelMosaic:
+    """ISSUE 18: cross-lower the kv-head-sharded ragged kernel under
+    shard_map in every serving program shape.  The tensor-parallel step
+    runs the SAME Pallas kernel on a [kvh/tp, ...] shard-local pool with
+    q sliced to the shard's query heads — Mosaic sees different block
+    shapes than the tp=1 lowering, and the collective pair
+    (axis_index/all_gather) must survive the TPU lowering pipeline, so a
+    chip-only failure can't hide behind CPU interpret mode."""
+
+    b, qh, kvh, d = 2, 8, 4, 128
+    n_pages, page_size, max_pages = 16, 32, 8
+    tp = 2
+
+    def _mesh(self):
+        import paddle_tpu  # noqa: F401  -- installs the jax.shard_map shim
+        return jax.sharding.Mesh(
+            np.asarray(jax.devices()[:self.tp]), ("mp",))
+
+    def _cache(self):
+        kc = _rand((self.kvh, self.n_pages, self.page_size, self.d),
+                   seed=1)
+        vc = _rand((self.kvh, self.n_pages, self.page_size, self.d),
+                   seed=2)
+        bt = jnp.zeros((self.b, self.max_pages), jnp.int32)
+        cl = jnp.full((self.b,), 40, jnp.int32)
+        return kc, vc, bt, cl
+
+    def _shard_export(self, T, ql, int8=False):
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.kernels.paged_attention import \
+            _pallas_ragged_paged_attention
+
+        mesh = self._mesh()
+        qh_l = self.qh // self.tp
+        kvh_l = self.kvh // self.tp
+        dt = jnp.float32 if int8 else jnp.bfloat16
+        q = _rand((self.b, T, self.qh, self.d), dt)
+        if int8:
+            rng = np.random.default_rng(7)
+            shape = (self.kvh, self.n_pages, self.page_size, self.d)
+            kc = jnp.asarray(rng.integers(-127, 128, shape), jnp.int8)
+            vc = jnp.asarray(rng.integers(-127, 128, shape), jnp.int8)
+            ks = jnp.asarray(rng.uniform(0.005, 0.02,
+                                         (self.kvh, self.n_pages)),
+                             jnp.float32)
+            vs = jnp.asarray(ks)
+            bt = jnp.zeros((self.b, self.max_pages), jnp.int32)
+            cl = jnp.full((self.b,), 40, jnp.int32)
+        else:
+            kc, vc, bt, cl = self._cache()
+            ks = vs = None
+        decode = T == 1 and ql is None
+        qlv = None if decode else jnp.asarray(ql, jnp.int32)
+        kn = None if decode else _rand((self.b, T, self.kvh, self.d),
+                                       dt, seed=3)
+        vn = None if decode else _rand((self.b, T, self.kvh, self.d),
+                                       dt, seed=4)
+
+        def body(q_, kc_, vc_, bt_, cl_, ql_=None, kn_=None, vn_=None,
+                 ks_=None, vs_=None):
+            # mirror of generation._forward_tokens' tp layer body: slice
+            # q (and fresh KV) to this shard's heads, run the kernel on
+            # the shard-local pool, gather heads back
+            shard = jax.lax.axis_index("mp")
+            q_s = jax.lax.dynamic_slice_in_dim(
+                q_, shard * qh_l, qh_l, axis=2)
+            if kn_ is not None:
+                kn_ = jax.lax.dynamic_slice_in_dim(
+                    kn_, shard * kvh_l, kvh_l, axis=2)
+                vn_ = jax.lax.dynamic_slice_in_dim(
+                    vn_, shard * kvh_l, kvh_l, axis=2)
+            attn = _pallas_ragged_paged_attention(
+                q_s, kc_, vc_, bt_, cl_, ql_, kn_, vn_, False,
+                ks_, vs_)[0]
+            return jax.lax.all_gather(attn, "mp", axis=2, tiled=True)
+
+        rep, sh = P(), P("mp")
+        args = [q, kc, vc, bt, cl]
+        specs = [rep, sh, sh, rep, rep]
+        if not decode:
+            args += [qlv, kn, vn]
+            specs += [rep, rep, rep]
+        if int8:
+            if decode:
+                args += [None, None, None]
+                specs += [rep, rep, rep]
+            args += [ks, vs]
+            specs += [sh, sh]
+        fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(specs),
+                           out_specs=rep)
+        _export_tpu(fn, *args)
+
+    def test_tp_decode_kernel(self):
+        self._shard_export(T=1, ql=None)
+
+    def test_tp_spec_verify_kernel(self):
+        self._shard_export(T=4, ql=(4, 1))
+
+    def test_tp_prefill_chunk_kernel(self):
+        self._shard_export(T=16, ql=(16, 3))
+
+    def test_tp_int8_kernel(self):
+        self._shard_export(T=4, ql=(4, 1), int8=True)
+
+
 class TestWeightOnlyMosaic:
     def test_w8a16(self):
         from paddle_tpu.kernels.weight_only import _wo_core

@@ -597,6 +597,66 @@ def test_jl007_covers_migration_module():
     assert len(ctx.findings) == 1
 
 
+def test_jl008_fires_on_hardcoded_axis_in_shard_map_module():
+    """ISSUE 18 satellite: a module that builds shard_map programs must
+    pull collective axis names from the module-level mesh-axis constant
+    — a literal repeated at the call site survives an axis rename and
+    silently splits the axis_index/all_gather pair."""
+    src = """
+        import jax
+
+        MP_AXIS = "mp"
+
+        def build(mesh):
+            def body(x):
+                i = jax.lax.axis_index("mp")
+                y = jax.lax.all_gather(x, "mp", axis=0, tiled=True)
+                z = jax.lax.psum(x, axis_name="mp")
+                return i, y, z
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=None, out_specs=None)
+    """
+    ctx = lint(src, rel="paddle_tpu/inference/generation.py",
+               select={"JL008"})
+    assert len(ctx.findings) == 3
+
+
+def test_jl008_quiet_on_constant_and_threaded_axis():
+    src = """
+        import jax
+
+        MP_AXIS = "mp"
+
+        def build(mesh, cache):
+            axis = cache.axis
+            def body(x):
+                i = jax.lax.axis_index(MP_AXIS)
+                y = jax.lax.all_gather(x, MP_AXIS, axis=0, tiled=True)
+                z = jax.lax.psum(x, axis)          # threaded variable
+                t = jax.lax.pmean(x, (MP_AXIS,))   # tuple of constants
+                return i, y, z, t
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=None, out_specs=None)
+    """
+    ctx = lint(src, rel="paddle_tpu/inference/generation.py",
+               select={"JL008"})
+    assert ctx.findings == []
+
+
+def test_jl008_quiet_outside_shard_map_modules():
+    """Modules that never mention shard_map trace their collectives
+    under axis binders owned elsewhere — the constant-discipline
+    contract does not reach them."""
+    src = """
+        import jax
+
+        def loss(x):
+            return jax.lax.psum(x, "dp")
+    """
+    ctx = lint(src, rel="paddle_tpu/models/other.py", select={"JL008"})
+    assert ctx.findings == []
+
+
 # ------------------------------------------------- suppressions (JL000) --
 
 def test_suppression_with_reason_is_honored():
@@ -763,7 +823,7 @@ def test_cli_list_rules(capsys):
 def test_rule_catalog_complete():
     cat = analysis.rule_catalog()
     assert sorted(cat) == ["JL001", "JL002", "JL003", "JL004", "JL005",
-                           "JL006", "JL007"]
+                           "JL006", "JL007", "JL008"]
     for cls in cat.values():
         assert cls.title and cls.rationale
 
