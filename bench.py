@@ -2201,6 +2201,305 @@ def _run_disagg(on_tpu):
     }
 
 
+def _run_router_shard(on_tpu):
+    """ISSUE 19: sharded-control-plane A/B (`benchmarks/run.py
+    router_shard`) — the 50%-shared session mix served by ONE router vs
+    a THREE-router fleet sharing a membership store, with a router
+    killed at the halfway barrier.  Requests spray round-robin across
+    the fleet (a dumb load balancer); consistent-hash session ownership
+    forwards each to its owner in AT MOST one hop, so session pins and
+    the routed overlay concentrate exactly as they do single-router:
+    the fleet-wide prefix hit rate must land within 10% of the
+    single-router arm, outputs must bit-match across ALL arms (greedy
+    placement-invariance survives both sharding and the kill —
+    router_shard_zero_loss_match), and the post-kill ring must have
+    moved the dead router's span to the survivors.  A third arm re-runs
+    the sharded fleet with the digest SKETCH forced on
+    (router_digest_sketch_threshold=0): the hit-rate delta vs the exact
+    digest is stamped, and the sketch's per-poll wire bytes must be
+    FLAT (identical after warmup and after the full run) while the
+    exact digest's bytes scale with resident pages."""
+    import asyncio
+    import json as _json
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags as _flags
+    from paddle_tpu import observability as obs
+    from paddle_tpu.controlplane import LocalStore, RouterControlPlane, \
+        StoreState
+    from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                      GenerationConfig)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.router import InprocReplica, RouterServer
+    from paddle_tpu.serving import ServingServer
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048, dtype="bfloat16")
+        slots, max_seq, page, bucket = 16, 1024, 32, 128
+        n_groups, group_size, n_unique = 8, 3, 24
+        shared_len, tail_range, budget_range, clients = \
+            512, (16, 65), (16, 49), 8
+    else:
+        cfg = LlamaConfig.tiny()
+        slots, max_seq, page, bucket = 4, 256, 16, 64
+        n_groups, group_size, n_unique = 4, 3, 12
+        shared_len, tail_range, budget_range, clients = \
+            96, (8, 25), (8, 17), 4
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    # the 50%-shared mix with SESSIONS: each shared-prefix group is one
+    # conversation (one session id -> one ring owner), uniques are
+    # one-shot sessions of their own
+    reqs = []
+    for g in range(n_groups):
+        shared = [int(t) for t in rng.integers(1, cfg.vocab_size,
+                                               shared_len)]
+        for _ in range(group_size):
+            tail = int(rng.integers(*tail_range))
+            reqs.append((f"g{g}",
+                         shared + [int(t) for t in rng.integers(
+                             1, cfg.vocab_size, tail)],
+                         int(rng.integers(*budget_range))))
+    for j in range(n_unique):
+        tail = int(rng.integers(*tail_range))
+        reqs.append((f"u{j}",
+                     [int(t) for t in rng.integers(
+                         1, cfg.vocab_size, shared_len + tail)],
+                     int(rng.integers(*budget_range))))
+    order = [int(i) for i in rng.permutation(len(reqs))]
+    n_req = len(reqs)
+
+    def _servers():
+        out = []
+        for _ in range(2):
+            eng = ContinuousBatchingEngine(
+                model, max_batch=slots,
+                gen=GenerationConfig(max_new_tokens=int(budget_range[1])),
+                max_seq_len=max_seq, page_size=page,
+                prefill_bucket=bucket, prefix_cache=True)
+            eng.add_request(list(rng.integers(1, cfg.vocab_size,
+                                              bucket + 3)),
+                            max_new_tokens=4)
+            eng.run()                      # warm both step programs
+            out.append(ServingServer(eng, slo=False,
+                                     flight_recorder=False).start())
+        return out
+
+    async def _one(router, i):
+        sid, prompt, budget = reqs[i]
+        body = _json.dumps({"prompt": prompt,
+                            "max_tokens": budget}).encode()
+        head = ("POST /v1/completions HTTP/1.1\r\nHost: bench\r\n"
+                f"X-Session-Id: {sid}\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n").encode()
+        r = asyncio.StreamReader()
+        r.feed_data(head + body)
+        r.feed_eof()
+        buf = bytearray()
+
+        class W:
+            def write(self, b):
+                buf.extend(b)
+
+            async def drain(self):
+                pass
+
+            def close(self):
+                pass
+
+            async def wait_closed(self):
+                pass
+
+        await router.handle(r, W())
+        raw = bytes(buf)
+        head_raw, _, body_raw = raw.partition(b"\r\n\r\n")
+        status = int(head_raw.split()[1])
+        assert status == 200, (status, body_raw[:200])
+        return i, _json.loads(body_raw)["choices"][0]["token_ids"]
+
+    async def _wave(pick_router, idxs):
+        sem = asyncio.Semaphore(clients)
+
+        async def worker(i):
+            async with sem:
+                return await _one(pick_router(i), i)
+
+        return await asyncio.gather(*(worker(i) for i in idxs))
+
+    def single_arm():
+        servers = _servers()
+        replicas = [InprocReplica(f"r{i}", s)
+                    for i, s in enumerate(servers)]
+        router = RouterServer(replicas, policy="scored",
+                              health_interval_s=1e9)
+
+        async def drive():
+            await router.poll_replicas()
+            half = len(order) // 2
+            out = await _wave(lambda i: router, order[:half])
+            await router.poll_replicas()
+            out += await _wave(lambda i: router, order[half:])
+            return out
+
+        try:
+            with obs.assert_overhead(record=True) as rec:
+                t0 = time.perf_counter()
+                results = asyncio.run(drive())
+                dt = time.perf_counter() - t0
+            exact_bytes = len(_json.dumps(
+                servers[0].engine.prefix_digest()))
+        finally:
+            for s in servers:
+                s.close()
+        outs = dict(results)
+        stats = [s.engine.stats() for s in servers]
+        return {"tps": sum(len(v) for v in outs.values()) / dt,
+                "outputs": [outs[i] for i in range(n_req)],
+                "hit_rate": sum(st["prefix_hits"] for st in stats) / n_req,
+                "compiles": rec.compiles, "exact_bytes": exact_bytes}
+
+    def sharded_arm(sketch):
+        old = _flags.get_flags("router_digest_sketch_threshold")
+        _flags.set_flags({"router_digest_sketch_threshold":
+                          0 if sketch else (1 << 30)})
+        fwd = {o: obs.metrics.counter("router.forwarded", outcome=o)
+               for o in ("out", "received", "fallback")}
+        moves = obs.metrics.counter("router.ring_moves")
+        base = {o: c.value for o, c in fwd.items()}
+        moves0 = moves.value
+        servers = _servers()
+        state = StoreState()
+        planes, routers = [], []
+        for i in range(3):
+            plane = RouterControlPlane(
+                f"rt{i}", LocalStore(state),
+                heartbeat_ttl_s=1e9)   # expiry driven by the kill below
+            router = RouterServer(
+                [InprocReplica(f"r{j}", s)
+                 for j, s in enumerate(servers)],
+                policy="scored", health_interval_s=1e9,
+                controlplane=plane)
+            planes.append(plane)
+            routers.append(router)
+        for i, plane in enumerate(planes):
+            for j, router in enumerate(routers):
+                if i != j:
+                    plane.register_peer(f"rt{j}",
+                                        InprocReplica(f"rt{j}", router))
+
+        async def drive():
+            for _ in range(2):             # join: hb then full refresh
+                for r in routers:
+                    await r.cp_tick()
+            for r in routers:
+                await r.poll_replicas()
+            half = len(order) // 2
+            # the dumb load balancer: spray over all 3 routers
+            out = await _wave(lambda i: routers[i % 3], order[:half])
+            # SIGKILL rt2 at the barrier: its heartbeat key vanishes,
+            # the survivors' next refresh moves its ring span
+            await planes[0].store.delete("router/rt2")
+            for p in planes[:2]:
+                peer = p._peers.get("rt2")
+                if peer is not None:
+                    peer.kill(close_server=False)
+            for _ in range(2):
+                for r in routers[:2]:
+                    await r.cp_tick()
+            for r in routers[:2]:
+                await r.poll_replicas()
+            out += await _wave(lambda i: routers[i % 2], order[half:])
+            return out
+
+        try:
+            with obs.assert_overhead(record=True) as rec:
+                t0 = time.perf_counter()
+                results = asyncio.run(drive())
+                dt = time.perf_counter() - t0
+            dig = servers[0].engine.prefix_digest()
+            # the flat-bytes claim is about the BITMAP: "n" jitters in
+            # digit count, the b64 bitmap never moves
+            sketch_bytes = (len(dig["sketch"]["bits"])
+                            if dig.get("mode") == "sketch" else None)
+        finally:
+            for s in servers:
+                s.close()
+            _flags.set_flags(old)
+        outs = dict(results)
+        stats = [s.engine.stats() for s in servers]
+        return {"tps": sum(len(v) for v in outs.values()) / dt,
+                "outputs": [outs[i] for i in range(n_req)],
+                "hit_rate": sum(st["prefix_hits"] for st in stats) / n_req,
+                "compiles": rec.compiles,
+                "sketch_bytes": sketch_bytes,
+                "ring_moves": int(moves.value - moves0),
+                "members": sorted(planes[0].members),
+                "fwd": {o: int(c.value - base[o])
+                        for o, c in fwd.items()}}
+
+    # flat-bytes probe: the sketch wire after ONE warm page vs after the
+    # whole run must serialize to the same byte count (m is fixed)
+    _flags_mod = _flags
+    old = _flags_mod.get_flags("router_digest_sketch_threshold")
+    _flags_mod.set_flags({"router_digest_sketch_threshold": 0})
+    try:
+        probe = _servers()
+        warm_sketch_bytes = len(
+            probe[0].engine.prefix_digest()["sketch"]["bits"])
+        for s in probe:
+            s.close()
+    finally:
+        _flags_mod.set_flags(old)
+
+    single = single_arm()
+    exact = sharded_arm(sketch=False)
+    sk = sharded_arm(sketch=True)
+    hops = exact["fwd"]["out"] / max(n_req, 1)
+    return {
+        "router_shard_requests": n_req,
+        "router_shard_routers": 3,
+        "router_shard_replicas": 2,
+        "router_shard_shared_frac": round(
+            n_groups * group_size / n_req, 3),
+        "router_shard_single_tok_per_sec": round(single["tps"], 1),
+        "router_shard_fleet_tok_per_sec": round(exact["tps"], 1),
+        "router_shard_single_hit_rate": round(single["hit_rate"], 3),
+        "router_shard_fleet_hit_rate": round(exact["hit_rate"], 3),
+        "router_shard_hit_ratio": round(
+            exact["hit_rate"] / max(single["hit_rate"], 1e-9), 3),
+        "router_shard_hit_within_10pct": bool(
+            exact["hit_rate"] >= 0.9 * single["hit_rate"]),
+        "router_shard_fwd_out": exact["fwd"]["out"],
+        "router_shard_fwd_received": exact["fwd"]["received"],
+        "router_shard_fwd_fallback": exact["fwd"]["fallback"],
+        "router_shard_fwd_per_req": round(hops, 3),
+        "router_shard_single_hop": bool(
+            hops <= 1.0
+            and exact["fwd"]["received"] == exact["fwd"]["out"]),
+        "router_shard_ring_moves": exact["ring_moves"],
+        "router_shard_survivors": exact["members"],
+        "router_shard_sketch_hit_rate": round(sk["hit_rate"], 3),
+        "router_shard_sketch_hit_delta": round(
+            sk["hit_rate"] - exact["hit_rate"], 3),
+        "router_shard_exact_digest_bytes": single["exact_bytes"],
+        "router_shard_sketch_digest_bytes": sk["sketch_bytes"],
+        "router_shard_sketch_bytes_flat": bool(
+            sk["sketch_bytes"] == warm_sketch_bytes),
+        "router_shard_warm_compiles_single": single["compiles"],
+        "router_shard_warm_compiles_fleet": exact["compiles"]
+        + sk["compiles"],
+        "router_shard_zero_loss_match": bool(
+            single["outputs"] == exact["outputs"] == sk["outputs"]),
+    }
+
+
 # extras measured after the flagship ladder, each in its own subprocess
 _EXTRAS = (("large", _run_large), ("decode", _run_decode),
            ("moe", _run_moe), ("gpt2", _run_gpt2_compiled_vs_eager),
@@ -2213,7 +2512,8 @@ _EXTRAS = (("large", _run_large), ("decode", _run_decode),
            ("router_serve", _run_router_serve),
            ("kv_quant", _run_kv_quant),
            ("fleet_chaos", _run_fleet_chaos),
-           ("disagg", _run_disagg))
+           ("disagg", _run_disagg),
+           ("router_shard", _run_router_shard))
 
 
 def _force_host_devices(n=8):

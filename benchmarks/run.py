@@ -5,7 +5,8 @@ Usage:
     python benchmarks/run.py [config ...] [--cpu] [--fused-gather=0|1]
                              [--trace=PATH] [--gate]
 configs: resnet gpt2 llama dit moe decode serve http_serve router_serve
-         fleet_chaos spec_decode kv_quant disagg tp_serve all (default: all)
+         fleet_chaos spec_decode kv_quant disagg tp_serve router_shard
+         all (default: all)
 
 --gate compares each fresh result against the committed
 results/<config>.json (benchmarks/check.py guardbands), stamps the
@@ -434,6 +435,20 @@ def run_disagg():
     return {"config": "disagg", **bench._run_disagg(_on_tpu())}
 
 
+def run_router_shard():
+    """ISSUE 19: sharded-control-plane A/B (`python benchmarks/run.py
+    router_shard --cpu`) — the 50%-shared session mix on ONE router vs
+    a THREE-router fleet sharing a membership store, spray-balanced,
+    with a router killed at the halfway barrier, plus a third arm with
+    the digest sketch forced on.  Gated stamps: bit-identical outputs
+    across all arms (router_shard_zero_loss_match), at most one forward
+    hop per request, fleet hit rate within 10% of single-router, the
+    ring span moved to the survivors, sketch-vs-exact hit-rate delta,
+    and FLAT sketch wire bytes next to the page-scaled exact digest."""
+    import bench
+    return {"config": "router_shard", **bench._run_router_shard(_on_tpu())}
+
+
 CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
            "dit": run_dit, "moe": run_moe, "decode": run_decode,
            "longctx": run_longctx, "grad_comm": run_grad_comm,
@@ -441,7 +456,8 @@ CONFIGS = {"resnet": run_resnet, "llama": run_llama, "gpt2": run_gpt2,
            "serve": run_serve,
            "http_serve": run_http_serve, "router_serve": run_router_serve,
            "kv_quant": run_kv_quant, "fleet_chaos": run_fleet_chaos,
-           "disagg": run_disagg, "tp_serve": run_tp_serve}
+           "disagg": run_disagg, "tp_serve": run_tp_serve,
+           "router_shard": run_router_shard}
 
 
 def _supervise(names, timeout):

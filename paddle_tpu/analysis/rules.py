@@ -22,9 +22,11 @@ from .core import ModuleInfo, Rule, RunContext, register
 # (inference/migration.py, ISSUE 14) is included even though it is
 # sync today — its functions are invoked from the /migratez handlers'
 # executor seam, and an async def creeping in there would block the
-# front door exactly like one in serving/ proper.
+# front door exactly like one in serving/ proper.  The control plane
+# (ISSUE 19) rides the ROUTER's event loop: a blocking store call in
+# an async def there stalls every in-flight completion stream.
 _ASYNC_PLANE = ("/serving/", "/router/", "/fleet/",
-                "/inference/migration")
+                "/inference/migration", "/controlplane/")
 
 
 def _in_async_plane(rel: str) -> bool:

@@ -1,0 +1,37 @@
+"""Sharded control plane (ISSUE 19).
+
+The router fleet's coordination layer: a tiny TCPStore-shaped
+membership/state store (``store.py``), a consistent-hash ring mapping
+``X-Session-Id`` to its owning router (``ring.py``), the per-router
+facade that ties both to ``RouterServer`` (``plane.py``), and the
+counting-Bloom digest sketch that keeps per-replica digest bytes flat
+as prefix caches grow (``sketch.py``).
+
+Stdlib-asyncio only — the store speaks newline-delimited JSON over one
+socket endpoint, the ring is a sorted blake2b keyspace, and every
+in-process test runs the same code paths through ``LocalStore`` with
+zero sockets.
+"""
+
+from .ring import HashRing
+from .sketch import BloomView, CountingBloom, fp_rate
+from .store import (LocalStore, StoreClient, StoreServer, StoreState,
+                    SyncStoreClient)
+from .plane import RouterControlPlane
+from .slots import InprocRouterHandle, ProcessRouterHandle, RouterHandle
+
+__all__ = [
+    "RouterHandle",
+    "InprocRouterHandle",
+    "ProcessRouterHandle",
+    "HashRing",
+    "BloomView",
+    "CountingBloom",
+    "fp_rate",
+    "LocalStore",
+    "StoreClient",
+    "StoreServer",
+    "StoreState",
+    "SyncStoreClient",
+    "RouterControlPlane",
+]

@@ -1,0 +1,426 @@
+"""Membership/state store: the fleet's single shared KV endpoint.
+
+TCPStore-shaped (the reference framework's layer-3 fleet bootstrap
+primitive): ``set`` / ``get`` / ``wait`` / ``cas`` over ONE socket
+endpoint, owned by the supervisor, plus heartbeat-based liveness so a
+dead router drops out of membership without anyone holding a lock on
+its corpse.
+
+Wire protocol (``StoreServer`` <-> ``StoreClient``): newline-delimited
+JSON, one object per request, one per response, many per connection:
+
+    -> {"op": "set",  "key": K, "value": V, "ttl": null}
+    <- {"ok": true, "version": 3}
+    -> {"op": "cas",  "key": K, "old": V0, "new": V1}
+    <- {"ok": false, "value": V_current}         # lost the race
+    -> {"op": "hb",   "key": K, "value": V, "ttl": 5.0}
+    <- {"ok": true}
+    -> {"op": "members", "prefix": "router/"}
+    <- {"ok": true, "members": {K: V, ...}}      # live heartbeats only
+
+The state itself (``StoreState``) is plain-dict + lock so the same
+object backs three faces: the socket server, the async in-process
+facade (``LocalStore`` — tier-1 tests, zero sockets), and the blocking
+client the supervisor thread uses (``SyncStoreClient``).  ``wait``
+blocks until a key exists; async waiters poll at 10 ms (control-plane
+cadence, not a data path).
+
+Bounds: every key carries an optional TTL (swept opportunistically on
+writes and membership reads) and the whole table is LRU-capped at
+``FLAGS_controlplane_store_max_keys`` — session churn can never grow
+the store without bound.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+from .. import flags
+from .. import observability as _obs
+
+__all__ = ["StoreState", "LocalStore", "StoreServer", "StoreClient",
+           "SyncStoreClient"]
+
+_WAIT_POLL_S = 0.01
+_MAX_LINE = 1 << 20
+
+_TOMBSTONE = object()
+
+
+class _StoreMetrics:
+    """Registry handles resolved once (the PR 5 idiom)."""
+
+    __slots__ = ("ops", "keys", "evictions")
+
+    def __init__(self):
+        m = _obs.metrics
+        # jaxlint: disable=JL006 -- bounded by construction: op is one of the fixed protocol verbs
+        self.ops = lambda op: m.counter("controlplane.store_ops", op=op)
+        self.keys = m.gauge("controlplane.store_keys")
+        self.evictions = m.counter("controlplane.store_evictions")
+
+
+class StoreState:
+    """The actual KV table.  Thread-safe; clock injectable for tests."""
+
+    def __init__(self, *, max_keys: Optional[int] = None, clock=None):
+        self._kv: "OrderedDict[str, Tuple[Any, int, Optional[float]]]" = \
+            OrderedDict()  # key -> (value, version, expires_at|None)
+        self._lock = threading.Lock()
+        self._clock = clock or time.monotonic
+        self.max_keys = int(flags.flag("controlplane_store_max_keys")
+                            if max_keys is None else max_keys)
+        self._m = _StoreMetrics()
+
+    # -- core ops (each is one lock hold; sweeps ride the write path) --
+
+    def set(self, key: str, value: Any, ttl: Optional[float] = None) -> int:
+        self._m.ops("set").inc()
+        with self._lock:
+            self._sweep_locked()
+            _, version, _ = self._kv.pop(key, (None, 0, None))
+            expires = self._clock() + ttl if ttl is not None else None
+            self._kv[key] = (value, version + 1, expires)
+            self._evict_locked()
+            self._m.keys.set(len(self._kv))
+            return version + 1
+
+    def get(self, key: str) -> Tuple[bool, Any]:
+        self._m.ops("get").inc()
+        with self._lock:
+            v = self._get_live_locked(key)
+            return (False, None) if v is _TOMBSTONE else (True, v)
+
+    def cas(self, key: str, old: Any, new: Any,
+            ttl: Optional[float] = None) -> Tuple[bool, Any]:
+        """Swap ``old -> new`` atomically; ``old=None`` means create-if-
+        absent.  Returns ``(won, current_value)``."""
+        self._m.ops("cas").inc()
+        with self._lock:
+            cur = self._get_live_locked(key)
+            if cur is _TOMBSTONE:
+                cur = None
+            if cur != old:
+                return False, cur
+            _, version, _ = self._kv.pop(key, (None, 0, None))
+            expires = self._clock() + ttl if ttl is not None else None
+            self._kv[key] = (new, version + 1, expires)
+            self._evict_locked()
+            self._m.keys.set(len(self._kv))
+            return True, new
+
+    def delete(self, key: str) -> bool:
+        self._m.ops("del").inc()
+        with self._lock:
+            hit = self._kv.pop(key, None) is not None
+            self._m.keys.set(len(self._kv))
+            return hit
+
+    def heartbeat(self, key: str, value: Any, ttl: float) -> None:
+        """Liveness stamp: a TTL'd set whose expiry IS the death signal."""
+        self._m.ops("hb").inc()
+        with self._lock:
+            _, version, _ = self._kv.pop(key, (None, 0, None))
+            self._kv[key] = (value, version + 1, self._clock() + float(ttl))
+            self._evict_locked()
+            self._m.keys.set(len(self._kv))
+
+    def members(self, prefix: str) -> Dict[str, Any]:
+        """Unexpired keys under ``prefix`` — the live-membership read."""
+        self._m.ops("members").inc()
+        with self._lock:
+            self._sweep_locked()
+            return {k: v for k, (v, _, _) in self._kv.items()
+                    if k.startswith(prefix)}
+
+    def dump(self) -> Dict[str, Any]:
+        with self._lock:
+            self._sweep_locked()
+            return {k: v for k, (v, _, _) in self._kv.items()}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._kv)
+
+    # -- internals (callers hold the lock) --
+
+    def _get_live_locked(self, key: str):
+        rec = self._kv.get(key)
+        if rec is None:
+            return _TOMBSTONE
+        value, _, expires = rec
+        if expires is not None and self._clock() >= expires:
+            del self._kv[key]
+            return _TOMBSTONE
+        return value
+
+    def _sweep_locked(self) -> None:
+        now = self._clock()
+        dead = [k for k, (_, _, exp) in self._kv.items()
+                if exp is not None and now >= exp]
+        for k in dead:
+            del self._kv[k]
+
+    def _evict_locked(self) -> None:
+        while len(self._kv) > self.max_keys:
+            self._kv.popitem(last=False)
+            self._m.evictions.inc()
+
+
+class LocalStore:
+    """Async facade over an in-process ``StoreState`` — the zero-socket
+    store every tier-1 test and in-proc fleet shares.  Same method
+    shapes as ``StoreClient`` so ``RouterControlPlane`` cannot tell the
+    difference."""
+
+    def __init__(self, state: Optional[StoreState] = None):
+        self.state = state if state is not None else StoreState()
+
+    async def set(self, key, value, ttl=None):
+        return self.state.set(key, value, ttl)
+
+    async def get(self, key):
+        return self.state.get(key)
+
+    async def cas(self, key, old, new, ttl=None):
+        return self.state.cas(key, old, new, ttl)
+
+    async def delete(self, key):
+        return self.state.delete(key)
+
+    async def heartbeat(self, key, value, ttl):
+        self.state.heartbeat(key, value, ttl)
+
+    async def members(self, prefix):
+        return self.state.members(prefix)
+
+    async def wait(self, key, timeout: float = 5.0):
+        deadline = time.monotonic() + timeout
+        while True:
+            ok, value = self.state.get(key)
+            if ok:
+                return True, value
+            if time.monotonic() >= deadline:
+                return False, None
+            await asyncio.sleep(_WAIT_POLL_S)
+
+    async def close(self):
+        pass
+
+
+class StoreServer:
+    """The socket endpoint: newline-JSON requests against a
+    ``StoreState``.  Supervisor-owned; one instance per fleet."""
+
+    def __init__(self, state: Optional[StoreState] = None):
+        self.state = state if state is not None else StoreState()
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def handle(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or len(line) > _MAX_LINE:
+                    return
+                try:
+                    req = json.loads(line)
+                    resp = await self._dispatch(req)
+                except Exception as e:  # malformed request, not a crash
+                    resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                writer.write(json.dumps(resp).encode() + b"\n")
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, req: dict) -> dict:
+        op, s = req.get("op"), self.state
+        if op == "set":
+            version = s.set(req["key"], req.get("value"), req.get("ttl"))
+            return {"ok": True, "version": version}
+        if op == "get":
+            ok, value = s.get(req["key"])
+            return {"ok": ok, "value": value}
+        if op == "cas":
+            won, cur = s.cas(req["key"], req.get("old"), req.get("new"),
+                             req.get("ttl"))
+            return {"ok": won, "value": cur}
+        if op == "del":
+            return {"ok": s.delete(req["key"])}
+        if op == "hb":
+            s.heartbeat(req["key"], req.get("value"), req.get("ttl", 5.0))
+            return {"ok": True}
+        if op == "members":
+            return {"ok": True, "members": s.members(req.get("prefix", ""))}
+        if op == "dump":
+            return {"ok": True, "members": s.dump()}
+        if op == "wait":
+            deadline = time.monotonic() + float(req.get("timeout", 5.0))
+            while True:
+                ok, value = s.get(req["key"])
+                if ok:
+                    return {"ok": True, "value": value}
+                if time.monotonic() >= deadline:
+                    return {"ok": False, "value": None}
+                await asyncio.sleep(_WAIT_POLL_S)
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self.handle, host, port)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+
+class StoreClient:
+    """Async socket client (router side).  One lazy connection, one
+    in-flight request at a time (a lock serializes — store ops are
+    control-plane cadence, not per-token)."""
+
+    def __init__(self, host: str, port: int, *,
+                 connect_timeout_s: float = 5.0):
+        self.host, self.port = host, int(port)
+        self.connect_timeout_s = connect_timeout_s
+        self._rw: Optional[Tuple[asyncio.StreamReader,
+                                 asyncio.StreamWriter]] = None
+        self._lock = asyncio.Lock()
+
+    async def _call(self, req: dict) -> dict:
+        async with self._lock:
+            for attempt in (0, 1):  # one transparent reconnect
+                if self._rw is None:
+                    self._rw = await asyncio.wait_for(
+                        asyncio.open_connection(self.host, self.port),
+                        self.connect_timeout_s)
+                reader, writer = self._rw
+                try:
+                    writer.write(json.dumps(req).encode() + b"\n")
+                    await writer.drain()
+                    line = await reader.readline()
+                    if not line:
+                        raise ConnectionResetError("store closed")
+                    return json.loads(line)
+                except (ConnectionError, asyncio.IncompleteReadError):
+                    self._rw = None
+                    if attempt:
+                        raise
+        raise ConnectionResetError("store unreachable")
+
+    async def set(self, key, value, ttl=None):
+        return (await self._call({"op": "set", "key": key, "value": value,
+                                  "ttl": ttl}))["version"]
+
+    async def get(self, key):
+        r = await self._call({"op": "get", "key": key})
+        return r["ok"], r.get("value")
+
+    async def cas(self, key, old, new, ttl=None):
+        r = await self._call({"op": "cas", "key": key, "old": old,
+                              "new": new, "ttl": ttl})
+        return r["ok"], r.get("value")
+
+    async def delete(self, key):
+        return (await self._call({"op": "del", "key": key}))["ok"]
+
+    async def heartbeat(self, key, value, ttl):
+        await self._call({"op": "hb", "key": key, "value": value,
+                          "ttl": ttl})
+
+    async def members(self, prefix):
+        return (await self._call({"op": "members",
+                                  "prefix": prefix}))["members"]
+
+    async def wait(self, key, timeout: float = 5.0):
+        r = await self._call({"op": "wait", "key": key, "timeout": timeout})
+        return r["ok"], r.get("value")
+
+    async def close(self):
+        if self._rw is not None:
+            try:
+                self._rw[1].close()
+            except Exception:
+                pass
+            self._rw = None
+
+
+class SyncStoreClient:
+    """Blocking socket client for the supervisor's tick thread (and
+    test harnesses) — same verbs, plain ``socket`` I/O."""
+
+    def __init__(self, host: str, port: int, *, timeout_s: float = 5.0):
+        self.host, self.port = host, int(port)
+        self.timeout_s = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._buf = b""
+        self._lock = threading.Lock()
+
+    def _call(self, req: dict) -> dict:
+        with self._lock:
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        (self.host, self.port), timeout=self.timeout_s)
+                    self._buf = b""
+                try:
+                    self._sock.sendall(json.dumps(req).encode() + b"\n")
+                    while b"\n" not in self._buf:
+                        chunk = self._sock.recv(65536)
+                        if not chunk:
+                            raise ConnectionResetError("store closed")
+                        self._buf += chunk
+                    line, self._buf = self._buf.split(b"\n", 1)
+                    return json.loads(line)
+                except (OSError, ConnectionError):
+                    self._sock = None
+                    if attempt:
+                        raise
+        raise ConnectionResetError("store unreachable")
+
+    def set(self, key, value, ttl=None):
+        return self._call({"op": "set", "key": key, "value": value,
+                           "ttl": ttl})["version"]
+
+    def get(self, key):
+        r = self._call({"op": "get", "key": key})
+        return r["ok"], r.get("value")
+
+    def cas(self, key, old, new, ttl=None):
+        r = self._call({"op": "cas", "key": key, "old": old, "new": new,
+                        "ttl": ttl})
+        return r["ok"], r.get("value")
+
+    def delete(self, key):
+        return self._call({"op": "del", "key": key})["ok"]
+
+    def heartbeat(self, key, value, ttl):
+        self._call({"op": "hb", "key": key, "value": value, "ttl": ttl})
+
+    def members(self, prefix):
+        return self._call({"op": "members", "prefix": prefix})["members"]
+
+    def wait(self, key, timeout: float = 5.0):
+        r = self._call({"op": "wait", "key": key, "timeout": timeout})
+        return r["ok"], r.get("value")
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except Exception:
+                pass
+            self._sock = None

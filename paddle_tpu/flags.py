@@ -463,6 +463,44 @@ define_flag("fleet_rebalance_cooldown_s", 10.0,
             "Min seconds between proactive rebalances (one victim per "
             "pass; the cooldown lets the SLO window react before the "
             "supervisor moves more state).")
+define_flag("router_digest_sketch", True,
+            "Ship the prefix-residency digest as a counting-Bloom "
+            "sketch (ISSUE 19) once the exact chain-hash set grows "
+            "past router_digest_sketch_threshold entries: per-poll "
+            "digest bytes stay flat (m/8 bitmap bytes) instead of "
+            "O(resident pages), and expected_hit_tokens becomes a "
+            "bounded over-estimate with false-positive rate "
+            "(1-e^{-kn/m})^k.  Below the threshold (and with the flag "
+            "off) the exact hash list ships as before.")
+define_flag("router_digest_sketch_threshold", 2048,
+            "Resident-page count above which a sketch replaces the "
+            "exact digest in /statusz (exact mode stays the default "
+            "for small caches, where precision is free).")
+define_flag("router_digest_sketch_bits", 65536,
+            "Bloom filter width m in bits (wire form is m/8 bytes, "
+            "base64-encoded; 64 KiB bits = 8 KiB raw).")
+define_flag("router_digest_sketch_hashes", 4,
+            "Bloom hash count k (indices derived from one blake2b "
+            "via double hashing).")
+define_flag("controlplane_heartbeat_ttl_s", 5.0,
+            "Router-liveness TTL in the membership store (ISSUE 19): "
+            "a router whose last heartbeat is older than this drops "
+            "out of the consistent-hash ring and its span moves to "
+            "survivors.")
+define_flag("controlplane_heartbeat_interval_s", 1.0,
+            "Seconds between router heartbeats / membership refreshes "
+            "against the store (the background cp loop cadence).")
+define_flag("controlplane_vnodes", 64,
+            "Virtual nodes per router on the consistent-hash ring; "
+            "more vnodes = smoother span split on membership change.")
+define_flag("controlplane_journal_ttl_s", 120.0,
+            "TTL on journal records a router replicates into the "
+            "membership store for cross-router failover resume; past "
+            "it an orphaned record is swept (the client has long "
+            "since given up).")
+define_flag("controlplane_store_max_keys", 65536,
+            "Hard cap on membership-store keys (oldest-set evicted "
+            "first); bounds store memory under session churn.")
 define_flag("use_native_dataloader", False,
             "Route DataLoader prefetch through the C++ ring-buffer engine "
             "(native/ringbuf.cc). Off by default: with in-process thread "

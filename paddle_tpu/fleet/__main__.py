@@ -43,6 +43,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--replica-port-base", type=int, default=8001,
                    help="replica slot i listens on base+i; a restarted "
                         "slot reuses its port")
+    p.add_argument("--routers", type=int, default=1,
+                   help="router fleet size (ISSUE 19): 1 keeps the "
+                        "classic single front door; N>1 shards "
+                        "X-Session-Id space over a consistent-hash "
+                        "ring — this process runs router rt0 plus the "
+                        "membership store, and spawns rt1..rt<N-1> as "
+                        "python -m paddle_tpu.router subprocesses")
+    p.add_argument("--router-port-base", type=int, default=8901,
+                   help="spawned router rt<i> listens on base+i; a "
+                        "restarted router slot reuses its port")
+    p.add_argument("--store-port", type=int, default=0,
+                   help="membership store bind port (0 = ephemeral; "
+                        "only bound when --routers > 1)")
     p.add_argument("--preset", choices=_PRESETS, default="tiny",
                    help="model preset forwarded to each replica")
     p.add_argument("--policy", choices=("scored", "round_robin"),
@@ -117,12 +130,69 @@ def main(argv: Optional[List[str]] = None) -> int:
         return ProcessReplicaHandle(rid, args.host, port,
                                     launch_args=launch + extra)
 
+    # sharded control plane (ISSUE 19): with --routers N>1 this process
+    # owns the membership store (its own thread+loop so the port exists
+    # BEFORE router subprocesses spawn) and runs rt0 in-process on a
+    # zero-socket LocalStore face; rt1.. are supervised subprocesses
+    # that join over the socket and discover replicas from the store.
+    controlplane = None
+    router_spawner = None
+    router_target = 0
+    store_state = None
+    if args.routers > 1:
+        from ..controlplane import (LocalStore, RouterControlPlane,
+                                    StoreServer, StoreState)
+        store_state = StoreState()
+        store_ready = threading.Event()
+        store_port: List[int] = []
+
+        def _store_thread():
+            async def _run():
+                srv = StoreServer(store_state)
+                store_port.append(await srv.start(args.host,
+                                                  args.store_port))
+                store_ready.set()
+                while True:
+                    await asyncio.sleep(3600)
+            asyncio.run(_run())
+
+        threading.Thread(target=_store_thread, name="fleet-store",
+                         daemon=True).start()
+        if not store_ready.wait(timeout=10):
+            raise SystemExit("membership store failed to bind")
+        controlplane = RouterControlPlane(
+            "rt0", LocalStore(store_state),
+            advertise={"host": args.host, "port": args.port})
+
+        router_launch: List[str] = ["--model-name",
+                                    args.model_name or args.preset]
+        if args.policy is not None:
+            router_launch += ["--policy", args.policy]
+        for pair in args.flag_sets:
+            router_launch += ["--set", pair]
+
+        def router_spawner(rid: str):
+            from ..controlplane import ProcessRouterHandle
+            port = args.router_port_base + int(rid.removeprefix("rt"))
+            return ProcessRouterHandle(rid, args.host, port,
+                                       store_host=args.host,
+                                       store_port=store_port[0],
+                                       launch_args=router_launch)
+
+        router_target = args.routers - 1
+        print(f"[paddle_tpu fleet] membership store on "
+              f"{args.host}:{store_port[0]}  routers={args.routers} "
+              f"(ports from {args.router_port_base + 1})")
+
     router = RouterServer([], policy=args.policy,
                           model_name=args.model_name or args.preset,
-                          allow_empty=True)
+                          allow_empty=True, controlplane=controlplane)
     sup = FleetSupervisor(router, spawner, target=args.replicas,
                           min_replicas=args.min_replicas,
-                          max_replicas=args.max_replicas)
+                          max_replicas=args.max_replicas,
+                          router_spawner=router_spawner,
+                          router_target=router_target,
+                          store=store_state)
     sup.start()
     stop = threading.Event()
     loop_thread = threading.Thread(target=sup.run_forever,

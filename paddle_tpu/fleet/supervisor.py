@@ -101,7 +101,7 @@ class _FleetMetrics:
 
     __slots__ = ("replicas", "target", "restarts", "crashes", "scale",
                  "drains", "migrations", "migrated_pages", "role_gauge",
-                 "rebalances")
+                 "rebalances", "routers", "router_restarts")
 
     def __init__(self):
         m = _obs.metrics
@@ -120,12 +120,15 @@ class _FleetMetrics:
         self.replicas = lambda s: m.gauge("fleet.replicas", state=s)
         self.target = m.gauge("fleet.target_replicas")
         self.restarts = m.counter("fleet.replica_restarts")
-        # jaxlint: disable=JL006 -- bounded by construction: kind callers pass exit/wedged literals
+        # jaxlint: disable=JL006 -- bounded by construction: kind callers pass exit/wedged/router literals
         self.crashes = lambda kind: m.counter("fleet.crashes", kind=kind)
         # jaxlint: disable=JL006 -- bounded by construction: direction callers pass up/down literals
         self.scale = lambda d: m.counter("fleet.scale_events", direction=d)
         # jaxlint: disable=JL006 -- bounded by construction: outcome callers pass clean/timeout/died literals
         self.drains = lambda o: m.counter("fleet.drains", outcome=o)
+        # sharded control plane (ISSUE 19): supervised router slots
+        self.routers = m.gauge("controlplane.routers")
+        self.router_restarts = m.counter("fleet.router_restarts")
 
 
 # ---------------------------------------------------------------------------
@@ -478,6 +481,12 @@ class FleetSupervisor:
                  on_spawn: Optional[Callable[[ReplicaHandle],
                                              None]] = None,
                  breaker=None,
+                 router_spawner: Optional[Callable[[str],
+                                                   object]] = None,
+                 router_target: int = 0,
+                 on_router_spawn: Optional[Callable[[object],
+                                                    None]] = None,
+                 store=None,
                  clock: Callable[[], float] = time.monotonic):
         f = flags.flag
         self.router = router
@@ -574,6 +583,22 @@ class FleetSupervisor:
         if self.breaker is not None:
             # plain attribute write: GIL-atomic vs the event loop's reads
             self.router.breaker = self.breaker
+        # sharded control plane (ISSUE 19): the supervisor owns the
+        # membership store and N ROUTER slots alongside its replica
+        # slots — same state machine, simpler lifecycle (no drain: a
+        # dying router's in-flight streams fail over to ring survivors
+        # over the store-replicated journal, so a router death is a
+        # restart, never a breaker-visible replica death).  ``store`` is
+        # a SYNC face (StoreState or SyncStoreClient): the tick thread
+        # publishes ``replica/<id>`` endpoints through it so spawned
+        # routers discover the replica set without static --replica
+        # wiring.
+        self._router_spawner = router_spawner
+        self._on_router_spawn = on_router_spawn
+        self.router_target = int(router_target)
+        self._router_slots: List[_Slot] = []
+        self._next_router_slot = 0
+        self.store = store
 
     # --------------------------------------------------------- population --
     def _build_handle(self, rid: str, role: str) -> ReplicaHandle:
@@ -595,9 +620,24 @@ class FleetSupervisor:
         return sum(1 for s in self._slots
                    if s.role == role and s.state != FAILED)
 
+    def _spawn_router_slot(self) -> _Slot:
+        # rt0 is the launcher's in-process router; supervised peers
+        # start at rt1 so the id space never collides
+        self._next_router_slot += 1
+        rid = f"rt{self._next_router_slot}"
+        slot = _Slot(self._router_spawner(rid), role="router")
+        slot.handle.spawn()
+        if self._on_router_spawn is not None:
+            self._on_router_spawn(slot.handle)
+        self._router_slots.append(slot)
+        return slot
+
     def start(self) -> "FleetSupervisor":
         """Spawn the initial ``target`` replica slots (idempotent);
         with roles, one slot per role unit."""
+        if self._router_spawner is not None:
+            while len(self._router_slots) < self.router_target:
+                self._spawn_router_slot()
         if self.roles is not None:
             for role in sorted(self.roles):
                 while self._role_count(role) < self.roles[role]:
@@ -623,7 +663,75 @@ class FleetSupervisor:
     def _deregister(self, slot: _Slot) -> None:
         if slot.registered:
             self.router.remove_replica(slot.handle.id)
+            self._unpublish_replica(slot.handle)
             slot.registered = False
+
+    # ------------------------------ store publication (ISSUE 19) --
+    def _publish_replica(self, handle: ReplicaHandle) -> None:
+        """Advertise a READY replica's endpoint under ``replica/<id>``
+        so store-discovering routers (the spawned rt1..rtN fleet) pick
+        it up.  In-process handles have no endpoint to advertise — the
+        harness registers their clients with each router directly."""
+        if self.store is None:
+            return
+        host = getattr(handle, "host", None)
+        port = getattr(handle, "port", None)
+        if host is None or port is None:
+            return
+        try:
+            self.store.set(f"replica/{handle.id}",
+                           {"host": host, "port": int(port)})
+        except Exception:
+            pass    # the store being down must never wedge the loop
+
+    def _unpublish_replica(self, handle: ReplicaHandle) -> None:
+        if self.store is None:
+            return
+        try:
+            self.store.delete(f"replica/{handle.id}")
+        except Exception:
+            pass
+
+    # ------------------------------ router slots (ISSUE 19) --
+    def _tick_routers(self, now: float, actions: list) -> None:
+        """Supervise the router fleet exactly like replica slots minus
+        the drain protocol and the breaker: a router death is a control-
+        plane event (its ring span moves to survivors and store-journal
+        takeover resumes its streams), not a capacity death the cascade
+        breaker should trip on."""
+        for slot in list(self._router_slots):
+            h = slot.handle
+            if slot.state in (STARTING, READY) and not h.alive():
+                self._m.crashes("router").inc()
+                if slot.ready_since is not None and \
+                        now - slot.ready_since >= self.backoff_reset_s:
+                    slot.restarts = 0
+                if slot.restarts >= self.restart_budget:
+                    slot.state = FAILED
+                    actions.append(("router_failed", h.id))
+                else:
+                    slot.state = BACKOFF
+                    slot.deadline = now + min(
+                        self.backoff_max_s,
+                        self.backoff_base_s * (2.0 ** min(slot.restarts,
+                                                          16)))
+                    actions.append(("router_backoff", h.id))
+                continue
+            if slot.state == BACKOFF and now >= slot.deadline:
+                slot.restarts += 1
+                self._m.router_restarts.inc()
+                slot.handle = self._router_spawner(h.id)
+                slot.handle.spawn()
+                if self._on_router_spawn is not None:
+                    self._on_router_spawn(slot.handle)
+                slot.state = STARTING
+                slot.ready_since = None
+                actions.append(("router_restart", h.id))
+                continue
+            if slot.state == STARTING and h.ready():
+                slot.state = READY
+                slot.ready_since = now
+                actions.append(("router_ready", h.id))
 
     def _crash(self, slot: _Slot, now: float, kind: str,
                actions: list) -> None:
@@ -656,6 +764,7 @@ class FleetSupervisor:
             # time-driven breaker transitions (open -> half-open after a
             # death-free cooldown) ride the control loop's clock
             self.breaker.update(now)
+        self._tick_routers(now, actions)
         for slot in list(self._slots):
             h = slot.handle
             if slot.state == DRAINING:
@@ -720,6 +829,7 @@ class FleetSupervisor:
                 # /readyz warmup gate passed: ONLY now does the router
                 # see it — live traffic never lands on a cold compile
                 self.router.add_replica(h.client())
+                self._publish_replica(h)
                 slot.state = READY
                 slot.ready_since = now
                 slot.registered = True
@@ -1068,7 +1178,9 @@ class FleetSupervisor:
         for slot in self._slots:
             counts[slot.state] += 1
         want = max(0, self.target - counts[FAILED])
-        return counts[READY] == want and \
+        routers_settled = all(s.state in (READY, FAILED)
+                              for s in self._router_slots)
+        return counts[READY] == want and routers_settled and \
             counts[STARTING] == counts[BACKOFF] == counts[DRAINING] == 0
 
     def _export_gauges(self) -> None:
@@ -1083,6 +1195,8 @@ class FleetSupervisor:
         for r, n in role_counts.items():
             self._m.role_gauge(r).set(n)
         self._m.target.set(self.target)
+        self._m.routers.set(sum(1 for s in self._router_slots
+                                if s.state != FAILED))
 
     def state(self) -> dict:
         """Introspection for the launcher / tests / statusz."""
@@ -1104,6 +1218,10 @@ class FleetSupervisor:
             "slots": [{"id": s.handle.id, "state": s.state,
                        "role": s.role, "restarts": s.restarts,
                        **s.handle.describe()} for s in self._slots],
+            "router_slots": [{"id": s.handle.id, "state": s.state,
+                              "restarts": s.restarts,
+                              **s.handle.describe()}
+                             for s in self._router_slots],
             "signals": self.router.fleet_signals(),
             "breaker": self.breaker.state_dict()
             if self.breaker is not None else None,
@@ -1146,4 +1264,9 @@ class FleetSupervisor:
             self._deregister(slot)
             slot.handle.stop(timeout_s=max(0.1, deadline - self._clock()))
         self._slots.clear()
+        # routers go LAST: in-flight drains above may still be relaying
+        # through them
+        for slot in self._router_slots:
+            slot.handle.stop(timeout_s=max(0.1, deadline - self._clock()))
+        self._router_slots.clear()
         self._export_gauges()

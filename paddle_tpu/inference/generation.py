@@ -1537,6 +1537,16 @@ class ContinuousBatchingEngine:
                # don't change index membership, so the delta log can't
                # carry them)
                "spilled": cache.spilled_hashes()}
+        # digest sketch (ISSUE 19): past the size threshold the exact
+        # hash list (O(resident pages) bytes) gives way to the counting-
+        # Bloom membership bitmap (m/8 bytes, flat).  Sketch mode ships
+        # whole every poll — no epochs to confirm, so delta sync is
+        # moot at this size.
+        sk = cache.sketch_wire()
+        if (sk is not None and sk["n"] >
+                int(flags.flag("router_digest_sketch_threshold"))):
+            out.update(mode="sketch", sketch=sk, count=sk["n"])
+            return out
         if since:
             gen, _, ep = str(since).partition(":")
             if gen == cache.digest_gen:

@@ -193,6 +193,15 @@ class PrefixCache:
         self.digest_epoch = 0
         self._digest_log: "deque" = deque(maxlen=max(0, log_cap) or None)
         self._digest_log_on = log_cap > 0
+        # digest SKETCH (ISSUE 19): a counting-Bloom maintained on the
+        # same membership-change hook, so past the size threshold a
+        # /statusz poll ships m/8 flat bitmap bytes instead of one hash
+        # per resident page.  Counters exist for removal; the wire form
+        # is membership-only.
+        self._sketch = None
+        if _flags.flag("router_digest_sketch"):
+            from ..controlplane.sketch import CountingBloom
+            self._sketch = CountingBloom()
         allocator.set_reclaimer(self._reclaim, self.evictable_pages)
 
     # ---------------------------------------------- digest delta (ISSUE 14)
@@ -202,6 +211,18 @@ class PrefixCache:
         if self._digest_log_on:
             self._digest_log.append((self.digest_epoch, op,
                                      node.chain.hex()))
+        if self._sketch is not None:
+            if op == "+":
+                self._sketch.add(node.chain.hex())
+            else:
+                self._sketch.remove(node.chain.hex())
+
+    def sketch_wire(self):
+        """Wire form of the digest sketch (``None`` when sketching is
+        off).  O(m/8) to serialize regardless of resident-page count —
+        the flat-bytes property the sharded control plane ships past
+        ``FLAGS_router_digest_sketch_threshold``."""
+        return self._sketch.wire() if self._sketch is not None else None
 
     def digest_delta(self, since: int):
         """Adds/evictions since confirmed epoch ``since`` → ``(adds,

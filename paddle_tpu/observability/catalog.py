@@ -212,10 +212,14 @@ CATALOG: Dict[str, tuple] = {
         "FLAGS_router_journal_cap (their streams fall back to the "
         "synthesized-error contract)"),
     "router.digest_sync": (
-        "counter", "mode=full|delta", "prefix-digest syncs by mode: "
-        "delta = only adds/evictions since the confirmed epoch rode "
-        "the poll; full = complete set re-ship (first poll, replica "
-        "restart, or change-log miss)"),
+        "counter", "mode=full|delta|sketch", "prefix-digest syncs by "
+        "mode: delta = only adds/evictions since the confirmed epoch "
+        "rode the poll; full = complete set re-ship (first poll, "
+        "replica restart, or change-log miss); sketch = a counting-"
+        "Bloom membership bitmap replaced the exact set (ISSUE 19: the "
+        "cache grew past FLAGS_router_digest_sketch_threshold — "
+        "expected_hit_tokens becomes a bounded estimate, per-poll "
+        "digest bytes stay flat)"),
     # ---- poison quarantine (ISSUE 15) ----
     "router.quarantine": (
         "counter", "action=strike|quarantined|refused",
@@ -258,10 +262,14 @@ CATALOG: Dict[str, tuple] = {
         "counter", "", "crash-restarts performed (after exponential "
         "backoff, within FLAGS_fleet_restart_budget)"),
     "fleet.crashes": (
-        "counter", "kind=exit|wedged",
-        "replica deaths detected: process/engine exit, or a wedge (the "
-        "router reports it dead while the process is still alive — the "
-        "SIGSTOP shape; the supervisor kills and restarts it)"),
+        "counter", "kind=exit|wedged|router",
+        "deaths detected: process/engine exit, a wedge (the router "
+        "reports it dead while the process is still alive — the "
+        "SIGSTOP shape; the supervisor kills and restarts it), or a "
+        "supervised ROUTER slot death (ISSUE 19: restarted through "
+        "the same backoff/budget, but never fed to the cascade "
+        "breaker — a router death is a ring failover, not lost "
+        "serving capacity)"),
     "fleet.scale_events": (
         "counter", "direction=up|down",
         "autoscale actions taken after hysteresis + cooldown"),
@@ -291,6 +299,60 @@ CATALOG: Dict[str, tuple] = {
         "replica's resident sessions pre-staged on an admitting "
         "same-role-or-mixed peer BEFORE the shed, their router pins "
         "re-pointed; in-flight streams finish out on the source"),
+    # ---- sharded control plane (ISSUE 19) ----
+    "router.forwarded": (
+        "counter", "outcome=out|received|fallback",
+        "consistent-hash ownership forwards (router/server.py): out = "
+        "this router relayed a session it doesn't own one hop to its "
+        "ring owner, received = it served a request forwarded to it "
+        "(the X-Router-Forwarded loop guard: never re-forwarded), "
+        "fallback = the owner was unreachable so the request was "
+        "served locally instead of dropped"),
+    "router.ring_moves": (
+        "counter", "", "consistent-hash ring rebuilds observed by this "
+        "router (a membership change: a router joined, or one's "
+        "heartbeat expired and its session span moved to survivors)"),
+    "fleet.router_restarts": (
+        "counter", "", "supervised router-slot crash-restarts (after "
+        "exponential backoff, within FLAGS_fleet_restart_budget)"),
+    "controlplane.routers": (
+        "gauge", "", "non-failed supervised router slots "
+        "(fleet/supervisor.py; the in-process rt0 is not a slot)"),
+    "controlplane.store_ops": (
+        "counter", "op=set|get|cas|del|hb|members",
+        "membership-store operations served, by protocol verb "
+        "(controlplane/store.py)"),
+    "controlplane.store_keys": (
+        "gauge", "", "keys resident in the membership store (TTL-swept "
+        "on writes and membership reads, LRU-capped at "
+        "FLAGS_controlplane_store_max_keys)"),
+    "controlplane.store_evictions": (
+        "counter", "", "store keys LRU-evicted past "
+        "FLAGS_controlplane_store_max_keys"),
+    "controlplane.members": (
+        "gauge", "", "live routers on the consistent-hash ring as seen "
+        "by this router (unexpired router/ heartbeats, self included)"),
+    "controlplane.ring_epoch": (
+        "gauge", "", "epoch of the shared cp/ring record (CAS-bumped "
+        "once per membership change; every router converges to the "
+        "winner's epoch)"),
+    "controlplane.heartbeats": (
+        "counter", "", "liveness stamps written to the store "
+        "(TTL FLAGS_controlplane_heartbeat_ttl_s; expiry IS the death "
+        "signal)"),
+    "controlplane.journal_replicated": (
+        "counter", "", "in-flight journal records mirrored to the "
+        "store under journal/<session_id> (TTL "
+        "FLAGS_controlplane_journal_ttl_s) so a session's NEXT owner "
+        "can resume its stream after this router dies"),
+    "controlplane.takeovers": (
+        "counter", "outcome=resumed|stale|failed",
+        "cross-router journal adoptions after a membership change: "
+        "resumed = the new owner replayed the dead router's journal "
+        "and continued the stream bit-identically, stale = the store "
+        "record didn't match the incoming request (different prompt / "
+        "own record / nothing emitted), failed = adoption began but "
+        "the replay could not complete"),
     "fleet.breaker_state": (
         "gauge", "", "cascade-breaker state (fleet/breaker.py, ISSUE "
         "15): 0=closed, 1=half-open (one parked resume probing), "

@@ -6,6 +6,13 @@ Replicas are ``--replica HOST:PORT`` upstreams (spawn each with
 ``python -m paddle_tpu.serving``); placement policy and health/scoring
 knobs ride the ``FLAGS_router_*`` flag family, settable here via
 ``--set NAME=VALUE`` exactly like the replica launcher.
+
+Sharded control plane (ISSUE 19): ``--store HOST:PORT --router-id R``
+joins this router to an N-router fleet through the shared membership
+store — it heartbeats liveness, owns its consistent-hash span of
+``X-Session-Id`` space, forwards sessions it doesn't own one hop to
+their owner, and discovers the replica set from the supervisor's
+``replica/<id>`` store keys (``--replica`` becomes optional).
 """
 
 from __future__ import annotations
@@ -21,11 +28,19 @@ def build_parser() -> argparse.ArgumentParser:
                     "paddle_tpu serving replicas: one OpenAI-compatible "
                     "front door with aggregate SLO shedding, health "
                     "checking and failover.")
-    p.add_argument("--replica", action="append", required=True,
+    p.add_argument("--replica", action="append", default=[],
                    metavar="HOST:PORT", dest="replicas",
-                   help="one serving replica upstream; repeat per replica")
+                   help="one serving replica upstream; repeat per "
+                        "replica (optional with --store: the replica "
+                        "set is discovered from the membership store)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--store", default=None, metavar="HOST:PORT",
+                   help="membership store endpoint (ISSUE 19): join "
+                        "the sharded N-router control plane")
+    p.add_argument("--router-id", default="router0",
+                   help="this router's identity on the consistent-hash "
+                        "ring (unique per fleet; default router0)")
     p.add_argument("--policy", choices=("scored", "round_robin"),
                    default=None,
                    help="placement policy (default: "
@@ -54,10 +69,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     from ..serving.__main__ import apply_flag_sets
     apply_flag_sets(args.flag_sets)
+    if not args.replicas and not args.store:
+        raise SystemExit("need --replica HOST:PORT (repeatable) or "
+                         "--store HOST:PORT for store discovery")
     replicas = parse_replicas(args.replicas)
+    controlplane = None
+    if args.store:
+        host, sep, port = args.store.rpartition(":")
+        if not sep or not port.isdigit():
+            raise SystemExit(f"--store expects HOST:PORT, got "
+                             f"{args.store!r}")
+        from ..controlplane import RouterControlPlane, StoreClient
+        controlplane = RouterControlPlane(
+            args.router_id,
+            StoreClient(host or "127.0.0.1", int(port)),
+            advertise={"host": args.host, "port": args.port})
     from .server import route_forever
     route_forever(replicas, host=args.host, port=args.port,
-                  model_name=args.model_name, policy=args.policy)
+                  model_name=args.model_name, policy=args.policy,
+                  allow_empty=bool(args.store),
+                  controlplane=controlplane,
+                  discover_replicas=bool(args.store))
     return 0
 
 

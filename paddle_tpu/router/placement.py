@@ -77,6 +77,11 @@ class ReplicaState:
         self.reported_draining = False
         # placement inputs from the last successful /statusz
         self.digest: frozenset = frozenset()
+        # digest sketch (ISSUE 19): past the replica's sketch threshold
+        # the exact set above stays empty and membership tests answer
+        # from this counting-Bloom bitmap view instead — a bounded
+        # OVER-estimate (no false negatives), flat bytes per poll
+        self.digest_sketch = None
         # spill-aware scoring (ISSUE 16 satellite): the digest subset
         # demoted to the replica's host ring — swappable, so a hit there
         # scores between resident and absent
@@ -190,11 +195,35 @@ class ReplicaState:
             samp.get("do_sample") is False
         self.sampling = dict(samp) if isinstance(samp, dict) else None
         dig = doc.get("prefix_digest")
-        if dig:
+        if dig and str(dig.get("mode", "full")) == "sketch" \
+                and dig.get("sketch"):
+            # sketch mode (ISSUE 19): membership answers from the Bloom
+            # bitmap; the exact set stays empty and epochs un-anchor so
+            # the replica keeps shipping whole sketches (no deltas to
+            # ask for — the sketch IS flat).
+            from ..controlplane.sketch import BloomView
+            self.page_size = int(dig.get("page_size", 0) or 0)
+            self.digest_sketch = BloomView(dig["sketch"])
+            self.digest = frozenset()
+            self.digest_gen = None
+            self.digest_epoch = -1
+            self.spilled = frozenset(dig.get("spilled") or ())
+            _obs.metrics.counter("router.digest_sync",
+                                 mode="sketch").inc()
+            # overlay aging under sketch confirmation: same two-poll
+            # rule, with the sketch answering "confirmed"
+            self._poll_gen += 1
+            poll_gen = self._poll_gen
+            sk = self.digest_sketch
+            for h in [h for h, g in self.routed.items()
+                      if h in sk or poll_gen - g >= 2]:
+                del self.routed[h]
+        elif dig:
             self.page_size = int(dig.get("page_size", 0) or 0)
             gen = dig.get("gen")
             is_delta = (str(dig.get("mode", "full")) == "delta"
                         and gen is not None and gen == self.digest_gen)
+            self.digest_sketch = None
             if is_delta:
                 # apply adds/evictions since the confirmed epoch to the
                 # held set — the per-poll full-set re-ship is gone
@@ -231,6 +260,7 @@ class ReplicaState:
                 del self.routed[h]
         else:
             self.digest = frozenset()
+            self.digest_sketch = None
             self.spilled = frozenset()
             self.routed.clear()
             self.digest_gen = None
@@ -278,10 +308,11 @@ class ReplicaState:
         stale spill mark: the page was just re-routed here and the
         admission swap-in re-promotes it."""
         n = sp = 0
+        sk = self.digest_sketch
         for h in hashes:
             if h in self.routed:
                 n += 1
-            elif h in self.digest:
+            elif h in self.digest or (sk is not None and h in sk):
                 n += 1
                 if h in self.spilled:
                     sp += 1
@@ -327,6 +358,13 @@ class ReplicaState:
                 "inflight": self.inflight,
                 "greedy": self.greedy,
                 "digest_entries": len(self.digest),
+                "digest_sketch": (None if self.digest_sketch is None
+                                  else {"n": len(self.digest_sketch),
+                                        "m": self.digest_sketch.m,
+                                        "k": self.digest_sketch.k,
+                                        "fp_bound": round(
+                                            self.digest_sketch.fp_bound(),
+                                            6)}),
                 "digest_epoch": self.digest_epoch,
                 "spilled_entries": len(self.spilled),
                 "routed_overlay": len(self.routed),

@@ -93,7 +93,7 @@ class _RouterMetrics:
     __slots__ = ("requests", "streams", "responses", "inflight",
                  "request_ms", "failover", "shed", "slo_decision",
                  "health_polls", "replicas_gauge", "resumes", "handoff",
-                 "overlay_entries")
+                 "overlay_entries", "forwarded")
 
     def __init__(self):
         m = _obs.metrics
@@ -114,6 +114,9 @@ class _RouterMetrics:
         # jaxlint: disable=JL006 -- bounded by construction: outcome callers pass ok/export_failed/import_failed/no_successor literals
         self.handoff = lambda o: m.counter("router.handoff", outcome=o)
         self.overlay_entries = m.gauge("router.overlay_entries")
+        # jaxlint: disable=JL006 -- bounded by construction: outcome callers pass out/received/fallback literals
+        self.forwarded = lambda o: m.counter("router.forwarded",
+                                             outcome=o)
         self.shed = m.counter("router.shed")
         # jaxlint: disable=JL006 -- bounded by construction: decision callers pass admit/shed/unavailable/breaker literals
         self.slo_decision = lambda d: m.counter("router.slo_decision",
@@ -138,7 +141,10 @@ class RouterServer:
                  health_interval_s: Optional[float] = None,
                  dead_after: Optional[int] = None,
                  poll_timeout_s: Optional[float] = None,
-                 allow_empty: bool = False):
+                 allow_empty: bool = False,
+                 router_id: str = "router0",
+                 controlplane=None,
+                 discover_replicas: bool = False):
         # an empty replica set is only sane when a fleet supervisor owns
         # the set and will register replicas as they warm (ISSUE 12); a
         # hand-launched router with zero upstreams is a misconfiguration
@@ -180,6 +186,16 @@ class RouterServer:
         self.breaker = None
         self._park_timeout_s = float(f("router_breaker_park_timeout_s"))
         self._parked = 0              # resumes currently parked
+        # sharded control plane (ISSUE 19): with a RouterControlPlane
+        # attached this router is one of N — it heartbeats membership,
+        # forwards sessions it doesn't own (one hop) to their ring
+        # owner, and adopts a dead peer's store-replicated journal so
+        # its in-flight streams resume here
+        self.router_id = (controlplane.rid if controlplane is not None
+                          else router_id)
+        self.cp = controlplane
+        self._discover_replicas = bool(discover_replicas)
+        self._cp_task: Optional[asyncio.Task] = None
         self._t0 = time.perf_counter()
         self._next_rid = 0
         self._health_tasks: Dict[str, asyncio.Task] = {}
@@ -409,6 +425,64 @@ class RouterServer:
             await asyncio.gather(*(self.poll_replica(s) for s in todo))
             self._export_replica_gauges()
 
+    # ----------------------------------------- control plane (ISSUE 19) --
+    async def cp_tick(self) -> bool:
+        """One control-plane beat: heartbeat + membership refresh (and,
+        for store-discovered fleets, replica-set sync).  Tests and the
+        in-proc supervisor call this explicitly; production routers run
+        it on the background loop.  Returns True when the ring moved."""
+        if self.cp is None:
+            return False
+        moved = await self.cp.tick()
+        if self._discover_replicas:
+            await self._sync_replicas_from_store()
+        return moved
+
+    async def _cp_loop(self) -> None:
+        interval = float(flags.flag("controlplane_heartbeat_interval_s"))
+        while True:
+            try:
+                await self.cp_tick()
+            except Exception:
+                pass                 # a store blip must not kill the loop
+            await asyncio.sleep(max(0.05, interval))
+
+    async def _sync_replicas_from_store(self) -> None:
+        """Adopt the supervisor-published replica set (``replica/<id>``
+        store keys): process routers launched with ``--store`` need no
+        ``--replica`` flags and follow fleet scaling live."""
+        try:
+            members = await self.cp.replica_members()
+        except Exception:
+            return
+        known = {s.id for s in self.states}
+        for rid, addr in members.items():
+            if rid not in known and isinstance(addr, dict) \
+                    and "host" in addr:
+                from .replica import HttpReplica
+                self.add_replica(HttpReplica(rid, addr["host"],
+                                             int(addr["port"])))
+        for s in list(self.states):
+            if s.id not in members:
+                self.remove_replica(s.id)
+
+    async def _cp_publish(self, entry) -> None:
+        """Mirror a journaled stream's state into the store so the
+        session's NEXT owner can resume it if this router dies.  Best
+        effort: a store outage must not kill the live stream."""
+        if (self.cp is None or entry is None
+                or entry.session_id is None or not entry.resumable):
+            return
+        try:
+            await self.cp.publish_journal(entry.session_id, {
+                "router": self.cp.rid,
+                "prompt": list(entry.prompt),
+                "emitted": list(entry.emitted),
+                "payload": entry.payload,
+                "max_tokens": entry.max_tokens})
+        except Exception:
+            pass
+
     # ----------------------------------------------------------- handler --
     async def handle(self, reader, writer) -> None:
         """One client HTTP connection (asyncio.start_server signature;
@@ -531,6 +605,27 @@ class RouterServer:
         except (ValueError, UnicodeDecodeError):
             pass
         stream = bool(payload.get("stream", False))
+        session_id = self._session_id(headers)
+
+        # session-sharded ownership (ISSUE 19): a session belongs to
+        # exactly one router on the consistent-hash ring — its pins,
+        # journal, and quarantine strikes live THERE.  A request landing
+        # on the wrong router forwards ONE hop to the owner; the
+        # X-Router-Forwarded loop guard makes a stale ring view degrade
+        # to local service, never a forwarding loop.
+        if self.cp is not None and session_id is not None:
+            if "x-router-forwarded" in headers:
+                self._m.forwarded("received").inc()
+            else:
+                owner = self.cp.owner(session_id)
+                if owner != self.cp.rid:
+                    code = await self._forward(owner, headers, body,
+                                               writer)
+                    if code is not None:
+                        return code
+                    # owner unreachable: availability beats purity —
+                    # serve locally off the stale ring view
+                    self._m.forwarded("fallback").inc()
 
         # poison quarantine (ISSUE 15): a signature that has struck out
         # is refused with a clean 503 BEFORE any replica sees it — the
@@ -604,13 +699,23 @@ class RouterServer:
         self._m.slo_decision("admit").inc()
 
         trace_id = self._trace_id(headers)
-        session_id = self._session_id(headers)
         if stream:
             self._m.streams.inc()
         t_accept = time.perf_counter()
-        code = await self._proxy(trace_id, session_id, prompt, payload,
-                                 body, candidates, writer, stream,
-                                 sig=sig)
+        # cross-router failover resume (ISSUE 19): if this session's
+        # previous owner died mid-stream, its store-replicated journal
+        # is waiting here (the ring moved the session to us) — adopt it
+        # and resume the stream instead of starting over
+        code = None
+        if (self.cp is not None and stream and session_id is not None
+                and self._resume_on and prompt):
+            code = await self._maybe_takeover(trace_id, session_id,
+                                              prompt, payload,
+                                              candidates, writer, sig)
+        if code is None:
+            code = await self._proxy(trace_id, session_id, prompt,
+                                     payload, body, candidates, writer,
+                                     stream, sig=sig)
         if _obs.TRACER.enabled:
             _obs.TRACER.event("router.request", t_accept,
                               time.perf_counter() - t_accept,
@@ -619,6 +724,127 @@ class RouterServer:
                                     "stream": stream,
                                     "prompt_tokens": len(prompt)})
         return code
+
+    async def _forward(self, owner: str, headers, body,
+                       writer) -> Optional[int]:
+        """Proxy this request one hop to its owning router (ISSUE 19).
+        Returns the relayed status, or None when the owner could not be
+        reached BEFORE anything was written — the caller serves locally
+        off its (possibly stale) ring view instead."""
+        peer = self.cp.peer(owner)
+        if peer is None:
+            return None
+        fwd = [("X-Router-Forwarded", self.cp.rid),
+               ("Content-Type", "application/json")]
+        for h in ("x-session-id", "x-trace-id"):
+            if h in headers:
+                fwd.append((h, headers[h]))
+        try:
+            up, close = await peer.open("POST", "/v1/completions",
+                                        headers=tuple(fwd), body=body)
+            status, _headers, head_raw = await _read_head(up)
+        except Exception:
+            return None
+        self._m.forwarded("out").inc()
+        try:
+            writer.write(_head_with(head_raw, (
+                ("X-Router-Owner", owner),)))
+            await writer.drain()
+            # pump verbatim until the owner closes: SSE frames, unary
+            # bodies, and error documents all relay unmodified — the
+            # owner's resume/quarantine/breaker machinery already ran
+            while True:
+                chunk = await up.read(65536)
+                if not chunk:
+                    break
+                writer.write(chunk)
+                await writer.drain()
+        finally:
+            close()
+        return status
+
+    async def _maybe_takeover(self, trace_id, session_id, prompt,
+                              payload, candidates, writer,
+                              sig) -> Optional[int]:
+        """Adopt a dead peer's store-replicated journal for this
+        session, if one is waiting and matches the resubmitted request.
+        Returns None (no takeover — serve normally) or the final
+        status."""
+        try:
+            rec = await self.cp.take_journal(session_id)
+        except Exception:
+            return None
+        if not isinstance(rec, dict):
+            return None
+        emitted = rec.get("emitted")
+        if (rec.get("router") == self.cp.rid
+                or rec.get("prompt") != prompt
+                or not emitted
+                or not all(isinstance(t, int) and not isinstance(t, bool)
+                           for t in emitted)):
+            # our own live record, a different conversation, or nothing
+            # relayed yet (a fresh serve replays from scratch anyway)
+            self.cp.takeover("stale")
+            return None
+        return await self._takeover_resume(trace_id, session_id, prompt,
+                                           payload, emitted, candidates,
+                                           writer, sig)
+
+    async def _takeover_resume(self, trace_id, session_id, prompt,
+                               payload, emitted, candidates, writer,
+                               sig) -> Optional[int]:
+        """Resume a dead peer's stream here: re-emit the journaled
+        tokens the client already saw on the old connection's stream
+        position zero, then splice a live replay leg (PR 14 plane,
+        unchanged) — concatenated, the client's token stream is
+        bit-identical to a no-fault run."""
+        entry = self.journal.begin(trace_id, session_id, prompt,
+                                   dict(payload))
+        if entry is None or not entry.resumable:
+            self.journal.finish(entry)
+            self.cp.takeover("stale")
+            return None
+        writer.write(_http.sse_headers((
+            ("X-Router-Replica", "takeover"),)))
+        writer.write(_http.sse_event({
+            "id": trace_id, "object": "text_completion.chunk",
+            "model": self.model_name,
+            "choices": [{"index": 0, "text": "",
+                         "token_ids": list(emitted),
+                         "finish_reason": None}]}))
+        await writer.drain()
+        self.journal.record(entry, emitted)
+        try:
+            if not entry.resumable:
+                # adoption overflowed the journal bound: terminate the
+                # PR 7 way — never a silent truncation
+                writer.write(_http.sse_event(self._finish_chunk(
+                    trace_id, "error")))
+                writer.write(_http.sse_done())
+                await writer.drain()
+                self.cp.takeover("failed")
+                return 200
+            rem = entry.remaining()
+            if rem is not None and rem <= 0:
+                # the dead peer had already delivered the whole budget;
+                # only its finish frame was lost
+                writer.write(_http.sse_event(self._finish_chunk(
+                    trace_id, "length")))
+                writer.write(_http.sse_done())
+                await writer.drain()
+                self.cp.takeover("resumed")
+                return 200
+            code = await self._proxy_dispatch(
+                trace_id, session_id, prompt, b"", candidates, writer,
+                True, entry, sig, resuming=True, head_sent=[True])
+            self.cp.takeover("resumed" if code == 200 else "failed")
+            return code
+        finally:
+            self.journal.finish(entry)
+            try:
+                await self.cp.drop_journal(session_id)
+            except Exception:
+                pass
 
     def _resume_candidates(self, tried: List[str],
                            entry=None) -> List[ReplicaState]:
@@ -761,13 +987,28 @@ class RouterServer:
             # raising out of a relay write) must not strand the entry
             # in the journal until LRU pressure pushes it out
             self.journal.finish(entry)
+            # the store mirror is only for OUR death — a request this
+            # router finished (however it finished) must not leave a
+            # record for the session's next owner to misread
+            if (self.cp is not None and entry is not None
+                    and entry.session_id is not None):
+                try:
+                    await self.cp.drop_journal(entry.session_id)
+                except Exception:
+                    pass
 
     async def _proxy_dispatch(self, trace_id, session_id, prompt, body,
                               candidates: List[ReplicaState], writer,
-                              stream, entry, sig=None) -> int:
+                              stream, entry, sig=None,
+                              resuming: bool = False,
+                              head_sent: Optional[list] = None) -> int:
+        # ``resuming=True`` + a pre-flipped ``head_sent`` is the
+        # cross-router takeover entry (ISSUE 19): the adopted journal
+        # replays from the first dispatch and the client's head is out
         tried: List[str] = []
-        head_sent = [False]           # flipped by _relay at the SSE head
-        resuming = False              # a replay body is in flight
+        if head_sent is None:
+            head_sent = [False]       # flipped by _relay at the SSE head
+        resuming = bool(resuming)     # a replay body is in flight
         unary_replayed = False
         died_post_dispatch = False    # a death a replay COULD recover
         quarantined_out = False       # this signature struck out (15)
@@ -782,7 +1023,7 @@ class RouterServer:
         # (and the prefix it implies) beats phase specialization.
         all_cands = list(candidates)
         handoff_on = (self._handoff_on and stream and entry is not None
-                      and entry.resumable
+                      and entry.resumable and not resuming
                       and entry.max_tokens is not None
                       and entry.max_tokens >= 2)
         if handoff_on:
@@ -1158,6 +1399,7 @@ class RouterServer:
                     if toks:
                         if journaling:
                             self.journal.record(entry, toks)
+                            await self._cp_publish(entry)
                         if flight_tokens is not None:
                             flight_tokens[0] = True
                         if not progressed and sig is not None:
@@ -1166,6 +1408,7 @@ class RouterServer:
                 if toks:
                     if journaling:
                         self.journal.record(entry, toks)
+                        await self._cp_publish(entry)
                     if flight_tokens is not None:
                         flight_tokens[0] = True
                     if not progressed and sig is not None:
@@ -1243,6 +1486,13 @@ class RouterServer:
                     for o in ("ok", "export_failed", "import_failed",
                               "no_successor")},
             },
+            # sharded control plane (ISSUE 19): ring membership +
+            # forwarding counters (None on a classic single router)
+            "controlplane": self._controlplane_state(),
+            # O(sessions) memory audit (ISSUE 19 satellite): live size
+            # + cap of every per-session/per-signature table, so "is
+            # the control plane bounded?" is one statusz read
+            "tables": self._tables_state(),
             # poison quarantine + cascade breaker (ISSUE 15)
             "quarantine": self.quarantine.state(),
             "breaker": (self.breaker.state_dict()
@@ -1255,6 +1505,40 @@ class RouterServer:
                     "router.failover", phase="stream").value)},
             "shed_total": int(self._m.shed.value),
             "pid": os.getpid(),
+        }
+
+    def _controlplane_state(self) -> Optional[dict]:
+        if self.cp is None:
+            return None
+        m = _obs.metrics
+        return {**self.cp.describe(),
+                "forwarded": {o: int(m.counter(
+                    "router.forwarded", outcome=o).value)
+                    for o in ("out", "received", "fallback")},
+                "ring_moves": int(m.counter("router.ring_moves").value),
+                "takeovers": {o: int(m.counter(
+                    "controlplane.takeovers", outcome=o).value)
+                    for o in ("resumed", "stale", "failed")}}
+
+    def _tables_state(self) -> dict:
+        sess = self.placer.session_state()
+        return {
+            "session_pins": {"size": sess["pins"], "cap": sess["cap"]},
+            "journal": {"size": len(self.journal),
+                        "cap": self.journal.cap},
+            "routed_overlay": {
+                "size": sum(len(s.routed) for s in self.states),
+                # the overlay cap is per-replica (placement.py applies
+                # it to each state's LRU), so the fleet bound scales
+                # with the replica count
+                "cap": int(flags.flag("router_overlay_cap"))
+                * max(1, len(self.states))},
+            "quarantine": {"size": len(self.quarantine),
+                           "cap": self.quarantine.cap},
+            # parked resumes are TIME-bounded, not count-capped: every
+            # parked entry leaves within router_breaker_park_timeout_s
+            "breaker_park": {"size": self._parked, "cap": None,
+                             "bound_s": self._park_timeout_s},
         }
 
     def _fleet_anomalies(self) -> dict:
@@ -1271,8 +1555,12 @@ class RouterServer:
 
     # --------------------------------------------------------- lifecycle --
     async def start_http(self, host: str = "127.0.0.1", port: int = 0):
-        """Bind a listener and start background health polling."""
+        """Bind a listener and start background health polling (and the
+        control-plane heartbeat loop when a plane is attached)."""
         self.start_health()
+        if self.cp is not None:
+            await self.cp_tick()        # join membership before serving
+            self._cp_task = asyncio.ensure_future(self._cp_loop())
         await self.poll_replicas()      # first view before first request
         self._asyncio_server = await asyncio.start_server(
             self.handle, host, port)
@@ -1280,6 +1568,9 @@ class RouterServer:
 
     async def stop_http(self) -> None:
         self.stop_health()
+        if self._cp_task is not None:
+            self._cp_task.cancel()
+            self._cp_task = None
         if self._asyncio_server is not None:
             self._asyncio_server.close()
             await self._asyncio_server.wait_closed()

@@ -490,6 +490,27 @@ def test_jl005_covers_migration_module():
     assert ctx.findings == []
 
 
+def test_jl005_covers_controlplane_package():
+    """ISSUE 19 satellite: the control plane rides the router's event
+    loop — a blocking store call in an async def there stalls every
+    in-flight completion stream."""
+    ctx = lint(_ASYNC_POS, rel="paddle_tpu/controlplane/store.py",
+               select={"JL005"})
+    assert len(ctx.findings) == 3
+    # the SYNC faces (SyncStoreClient on the supervisor thread,
+    # ProcessRouterHandle probes) stay exempt: blocking there is the
+    # design
+    src = """
+        import time
+
+        def _call(self, req):
+            time.sleep(0.01)
+    """
+    ctx = lint(src, rel="paddle_tpu/controlplane/store.py",
+               select={"JL005"})
+    assert ctx.findings == []
+
+
 # ------------------------------------------------------------------ JL006 --
 
 def test_jl006_fires_on_request_data_labels():
@@ -593,6 +614,19 @@ def test_jl007_covers_migration_module():
             self.engine._drain()
     """
     ctx = lint(src, rel="paddle_tpu/inference/migration.py",
+               select={"JL007"})
+    assert len(ctx.findings) == 1
+
+
+def test_jl007_covers_controlplane_package():
+    """ISSUE 19 satellite: engine single-ownership applies on the
+    control plane too — membership/ring code must never reach into an
+    engine from its async defs."""
+    src = """
+        async def takeover(self):
+            self.engine.step()
+    """
+    ctx = lint(src, rel="paddle_tpu/controlplane/plane.py",
                select={"JL007"})
     assert len(ctx.findings) == 1
 
