@@ -1955,6 +1955,51 @@ def _run_fleet_chaos(on_tpu):
     }
 
 
+def _trace_fleet(obs):
+    """``benchmarks/run.py --trace`` support (ISSUE 20): when the run's
+    tracer is on, stand up an in-process TraceCollector behind a
+    SpanExporter so the multi-component arms (router + role-tagged
+    replica servers sharing this one process) assemble ONE merged,
+    clock-aligned timeline per request.  Returns (collector, exporter),
+    both None when tracing is off."""
+    if not obs.TRACER.enabled:
+        return None, None
+    from paddle_tpu.observability.collector import (InprocTransport,
+                                                    SpanExporter,
+                                                    TraceCollector)
+    col = TraceCollector()
+    exp = SpanExporter(InprocTransport(col), proc="bench",
+                       interval_s=0.1)
+    exp.start()
+    return col, exp
+
+
+def _trace_stamp(col, tid, wall_ms, path):
+    """Write ``tid``'s merged timeline to ``path`` and return the result
+    stamps: the trace path, its per-process track map, the critical-path
+    breakdown, and the coverage check against the client-measured wall
+    time (phases must sum within 10% of what the client saw — the
+    sweep's gap-attribution makes that structural, so a miss means the
+    clock alignment or span classification broke)."""
+    doc = col.assemble(tid)
+    if doc is None:
+        return {}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    meta = doc["metadata"]
+    cp = meta.get("critical_path") or {}
+    out = {"merged_trace_path": os.path.abspath(path),
+           "merged_trace_tracks": meta["processes"],
+           "critical_path_ms": {**cp.get("phases_ms", {}),
+                                "total": cp.get("total_ms")}}
+    if wall_ms and cp.get("total_ms"):
+        total = float(cp["total_ms"])
+        out["critical_path_client_ms"] = round(wall_ms, 1)
+        out["critical_path_within_10pct"] = bool(
+            abs(total - wall_ms) <= 0.1 * wall_ms)
+    return out
+
+
 def _run_disagg(on_tpu):
     """ISSUE 16: disaggregated prefill/decode serving A/B
     (`benchmarks/run.py disagg`) — 2 prefill + 2 decode replicas vs 4
@@ -2024,8 +2069,9 @@ def _run_disagg(on_tpu):
                      int(rng.integers(*budget_range))))
     order = rng.permutation(len(reqs))
     n_req = len(reqs)
+    col, exp = _trace_fleet(obs)
 
-    def arm(roles):
+    def arm(roles, tag):
         servers = []
         for role in roles:
             eng = ContinuousBatchingEngine(
@@ -2061,7 +2107,13 @@ def _run_disagg(on_tpu):
             prompt, budget = reqs[i]
             body = _json.dumps({"prompt": prompt, "max_tokens": budget,
                                 "stream": True}).encode()
+            # a traced run mints the client's own X-Trace-Id (arm-unique,
+            # request-indexed) so the merged timeline maps back to this
+            # request's client-side measurements
+            trace_hdr = (f"X-Trace-Id: cmpl-bench-{tag}-r{i:04d}\r\n"
+                         if col is not None else "")
             head = ("POST /v1/completions HTTP/1.1\r\nHost: bench\r\n"
+                    f"{trace_hdr}"
                     f"Content-Length: {len(body)}\r\n\r\n").encode()
             r = asyncio.StreamReader()
             r.feed_data(head + body)
@@ -2115,7 +2167,8 @@ def _run_disagg(on_tpu):
                         gaps.append(t - last)
                     last = t
                     toks.extend(ids)
-            return i, toks, ttft, gaps
+            wall = (last - t0) if last is not None else None
+            return i, toks, ttft, gaps, wall
 
         async def drive():
             await router.poll_replicas()
@@ -2135,15 +2188,17 @@ def _run_disagg(on_tpu):
         finally:
             for s in servers:
                 s.close()
-        outs = {i: toks for i, toks, _, _ in results}
-        ttfts = [ttft for _, _, ttft, _ in results if ttft is not None]
-        gaps = [g for _, _, _, gs in results for g in gs]
+        outs = {i: toks for i, toks, _, _, _ in results}
+        ttfts = [ttft for _, _, ttft, _, _ in results if ttft is not None]
+        gaps = [g for _, _, _, gs, _ in results for g in gs]
+        walls = {i: w for i, _, _, _, w in results}
         toks = sum(len(v) for v in outs.values())
 
         def pct(xs, q):
             return float(np.percentile(xs, q) * 1000) if xs else 0.0
 
         return {"tps": toks / dt, "tokens": int(toks),
+                "tag": tag, "walls": walls,
                 "outputs": [outs[i] for i in range(n_req)],
                 "ttft": {"p50": round(pct(ttfts, 50), 1),
                          "p95": round(pct(ttfts, 95), 1)},
@@ -2159,14 +2214,34 @@ def _run_disagg(on_tpu):
     # deterministic across samples
     samples = 2
     mixed = disagg = None
-    for _ in range(samples):
-        a = arm(["mixed"] * 4)
+    for s_i in range(samples):
+        a = arm(["mixed"] * 4, f"m{s_i}")
         mixed = a if mixed is None or \
             a["ttft"]["p95"] < mixed["ttft"]["p95"] else mixed
-        b = arm(["prefill", "prefill", "decode", "decode"])
+        b = arm(["prefill", "prefill", "decode", "decode"], f"d{s_i}")
         disagg = b if disagg is None or \
             b["ttft"]["p95"] < disagg["ttft"]["p95"] else disagg
+    trace_stamps = {}
+    if col is not None:
+        exp.close()                  # final flush before assembly
+        # the merged-timeline exhibit: a handed-off stream from the
+        # winning disagg arm — router dispatch, prefill admit, KV
+        # export/import, decode leg, one clock-aligned file
+        pre = f"cmpl-bench-{disagg['tag']}"
+        handed = [t for t in col.find_traces("migrate.import")
+                  if t.startswith(pre)] or \
+                 [t for t in col.find_traces("handoff")
+                  if t.startswith(pre)] or \
+                 [t for t in col.traces() if t.startswith(pre)]
+        if handed:
+            tid = handed[0]
+            i = int(tid.rsplit("-r", 1)[1])
+            wall = disagg["walls"].get(i)
+            st = _trace_stamp(col, tid, (wall or 0) * 1e3,
+                              "disagg_merged_trace.json")
+            trace_stamps = {f"disagg_{k}": v for k, v in st.items()}
     return {
+        **trace_stamps,
         "disagg_requests": n_req,
         "disagg_replicas": 4,
         "disagg_clients": clients,
@@ -2275,6 +2350,9 @@ def _run_router_shard(on_tpu):
                      int(rng.integers(*budget_range))))
     order = [int(i) for i in rng.permutation(len(reqs))]
     n_req = len(reqs)
+    col, exp = _trace_fleet(obs)
+    arm_tag = ["a"]          # rebound per arm: trace ids stay arm-unique
+    walls = {}               # (arm, i) -> client-measured request wall s
 
     def _servers():
         out = []
@@ -2296,8 +2374,10 @@ def _run_router_shard(on_tpu):
         sid, prompt, budget = reqs[i]
         body = _json.dumps({"prompt": prompt,
                             "max_tokens": budget}).encode()
+        trace_hdr = (f"X-Trace-Id: cmpl-bench-{arm_tag[0]}-r{i:04d}\r\n"
+                     if col is not None else "")
         head = ("POST /v1/completions HTTP/1.1\r\nHost: bench\r\n"
-                f"X-Session-Id: {sid}\r\n"
+                f"X-Session-Id: {sid}\r\n{trace_hdr}"
                 f"Content-Length: {len(body)}\r\n\r\n").encode()
         r = asyncio.StreamReader()
         r.feed_data(head + body)
@@ -2317,7 +2397,9 @@ def _run_router_shard(on_tpu):
             async def wait_closed(self):
                 pass
 
+        t0 = time.perf_counter()
         await router.handle(r, W())
+        walls[(arm_tag[0], i)] = time.perf_counter() - t0
         raw = bytes(buf)
         head_raw, _, body_raw = raw.partition(b"\r\n\r\n")
         status = int(head_raw.split()[1])
@@ -2334,6 +2416,7 @@ def _run_router_shard(on_tpu):
         return await asyncio.gather(*(worker(i) for i in idxs))
 
     def single_arm():
+        arm_tag[0] = "s"
         servers = _servers()
         replicas = [InprocReplica(f"r{i}", s)
                     for i, s in enumerate(servers)]
@@ -2366,6 +2449,7 @@ def _run_router_shard(on_tpu):
                 "compiles": rec.compiles, "exact_bytes": exact_bytes}
 
     def sharded_arm(sketch):
+        arm_tag[0] = "k" if sketch else "e"
         old = _flags.get_flags("router_digest_sketch_threshold")
         _flags.set_flags({"router_digest_sketch_threshold":
                           0 if sketch else (1 << 30)})
@@ -2462,7 +2546,22 @@ def _run_router_shard(on_tpu):
     exact = sharded_arm(sketch=False)
     sk = sharded_arm(sketch=True)
     hops = exact["fwd"]["out"] / max(n_req, 1)
+    trace_stamps = {}
+    if col is not None:
+        exp.close()
+        # the merged-timeline exhibit: the exact-sharded arm's most
+        # fleet-crossing request (a forwarded session shows two router
+        # tracks; any request shows router + replica engine lanes)
+        cand = [t for t in col.traces() if t.startswith("cmpl-bench-e-")]
+        if cand:
+            tid = max(cand, key=lambda t: len(col.track_names(t)))
+            i = int(tid.rsplit("-r", 1)[1])
+            wall = walls.get(("e", i))
+            st = _trace_stamp(col, tid, (wall or 0) * 1e3,
+                              "router_shard_merged_trace.json")
+            trace_stamps = {f"router_shard_{k}": v for k, v in st.items()}
     return {
+        **trace_stamps,
         "router_shard_requests": n_req,
         "router_shard_routers": 3,
         "router_shard_replicas": 2,

@@ -67,8 +67,13 @@ _SKIP = {"config", "platform", "device_kind", "metric", "unit", "wall_s",
 # noisy-by-construction / workload-shaped fragments that are never
 # gated: queue wait, client chunk gaps and batch occupancy measure the
 # traffic mix, not the engine (and occupancy is higher-is-better — the
-# {p50,p95} record shape must not drag it into latency semantics)
-_NOISY = ("queue_wait", "chunk_gap", "queue_depth", "occupancy")
+# {p50,p95} record shape must not drag it into latency semantics).
+# Merged-trace provenance (ISSUE 20) rides results the same way: the
+# trace file path and its critical-path breakdown are diagnostics a
+# --trace run stamps for humans, not gated metrics — phase split shifts
+# with the traffic mix even when the engine is bit-identical.
+_NOISY = ("queue_wait", "chunk_gap", "queue_depth", "occupancy",
+          "trace_path", "critical_path")
 
 
 def classify(key: str, value) -> Optional[str]:

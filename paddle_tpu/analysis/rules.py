@@ -24,9 +24,14 @@ from .core import ModuleInfo, Rule, RunContext, register
 # executor seam, and an async def creeping in there would block the
 # front door exactly like one in serving/ proper.  The control plane
 # (ISSUE 19) rides the ROUTER's event loop: a blocking store call in
-# an async def there stalls every in-flight completion stream.
+# an async def there stalls every in-flight completion stream.  The
+# trace collector (ISSUE 20) is included the same way migration is:
+# mostly sync today, but its ingest/clock faces are called from the
+# router's /collectz handler — an async def creeping in there would
+# block span assembly on the serving loop.
 _ASYNC_PLANE = ("/serving/", "/router/", "/fleet/",
-                "/inference/migration", "/controlplane/")
+                "/inference/migration", "/controlplane/",
+                "/observability/collector")
 
 
 def _in_async_plane(rel: str) -> bool:

@@ -93,6 +93,12 @@ class StoreState:
 
     def get(self, key: str) -> Tuple[bool, Any]:
         self._m.ops("get").inc()
+        if key == "__now__":
+            # virtual clock key (ISSUE 20): a store round trip doubles as
+            # the span collector's NTP-style handshake — the store server
+            # shares the collector's process, so its perf_counter IS the
+            # collector clock the exporters align to
+            return True, {"t": time.perf_counter()}
         with self._lock:
             v = self._get_live_locked(key)
             return (False, None) if v is _TOMBSTONE else (True, v)

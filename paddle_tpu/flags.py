@@ -167,6 +167,39 @@ define_flag("trace_max_events", 200000,
             "Cap on buffered Chrome-trace events in the observability "
             "tracer (observability/tracing.py); overflow is counted in the "
             "exported file's metadata instead of growing without bound.")
+define_flag("trace_sample_rate", 1.0,
+            "Fraction of request traces the span exporter ships to the "
+            "fleet collector (observability/collector.py), decided per "
+            "trace id by stable hash so every process keeps or drops the "
+            "SAME traces.  Anomalous / shed / failover / handoff traces "
+            "are tail-kept regardless of the rate; 0 disables export "
+            "entirely (the exporter never attaches).")
+define_flag("trace_export_events", 8192,
+            "Bound on pending span-export events buffered per process "
+            "(observability/collector.py SpanExporter ring).  The tracer's "
+            "offer into the ring is one deque append — overflow evicts "
+            "oldest and bumps observability.collector.export_dropped, "
+            "never blocks the engine or event loop.")
+define_flag("trace_export_batch", 512,
+            "Max span events per export batch shipped to the collector; a "
+            "flush splits larger backlogs into multiple batches.")
+define_flag("trace_export_interval_s", 0.5,
+            "Seconds between span-export flushes from each process's "
+            "exporter thread to the fleet collector (host-side daemon "
+            "thread, off the dispatch path).")
+define_flag("trace_collector", "",
+            "host:port of the fleet trace collector's HTTP ingest "
+            "(POST /collectz on the router / fleet launcher).  Non-empty "
+            "makes `python -m paddle_tpu.serving` start a span exporter "
+            "over direct HTTP; empty, a fleet-spawned replica exports "
+            "over the control-plane store when one is configured, else "
+            "tracing stays process-local.")
+define_flag("trace_clock_drift_ms", 5.0,
+            "Clock-offset drift threshold for the collector's NTP-style "
+            "handshake (observability/collector.py ClockSync): a fresh "
+            "midpoint measurement differing from the held offset by more "
+            "than this (and not explained by round-trip jitter) replaces "
+            "it and bumps observability.collector.clock_resyncs.")
 define_flag("metrics_max_series", 512,
             "Cap on LABELED series per metric family in the registry "
             "(observability/metrics.py).  A family at the cap folds every "

@@ -106,9 +106,25 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     signal.signal(signal.SIGTERM, _sigterm)
 
+    # distributed tracing (ISSUE 20): the launcher owns THE fleet trace
+    # collector.  Replica (and spawned-router) processes export span
+    # batches back here — over the membership store when one exists,
+    # else direct HTTP POST to the router's /collectz — and /tracez on
+    # the in-process router serves the merged, clock-aligned timelines.
+    from .. import flags as _flags
+    from ..observability.collector import (InprocTransport, SpanExporter,
+                                           TraceCollector)
+    collector = TraceCollector()
+    trace_on = float(_flags.flag("trace_sample_rate")) > 0
+
     launch: List[str] = ["--preset", args.preset]
     if args.prefix_cache:
         launch.append("--prefix-cache")
+    if trace_on and not str(_flags.flag("trace_collector")):
+        # replicas POST spans to the router's /collectz unless the
+        # operator pointed them somewhere else explicitly
+        launch += ["--set",
+                   f"trace_collector={args.host}:{args.port}"]
     # engine knobs ride the replica's own argparse surface (ISSUE 18
     # satellite): one threading path, so a knob the serving launcher
     # grows is forwarded here by name instead of silently dropping
@@ -187,12 +203,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     router = RouterServer([], policy=args.policy,
                           model_name=args.model_name or args.preset,
                           allow_empty=True, controlplane=controlplane)
+    router.collector = collector
     sup = FleetSupervisor(router, spawner, target=args.replicas,
                           min_replicas=args.min_replicas,
                           max_replicas=args.max_replicas,
                           router_spawner=router_spawner,
                           router_target=router_target,
-                          store=store_state)
+                          store=store_state, collector=collector)
+    # this process's own spans (router rt0 + supervisor) join the
+    # merged timelines through a zero-copy in-proc transport
+    exporter = None
+    if trace_on:
+        exporter = SpanExporter(InprocTransport(collector),
+                                proc=f"fleet@{args.host}:{args.port}",
+                                role="router")
+        exporter.start()
     sup.start()
     stop = threading.Event()
     loop_thread = threading.Thread(target=sup.run_forever,
@@ -218,6 +243,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     finally:
         stop.set()
         loop_thread.join(timeout=5)
+        if exporter is not None:
+            exporter.close()
         sup.shutdown(drain=True)
     return 0
 

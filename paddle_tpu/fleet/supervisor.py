@@ -487,6 +487,7 @@ class FleetSupervisor:
                  on_router_spawn: Optional[Callable[[object],
                                                     None]] = None,
                  store=None,
+                 collector=None,
                  clock: Callable[[], float] = time.monotonic):
         f = flags.flag
         self.router = router
@@ -599,6 +600,12 @@ class FleetSupervisor:
         self._router_slots: List[_Slot] = []
         self._next_router_slot = 0
         self.store = store
+        # distributed tracing (ISSUE 20): the supervisor owns the trace
+        # collector — replicas publish span batches under
+        # ``trace/batch/*`` on the store (or POST them to a router's
+        # ``/collectz``), and the tick thread drains the store leg here
+        # so assembly never needs its own poller thread.
+        self.collector = collector
 
     # --------------------------------------------------------- population --
     def _build_handle(self, rid: str, role: str) -> ReplicaHandle:
@@ -664,7 +671,21 @@ class FleetSupervisor:
         if slot.registered:
             self.router.remove_replica(slot.handle.id)
             self._unpublish_replica(slot.handle)
+            if self.collector is not None:
+                self.collector.unregister_ring(slot.handle.id)
             slot.registered = False
+
+    def _register_ring(self, handle: ReplicaHandle) -> None:
+        """Hand the trace collector an in-proc replica's flight-recorder
+        ring so a fleet-correlated anomaly dump can merge its window
+        (ISSUE 20).  Process replicas have no in-proc ring — their
+        tail-kept spans arrive through the export path instead."""
+        if self.collector is None:
+            return
+        fr = getattr(getattr(handle, "server", None),
+                     "flight_recorder", None)
+        if fr is not None:
+            self.collector.register_ring(handle.id, fr.events)
 
     # ------------------------------ store publication (ISSUE 19) --
     def _publish_replica(self, handle: ReplicaHandle) -> None:
@@ -830,6 +851,7 @@ class FleetSupervisor:
                 # see it — live traffic never lands on a cold compile
                 self.router.add_replica(h.client())
                 self._publish_replica(h)
+                self._register_ring(h)
                 slot.state = READY
                 slot.ready_since = now
                 slot.registered = True
@@ -837,6 +859,11 @@ class FleetSupervisor:
         self._maybe_rebalance(now, actions)
         self._autoscale(now, actions)
         self._converge(now, actions)
+        if self.collector is not None and self.store is not None:
+            # drain replica span batches published over the control
+            # plane (ISSUE 20); a broken store face must not wedge the
+            # loop — poll_store already swallows transport errors
+            self.collector.poll_store(self.store)
         self._export_gauges()
         return actions
 

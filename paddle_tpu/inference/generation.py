@@ -1679,6 +1679,14 @@ class ContinuousBatchingEngine:
                     rid = req.req_id
                     ctx = {"trace_id": req.trace_id, "slot": b} \
                         if req.trace_id else {"slot": b}
+                    # component tag for the fleet collector (ISSUE 20):
+                    # the serving server stamps its identity on the
+                    # engine so multi-engine processes (the in-proc
+                    # disagg bench, tests) still assemble one track per
+                    # logical replica
+                    proc = getattr(self, "trace_proc", None)
+                    if proc:
+                        ctx["proc"] = proc
                     tr.event(f"req{rid}.queued", req.t_enqueue,
                              t_adm - req.t_enqueue, cat="serving",
                              tid=lane, args=ctx)

@@ -34,6 +34,8 @@ from typing import Optional
 from .. import flags
 from . import catalog, metrics, tracing
 from .attribution import StepAttribution
+from .collector import (ClockSync, HttpTransport, InprocTransport,
+                        SpanExporter, StoreTransport, TraceCollector)
 from .flight_recorder import FlightRecorder
 from .metrics import (REGISTRY, counter, find, gauge, histogram,
                       prometheus_text, reset, set_help, snapshot)
@@ -46,6 +48,8 @@ __all__ = ["metrics", "tracing", "catalog", "REGISTRY", "counter", "gauge",
            "histogram", "snapshot", "prometheus_text", "reset", "find",
            "set_help", "tracer", "Tracer", "TRACER", "FlightRecorder",
            "StepAttribution", "Sentinel",
+           "ClockSync", "SpanExporter", "TraceCollector",
+           "InprocTransport", "StoreTransport", "HttpTransport",
            "metrics_enabled", "count_sync", "assert_overhead", "StepTimer",
            "export_chrome_trace"]
 

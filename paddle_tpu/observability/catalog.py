@@ -365,6 +365,48 @@ CATALOG: Dict[str, tuple] = {
         "sentinel anomalies by watched series and detector kind "
         "(observability/sentinel.py; each also lands as a tracer "
         "instant event and a rate-limited flight-recorder dump)"),
+    # ---- distributed tracing (ISSUE 20) ----
+    "serving.trace.critical_path_ms": (
+        "histogram", "phase=queue|prefill|transfer|decode|replay",
+        "per-request critical-path breakdown computed at timeline "
+        "assembly (observability/collector.py): an interval sweep over "
+        "the clock-aligned spans where gaps ride the ongoing phase, so "
+        "the phases sum exactly to the trace extent — what the client "
+        "measured as TTFT + stream time"),
+    "observability.collector.export_batches": (
+        "counter", "", "span batches shipped by this process's "
+        "SpanExporter (store set / HTTP POST / in-proc ingest)"),
+    "observability.collector.export_spans": (
+        "counter", "", "span events shipped in export batches"),
+    "observability.collector.export_dropped": (
+        "counter", "", "span events evicted from the bounded export "
+        "ring before a flush could ship them "
+        "(FLAGS_trace_export_events)"),
+    "observability.collector.sampled_out": (
+        "counter", "", "span events skipped by head sampling "
+        "(FLAGS_trace_sample_rate; tail-kept anomaly/handoff/failover "
+        "lanes ship regardless)"),
+    "observability.collector.export_errors": (
+        "counter", "", "export batch sends that raised (transport "
+        "down; the batch is dropped, serving is never blocked)"),
+    "observability.collector.clock_resyncs": (
+        "counter", "", "clock-offset re-estimations adopted because "
+        "the midpoint drifted past FLAGS_trace_clock_drift_ms beyond "
+        "the handshake's rtt/2 uncertainty"),
+    "observability.collector.batches": (
+        "counter", "", "export batches ingested by the collector"),
+    "observability.collector.spans": (
+        "counter", "", "span events ingested by the collector"),
+    "observability.collector.traces": (
+        "gauge", "", "distinct trace ids currently held in the "
+        "collector's bounded span store (LRU past max_traces)"),
+    "observability.collector.processes": (
+        "gauge", "", "exporting processes the collector has seen "
+        "(each with its own clock-offset estimate)"),
+    "observability.collector.fleet_dumps": (
+        "counter", "", "fleet-correlated anomaly dumps written (every "
+        "registered flight-recorder ring plus the collector's aligned "
+        "spans for the anomalous window, merged into ONE file)"),
     # ---- train loop (PR 5 StepTimer, default name) ----
     "train.steps": ("counter", "", "train steps dispatched"),
     "train.step_ms": (

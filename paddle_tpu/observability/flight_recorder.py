@@ -120,6 +120,16 @@ class FlightRecorder:
         self.snapshot_now(now)
         return True
 
+    def events(self) -> list:
+        """Snapshot the ring's buffered events (the fleet-correlated
+        dump provider seam, ISSUE 20).  Retries the race where a writer
+        mutates the deque mid-copy."""
+        while True:
+            try:
+                return list(self._ring)
+            except RuntimeError:
+                continue
+
     def snapshot_now(self, now: Optional[float] = None) -> None:
         now = time.perf_counter() if now is None else now
         self._ring.append({"ph": "i", "s": "g", "pid": 0, "tid": 0,
@@ -131,7 +141,11 @@ class FlightRecorder:
     def _dump_path(self, reason: str) -> str:
         stem, ext = os.path.splitext(self.path)
         tag = re.sub(r"[^A-Za-z0-9_.-]", "_", reason) if reason else "manual"
-        return f"{stem}_{tag}{ext or '.json'}"
+        # the process tag (ISSUE 20 satellite): two processes dumping the
+        # same reason in the same second must never overwrite each other's
+        # file — a fleet-correlated anomaly dump fans out to EVERY live
+        # process at once
+        return f"{stem}_{tag}_p{os.getpid()}{ext or '.json'}"
 
     def dump(self, path: Optional[str] = None, reason: str = "manual") -> str:
         """Write ring + final registry snapshot as Chrome-trace JSON;

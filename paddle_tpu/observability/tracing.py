@@ -61,6 +61,7 @@ class Tracer:
         self._active = False
         self._max = max_events
         self._ring = None           # flight-recorder sink (bounded deque)
+        self._export = None         # span-export sink (collector shipping)
         self.dropped = 0
         self._tids: Dict[str, int] = {}
         self._lock = threading.Lock()
@@ -84,7 +85,7 @@ class Tracer:
 
     def stop(self) -> "Tracer":
         self._enabled = False
-        self._active = self._ring is not None
+        self._active = self._ring is not None or self._export is not None
         return self
 
     def attach_ring(self, ring) -> None:
@@ -97,7 +98,21 @@ class Tracer:
 
     def detach_ring(self) -> None:
         self._ring = None
-        self._active = self._enabled
+        self._active = self._enabled or self._export is not None
+
+    def attach_export(self, sink) -> None:
+        """Attach a span-export sink (``SpanExporter.offer``-shaped: any
+        object with a non-blocking ``offer(ev)``) that receives every
+        event from now on — the fleet-tracing shipping lane (ISSUE 20).
+        Like the flight-recorder ring, attachment alone activates span
+        recording; the sink must be a bounded buffer, never a network
+        call (``offer`` runs on the engine/event-loop threads)."""
+        self._export = sink
+        self._active = True
+
+    def detach_export(self) -> None:
+        self._export = None
+        self._active = self._enabled or self._ring is not None
 
     # a serving process mints one lane per request trace-id: the name->tid
     # map must be bounded or it (and thread_metadata()) grows forever.
@@ -129,6 +144,13 @@ class Tracer:
                                   "args": {"name": tid}})
         return n
 
+    def lane_names(self) -> Dict[int, str]:
+        """Snapshot of the integer-tid -> lane-name map (request trace ids,
+        "train", ...).  Span-export batches carry this so the collector can
+        recover trace ids from the compact integer tids."""
+        with self._lock:
+            return {n: name for name, n in self._tids.items()}
+
     def thread_metadata(self) -> List[dict]:
         """Fresh thread_name metadata events for every known lane — the
         flight recorder prepends these to a ring dump, where the original
@@ -141,6 +163,9 @@ class Tracer:
         ring = self._ring
         if ring is not None:
             ring.append(ev)         # deque(maxlen): bounded, oldest out
+        exp = self._export
+        if exp is not None:
+            exp.offer(ev)           # bounded ring append, never blocks
         if not self._enabled:
             return
         cap = self._max

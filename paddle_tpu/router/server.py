@@ -196,6 +196,10 @@ class RouterServer:
         self.cp = controlplane
         self._discover_replicas = bool(discover_replicas)
         self._cp_task: Optional[asyncio.Task] = None
+        # fleet tracing (ISSUE 20): the launcher / supervisor attaches a
+        # TraceCollector here; /tracez serves its merged timelines and
+        # /collectz is its direct-HTTP span ingest
+        self.collector = None
         self._t0 = time.perf_counter()
         self._next_rid = 0
         self._health_tasks: Dict[str, asyncio.Task] = {}
@@ -479,7 +483,11 @@ class RouterServer:
                 "prompt": list(entry.prompt),
                 "emitted": list(entry.emitted),
                 "payload": entry.payload,
-                "max_tokens": entry.max_tokens})
+                "max_tokens": entry.max_tokens,
+                # ISSUE 20 satellite: the originating trace id rides the
+                # replicated journal so a surviving router's takeover
+                # resume continues the SAME trace lane
+                "trace_id": entry.trace_id})
         except Exception:
             pass
 
@@ -522,7 +530,7 @@ class RouterServer:
                 pass
 
     async def _route(self, method, path, headers, body, writer) -> int:
-        path = path.split("?", 1)[0]
+        path, _, query = path.partition("?")
         if path == "/metrics" and method == "GET":
             text = _obs.prometheus_text().encode()
             writer.write(_http.response(
@@ -554,14 +562,81 @@ class RouterServer:
             return 200
         if path == "/v1/completions" and method == "POST":
             return await self._completions(headers, body, writer)
+        if path == "/tracez" and method == "GET":
+            return await self._tracez(query, writer)
+        if path == "/collectz" and method == "POST":
+            return await self._collectz(body, writer)
         if path in ("/metrics", "/healthz", "/readyz", "/statusz",
-                    "/v1/completions"):
+                    "/v1/completions", "/tracez", "/collectz"):
             writer.write(_http.error_response(405, f"{method} not allowed"))
             await writer.drain()
             return 405
         writer.write(_http.error_response(404, f"no route {path}"))
         await writer.drain()
         return 404
+
+    # ------------------------------------------- fleet tracing (ISSUE 20) --
+    async def _tracez(self, query, writer) -> int:
+        """``GET /tracez?trace_id=`` — the merged, clock-aligned fleet
+        timeline for one request from the attached ``TraceCollector``
+        (the fleet launcher / tests wire ``router.collector``); without
+        ``trace_id``, an index of known traces."""
+        col = self.collector
+        if col is None:
+            writer.write(_http.error_response(
+                503, "no trace collector attached to this router"))
+            await writer.drain()
+            return 503
+        trace_id = None
+        if query:
+            from urllib.parse import parse_qs
+            trace_id = (parse_qs(query).get("trace_id") or [None])[0]
+        if not trace_id:
+            ids = col.traces()
+            writer.write(_http.json_response(
+                200, {"traces": ids[-64:], "known": len(ids),
+                      "processes": col.processes()}))
+            await writer.drain()
+            return 200
+        doc = col.assemble(trace_id)
+        if doc is None:
+            writer.write(_http.error_response(
+                404, f"no spans collected for trace {trace_id!r}"))
+            await writer.drain()
+            return 404
+        writer.write(_http.json_response(200, doc))
+        await writer.drain()
+        return 200
+
+    async def _collectz(self, body, writer) -> int:
+        """``POST /collectz`` — span-export ingest (the direct-HTTP
+        transport for processes with no control-plane store) and the
+        ``{"op": "clock"}`` handshake probe.  Ingest is one dict fold
+        into the collector's in-memory store — cheap enough for the
+        event loop; the response timestamp doubles as the NTP-style
+        server time."""
+        col = self.collector
+        if col is None:
+            writer.write(_http.error_response(
+                503, "no trace collector attached to this router"))
+            await writer.drain()
+            return 503
+        try:
+            doc = json.loads(body.decode() or "{}")
+            if not isinstance(doc, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as e:
+            writer.write(_http.error_response(400, f"bad JSON body: {e}"))
+            await writer.drain()
+            return 400
+        if doc.get("op") == "clock":
+            writer.write(_http.json_response(200, {"t": col.now()}))
+            await writer.drain()
+            return 200
+        resp = col.ingest(doc)
+        writer.write(_http.json_response(200, resp))
+        await writer.drain()
+        return 200
 
     # -------------------------------------------------------- completions --
     def _candidates(self, include_shedding: bool = False
@@ -722,6 +797,7 @@ class RouterServer:
                               cat="router", tid=trace_id,
                               args={"trace_id": trace_id,
                                     "stream": stream,
+                                    "proc": f"router:{self.router_id}",
                                     "prompt_tokens": len(prompt)})
         return code
 
@@ -786,6 +862,13 @@ class RouterServer:
             # relayed yet (a fresh serve replays from scratch anyway)
             self.cp.takeover("stale")
             return None
+        # trace continuity (ISSUE 20 satellite): the journaled record
+        # carries the ORIGINATING request's trace id — resume on that
+        # lane (it is the same logical request; only the router died),
+        # so the takeover leg joins the original merged timeline
+        orig = rec.get("trace_id")
+        if isinstance(orig, str) and orig and _TRACE_ID_OK(orig):
+            trace_id = orig
         return await self._takeover_resume(trace_id, session_id, prompt,
                                            payload, emitted, candidates,
                                            writer, sig)
@@ -804,6 +887,15 @@ class RouterServer:
             self.journal.finish(entry)
             self.cp.takeover("stale")
             return None
+        if _obs.TRACER.enabled:
+            # the takeover marker on the originating lane: tail-kept by
+            # the span exporter regardless of sampling
+            _obs.TRACER.instant("router.takeover", cat="router",
+                                tid=trace_id,
+                                args={"trace_id": trace_id,
+                                      "proc": f"router:{self.router_id}",
+                                      "session": session_id,
+                                      "replayed": len(emitted)})
         writer.write(_http.sse_headers((
             ("X-Router-Replica", "takeover"),)))
         writer.write(_http.sse_event({
@@ -903,32 +995,51 @@ class RouterServer:
         prefix-cache nodes (``resume: false`` — the ROUTER re-dispatches
         the stream itself; ``handoff: true`` so the replica counts
         ``serving.kv.handoff_*``).  Returns ``"ok"`` /
-        ``"export_failed"`` / ``"import_failed"``."""
+        ``"export_failed"`` / ``"import_failed"``.
+
+        Trace propagation (ISSUE 20 satellite): the journal entry's
+        trace id rides both migration bodies, so the export/import legs
+        land as ``migrate.*`` spans on the ORIGINATING request's lane on
+        both replicas — and the transfer itself is a ``router.handoff``
+        span on the same lane — one merged fleet timeline per request
+        instead of three disjoint ones."""
         t = self._handoff_timeout_s
+        t0 = time.perf_counter()
+        verdict = "ok"
         try:
             status, doc = await self._post_json(
                 src.client, "/migratez/export",
-                {"tokens": entry.full_tokens}, t)
+                {"tokens": entry.full_tokens,
+                 "trace_id": entry.trace_id}, t)
             sessions = doc.get("sessions") if status == 200 else None
         except Exception:
             sessions = None
         if not sessions:
-            return "export_failed"
-        try:
-            status, doc = await self._post_json(
-                dst.client, "/migratez/import",
-                {"sessions": sessions, "resume": False,
-                 "handoff": True}, t)
-        except Exception:
-            return "import_failed"
-        # a 200 with zero installed sessions (geometry mismatch,
-        # integrity rejection — per-snapshot isolation aborts inside
-        # the bulk import) left the successor with NO prefix: treat it
-        # as failed so the stream falls back instead of paying a full
-        # re-prefill on a decode replica
-        if status != 200 or int(doc.get("sessions") or 0) < 1:
-            return "import_failed"
-        return "ok"
+            verdict = "export_failed"
+        else:
+            try:
+                status, doc = await self._post_json(
+                    dst.client, "/migratez/import",
+                    {"sessions": sessions, "resume": False,
+                     "handoff": True, "trace_id": entry.trace_id}, t)
+            except Exception:
+                status, doc = 0, {}
+            # a 200 with zero installed sessions (geometry mismatch,
+            # integrity rejection — per-snapshot isolation aborts inside
+            # the bulk import) left the successor with NO prefix: treat
+            # it as failed so the stream falls back instead of paying a
+            # full re-prefill on a decode replica
+            if status != 200 or int(doc.get("sessions") or 0) < 1:
+                verdict = "import_failed"
+        if _obs.TRACER.enabled:
+            _obs.TRACER.event("router.handoff", t0,
+                              time.perf_counter() - t0, cat="router",
+                              tid=entry.trace_id,
+                              args={"trace_id": entry.trace_id,
+                                    "proc": f"router:{self.router_id}",
+                                    "src": src.id, "dst": dst.id,
+                                    "verdict": verdict})
+        return verdict
 
     async def _breaker_gate(self) -> Optional[str]:
         """Park a post-death re-dispatch while the cascade breaker is
@@ -1643,7 +1754,35 @@ def route_forever(replicas: List[ReplicaClient], *,
     """Blocking convenience entry: build the router and serve until
     killed (``python -m paddle_tpu.router`` wraps this)."""
     router = RouterServer(replicas, **kw)
+    # distributed tracing (ISSUE 20): a spawned router ships its span
+    # ring to the supervisor-owned collector — over the control-plane
+    # store when it joined one (the fleet launcher's tick drains
+    # ``trace/batch/*``), direct HTTP POST to FLAGS_trace_collector
+    # otherwise.
+    exporter = None
+    if float(flags.flag("trace_sample_rate")) > 0:
+        from ..observability.collector import (HttpTransport,
+                                               SpanExporter,
+                                               StoreTransport)
+        plane = kw.get("controlplane")
+        sc = getattr(plane, "store", None)
+        transport = None
+        if sc is not None and hasattr(sc, "host"):
+            from ..controlplane import SyncStoreClient
+            transport = StoreTransport(
+                SyncStoreClient(sc.host, sc.port))
+        elif str(flags.flag("trace_collector")):
+            transport = HttpTransport(str(flags.flag("trace_collector")))
+        if transport is not None:
+            rid = getattr(plane, "rid", None) or "router"
+            exporter = SpanExporter(transport,
+                                    proc=f"{rid}@{host}:{port}",
+                                    role="router")
+            exporter.start()
     try:
         asyncio.run(_route_async(router, host, port))
     except KeyboardInterrupt:
         pass
+    finally:
+        if exporter is not None:
+            exporter.close()

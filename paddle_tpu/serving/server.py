@@ -62,6 +62,10 @@ __all__ = ["ServingServer", "serve_forever"]
 
 _TRACE_ID_OK = _http.SAFE_ID_OK
 
+# process-wide server ordinal: the per-replica track tag in merged fleet
+# timelines (ISSUE 20)
+_SERVER_SEQ = 0
+
 
 class _HttpMetrics:
     """Registry handles for the HTTP layer, resolved once (the PR 5
@@ -190,6 +194,14 @@ class ServingServer:
         self._rid_lock = threading.Lock()
         self._m = _HttpMetrics()
         self._asyncio_server = None
+        # component identity for the fleet trace collector (ISSUE 20):
+        # stamped onto engine lifecycle spans and this server's HTTP
+        # spans so the merged timeline gets one track per replica even
+        # when several servers share a process (tests, the in-proc
+        # disagg bench)
+        global _SERVER_SEQ
+        _SERVER_SEQ += 1
+        self.trace_proc = f"{self.role}-{_SERVER_SEQ}"
 
     # ------------------------------------------------------- lifecycle --
     def start(self) -> "ServingServer":
@@ -198,6 +210,9 @@ class ServingServer:
             return self
         if self.flight_recorder is not None:
             self.flight_recorder.attach()
+        # tag the engine's retroactive lifecycle spans with this
+        # replica's identity for the fleet collector's per-track merge
+        self.engine.trace_proc = self.trace_proc
         self._stop.clear()
         self._dead = False
         self._draining = False
@@ -686,6 +701,7 @@ class ServingServer:
                     "body needs one of req_id / tokens / all")
             return [_mig.to_wire(s) for s in snaps]
 
+        t0 = time.perf_counter()
         loop = asyncio.get_running_loop()
         try:
             snaps = await loop.run_in_executor(
@@ -700,6 +716,26 @@ class ServingServer:
                 err_type="internal_error"))
             await writer.drain()
             return 503
+        # trace propagation (ISSUE 20 satellite): a handoff/takeover leg
+        # joins the ORIGINATING request's trace lane — the caller's
+        # trace id rides the body, is stamped onto snapshots that lack
+        # one (token-chain exports), and the export itself becomes a
+        # span on that lane instead of starting a fresh one
+        trace_id = payload.get("trace_id")
+        if isinstance(trace_id, str) and trace_id and _TRACE_ID_OK(trace_id):
+            for s in snaps:
+                if not s.get("trace_id"):
+                    s["trace_id"] = trace_id
+        else:
+            trace_id = next((s.get("trace_id") for s in snaps
+                             if s.get("trace_id")), None)
+        if _obs.TRACER.enabled and trace_id:
+            _obs.TRACER.event("migrate.export", t0,
+                              time.perf_counter() - t0, cat="migration",
+                              tid=trace_id,
+                              args={"trace_id": trace_id,
+                                    "proc": self.trace_proc,
+                                    "sessions": len(snaps)})
         writer.write(_http.json_response(200, {"sessions": snaps}))
         await writer.drain()
         return 200
@@ -741,6 +777,20 @@ class ServingServer:
             await writer.drain()
             return 503
         resume = bool(payload.get("resume", False))
+        # trace propagation (ISSUE 20 satellite): stamp the caller's
+        # trace id onto snapshots that lack one BEFORE import, so a
+        # resumed continuation request inherits the originating lane and
+        # its decode-leg lifecycle spans join the same merged timeline
+        trace_id = payload.get("trace_id")
+        if isinstance(trace_id, str) and trace_id and _TRACE_ID_OK(trace_id):
+            for s in sessions:
+                if isinstance(s, dict) and not s.get("trace_id"):
+                    s["trace_id"] = trace_id
+        else:
+            trace_id = next((s.get("trace_id") for s in sessions
+                             if isinstance(s, dict) and s.get("trace_id")),
+                            None)
+        t0 = time.perf_counter()
         loop = asyncio.get_running_loop()
         try:
             result = await loop.run_in_executor(
@@ -760,6 +810,15 @@ class ServingServer:
             # of the shipped prefix this successor must re-prefill —
             # the acceptance lever is 0 full pages
             _mig.record_handoff(sessions, result)
+        if _obs.TRACER.enabled and trace_id:
+            _obs.TRACER.event("migrate.import", t0,
+                              time.perf_counter() - t0, cat="migration",
+                              tid=trace_id,
+                              args={"trace_id": trace_id,
+                                    "proc": self.trace_proc,
+                                    "resume": resume,
+                                    "handoff": bool(payload.get("handoff")),
+                                    "sessions": len(sessions)})
         writer.write(_http.json_response(200, result))
         await writer.drain()
         return 200
@@ -908,6 +967,7 @@ class ServingServer:
                               cat="serving", tid=trace_id,
                               args={"trace_id": trace_id,
                                     "stream": stream,
+                                    "proc": self.trace_proc,
                                     "prompt_tokens": len(prompt)})
         return code
 
@@ -1144,9 +1204,24 @@ def serve_forever(engine, *, host: str = "127.0.0.1", port: int = 8000,
     server.start()
     server.install_drain_signal()     # BEFORE crash hooks: dump chains here
     server.install_crash_hooks()
+    # fleet span export (ISSUE 20): with a collector address configured
+    # (the fleet launcher passes its router's host:port down via
+    # --set trace_collector=...), ship this replica's spans over direct
+    # HTTP POST /collectz — host-side daemon thread, off the dispatch
+    # path, so the warm-step 0-compile/0-sync contract is untouched
+    exporter = None
+    addr = str(flags.flag("trace_collector"))
+    if addr and float(flags.flag("trace_sample_rate")) > 0:
+        from ..observability.collector import HttpTransport, SpanExporter
+        exporter = SpanExporter(
+            HttpTransport(addr),
+            proc=f"{server.trace_proc}@{host}:{port}",
+            role=server.role).start()
     try:
         asyncio.run(_serve_async(server, host, port))
     except KeyboardInterrupt:
         pass
     finally:
+        if exporter is not None:
+            exporter.close()
         server.close()

@@ -511,6 +511,30 @@ def test_jl005_covers_controlplane_package():
     assert ctx.findings == []
 
 
+def test_jl005_covers_trace_collector_module():
+    """ISSUE 20 satellite: the trace collector's ingest/clock faces are
+    called from the router's /collectz handler — an async def with
+    blocking calls there stalls span assembly on the serving loop."""
+    ctx = lint(_ASYNC_POS, rel="paddle_tpu/observability/collector.py",
+               select={"JL005"})
+    assert len(ctx.findings) == 3
+    # its sync verbs (SpanExporter's flush thread, the supervisor-tick
+    # poll_store) stay exempt: blocking there is the design
+    src = """
+        import time
+
+        def flush(self):
+            time.sleep(0.01)
+    """
+    ctx = lint(src, rel="paddle_tpu/observability/collector.py",
+               select={"JL005"})
+    assert ctx.findings == []
+    # the rest of observability/ is NOT in the async plane
+    ctx = lint(_ASYNC_POS, rel="paddle_tpu/observability/tracing.py",
+               select={"JL005"})
+    assert ctx.findings == []
+
+
 # ------------------------------------------------------------------ JL006 --
 
 def test_jl006_fires_on_request_data_labels():
@@ -627,6 +651,18 @@ def test_jl007_covers_controlplane_package():
             self.engine.step()
     """
     ctx = lint(src, rel="paddle_tpu/controlplane/plane.py",
+               select={"JL007"})
+    assert len(ctx.findings) == 1
+
+
+def test_jl007_covers_trace_collector_module():
+    """ISSUE 20 satellite: the collector assembles timelines FROM span
+    exports — it must never reach into an engine from an async def."""
+    src = """
+        async def assemble(self, trace_id):
+            self.engine.step()
+    """
+    ctx = lint(src, rel="paddle_tpu/observability/collector.py",
                select={"JL007"})
     assert len(ctx.findings) == 1
 

@@ -1,0 +1,538 @@
+"""Fleet-wide distributed tracing (ISSUE 20): span export, clock-aligned
+assembly, and end-to-end request timelines.
+
+Units first (clock-offset estimator, sampling, tail-keep), then the
+export pipeline over each transport, then assembly semantics (tracks,
+flow ordering, critical-path sweep), and finally the flagship 2-router +
+2-replica in-process test: a session owned by the OTHER router forwards
+one hop, hands off prefill -> decode over the migration plane, and the
+collector renders ONE merged timeline with the handoff flow events in
+dispatch -> admit -> export -> import -> decode order.
+"""
+
+import asyncio
+import json
+import os
+import time
+import types
+import zlib
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import flags
+from paddle_tpu import observability as obs
+from paddle_tpu.controlplane import LocalStore, RouterControlPlane, StoreState
+from paddle_tpu.inference import ContinuousBatchingEngine, GenerationConfig
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability.collector import (STORE_BATCH_PREFIX,
+                                                ClockSync, InprocTransport,
+                                                SpanExporter, StoreTransport,
+                                                TraceCollector, _keep_event,
+                                                _sampled)
+from paddle_tpu.observability.flight_recorder import FlightRecorder
+from paddle_tpu.observability.tracing import Tracer
+from paddle_tpu.router import InprocReplica, RouterServer
+from paddle_tpu.serving import ServingServer
+
+from test_disagg import do
+from test_fleet import _sup
+from test_serving_http import completion_body
+
+
+# ---------------------------------------------------------------------------
+# units: clock sync / sampling / tail-keep
+# ---------------------------------------------------------------------------
+
+def test_clock_sync_keeps_tightest_round_trip():
+    cs = ClockSync(drift_s=0.005)
+    cs.observe(10.0, 20.25, 10.5)                 # rtt 0.5 -> offset 10.0
+    assert cs.offset == pytest.approx(10.0)
+    assert cs.rtt == pytest.approx(0.5)
+    # a tighter bracket is strictly better: adopted, no resync counted
+    cs.observe(11.0, 21.002, 11.0)
+    assert cs.offset == pytest.approx(10.002)
+    assert (cs.rtt, cs.resyncs) == (0.0, 0)
+
+
+def test_clock_sync_jitter_tolerant_but_resyncs_on_drift():
+    cs = ClockSync(drift_s=0.005)
+    cs.observe(0.0, 10.0, 0.002)                  # held: offset ~10, rtt 2ms
+    held = cs.offset
+    # looser round trip disagreeing by less than threshold + rtt/2: the
+    # jitter explains it, the held estimate stands
+    cs.observe(1.0, 11.05, 1.2)                   # rtt 0.2 -> slack 0.105
+    assert cs.offset == pytest.approx(held)
+    assert cs.resyncs == 0
+    # disagreement beyond what the round trip explains: the clock moved
+    cs.observe(2.0, 12.5, 2.02)                   # off ~10.49 vs held ~10.0
+    assert cs.offset == pytest.approx(12.5 - 2.01)
+    assert cs.resyncs == 1
+    assert cs.samples == 3
+
+
+def test_sampling_is_a_stable_per_trace_hash():
+    assert _sampled("tr-x", 1.0) and not _sampled("tr-x", 0.0)
+    ids = [f"tr-{i}" for i in range(200)]
+    kept = [t for t in ids if _sampled(t, 0.5)]
+    assert 0 < len(kept) < len(ids)               # it actually samples
+    # deterministic: every process keeps/drops the SAME traces
+    assert kept == [t for t in ids if _sampled(t, 0.5)]
+    frac = (zlib.crc32(b"tr-x") & 0xFFFFFFFF) / 2**32
+    assert _sampled("tr-x", frac + 1e-6) and not _sampled("tr-x", frac)
+
+
+def test_keep_markers_match_name_cat_and_outcome_args():
+    assert _keep_event({"name": "router.handoff"})
+    assert _keep_event({"name": "kv.ship", "cat": "migrate.export"})
+    assert _keep_event({"name": "x", "args": {"outcome": "shed"}})
+    assert _keep_event({"name": "x", "args": {"reason": "failover"}})
+    assert not _keep_event({"name": "engine.step", "cat": "host"})
+
+
+# ---------------------------------------------------------------------------
+# the export pipeline (in-process transport)
+# ---------------------------------------------------------------------------
+
+def test_exporter_ships_named_lanes_and_skips_metadata():
+    col = TraceCollector()
+    tr = Tracer()
+    exp = SpanExporter(InprocTransport(col), proc="p0", role="replica",
+                       tracer=tr, sample_rate=1.0, batch=1)
+    tr.attach_export(exp)
+    try:
+        exp.probe_clock()
+        tr.event("req0.prefill", 1.0, 0.1, tid="tr-a")
+        tr.event("req0.decode", 1.1, 0.2, tid="tr-a")
+        assert exp.flush() == 2                   # lane-metadata M skipped
+    finally:
+        tr.detach_export()
+    assert col.traces() == ["tr-a"]
+    proc = col.processes()["p0"]
+    assert proc["role"] == "replica"
+    assert proc["seq"] == 1                       # batch=1 -> two batches
+    assert col.track_names("tr-a") == ["p0/p0"]
+
+
+def test_exporter_ring_is_bounded_and_drops_count():
+    before = obs.metrics.counter(
+        "observability.collector.export_dropped").value
+    exp = SpanExporter(InprocTransport(TraceCollector()), proc="p1",
+                       tracer=Tracer(), max_events=2)
+    for i in range(5):
+        exp.offer({"ph": "X", "name": f"e{i}"})
+    assert len(exp._buf) == 2                     # oldest evicted
+    assert obs.metrics.counter(
+        "observability.collector.export_dropped").value - before == 3
+
+
+def test_sampled_out_traces_tail_keep_on_handoff_markers():
+    col = TraceCollector()
+    tr = Tracer()
+    exp = SpanExporter(InprocTransport(col), proc="p2", tracer=tr,
+                       sample_rate=0.0)          # sample NOTHING...
+    tr.attach_export(exp)
+    try:
+        tr.event("plain.step", 1.0, 0.1, tid="tr-plain")
+        tr.event("router.handoff", 1.0, 0.1, tid="tr-hand")
+        tr.event("shed.refuse", 1.0, 0.1)         # unnamed lane, keep mark
+        tr.event("engine.step", 1.0, 0.1)         # unnamed lane, plain
+        assert exp.flush() == 2                   # handoff + shed only
+        assert col.traces() == ["tr-hand"]
+        # ...and the keep decision is STICKY: later plain spans of the
+        # marked trace still ship, the unmarked trace still does not
+        tr.event("later.decode", 1.2, 0.1, tid="tr-hand")
+        tr.event("later.step", 1.2, 0.1, tid="tr-plain")
+        assert exp.flush() == 1
+    finally:
+        tr.detach_export()
+    assert [e["name"] for e in col.assemble("tr-hand")["traceEvents"]
+            if e.get("ph") == "X"] == ["router.handoff", "later.decode"]
+
+
+def test_store_transport_roundtrip_and_supervisor_poll():
+    state = StoreState()
+    col = TraceCollector()
+    tr = Tracer()
+    exp = SpanExporter(StoreTransport(state), proc="p9", tracer=tr,
+                       sample_rate=1.0)
+    tr.attach_export(exp)
+    try:
+        exp.probe_clock()                         # brackets __now__
+        assert exp.clock_sync.samples == 1
+        tr.event("req1.decode", 1.0, 0.1, tid="tr-store")
+        assert exp.flush() == 1
+    finally:
+        tr.detach_export()
+    keys = state.members(STORE_BATCH_PREFIX)
+    assert list(keys) == [f"{STORE_BATCH_PREFIX}p9/0"]
+    assert col.poll_store(state) == 1
+    assert col.traces() == ["tr-store"]
+    # drained batches are deleted: the next poll is a no-op
+    assert state.members(STORE_BATCH_PREFIX) == {}
+    assert col.poll_store(state) == 0
+
+
+def test_supervisor_tick_drains_store_and_registers_rings():
+    state = StoreState()
+    col = TraceCollector()
+    sup, router, handles = _sup(1, store=state, collector=col)
+    sup.start()
+    h = sup._slots[0].handle
+    h.ready_now = True
+    fr = FlightRecorder(path="unused.json", max_events=8,
+                        tracer=Tracer())
+    h.server = types.SimpleNamespace(flight_recorder=fr)
+    state.set(f"{STORE_BATCH_PREFIX}px/0",
+              {"proc": "px", "events": [{"ph": "X", "name": "req2.decode",
+                                         "tid": 1, "ts": 1.0, "dur": 1.0}],
+               "lanes": {"1": "tr-sup"}, "offset_us": 0.0})
+    sup.tick()
+    assert col.traces() == ["tr-sup"]             # store drained
+    assert state.members(STORE_BATCH_PREFIX) == {}
+    assert h.id in col._rings                     # ring registered at READY
+    sup._deregister(sup._slots[0])
+    assert h.id not in col._rings
+
+
+# ---------------------------------------------------------------------------
+# clock-aligned assembly under skew (the satellite contract)
+# ---------------------------------------------------------------------------
+
+def test_skewed_process_clocks_align_to_a_monotonic_timeline():
+    """±500ms injected skew: process A runs 0.5s fast, B 0.5s slow, so
+    the RAW timestamps order A's earlier work after B's later work.  The
+    offset handshake (rtt 0 with fake clocks -> exact midpoint) must
+    recover the true order on the collector axis."""
+    world = {"t": 100.0}
+    col = TraceCollector(clock=lambda: world["t"])
+    tr_a, tr_b = Tracer(), Tracer()
+    exp_a = SpanExporter(InprocTransport(col), proc="A", tracer=tr_a,
+                         clock=lambda: world["t"] + 0.5, sample_rate=1.0)
+    exp_b = SpanExporter(InprocTransport(col), proc="B", tracer=tr_b,
+                         clock=lambda: world["t"] - 0.5, sample_rate=1.0)
+    tr_a.attach_export(exp_a)
+    tr_b.attach_export(exp_b)
+    try:
+        exp_a.probe_clock()
+        exp_b.probe_clock()
+        assert exp_a.clock_sync.offset == pytest.approx(-0.5)
+        assert exp_b.clock_sync.offset == pytest.approx(+0.5)
+        # true order: A works at world 101.0, B at world 101.2 — but A
+        # STAMPS 101.5 and B stamps 100.7 (raw order inverted)
+        tr_a.event("leg.a", 101.5, 0.1, tid="tr-skew")
+        tr_b.event("leg.b", 100.7, 0.1, tid="tr-skew")
+        assert exp_a.flush() == 1 and exp_b.flush() == 1
+    finally:
+        tr_a.detach_export()
+        tr_b.detach_export()
+    doc = col.assemble("tr-skew")
+    ts = {e["name"]: e["ts"] for e in doc["traceEvents"]
+          if e.get("ph") == "X"}
+    assert ts["leg.a"] == pytest.approx(101.0e6)
+    assert ts["leg.b"] == pytest.approx(101.2e6)
+    assert ts["leg.a"] < ts["leg.b"]              # monotonic merged order
+    assert set(doc["metadata"]["processes"]) == {"A/A", "B/B"}
+
+
+def test_offset_reestimated_when_the_clock_drifts():
+    """A process clock that jumps mid-run: the next (looser-rtt)
+    handshake disagrees beyond its jitter and is re-adopted, counted as
+    a resync; same-magnitude jitter WITHOUT real drift is not."""
+    world = {"t": 0.0}
+    skew, rtt = [0.0], [0.001]
+
+    class DriftTransport:
+        def clock(self):
+            t = world["t"]
+            world["t"] = t + rtt[0]               # the round trip itself
+            return t + rtt[0] / 2 + skew[0]
+
+        def send(self, batch):
+            pass
+
+    exp = SpanExporter(DriftTransport(), proc="d", tracer=Tracer(),
+                       clock=lambda: world["t"])
+    exp.probe_clock()
+    assert exp.clock_sync.offset == pytest.approx(0.0)
+    skew[0], rtt[0] = 0.1, 0.002                  # the clock MOVED 100ms
+    exp.probe_clock()
+    assert exp.clock_sync.offset == pytest.approx(0.1)
+    assert exp.clock_sync.resyncs == 1
+    skew[0], rtt[0] = 0.102, 0.004                # jitter, not drift
+    exp.probe_clock()
+    assert exp.clock_sync.offset == pytest.approx(0.1)
+    assert exp.clock_sync.resyncs == 1
+
+
+# ---------------------------------------------------------------------------
+# assembly: tracks, flow ordering, critical path
+# ---------------------------------------------------------------------------
+
+def _batch(proc, lanes, events, offset_us=0.0, role=""):
+    return {"proc": proc, "pid": 1, "role": role, "seq": 0,
+            "offset_us": offset_us, "rtt_us": 0.0, "lanes": lanes,
+            "events": events}
+
+
+def _x(name, ts, dur, tid=1, proc=None):
+    ev = {"ph": "X", "name": name, "cat": "host", "pid": 0, "tid": tid,
+          "ts": float(ts), "dur": float(dur)}
+    if proc:
+        ev["args"] = {"proc": proc}
+    return ev
+
+
+def test_assemble_merges_tracks_and_orders_flow_events():
+    col = TraceCollector()
+    col.ingest(_batch("rt0", {"1": "tr-9"}, [
+        _x("router.request", 900, 5100, proc="router:rt0")], role="router"))
+    col.ingest(_batch("replica-a", {"5": "tr-9"}, [
+        _x("http.request", 1000, 2500, tid=5, proc="prefill-1"),
+        _x("req0.queued", 1500, 500, tid=5, proc="prefill-1"),
+        _x("req0.prefill", 2000, 1000, tid=5, proc="prefill-1"),
+        _x("migrate.export", 3000, 400, tid=5, proc="prefill-1")]))
+    # the decode replica's clock reads 100µs slow: its batch carries the
+    # measured offset and ingest aligns the spans onto the shared axis
+    col.ingest(_batch("replica-b", {"7": "tr-9"}, [
+        _x("migrate.import", 3500, 400, tid=7, proc="decode-1"),
+        _x("req0.decode", 3900, 2000, tid=7, proc="decode-1")],
+        offset_us=100.0))
+    doc = col.assemble("tr-9")
+    assert set(doc["metadata"]["processes"]) == \
+        {"rt0/router:rt0", "replica-a/prefill-1", "replica-b/decode-1"}
+    ts = {e["name"]: e["ts"] for e in doc["traceEvents"]
+          if e.get("ph") == "X"}
+    assert ts["migrate.import"] == pytest.approx(3600.0)  # aligned +100
+    flows = [e for e in doc["traceEvents"] if e.get("cat") == "flow"]
+    assert [f["ph"] for f in flows] == ["s", "t", "t", "t", "t", "f"]
+    assert [f["ts"] for f in flows] == sorted(f["ts"] for f in flows)
+    assert flows[-1]["bp"] == "e"
+    assert all(f["id"] == flows[0]["id"] for f in flows)
+    # the handoff stitches export -> import across DIFFERENT tracks
+    by_name = {e["name"]: e for e in doc["traceEvents"]
+               if e.get("ph") == "X"}
+    assert by_name["migrate.export"]["pid"] != \
+        by_name["migrate.import"]["pid"]
+    assert by_name["migrate.export"]["ts"] < by_name["migrate.import"]["ts"]
+    cp = doc["metadata"]["critical_path"]
+    assert cp["phases_ms"] == {"queue": 0.5, "prefill": 1.0,
+                               "transfer": 1.0, "decode": 2.0}
+    assert sum(cp["phases_ms"].values()) == pytest.approx(cp["total_ms"])
+    assert cp["total_ms"] == pytest.approx(4.5)
+
+
+def test_critical_path_classifies_destination_reprefill_as_replay():
+    col = TraceCollector()
+    col.ingest(_batch("pa", {"1": "tr-rp"}, [
+        _x("req7.prefill", 1000, 1000),
+        _x("migrate.export", 2000, 500)]))
+    col.ingest(_batch("pb", {"2": "tr-rp"}, [
+        _x("req7.prefill", 3000, 800, tid=2),    # other track, post-export
+        _x("req7.decode", 3800, 1200, tid=2)]))
+    cp = col.critical_path("tr-rp")
+    assert cp["phases_ms"] == {"prefill": 1.0, "transfer": 1.0,
+                               "replay": 0.8, "decode": 1.2}
+    assert sum(cp["phases_ms"].values()) == pytest.approx(cp["total_ms"])
+
+
+def test_fleet_dump_merges_rings_with_aligned_spans(tmp_path):
+    col = TraceCollector()
+    now_us = time.perf_counter() * 1e6
+    col.register_ring("r0", lambda: [
+        {"ph": "X", "name": "ring.span", "pid": 0, "tid": 0,
+         "ts": now_us, "dur": 1.0}])
+    col.ingest(_batch("pz", {"1": "tr-fd"}, [
+        _x("req3.decode", now_us, 1000)]))
+    path = col.fleet_dump(reason="test", path=str(tmp_path / "fd.json"))
+    doc = json.loads(open(path).read())
+    names = [e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert names == ["ring:r0", "collector (aligned spans)"]
+    assert "tr-fd" in [e["args"]["name"] for e in doc["traceEvents"]
+                       if e.get("ph") == "M" and e["name"] == "thread_name"]
+    assert any(e.get("name") == "ring.span" for e in doc["traceEvents"])
+    assert any(e.get("name") == "req3.decode" for e in doc["traceEvents"])
+    assert doc["metadata"]["rings"] == ["r0"]
+
+
+def test_anomaly_span_triggers_fleet_correlated_dump(tmp_path):
+    old_path = flags.flag("flight_recorder_path")
+    old_gap = flags.flag("flight_recorder_min_interval_s")
+    flags.set_flags({"flight_recorder_path": str(tmp_path / "fr.json"),
+                     "flight_recorder_min_interval_s": 0.0})
+    try:
+        col = TraceCollector()
+        dumps = obs.metrics.counter(
+            "observability.collector.fleet_dumps").value
+        col.ingest(_batch("ps", {"1": "tr-an"}, [
+            _x("sentinel.anomaly", 1000, 0)]))
+        assert (tmp_path / "fr_fleet_anomaly.json").exists()
+        assert obs.metrics.counter(
+            "observability.collector.fleet_dumps").value - dumps == 1
+    finally:
+        flags.set_flags({"flight_recorder_path": old_path,
+                         "flight_recorder_min_interval_s": old_gap})
+
+
+def test_flight_recorder_dump_filename_carries_the_process_tag(tmp_path):
+    fr = FlightRecorder(path=str(tmp_path / "fr.json"), max_events=8,
+                        min_interval_s=0.0, tracer=Tracer())
+    out = fr.dump(reason="sigterm")
+    assert out.endswith(f"_sigterm_p{os.getpid()}.json")
+    assert os.path.exists(out)
+
+
+# ---------------------------------------------------------------------------
+# the router's /tracez and /collectz surfaces
+# ---------------------------------------------------------------------------
+
+def test_router_tracez_and_collectz_endpoints():
+    router = RouterServer([], allow_empty=True, health_interval_s=1e9)
+
+    async def main():
+        out = {}
+        out["no_col"] = await do(router, "GET", "/tracez")
+        router.collector = TraceCollector()
+        out["clock"] = await do(router, "POST", "/collectz",
+                                json.dumps({"op": "clock"}).encode())
+        out["bad"] = await do(router, "POST", "/collectz", b"{nope")
+        batch = _batch("pe", {"1": "tr-ep"}, [_x("req0.decode", 1000, 500)])
+        out["ingest"] = await do(router, "POST", "/collectz",
+                                 json.dumps(batch).encode())
+        out["index"] = await do(router, "GET", "/tracez")
+        out["miss"] = await do(router, "GET", "/tracez?trace_id=nope")
+        out["hit"] = await do(router, "GET", "/tracez?trace_id=tr-ep")
+        return out
+
+    out = asyncio.run(main())
+    assert out["no_col"][0] == 503
+    assert out["clock"][0] == 200
+    assert json.loads(out["clock"][2])["t"] > 0
+    assert out["bad"][0] == 400
+    assert out["ingest"][0] == 200
+    idx = json.loads(out["index"][2])
+    assert idx["traces"] == ["tr-ep"] and idx["known"] == 1
+    assert "pe" in idx["processes"]
+    assert out["miss"][0] == 404
+    doc = json.loads(out["hit"][2])
+    assert out["hit"][0] == 200
+    assert doc["metadata"]["trace_id"] == "tr-ep"
+    assert any(e.get("name") == "req0.decode" for e in doc["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# flagship: 2 routers + 2 replicas, one merged handed-off timeline
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("gen", GenerationConfig(max_new_tokens=6))
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_bucket", 8)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+PROMPT = list(range(1, 17))                       # 2 full pages of 8
+
+
+@pytest.fixture(scope="module")
+def oracle(model):
+    eng = _engine(model, gen=GenerationConfig(max_new_tokens=64))
+    rid = eng.add_request(list(PROMPT))
+    return eng.run()[rid]
+
+
+def test_two_router_two_replica_handoff_assembles_one_timeline(
+        model, oracle):
+    """The ISSUE 20 assembly contract, in process: a session owned by
+    rt1 is POSTed to rt0 (one hop forward carries X-Trace-Id), rt1
+    prefills on the prefill replica, hands the prefix off to the decode
+    replica, and the collector renders ONE merged timeline — router +
+    both replica legs on one clock axis, flow anchors in export-before-
+    import order, critical path covering prefill/transfer/decode."""
+    obs.reset("router.")
+    col = TraceCollector()
+    exp = SpanExporter(InprocTransport(col), proc="fleet", role="test",
+                       sample_rate=1.0)
+    obs.TRACER.attach_export(exp)
+    state = StoreState()
+    servers = [ServingServer(_engine(model, prefix_cache=True), role=role,
+                             slo=False, flight_recorder=False).start()
+               for role in ("prefill", "decode")]
+    planes, routers = [], []
+    for i in range(2):
+        plane = RouterControlPlane(f"rt{i}", LocalStore(state))
+        replicas = [InprocReplica(f"r{j}", s)
+                    for j, s in enumerate(servers)]
+        planes.append(plane)
+        routers.append(RouterServer(replicas, policy="scored",
+                                    controlplane=plane,
+                                    health_interval_s=1e9))
+    for i, plane in enumerate(planes):
+        for j, router in enumerate(routers):
+            if i != j:
+                plane.register_peer(f"rt{j}", InprocReplica(f"rt{j}",
+                                                            router))
+    try:
+        exp.probe_clock()
+
+        async def main():
+            for _ in range(2):
+                for r in routers:
+                    await r.cp_tick()
+            for r in routers:
+                await r.poll_replicas()
+            sid = next(f"sess-{n}" for n in range(10_000)
+                       if planes[0].owner(f"sess-{n}") == "rt1")
+            return await do(
+                routers[0], "POST", "/v1/completions",
+                completion_body(PROMPT, 12, stream=True),
+                headers=[("X-Session-Id", sid),
+                         ("X-Trace-Id", "tr-flagship")])
+
+        status, headers, body = asyncio.run(main())
+        assert status == 200
+        assert headers["x-router-owner"] == "rt1"     # the hop happened
+        assert int(obs.metrics.counter("router.handoff",
+                                       outcome="ok").value) == 1
+        exp.flush()
+    finally:
+        obs.TRACER.detach_export()
+        for s in servers:
+            s.close()
+
+    assert "tr-flagship" in col.traces()
+    tracks = col.track_names("tr-flagship")
+    # the OWNER router's span proves the trace id crossed the forward
+    # hop; both replica legs land on their own role-tagged tracks
+    assert "fleet/router:rt1" in tracks
+    assert any(t.startswith("fleet/prefill") for t in tracks)
+    assert any(t.startswith("fleet/decode") for t in tracks)
+    assert len(tracks) >= 3
+
+    doc = col.assemble("tr-flagship")
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    by_name = {}
+    for e in evs:
+        by_name.setdefault(e["name"], e)
+    assert "router.request" in by_name
+    assert by_name["migrate.export"]["ts"] < by_name["migrate.import"]["ts"]
+    assert by_name["migrate.export"]["pid"] != \
+        by_name["migrate.import"]["pid"]              # across the handoff
+    flows = [e for e in doc["traceEvents"] if e.get("cat") == "flow"]
+    assert len(flows) >= 4
+    assert flows[0]["ph"] == "s" and flows[-1]["ph"] == "f"
+    assert [f["ts"] for f in flows] == sorted(f["ts"] for f in flows)
+    cp = doc["metadata"]["critical_path"]
+    for phase in ("prefill", "transfer", "decode"):
+        assert cp["phases_ms"].get(phase, 0) > 0
+    assert sum(cp["phases_ms"].values()) == pytest.approx(cp["total_ms"])
+    assert cp["total_ms"] > 0
